@@ -1,0 +1,188 @@
+"""Action-level integration tests without a cluster — the reference's key
+test pattern (pkg/scheduler/actions/allocate/allocate_test.go:43-232): build
+a real SchedulerCache by hand, inject FakeBinder, open a real Session with
+real plugins, run the real action, assert on recorded bindings.
+
+The same fixtures run against every allocate engine (callbacks / tpu-strict /
+tpu-fused) — the decision-parity gate of BASELINE.md.
+"""
+
+import pytest
+
+from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
+                             QueueInfo, Resource, TaskInfo, TaskStatus)
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.framework import (Configuration, PluginOption, Tier,
+                                   close_session, open_session)
+from volcano_tpu.framework.arguments import Arguments
+from volcano_tpu.actions import AllocateAction
+import volcano_tpu.plugins  # noqa: F401  (registers plugins)
+
+ENGINES = ["callbacks", "tpu-strict", "tpu-fused"]
+
+
+def build_node(name, cpu, mem, pods=100):
+    alloc = Resource(cpu, mem)
+    alloc.max_task_num = pods
+    return NodeInfo(name=name, allocatable=alloc)
+
+
+def build_job(name, queue, min_avail, task_reqs, namespace="default",
+              phase=PodGroupPhase.INQUEUE, priority=0):
+    pg = PodGroup(name=name, namespace=namespace, queue=queue,
+                  min_member=min_avail, phase=phase)
+    job = JobInfo(uid=name, name=name, namespace=namespace, queue=queue,
+                  min_available=min_avail, podgroup=pg, priority=priority)
+    for i, (cpu, mem) in enumerate(task_reqs):
+        job.add_task_info(TaskInfo(uid=f"{name}-{i}", name=f"{name}-{i}",
+                                   namespace=namespace, job=name,
+                                   resreq=Resource(cpu, mem),
+                                   creation_timestamp=float(i)))
+    return job
+
+
+def build_cache(jobs, nodes, queues=None):
+    binder = FakeBinder()
+    cache = SchedulerCache(binder=binder, evictor=FakeEvictor())
+    for q in (queues or [QueueInfo(name="default", weight=1)]):
+        cache.add_queue(q)
+    for n in nodes:
+        cache.add_node(n)
+    for j in jobs:
+        cache.add_job(j)
+    return cache, binder
+
+
+def default_tiers():
+    return [
+        Tier(plugins=[PluginOption("priority"), PluginOption("gang")]),
+        Tier(plugins=[PluginOption("drf"), PluginOption("predicates"),
+                      PluginOption("proportion"), PluginOption("nodeorder"),
+                      PluginOption("binpack")]),
+    ]
+
+
+def run_allocate(cache, engine, tiers=None):
+    ssn = open_session(cache, tiers or default_tiers(), [])
+    AllocateAction(engine=engine).execute(ssn)
+    close_session(ssn)
+    return ssn
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+class TestAllocate:
+    def test_gang_fits(self, engine):
+        """One gang of 3 on two nodes with room for 2+1 -> all bind."""
+        job = build_job("j1", "default", 3, [(1000, 1000)] * 3)
+        nodes = [build_node("n1", 2000, 2000), build_node("n2", 1000, 1000)]
+        cache, binder = build_cache([job], nodes)
+        run_allocate(cache, engine)
+        assert len(binder.binds) == 3
+        targets = list(binder.binds.values())
+        assert targets.count("n1") == 2 and targets.count("n2") == 1
+
+    def test_gang_unsatisfiable_binds_nothing(self, engine):
+        job = build_job("j1", "default", 3, [(1000, 1000)] * 3)
+        nodes = [build_node("n1", 2000, 2000)]
+        cache, binder = build_cache([job], nodes)
+        run_allocate(cache, engine)
+        assert binder.binds == {}
+
+    def test_pending_podgroup_skipped(self, engine):
+        job = build_job("j1", "default", 1, [(100, 100)],
+                        phase=PodGroupPhase.PENDING)
+        cache, binder = build_cache([job], [build_node("n1", 1000, 1000)])
+        run_allocate(cache, engine)
+        assert binder.binds == {}
+
+    def test_two_jobs_one_slot_discard_frees(self, engine):
+        """j-big (gang 2) can't fit; its rollback must leave room for j-small."""
+        jobs = [build_job("a-big", "default", 2, [(800, 800)] * 2, priority=10),
+                build_job("b-small", "default", 1, [(800, 800)])]
+        cache, binder = build_cache(jobs, [build_node("n1", 1000, 1000)])
+        run_allocate(cache, engine)
+        assert list(binder.binds) == ["default/b-small-0"]
+
+    def test_priority_order(self, engine):
+        """Higher-priority job wins the contended node."""
+        jobs = [build_job("low", "default", 1, [(800, 800)], priority=1),
+                build_job("high", "default", 1, [(800, 800)], priority=10)]
+        cache, binder = build_cache(jobs, [build_node("n1", 1000, 1000)])
+        run_allocate(cache, engine)
+        assert list(binder.binds) == ["default/high-0"]
+
+    def test_best_effort_skipped_in_allocate(self, engine):
+        job = build_job("j1", "default", 1, [(0, 0)])
+        cache, binder = build_cache([job], [build_node("n1", 1000, 1000)])
+        run_allocate(cache, engine)
+        assert binder.binds == {}
+
+    def test_node_selector_respected(self, engine):
+        job = build_job("j1", "default", 1, [(100, 100)])
+        for t in job.tasks.values():
+            t.node_selector = {"zone": "a"}
+        n1 = build_node("n1", 1000, 1000)
+        n2 = build_node("n2", 1000, 1000)
+        n2.labels["zone"] = "a"
+        cache, binder = build_cache([job], [n1, n2])
+        run_allocate(cache, engine)
+        assert binder.binds == {"default/j1-0": "n2"}
+
+    def test_taint_respected(self, engine):
+        job = build_job("j1", "default", 1, [(100, 100)])
+        n1 = build_node("n1", 1000, 1000)
+        n1.taints = [{"key": "dedicated", "value": "x", "effect": "NoSchedule"}]
+        n2 = build_node("n2", 1000, 1000)
+        cache, binder = build_cache([job], [n1, n2])
+        run_allocate(cache, engine)
+        assert binder.binds == {"default/j1-0": "n2"}
+
+    def test_queue_weights_proportion(self, engine):
+        """Two queues 3:1 on a cluster that fits only 4 of 8 tasks: the
+        heavier queue gets 3, the lighter 1 (proportion deserved +
+        overused gating)."""
+        q1 = QueueInfo(name="q1", weight=3)
+        q2 = QueueInfo(name="q2", weight=1)
+        jobs = []
+        for i in range(4):
+            jobs.append(build_job(f"a{i}", "q1", 1, [(1000, 1000)]))
+            jobs.append(build_job(f"b{i}", "q2", 1, [(1000, 1000)]))
+        cache, binder = build_cache(jobs, [build_node("n1", 4000, 4000)],
+                                    queues=[q1, q2])
+        run_allocate(cache, engine)
+        q1_binds = [k for k in binder.binds if k.startswith("default/a")]
+        q2_binds = [k for k in binder.binds if k.startswith("default/b")]
+        assert len(q1_binds) == 3
+        assert len(q2_binds) == 1
+
+
+class TestEngineParity:
+    """Property check: all engines produce identical gang admissions on a
+    randomized fixture (the BASELINE 'identical gang-admission decisions'
+    oracle)."""
+
+    def test_random_fixture_parity(self):
+        import random
+        rng = random.Random(7)
+        nodes = [build_node(f"n{i}", rng.choice([2000, 4000, 8000]),
+                            rng.choice([4000, 8000, 16000]))
+                 for i in range(8)]
+        jobs = []
+        for j in range(12):
+            k = rng.randint(1, 4)
+            reqs = [(rng.choice([500, 1000, 2000]),
+                     rng.choice([500, 1000, 2000]))] * k
+            jobs.append(build_job(f"job{j}", "default", k, reqs,
+                                  priority=rng.randint(0, 5)))
+
+        admitted = {}
+        for engine in ENGINES:
+            cache, binder = build_cache(
+                [j.clone() for j in jobs],
+                [NodeInfo(name=n.name, allocatable=n.allocatable)
+                 for n in nodes])
+            run_allocate(cache, engine)
+            admitted[engine] = {k.split("/")[1].rsplit("-", 1)[0]
+                                for k in binder.binds}
+        assert admitted["callbacks"] == admitted["tpu-strict"]
+        assert admitted["callbacks"] == admitted["tpu-fused"]

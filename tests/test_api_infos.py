@@ -1,0 +1,112 @@
+"""JobInfo/NodeInfo gang-state and accounting semantics
+(pkg/scheduler/api/{job_info,node_info}_test.go patterns)."""
+
+import pytest
+
+from volcano_tpu.api import (JobInfo, NodeInfo, Resource, TaskInfo,
+                             TaskStatus)
+
+
+def task(name, cpu=1000, mem=100, status=TaskStatus.PENDING, role=None):
+    return TaskInfo(name=name, resreq=Resource(cpu, mem), status=status,
+                    task_role=role or name.split("-")[0])
+
+
+class TestJobInfo:
+    def test_add_update_delete(self):
+        job = JobInfo(name="j1", min_available=2)
+        t1 = task("a-0")
+        t2 = task("a-1", status=TaskStatus.RUNNING)
+        job.add_task_info(t1)
+        job.add_task_info(t2)
+        assert job.total_request == Resource(2000, 200)
+        assert job.allocated == Resource(1000, 100)
+        assert job.ready_task_num() == 1
+        assert not job.ready()
+
+        job.update_task_status(t1, TaskStatus.ALLOCATED)
+        assert job.allocated == Resource(2000, 200)
+        assert job.ready()
+
+        job.delete_task_info(t2)
+        assert job.allocated == Resource(1000, 100)
+        assert job.ready_task_num() == 1
+
+    def test_best_effort_counts_ready(self):
+        # Pending tasks with empty resreq count as occupied
+        # (job_info.go:519-524)
+        job = JobInfo(name="j", min_available=1)
+        job.add_task_info(TaskInfo(name="be", resreq=Resource()))
+        assert job.ready()
+
+    def test_pipelined_gang(self):
+        job = JobInfo(name="j", min_available=2)
+        t1 = task("t-0", status=TaskStatus.RUNNING)
+        t2 = task("t-1", status=TaskStatus.PIPELINED)
+        job.add_task_info(t1)
+        job.add_task_info(t2)
+        assert not job.ready()
+        assert job.pipelined()
+
+    def test_check_task_min_available(self):
+        job = JobInfo(name="j", min_available=3)
+        job.task_min_available = {"ps": 1, "worker": 2}
+        job.task_min_available_total = 3
+        job.add_task_info(task("ps-0", role="ps"))
+        job.add_task_info(task("worker-0", role="worker"))
+        assert not job.check_task_min_available()
+        job.add_task_info(task("worker-1", role="worker"))
+        assert job.check_task_min_available()
+        # job minAvailable below per-task total skips the check
+        job.min_available = 2
+        assert job.check_task_min_available()
+
+    def test_valid_task_num_excludes_failed(self):
+        job = JobInfo(name="j")
+        job.add_task_info(task("a-0"))
+        job.add_task_info(task("a-1", status=TaskStatus.FAILED))
+        assert job.valid_task_num() == 1
+
+
+class TestNodeInfo:
+    def node(self, cpu=8000, mem=1000):
+        return NodeInfo(name="n1", allocatable=Resource(cpu, mem))
+
+    def test_add_remove_allocated(self):
+        n = self.node()
+        t = task("t-0", 2000, 200, status=TaskStatus.RUNNING)
+        n.add_task(t)
+        assert n.idle == Resource(6000, 800)
+        assert n.used == Resource(2000, 200)
+        assert t.node_name == "n1"
+        n.remove_task(t)
+        assert n.idle == Resource(8000, 1000)
+        assert n.used == Resource()
+
+    def test_releasing_counts_future_idle(self):
+        n = self.node()
+        n.add_task(task("r-0", 2000, 200, status=TaskStatus.RELEASING))
+        assert n.idle == Resource(6000, 800)
+        assert n.future_idle() == Resource(8000, 1000)
+
+    def test_pipelined_reserves_future(self):
+        n = self.node()
+        n.add_task(task("r-0", 2000, 200, status=TaskStatus.RELEASING))
+        n.add_task(task("p-0", 3000, 300, status=TaskStatus.PIPELINED))
+        # idle untouched by pipelined, future idle reduced
+        assert n.idle == Resource(6000, 800)
+        assert n.future_idle() == Resource(5000, 700)
+
+    def test_over_allocate_raises(self):
+        n = self.node(1000, 100)
+        with pytest.raises(ValueError):
+            n.add_task(task("big", 2000, 50, status=TaskStatus.ALLOCATED))
+
+    def test_clone_independent(self):
+        n = self.node()
+        t = task("t-0", 1000, 100, status=TaskStatus.RUNNING)
+        n.add_task(t)
+        c = n.clone()
+        c.remove_task(t)
+        assert n.idle == Resource(7000, 900)
+        assert c.idle == Resource(8000, 1000)

@@ -1,0 +1,143 @@
+"""Framework-layer unit tests: conf parsing, statement undo, session
+dispatch semantics, and review-finding regressions."""
+
+import numpy as np
+
+from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
+                             QueueInfo, Resource, TaskInfo, TaskStatus)
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.framework import (PluginOption, Tier, close_session,
+                                   open_session, parse_scheduler_conf)
+import volcano_tpu.plugins  # noqa: F401
+
+
+class TestConf:
+    def test_default_conf(self):
+        conf = parse_scheduler_conf(None)
+        assert conf.actions == ["enqueue", "allocate", "backfill"]
+        assert [p.name for p in conf.tiers[0].plugins] == ["priority", "gang"]
+        assert len(conf.tiers) == 2
+
+    def test_reference_enable_flag_tags(self):
+        """The reference YAML tags are enableXxx (scheduler_conf.go:45-81);
+        they must land on the internal enabledXxx flags."""
+        conf = parse_scheduler_conf("""
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+    enableJobOrder: false
+    enablePreemptable: false
+  - name: sla
+    arguments:
+      sla-waiting-time: 1h
+""")
+        opt = conf.tiers[0].plugins[0]
+        assert opt.is_enabled("enabledJobOrder") is False
+        assert opt.is_enabled("enabledPreemptable") is False
+        assert opt.is_enabled("enabledJobReady") is True
+        assert conf.tiers[0].plugins[1].arguments["sla-waiting-time"] == "1h"
+
+    def test_configurations_block(self):
+        conf = parse_scheduler_conf("""
+actions: "enqueue, allocate-tpu"
+tiers:
+- plugins:
+  - name: gang
+configurations:
+- name: allocate-tpu
+  arguments:
+    engine: tpu-strict
+""")
+        assert conf.action_arguments("allocate-tpu")["engine"] == "tpu-strict"
+
+
+class TestStatement:
+    def build(self):
+        cache = SchedulerCache(binder=FakeBinder(), evictor=FakeEvictor())
+        alloc = Resource(4000, 4000)
+        cache.add_node(NodeInfo(name="n1", allocatable=alloc))
+        pg = PodGroup(name="j", queue="default", min_member=1,
+                      phase=PodGroupPhase.INQUEUE)
+        job = JobInfo(uid="j", name="j", queue="default", min_available=1,
+                      podgroup=pg)
+        job.add_task_info(TaskInfo(uid="t0", name="t0", job="j",
+                                   resreq=Resource(1000, 1000)))
+        cache.add_job(job)
+        tiers = [Tier(plugins=[PluginOption("gang"),
+                               PluginOption("predicates")])]
+        ssn = open_session(cache, tiers, [])
+        return cache, ssn
+
+    def test_allocate_discard_restores(self):
+        cache, ssn = self.build()
+        job = ssn.jobs["j"]
+        task = job.tasks["t0"]
+        node = ssn.nodes["n1"]
+        stmt = ssn.statement()
+        stmt.allocate(task, node)
+        assert task.status == TaskStatus.ALLOCATED
+        assert node.idle == Resource(3000, 3000)
+        stmt.discard()
+        assert task.status == TaskStatus.PENDING
+        assert node.idle == Resource(4000, 4000)
+        assert task.node_name == ""
+
+    def test_commit_binds(self):
+        cache, ssn = self.build()
+        job = ssn.jobs["j"]
+        stmt = ssn.statement()
+        stmt.allocate(job.tasks["t0"], ssn.nodes["n1"])
+        stmt.commit()
+        assert cache.binder.binds == {"default/t0": "n1"}
+        # cache-side task transitioned to BOUND
+        assert cache.jobs["j"].tasks["t0"].status == TaskStatus.BOUND
+
+    def test_pipeline_commit_does_not_bind(self):
+        cache, ssn = self.build()
+        job = ssn.jobs["j"]
+        stmt = ssn.statement()
+        stmt.pipeline(job.tasks["t0"], "n1")
+        stmt.commit()
+        assert cache.binder.binds == {}
+
+
+class TestSessionDispatch:
+    def test_overused_any_dimension(self):
+        """Regression (code review): overused iff allocated exceeds deserved
+        in ANY dimension (proportion.go:244)."""
+        cache = SchedulerCache(binder=FakeBinder(), evictor=FakeEvictor())
+        cache.add_queue(QueueInfo(name="default", weight=1))
+        cache.add_node(NodeInfo(name="n1",
+                                allocatable=Resource(10000, 4000)))
+        pg = PodGroup(name="j", queue="default", min_member=1,
+                      phase=PodGroupPhase.INQUEUE)
+        job = JobInfo(uid="j", name="j", queue="default", min_available=1,
+                      podgroup=pg)
+        # running cpu-heavy task: allocated cpu >> deserved cpu, memory 0
+        job.add_task_info(TaskInfo(uid="r0", name="r0", job="j",
+                                   resreq=Resource(20000, 0),
+                                   status=TaskStatus.RUNNING))
+        cache.add_job(job)
+        tiers = [Tier(plugins=[PluginOption("proportion")])]
+        ssn = open_session(cache, tiers, [])
+        assert ssn.overused(ssn.queues["default"])
+
+    def test_condition_replaced_not_appended(self):
+        """Regression (code review): PodGroup conditions are bounded — one
+        per type, replaced on transition."""
+        cache = SchedulerCache(binder=FakeBinder(), evictor=FakeEvictor())
+        cache.add_node(NodeInfo(name="n1", allocatable=Resource(100, 100)))
+        pg = PodGroup(name="j", queue="default", min_member=2,
+                      phase=PodGroupPhase.INQUEUE)
+        job = JobInfo(uid="j", name="j", queue="default", min_available=2,
+                      podgroup=pg)
+        job.add_task_info(TaskInfo(uid="t0", name="t0", job="j",
+                                   resreq=Resource(1000, 1000)))
+        cache.add_job(job)
+        tiers = [Tier(plugins=[PluginOption("gang")])]
+        for _ in range(3):
+            ssn = open_session(cache, tiers, [])
+            close_session(ssn)
+        assert len(pg.conditions) == 1
+        assert pg.conditions[0]["type"] == "Unschedulable"

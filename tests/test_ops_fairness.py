@@ -1,0 +1,82 @@
+"""Proportion water-filling and DRF share kernels vs hand-computed fixtures
+(pkg/scheduler/plugins/proportion + drf semantics)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from volcano_tpu.ops import (dominant_share, drf_shares, proportion_deserved,
+                             queue_overused)
+
+INF = float("inf")
+
+
+class TestProportion:
+    def test_weighted_split_unbounded(self):
+        """Two queues weight 2:1, both requesting more than the cluster:
+        deserved splits 2/3 vs 1/3."""
+        total = jnp.array([9000.0, 9000.0])
+        weight = jnp.array([2.0, 1.0])
+        request = jnp.array([[9000.0, 9000.0], [9000.0, 9000.0]])
+        cap = jnp.full((2, 2), INF)
+        alloc = jnp.zeros((2, 2))
+        res = proportion_deserved(total, weight, request, cap, alloc)
+        np.testing.assert_allclose(np.asarray(res.deserved),
+                                   [[6000, 6000], [3000, 3000]], atol=1.0)
+
+    def test_small_request_met_redistributes(self):
+        """Queue 0 requests little; surplus water-fills to queue 1
+        (proportion.go:170-177)."""
+        total = jnp.array([9000.0, 9000.0])
+        weight = jnp.array([1.0, 1.0])
+        request = jnp.array([[1000.0, 1000.0], [9000.0, 9000.0]])
+        cap = jnp.full((2, 2), INF)
+        alloc = jnp.zeros((2, 2))
+        res = proportion_deserved(total, weight, request, cap, alloc)
+        np.testing.assert_allclose(np.asarray(res.deserved),
+                                   [[1000, 1000], [8000, 8000]], atol=1.0)
+
+    def test_capability_clamp(self):
+        total = jnp.array([9000.0, 9000.0])
+        weight = jnp.array([1.0, 1.0])
+        request = jnp.array([[9000.0, 9000.0], [9000.0, 9000.0]])
+        cap = jnp.array([[2000.0, INF], [INF, INF]])
+        alloc = jnp.zeros((2, 2))
+        res = proportion_deserved(total, weight, request, cap, alloc)
+        d = np.asarray(res.deserved)
+        # queue 0 capped at 2000 cpu; queue 1 absorbs the surplus
+        assert d[0, 0] == pytest.approx(2000.0, abs=1.0)
+        assert d[1, 0] == pytest.approx(7000.0, abs=1.0)
+
+    def test_share_and_overused(self):
+        deserved = jnp.array([[4000.0, 4000.0], [2000.0, 2000.0]])
+        allocated = jnp.array([[2000.0, 1000.0], [2500.0, 2000.0]])
+        share = dominant_share(allocated, deserved)
+        np.testing.assert_allclose(np.asarray(share), [0.5, 1.25])
+        over = queue_overused(allocated, deserved)
+        assert np.asarray(over).tolist() == [False, True]
+
+    def test_zero_weight_queue_gets_nothing(self):
+        total = jnp.array([1000.0, 1000.0])
+        weight = jnp.array([0.0, 1.0])
+        request = jnp.array([[1000.0, 1000.0], [1000.0, 1000.0]])
+        cap = jnp.full((2, 2), INF)
+        res = proportion_deserved(total, weight, request, cap, jnp.zeros((2, 2)))
+        d = np.asarray(res.deserved)
+        assert d[0].max() == 0.0
+        assert d[1, 0] == pytest.approx(1000.0, abs=1.0)
+
+
+class TestDRF:
+    def test_dominant_share(self):
+        total = jnp.array([10000.0, 1000.0])
+        alloc = jnp.array([[1000.0, 10.0],     # cpu 10%, mem 1% -> 0.1
+                           [100.0, 500.0]])    # cpu 1%, mem 50% -> 0.5
+        np.testing.assert_allclose(np.asarray(drf_shares(alloc, total)),
+                                   [0.1, 0.5])
+
+    def test_zero_total_dim(self):
+        total = jnp.array([10000.0, 0.0])
+        alloc = jnp.array([[1000.0, 10.0]])
+        # dim with zero total but nonzero usage -> share 1
+        np.testing.assert_allclose(np.asarray(drf_shares(alloc, total)), [1.0])

@@ -1,0 +1,249 @@
+"""Golden tests for the placement kernels, mirroring the reference's
+allocate fixtures (pkg/scheduler/actions/allocate/allocate_test.go):
+same tasks/nodes in, same binding decisions out."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from volcano_tpu.ops import (NO_NODE, BlockTasks, JobMeta, NodeState,
+                             PlacementTasks, default_weights, gang_admission,
+                             make_node_state, place_blocks, place_scan)
+
+R = 2  # cpu, memory
+
+
+def nodes_state(idle_list, releasing=None, pipelined=None, used=None):
+    N = len(idle_list)
+    idle = jnp.asarray(idle_list, dtype=jnp.float32)
+    rel = jnp.asarray(releasing if releasing else np.zeros((N, R)), jnp.float32)
+    pip = jnp.asarray(pipelined if pipelined else np.zeros((N, R)), jnp.float32)
+    us = jnp.asarray(used if used else np.zeros((N, R)), jnp.float32)
+    return make_node_state(idle, rel, pip, us, jnp.zeros(N, jnp.int32))
+
+
+def mk_tasks(reqs, job_ix, n_nodes, feas=None):
+    T = len(reqs)
+    job_ix = np.asarray(job_ix)
+    first = np.ones(T, bool)
+    first[1:] = job_ix[1:] != job_ix[:-1]
+    last = np.ones(T, bool)
+    last[:-1] = job_ix[1:] != job_ix[:-1]
+    return PlacementTasks(
+        req=jnp.asarray(reqs, jnp.float32),
+        job_ix=jnp.asarray(job_ix, jnp.int32),
+        valid=jnp.ones(T, bool),
+        feas=jnp.asarray(feas if feas is not None else np.ones((T, n_nodes), bool)),
+        static_score=jnp.zeros((T, n_nodes), jnp.float32),
+        first_of_job=jnp.asarray(first),
+        last_of_job=jnp.asarray(last))
+
+
+def run_scan(nodes, tasks, jobs, allocatable, max_tasks=None):
+    N = allocatable.shape[0]
+    if max_tasks is None:
+        max_tasks = jnp.full(N, 1000, jnp.int32)
+    return place_scan(nodes, tasks, jobs, default_weights(R),
+                      jnp.asarray(allocatable, jnp.float32), max_tasks)
+
+
+class TestPlaceScan:
+    def test_one_job_fits(self):
+        """allocate_test.go case 1: 1 job, 3 tasks minAvailable 3, two nodes
+        with capacity for 2+1 -> all bound."""
+        alloc = np.array([[2000.0, 4000.0], [1000.0, 2000.0]])
+        nodes = nodes_state(alloc.tolist())
+        tasks = mk_tasks([[1000, 2000]] * 3, [0, 0, 0], 2)
+        jobs = JobMeta(min_available=jnp.array([3]),
+                       base_ready=jnp.array([0]),
+                       base_pipelined=jnp.array([0]))
+        res = run_scan(nodes, tasks, jobs, alloc)
+        assert bool(res.job_ready[0])
+        picks = np.asarray(res.task_node)
+        assert (picks != NO_NODE).all()
+        # capacity respected: node 0 at most 2 tasks, node 1 at most 1
+        assert (picks == 0).sum() <= 2 and (picks == 1).sum() <= 1
+
+    def test_gang_discard(self):
+        """Gang short of minAvailable discards all placements
+        (statement.go:352-374 semantics)."""
+        alloc = np.array([[1000.0, 2000.0]])
+        nodes = nodes_state(alloc.tolist())
+        tasks = mk_tasks([[1000, 2000]] * 3, [0, 0, 0], 1)
+        jobs = JobMeta(min_available=jnp.array([3]),
+                       base_ready=jnp.array([0]),
+                       base_pipelined=jnp.array([0]))
+        res = run_scan(nodes, tasks, jobs, alloc)
+        assert not bool(res.job_ready[0])
+        assert not bool(res.job_kept[0])
+        assert (np.asarray(res.task_node) == NO_NODE).all()
+        # node state rolled back
+        np.testing.assert_allclose(np.asarray(res.nodes.idle), alloc)
+
+    def test_discarded_job_frees_for_next(self):
+        """Job A (minAvailable 2) can't fit both tasks; its rollback lets
+        job B (minAvailable 1) use the node."""
+        alloc = np.array([[1000.0, 1000.0]])
+        nodes = nodes_state(alloc.tolist())
+        tasks = mk_tasks([[1000, 1000], [1000, 1000], [1000, 1000]],
+                         [0, 0, 1], 1)
+        jobs = JobMeta(min_available=jnp.array([2, 1]),
+                       base_ready=jnp.array([0, 0]),
+                       base_pipelined=jnp.array([0, 0]))
+        res = run_scan(nodes, tasks, jobs, alloc)
+        assert not bool(res.job_ready[0])
+        assert bool(res.job_ready[1])
+        assert np.asarray(res.task_node)[2] == 0
+
+    def test_pipeline_on_releasing(self):
+        """Task that fits FutureIdle but not Idle is pipelined
+        (allocate.go:241-256)."""
+        alloc = np.array([[1000.0, 1000.0]])
+        # node fully used but 1000/1000 releasing
+        nodes = NodeState(
+            idle=jnp.zeros((1, R)),
+            future_idle=jnp.asarray([[1000.0, 1000.0]]),
+            used=jnp.asarray([[1000.0, 1000.0]]),
+            ntasks=jnp.ones(1, jnp.int32))
+        tasks = mk_tasks([[1000, 1000]], [0], 1)
+        jobs = JobMeta(min_available=jnp.array([1]),
+                       base_ready=jnp.array([0]),
+                       base_pipelined=jnp.array([0]))
+        res = run_scan(nodes, tasks, jobs, alloc)
+        # pipelined, not ready -> kept but not committed
+        assert bool(res.task_pipelined[0])
+        assert not bool(res.job_ready[0])
+        assert bool(res.job_kept[0])
+
+    def test_binpack_prefers_used_node(self):
+        """Binpack scores the fuller node higher (binpack.go:196-260)."""
+        alloc = np.array([[4000.0, 4000.0], [4000.0, 4000.0]])
+        used = [[2000.0, 2000.0], [0.0, 0.0]]
+        idle = [[2000.0, 2000.0], [4000.0, 4000.0]]
+        nodes = nodes_state(idle, used=used)
+        w = default_weights(R)._replace(least_req_weight=0.0, balanced_weight=0.0)
+        tasks = mk_tasks([[1000, 1000]], [0], 2)
+        jobs = JobMeta(min_available=jnp.array([1]),
+                       base_ready=jnp.array([0]),
+                       base_pipelined=jnp.array([0]))
+        res = place_scan(nodes, tasks, jobs, w,
+                         jnp.asarray(alloc, jnp.float32),
+                         jnp.full(2, 100, jnp.int32))
+        assert int(res.task_node[0]) == 0
+
+    def test_least_allocated_prefers_empty_node(self):
+        alloc = np.array([[4000.0, 4000.0], [4000.0, 4000.0]])
+        used = [[2000.0, 2000.0], [0.0, 0.0]]
+        idle = [[2000.0, 2000.0], [4000.0, 4000.0]]
+        nodes = nodes_state(idle, used=used)
+        w = default_weights(R)._replace(binpack_weight=0.0, balanced_weight=0.0)
+        tasks = mk_tasks([[1000, 1000]], [0], 2)
+        jobs = JobMeta(min_available=jnp.array([1]),
+                       base_ready=jnp.array([0]),
+                       base_pipelined=jnp.array([0]))
+        res = place_scan(nodes, tasks, jobs, w,
+                         jnp.asarray(alloc, jnp.float32),
+                         jnp.full(2, 100, jnp.int32))
+        assert int(res.task_node[0]) == 1
+
+    def test_feasibility_mask_respected(self):
+        alloc = np.array([[4000.0, 4000.0], [4000.0, 4000.0]])
+        nodes = nodes_state(alloc.tolist())
+        feas = np.array([[False, True]])
+        tasks = mk_tasks([[1000, 1000]], [0], 2, feas=feas)
+        jobs = JobMeta(min_available=jnp.array([1]),
+                       base_ready=jnp.array([0]),
+                       base_pipelined=jnp.array([0]))
+        res = run_scan(nodes, tasks, jobs, alloc)
+        assert int(res.task_node[0]) == 1
+
+    def test_max_pods(self):
+        alloc = np.array([[8000.0, 8000.0]])
+        nodes = nodes_state(alloc.tolist())
+        tasks = mk_tasks([[100, 100]] * 3, [0, 1, 2], 1)
+        jobs = JobMeta(min_available=jnp.array([1, 1, 1]),
+                       base_ready=jnp.array([0, 0, 0]),
+                       base_pipelined=jnp.array([0, 0, 0]))
+        res = run_scan(nodes, tasks, jobs, alloc,
+                       max_tasks=jnp.array([2], jnp.int32))
+        picks = np.asarray(res.task_node)
+        assert (picks != NO_NODE).sum() == 2
+
+    def test_base_ready_counts(self):
+        """Already-running tasks count toward the gang (job_info.go:509-529)."""
+        alloc = np.array([[1000.0, 1000.0]])
+        nodes = nodes_state(alloc.tolist())
+        tasks = mk_tasks([[1000, 1000]], [0], 1)
+        jobs = JobMeta(min_available=jnp.array([2]),
+                       base_ready=jnp.array([1]),
+                       base_pipelined=jnp.array([0]))
+        res = run_scan(nodes, tasks, jobs, alloc)
+        assert bool(res.job_ready[0])
+        assert int(res.task_node[0]) == 0
+
+
+class TestPlaceBlocks:
+    def mk_block(self, reqs, job_ix, n_nodes):
+        T = len(reqs)
+        return BlockTasks(
+            req=jnp.asarray(reqs, jnp.float32),
+            job_ix=jnp.asarray(job_ix, jnp.int32),
+            valid=jnp.ones(T, bool),
+            feas=jnp.ones((T, n_nodes), bool),
+            static_score=jnp.zeros((T, n_nodes), jnp.float32))
+
+    def test_matches_capacity(self):
+        alloc = np.array([[2000.0, 4000.0], [1000.0, 2000.0]])
+        nodes = nodes_state(alloc.tolist())
+        tasks = self.mk_block([[1000, 2000]] * 3, [0, 0, 0], 2)
+        jobs = JobMeta(min_available=jnp.array([3]),
+                       base_ready=jnp.array([0]),
+                       base_pipelined=jnp.array([0]))
+        assign, ready, _ = place_blocks(nodes, tasks, jobs, default_weights(R),
+                                        jnp.asarray(alloc, jnp.float32),
+                                        jnp.full(2, 100, jnp.int32), chunk=4)
+        assert bool(ready[0])
+        picks = np.asarray(assign)
+        assert (picks != NO_NODE).all()
+        assert (picks == 0).sum() <= 2 and (picks == 1).sum() <= 1
+
+    def test_gang_rollback_and_refill(self):
+        """Job 0 can't meet minAvailable; rollback lets job 1 fill in the
+        second sweep."""
+        alloc = np.array([[1000.0, 1000.0]])
+        nodes = nodes_state(alloc.tolist())
+        tasks = self.mk_block([[1000, 1000], [1000, 1000], [1000, 1000]],
+                              [0, 0, 1], 1)
+        jobs = JobMeta(min_available=jnp.array([2, 1]),
+                       base_ready=jnp.array([0, 0]),
+                       base_pipelined=jnp.array([0, 0]))
+        assign, ready, _ = place_blocks(nodes, tasks, jobs, default_weights(R),
+                                        jnp.asarray(alloc, jnp.float32),
+                                        jnp.full(1, 100, jnp.int32), chunk=2)
+        assert not bool(ready[0]) and bool(ready[1])
+        assert np.asarray(assign)[2] == 0
+
+    def test_intra_chunk_contention_exact(self):
+        """Tasks in one chunk can't oversubscribe a node: the cumulative-sum
+        acceptance admits exactly as many as fit."""
+        alloc = np.array([[2500.0, 2500.0]])
+        nodes = nodes_state(alloc.tolist())
+        tasks = self.mk_block([[1000, 1000]] * 4, [0, 1, 2, 3], 1)
+        jobs = JobMeta(min_available=jnp.ones(4, jnp.int32),
+                       base_ready=jnp.zeros(4, jnp.int32),
+                       base_pipelined=jnp.zeros(4, jnp.int32))
+        assign, ready, nodes_out = place_blocks(
+            nodes, tasks, jobs, default_weights(R),
+            jnp.asarray(alloc, jnp.float32), jnp.full(1, 100, jnp.int32),
+            chunk=4, sweeps=1)
+        assert (np.asarray(assign) != NO_NODE).sum() == 2
+        assert float(nodes_out.idle[0, 0]) == pytest.approx(500.0)
+
+
+def test_gang_admission_reduction():
+    assigned = jnp.array([True, True, False, True])
+    job_ix = jnp.array([0, 0, 1, 1])
+    assert np.asarray(gang_admission(assigned, job_ix,
+                                     jnp.array([2, 2]))).tolist() == [True, False]
+    assert np.asarray(gang_admission(assigned, job_ix,
+                                     jnp.array([2, 1]))).tolist() == [True, True]

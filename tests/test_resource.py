@@ -1,0 +1,112 @@
+"""Resource arithmetic semantics vs the reference
+(pkg/scheduler/api/resource_info_test.go patterns)."""
+
+import numpy as np
+import pytest
+
+from volcano_tpu.api import (INFINITY, ZERO, Resource, ResourceNames,
+                             parse_quantity)
+
+
+def res(cpu=0.0, mem=0.0, **scalars):
+    return Resource(cpu, mem, scalars or None)
+
+
+class TestArithmetic:
+    def test_add_sub(self):
+        r = res(1000, 100, **{"nvidia.com/gpu": 1})
+        r.add(res(2000, 1000))
+        assert r.cpu == 3000 and r.memory == 1100
+        r.sub(res(1000, 100, **{"nvidia.com/gpu": 1}))
+        assert r.cpu == 2000 and r.memory == 1000
+        assert r.scalars["nvidia.com/gpu"] == 0
+
+    def test_sub_insufficient_asserts(self):
+        with pytest.raises(AssertionError):
+            res(100, 100).sub(res(200, 50))
+
+    def test_multi(self):
+        r = res(1000, 100, **{"x": 4}).multi(0.5)
+        assert r.cpu == 500 and r.memory == 50 and r.scalars["x"] == 2
+
+    def test_min_dimension_missing_is_zero(self):
+        # MinDimensionResource treats dims missing from rr as zero
+        # (resource_info.go:428-455)
+        r = res(1000, 100, **{"x": 4})
+        r.min_dimension_resource(res(500, 200))
+        assert r.cpu == 500 and r.memory == 100 and r.scalars["x"] == 0
+
+    def test_diff(self):
+        inc, dec = res(1000, 100).diff(res(500, 200))
+        assert inc.cpu == 500 and inc.memory == 0
+        assert dec.cpu == 0 and dec.memory == 100
+
+    def test_set_max(self):
+        r = res(1000, 100)
+        r.set_max_resource(res(500, 200, **{"g": 3}))
+        assert r.cpu == 1000 and r.memory == 200 and r.scalars["g"] == 3
+
+
+class TestComparisons:
+    def test_less_equal_epsilon(self):
+        # epsilon 0.1 (resource_info.go:36): equality within 0.1 passes
+        assert res(1000.05, 100).less_equal(res(1000, 100))
+        assert not res(1000.2, 100).less_equal(res(1000, 100))
+
+    def test_less_equal_zero_default(self):
+        # missing dim on right treated as 0 under Zero default
+        assert not res(10, 10, **{"g": 1}).less_equal(res(100, 100), ZERO)
+        assert res(10, 10).less_equal(res(100, 100, **{"g": 1}), ZERO)
+
+    def test_less_equal_infinity_default(self):
+        # missing dim on right treated as infinite under Infinity default
+        assert res(10, 10, **{"g": 1}).less_equal(res(100, 100), INFINITY)
+        # missing dim on LEFT is infinite too -> fails against finite right
+        assert not res(10, 10).less_equal(res(100, 100, **{"g": 1}), INFINITY)
+
+    def test_less_in_some_dimension(self):
+        assert res(10, 500).less_in_some_dimension(res(20, 100))
+        assert not res(20, 500).less_in_some_dimension(res(20, 100))
+        # scalar present only on right counts if above epsilon
+        assert res(100, 100).less_in_some_dimension(res(1, 1, **{"g": 1}))
+
+    def test_is_empty(self):
+        assert Resource().is_empty()
+        assert res(0.05, 0.01).is_empty()
+        assert not res(1, 0).is_empty()
+
+
+class TestVectorBridge:
+    def test_roundtrip(self):
+        names = ResourceNames(["nvidia.com/gpu"])
+        r = res(4000, 8 << 30, **{"nvidia.com/gpu": 2})
+        v = r.to_vector(names)
+        assert v.shape == (3,)
+        back = Resource.from_vector(v, names)
+        assert back == r
+
+    def test_discover(self):
+        names = ResourceNames.discover([res(1, 1, **{"b": 1}), res(1, 1, **{"a": 1})])
+        assert names.names == ["cpu", "memory", "a", "b"]
+
+    def test_capability_inf_fill(self):
+        names = ResourceNames(["g"])
+        v = res(100, 200).to_vector_inf_fill(names)
+        assert v[0] == 100 and v[1] == 200 and np.isinf(v[2])
+
+
+class TestQuantity:
+    def test_parse(self):
+        assert parse_quantity("100m") == pytest.approx(0.1)
+        assert parse_quantity("2") == 2
+        assert parse_quantity("4Gi") == 4 * 2**30
+        assert parse_quantity("1k") == 1000
+        assert parse_quantity(1.5) == 1.5
+
+    def test_from_dict(self):
+        r = Resource.from_dict({"cpu": "2", "memory": "1Gi", "pods": 110,
+                                "nvidia.com/gpu": 1})
+        assert r.cpu == 2000
+        assert r.memory == 2**30
+        assert r.max_task_num == 110
+        assert r.scalars["nvidia.com/gpu"] == 1000

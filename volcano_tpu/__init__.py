@@ -1,0 +1,18 @@
+"""volcano_tpu — a TPU-native batch scheduling framework with the capability
+surface of Volcano (gang scheduling, multi-queue fairness, preempt/reclaim,
+binpack placement, job lifecycle, admission, CLI), whose per-cycle placement
+math runs as batched array programs on TPU via JAX/XLA.
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+
+- ``api``         in-memory scheduling model (dense-tensor friendly)
+- ``ops``         pure-JAX kernels: fit masks, scores, placement, fairness
+- ``framework``   Session / Statement / tiers / conf — the semantics layer
+- ``plugins``     gang, drf, proportion, binpack, predicates, ... as array transforms
+- ``actions``     enqueue, allocate / allocate-tpu, backfill
+- ``cache``       cluster-state cache, snapshot marshaling, side-effect executors
+- ``metrics``     Prometheus metrics with the reference's metric names
+- ``utils``       priority queue, scheduler helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,16 @@
+"""Actions (mirrors /root/reference/pkg/scheduler/actions). Importing this
+package registers the in-tree actions."""
+
+from ..framework.registry import register_action
+from .allocate import AllocateAction, AllocateTPUAction
+from .backfill import BackfillAction
+from .base import Action
+from .enqueue import EnqueueAction
+
+register_action(EnqueueAction())
+register_action(AllocateAction())
+register_action(AllocateTPUAction())
+register_action(BackfillAction())
+
+__all__ = ["Action", "AllocateAction", "AllocateTPUAction", "BackfillAction",
+           "EnqueueAction"]

@@ -1,0 +1,522 @@
+"""Allocate: the core scheduling action, in three engines.
+
+Control flow mirrors /root/reference/pkg/scheduler/actions/allocate/
+allocate.go:42-277 — namespace → queue (overused-filtered, share-ordered) →
+job → task priority interleave, per-task predicate/score/select, Statement
+commit iff the gang is Ready.
+
+Engines:
+
+- ``callbacks``  the reference architecture verbatim: per-(task,node) plugin
+  callbacks through PredicateNodes/PrioritizeNodes. The CPU baseline.
+- ``tpu-strict`` identical interleave, but each popped job's task placement is
+  one device solve (ops/place.place_scan with J=1): node state lives on
+  device between jobs, the host replays the picks through the Statement so
+  every plugin event handler and gang vote sees exactly what the reference
+  would. Decision-parity mode.
+- ``tpu-fused``  the whole action is ONE device program: job order is fixed
+  up front (same priority rules, without mid-cycle queue re-ordering), all
+  pending tasks solve in a single place_scan, results replay through
+  Statements. Highest throughput; gang admissions may differ from strict
+  only when mid-cycle share updates would reorder queues.
+
+The action name ``allocate`` defaults to callbacks; ``allocate-tpu``
+(registered separately) defaults to tpu-fused — so the conf swap
+``actions: "enqueue, allocate-tpu, backfill"`` is exactly the north-star
+drop-in.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import (FitErrors, NodeInfo, PodGroupPhase, TaskInfo, TaskStatus)
+from ..cache.snapshot import (NodeTensors, assemble_feasibility,
+                              assemble_static_score, assemble_weights,
+                              discover_resource_names, task_requests)
+from ..utils import PriorityQueue
+from ..utils.scheduler_helper import (predicate_nodes, prioritize_nodes,
+                                      select_best_node)
+from .base import Action
+
+NO_NODE = -1
+
+
+class AllocateAction(Action):
+    NAME = "allocate"
+    DEFAULT_ENGINE = "callbacks"
+
+    def __init__(self, engine: Optional[str] = None):
+        self.engine = engine or self.DEFAULT_ENGINE
+
+    def execute(self, ssn) -> None:
+        engine = self.engine
+        for conf in ssn.configurations:
+            if conf.name in (self.NAME, "allocate"):
+                engine = conf.arguments.get("engine", engine)
+        if engine == "callbacks":
+            _execute_interleaved(ssn, _CallbackJobPlacer(ssn))
+        elif engine == "tpu-strict":
+            _execute_interleaved(ssn, _DeviceJobPlacer(ssn))
+        elif engine in ("tpu-fused", "tpu-blocks"):
+            _execute_fused(ssn, blocks=(engine == "tpu-blocks"))
+        else:
+            raise ValueError(f"unknown allocate engine {engine!r}")
+
+
+class AllocateTPUAction(AllocateAction):
+    NAME = "allocate-tpu"
+    DEFAULT_ENGINE = "tpu-fused"
+
+
+# ---------------------------------------------------------------------------
+# shared interleave loop (allocate.go:123-274)
+# ---------------------------------------------------------------------------
+
+def _eligible_jobs(ssn):
+    for job in ssn.jobs.values():
+        if job.podgroup.phase == PodGroupPhase.PENDING:
+            continue
+        vr = ssn.job_valid(job)
+        if vr is not None and not vr.passed:
+            continue
+        if job.queue not in ssn.queues:
+            continue
+        yield job
+
+
+def _pending_tasks(ssn, job) -> List[TaskInfo]:
+    """Pending, non-best-effort tasks in TaskOrderFn order."""
+    pq = PriorityQueue(ssn.task_order_fn)
+    for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
+        if task.resreq.is_empty():
+            continue
+        pq.push(task)
+    out = []
+    while not pq.empty():
+        out.append(pq.pop())
+    return out
+
+
+def _execute_interleaved(ssn, placer) -> None:
+    namespaces = PriorityQueue(ssn.namespace_order_fn)
+    jobs_map: Dict[str, Dict[str, PriorityQueue]] = {}
+
+    for job in _eligible_jobs(ssn):
+        ns = job.namespace
+        if ns not in jobs_map:
+            namespaces.push(ns)
+            jobs_map[ns] = {}
+        if job.queue not in jobs_map[ns]:
+            jobs_map[ns][job.queue] = PriorityQueue(ssn.job_order_fn)
+        jobs_map[ns][job.queue].push(job)
+
+    pending: Dict[str, List[TaskInfo]] = {}
+
+    while not namespaces.empty():
+        ns = namespaces.pop()
+        queue_jobs = jobs_map[ns]
+
+        queue = None
+        for qid in list(queue_jobs):
+            q = ssn.queues[qid]
+            if ssn.overused(q):
+                del queue_jobs[qid]
+                continue
+            if queue_jobs[qid].empty():
+                continue
+            if queue is None or ssn.queue_order_fn(q, queue):
+                queue = q
+        if queue is None:
+            if queue_jobs:
+                # only empty PQs remain; drop namespace
+                if all(pq.empty() for pq in queue_jobs.values()):
+                    continue
+                namespaces.push(ns)
+            continue
+
+        jobs = queue_jobs[queue.uid]
+        if jobs.empty():
+            del queue_jobs[queue.uid]
+            namespaces.push(ns)
+            continue
+        job = jobs.pop()
+
+        if job.uid not in pending:
+            pending[job.uid] = _pending_tasks(ssn, job)
+        tasks = pending[job.uid]
+
+        stmt = ssn.statement()
+        readded = placer.place(job, tasks, stmt, jobs)
+
+        if ssn.job_ready(job):
+            stmt.commit()
+        elif not ssn.job_pipelined(job):
+            stmt.discard()
+
+        namespaces.push(ns)
+
+
+class _CallbackJobPlacer:
+    """Per-(task,node) callback placement — the reference hot loop
+    (allocate.go:186-262)."""
+
+    def __init__(self, ssn):
+        self.ssn = ssn
+
+    def place(self, job, tasks, stmt, jobs_pq) -> bool:
+        ssn = self.ssn
+        nodes = list(ssn.nodes.values())
+
+        def pred(task, node):
+            if not task.init_resreq.less_equal(node.future_idle()):
+                raise _fit_error(task, node)
+            ssn.predicate_fn(task, node)
+
+        while tasks:
+            task = tasks.pop(0)
+            feasible, fit_errors = predicate_nodes(task, nodes, pred)
+            if not feasible:
+                job.nodes_fit_errors[task.uid] = fit_errors
+                break
+
+            candidates = [n for n in feasible
+                          if task.init_resreq.less_equal(n.idle)
+                          or task.init_resreq.less_equal(n.future_idle())]
+            if not candidates:
+                continue
+
+            scores = prioritize_nodes(task, candidates,
+                                      ssn.batch_node_order_fn,
+                                      ssn.node_order_fn)
+            node = ssn.best_node_fn(task, scores) or select_best_node(scores)
+
+            if task.init_resreq.less_equal(node.idle):
+                stmt.allocate(task, node)
+            elif task.init_resreq.less_equal(node.future_idle()):
+                stmt.pipeline(task, node.name)
+
+            if ssn.job_ready(job) and tasks:
+                jobs_pq.push(job)
+                return True
+        return False
+
+
+class _DeviceJobPlacer:
+    """Per-job device solve with device-resident node state (tpu-strict).
+
+    The kernel replays the same per-task loop (ops/place.place_scan), so
+    within a job the decisions match the callback engine; across jobs the
+    interleave is identical because this placer is driven by the same loop.
+    """
+
+    def __init__(self, ssn):
+        import jax.numpy as jnp
+        self.ssn = ssn
+        self.jnp = jnp
+        tasks_all = [t for j in ssn.jobs.values() for t in j.tasks.values()]
+        self.rnames = discover_resource_names(list(ssn.nodes.values()), tasks_all)
+        self.node_t = NodeTensors(list(ssn.nodes.values()), self.rnames)
+        self.state = self.node_t.node_state()
+        self.allocatable = jnp.asarray(self.node_t.allocatable)
+        self.max_tasks = jnp.asarray(self.node_t.max_tasks)
+        self.weights = assemble_weights(ssn, self.rnames)
+        self._solve = _job_solver()
+
+    def place(self, job, tasks, stmt, jobs_pq) -> bool:
+        if not tasks or not self.node_t.names:
+            tasks.clear()
+            return False
+        jnp = self.jnp
+        from ..ops.place import JobMeta, PlacementTasks
+
+        req = task_requests(tasks, self.rnames)
+        feas = assemble_feasibility(self.ssn, tasks, self.node_t)
+        static = assemble_static_score(self.ssn, tasks, self.node_t)
+        T = len(tasks)
+        bucket = _bucket(T)
+        pad = bucket - T
+        pt = PlacementTasks(
+            req=jnp.asarray(np.pad(req, ((0, pad), (0, 0)))),
+            job_ix=jnp.zeros(bucket, jnp.int32),
+            valid=jnp.asarray(np.r_[np.ones(T, bool), np.zeros(pad, bool)]),
+            feas=jnp.asarray(np.pad(feas, ((0, pad), (0, 0)))),
+            static_score=jnp.asarray(np.pad(static, ((0, pad), (0, 0)))),
+            first_of_job=jnp.asarray(np.r_[[True], np.zeros(bucket - 1, bool)]),
+            last_of_job=jnp.asarray(
+                np.r_[np.zeros(T - 1, bool), [True], np.zeros(pad, bool)]))
+        jobs_meta = JobMeta(
+            min_available=jnp.asarray([job.min_available], jnp.int32),
+            base_ready=jnp.asarray([job.ready_task_num()], jnp.int32),
+            base_pipelined=jnp.asarray([job.waiting_task_num()], jnp.int32))
+
+        result = self._solve(self.state, pt, jobs_meta, self.weights,
+                             self.allocatable, self.max_tasks)
+        task_node = np.asarray(result.task_node[:T])
+        pipelined = np.asarray(result.task_pipelined[:T])
+        kept = bool(result.job_kept[0])
+        if kept:
+            self.state = result.nodes
+
+        # Replay picks through the Statement for host bookkeeping. All tasks
+        # are consumed — the reference pops each task from its queue exactly
+        # once per cycle whether or not it placed (allocate.go:187-223).
+        for i, task in enumerate(tasks):
+            n = int(task_node[i])
+            if n == NO_NODE:
+                continue
+            node_name = self.node_t.names[n]
+            if pipelined[i]:
+                stmt.pipeline(task, node_name)
+            else:
+                stmt.allocate(task, self.ssn.nodes[node_name])
+        tasks.clear()
+        return False
+
+
+def _bucket(n: int) -> int:
+    """Pad task counts to power-of-two buckets to bound jit recompiles."""
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
+_SOLVER_CACHE: dict = {}
+
+
+def _job_solver():
+    import jax
+    if "solve" not in _SOLVER_CACHE:
+        from ..ops.place import place_scan
+        _SOLVER_CACHE["solve"] = jax.jit(place_scan)
+    return _SOLVER_CACHE["solve"]
+
+
+# ---------------------------------------------------------------------------
+# fused engine: one device program per cycle
+# ---------------------------------------------------------------------------
+
+def _fixed_job_order(ssn, assumed_admitted: Optional[set] = None) -> List:
+    """Precompute the namespace→queue→job interleave for the fused solve.
+
+    Runs the reference's popping loop (allocate.go:123-180) with one
+    assumption: every popped job in ``assumed_admitted`` (all jobs when None)
+    allocates all of its pending tasks. Plugin allocate-events fire during
+    the simulation so mid-cycle share updates and overused gating order
+    queues exactly as the live loop would; all events are undone before
+    returning. The fused executor iterates this to a fixed point on the
+    actually-admitted set, so gang failures feed back into the ordering.
+    """
+    namespaces = PriorityQueue(ssn.namespace_order_fn)
+    jobs_map: Dict[str, Dict[str, PriorityQueue]] = {}
+    for job in _eligible_jobs(ssn):
+        ns = job.namespace
+        if ns not in jobs_map:
+            namespaces.push(ns)
+            jobs_map[ns] = {}
+        if job.queue not in jobs_map[ns]:
+            jobs_map[ns][job.queue] = PriorityQueue(ssn.job_order_fn)
+        jobs_map[ns][job.queue].push(job)
+
+    ordered: List = []
+    simulated: List[TaskInfo] = []
+    while not namespaces.empty():
+        ns = namespaces.pop()
+        queue_jobs = jobs_map[ns]
+        queue = None
+        for qid in list(queue_jobs):
+            q = ssn.queues[qid]
+            if ssn.overused(q):
+                del queue_jobs[qid]
+                continue
+            if queue_jobs[qid].empty():
+                continue
+            if queue is None or ssn.queue_order_fn(q, queue):
+                queue = q
+        if queue is None:
+            continue
+        jobs = queue_jobs[queue.uid]
+        if jobs.empty():
+            del queue_jobs[queue.uid]
+            namespaces.push(ns)
+            continue
+        job = jobs.pop()
+        ordered.append(job)
+        if assumed_admitted is None or job.uid in assumed_admitted:
+            for task in job.task_status_index.get(TaskStatus.PENDING,
+                                                  {}).values():
+                if task.resreq.is_empty():
+                    continue
+                ssn._fire_allocate(task)
+                simulated.append(task)
+        namespaces.push(ns)
+
+    for task in reversed(simulated):
+        ssn._fire_deallocate(task)
+    return ordered
+
+
+def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4) -> None:
+    """Fused executor: iterate (order simulation → one device solve) until
+    the admitted-job set stabilizes, then replay the final solve through
+    Statements. Convergence is usually immediate; gang rollbacks trigger one
+    extra iteration because a failed job must stop influencing queue shares
+    and overused gating."""
+    assumed: Optional[set] = None
+    solution = None
+    for _ in range(max_order_iters):
+        ordered_jobs = _fixed_job_order(ssn, assumed)
+        if not ordered_jobs:
+            return
+        solution = _solve_fused(ssn, ordered_jobs, blocks)
+        if solution is None:
+            return
+        kept_uids = {solution.jobs_list[jx].uid
+                     for jx in range(len(solution.jobs_list))
+                     if solution.job_kept[jx]}
+        # assumed=None simulated "all jobs admitted" — if the solve indeed
+        # kept every job the premise held and no re-solve is needed.
+        if kept_uids == assumed or (
+                assumed is None
+                and kept_uids == {j.uid for j in ordered_jobs}):
+            break
+        assumed = kept_uids
+    _replay_fused(ssn, solution)
+
+
+class _FusedSolution:
+    def __init__(self, tasks, job_ix, jobs_list, node_t, task_node,
+                 pipelined, job_ready, job_kept):
+        self.tasks = tasks
+        self.job_ix = job_ix
+        self.jobs_list = jobs_list
+        self.node_t = node_t
+        self.task_node = task_node
+        self.pipelined = pipelined
+        self.job_ready = job_ready
+        self.job_kept = job_kept
+
+
+def _solve_fused(ssn, ordered_jobs, blocks: bool):
+    import jax.numpy as jnp
+    from ..ops.place import JobMeta, PlacementTasks
+    from ..ops.auction import BlockTasks
+
+    tasks: List[TaskInfo] = []
+    job_ix: List[int] = []
+    job_index: Dict[str, int] = {}
+    jobs_list: List = []
+    for job in ordered_jobs:
+        jtasks = _pending_tasks(ssn, job)
+        if not jtasks:
+            continue
+        if job.uid not in job_index:
+            job_index[job.uid] = len(jobs_list)
+            jobs_list.append(job)
+        tasks.extend(jtasks)
+        job_ix.extend([job_index[job.uid]] * len(jtasks))
+    if not tasks or not ssn.nodes:
+        return None
+
+    rnames = discover_resource_names(list(ssn.nodes.values()), tasks)
+    node_t = NodeTensors(list(ssn.nodes.values()), rnames)
+    req = task_requests(tasks, rnames)
+    feas = assemble_feasibility(ssn, tasks, node_t)
+    static = assemble_static_score(ssn, tasks, node_t)
+    weights = assemble_weights(ssn, rnames)
+
+    T = len(tasks)
+    J = len(jobs_list)
+    bucket = _bucket(T)
+    pad = bucket - T
+    job_ix_np = np.asarray(job_ix, np.int32)
+    first = np.zeros(T, bool)
+    last = np.zeros(T, bool)
+    first[0] = True
+    first[1:] = job_ix_np[1:] != job_ix_np[:-1]
+    last[:-1] = job_ix_np[1:] != job_ix_np[:-1]
+    last[-1] = True
+
+    jobs_meta = JobMeta(
+        min_available=jnp.asarray([j.min_available for j in jobs_list], jnp.int32),
+        base_ready=jnp.asarray([j.ready_task_num() for j in jobs_list], jnp.int32),
+        base_pipelined=jnp.asarray([j.waiting_task_num() for j in jobs_list],
+                                   jnp.int32))
+
+    if blocks:
+        bt = BlockTasks(req=jnp.asarray(req), job_ix=jnp.asarray(job_ix_np),
+                        valid=jnp.ones(T, bool), feas=jnp.asarray(feas),
+                        static_score=jnp.asarray(static))
+        assign, ready, _ = _fused_blocks_solver()(
+            node_t.node_state(), bt, jobs_meta, weights,
+            jnp.asarray(node_t.allocatable), jnp.asarray(node_t.max_tasks))
+        task_node = np.asarray(assign)
+        pipelined = np.zeros(T, bool)
+        job_ready = np.asarray(ready)
+        job_kept = job_ready
+    else:
+        pt = PlacementTasks(
+            req=jnp.asarray(np.pad(req, ((0, pad), (0, 0)))),
+            job_ix=jnp.asarray(np.pad(job_ix_np, (0, pad))),
+            valid=jnp.asarray(np.r_[np.ones(T, bool), np.zeros(pad, bool)]),
+            feas=jnp.asarray(np.pad(feas, ((0, pad), (0, 0)))),
+            static_score=jnp.asarray(np.pad(static, ((0, pad), (0, 0)))),
+            first_of_job=jnp.asarray(np.pad(first, (0, pad))),
+            last_of_job=jnp.asarray(np.pad(last, (0, pad))))
+        result = _job_solver()(node_t.node_state(), pt, jobs_meta, weights,
+                               jnp.asarray(node_t.allocatable),
+                               jnp.asarray(node_t.max_tasks))
+        task_node = np.asarray(result.task_node[:T])
+        pipelined = np.asarray(result.task_pipelined[:T])
+        job_ready = np.asarray(result.job_ready)
+        job_kept = np.asarray(result.job_kept)
+
+    return _FusedSolution(tasks, job_ix_np, jobs_list, node_t, task_node,
+                          pipelined, job_ready, job_kept)
+
+
+def _replay_fused(ssn, sol: _FusedSolution) -> None:
+    """Replay device decisions through Statements, job by job, preserving
+    gang atomicity on the host model (statement.go semantics)."""
+    per_job_tasks: Dict[int, List[int]] = {}
+    for i, jx in enumerate(sol.job_ix):
+        per_job_tasks.setdefault(int(jx), []).append(i)
+
+    for jx, task_ids in per_job_tasks.items():
+        if not sol.job_kept[jx]:
+            continue
+        job = sol.jobs_list[jx]
+        stmt = ssn.statement()
+        for i in task_ids:
+            n = int(sol.task_node[i])
+            if n == NO_NODE:
+                continue
+            name = sol.node_t.names[n]
+            if sol.pipelined[i]:
+                stmt.pipeline(sol.tasks[i], name)
+            else:
+                stmt.allocate(sol.tasks[i], ssn.nodes[name])
+        if ssn.job_ready(job):
+            stmt.commit()
+        elif not ssn.job_pipelined(job):
+            stmt.discard()
+
+
+def _fused_blocks_solver():
+    import jax
+    if "blocks" not in _SOLVER_CACHE:
+        from ..ops.auction import place_blocks
+        _SOLVER_CACHE["blocks"] = jax.jit(
+            place_blocks, static_argnames=("chunk", "sweeps", "passes"))
+    return _SOLVER_CACHE["blocks"]
+
+
+def _fit_error(task, node):
+    from ..api.types import NODE_RESOURCE_FIT_FAILED
+    err = ValueError(f"task {task.key()} on node {node.name}: resource fit failed")
+    err.fit_error = FitError(task, node, [NODE_RESOURCE_FIT_FAILED])
+    return err

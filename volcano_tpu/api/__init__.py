@@ -1,0 +1,24 @@
+"""Scheduler in-memory model (the reference's pkg/scheduler/api, re-shaped
+for dense-tensor snapshots)."""
+
+from .resource import (CPU, GPU_RESOURCE_NAME, INFINITY, MEMORY, MIN_RESOURCE,
+                       PODS, TPU_RESOURCE_NAME, ZERO, Resource, ResourceNames,
+                       parse_quantity)
+from .types import (BusAction, BusEvent, JobPhase, NodePhase, PodGroupPhase,
+                    PodGroupConditionType, QueueState, TaskStatus,
+                    allocated_status)
+from .job_info import DisruptionBudget, JobInfo, PodGroup, TaskInfo
+from .node_info import NodeInfo
+from .queue_info import NamespaceCollection, NamespaceInfo, QueueInfo, QueueSpec
+from .cluster_info import ClusterInfo
+from .unschedule_info import FitError, FitErrors
+
+__all__ = [
+    "CPU", "GPU_RESOURCE_NAME", "INFINITY", "MEMORY", "MIN_RESOURCE", "PODS",
+    "TPU_RESOURCE_NAME", "ZERO", "Resource", "ResourceNames", "parse_quantity",
+    "BusAction", "BusEvent", "JobPhase", "NodePhase", "PodGroupPhase",
+    "PodGroupConditionType", "QueueState", "TaskStatus", "allocated_status",
+    "DisruptionBudget", "JobInfo", "PodGroup", "TaskInfo", "NodeInfo",
+    "NamespaceCollection", "NamespaceInfo", "QueueInfo", "QueueSpec",
+    "ClusterInfo", "FitError", "FitErrors",
+]

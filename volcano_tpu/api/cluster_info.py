@@ -1,0 +1,29 @@
+"""ClusterInfo: the per-cycle snapshot root.
+
+Mirrors /root/reference/pkg/scheduler/api/cluster_info.go. The snapshot is the
+session's isolated world: plugins and actions mutate only this copy, never the
+live cache. The TPU path additionally materializes it into dense tensors
+(see volcano_tpu.cache.snapshot.SnapshotTensors).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .job_info import JobInfo
+from .node_info import NodeInfo
+from .queue_info import NamespaceInfo, QueueInfo
+
+
+class ClusterInfo:
+    def __init__(self):
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.namespaces: Dict[str, NamespaceInfo] = {}
+        self.revocable_nodes: Dict[str, NodeInfo] = {}
+        self.node_list: list = []
+
+    def __repr__(self) -> str:
+        return (f"ClusterInfo(jobs={len(self.jobs)} nodes={len(self.nodes)} "
+                f"queues={len(self.queues)})")

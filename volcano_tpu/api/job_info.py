@@ -1,0 +1,284 @@
+"""TaskInfo and JobInfo: the in-memory scheduling model of tasks and gangs.
+
+Mirrors /root/reference/pkg/scheduler/api/pod_info.go and job_info.go:187-600
+(gang state: MinAvailable, TaskStatusIndex, ReadyTaskNum, ValidTaskNum,
+CheckTaskMinAvailable), re-shaped so a snapshot can be flattened into dense
+``f32[T, R]`` request tensors for the TPU solver.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time as _time
+from typing import Dict, List, Optional, TYPE_CHECKING
+
+from .resource import Resource
+from .types import PodGroupPhase, TaskStatus, allocated_status
+
+if TYPE_CHECKING:
+    from .unschedule_info import FitErrors
+
+_uid_counter = itertools.count()
+
+
+def _new_uid(prefix: str) -> str:
+    return f"{prefix}-{next(_uid_counter)}"
+
+
+class DisruptionBudget:
+    """JobInfo disruption budget (job_info.go:354-365)."""
+
+    def __init__(self, min_available: Optional[int] = None,
+                 max_unavailable: Optional[int] = None):
+        self.min_available = min_available
+        self.max_unavailable = max_unavailable
+
+
+class TaskInfo:
+    """One schedulable unit (a pod in the reference, pod_info.go)."""
+
+    def __init__(self, uid: Optional[str] = None, name: str = "", namespace: str = "default",
+                 job: str = "", resreq: Optional[Resource] = None,
+                 status: TaskStatus = TaskStatus.PENDING, priority: int = 1,
+                 node_name: str = "", task_role: str = "",
+                 node_selector: Optional[Dict[str, str]] = None,
+                 tolerations: Optional[List[dict]] = None,
+                 affinity: Optional[dict] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 annotations: Optional[Dict[str, str]] = None,
+                 preemptable: bool = False, revocable_zone: str = "",
+                 creation_timestamp: Optional[float] = None,
+                 pod: object = None):
+        self.uid = uid or _new_uid("task")
+        self.name = name or self.uid
+        self.namespace = namespace
+        self.job = job                      # owning JobInfo uid
+        self.resreq = resreq.clone() if resreq else Resource()
+        # InitResreq: request at admission time; Resreq may be zeroed when the
+        # task is running on opportunistic resources. We keep them equal unless
+        # a caller changes one.
+        self.init_resreq = self.resreq.clone()
+        self.status = status
+        self.priority = priority
+        self.node_name = node_name
+        # task_role groups replicas of the same task template; per-template
+        # minAvailable (job_info.go TaskMinAvailable) is keyed by it.
+        self.task_role = task_role or name
+        self.node_selector = dict(node_selector or {})
+        self.tolerations = list(tolerations or [])
+        self.affinity = affinity or {}
+        self.labels = dict(labels or {})
+        self.annotations = dict(annotations or {})
+        self.preemptable = preemptable
+        self.revocable_zone = revocable_zone
+        self.creation_timestamp = creation_timestamp if creation_timestamp is not None else _time.time()
+        self.pod = pod                      # backing store object, if any
+        self.volume_ready = False
+
+    @property
+    def best_effort(self) -> bool:
+        return self.init_resreq.is_empty()
+
+    def clone(self) -> "TaskInfo":
+        t = TaskInfo(uid=self.uid, name=self.name, namespace=self.namespace,
+                     job=self.job, resreq=self.resreq, status=self.status,
+                     priority=self.priority, node_name=self.node_name,
+                     task_role=self.task_role, node_selector=self.node_selector,
+                     tolerations=self.tolerations, affinity=self.affinity,
+                     labels=self.labels, annotations=self.annotations,
+                     preemptable=self.preemptable, revocable_zone=self.revocable_zone,
+                     creation_timestamp=self.creation_timestamp, pod=self.pod)
+        t.init_resreq = self.init_resreq.clone()
+        t.volume_ready = self.volume_ready
+        return t
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def __repr__(self) -> str:
+        return (f"Task({self.namespace}/{self.name} job={self.job} "
+                f"status={self.status.name} node={self.node_name!r})")
+
+
+class PodGroup:
+    """Minimal scheduling/v1beta1 PodGroup mirror carried on JobInfo."""
+
+    def __init__(self, name: str = "", namespace: str = "default", queue: str = "default",
+                 min_member: int = 0, min_resources: Optional[Resource] = None,
+                 priority_class_name: str = "",
+                 phase: PodGroupPhase = PodGroupPhase.PENDING,
+                 annotations: Optional[Dict[str, str]] = None,
+                 labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.namespace = namespace
+        self.queue = queue
+        self.min_member = min_member
+        self.min_resources = min_resources
+        self.priority_class_name = priority_class_name
+        self.phase = phase
+        self.conditions: List[dict] = []
+        self.conditions_dirty = False
+        self.annotations = dict(annotations or {})
+        self.labels = dict(labels or {})
+        self.running = 0
+        self.succeeded = 0
+        self.failed = 0
+
+
+class JobInfo:
+    """A gang: the scheduler-side view of one PodGroup and its tasks."""
+
+    def __init__(self, uid: Optional[str] = None, name: str = "",
+                 namespace: str = "default", queue: str = "default",
+                 priority: int = 0, min_available: int = 0,
+                 podgroup: Optional[PodGroup] = None,
+                 creation_timestamp: Optional[float] = None):
+        self.uid = uid or _new_uid("job")
+        self.name = name or self.uid
+        self.namespace = namespace
+        self.queue = queue
+        self.priority = priority
+        self.min_available = min_available
+        self.waiting_time: Optional[float] = None
+
+        self.job_fit_errors = ""
+        self.nodes_fit_errors: Dict[str, "FitErrors"] = {}
+
+        self.tasks: Dict[str, TaskInfo] = {}
+        self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
+        self.task_min_available: Dict[str, int] = {}
+        self.task_min_available_total = 0
+
+        self.allocated = Resource()
+        self.total_request = Resource()
+
+        self.creation_timestamp = creation_timestamp if creation_timestamp is not None else _time.time()
+        self.podgroup = podgroup or PodGroup(name=self.name, namespace=namespace,
+                                             queue=queue, min_member=min_available)
+        self.preemptable = False
+        self.revocable_zone = ""
+        self.budget: Optional[DisruptionBudget] = None
+
+    # -- task bookkeeping (job_info.go:375-437) -----------------------------
+
+    def _add_index(self, task: TaskInfo) -> None:
+        self.task_status_index.setdefault(task.status, {})[task.uid] = task
+
+    def _del_index(self, task: TaskInfo) -> None:
+        bucket = self.task_status_index.get(task.status)
+        if bucket is not None:
+            bucket.pop(task.uid, None)
+            if not bucket:
+                del self.task_status_index[task.status]
+
+    def add_task_info(self, task: TaskInfo) -> None:
+        task.job = self.uid
+        self.tasks[task.uid] = task
+        self._add_index(task)
+        if task.status == TaskStatus.PENDING or allocated_status(task.status):
+            self.total_request.add(task.resreq)
+        if allocated_status(task.status):
+            self.allocated.add(task.resreq)
+
+    def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
+        own = self.tasks.get(task.uid)
+        if own is None:
+            raise KeyError(f"task {task.uid} not in job {self.uid}")
+        if allocated_status(own.status):
+            self.allocated.sub(own.resreq)
+        self._del_index(own)
+        own.status = status
+        if allocated_status(status):
+            self.allocated.add(own.resreq)
+        self._add_index(own)
+
+    def delete_task_info(self, task: TaskInfo) -> None:
+        own = self.tasks.pop(task.uid, None)
+        if own is None:
+            return
+        if allocated_status(own.status):
+            self.allocated.sub(own.resreq)
+        if own.status == TaskStatus.PENDING or allocated_status(own.status):
+            self.total_request.sub(own.resreq)
+        self._del_index(own)
+
+    # -- gang state (job_info.go:509-600) -----------------------------------
+
+    def ready_task_num(self) -> int:
+        """Allocated/Bound/Binding/Running + Succeeded + best-effort Pending."""
+        occupied = 0
+        for status, tasks in self.task_status_index.items():
+            if allocated_status(status) or status == TaskStatus.SUCCEEDED:
+                occupied += len(tasks)
+            elif status == TaskStatus.PENDING:
+                occupied += sum(1 for t in tasks.values() if t.init_resreq.is_empty())
+        return occupied
+
+    def waiting_task_num(self) -> int:
+        return len(self.task_status_index.get(TaskStatus.PIPELINED, {}))
+
+    def ready(self) -> bool:
+        return self.ready_task_num() >= self.min_available
+
+    def pipelined(self) -> bool:
+        return self.waiting_task_num() + self.ready_task_num() >= self.min_available
+
+    def valid_task_num(self) -> int:
+        occupied = 0
+        for status, tasks in self.task_status_index.items():
+            if (allocated_status(status) or status in
+                    (TaskStatus.SUCCEEDED, TaskStatus.PIPELINED, TaskStatus.PENDING)):
+                occupied += len(tasks)
+        return occupied
+
+    def check_task_min_available(self) -> bool:
+        """Per-task-template minAvailable check (job_info.go:543-570)."""
+        if self.min_available < self.task_min_available_total:
+            return True
+        actual: Dict[str, int] = {}
+        for status, tasks in self.task_status_index.items():
+            if (allocated_status(status) or status in
+                    (TaskStatus.SUCCEEDED, TaskStatus.PIPELINED, TaskStatus.PENDING)):
+                for t in tasks.values():
+                    actual[t.task_role] = actual.get(t.task_role, 0) + 1
+        for role, min_avail in self.task_min_available.items():
+            if actual.get(role, 0) < min_avail:
+                return False
+        return True
+
+    def get_min_resources(self) -> Resource:
+        if self.podgroup and self.podgroup.min_resources is not None:
+            return self.podgroup.min_resources.clone()
+        return Resource()
+
+    def is_pending(self) -> bool:
+        return (self.podgroup is None
+                or self.podgroup.phase in (PodGroupPhase.PENDING, ""))
+
+    def fit_error(self) -> str:
+        """Aggregate pending-reason string (job_info.go:489-507)."""
+        counts: Dict[TaskStatus, int] = {}
+        for status, tasks in self.task_status_index.items():
+            counts[status] = len(tasks)
+        sorted_counts = ", ".join(
+            f"{n} {s.name}" for s, n in sorted(counts.items(), key=lambda kv: kv[0]))
+        return f"job is not ready, task statuses: {sorted_counts}"
+
+    def clone(self) -> "JobInfo":
+        job = JobInfo(uid=self.uid, name=self.name, namespace=self.namespace,
+                      queue=self.queue, priority=self.priority,
+                      min_available=self.min_available, podgroup=self.podgroup,
+                      creation_timestamp=self.creation_timestamp)
+        job.waiting_time = self.waiting_time
+        job.task_min_available = dict(self.task_min_available)
+        job.task_min_available_total = self.task_min_available_total
+        job.preemptable = self.preemptable
+        job.revocable_zone = self.revocable_zone
+        job.budget = self.budget
+        for task in self.tasks.values():
+            job.add_task_info(task.clone())
+        return job
+
+    def __repr__(self) -> str:
+        return (f"Job({self.namespace}/{self.name} queue={self.queue} "
+                f"minAvailable={self.min_available} tasks={len(self.tasks)})")

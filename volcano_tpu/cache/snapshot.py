@@ -1,0 +1,122 @@
+"""Snapshot → dense tensor marshaling: the Go↔sidecar boundary of the
+north-star design, collapsed into one process.
+
+The reference's hot loops iterate (pending task × node) pairs through plugin
+callbacks (util.PredicateNodes / PrioritizeNodes,
+/root/reference/pkg/scheduler/util/scheduler_helper.go:71-192). Here the
+session is materialized once per action into:
+
+- per-node state arrays f32[N,R] (idle/used/releasing/pipelined/allocatable),
+- per-task request rows f32[R],
+- a static feasibility mask bool[T,N] assembled from plugin feasibility fns
+  (node selectors, taints, unschedulable, affinity — everything that does not
+  depend on mutable node usage),
+- a static score matrix f32[T,N] from plugin static-score fns,
+- ScoreWeights for the in-kernel dynamic scorers.
+
+Buffers are NumPy until the final device_put so marshaling stays cheap.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import NodeInfo, Resource, ResourceNames, TaskInfo
+from ..ops.place import NodeState
+from ..ops.scores import ScoreWeights
+
+BIG_MAX_TASKS = 1 << 30
+
+
+class NodeTensors:
+    """Dense node-state arrays, index-aligned with ``names`` order."""
+
+    def __init__(self, nodes: Sequence[NodeInfo], rnames: ResourceNames):
+        self.rnames = rnames
+        self.names: List[str] = [n.name for n in nodes]
+        self.index: Dict[str, int] = {n: i for i, n in enumerate(self.names)}
+        N, R = len(nodes), len(rnames)
+        self.idle = np.zeros((N, R), np.float32)
+        self.used = np.zeros((N, R), np.float32)
+        self.releasing = np.zeros((N, R), np.float32)
+        self.pipelined = np.zeros((N, R), np.float32)
+        self.allocatable = np.zeros((N, R), np.float32)
+        self.max_tasks = np.zeros(N, np.int32)
+        self.ntasks = np.zeros(N, np.int32)
+        for i, n in enumerate(nodes):
+            self.idle[i] = n.idle.to_vector(rnames)
+            self.used[i] = n.used.to_vector(rnames)
+            self.releasing[i] = n.releasing.to_vector(rnames)
+            self.pipelined[i] = n.pipelined.to_vector(rnames)
+            self.allocatable[i] = n.allocatable.to_vector(rnames)
+            self.max_tasks[i] = n.max_task_num if n.max_task_num > 0 else BIG_MAX_TASKS
+            self.ntasks[i] = len(n.tasks)
+
+    def node_state(self) -> NodeState:
+        import jax.numpy as jnp
+        return NodeState(
+            idle=jnp.asarray(self.idle),
+            future_idle=jnp.asarray(self.idle + self.releasing - self.pipelined),
+            used=jnp.asarray(self.used),
+            ntasks=jnp.asarray(self.ntasks))
+
+
+def discover_resource_names(nodes: Sequence[NodeInfo],
+                            tasks: Sequence[TaskInfo]) -> ResourceNames:
+    rs: List[Resource] = [n.allocatable for n in nodes]
+    rs += [t.resreq for t in tasks]
+    return ResourceNames.discover(rs)
+
+
+def task_requests(tasks: Sequence[TaskInfo], rnames: ResourceNames) -> np.ndarray:
+    T, R = len(tasks), len(rnames)
+    req = np.zeros((T, R), np.float32)
+    for i, t in enumerate(tasks):
+        req[i] = t.init_resreq.to_vector(rnames)
+    return req
+
+
+def assemble_feasibility(ssn, tasks: Sequence[TaskInfo],
+                         node_t: NodeTensors) -> np.ndarray:
+    """AND of all plugin feasibility contributions; base mask excludes
+    not-ready nodes (snapshot already dropped them) — plugins add selectors/
+    taints/affinity (predicates plugin) and revocable-zone windows (tdm)."""
+    mask = np.ones((len(tasks), len(node_t.names)), dtype=bool)
+    for fn in ssn.feasibility_fns.values():
+        m = fn(ssn, tasks, node_t)
+        if m is not None:
+            mask &= m
+    return mask
+
+
+def assemble_static_score(ssn, tasks: Sequence[TaskInfo],
+                          node_t: NodeTensors) -> np.ndarray:
+    score = np.zeros((len(tasks), len(node_t.names)), dtype=np.float32)
+    for fn in ssn.static_score_fns.values():
+        s = fn(ssn, tasks, node_t)
+        if s is not None:
+            score += s.astype(np.float32)
+    return score
+
+
+def assemble_weights(ssn, rnames: ResourceNames) -> ScoreWeights:
+    """Merge plugin weight contributions into one ScoreWeights. Plugins set
+    e.g. {'binpack_weight': 1, 'binpack_res': {...}} or {'least_req_weight': 1}
+    via ssn.set_dynamic_score_weights."""
+    import jax.numpy as jnp
+    binpack_res = np.zeros(len(rnames), np.float32)
+    vals = {"binpack_weight": 0.0, "least_req_weight": 0.0,
+            "most_req_weight": 0.0, "balanced_weight": 0.0}
+    for w in ssn.dynamic_score_weights.values():
+        for k in vals:
+            vals[k] += float(w.get(k, 0.0))
+        for rname, rw in (w.get("binpack_res") or {}).items():
+            if rname in rnames.index:
+                binpack_res[rnames.index[rname]] += float(rw)
+    return ScoreWeights(binpack_weight=vals["binpack_weight"],
+                        binpack_res=jnp.asarray(binpack_res),
+                        least_req_weight=vals["least_req_weight"],
+                        most_req_weight=vals["most_req_weight"],
+                        balanced_weight=vals["balanced_weight"])

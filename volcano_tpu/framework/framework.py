@@ -1,0 +1,40 @@
+"""OpenSession/CloseSession (mirrors
+/root/reference/pkg/scheduler/framework/framework.go:30-60)."""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+from .. import metrics
+from .conf import Configuration, Tier
+from .registry import get_plugin_builder
+from .session import Session
+
+
+def open_session(cache, tiers: List[Tier],
+                 configurations: List[Configuration] = ()) -> Session:
+    ssn = Session(cache, tiers, list(configurations))
+    for tier in tiers:
+        for opt in tier.plugins:
+            builder = get_plugin_builder(opt.name)
+            if builder is None:
+                continue
+            plugin = builder(opt.arguments)
+            ssn.plugins[plugin.name()] = plugin
+            start = time.perf_counter()
+            plugin.on_session_open(ssn)
+            metrics.update_plugin_duration(plugin.name(), "OnSessionOpen",
+                                           time.perf_counter() - start)
+    return ssn
+
+
+def close_session(ssn: Session) -> None:
+    for plugin in ssn.plugins.values():
+        start = time.perf_counter()
+        plugin.on_session_close(ssn)
+        metrics.update_plugin_duration(plugin.name(), "OnSessionClose",
+                                       time.perf_counter() - start)
+    # writeback of job/podgroup status (job_updater.go:95-108)
+    from .job_updater import update_all
+    update_all(ssn)

@@ -1,0 +1,45 @@
+"""Session-close writeback of PodGroup status, mirroring
+/root/reference/pkg/scheduler/framework/job_updater.go:85-108 (the reference
+fans out over 16 workers; here the cache write is in-process so a loop
+suffices — dedup on unchanged status is kept).
+"""
+
+from __future__ import annotations
+
+from ..api import PodGroupPhase, TaskStatus, allocated_status
+
+
+def job_terminated(job) -> bool:
+    return all(t.status in (TaskStatus.SUCCEEDED, TaskStatus.FAILED)
+               for t in job.tasks.values()) and bool(job.tasks)
+
+
+def _phase_for(job) -> PodGroupPhase:
+    if job.podgroup.phase == PodGroupPhase.PENDING:
+        return PodGroupPhase.PENDING
+    running = sum(1 for t in job.tasks.values()
+                  if t.status == TaskStatus.RUNNING or allocated_status(t.status))
+    if running >= job.min_available and job.min_available > 0:
+        return PodGroupPhase.RUNNING
+    return job.podgroup.phase
+
+
+def update_all(ssn) -> None:
+    for job in ssn.jobs.values():
+        pg = job.podgroup
+        running = sum(1 for t in job.tasks.values()
+                      if t.status == TaskStatus.RUNNING)
+        succeeded = sum(1 for t in job.tasks.values()
+                        if t.status == TaskStatus.SUCCEEDED)
+        failed = sum(1 for t in job.tasks.values()
+                     if t.status == TaskStatus.FAILED)
+        new_phase = _phase_for(job)
+        changed = (pg.running != running or pg.succeeded != succeeded
+                   or pg.failed != failed or pg.phase != new_phase
+                   or pg.conditions_dirty)
+        if not changed:
+            continue
+        pg.running, pg.succeeded, pg.failed = running, succeeded, failed
+        pg.phase = new_phase
+        pg.conditions_dirty = False
+        ssn.cache.update_job_status(job)

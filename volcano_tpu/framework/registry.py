@@ -1,0 +1,57 @@
+"""Plugin and action registries.
+
+Mirrors /root/reference/pkg/scheduler/framework/plugins.go:38-119. The
+reference loads custom plugins from ``.so`` files via Go's plugin.Open; the
+Python-native equivalent loads modules from a ``--plugins-dir`` (each module
+exposes ``New(arguments)``) or from installed entry points.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import threading
+from typing import Callable, Dict, Optional
+
+_lock = threading.Lock()
+_plugin_builders: Dict[str, Callable] = {}
+_actions: Dict[str, object] = {}
+
+
+def register_plugin_builder(name: str, builder: Callable) -> None:
+    with _lock:
+        _plugin_builders[name] = builder
+
+
+def get_plugin_builder(name: str) -> Optional[Callable]:
+    with _lock:
+        return _plugin_builders.get(name)
+
+
+def register_action(action) -> None:
+    with _lock:
+        _actions[action.name()] = action
+
+
+def get_action(name: str):
+    with _lock:
+        return _actions.get(name)
+
+
+def load_custom_plugins(plugins_dir: str) -> None:
+    """Load every ``*.py`` in plugins_dir; each must define ``New(arguments)``
+    returning a plugin, registered under the module basename
+    (the analogue of plugins.go:62-99)."""
+    for fname in sorted(os.listdir(plugins_dir)):
+        if not fname.endswith(".py"):
+            continue
+        name = fname[:-3]
+        path = os.path.join(plugins_dir, fname)
+        spec = importlib.util.spec_from_file_location(f"vtpu_custom_{name}", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        if not hasattr(mod, "New"):
+            raise ValueError(f"custom plugin {path} lacks New(arguments)")
+        register_plugin_builder(name, mod.New)

@@ -1,0 +1,152 @@
+"""Prometheus metrics with the reference's metric names
+(/root/reference/pkg/scheduler/metrics/metrics.go:38-130, queue.go), so
+dashboards and the benchmark harness read identically.
+
+Falls back to an in-process recorder if prometheus_client is unavailable.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, Tuple
+
+try:
+    from prometheus_client import Counter, Gauge, Histogram, start_http_server
+    _HAVE_PROM = True
+except Exception:                                            # pragma: no cover
+    _HAVE_PROM = False
+
+_SUBSYSTEM = "volcano"
+
+_lock = threading.Lock()
+# local mirror (always kept, powers tests and the CLI without scraping)
+_durations: Dict[Tuple[str, ...], list] = collections.defaultdict(list)
+_gauges: Dict[Tuple[str, ...], float] = {}
+_counters: Dict[Tuple[str, ...], float] = collections.defaultdict(float)
+
+if _HAVE_PROM:
+    _e2e = Histogram(f"{_SUBSYSTEM}_e2e_scheduling_latency_milliseconds",
+                     "E2e scheduling latency in ms")
+    _action = Histogram(f"{_SUBSYSTEM}_action_scheduling_latency_microseconds",
+                        "Action latency in us", ["action"])
+    _plugin = Histogram(f"{_SUBSYSTEM}_plugin_scheduling_latency_microseconds",
+                        "Plugin latency in us", ["plugin", "OnSession"])
+    _task_lat = Histogram(f"{_SUBSYSTEM}_task_scheduling_latency_milliseconds",
+                          "Task scheduling latency in ms")
+    _attempts = Counter(f"{_SUBSYSTEM}_schedule_attempts_total",
+                        "Schedule attempts", ["result"])
+    _preempt_victims = Gauge(f"{_SUBSYSTEM}_pod_preemption_victims",
+                             "Current preemption victims")
+    _preempt_total = Counter(f"{_SUBSYSTEM}_total_preemption_attempts",
+                             "Total preemption attempts")
+    _unsched_tasks = Gauge(f"{_SUBSYSTEM}_unschedule_task_count",
+                           "Unschedulable tasks", ["job_id"])
+    _unsched_jobs = Counter(f"{_SUBSYSTEM}_unschedule_job_count",
+                            "Unschedulable jobs")
+    _q_alloc = Gauge(f"{_SUBSYSTEM}_queue_allocated_milli_cpu",
+                     "Queue allocated mcpu", ["queue_name"])
+    _q_alloc_mem = Gauge(f"{_SUBSYSTEM}_queue_allocated_memory_bytes",
+                         "Queue allocated memory", ["queue_name"])
+    _q_deserved = Gauge(f"{_SUBSYSTEM}_queue_deserved_milli_cpu",
+                        "Queue deserved mcpu", ["queue_name"])
+    _q_deserved_mem = Gauge(f"{_SUBSYSTEM}_queue_deserved_memory_bytes",
+                            "Queue deserved memory", ["queue_name"])
+    _q_share = Gauge(f"{_SUBSYSTEM}_queue_share", "Queue share", ["queue_name"])
+    _q_weight = Gauge(f"{_SUBSYSTEM}_queue_weight", "Queue weight", ["queue_name"])
+
+
+def update_e2e_duration(seconds: float) -> None:
+    with _lock:
+        _durations[("e2e",)].append(seconds * 1e3)
+    if _HAVE_PROM:
+        _e2e.observe(seconds * 1e3)
+
+
+def update_action_duration(action: str, seconds: float) -> None:
+    with _lock:
+        _durations[("action", action)].append(seconds * 1e6)
+    if _HAVE_PROM:
+        _action.labels(action=action).observe(seconds * 1e6)
+
+
+def update_plugin_duration(plugin: str, event: str, seconds: float) -> None:
+    with _lock:
+        _durations[("plugin", plugin, event)].append(seconds * 1e6)
+    if _HAVE_PROM:
+        _plugin.labels(plugin=plugin, OnSession=event).observe(seconds * 1e6)
+
+
+def update_task_schedule_duration(seconds: float) -> None:
+    with _lock:
+        _durations[("task",)].append(seconds * 1e3)
+    if _HAVE_PROM:
+        _task_lat.observe(seconds * 1e3)
+
+
+def register_schedule_attempt(result: str) -> None:
+    with _lock:
+        _counters[("attempts", result)] += 1
+    if _HAVE_PROM:
+        _attempts.labels(result=result).inc()
+
+
+def update_preemption_victims(count: int) -> None:
+    with _lock:
+        _gauges[("preemption_victims",)] = count
+    if _HAVE_PROM:
+        _preempt_victims.set(count)
+
+
+def register_preemption_attempt() -> None:
+    with _lock:
+        _counters[("preemption_attempts",)] += 1
+    if _HAVE_PROM:
+        _preempt_total.inc()
+
+
+def update_unschedule_task_count(job_id: str, count: int) -> None:
+    with _lock:
+        _gauges[("unschedule_tasks", job_id)] = count
+    if _HAVE_PROM:
+        _unsched_tasks.labels(job_id=job_id).set(count)
+
+
+def register_unschedule_job() -> None:
+    with _lock:
+        _counters[("unschedule_jobs",)] += 1
+    if _HAVE_PROM:
+        _unsched_jobs.inc()
+
+
+def update_queue_metrics(name: str, allocated_mcpu: float, allocated_mem: float,
+                         deserved_mcpu: float = 0.0, deserved_mem: float = 0.0,
+                         share: float = 0.0, weight: float = 1.0) -> None:
+    with _lock:
+        _gauges[("queue_allocated", name)] = allocated_mcpu
+        _gauges[("queue_share", name)] = share
+    if _HAVE_PROM:
+        _q_alloc.labels(queue_name=name).set(allocated_mcpu)
+        _q_alloc_mem.labels(queue_name=name).set(allocated_mem)
+        _q_deserved.labels(queue_name=name).set(deserved_mcpu)
+        _q_deserved_mem.labels(queue_name=name).set(deserved_mem)
+        _q_share.labels(queue_name=name).set(share)
+        _q_weight.labels(queue_name=name).set(weight)
+
+
+def serve(port: int = 8080) -> None:
+    """Expose /metrics like cmd/scheduler --listen-address (options.go:32,94)."""
+    if _HAVE_PROM:
+        start_http_server(port)
+
+
+def local_durations() -> Dict[Tuple[str, ...], list]:
+    with _lock:
+        return {k: list(v) for k, v in _durations.items()}
+
+
+def reset_local() -> None:
+    with _lock:
+        _durations.clear()
+        _gauges.clear()
+        _counters.clear()

@@ -1,0 +1,24 @@
+"""Pure-JAX kernels: the device-side math of the scheduler."""
+
+from .dense import EPS, is_empty, le_all, le_some, safe_div
+from .scores import (ScoreWeights, balanced_allocation_score, binpack_score,
+                     combined_dynamic_score, default_weights,
+                     least_allocated_score, most_allocated_score)
+from .place import (NO_NODE, JobMeta, NodeState, PlacementResult,
+                    PlacementTasks, gang_admission, make_node_state,
+                    place_scan)
+from .auction import BlockTasks, place_blocks
+from .fairness import (ProportionResult, dominant_share, drf_shares,
+                       proportion_deserved, queue_overused)
+
+__all__ = [
+    "EPS", "is_empty", "le_all", "le_some", "safe_div",
+    "ScoreWeights", "balanced_allocation_score", "binpack_score",
+    "combined_dynamic_score", "default_weights", "least_allocated_score",
+    "most_allocated_score",
+    "NO_NODE", "JobMeta", "NodeState", "PlacementResult", "PlacementTasks",
+    "gang_admission", "make_node_state", "place_scan",
+    "BlockTasks", "place_blocks",
+    "ProportionResult", "dominant_share", "drf_shares", "proportion_deserved",
+    "queue_overused",
+]

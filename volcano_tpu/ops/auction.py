@@ -1,0 +1,162 @@
+"""Block-greedy batched placement — the throughput path.
+
+The parity path (ops/place.py) replays the reference's task-by-task loop and
+is serial in T. This solver instead processes tasks in chunks of C: one chunk
+scores all C tasks against current node state at once (dense [C, N] work that
+maps onto the VPU/MXU), resolves intra-chunk capacity contention exactly with
+an exclusive cumulative-sum of requests per chosen node, and commits the chunk
+in one step. Chunked greedy differs from pure sequential only in that scores
+are evaluated at chunk granularity; capacity feasibility is exact.
+
+Gang semantics are restored after placement: a segment-sum gang check
+(ops/place.gang_admission) rejects jobs that missed minAvailable, their
+resources are returned in one vectorized rollback, and an optional extra
+sweep reuses the freed capacity — the batched analogue of
+Statement.Commit/Discard (statement.go:352-395).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .dense import EPS
+from .place import NO_NODE, JobMeta, NodeState
+from .scores import ScoreWeights, combined_dynamic_score
+
+
+class BlockTasks(NamedTuple):
+    """Pending tasks in priority order, padded to a multiple of the chunk."""
+
+    req: jnp.ndarray           # f32[T,R]
+    job_ix: jnp.ndarray        # i32[T]
+    valid: jnp.ndarray         # bool[T]
+    feas: jnp.ndarray          # bool[T,N]
+    static_score: jnp.ndarray  # f32[T,N]
+
+
+def _chunk_step(allocatable, max_tasks, weights):
+    def step(nodes: NodeState, chunk):
+        req, job_ix, valid, feas, static_score = chunk
+        C, R = req.shape
+        N = nodes.idle.shape[0]
+
+        pods_ok = nodes.ntasks < max_tasks                       # [N]
+        fit = (jnp.all(req[:, None, :] < nodes.idle[None] + EPS, axis=-1)
+               & feas & pods_ok[None])                            # [C,N]
+        score = static_score + combined_dynamic_score(
+            req, nodes.used, allocatable, weights)                # [C,N]
+        masked = jnp.where(fit, score, -jnp.inf)
+        choice = jnp.argmax(masked, axis=-1)                      # [C]
+        has_node = jnp.any(fit, axis=-1) & valid                  # [C]
+
+        onehot = jax.nn.one_hot(choice, N, dtype=req.dtype) * has_node[:, None]
+
+        def contention(accept_mask):
+            """Exclusive prefix of demand claimed on each node by earlier
+            accepted tasks in this chunk; returns the accept mask under it."""
+            live = onehot * accept_mask[:, None]
+            demand = live[:, :, None] * req[:, None, :]           # [C,N,R]
+            cum = jnp.cumsum(demand, axis=0) - demand             # exclusive
+            room = jnp.all(
+                req[:, None, :] + cum[jnp.arange(C), choice][:, None, :]
+                < nodes.idle[choice][:, None, :] + EPS, axis=-1)[:, 0]
+            cum_count = jnp.cumsum(live, axis=0) - live
+            pods_room = (nodes.ntasks[choice]
+                         + cum_count[jnp.arange(C), choice] < max_tasks[choice])
+            return has_node & room & pods_room                    # [C]
+
+        # Pass 1 counts every bidder's demand (conservative: a rejected
+        # bidder still blocks later ones); pass 2 recounts with only the
+        # accepted demand, admitting tasks wrongly displaced by rejected
+        # earlier bidders. Remaining misses retry in the next chunk pass.
+        accept = contention(jnp.ones(C, dtype=bool))
+        accept = accept | contention(accept)
+        accept = contention(accept)   # re-validate the merged set
+
+        placed = onehot * accept[:, None]
+        delta = jnp.einsum("cn,cr->nr", placed, req)
+        nodes = NodeState(
+            idle=nodes.idle - delta,
+            future_idle=nodes.future_idle - delta,
+            used=nodes.used + delta,
+            ntasks=nodes.ntasks + jnp.sum(placed, axis=0).astype(jnp.int32))
+        out = jnp.where(accept, choice, NO_NODE).astype(jnp.int32)
+        return nodes, out
+
+    return step
+
+
+def place_blocks(nodes: NodeState, tasks: BlockTasks, jobs: JobMeta,
+                 weights: ScoreWeights, allocatable: jnp.ndarray,
+                 max_tasks: jnp.ndarray, chunk: int = 256,
+                 sweeps: int = 2, passes: int = 2,
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, NodeState]:
+    """Place tasks; returns (task_node i32[T], job_ready bool[J], nodes).
+
+    Each sweep runs ``passes`` placement passes — a task rejected in pass k
+    (its chosen node filled up inside the chunk) retries against updated node
+    state in pass k+1 — then one gang check rolls back jobs below
+    minAvailable. Later sweeps let other jobs reuse freed capacity.
+    """
+    T = tasks.req.shape[0]
+    pad = (-T) % chunk
+    if pad:
+        tasks = BlockTasks(
+            req=jnp.pad(tasks.req, ((0, pad), (0, 0))),
+            job_ix=jnp.pad(tasks.job_ix, (0, pad)),
+            valid=jnp.pad(tasks.valid, (0, pad)),
+            feas=jnp.pad(tasks.feas, ((0, pad), (0, 0))),
+            static_score=jnp.pad(tasks.static_score, ((0, pad), (0, 0))))
+    Tp = T + pad
+    n_chunks = Tp // chunk
+
+    def reshape(x):
+        return x.reshape((n_chunks, chunk) + x.shape[1:])
+
+    J = jobs.min_available.shape[0]
+    assign = jnp.full(Tp, NO_NODE, dtype=jnp.int32)
+
+    def place_pass(carry, _):
+        nodes, assign, job_dead = carry
+        todo = (assign == NO_NODE) & tasks.valid & ~job_dead[tasks.job_ix]
+        xs = (reshape(tasks.req), reshape(tasks.job_ix), reshape(todo),
+              reshape(tasks.feas), reshape(tasks.static_score))
+        nodes, out = jax.lax.scan(
+            _chunk_step(allocatable, max_tasks, weights), nodes, xs)
+        assign = jnp.where(assign == NO_NODE, out.reshape(Tp), assign)
+        return (nodes, assign, job_dead), None
+
+    def sweep(carry, _):
+        (nodes, new_assign, job_dead), _ = jax.lax.scan(
+            place_pass, carry, jnp.arange(passes))
+
+        # Gang check + vectorized rollback of non-admitted jobs (batched
+        # Statement.Discard). A rolled-back job does not retry in later
+        # sweeps — the reference pops each job once and discards for good
+        # (allocate.go:264-270).
+        placed = new_assign != NO_NODE
+        counts = jax.ops.segment_sum(placed.astype(jnp.int32),
+                                     tasks.job_ix, num_segments=J)
+        ready = counts + jobs.base_ready >= jobs.min_available
+        keep_task = ready[tasks.job_ix] & placed
+        drop = placed & ~keep_task
+        drop_hot = (jax.nn.one_hot(jnp.where(drop, new_assign, 0),
+                                   nodes.idle.shape[0], dtype=tasks.req.dtype)
+                    * drop[:, None])
+        freed = jnp.einsum("tn,tr->nr", drop_hot, tasks.req)
+        nodes = NodeState(
+            idle=nodes.idle + freed,
+            future_idle=nodes.future_idle + freed,
+            used=nodes.used - freed,
+            ntasks=nodes.ntasks - jnp.sum(drop_hot, axis=0).astype(jnp.int32))
+        new_assign = jnp.where(drop, NO_NODE, new_assign)
+        job_dead = job_dead | (~ready & (counts > 0))
+        return (nodes, new_assign, job_dead), ready
+
+    job_dead = jnp.zeros(J, dtype=bool)
+    (nodes, assign, _), readies = jax.lax.scan(
+        sweep, (nodes, assign, job_dead), jnp.arange(sweeps))
+    return assign[:T], readies[-1], nodes

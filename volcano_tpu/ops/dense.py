@@ -1,0 +1,33 @@
+"""Dense resource-vector primitives shared by all device kernels.
+
+Every resource quantity is one lane of an ``f32[..., R]`` array (lane layout
+fixed by api.ResourceNames). Comparisons carry the reference's 0.1 epsilon
+(resource_info.go:36,311-316): ``l <= r`` means ``l < r + 0.1``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Epsilon from resource_info.go:36. `l < r or |l-r| < eps` == `l < r + eps`.
+EPS = 0.1
+
+
+def le_all(l: jnp.ndarray, r: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """LessEqualInAllDimension over the resource axis (resource_info.go:310)."""
+    return jnp.all(l < r + EPS, axis=axis)
+
+
+def le_some(l: jnp.ndarray, r: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Any dimension of l strictly below r (LessInSomeDimension)."""
+    return jnp.any(l < r, axis=axis)
+
+
+def is_empty(v: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """All dimensions below epsilon (resource_info.go:142-155)."""
+    return jnp.all(v < EPS, axis=axis)
+
+
+def safe_div(num: jnp.ndarray, den: jnp.ndarray) -> jnp.ndarray:
+    """num/den with 0 where den == 0 (scores never divide by zero capacity)."""
+    return jnp.where(den > 0, num / jnp.where(den > 0, den, 1.0), 0.0)

@@ -1,0 +1,98 @@
+"""Fairness math as fixed-point array iterations.
+
+- Proportion's deserved water-filling
+  (/root/reference/pkg/scheduler/plugins/proportion/proportion.go:132-196):
+  each round grants every unmet queue ``remaining * weight/totalWeight``,
+  clamps to capability and request, and stops when nothing moves. Here one
+  round is a masked vector update over ``f32[Q,R]`` and the loop is
+  ``lax.while_loop``.
+
+- DRF dominant share (/root/reference/pkg/scheduler/plugins/drf/drf.go:202-520):
+  ``share_j = max_r allocated_jr / total_r`` — one reduction.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .dense import EPS, le_all
+
+
+class ProportionResult(NamedTuple):
+    deserved: jnp.ndarray   # f32[Q,R]
+    share: jnp.ndarray      # f32[Q]
+
+
+def proportion_deserved(total: jnp.ndarray, weight: jnp.ndarray,
+                        request: jnp.ndarray, capability: jnp.ndarray,
+                        allocated: jnp.ndarray,
+                        max_iters: int = 64) -> ProportionResult:
+    """Water-fill cluster resources into per-queue `deserved` vectors.
+
+    total: f32[R]; weight: f32[Q]; request/capability/allocated: f32[Q,R]
+    (capability uses +inf for unlimited dimensions).
+    """
+    Q, R = request.shape
+
+    def cond(state):
+        i, deserved, meet, remaining, moved = state
+        total_w = jnp.sum(jnp.where(meet, 0.0, weight))
+        return (i < max_iters) & (total_w > 0) & moved & jnp.any(remaining >= EPS)
+
+    def body(state):
+        i, deserved, meet, remaining, _ = state
+        active = ~meet
+        total_w = jnp.sum(jnp.where(active, weight, 0.0))
+        grant = remaining[None, :] * (weight / jnp.maximum(total_w, 1e-9))[:, None]
+        new_deserved = deserved + jnp.where(active[:, None], grant, 0.0)
+
+        # capability clamp: if any dimension exceeds capability, queue is met
+        # at min(deserved, capability, request) (proportion.go:163-169)
+        over_cap = active & ~le_all(new_deserved, capability)
+        # request met: request <= deserved in all dims (proportion.go:170-173)
+        req_met = active & ~over_cap & le_all(request, new_deserved)
+
+        capped = jnp.minimum(jnp.minimum(new_deserved, capability), request)
+        # still-unmet queues clamp per-dimension to request
+        # (MinDimensionResource, proportion.go:174-177)
+        clamped = jnp.minimum(new_deserved, request)
+
+        new_deserved = jnp.where(over_cap[:, None], capped,
+                                 jnp.where(req_met[:, None],
+                                           jnp.minimum(new_deserved, request),
+                                           jnp.where(active[:, None], clamped,
+                                                     deserved)))
+        new_meet = meet | over_cap | req_met
+
+        delta = jnp.sum(new_deserved - deserved, axis=0)   # inc - dec per dim
+        new_remaining = remaining - delta
+        moved = jnp.any(jnp.abs(delta) >= EPS)
+        return i + 1, new_deserved, new_meet, new_remaining, moved
+
+    init = (jnp.int32(0), jnp.zeros_like(request),
+            jnp.zeros(Q, dtype=bool), total, jnp.bool_(True))
+    _, deserved, _, _, _ = jax.lax.while_loop(cond, body, init)
+    share = dominant_share(allocated, jnp.maximum(deserved, 0.0))
+    return ProportionResult(deserved=deserved, share=share)
+
+
+def dominant_share(used: jnp.ndarray, denom: jnp.ndarray) -> jnp.ndarray:
+    """max_r used_r/denom_r, dims with denom 0: share=1 if used>0 else 0
+    (proportion.go updateShare / drf.go calculateShare)."""
+    ratio = jnp.where(denom > 0, used / jnp.where(denom > 0, denom, 1.0),
+                      jnp.where(used > 0, 1.0, 0.0))
+    return jnp.max(ratio, axis=-1)
+
+
+def drf_shares(job_allocated: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
+    """DRF dominant share per job: allocated f32[J,R], total f32[R] -> f32[J]."""
+    return dominant_share(job_allocated, jnp.broadcast_to(total, job_allocated.shape))
+
+
+def queue_overused(allocated: jnp.ndarray, deserved: jnp.ndarray) -> jnp.ndarray:
+    """proportion OverusedFn (proportion.go:244): allocated exceeds deserved
+    in ANY dimension, i.e. NOT allocated <= deserved in all dims."""
+    return ~le_all(allocated, deserved)

@@ -1,0 +1,191 @@
+"""The placement kernel: Volcano's allocate loop as one jitted scan.
+
+The reference allocates task-by-task (actions/allocate/allocate.go:42-277),
+mutating node Idle as it goes, and wraps each job's placements in a Statement
+that commits only if the gang is Ready (statement.go:229-289,352-395). Here
+the whole loop is a single ``lax.scan`` over the ordered task list:
+
+- carry: tentative node state + the last committed state (the Statement
+  undo-log, reduced to "restore the snapshot saved at job start");
+- per step: feasibility = dense resource fit vs FutureIdle (allocate.go:111-118)
+  AND a host-precomputed static predicate mask; score = static score matrix +
+  dynamic state-dependent scorers (ops/scores.py); best node by argmax
+  (reference tie-breaks randomly, scheduler_helper.go:210-225 — we tie-break
+  by lowest node index for determinism);
+- allocate if the task fits Idle, else pipeline onto FutureIdle
+  (allocate.go:232-256);
+- at a job boundary: gang check (gang.go jobReadyFn: occupied >= MinAvailable)
+  decides commit vs rollback, exactly Statement.Commit/Discard — a job that is
+  merely Pipelined keeps its session-local state but emits no binds
+  (allocate.go:264-270).
+
+Because every step is vector ops over [N, R] arrays, XLA fuses the whole
+per-task body into a few kernels; T sequential steps are the only serial
+dimension. For batched/parallel placement see ops/auction.py.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .dense import EPS, le_all
+from .scores import ScoreWeights, combined_dynamic_score
+
+NO_NODE = -1
+
+
+class NodeState(NamedTuple):
+    """Mutable per-node accounting (api.NodeInfo reduced to arrays)."""
+
+    idle: jnp.ndarray          # f32[N,R]
+    future_idle: jnp.ndarray   # f32[N,R] = idle + releasing - pipelined
+    used: jnp.ndarray          # f32[N,R]
+    ntasks: jnp.ndarray        # i32[N] current pod count
+
+
+class PlacementTasks(NamedTuple):
+    """Pending tasks in processing order (host decides the order: the
+    namespace/queue/job/task priority-queue interleave)."""
+
+    req: jnp.ndarray           # f32[T,R]
+    job_ix: jnp.ndarray        # i32[T]
+    valid: jnp.ndarray         # bool[T] padding mask
+    feas: jnp.ndarray          # bool[T,N] static predicates (affinity/taints/...)
+    static_score: jnp.ndarray  # f32[T,N] session-constant score terms
+    first_of_job: jnp.ndarray  # bool[T]
+    last_of_job: jnp.ndarray   # bool[T]
+
+
+class JobMeta(NamedTuple):
+    min_available: jnp.ndarray   # i32[J]
+    base_ready: jnp.ndarray      # i32[J] ReadyTaskNum before this action
+    base_pipelined: jnp.ndarray  # i32[J] WaitingTaskNum before this action
+
+
+class PlacementResult(NamedTuple):
+    task_node: jnp.ndarray     # i32[T] chosen node or NO_NODE
+    task_pipelined: jnp.ndarray  # bool[T] pipeline (vs allocate)
+    job_ready: jnp.ndarray     # bool[J] gang Ready -> Statement committed (bind)
+    job_kept: jnp.ndarray      # bool[J] state kept (ready or pipelined)
+    nodes: NodeState           # final committed node state
+
+
+class _Carry(NamedTuple):
+    tent: NodeState            # tentative (inside current job's statement)
+    saved: NodeState           # committed state at current job's start
+    cnt_alloc: jnp.ndarray     # i32 newly-allocated tasks of current job
+    cnt_pipe: jnp.ndarray      # i32 newly-pipelined tasks of current job
+    broken: jnp.ndarray        # bool: a task of this job had no feasible node
+
+
+def _select(pred, a: NodeState, b: NodeState) -> NodeState:
+    return NodeState(*(jnp.where(pred, x, y) for x, y in zip(a, b)))
+
+
+def make_node_state(idle, releasing, pipelined, used, ntasks) -> NodeState:
+    return NodeState(idle=idle, future_idle=idle + releasing - pipelined,
+                     used=used, ntasks=ntasks)
+
+
+def place_scan(nodes: NodeState, tasks: PlacementTasks, jobs: JobMeta,
+               weights: ScoreWeights, allocatable: jnp.ndarray,
+               max_tasks: jnp.ndarray) -> PlacementResult:
+    """Run the sequential-parity placement over all tasks.
+
+    allocatable: f32[N,R]; max_tasks: i32[N] (pod-count capacity; the
+    reference checks it first in the predicate chain, predicates.go:267-290).
+    """
+    J = jobs.min_available.shape[0]
+
+    def step(carry: _Carry, inp):
+        (req, job_ix, valid, feas, static_score,
+         first_of_job, last_of_job) = inp
+
+        # Job boundary: snapshot committed state (Statement open).
+        saved = _select(first_of_job, carry.tent, carry.saved)
+        cnt_alloc = jnp.where(first_of_job, 0, carry.cnt_alloc)
+        cnt_pipe = jnp.where(first_of_job, 0, carry.cnt_pipe)
+        broken = jnp.where(first_of_job, False, carry.broken)
+        tent = carry.tent
+
+        # Predicate: resource fit vs FutureIdle + static mask + pod count
+        # (allocate.go:111-118 predicateFn).
+        pods_ok = tent.ntasks < max_tasks
+        fit_future = le_all(req[None, :], tent.future_idle) & feas & pods_ok
+        fit_idle = le_all(req[None, :], tent.idle) & fit_future
+        has_node = jnp.any(fit_future)
+
+        # Reference breaks out of the job's task loop when no node passes
+        # predicates (allocate.go:206-210).
+        attempt = valid & ~broken
+        broken = broken | (attempt & ~has_node)
+
+        score = static_score + combined_dynamic_score(
+            req, tent.used, allocatable, weights)
+        # Prefer feasible nodes; among them argmax score, lowest index on tie.
+        masked = jnp.where(fit_future, score, -jnp.inf)
+        best = jnp.argmax(masked)
+
+        do_place = attempt & has_node
+        do_alloc = do_place & fit_idle[best]
+        do_pipe = do_place & ~fit_idle[best]
+
+        onehot = (jnp.arange(tent.idle.shape[0]) == best)[:, None]  # [N,1]
+        delta = onehot * req[None, :]
+        new_idle = tent.idle - jnp.where(do_alloc, delta, 0.0)
+        new_used = tent.used + jnp.where(do_alloc, delta, 0.0)
+        # allocate consumes idle (so future_idle too); pipeline only reserves
+        # future resources (node_info.go AddTask Pipelined case).
+        new_fidle = tent.future_idle - jnp.where(do_place, delta, 0.0)
+        new_ntasks = tent.ntasks + jnp.where(
+            do_place, onehot[:, 0].astype(jnp.int32), 0)
+        tent = NodeState(new_idle, new_fidle, new_used, new_ntasks)
+
+        cnt_alloc = cnt_alloc + do_alloc.astype(jnp.int32)
+        cnt_pipe = cnt_pipe + do_pipe.astype(jnp.int32)
+
+        # Job boundary close: gang vote (gang.go:45-216) -> commit/keep/rollback.
+        min_avail = jobs.min_available[job_ix]
+        ready = jobs.base_ready[job_ix] + cnt_alloc >= min_avail
+        pipelined_ok = (jobs.base_ready[job_ix] + jobs.base_pipelined[job_ix]
+                        + cnt_alloc + cnt_pipe >= min_avail)
+        keep = ready | pipelined_ok
+        commit_now = last_of_job & valid
+        tent = _select(commit_now & ~keep, saved, tent)
+
+        out = (jnp.where(do_place, best, NO_NODE).astype(jnp.int32),
+               do_pipe,
+               commit_now & ready,
+               commit_now & keep)
+        return _Carry(tent, saved, cnt_alloc, cnt_pipe, broken), out
+
+    init = _Carry(tent=nodes, saved=nodes,
+                  cnt_alloc=jnp.int32(0), cnt_pipe=jnp.int32(0),
+                  broken=jnp.bool_(False))
+    xs = (tasks.req, tasks.job_ix, tasks.valid, tasks.feas, tasks.static_score,
+          tasks.first_of_job, tasks.last_of_job)
+    carry, (task_node, task_pipe, job_ready_t, job_kept_t) = jax.lax.scan(
+        step, init, xs)
+
+    # Scatter per-boundary job verdicts to [J].
+    job_ready = jnp.zeros(J, dtype=bool).at[tasks.job_ix].max(job_ready_t)
+    job_kept = jnp.zeros(J, dtype=bool).at[tasks.job_ix].max(job_kept_t)
+
+    kept_task = job_kept[tasks.job_ix]
+    task_node = jnp.where(kept_task, task_node, NO_NODE)
+    return PlacementResult(task_node=task_node, task_pipelined=task_pipe,
+                           job_ready=job_ready, job_kept=job_kept,
+                           nodes=carry.tent)
+
+
+def gang_admission(assigned: jnp.ndarray, job_ix: jnp.ndarray,
+                   min_needed: jnp.ndarray) -> jnp.ndarray:
+    """Gang feasibility reduction: per-job count of assigned tasks vs
+    remaining minAvailable (the batched analogue of JobInfo.Ready,
+    job_info.go:587-590). assigned: bool[T]; returns bool[J]."""
+    counts = jax.ops.segment_sum(assigned.astype(jnp.int32), job_ix,
+                                 num_segments=min_needed.shape[0])
+    return counts >= min_needed

@@ -1,0 +1,117 @@
+"""Node-order scoring as vectorized array expressions.
+
+Each scorer maps (task request ``f32[R]`` or batch ``f32[T,R]``, node state
+``f32[N,R]``) → ``f32[N]``/``f32[T,N]``. These replace the per-(task,node)
+callback scorers of the reference:
+
+- binpack       /root/reference/pkg/scheduler/plugins/binpack/binpack.go:196-260
+- least/most    k8s noderesources plugins wrapped by
+                /root/reference/pkg/scheduler/plugins/nodeorder/nodeorder.go:179-269
+- balanced      k8s NodeResourcesBalancedAllocation (same wrap)
+
+All scorers are pure and state comes in as arguments, so the placement scan
+can re-evaluate them as node usage mutates — the array analogue of the
+EventHandler-driven cache updates in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from .dense import safe_div
+
+MAX_NODE_SCORE = 100.0
+
+
+class ScoreWeights(NamedTuple):
+    """Static weights for the dynamic (state-dependent) scorers.
+
+    binpack_res: f32[R] per-resource binpack weights (binpack.go:89-155;
+    defaults cpu=1, memory=1, others 0 unless configured).
+    """
+
+    binpack_weight: float = 1.0
+    binpack_res: jnp.ndarray = None            # f32[R]
+    least_req_weight: float = 1.0
+    most_req_weight: float = 0.0
+    balanced_weight: float = 1.0
+
+
+def binpack_score(req: jnp.ndarray, used: jnp.ndarray, allocatable: jnp.ndarray,
+                  res_weights: jnp.ndarray, plugin_weight: float) -> jnp.ndarray:
+    """Best-fit score (BinPackingScore, binpack.go:196-260).
+
+    req: f32[R] (one task) or f32[T,R]; used/allocatable: f32[N,R];
+    res_weights: f32[R]. Returns f32[N] or f32[T,N].
+
+    Per resource r with request>0 and weight>0:
+      score_r = (used_r + req_r) * w_r / allocatable_r   (0 if would overflow)
+    total = sum_r score_r / sum_r w_r * 100 * plugin_weight
+    """
+    req_b = req[..., None, :]                      # [..., 1, R]
+    used_finally = used + req_b                    # [..., N, R]
+    active = (req_b > 0) & (res_weights > 0)       # dims that participate
+    fits = used_finally <= allocatable             # inclusive (binpack.go:253)
+    per_res = jnp.where(active & fits & (allocatable > 0),
+                        safe_div(used_finally * res_weights, allocatable), 0.0)
+    weight_sum = jnp.sum(jnp.where(req_b > 0, res_weights, 0.0), axis=-1)
+    score = safe_div(jnp.sum(per_res, axis=-1), weight_sum)
+    return score * MAX_NODE_SCORE * plugin_weight
+
+
+def least_allocated_score(req: jnp.ndarray, used: jnp.ndarray,
+                          allocatable: jnp.ndarray) -> jnp.ndarray:
+    """k8s NodeResourcesLeastAllocated with cpu/memory weight 50/50
+    (nodeorder.go:179-190): mean over {cpu,mem} of
+    (alloc - used - req) * 100 / alloc."""
+    req_b = req[..., None, :]
+    frac = safe_div(allocatable - used - req_b, allocatable)
+    frac = jnp.clip(frac, 0.0, 1.0)
+    return jnp.mean(frac[..., :2], axis=-1) * MAX_NODE_SCORE
+
+
+def most_allocated_score(req: jnp.ndarray, used: jnp.ndarray,
+                         allocatable: jnp.ndarray) -> jnp.ndarray:
+    """k8s NodeResourcesMostAllocated, cpu/mem weights 1/1 (nodeorder.go:195-202)."""
+    req_b = req[..., None, :]
+    frac = safe_div(used + req_b, allocatable)
+    frac = jnp.where(frac > 1.0, 0.0, frac)        # over-capacity scores 0
+    return jnp.mean(frac[..., :2], axis=-1) * MAX_NODE_SCORE
+
+
+def balanced_allocation_score(req: jnp.ndarray, used: jnp.ndarray,
+                              allocatable: jnp.ndarray) -> jnp.ndarray:
+    """k8s NodeResourcesBalancedAllocation (nodeorder.go:204-206):
+    (1 - std(resource fractions)) * 100 over cpu/mem."""
+    req_b = req[..., None, :]
+    frac = jnp.clip(safe_div(used + req_b, allocatable), 0.0, 1.0)[..., :2]
+    mean = jnp.mean(frac, axis=-1, keepdims=True)
+    std = jnp.sqrt(jnp.mean((frac - mean) ** 2, axis=-1))
+    return (1.0 - std) * MAX_NODE_SCORE
+
+
+def combined_dynamic_score(req: jnp.ndarray, used: jnp.ndarray,
+                           allocatable: jnp.ndarray,
+                           w: ScoreWeights) -> jnp.ndarray:
+    """Weighted sum of all state-dependent scorers, mirroring how the session
+    sums NodeOrderFn contributions (session_plugins.go NodeOrderFn)."""
+    # weights may be traced scalars under jit — gate with multiplication,
+    # never Python branches; XLA drops the zero-weight terms after constant
+    # folding when weights are compile-time constants.
+    score = binpack_score(req, used, allocatable, w.binpack_res,
+                          w.binpack_weight)
+    score = score + w.least_req_weight * least_allocated_score(req, used, allocatable)
+    score = score + w.most_req_weight * most_allocated_score(req, used, allocatable)
+    score = score + w.balanced_weight * balanced_allocation_score(req, used, allocatable)
+    return score
+
+
+def default_weights(num_res: int) -> ScoreWeights:
+    """Default plugin weights: binpack cpu/mem = 1, others 0; nodeorder
+    least=1, most=0, balanced=1 (nodeorder.go:71-138, binpack.go:89-155)."""
+    res = jnp.zeros(num_res, dtype=jnp.float32).at[:2].set(1.0)
+    return ScoreWeights(binpack_weight=1.0, binpack_res=res,
+                        least_req_weight=1.0, most_req_weight=0.0,
+                        balanced_weight=1.0)

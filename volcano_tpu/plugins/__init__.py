@@ -1,0 +1,20 @@
+"""Policy plugins (mirrors /root/reference/pkg/scheduler/plugins/factory.go:38-56).
+
+Importing this package registers all in-tree plugins.
+"""
+
+from ..framework.registry import register_plugin_builder
+from .base import Plugin
+from . import binpack, conformance, drf, gang, nodeorder, predicates, priority
+from . import proportion
+
+register_plugin_builder("gang", gang.New)
+register_plugin_builder("priority", priority.New)
+register_plugin_builder("conformance", conformance.New)
+register_plugin_builder("drf", drf.New)
+register_plugin_builder("proportion", proportion.New)
+register_plugin_builder("binpack", binpack.New)
+register_plugin_builder("nodeorder", nodeorder.New)
+register_plugin_builder("predicates", predicates.New)
+
+__all__ = ["Plugin"]

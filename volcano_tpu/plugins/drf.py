@@ -1,0 +1,107 @@
+"""DRF plugin: dominant-resource fairness job ordering and preemption.
+
+Mirrors /root/reference/pkg/scheduler/plugins/drf/drf.go:202-520. The share
+math (max_r allocated_r/total_r) is the ops.fairness.dominant_share kernel;
+per-event share maintenance stays on host because it is O(1) per task event.
+Hierarchical DRF (drf.go:522-663) is provided by the `hdrf` arguments flag.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+from ..api import Resource, allocated_status
+from ..framework.session import ABSTAIN, PERMIT, EventHandler
+from .base import Plugin
+
+SHARE_DELTA = 0.000001
+
+
+class _Attr:
+    def __init__(self):
+        self.allocated = Resource()
+        self.share = 0.0
+
+
+def calculate_share(allocated: Resource, total: Resource) -> float:
+    share = 0.0
+    for name in total.resource_names():
+        t = total.get(name)
+        a = allocated.get(name)
+        if t > 0:
+            share = max(share, a / t)
+        elif a > 0:
+            share = max(share, 1.0)
+    return share
+
+
+class DRFPlugin(Plugin):
+    NAME = "drf"
+
+    def __init__(self, arguments=None):
+        super().__init__(arguments)
+        self.total = Resource()
+        self.job_attrs: Dict[str, _Attr] = {}
+
+    def on_session_open(self, ssn) -> None:
+        for node in ssn.nodes.values():
+            self.total.add(node.allocatable)
+
+        for job in ssn.jobs.values():
+            attr = _Attr()
+            for t in job.tasks.values():
+                if allocated_status(t.status):
+                    attr.allocated.add(t.resreq)
+            attr.share = calculate_share(attr.allocated, self.total)
+            self.job_attrs[job.uid] = attr
+
+        def preemptable(preemptor, preemptees):
+            """Victim iff preemptor's share (with the task) stays <= the
+            preemptee job's share after losing the task (drf.go:308-330)."""
+            latt = self.job_attrs[preemptor.job]
+            lalloc = latt.allocated.clone().add(preemptor.resreq)
+            ls = calculate_share(lalloc, self.total)
+            victims = []
+            allocations: Dict[str, Resource] = {}
+            for preemptee in preemptees:
+                if preemptee.job not in allocations:
+                    allocations[preemptee.job] = \
+                        self.job_attrs[preemptee.job].allocated.clone()
+                ralloc = allocations[preemptee.job].sub(preemptee.resreq)
+                rs = calculate_share(ralloc, self.total)
+                if ls < rs or abs(ls - rs) <= SHARE_DELTA:
+                    victims.append(preemptee)
+            return victims, PERMIT
+
+        ssn.add_preemptable_fn(self.NAME, preemptable)
+
+        def job_order(l, r) -> int:
+            ls = self.job_attrs[l.uid].share
+            rs = self.job_attrs[r.uid].share
+            if ls == rs:
+                return 0
+            return -1 if ls < rs else 1
+
+        ssn.add_job_order_fn(self.NAME, job_order)
+
+        def on_allocate(event):
+            attr = self.job_attrs[event.task.job]
+            attr.allocated.add(event.task.resreq)
+            attr.share = calculate_share(attr.allocated, self.total)
+
+        def on_deallocate(event):
+            attr = self.job_attrs[event.task.job]
+            attr.allocated.sub(event.task.resreq)
+            attr.share = calculate_share(attr.allocated, self.total)
+
+        ssn.add_event_handler(EventHandler(allocate_func=on_allocate,
+                                           deallocate_func=on_deallocate))
+
+    def on_session_close(self, ssn) -> None:
+        self.total = Resource()
+        self.job_attrs = {}
+
+
+def New(arguments):
+    return DRFPlugin(arguments)

@@ -1,0 +1,102 @@
+"""Predicates plugin: hard feasibility filters.
+
+Mirrors /root/reference/pkg/scheduler/plugins/predicates/predicates.go:80-362
+(task-count limit, node-unschedulable, node affinity/selector, taints) —
+re-architected for the device path: every static filter contributes to one
+``bool[T,N]`` feasibility mask (assembled in cache/snapshot.py) so the
+placement kernels never call back to the host. The host PredicateFn remains
+for callback-path actions (preempt/reclaim/backfill).
+
+Resource fit itself (vs FutureIdle, with pod-count capacity) is checked
+in-kernel because it depends on mutable node state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..api import FitError
+from ..api.types import (NODE_AFFINITY_FAILED, NODE_POD_NUMBER_EXCEEDED,
+                         NODE_UNSCHEDULABLE, TAINTS_UNTOLERATED)
+from .base import Plugin
+from .nodeorder import _toleration_matches, match_node_selector_terms
+
+
+def node_selector_ok(task, node) -> bool:
+    for k, v in task.node_selector.items():
+        if node.labels.get(k) != v:
+            return False
+    required = (task.affinity.get("nodeAffinity", {})
+                .get("requiredDuringSchedulingIgnoredDuringExecution"))
+    if required:
+        terms = required.get("nodeSelectorTerms", []) or []
+        if not match_node_selector_terms(node.labels, terms):
+            return False
+    return True
+
+
+def taints_tolerated(task, node) -> bool:
+    """NoSchedule/NoExecute taints must be tolerated (PreferNoSchedule is
+    scoring-only)."""
+    for taint in node.taints:
+        if taint.get("effect") in ("NoSchedule", "NoExecute"):
+            if not any(_toleration_matches(tol, taint)
+                       for tol in task.tolerations):
+                return False
+    return True
+
+
+class PredicatesPlugin(Plugin):
+    NAME = "predicates"
+
+    def __init__(self, arguments=None):
+        super().__init__(arguments)
+        args = self.arguments
+        self.node_affinity_enable = args.get_bool("predicate.NodeAffinityEnable", True)
+        self.taint_enable = args.get_bool("predicate.TaintTolerationEnable", True)
+        self.pod_number_enable = args.get_bool("predicate.PodNumberEnable", True)
+
+    def predicate(self, task, node) -> None:
+        if self.pod_number_enable and node.max_task_num:
+            if len(node.tasks) >= node.max_task_num:
+                raise PredicateError(task, node, NODE_POD_NUMBER_EXCEEDED)
+        if node.unschedulable:
+            raise PredicateError(task, node, NODE_UNSCHEDULABLE)
+        if self.node_affinity_enable and not node_selector_ok(task, node):
+            raise PredicateError(task, node, NODE_AFFINITY_FAILED)
+        if self.taint_enable and not taints_tolerated(task, node):
+            raise PredicateError(task, node, TAINTS_UNTOLERATED)
+
+    def feasibility_mask(self, ssn, tasks, node_t) -> np.ndarray:
+        node_infos = [ssn.nodes[name] for name in node_t.names]
+        T, N = len(tasks), len(node_infos)
+        mask = np.ones((T, N), dtype=bool)
+        sched = np.asarray([not n.unschedulable for n in node_infos], dtype=bool)
+        mask &= sched[None, :]
+        for ti, task in enumerate(tasks):
+            simple = (not task.node_selector and not task.affinity
+                      and not any(n.taints for n in node_infos))
+            if simple:
+                continue
+            for ni, node in enumerate(node_infos):
+                if not mask[ti, ni]:
+                    continue
+                if self.node_affinity_enable and not node_selector_ok(task, node):
+                    mask[ti, ni] = False
+                elif self.taint_enable and not taints_tolerated(task, node):
+                    mask[ti, ni] = False
+        return mask
+
+    def on_session_open(self, ssn) -> None:
+        ssn.add_predicate_fn(self.NAME, self.predicate)
+        ssn.add_feasibility_fn(self.NAME, self.feasibility_mask)
+
+
+class PredicateError(ValueError):
+    def __init__(self, task, node, reason: str):
+        super().__init__(f"task {task.key()} on node {node.name}: {reason}")
+        self.fit_error = FitError(task, node, [reason])
+
+
+def New(arguments):
+    return PredicatesPlugin(arguments)

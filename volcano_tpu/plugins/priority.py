@@ -1,0 +1,38 @@
+"""Priority plugin (mirrors
+/root/reference/pkg/scheduler/plugins/priority/priority.go:44-117)."""
+
+from __future__ import annotations
+
+from ..framework.session import PERMIT
+from .base import Plugin
+
+
+class PriorityPlugin(Plugin):
+    NAME = "priority"
+
+    def on_session_open(self, ssn) -> None:
+        def task_order(l, r) -> int:
+            if l.priority == r.priority:
+                return 0
+            return -1 if l.priority > r.priority else 1
+
+        ssn.add_task_order_fn(self.NAME, task_order)
+
+        def job_order(l, r) -> int:
+            if l.priority == r.priority:
+                return 0
+            return -1 if l.priority > r.priority else 1
+
+        ssn.add_job_order_fn(self.NAME, job_order)
+
+        def preemptable(preemptor, preemptees):
+            p_job = ssn.jobs[preemptor.job]
+            victims = [t for t in preemptees
+                       if ssn.jobs[t.job].priority < p_job.priority]
+            return victims, PERMIT
+
+        ssn.add_preemptable_fn(self.NAME, preemptable)
+
+
+def New(arguments):
+    return PriorityPlugin(arguments)

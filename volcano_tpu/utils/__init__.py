@@ -1,0 +1,3 @@
+from .priority_queue import PriorityQueue
+
+__all__ = ["PriorityQueue"]
