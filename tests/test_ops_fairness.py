@@ -7,6 +7,7 @@ import pytest
 
 from volcano_tpu.ops import (dominant_share, drf_shares, proportion_deserved,
                              queue_overused)
+from volcano_tpu.ops.fairness import proportion_deserved_numpy
 
 INF = float("inf")
 
@@ -65,6 +66,31 @@ class TestProportion:
         d = np.asarray(res.deserved)
         assert d[0].max() == 0.0
         assert d[1, 0] == pytest.approx(1000.0, abs=1.0)
+
+
+class TestNumpyTwin:
+    def test_numpy_matches_jax_kernel(self):
+        """The zero-compile numpy twin must match the device kernel exactly
+        (the plugin switches between them by queue count)."""
+        import numpy as _np
+        rng = _np.random.RandomState(3)
+        for _ in range(5):
+            Q, R = rng.randint(2, 8), rng.randint(2, 5)
+            total = rng.uniform(1e3, 1e5, R).astype(_np.float32)
+            weight = rng.randint(0, 5, Q).astype(_np.float32)
+            request = rng.uniform(0, 5e4, (Q, R)).astype(_np.float32)
+            cap = _np.where(rng.rand(Q, R) < 0.3,
+                            rng.uniform(1e3, 5e4, (Q, R)),
+                            _np.inf).astype(_np.float32)
+            alloc = rng.uniform(0, 2e4, (Q, R)).astype(_np.float32)
+            jres = proportion_deserved(jnp.asarray(total), jnp.asarray(weight),
+                                       jnp.asarray(request), jnp.asarray(cap),
+                                       jnp.asarray(alloc))
+            nres = proportion_deserved_numpy(total, weight, request, cap, alloc)
+            _np.testing.assert_allclose(_np.asarray(jres.deserved),
+                                        nres.deserved, rtol=1e-4, atol=1.0)
+            _np.testing.assert_allclose(_np.asarray(jres.share), nres.share,
+                                        rtol=1e-4, atol=1e-4)
 
 
 class TestDRF:

@@ -1,0 +1,137 @@
+"""Preempt/reclaim action tests — the reference's TestPreempt/TestReclaim
+pattern (pkg/scheduler/actions/{preempt,reclaim}/*_test.go): hand-built
+cache, fake evictor, real session, real action."""
+
+import pytest
+
+from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
+                             QueueInfo, Resource, TaskInfo, TaskStatus)
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.framework import PluginOption, Tier, open_session
+from volcano_tpu.actions import PreemptAction, ReclaimAction
+import volcano_tpu.plugins  # noqa: F401
+
+
+def build_job(name, queue, min_avail, tasks, priority=0, namespace="default"):
+    """tasks: list of (cpu, mem, status, node_name)."""
+    pg = PodGroup(name=name, namespace=namespace, queue=queue,
+                  min_member=min_avail, phase=PodGroupPhase.INQUEUE)
+    job = JobInfo(uid=name, name=name, namespace=namespace, queue=queue,
+                  min_available=min_avail, podgroup=pg, priority=priority)
+    for i, (cpu, mem, status, node) in enumerate(tasks):
+        job.add_task_info(TaskInfo(uid=f"{name}-{i}", name=f"{name}-{i}",
+                                   namespace=namespace, job=name,
+                                   resreq=Resource(cpu, mem), status=status,
+                                   node_name="",
+                                   creation_timestamp=float(i)))
+        if node:
+            job.tasks[f"{name}-{i}"].node_name = ""
+            job.tasks[f"{name}-{i}"]._target_node = node
+    return job
+
+
+def wire(jobs, nodes, queues):
+    evictor = FakeEvictor()
+    cache = SchedulerCache(binder=FakeBinder(), evictor=evictor)
+    for q in queues:
+        cache.add_queue(q)
+    node_map = {n.name: n for n in nodes}
+    for n in nodes:
+        cache.add_node(n)
+    for j in jobs:
+        cache.add_job(j)
+        for t in j.tasks.values():
+            target = getattr(t, "_target_node", None)
+            if target:
+                t.node_name = ""
+                node_map[target].add_task(t)
+    return cache, evictor
+
+
+PREEMPT_TIERS = [
+    Tier(plugins=[PluginOption("priority"),
+                  PluginOption("conformance"),
+                  PluginOption("gang")]),
+]
+
+
+class TestPreempt:
+    def test_high_priority_preempts_low(self):
+        """Starving high-priority gang evicts a low-priority running task
+        in the same queue and pipelines onto the freed node."""
+        low = build_job("low", "default", 1,
+                        [(3000, 3000, TaskStatus.RUNNING, "n1")], priority=1)
+        high = build_job("high", "default", 1,
+                         [(3000, 3000, TaskStatus.PENDING, None)], priority=10)
+        node = NodeInfo(name="n1", allocatable=Resource(4000, 4000))
+        cache, evictor = wire([low, high], [node],
+                              [QueueInfo(name="default", weight=1)])
+        ssn = open_session(cache, PREEMPT_TIERS, [])
+        PreemptAction().execute(ssn)
+        assert evictor.evicts == ["default/low-0"]
+        # preemptor pipelined onto the node
+        assert ssn.jobs["high"].tasks["high-0"].status == TaskStatus.PIPELINED
+        assert ssn.jobs["high"].tasks["high-0"].node_name == "n1"
+
+    def test_no_preempt_equal_priority(self):
+        low = build_job("a", "default", 1,
+                        [(3000, 3000, TaskStatus.RUNNING, "n1")], priority=5)
+        high = build_job("b", "default", 1,
+                         [(3000, 3000, TaskStatus.PENDING, None)], priority=5)
+        node = NodeInfo(name="n1", allocatable=Resource(4000, 4000))
+        cache, evictor = wire([low, high], [node],
+                              [QueueInfo(name="default", weight=1)])
+        ssn = open_session(cache, PREEMPT_TIERS, [])
+        PreemptAction().execute(ssn)
+        assert evictor.evicts == []
+
+    def test_conformance_protects_critical(self):
+        low = build_job("sys", "default", 1,
+                        [(3000, 3000, TaskStatus.RUNNING, "n1")], priority=1,
+                        namespace="kube-system")
+        high = build_job("high", "default", 1,
+                         [(3000, 3000, TaskStatus.PENDING, None)], priority=10)
+        node = NodeInfo(name="n1", allocatable=Resource(4000, 4000))
+        cache, evictor = wire([low, high], [node],
+                              [QueueInfo(name="default", weight=1)])
+        ssn = open_session(cache, PREEMPT_TIERS, [])
+        PreemptAction().execute(ssn)
+        assert evictor.evicts == []
+
+
+RECLAIM_TIERS = [
+    Tier(plugins=[PluginOption("priority"),
+                  PluginOption("conformance")]),
+    Tier(plugins=[PluginOption("proportion")]),
+]
+
+
+class TestReclaim:
+    def test_starved_queue_reclaims_from_overused(self):
+        """q2 holds the whole cluster; q1's pending job reclaims its share."""
+        hog = build_job("hog", "q2", 1,
+                        [(4000, 4000, TaskStatus.RUNNING, "n1")])
+        needy = build_job("needy", "q1", 1,
+                          [(3000, 3000, TaskStatus.PENDING, None)])
+        node = NodeInfo(name="n1", allocatable=Resource(4000, 4000))
+        cache, evictor = wire(
+            [hog, needy], [node],
+            [QueueInfo(name="q1", weight=1), QueueInfo(name="q2", weight=1)])
+        ssn = open_session(cache, RECLAIM_TIERS, [])
+        ReclaimAction().execute(ssn)
+        assert evictor.evicts == ["default/hog-0"]
+        assert ssn.jobs["needy"].tasks["needy-0"].status == TaskStatus.PIPELINED
+
+    def test_unreclaimable_queue_protected(self):
+        hog = build_job("hog", "q2", 1,
+                        [(4000, 4000, TaskStatus.RUNNING, "n1")])
+        needy = build_job("needy", "q1", 1,
+                          [(3000, 3000, TaskStatus.PENDING, None)])
+        node = NodeInfo(name="n1", allocatable=Resource(4000, 4000))
+        cache, evictor = wire(
+            [hog, needy], [node],
+            [QueueInfo(name="q1", weight=1),
+             QueueInfo(name="q2", weight=1, reclaimable=False)])
+        ssn = open_session(cache, RECLAIM_TIERS, [])
+        ReclaimAction().execute(ssn)
+        assert evictor.evicts == []

@@ -1,0 +1,213 @@
+"""In-process end-to-end tests: store + webhooks + controllers + scheduler,
+driving the full submission flow of SURVEY.md §3.2 — the e2e analogue of
+the reference's kind-cluster suites (test/e2e/jobp, jobseq, vcctl)."""
+
+import pytest
+
+from volcano_tpu.api import (BusEvent, BusAction, JobPhase, PodGroupPhase,
+                             QueueState, Resource)
+from volcano_tpu.apis.objects import (Job, JobSpec, LifecyclePolicy,
+                                      ObjectMeta, Pod, PodTemplate, TaskSpec)
+from volcano_tpu.store import AdmissionError
+from volcano_tpu.system import VolcanoSystem
+
+
+def make_system():
+    sys = VolcanoSystem(schedule_period=0.01)
+    # add worker nodes
+    from volcano_tpu.api import NodeInfo
+    for i in range(3):
+        alloc = Resource(8000, 16 << 30)
+        alloc.max_task_num = 110
+        sys.cache.add_node(NodeInfo(name=f"node-{i}", allocatable=alloc))
+    return sys
+
+
+def submit_mpi_job(sys, name="mpi-job", replicas=3, min_available=None,
+                   plugins=None):
+    job = Job(
+        metadata=ObjectMeta(name=name),
+        spec=JobSpec(
+            min_available=min_available if min_available is not None else 0,
+            tasks=[TaskSpec(name="worker", replicas=replicas,
+                            template=PodTemplate(
+                                resources=Resource(1000, 1 << 30)))],
+            plugins=plugins or {}))
+    return sys.store.create(job)
+
+
+class TestJobLifecycle:
+    def test_submit_schedule_run(self):
+        """Job create → webhook defaults → controller pods+podgroup →
+        scheduler binds gang → pods running → job Running."""
+        sys = make_system()
+        submit_mpi_job(sys)
+        # webhook defaulted minAvailable to Σreplicas
+        job = sys.store.get("Job", "default", "mpi-job")
+        assert job.spec.min_available == 3
+        # controller created pods + podgroup
+        pods = sys.store.list("Pod")
+        assert len(pods) == 3
+        pg = sys.store.get("PodGroup", "default", "mpi-job")
+        assert pg is not None and pg.spec.min_member == 3
+        assert pg.spec.min_resources.cpu == 3000
+
+        sys.schedule_once()
+
+        pods = sys.store.list("Pod")
+        assert all(p.status.phase == "Running" for p in pods)
+        assert len({p.status.node_name for p in pods}) >= 1
+        job = sys.store.get("Job", "default", "mpi-job")
+        assert job.status.running == 3
+        assert job.status.state == JobPhase.RUNNING
+        pg = sys.store.get("PodGroup", "default", "mpi-job")
+        assert pg.status.phase == PodGroupPhase.RUNNING
+
+    def test_gang_blocks_partial(self):
+        """A gang larger than the cluster binds nothing."""
+        sys = make_system()
+        submit_mpi_job(sys, name="huge", replicas=100)
+        sys.schedule_once()
+        pods = sys.store.list("Pod")
+        assert all(p.status.phase == "Pending" for p in pods)
+        pg = sys.store.get("PodGroup", "default", "huge")
+        assert any(c["type"] == "Unschedulable"
+                   for c in pg.status.conditions)
+
+    def test_complete_and_gc(self):
+        sys = make_system()
+        job = submit_mpi_job(sys)
+        job.spec.ttl_seconds_after_finished = 0.0
+        sys.schedule_once()
+        for pod in list(sys.store.list("Pod")):
+            sys.store.finish_pod(pod.metadata.namespace, pod.metadata.name)
+        job = sys.store.get("Job", "default", "mpi-job")
+        assert job.status.state == JobPhase.COMPLETED
+        from volcano_tpu.controllers import GarbageCollector
+        gc = next(c for c in sys.controllers
+                  if isinstance(c, GarbageCollector))
+        deleted = gc.process()
+        assert deleted == ["default/mpi-job"]
+        assert sys.store.get("Job", "default", "mpi-job") is None
+
+    def test_suspend_resume(self):
+        """vcctl suspend posts an AbortJob command; pods are torn down;
+        resume restarts (SURVEY.md §3.4)."""
+        sys = make_system()
+        submit_mpi_job(sys)
+        sys.schedule_once()
+        sys.jobs.suspend("mpi-job")
+        job = sys.store.get("Job", "default", "mpi-job")
+        assert job.status.state in (JobPhase.ABORTING, JobPhase.ABORTED)
+        assert sys.store.list("Pod") == []
+        sys.jobs.resume("mpi-job")
+        job = sys.store.get("Job", "default", "mpi-job")
+        assert job.status.state in (JobPhase.RESTARTING, JobPhase.PENDING,
+                                    JobPhase.RUNNING)
+        # pods recreated after resync
+        assert len(sys.store.list("Pod")) == 3
+
+    def test_pod_failure_policy_restart(self):
+        """LifecyclePolicy PodFailed -> RestartJob tears down and retries
+        (job_error_handling e2e analogue)."""
+        sys = make_system()
+        job = Job(
+            metadata=ObjectMeta(name="fragile"),
+            spec=JobSpec(
+                tasks=[TaskSpec(name="w", replicas=2,
+                                template=PodTemplate(
+                                    resources=Resource(1000, 1 << 30)))],
+                policies=[LifecyclePolicy(event=BusEvent.POD_FAILED,
+                                          action=BusAction.RESTART_JOB)]))
+        sys.store.create(job)
+        sys.schedule_once()
+        pod = sys.store.list("Pod")[0]
+        sys.store.finish_pod(pod.metadata.namespace, pod.metadata.name,
+                             succeeded=False)
+        job = sys.store.get("Job", "default", "fragile")
+        assert job.status.retry_count == 1
+        assert job.status.state in (JobPhase.RESTARTING, JobPhase.PENDING)
+
+    def test_job_plugins_env_svc(self):
+        sys = make_system()
+        submit_mpi_job(sys, name="mpi", plugins={"env": [], "svc": [],
+                                                 "ssh": []})
+        pods = sys.store.list("Pod")
+        env = {e["name"]: e["value"] for e in pods[0].template.env}
+        assert env["VC_TASK_INDEX"] in ("0", "1", "2")
+        assert "mpi-worker-0.mpi" in env["VC_WORKER_HOSTS"]
+        assert env["VC_WORKER_NUM"] == "3"
+        assert any(v.get("secret") == "mpi-ssh"
+                   for v in pods[0].template.volumes)
+        job = sys.store.get("Job", "default", "mpi")
+        assert job.metadata.annotations.get("volcano.sh/ssh-secret") == "mpi-ssh"
+
+
+class TestAdmission:
+    def test_min_available_exceeds_replicas_denied(self):
+        sys = make_system()
+        with pytest.raises(AdmissionError):
+            submit_mpi_job(sys, name="bad", replicas=2, min_available=5)
+
+    def test_unknown_queue_denied(self):
+        sys = make_system()
+        job = Job(metadata=ObjectMeta(name="q"),
+                  spec=JobSpec(queue="nope",
+                               tasks=[TaskSpec(name="t", replicas=1)]))
+        with pytest.raises(AdmissionError):
+            sys.store.create(job)
+
+    def test_closed_queue_denied(self):
+        sys = make_system()
+        sys.queues.create("night", weight=1)
+        sys.queues.operate("night", "close")
+        q = sys.store.get("Queue", "default", "night")
+        assert q.status.state == QueueState.CLOSED
+        job = Job(metadata=ObjectMeta(name="j"),
+                  spec=JobSpec(queue="night",
+                               tasks=[TaskSpec(name="t", replicas=1)]))
+        with pytest.raises(AdmissionError):
+            sys.store.create(job)
+
+    def test_queue_weight_validated(self):
+        sys = make_system()
+        with pytest.raises(AdmissionError):
+            sys.queues.create("bad", weight=-1)
+
+    def test_duplicate_task_name_denied(self):
+        sys = make_system()
+        job = Job(metadata=ObjectMeta(name="dup"),
+                  spec=JobSpec(tasks=[TaskSpec(name="a", replicas=1),
+                                      TaskSpec(name="a", replicas=1)]))
+        with pytest.raises(AdmissionError):
+            sys.store.create(job)
+
+
+class TestBarePod:
+    def test_bare_pod_gets_podgroup_and_schedules(self):
+        """SURVEY.md §3.5: plain pod → pg controller creates a 1-gang →
+        scheduler binds it."""
+        sys = make_system()
+        pod = Pod(metadata=ObjectMeta(name="solo"),
+                  template=PodTemplate(resources=Resource(500, 1 << 30)))
+        sys.store.create(pod)
+        pgs = sys.store.list("PodGroup")
+        assert len(pgs) == 1 and pgs[0].spec.min_member == 1
+        sys.schedule_once()
+        pod = sys.store.get("Pod", "default", "solo")
+        assert pod.status.phase == "Running"
+
+
+class TestQueueCLI:
+    def test_queue_status_aggregation(self):
+        sys = make_system()
+        submit_mpi_job(sys)
+        sys.schedule_once()
+        q = sys.store.get("Queue", "default", "default")
+        assert q.status.running >= 0   # aggregated by queue controller
+        lines = []
+        from volcano_tpu.cli.vcctl import main
+        main(["queue", "list"], store=sys.store, out=lines.append)
+        assert any("default" in line for line in lines)
+        main(["job", "list"], store=sys.store, out=lines.append)
+        assert any("mpi-job" in line for line in lines)

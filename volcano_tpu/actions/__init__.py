@@ -5,12 +5,21 @@ from ..framework.registry import register_action
 from .allocate import AllocateAction, AllocateTPUAction
 from .backfill import BackfillAction
 from .base import Action
+from .elect import ElectAction
 from .enqueue import EnqueueAction
+from .preempt import PreemptAction
+from .reclaim import ReclaimAction
+from .reserve import ReserveAction
 
 register_action(EnqueueAction())
 register_action(AllocateAction())
 register_action(AllocateTPUAction())
 register_action(BackfillAction())
+register_action(PreemptAction())
+register_action(ReclaimAction())
+register_action(ElectAction())
+register_action(ReserveAction())
 
 __all__ = ["Action", "AllocateAction", "AllocateTPUAction", "BackfillAction",
-           "EnqueueAction"]
+           "ElectAction", "EnqueueAction", "PreemptAction", "ReclaimAction",
+           "ReserveAction"]

@@ -33,7 +33,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..api import (FitErrors, NodeInfo, PodGroupPhase, TaskInfo, TaskStatus)
+from ..api import (FitErrors, NodeInfo, PodGroupPhase, Resource, TaskInfo,
+                   TaskStatus)
 from ..cache.snapshot import (NodeTensors, assemble_feasibility,
                               assemble_static_score, assemble_weights,
                               discover_resource_names, task_requests)
@@ -43,6 +44,17 @@ from ..utils.scheduler_helper import (predicate_nodes, prioritize_nodes,
 from .base import Action
 
 NO_NODE = -1
+
+
+class _AggTask:
+    """Lightweight task stand-in carrying a summed resreq, used to fire one
+    aggregated allocate event per job during order simulation."""
+
+    __slots__ = ("job", "resreq")
+
+    def __init__(self, job: str, resreq):
+        self.job = job
+        self.resreq = resreq
 
 
 class AllocateAction(Action):
@@ -89,11 +101,24 @@ def _eligible_jobs(ssn):
 
 
 def _pending_tasks(ssn, job) -> List[TaskInfo]:
-    """Pending, non-best-effort tasks in TaskOrderFn order."""
+    """Pending, non-best-effort tasks in TaskOrderFn order. When only the
+    priority plugin registers a task order (the default conf), a key sort
+    replaces the comparator heap — same order, ~10x cheaper at 10k tasks."""
+    tasks = [t for t in job.task_status_index.get(TaskStatus.PENDING,
+                                                  {}).values()
+             if not t.resreq.is_empty()]
+    # the ENABLED comparator chain decides whether a key sort is equivalent
+    enabled = [name for tier in ssn.tiers for opt in tier.plugins
+               if opt.is_enabled("enabledTaskOrder")
+               and (name := opt.name) in ssn.task_order_fns]
+    if enabled == ["priority"]:
+        tasks.sort(key=lambda t: (-t.priority, t.creation_timestamp, t.uid))
+        return tasks
+    if not enabled:
+        tasks.sort(key=lambda t: (t.creation_timestamp, t.uid))
+        return tasks
     pq = PriorityQueue(ssn.task_order_fn)
-    for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
-        if task.resreq.is_empty():
-            continue
+    for task in tasks:
         pq.push(task)
     out = []
     while not pq.empty():
@@ -237,14 +262,19 @@ class _DeviceJobPlacer:
         feas = assemble_feasibility(self.ssn, tasks, self.node_t)
         static = assemble_static_score(self.ssn, tasks, self.node_t)
         T = len(tasks)
+        N = len(self.node_t.names)
         bucket = _bucket(T)
         pad = bucket - T
+        feas_d = (jnp.ones((bucket, N), bool) if feas is None
+                  else jnp.asarray(np.pad(feas, ((0, pad), (0, 0)))))
+        static_d = (jnp.zeros((bucket, N), jnp.float32) if static is None
+                    else jnp.asarray(np.pad(static, ((0, pad), (0, 0)))))
         pt = PlacementTasks(
             req=jnp.asarray(np.pad(req, ((0, pad), (0, 0)))),
             job_ix=jnp.zeros(bucket, jnp.int32),
             valid=jnp.asarray(np.r_[np.ones(T, bool), np.zeros(pad, bool)]),
-            feas=jnp.asarray(np.pad(feas, ((0, pad), (0, 0)))),
-            static_score=jnp.asarray(np.pad(static, ((0, pad), (0, 0)))),
+            feas=feas_d,
+            static_score=static_d,
             first_of_job=jnp.asarray(np.r_[[True], np.zeros(bucket - 1, bool)]),
             last_of_job=jnp.asarray(
                 np.r_[np.zeros(T - 1, bool), [True], np.zeros(pad, bool)]))
@@ -253,13 +283,15 @@ class _DeviceJobPlacer:
             base_ready=jnp.asarray([job.ready_task_num()], jnp.int32),
             base_pipelined=jnp.asarray([job.waiting_task_num()], jnp.int32))
 
-        result = self._solve(self.state, pt, jobs_meta, self.weights,
-                             self.allocatable, self.max_tasks)
-        task_node = np.asarray(result.task_node[:T])
-        pipelined = np.asarray(result.task_pipelined[:T])
-        kept = bool(result.job_kept[0])
-        if kept:
-            self.state = result.nodes
+        from ..ops.place import unpack_placement
+        packed, new_state = self._solve(self.state, pt, jobs_meta,
+                                        self.weights, self.allocatable,
+                                        self.max_tasks)
+        task_node, pipelined, _, job_kept = unpack_placement(
+            np.asarray(packed), bucket, 1)
+        task_node, pipelined = task_node[:T], pipelined[:T]
+        if bool(job_kept[0]):
+            self.state = new_state
 
         # Replay picks through the Statement for host bookkeeping. All tasks
         # are consumed — the reference pops each task from its queue exactly
@@ -289,10 +321,12 @@ _SOLVER_CACHE: dict = {}
 
 
 def _job_solver():
+    """Jitted packed solver: one device→host fetch per solve (tunnel RTTs
+    dominate on remote TPU backends)."""
     import jax
     if "solve" not in _SOLVER_CACHE:
-        from ..ops.place import place_scan
-        _SOLVER_CACHE["solve"] = jax.jit(place_scan)
+        from ..ops.place import place_scan_packed
+        _SOLVER_CACHE["solve"] = jax.jit(place_scan_packed)
     return _SOLVER_CACHE["solve"]
 
 
@@ -347,12 +381,22 @@ def _fixed_job_order(ssn, assumed_admitted: Optional[set] = None) -> List:
         job = jobs.pop()
         ordered.append(job)
         if assumed_admitted is None or job.uid in assumed_admitted:
+            # one aggregated pseudo-event per job: allocate-event handlers
+            # (drf/proportion) are additive in task.resreq, so summing the
+            # job's pending requests into a single event is equivalent and
+            # O(jobs) instead of O(tasks)
+            total = Resource()
+            count = 0
             for task in job.task_status_index.get(TaskStatus.PENDING,
                                                   {}).values():
                 if task.resreq.is_empty():
                     continue
-                ssn._fire_allocate(task)
-                simulated.append(task)
+                total.add(task.resreq)
+                count += 1
+            if count:
+                agg = _AggTask(job.uid, total)
+                ssn._fire_allocate(agg)
+                simulated.append(agg)
         namespaces.push(ns)
 
     for task in reversed(simulated):
@@ -430,6 +474,7 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool):
     weights = assemble_weights(ssn, rnames)
 
     T = len(tasks)
+    N = len(node_t.names)
     J = len(jobs_list)
     bucket = _bucket(T)
     pad = bucket - T
@@ -447,10 +492,13 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool):
         base_pipelined=jnp.asarray([j.waiting_task_num() for j in jobs_list],
                                    jnp.int32))
 
+    feas_b = (jnp.ones((T, N), bool) if feas is None else jnp.asarray(feas))
+    static_b = (jnp.zeros((T, N), jnp.float32) if static is None
+                else jnp.asarray(static))
     if blocks:
         bt = BlockTasks(req=jnp.asarray(req), job_ix=jnp.asarray(job_ix_np),
-                        valid=jnp.ones(T, bool), feas=jnp.asarray(feas),
-                        static_score=jnp.asarray(static))
+                        valid=jnp.ones(T, bool), feas=feas_b,
+                        static_score=static_b)
         assign, ready, _ = _fused_blocks_solver()(
             node_t.node_state(), bt, jobs_meta, weights,
             jnp.asarray(node_t.allocatable), jnp.asarray(node_t.max_tasks))
@@ -463,17 +511,17 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool):
             req=jnp.asarray(np.pad(req, ((0, pad), (0, 0)))),
             job_ix=jnp.asarray(np.pad(job_ix_np, (0, pad))),
             valid=jnp.asarray(np.r_[np.ones(T, bool), np.zeros(pad, bool)]),
-            feas=jnp.asarray(np.pad(feas, ((0, pad), (0, 0)))),
-            static_score=jnp.asarray(np.pad(static, ((0, pad), (0, 0)))),
+            feas=jnp.pad(feas_b, ((0, pad), (0, 0))),
+            static_score=jnp.pad(static_b, ((0, pad), (0, 0))),
             first_of_job=jnp.asarray(np.pad(first, (0, pad))),
             last_of_job=jnp.asarray(np.pad(last, (0, pad))))
-        result = _job_solver()(node_t.node_state(), pt, jobs_meta, weights,
-                               jnp.asarray(node_t.allocatable),
-                               jnp.asarray(node_t.max_tasks))
-        task_node = np.asarray(result.task_node[:T])
-        pipelined = np.asarray(result.task_pipelined[:T])
-        job_ready = np.asarray(result.job_ready)
-        job_kept = np.asarray(result.job_kept)
+        from ..ops.place import unpack_placement
+        packed, _ = _job_solver()(node_t.node_state(), pt, jobs_meta, weights,
+                                  jnp.asarray(node_t.allocatable),
+                                  jnp.asarray(node_t.max_tasks))
+        task_node, pipelined, job_ready, job_kept = unpack_placement(
+            np.asarray(packed), bucket, J)
+        task_node, pipelined = task_node[:T], pipelined[:T]
 
     return _FusedSolution(tasks, job_ix_np, jobs_list, node_t, task_node,
                           pipelined, job_ready, job_kept)

@@ -1,0 +1,21 @@
+"""Elect action: pick the reservation target job.
+
+Mirrors /root/reference/pkg/scheduler/actions/elect/elect.go:28-51.
+"""
+
+from __future__ import annotations
+
+from ..api import PodGroupPhase
+from ..utils.reservation import Reservation
+from .base import Action
+
+
+class ElectAction(Action):
+    NAME = "elect"
+
+    def execute(self, ssn) -> None:
+        if Reservation.target_job is not None:
+            return
+        pending = [job for job in ssn.jobs.values()
+                   if job.podgroup.phase == PodGroupPhase.PENDING]
+        Reservation.target_job = ssn.target_job(pending)

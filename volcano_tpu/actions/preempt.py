@@ -1,0 +1,177 @@
+"""Preempt action: within-queue job-vs-job and within-job preemption for
+starving jobs.
+
+Mirrors /root/reference/pkg/scheduler/actions/preempt/preempt.go:41-284.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from .. import metrics
+from ..api import PodGroupPhase, Resource, TaskInfo, TaskStatus
+from ..utils import PriorityQueue
+from ..utils.scheduler_helper import (predicate_nodes, prioritize_nodes,
+                                      select_best_node)
+from .base import Action
+
+
+def validate_victims(preemptor: TaskInfo, node, victims: List[TaskInfo]) -> bool:
+    """scheduler_helper.go ValidateVictims: enough future-idle after evicting
+    all victims."""
+    if not victims:
+        return False
+    future_idle = node.future_idle()
+    for v in victims:
+        future_idle.add(v.resreq)
+    return preemptor.init_resreq.less_equal(future_idle)
+
+
+def sort_nodes(node_scores) -> List:
+    out = []
+    for score in sorted(node_scores, reverse=True):
+        out.extend(node_scores[score])
+    return out
+
+
+class PreemptAction(Action):
+    NAME = "preempt"
+
+    def execute(self, ssn) -> None:
+        preemptors_map = {}
+        preemptor_tasks = {}
+        under_request = []
+        queues = {}
+
+        for job in ssn.jobs.values():
+            if job.podgroup.phase == PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.passed:
+                continue
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                continue
+            queues[queue.uid] = queue
+
+            if ssn.job_starving(job):
+                preemptors_map.setdefault(
+                    job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
+                under_request.append(job)
+                pq = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index.get(TaskStatus.PENDING,
+                                                      {}).values():
+                    pq.push(task)
+                preemptor_tasks[job.uid] = pq
+
+        # Preemption between jobs within a queue (preempt.go:83-144).
+        for queue in queues.values():
+            while True:
+                preemptors = preemptors_map.get(queue.uid)
+                if preemptors is None or preemptors.empty():
+                    break
+                preemptor_job = preemptors.pop()
+
+                stmt = ssn.statement()
+                assigned = False
+                while True:
+                    if not ssn.job_starving(preemptor_job):
+                        break
+                    if preemptor_tasks[preemptor_job.uid].empty():
+                        break
+                    preemptor = preemptor_tasks[preemptor_job.uid].pop()
+
+                    def job_filter(task: TaskInfo) -> bool:
+                        if task.status != TaskStatus.RUNNING:
+                            return False
+                        if task.resreq.is_empty():
+                            return False
+                        victim_job = ssn.jobs.get(task.job)
+                        if victim_job is None:
+                            return False
+                        return (victim_job.queue == preemptor_job.queue
+                                and preemptor.job != task.job)
+
+                    if self._preempt(ssn, stmt, preemptor, job_filter):
+                        assigned = True
+
+                if ssn.job_pipelined(preemptor_job):
+                    stmt.commit()
+                else:
+                    stmt.discard()
+                    continue
+                if assigned:
+                    preemptors.push(preemptor_job)
+
+            # Preemption between tasks within one job (preempt.go:146-183).
+            for job in under_request:
+                pq = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index.get(TaskStatus.PENDING,
+                                                      {}).values():
+                    pq.push(task)
+                preemptor_tasks[job.uid] = pq
+                while not preemptor_tasks[job.uid].empty():
+                    preemptor = preemptor_tasks[job.uid].pop()
+                    stmt = ssn.statement()
+                    assigned = self._preempt(
+                        ssn, stmt, preemptor,
+                        lambda task: (task.status == TaskStatus.RUNNING
+                                      and not task.resreq.is_empty()
+                                      and preemptor.job == task.job))
+                    stmt.commit()
+                    if not assigned:
+                        break
+
+        self._victim_tasks(ssn)
+
+    def _preempt(self, ssn, stmt, preemptor: TaskInfo,
+                 task_filter: Callable[[TaskInfo], bool]) -> bool:
+        """preempt.go:190-269: evict lowest-priority victims on the best
+        node until FutureIdle fits, then Pipeline the preemptor."""
+        assigned = False
+        nodes = list(ssn.nodes.values())
+
+        def pred(task, node):
+            ssn.predicate_fn(task, node)
+
+        feasible, _ = predicate_nodes(preemptor, nodes, pred)
+        scores = prioritize_nodes(preemptor, feasible,
+                                  ssn.batch_node_order_fn, ssn.node_order_fn)
+        for node in sort_nodes(scores):
+            preemptees = [t.clone() for t in node.tasks.values()
+                          if task_filter(t)]
+            victims = ssn.preemptable(preemptor, preemptees)
+            metrics.update_preemption_victims(len(victims))
+            if not validate_victims(preemptor, node, victims):
+                continue
+
+            # lowest priority first (reversed TaskOrderFn)
+            vq = PriorityQueue(lambda l, r: not ssn.task_order_fn(l, r))
+            for v in victims:
+                vq.push(v)
+            preempted = Resource()
+            while not vq.empty():
+                if preemptor.init_resreq.less_equal(node.future_idle()):
+                    break
+                preemptee = vq.pop()
+                stmt.evict(ssn.jobs[preemptee.job].tasks[preemptee.uid],
+                           "preempt")
+                preempted.add(preemptee.resreq)
+            metrics.register_preemption_attempt()
+
+            if preemptor.init_resreq.less_equal(node.future_idle()):
+                stmt.pipeline(preemptor, node.name)
+                assigned = True
+                break
+        return assigned
+
+    def _victim_tasks(self, ssn) -> None:
+        """Plugin-driven eviction pass (tdm's VictimTasksFn etc.,
+        preempt.go:272-284)."""
+        stmt = ssn.statement()
+        for victim in ssn.victim_tasks():
+            job = ssn.jobs.get(victim.job)
+            if job is None or victim.uid not in job.tasks:
+                continue
+            stmt.evict(job.tasks[victim.uid], "evict")
+        stmt.commit()

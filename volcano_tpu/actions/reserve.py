@@ -1,0 +1,26 @@
+"""Reserve action: lock nodes for the elected target job until it schedules.
+
+Mirrors /root/reference/pkg/scheduler/actions/reserve/reserve.go:40-77.
+"""
+
+from __future__ import annotations
+
+from ..utils.reservation import Reservation
+from .base import Action
+
+
+class ReserveAction(Action):
+    NAME = "reserve"
+
+    def execute(self, ssn) -> None:
+        if Reservation.target_job is None:
+            return
+        target = ssn.jobs.get(Reservation.target_job.uid)
+        if target is None:
+            Reservation.reset()
+            return
+        Reservation.target_job = target
+        if not target.ready():
+            ssn.reserved_nodes()
+        else:
+            Reservation.reset()

@@ -80,16 +80,12 @@ class TaskInfo:
         return self.init_resreq.is_empty()
 
     def clone(self) -> "TaskInfo":
-        t = TaskInfo(uid=self.uid, name=self.name, namespace=self.namespace,
-                     job=self.job, resreq=self.resreq, status=self.status,
-                     priority=self.priority, node_name=self.node_name,
-                     task_role=self.task_role, node_selector=self.node_selector,
-                     tolerations=self.tolerations, affinity=self.affinity,
-                     labels=self.labels, annotations=self.annotations,
-                     preemptable=self.preemptable, revocable_zone=self.revocable_zone,
-                     creation_timestamp=self.creation_timestamp, pod=self.pod)
+        # hot path (NodeInfo.add_task clones every placed task): bypass the
+        # constructor, deep-copy only the mutable resource vectors
+        t = TaskInfo.__new__(TaskInfo)
+        t.__dict__.update(self.__dict__)
+        t.resreq = self.resreq.clone()
         t.init_resreq = self.init_resreq.clone()
-        t.volume_ready = self.volume_ready
         return t
 
     def key(self) -> str:

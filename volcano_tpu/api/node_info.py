@@ -33,6 +33,9 @@ class NodeInfo:
         self.taints = list(taints or [])
         self.unschedulable = unschedulable
         self.annotations = dict(annotations or {})
+        # volcano.sh/revocable-zone label marks time-division-multiplexed
+        # nodes (tdm plugin)
+        self.revocable_zone = self.labels.get("volcano.sh/revocable-zone", "")
         self.tasks: Dict[str, TaskInfo] = {}
         # ready mirrors NodePhase; nodes flagged not-ready are skipped in
         # Snapshot (cache.go:822-827 analogue handled by the cache layer).

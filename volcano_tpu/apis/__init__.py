@@ -1,0 +1,15 @@
+"""CRD-like object model for the store/controllers layer.
+
+Mirrors the vendored volcano.sh/apis module (SURVEY.md §2.6): batch/v1alpha1
+Job, scheduling/v1beta1 PodGroup + Queue, bus/v1alpha1 Command, plus a
+minimal core/v1 Pod. These are the objects that live in the ObjectStore (the
+in-process etcd/API-server); the scheduler's api.* infos are built FROM them
+by the cache's event handlers.
+"""
+
+from .objects import (Command, Job, JobSpec, LifecyclePolicy, Pod, PodGroupCR,
+                      PodTemplate, PriorityClass, QueueCR, TaskSpec)
+
+__all__ = ["Command", "Job", "JobSpec", "LifecyclePolicy", "Pod",
+           "PodGroupCR", "PodTemplate", "PriorityClass", "QueueCR",
+           "TaskSpec"]

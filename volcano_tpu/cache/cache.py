@@ -135,21 +135,20 @@ class SchedulerCache:
     # -- side effects (cache.go:549-666) ------------------------------------
 
     def bind(self, task: TaskInfo) -> None:
-        """Execute the bind through the Binder; on success mark Bound, on
-        failure push to the resync queue (cache.go:602-666)."""
-        try:
-            self.binder.bind(task, task.node_name)
-        except Exception:
-            with self._lock:
-                self.err_tasks.append(task)
-            self.resync_task(task)
-            return
+        """Mark the optimistic Binding state FIRST, then execute the bind
+        through the Binder (the reference's AddBindingTask-then-async-Bind
+        order, cache.go:602-666) — so the watch event that flips the pod to
+        Running lands after, never before, the cache's own update."""
+        newly_placed = False
+        prev_status = None
         with self._lock:
             job = self.jobs.get(task.job)
             if job is not None and task.uid in job.tasks:
                 cached = job.tasks[task.uid]
+                prev_status = cached.status
                 prev_node = cached.node_name
                 if not prev_node:
+                    newly_placed = True
                     cached.node_name = task.node_name
                     job.update_task_status(cached, TaskStatus.BOUND)
                     if task.node_name in self.nodes:
@@ -158,6 +157,25 @@ class SchedulerCache:
                     job.update_task_status(cached, TaskStatus.BOUND)
                     if prev_node in self.nodes:
                         self.nodes[prev_node].update_task(cached)
+        try:
+            self.binder.bind(task, task.node_name)
+        except Exception:
+            # roll back exactly what the optimistic phase did
+            with self._lock:
+                job = self.jobs.get(task.job)
+                if job is not None and task.uid in job.tasks:
+                    cached = job.tasks[task.uid]
+                    if newly_placed:
+                        if cached.node_name in self.nodes:
+                            self.nodes[cached.node_name].remove_task(cached)
+                        job.update_task_status(cached, TaskStatus.PENDING)
+                        cached.node_name = ""
+                    elif prev_status is not None:
+                        job.update_task_status(cached, prev_status)
+                        if cached.node_name in self.nodes:
+                            self.nodes[cached.node_name].update_task(cached)
+                self.err_tasks.append(task)
+            self.resync_task(task)
 
     def evict(self, task: TaskInfo, reason: str) -> None:
         """Execute eviction: pod condition + delete (cache.go:549-599)."""

@@ -92,3 +92,19 @@ class StoreEvictor(Evictor):
 
     def evict(self, task: TaskInfo, reason: str) -> None:
         self.store.evict_pod(task.namespace, task.name, reason)
+
+
+class StoreStatusUpdater(StatusUpdater):
+    """Writes PodGroup status back to the store (the jobUpdater's
+    UpdatePodGroup PUT, job_updater.go:95-108)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def update_pod_group(self, job) -> None:
+        pg = self.store.get("PodGroup", job.namespace, job.podgroup.name)
+        if pg is None:
+            return
+        pg.status.phase = job.podgroup.phase
+        pg.status.conditions = list(job.podgroup.conditions)
+        self.store.update_status(pg)

@@ -79,25 +79,32 @@ def task_requests(tasks: Sequence[TaskInfo], rnames: ResourceNames) -> np.ndarra
 
 
 def assemble_feasibility(ssn, tasks: Sequence[TaskInfo],
-                         node_t: NodeTensors) -> np.ndarray:
+                         node_t: NodeTensors) -> Optional[np.ndarray]:
     """AND of all plugin feasibility contributions; base mask excludes
     not-ready nodes (snapshot already dropped them) — plugins add selectors/
-    taints/affinity (predicates plugin) and revocable-zone windows (tdm)."""
-    mask = np.ones((len(tasks), len(node_t.names)), dtype=bool)
+    taints/affinity (predicates plugin) and revocable-zone windows (tdm).
+    Returns None when every plugin abstained (mask would be all-true) so
+    callers can skip the [T,N] transfer entirely."""
+    mask = None
     for fn in ssn.feasibility_fns.values():
         m = fn(ssn, tasks, node_t)
-        if m is not None:
-            mask &= m
+        if m is None:
+            continue
+        mask = m if mask is None else (mask & m)
     return mask
 
 
 def assemble_static_score(ssn, tasks: Sequence[TaskInfo],
-                          node_t: NodeTensors) -> np.ndarray:
-    score = np.zeros((len(tasks), len(node_t.names)), dtype=np.float32)
+                          node_t: NodeTensors) -> Optional[np.ndarray]:
+    """Sum of static score matrices; None when every plugin abstained (a
+    constant-zero matrix) so callers can skip the [T,N] transfer."""
+    score = None
     for fn in ssn.static_score_fns.values():
         s = fn(ssn, tasks, node_t)
-        if s is not None:
-            score += s.astype(np.float32)
+        if s is None:
+            continue
+        s = s.astype(np.float32)
+        score = s if score is None else (score + s)
     return score
 
 

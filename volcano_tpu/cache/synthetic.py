@@ -1,0 +1,137 @@
+"""Synthetic snapshot generator for the BASELINE benchmark configs.
+
+The reference has no simulated multi-node backend (SURVEY.md §4: its only
+multi-node testing is a kind cluster) — this generator is the rebuild's
+10k-pods/2k-nodes harness (BASELINE.md configs 2-5).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional, Tuple
+
+from ..api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase, QueueInfo,
+                   Resource, TaskInfo, TaskStatus)
+from .cache import SchedulerCache
+from .executors import FakeBinder, FakeEvictor
+
+GI = 1 << 30
+
+
+def make_cluster(num_nodes: int, cpu_milli: int = 32000,
+                 mem: int = 128 * GI, pods: int = 110,
+                 gpus: int = 0, seed: int = 0) -> List[NodeInfo]:
+    nodes = []
+    for i in range(num_nodes):
+        scalars = {"nvidia.com/gpu": float(gpus)} if gpus else None
+        alloc = Resource(cpu_milli, mem, scalars)
+        alloc.max_task_num = pods
+        nodes.append(NodeInfo(name=f"node-{i:05d}", allocatable=alloc))
+    return nodes
+
+
+def make_jobs(num_tasks: int, num_jobs: int, queues: List[str],
+              cpu_range=(500, 4000), mem_range=(1 * GI, 8 * GI),
+              gang_fraction: float = 1.0, gpus_per_task: int = 0,
+              running_fraction: float = 0.0, nodes: Optional[List[NodeInfo]] = None,
+              seed: int = 0, phase: PodGroupPhase = PodGroupPhase.INQUEUE,
+              ) -> List[JobInfo]:
+    """num_tasks split over num_jobs; each job is a gang
+    (minAvailable = task count * gang_fraction). running_fraction of jobs
+    is pre-placed onto nodes (for preempt/reclaim configs)."""
+    rng = random.Random(seed)
+    sizes = _split(num_tasks, num_jobs, rng)
+    jobs: List[JobInfo] = []
+    node_cycle = 0
+    for j, size in enumerate(sizes):
+        queue = queues[j % len(queues)]
+        running = rng.random() < running_fraction
+        min_avail = max(1, int(size * gang_fraction))
+        name = f"job-{j:05d}"
+        pg = PodGroup(name=name, queue=queue, min_member=min_avail,
+                      phase=PodGroupPhase.RUNNING if running else phase)
+        job = JobInfo(uid=name, name=name, queue=queue,
+                      min_available=min_avail, podgroup=pg,
+                      priority=rng.randint(0, 10),
+                      creation_timestamp=float(j))
+        cpu = rng.randrange(*cpu_range, 100)
+        mem = rng.randrange(mem_range[0], mem_range[1], GI // 4)
+        scalars = {"nvidia.com/gpu": float(gpus_per_task)} if gpus_per_task else None
+        for t in range(size):
+            task = TaskInfo(uid=f"{name}-{t}", name=f"{name}-{t}", job=name,
+                            resreq=Resource(cpu, mem, scalars),
+                            creation_timestamp=float(j * 100000 + t))
+            if running and nodes:
+                # place round-robin wherever it fits
+                for _ in range(len(nodes)):
+                    node = nodes[node_cycle % len(nodes)]
+                    node_cycle += 1
+                    if task.resreq.less_equal(node.idle):
+                        task.status = TaskStatus.RUNNING
+                        job.add_task_info(task)
+                        node.add_task(job.tasks[task.uid])
+                        break
+                else:
+                    task.status = TaskStatus.PENDING
+                    job.add_task_info(task)
+            else:
+                job.add_task_info(task)
+        jobs.append(job)
+    return jobs
+
+
+def _split(total: int, parts: int, rng: random.Random) -> List[int]:
+    if parts >= total:
+        return [1] * total
+    base = total // parts
+    sizes = [base] * parts
+    for i in rng.sample(range(parts), total - base * parts):
+        sizes[i] += 1
+    return sizes
+
+
+def baseline_config(name: str, seed: int = 0):
+    """Build (cache, binder, evictor) for a BASELINE.md config:
+
+    - "tiny":    example/job.yaml analogue — 1 gang of 3, 10 nodes
+    - "1k":      1k pending pods / 200 nodes, gang+priority
+    - "10k":     10k pods / 2k nodes, 3 queues (drf+proportion)
+    - "preempt": 5k running + 5k pending / 1k nodes
+    - "gpu":     2k nodes x 8 GPUs, GPU-requesting tasks
+    """
+    binder, evictor = FakeBinder(), FakeEvictor()
+    cache = SchedulerCache(binder=binder, evictor=evictor)
+
+    if name == "tiny":
+        nodes = make_cluster(10, cpu_milli=4000, mem=8 * GI)
+        jobs = make_jobs(3, 1, ["default"], cpu_range=(900, 1000),
+                         mem_range=(GI, GI + 1), seed=seed)
+        queues = [QueueInfo(name="default", weight=1)]
+    elif name == "1k":
+        nodes = make_cluster(200, seed=seed)
+        jobs = make_jobs(1000, 50, ["default"], seed=seed)
+        queues = [QueueInfo(name="default", weight=1)]
+    elif name == "10k":
+        nodes = make_cluster(2000, seed=seed)
+        jobs = make_jobs(10000, 200, ["q1", "q2", "q3"], seed=seed)
+        queues = [QueueInfo(name="q1", weight=3), QueueInfo(name="q2", weight=2),
+                  QueueInfo(name="q3", weight=1)]
+    elif name == "preempt":
+        nodes = make_cluster(1000, seed=seed)
+        jobs = make_jobs(10000, 200, ["q1", "q2"], running_fraction=0.5,
+                         nodes=nodes, seed=seed)
+        queues = [QueueInfo(name="q1", weight=1), QueueInfo(name="q2", weight=1)]
+    elif name == "gpu":
+        nodes = make_cluster(2000, gpus=8, seed=seed)
+        jobs = make_jobs(8000, 160, ["default"], gpus_per_task=1, seed=seed)
+        queues = [QueueInfo(name="default", weight=1)]
+    else:
+        raise ValueError(f"unknown baseline config {name!r}")
+
+    for q in queues:
+        cache.add_queue(q)
+    for n in nodes:
+        cache.add_node(n)
+    for j in jobs:
+        cache.add_job(j)
+    return cache, binder, evictor

@@ -1,0 +1,3 @@
+from .vcctl import main, JobCommands, QueueCommands
+
+__all__ = ["main", "JobCommands", "QueueCommands"]

@@ -1,0 +1,206 @@
+"""vcctl: the operator CLI.
+
+Mirrors /root/reference/{cmd/cli/vcctl.go:47-49, pkg/cli/job/*, pkg/cli/queue/*}:
+``job {run,list,view,suspend,resume,delete}``, ``queue {create,get,list,
+operate,delete}``, ``version``. Job suspend/resume/delete post bus Command
+CRs owner-referenced to the Job (pkg/cli/job/util.go:69-95), exactly like
+the reference — the job controller consumes them asynchronously.
+
+The standalone verb entry points (vsub/vcancel/vsuspend/vresume/vjobs/
+vqueues, Makefile:172-180) are exposed as functions of the same commands.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List, Optional
+
+from .. import __version__
+from ..api import BusAction, QueueState, Resource
+from ..apis.objects import (Command, Job, JobSpec, ObjectMeta, PodTemplate,
+                            QueueCR, QueueSpecCR, TaskSpec)
+from ..store import ObjectStore
+
+
+class JobCommands:
+    """pkg/cli/job analogue."""
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def run(self, name: str, namespace: str = "default", queue: str = "default",
+            replicas: int = 1, min_available: Optional[int] = None,
+            requests: Optional[dict] = None, image: str = "busybox",
+            scheduler: str = "volcano") -> Job:
+        """constructLaunchJobFlagsJob (pkg/cli/job/run.go:71-165)."""
+        res = Resource.from_dict(requests or {"cpu": "1", "memory": "1Gi"})
+        job = Job(
+            metadata=ObjectMeta(name=name, namespace=namespace),
+            spec=JobSpec(
+                queue=queue, scheduler_name=scheduler,
+                min_available=min_available or replicas,
+                tasks=[TaskSpec(name="default", replicas=replicas,
+                                template=PodTemplate(
+                                    resources=res,
+                                    containers=[{"name": name,
+                                                 "image": image}]))]))
+        return self.store.create(job)
+
+    def list(self, namespace: Optional[str] = None) -> List[Job]:
+        return self.store.list("Job", namespace)
+
+    def view(self, name: str, namespace: str = "default") -> Optional[Job]:
+        return self.store.get("Job", namespace, name)
+
+    def _command(self, name: str, namespace: str, action: BusAction) -> None:
+        """createJobCommand (pkg/cli/job/util.go:69-95)."""
+        self.store.create(Command(
+            metadata=ObjectMeta(
+                name=f"{name}-{action.value.lower()}-{ObjectMeta().uid}",
+                namespace=namespace,
+                owner_references=[{"kind": "Job", "name": name}]),
+            action=action,
+            target_object={"kind": "Job", "name": name}))
+
+    def suspend(self, name: str, namespace: str = "default") -> None:
+        self._command(name, namespace, BusAction.ABORT_JOB)
+
+    def resume(self, name: str, namespace: str = "default") -> None:
+        self._command(name, namespace, BusAction.RESUME_JOB)
+
+    def delete(self, name: str, namespace: str = "default") -> None:
+        self.store.delete("Job", namespace, name)
+
+
+class QueueCommands:
+    """pkg/cli/queue analogue."""
+
+    def __init__(self, store: ObjectStore):
+        self.store = store
+
+    def create(self, name: str, weight: int = 1,
+               capability: Optional[dict] = None,
+               reclaimable: bool = True) -> QueueCR:
+        cap = Resource.from_dict(capability) if capability else None
+        return self.store.create(QueueCR(
+            metadata=ObjectMeta(name=name, namespace="default"),
+            spec=QueueSpecCR(weight=weight, capability=cap,
+                             reclaimable=reclaimable)))
+
+    def get(self, name: str) -> Optional[QueueCR]:
+        return self.store.get("Queue", "default", name)
+
+    def list(self) -> List[QueueCR]:
+        return self.store.list("Queue")
+
+    def operate(self, name: str, action: str) -> None:
+        bus = {"open": BusAction.OPEN_QUEUE,
+               "close": BusAction.CLOSE_QUEUE}[action]
+        self.store.create(Command(
+            metadata=ObjectMeta(name=f"{name}-{action}-{ObjectMeta().uid}",
+                                namespace="default"),
+            action=bus, target_object={"kind": "Queue", "name": name}))
+
+    def delete(self, name: str) -> None:
+        self.store.delete("Queue", "default", name)
+
+
+def _fmt_job(job: Job) -> str:
+    return (f"{job.metadata.namespace}/{job.metadata.name}\t"
+            f"queue={job.spec.queue}\tstate={job.status.state.value}\t"
+            f"running={job.status.running}\tsucceeded={job.status.succeeded}")
+
+
+def _fmt_queue(q: QueueCR) -> str:
+    return (f"{q.metadata.name}\tweight={q.spec.weight}\t"
+            f"state={q.status.state.value}\tinqueue={q.status.inqueue}\t"
+            f"running={q.status.running}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="vcctl")
+    sub = parser.add_subparsers(dest="group")
+
+    job = sub.add_parser("job").add_subparsers(dest="verb")
+    run = job.add_parser("run")
+    run.add_argument("--name", required=True)
+    run.add_argument("--namespace", default="default")
+    run.add_argument("--queue", default="default")
+    run.add_argument("--replicas", type=int, default=1)
+    run.add_argument("--min", type=int, default=None)
+    run.add_argument("--requests", default="cpu=1,memory=1Gi")
+    run.add_argument("--image", default="busybox")
+    for verb in ("list", "view", "suspend", "resume", "delete"):
+        p = job.add_parser(verb)
+        if verb != "list":
+            p.add_argument("--name", required=True)
+        p.add_argument("--namespace", default="default")
+
+    queue = sub.add_parser("queue").add_subparsers(dest="verb")
+    qc = queue.add_parser("create")
+    qc.add_argument("--name", required=True)
+    qc.add_argument("--weight", type=int, default=1)
+    for verb in ("get", "delete"):
+        queue.add_parser(verb).add_argument("--name", required=True)
+    queue.add_parser("list")
+    qo = queue.add_parser("operate")
+    qo.add_argument("--name", required=True)
+    qo.add_argument("--action", choices=["open", "close"], required=True)
+
+    sub.add_parser("version")
+    return parser
+
+
+def parse_requests(text: str) -> dict:
+    out = {}
+    for part in text.split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
+         out=print) -> int:
+    args = build_parser().parse_args(argv)
+    if args.group == "version":
+        out(f"vcctl version {__version__}")
+        return 0
+    if store is None:
+        out("no cluster store attached (in-process CLI requires a store)")
+        return 1
+    if args.group == "job":
+        jc = JobCommands(store)
+        if args.verb == "run":
+            jc.run(args.name, args.namespace, args.queue, args.replicas,
+                   args.min, parse_requests(args.requests), args.image)
+        elif args.verb == "list":
+            for j in jc.list(args.namespace):
+                out(_fmt_job(j))
+        elif args.verb == "view":
+            j = jc.view(args.name, args.namespace)
+            out(_fmt_job(j) if j else f"job {args.name} not found")
+        elif args.verb == "suspend":
+            jc.suspend(args.name, args.namespace)
+        elif args.verb == "resume":
+            jc.resume(args.name, args.namespace)
+        elif args.verb == "delete":
+            jc.delete(args.name, args.namespace)
+        return 0
+    if args.group == "queue":
+        qc = QueueCommands(store)
+        if args.verb == "create":
+            qc.create(args.name, args.weight)
+        elif args.verb == "get":
+            q = qc.get(args.name)
+            out(_fmt_queue(q) if q else f"queue {args.name} not found")
+        elif args.verb == "list":
+            for q in qc.list():
+                out(_fmt_queue(q))
+        elif args.verb == "operate":
+            qc.operate(args.name, args.action)
+        elif args.verb == "delete":
+            qc.delete(args.name)
+        return 0
+    build_parser().print_help()
+    return 1

@@ -1,0 +1,34 @@
+"""Controller framework: interface + registry.
+
+Mirrors /root/reference/pkg/controllers/framework/{interface.go:25-43,
+factory.go:24-43}.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_controllers: Dict[str, Callable] = {}
+
+
+class Controller:
+    NAME = "controller"
+
+    def name(self) -> str:
+        return self.NAME
+
+    def initialize(self, store, **options) -> None:
+        raise NotImplementedError
+
+    def run(self) -> None:
+        """Register watches; in-process controllers are event-driven so run
+        is synchronous wiring, not a goroutine loop."""
+
+
+def register_controller(builder: Callable) -> None:
+    _controllers[builder().NAME if hasattr(builder, "NAME") else str(builder)] = builder
+
+
+def foreach_controller(fn: Callable) -> None:
+    for builder in _controllers.values():
+        fn(builder)

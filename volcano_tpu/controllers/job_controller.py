@@ -1,0 +1,265 @@
+"""Job controller: the Job CR lifecycle engine.
+
+Mirrors /root/reference/pkg/controllers/job/{job_controller.go:118-218,
+job_controller_actions.go:43-660, job_controller_handler.go:137-400} —
+informers on Job/Pod/Command, syncJob (podgroup + pod diff create/delete),
+killJob, lifecycle-policy event→action dispatch, and the job plugins
+(ssh/svc/env) that mutate pods at creation.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..api import BusAction, BusEvent, JobPhase, PodGroupPhase, Resource
+from ..apis.objects import (Command, Job, LifecyclePolicy, ObjectMeta, Pod,
+                            PodGroupCR, PodGroupSpec, PodTemplate, TaskSpec)
+from ..cache.store_wiring import GROUP_NAME_ANNOTATION
+from ..store import ADDED, DELETED, UPDATED, AdmissionError, ObjectStore
+from . import job_state
+from .framework import Controller
+from .job_plugins import plugin_on_job_add, plugin_on_pod_create
+
+TASK_SPEC_ANNOTATION = "volcano.sh/task-spec"
+JOB_NAME_ANNOTATION = "volcano.sh/job-name"
+TASK_INDEX_ANNOTATION = "volcano.sh/task-index"
+
+
+def pod_name(job: Job, task: TaskSpec, index: int) -> str:
+    return f"{job.metadata.name}-{task.name}-{index}"
+
+
+def calc_pg_min_resources(job: Job) -> Resource:
+    """Sum of the first minAvailable pod requests, tasks in priority order
+    (job_controller_actions.go:638-660)."""
+    reqs: List[Resource] = []
+    for task in sorted(job.spec.tasks, key=lambda t: -t.template.priority):
+        for _ in range(task.replicas):
+            reqs.append(task.template.resources or Resource())
+    total = Resource()
+    for r in reqs[: job.spec.min_available]:
+        total.add(r)
+    return total
+
+
+class JobController(Controller):
+    NAME = "job-controller"
+
+    def __init__(self):
+        self.store: ObjectStore = None
+        self._lock = threading.RLock()
+
+    # -- wiring -------------------------------------------------------------
+
+    def initialize(self, store: ObjectStore, **options) -> None:
+        self.store = store
+        job_state.sync_job = self.sync_job
+        job_state.kill_job = self.kill_job
+        store.watch("Job", self._on_job)
+        store.watch("Pod", self._on_pod)
+        store.watch("Command", self._on_command)
+
+    def _on_job(self, event: str, job: Job, old) -> None:
+        if event == ADDED:
+            self._execute(job, BusAction.SYNC_JOB)
+        elif event == UPDATED:
+            if old is not None and old.spec is not job.spec:
+                self._execute(job, BusAction.SYNC_JOB)
+        elif event == DELETED:
+            self._delete_job_resources(job)
+
+    def _on_pod(self, event: str, pod: Pod, old) -> None:
+        job_name = pod.metadata.annotations.get(JOB_NAME_ANNOTATION)
+        if not job_name:
+            return
+        job = self.store.get("Job", pod.metadata.namespace, job_name)
+        if job is None:
+            return
+        bus_event = None
+        if event == UPDATED and old is not None:
+            if pod.status.phase == "Failed" and old.status.phase != "Failed":
+                bus_event = BusEvent.POD_FAILED
+            elif (pod.status.phase == "Succeeded"
+                  and old.status.phase != "Succeeded"):
+                bus_event = BusEvent.TASK_COMPLETED
+        elif event == DELETED:
+            if pod.status.conditions and any(
+                    c.get("type") == "Evicted" for c in pod.status.conditions):
+                bus_event = BusEvent.POD_EVICTED
+            elif pod.status.phase not in ("Succeeded", "Failed"):
+                bus_event = BusEvent.POD_EVICTED
+        action = self._policy_action(job, pod, bus_event)
+        self._execute(job, action)
+
+    def _policy_action(self, job: Job, pod: Pod,
+                       event: Optional[BusEvent]) -> BusAction:
+        """LifecyclePolicy events→actions (handler.go:137-351): task policies
+        override job policies; default SyncJob."""
+        if event is None:
+            return BusAction.SYNC_JOB
+        task_name = pod.metadata.annotations.get(TASK_SPEC_ANNOTATION, "")
+        for task in job.spec.tasks:
+            if task.name == task_name:
+                for policy in task.policies:
+                    if policy.event in (event, BusEvent.ANY):
+                        return policy.action
+        for policy in job.spec.policies:
+            if policy.event in (event, BusEvent.ANY):
+                return policy.action
+        return BusAction.SYNC_JOB
+
+    def _on_command(self, event: str, cmd: Command, old) -> None:
+        """Command CR → state-machine action (handler.go:364-400)."""
+        if event != ADDED:
+            return
+        target = cmd.target_object or {}
+        if target.get("kind") != "Job":
+            return
+        job = self.store.get("Job", cmd.metadata.namespace, target.get("name"))
+        self.store.delete("Command", cmd.metadata.namespace, cmd.metadata.name)
+        if job is None:
+            return
+        self._execute(job, cmd.action)
+        self.store.update_status(job)
+        # a Resume lands in Restarting; drive the restart chain
+        # (drain -> Pending -> resync) like the reference's requeue
+        if job.status.state == JobPhase.RESTARTING:
+            self._execute(job, BusAction.SYNC_JOB)
+
+    def _execute(self, job: Job, action: BusAction) -> None:
+        with self._lock:
+            job_state.new_state(job).execute(action)
+
+    # -- core sync (job_controller_actions.go:206-440) -----------------------
+
+    def sync_job(self, job: Job, next_phase: Callable) -> None:
+        if job.status.state in (JobPhase.COMPLETED, JobPhase.FAILED,
+                                JobPhase.TERMINATED, JobPhase.ABORTED):
+            return
+        self._initiate_job(job)
+        desired: Dict[str, tuple] = {}
+        for task in job.spec.tasks:
+            for i in range(task.replicas):
+                desired[pod_name(job, task, i)] = (task, i)
+
+        existing = {p.metadata.name: p
+                    for p in self.store.list("Pod", job.metadata.namespace)
+                    if p.metadata.annotations.get(JOB_NAME_ANNOTATION)
+                    == job.metadata.name}
+
+        for name, (task, i) in desired.items():
+            if name not in existing:
+                self._create_pod(job, task, i)
+        for name, pod in existing.items():
+            if name not in desired:
+                self.store.delete("Pod", job.metadata.namespace, name)
+
+        self._update_status(job)
+        job_state._update_phase(job, next_phase(job.status))
+        self.store.update_status(job)
+        self._sync_podgroup_phase(job)
+
+    def kill_job(self, job: Job, phase: JobPhase,
+                 transition: Optional[Callable] = None) -> None:
+        """Delete all pods, then transition (job_controller_actions.go:43-146)."""
+        job_state._update_phase(job, phase)
+        for pod in self.store.list("Pod", job.metadata.namespace):
+            if pod.metadata.annotations.get(JOB_NAME_ANNOTATION) \
+                    == job.metadata.name:
+                self.store.delete("Pod", job.metadata.namespace,
+                                  pod.metadata.name)
+        self._update_status(job)
+        if transition is not None:
+            job_state._update_phase(job, transition(job.status))
+        self.store.update_status(job)
+        # restart cycle continues: once drained, Restarting -> Pending resync
+        if job.status.state == JobPhase.PENDING:
+            self._execute(job, BusAction.SYNC_JOB)
+
+    def _initiate_job(self, job: Job) -> None:
+        """Finalizer + PodGroup + plugin OnJobAdd
+        (job_controller_actions.go:442-560)."""
+        if "volcano.sh/job-finalizer" not in job.metadata.finalizers:
+            job.metadata.finalizers.append("volcano.sh/job-finalizer")
+        plugin_on_job_add(self.store, job)
+        pg = self.store.get("PodGroup", job.metadata.namespace,
+                            job.metadata.name)
+        if pg is None:
+            pg = PodGroupCR(
+                metadata=ObjectMeta(name=job.metadata.name,
+                                    namespace=job.metadata.namespace,
+                                    owner_references=[{
+                                        "kind": "Job",
+                                        "name": job.metadata.name}]),
+                spec=PodGroupSpec(
+                    min_member=job.spec.min_available,
+                    queue=job.spec.queue,
+                    priority_class_name=job.spec.priority_class_name,
+                    min_resources=calc_pg_min_resources(job)))
+            self.store.create(pg)
+        elif pg.spec.min_member != job.spec.min_available:
+            pg.spec.min_member = job.spec.min_available
+            pg.spec.min_resources = calc_pg_min_resources(job)
+            self.store.update(pg)
+
+    def _create_pod(self, job: Job, task: TaskSpec, index: int) -> None:
+        import copy
+        template = copy.deepcopy(task.template)
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=pod_name(job, task, index),
+                namespace=job.metadata.namespace,
+                annotations={
+                    GROUP_NAME_ANNOTATION: job.metadata.name,
+                    JOB_NAME_ANNOTATION: job.metadata.name,
+                    TASK_SPEC_ANNOTATION: task.name,
+                    TASK_INDEX_ANNOTATION: str(index),
+                },
+                owner_references=[{"kind": "Job", "name": job.metadata.name}]),
+            template=template,
+            scheduler_name=job.spec.scheduler_name)
+        plugin_on_pod_create(self.store, job, task, index, pod)
+        try:
+            self.store.create(pod)
+        except (ValueError, AdmissionError):
+            pass
+
+    def _update_status(self, job: Job) -> None:
+        counts = {"Pending": 0, "Running": 0, "Succeeded": 0, "Failed": 0}
+        task_counts: Dict[str, Dict[str, int]] = {}
+        for pod in self.store.list("Pod", job.metadata.namespace):
+            if pod.metadata.annotations.get(JOB_NAME_ANNOTATION) \
+                    != job.metadata.name:
+                continue
+            counts[pod.status.phase] = counts.get(pod.status.phase, 0) + 1
+            task = pod.metadata.annotations.get(TASK_SPEC_ANNOTATION, "")
+            task_counts.setdefault(task, {}).setdefault(pod.status.phase, 0)
+            task_counts[task][pod.status.phase] += 1
+        job.status.pending = counts.get("Pending", 0)
+        job.status.running = counts.get("Running", 0)
+        job.status.succeeded = counts.get("Succeeded", 0)
+        job.status.failed = counts.get("Failed", 0)
+        job.status.terminating = 0
+        job.status.min_available = job.spec.min_available
+        job.status.task_status_count = task_counts
+        job.status.version += 1
+
+    def _sync_podgroup_phase(self, job: Job) -> None:
+        pg = self.store.get("PodGroup", job.metadata.namespace,
+                            job.metadata.name)
+        if pg is None:
+            return
+        pg.status.running = job.status.running
+        pg.status.succeeded = job.status.succeeded
+        pg.status.failed = job.status.failed
+        self.store.update_status(pg)
+
+    def _delete_job_resources(self, job: Job) -> None:
+        for pod in self.store.list("Pod", job.metadata.namespace):
+            if pod.metadata.annotations.get(JOB_NAME_ANNOTATION) \
+                    == job.metadata.name:
+                self.store.delete("Pod", job.metadata.namespace,
+                                  pod.metadata.name)
+        self.store.delete("PodGroup", job.metadata.namespace,
+                          job.metadata.name)

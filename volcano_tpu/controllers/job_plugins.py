@@ -1,0 +1,77 @@
+"""Job controller plugins: ssh / svc / env pod mutation hooks.
+
+Mirrors /root/reference/pkg/controllers/job/plugins/{ssh/ssh.go:48-215,
+svc/svc.go:52-218, env/env.go, factory.go:28-51} — per-job SSH keypair
+secret for passwordless MPI, hostfile env (VC_<TASK>_HOSTS), and per-task
+index env vars, applied according to Job.spec.plugins.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+from typing import Dict, List
+
+from ..apis.objects import Job, Pod, TaskSpec
+
+SSH_PRIVATE_KEY = "id_rsa"
+SSH_PUBLIC_KEY = "id_rsa.pub"
+
+
+def _ssh_secret_name(job: Job) -> str:
+    return f"{job.metadata.name}-ssh"
+
+
+def plugin_on_job_add(store, job: Job) -> None:
+    """OnJobAdd hooks: create job-level artifacts (ssh secret, svc hostfile
+    stored as job annotations — the in-process analogue of the Secret and
+    ConfigMap the reference creates)."""
+    if "ssh" in job.spec.plugins:
+        if "volcano.sh/ssh-secret" not in job.metadata.annotations:
+            # deterministic placeholder keypair (no real crypto needed
+            # in-process; the contract is presence + mounting)
+            seed = hashlib.sha256(job.metadata.key().encode()).digest()
+            priv = base64.b64encode(seed).decode()
+            pub = base64.b64encode(seed[::-1]).decode()
+            job.metadata.annotations["volcano.sh/ssh-secret"] = _ssh_secret_name(job)
+            job.metadata.annotations["volcano.sh/ssh-private"] = priv
+            job.metadata.annotations["volcano.sh/ssh-public"] = pub
+    if "svc" in job.spec.plugins:
+        hosts = _job_hosts(job)
+        job.metadata.annotations["volcano.sh/job-hosts"] = ",".join(hosts)
+
+
+def plugin_on_pod_create(store, job: Job, task: TaskSpec, index: int,
+                         pod: Pod) -> None:
+    """OnPodCreate hooks: env vars + hostfile + ssh mount markers."""
+    env: List[dict] = pod.template.env
+    if "env" in job.spec.plugins:
+        # per-task index env (env.go): both VC_ and legacy VK_ names
+        env.append({"name": "VC_TASK_INDEX", "value": str(index)})
+        env.append({"name": "VK_TASK_INDEX", "value": str(index)})
+    if "svc" in job.spec.plugins:
+        for t in job.spec.tasks:
+            hosts = [f"{job.metadata.name}-{t.name}-{i}.{job.metadata.name}"
+                     for i in range(t.replicas)]
+            env.append({
+                "name": f"VC_{t.name.upper().replace('-', '_')}_HOSTS",
+                "value": ",".join(hosts)})
+            env.append({
+                "name": f"VC_{t.name.upper().replace('-', '_')}_NUM",
+                "value": str(t.replicas)})
+        pod.template.labels.setdefault("volcano.sh/job-service",
+                                       job.metadata.name)
+    if "ssh" in job.spec.plugins:
+        pod.template.volumes.append({
+            "name": "ssh-volume",
+            "secret": _ssh_secret_name(job),
+            "mount_path": "/root/.ssh",
+        })
+
+
+def _job_hosts(job: Job) -> List[str]:
+    hosts = []
+    for task in job.spec.tasks:
+        for i in range(task.replicas):
+            hosts.append(f"{job.metadata.name}-{task.name}-{i}.{job.metadata.name}")
+    return hosts

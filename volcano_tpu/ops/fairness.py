@@ -79,6 +79,55 @@ def proportion_deserved(total: jnp.ndarray, weight: jnp.ndarray,
     return ProportionResult(deserved=deserved, share=share)
 
 
+def proportion_deserved_numpy(total, weight, request, capability, allocated,
+                              max_iters: int = 64) -> ProportionResult:
+    """NumPy twin of proportion_deserved — same fixed-point semantics, zero
+    compile cost. The scheduler plugin uses this for small queue counts so
+    the first cycle never stalls on a device compile; the JAX kernel remains
+    the scale path and both are cross-checked in tests."""
+    import numpy as np
+
+    total = np.asarray(total, np.float32).copy()
+    weight = np.asarray(weight, np.float32)
+    request = np.asarray(request, np.float32)
+    capability = np.asarray(capability, np.float32)
+    allocated = np.asarray(allocated, np.float32)
+    Q, R = request.shape
+
+    deserved = np.zeros_like(request)
+    meet = np.zeros(Q, dtype=bool)
+    remaining = total
+    for _ in range(max_iters):
+        active = ~meet
+        total_w = weight[active].sum()
+        if total_w <= 0 or not (remaining >= EPS).any():
+            break
+        grant = remaining[None, :] * (weight / max(total_w, 1e-9))[:, None]
+        new_deserved = deserved + np.where(active[:, None], grant, 0.0)
+
+        over_cap = active & ~np.all(new_deserved < capability + EPS, axis=-1)
+        req_met = active & ~over_cap & np.all(request < new_deserved + EPS,
+                                              axis=-1)
+        capped = np.minimum(np.minimum(new_deserved, capability), request)
+        clamped = np.minimum(new_deserved, request)
+        new_deserved = np.where(over_cap[:, None], capped,
+                                np.where(req_met[:, None],
+                                         np.minimum(new_deserved, request),
+                                         np.where(active[:, None], clamped,
+                                                  deserved)))
+        meet = meet | over_cap | req_met
+        delta = (new_deserved - deserved).sum(axis=0)
+        remaining = remaining - delta
+        deserved = new_deserved
+        if not (np.abs(delta) >= EPS).any():
+            break
+
+    denom = np.maximum(deserved, 0.0)
+    ratio = np.where(denom > 0, allocated / np.where(denom > 0, denom, 1.0),
+                     np.where(allocated > 0, 1.0, 0.0))
+    return ProportionResult(deserved=deserved, share=ratio.max(axis=-1))
+
+
 def dominant_share(used: jnp.ndarray, denom: jnp.ndarray) -> jnp.ndarray:
     """max_r used_r/denom_r, dims with denom 0: share=1 if used>0 else 0
     (proportion.go updateShare / drf.go calculateShare)."""

@@ -92,11 +92,13 @@ def make_node_state(idle, releasing, pipelined, used, ntasks) -> NodeState:
 
 def place_scan(nodes: NodeState, tasks: PlacementTasks, jobs: JobMeta,
                weights: ScoreWeights, allocatable: jnp.ndarray,
-               max_tasks: jnp.ndarray) -> PlacementResult:
+               max_tasks: jnp.ndarray, unroll: int = 8) -> PlacementResult:
     """Run the sequential-parity placement over all tasks.
 
     allocatable: f32[N,R]; max_tasks: i32[N] (pod-count capacity; the
     reference checks it first in the predicate chain, predicates.go:267-290).
+    unroll amortizes the TPU while-loop per-iteration overhead over several
+    task steps without changing sequential semantics.
     """
     J = jobs.min_available.shape[0]
 
@@ -168,7 +170,7 @@ def place_scan(nodes: NodeState, tasks: PlacementTasks, jobs: JobMeta,
     xs = (tasks.req, tasks.job_ix, tasks.valid, tasks.feas, tasks.static_score,
           tasks.first_of_job, tasks.last_of_job)
     carry, (task_node, task_pipe, job_ready_t, job_kept_t) = jax.lax.scan(
-        step, init, xs)
+        step, init, xs, unroll=unroll)
 
     # Scatter per-boundary job verdicts to [J].
     job_ready = jnp.zeros(J, dtype=bool).at[tasks.job_ix].max(job_ready_t)
@@ -179,6 +181,34 @@ def place_scan(nodes: NodeState, tasks: PlacementTasks, jobs: JobMeta,
     return PlacementResult(task_node=task_node, task_pipelined=task_pipe,
                            job_ready=job_ready, job_kept=job_kept,
                            nodes=carry.tent)
+
+
+def place_scan_packed(nodes: NodeState, tasks: PlacementTasks, jobs: JobMeta,
+                      weights: ScoreWeights, allocatable: jnp.ndarray,
+                      max_tasks: jnp.ndarray, unroll: int = 8):
+    """place_scan with all host-bound outputs packed into ONE i32 vector
+    ``[task_node | task_pipelined | job_ready | job_kept]`` — a single
+    device→host fetch. On tunneled backends every fetch costs a full RTT
+    (~60ms measured), so result packing matters more than kernel time.
+    The final NodeState is returned as device arrays (never fetched)."""
+    res = place_scan(nodes, tasks, jobs, weights, allocatable, max_tasks,
+                     unroll=unroll)
+    packed = jnp.concatenate([
+        res.task_node,
+        res.task_pipelined.astype(jnp.int32),
+        res.job_ready.astype(jnp.int32),
+        res.job_kept.astype(jnp.int32)])
+    return packed, res.nodes
+
+
+def unpack_placement(packed: "np.ndarray", T_padded: int, J: int):
+    """Split the packed vector back into (task_node, task_pipelined,
+    job_ready, job_kept) numpy views."""
+    task_node = packed[:T_padded]
+    task_pipe = packed[T_padded:2 * T_padded].astype(bool)
+    job_ready = packed[2 * T_padded:2 * T_padded + J].astype(bool)
+    job_kept = packed[2 * T_padded + J:2 * T_padded + 2 * J].astype(bool)
+    return task_node, task_pipe, job_ready, job_kept
 
 
 def gang_admission(assigned: jnp.ndarray, job_ix: jnp.ndarray,

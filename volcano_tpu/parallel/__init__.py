@@ -1,0 +1,3 @@
+from .mesh import NODE_AXIS, make_mesh, place_blocks_sharded
+
+__all__ = ["NODE_AXIS", "make_mesh", "place_blocks_sharded"]

@@ -1,0 +1,184 @@
+"""Multi-chip scaling: shard the placement solve over a device mesh.
+
+SURVEY.md §5.7: the reference's "long axis" analogue is the node axis (2k →
+tens of k) and the pending-task axis (10k+). This module shards the
+block-greedy solver (ops/auction.py) over the NODE axis with ``shard_map`` —
+each device owns a node shard and scores every task chunk against it; the
+global best node per task is resolved with one ``all_gather`` of per-shard
+(score, index) maxima per chunk (the structural cousin of a ring-attention
+step: local compute + a small collective across the ring). Gang admission is
+a ``psum`` of per-job placement counts.
+
+All collectives ride ICI inside one jit program; nothing touches the host
+between chunks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.dense import EPS
+from ..ops.place import NO_NODE, JobMeta, NodeState
+from ..ops.scores import ScoreWeights, combined_dynamic_score
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(devices=None, axis: str = NODE_AXIS) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def _sharded_chunk_step(axis: str):
+    """One chunk over node-sharded state. Runs inside shard_map: all array
+    args are the per-device shards."""
+
+    def step(carry, chunk, *, allocatable, max_tasks, weights, shard_offset):
+        nodes: NodeState = carry
+        req, valid = chunk                                  # [C,R] replicated
+        C, R = req.shape
+        Nl = nodes.idle.shape[0]                            # local shard size
+
+        pods_ok = nodes.ntasks < max_tasks
+        fit = (jnp.all(req[:, None, :] < nodes.idle[None] + EPS, axis=-1)
+               & pods_ok[None])                              # [C,Nl]
+        score = combined_dynamic_score(req, nodes.used, allocatable, weights)
+        masked = jnp.where(fit, score, -jnp.inf)
+        local_best = jnp.argmax(masked, axis=-1)             # [C]
+        local_score = masked[jnp.arange(C), local_best]      # [C]
+
+        # Resolve the global winner per task with one gather across shards.
+        all_scores = jax.lax.all_gather(local_score, axis)   # [D,C]
+        my_shard = jax.lax.axis_index(axis)
+        winner_shard = jnp.argmax(all_scores, axis=0)        # [C]
+        has_node = jnp.max(all_scores, axis=0) > -jnp.inf
+        mine = (winner_shard == my_shard) & has_node & valid # [C]
+
+        # Local contention resolution for tasks won by this shard
+        # (same two-wave scheme as ops/auction.py).
+        choice = local_best
+        onehot = jax.nn.one_hot(choice, Nl, dtype=req.dtype) * mine[:, None]
+
+        def contention(accept_mask):
+            live = onehot * accept_mask[:, None]
+            demand = live[:, :, None] * req[:, None, :]
+            cum = jnp.cumsum(demand, axis=0) - demand
+            room = jnp.all(
+                req[:, None, :] + cum[jnp.arange(C), choice][:, None, :]
+                < nodes.idle[choice][:, None, :] + EPS, axis=-1)[:, 0]
+            cum_count = jnp.cumsum(live, axis=0) - live
+            pods_room = (nodes.ntasks[choice]
+                         + cum_count[jnp.arange(C), choice] < max_tasks[choice])
+            return mine & room & pods_room
+
+        accept = contention(jnp.ones(C, dtype=bool))
+        accept = accept | contention(accept)
+        accept = contention(accept)
+
+        placed = onehot * accept[:, None]
+        delta = jnp.einsum("cn,cr->nr", placed, req)
+        nodes = NodeState(
+            idle=nodes.idle - delta,
+            future_idle=nodes.future_idle - delta,
+            used=nodes.used + delta,
+            ntasks=nodes.ntasks + jnp.sum(placed, axis=0).astype(jnp.int32))
+
+        # global node index of the accepted pick; psum merges shards (every
+        # non-winning shard contributes 0).
+        local_pick = jnp.where(accept, shard_offset + choice + 1, 0)
+        global_pick = jax.lax.psum(local_pick, axis) - 1     # NO_NODE == -1
+        return nodes, global_pick.astype(jnp.int32)
+
+    return step
+
+
+def place_blocks_sharded(mesh: Mesh, nodes: NodeState, req: jnp.ndarray,
+                         valid: jnp.ndarray, job_ix: jnp.ndarray,
+                         jobs: JobMeta, weights: ScoreWeights,
+                         allocatable: jnp.ndarray, max_tasks: jnp.ndarray,
+                         chunk: int = 256, sweeps: int = 2,
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray, NodeState]:
+    """Node-sharded block-greedy placement over ``mesh``.
+
+    nodes/allocatable/max_tasks are sharded on the node axis; tasks
+    (req/valid/job_ix) and JobMeta are replicated. Returns
+    (task_node i32[T] global indices, job_ready bool[J], sharded NodeState).
+    N must be divisible by the mesh size (pad with zero-capacity nodes).
+    """
+    D = mesh.devices.size
+    N = allocatable.shape[0]
+    assert N % D == 0, f"node count {N} not divisible by mesh size {D}"
+    T = req.shape[0]
+    pad = (-T) % chunk
+    if pad:
+        req = jnp.pad(req, ((0, pad), (0, 0)))
+        valid = jnp.pad(valid, (0, pad))
+        job_ix = jnp.pad(job_ix, (0, pad))
+    Tp = T + pad
+    n_chunks = Tp // chunk
+    J = jobs.min_available.shape[0]
+
+    node_sharded = P(NODE_AXIS)
+    repl = P()
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(NodeState(*(node_sharded,) * 4), node_sharded,
+                       node_sharded, repl, repl, repl),
+             out_specs=(repl, repl, NodeState(*(node_sharded,) * 4)),
+             check_vma=False)
+    def solve(nodes, allocatable, max_tasks, req, valid, job_ix):
+        Nl = allocatable.shape[0]
+        shard_offset = jax.lax.axis_index(NODE_AXIS) * Nl
+        step = partial(_sharded_chunk_step(NODE_AXIS),
+                       allocatable=allocatable, max_tasks=max_tasks,
+                       weights=weights, shard_offset=shard_offset)
+
+        assign0 = jnp.full(Tp, NO_NODE, dtype=jnp.int32)
+
+        def place_pass(carry, _):
+            nodes, assign, job_dead = carry
+            todo = (assign == NO_NODE) & valid & ~job_dead[job_ix]
+            xs = (req.reshape(n_chunks, chunk, -1),
+                  todo.reshape(n_chunks, chunk))
+            nodes, out = jax.lax.scan(step, nodes, xs)
+            assign = jnp.where(assign == NO_NODE, out.reshape(Tp), assign)
+            return (nodes, assign, job_dead), None
+
+        def sweep(carry, _):
+            (nodes, assign, job_dead), _ = jax.lax.scan(
+                place_pass, carry, jnp.arange(2))
+
+            placed = assign != NO_NODE
+            counts = jax.ops.segment_sum(placed.astype(jnp.int32), job_ix,
+                                         num_segments=J)
+            ready = counts + jobs.base_ready >= jobs.min_available
+            drop = placed & ~ready[job_ix]
+            # free dropped demand on the owning shard
+            local = (assign >= shard_offset) & (assign < shard_offset + Nl) & drop
+            drop_hot = (jax.nn.one_hot(
+                jnp.where(local, assign - shard_offset, 0), Nl,
+                dtype=req.dtype) * local[:, None])
+            freed = jnp.einsum("tn,tr->nr", drop_hot, req)
+            nodes = NodeState(
+                idle=nodes.idle + freed,
+                future_idle=nodes.future_idle + freed,
+                used=nodes.used - freed,
+                ntasks=nodes.ntasks - jnp.sum(drop_hot, axis=0).astype(jnp.int32))
+            assign = jnp.where(drop, NO_NODE, assign)
+            job_dead = job_dead | (~ready & (counts > 0))
+            return (nodes, assign, job_dead), ready
+
+        (nodes, assign, _), readies = jax.lax.scan(
+            sweep, (nodes, assign0, jnp.zeros(J, dtype=bool)),
+            jnp.arange(sweeps))
+        return assign, readies[-1], nodes
+
+    assign, ready, nodes = solve(nodes, allocatable, max_tasks, req, valid,
+                                 job_ix)
+    return assign[:T], ready, nodes

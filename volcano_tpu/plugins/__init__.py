@@ -5,8 +5,8 @@ Importing this package registers all in-tree plugins.
 
 from ..framework.registry import register_plugin_builder
 from .base import Plugin
-from . import binpack, conformance, drf, gang, nodeorder, predicates, priority
-from . import proportion
+from . import binpack, conformance, drf, gang, nodeorder, overcommit
+from . import predicates, priority, proportion, reservation, sla, tdm
 
 register_plugin_builder("gang", gang.New)
 register_plugin_builder("priority", priority.New)
@@ -16,5 +16,9 @@ register_plugin_builder("proportion", proportion.New)
 register_plugin_builder("binpack", binpack.New)
 register_plugin_builder("nodeorder", nodeorder.New)
 register_plugin_builder("predicates", predicates.New)
+register_plugin_builder("overcommit", overcommit.New)
+register_plugin_builder("sla", sla.New)
+register_plugin_builder("tdm", tdm.New)
+register_plugin_builder("reservation", reservation.New)
 
 __all__ = ["Plugin"]

@@ -19,9 +19,22 @@ SHARE_DELTA = 0.000001
 
 
 class _Attr:
-    def __init__(self):
+    """share recomputed lazily on read (events are hot, ordering is not)."""
+
+    __slots__ = ("allocated", "_share", "_dirty", "_total")
+
+    def __init__(self, total: "Resource"):
         self.allocated = Resource()
-        self.share = 0.0
+        self._share = 0.0
+        self._dirty = True
+        self._total = total
+
+    @property
+    def share(self) -> float:
+        if self._dirty:
+            self._share = calculate_share(self.allocated, self._total)
+            self._dirty = False
+        return self._share
 
 
 def calculate_share(allocated: Resource, total: Resource) -> float:
@@ -49,11 +62,10 @@ class DRFPlugin(Plugin):
             self.total.add(node.allocatable)
 
         for job in ssn.jobs.values():
-            attr = _Attr()
+            attr = _Attr(self.total)
             for t in job.tasks.values():
                 if allocated_status(t.status):
                     attr.allocated.add(t.resreq)
-            attr.share = calculate_share(attr.allocated, self.total)
             self.job_attrs[job.uid] = attr
 
         def preemptable(preemptor, preemptees):
@@ -88,12 +100,12 @@ class DRFPlugin(Plugin):
         def on_allocate(event):
             attr = self.job_attrs[event.task.job]
             attr.allocated.add(event.task.resreq)
-            attr.share = calculate_share(attr.allocated, self.total)
+            attr._dirty = True
 
         def on_deallocate(event):
             attr = self.job_attrs[event.task.job]
             attr.allocated.sub(event.task.resreq)
-            attr.share = calculate_share(attr.allocated, self.total)
+            attr._dirty = True
 
         ssn.add_event_handler(EventHandler(allocate_func=on_allocate,
                                            deallocate_func=on_deallocate))

@@ -128,25 +128,42 @@ class NodeOrderPlugin(Plugin):
         return {n.name: self.taint_toleration_weight * taint_toleration_score(task, n)
                 for n in nodes}
 
-    # device-path static score matrix (preference terms only)
+    # device-path static score matrix (preference terms only). Vectorized for
+    # the common case — python loops only over tasks with affinity
+    # preferences and nodes with PreferNoSchedule taints.
     def _static_matrix(self, ssn, tasks, node_t):
         node_infos = [ssn.nodes[name] for name in node_t.names]
-        score = np.zeros((len(tasks), len(node_infos)), np.float32)
-        for ti, task in enumerate(tasks):
-            need_affinity = self.node_affinity_weight and (
-                task.affinity.get("nodeAffinity", {})
-                .get("preferredDuringSchedulingIgnoredDuringExecution"))
-            for ni, node in enumerate(node_infos):
-                s = 0.0
-                if need_affinity:
-                    s += self.node_affinity_weight * \
-                        node_affinity_preferred_score(task, node)
-                if self.taint_toleration_weight and node.taints:
-                    s += self.taint_toleration_weight * \
+        T, N = len(tasks), len(node_infos)
+        has_pref_taints = any(
+            t.get("effect") == "PreferNoSchedule"
+            for n in node_infos for t in n.taints)
+        has_affinity_prefs = any(
+            (t.affinity.get("nodeAffinity", {})
+             .get("preferredDuringSchedulingIgnoredDuringExecution"))
+            for t in tasks)
+        if not has_pref_taints and not has_affinity_prefs:
+            # constant per-task offset — no effect on node choice; skip the
+            # [T,N] matrix entirely
+            return None
+        score = np.zeros((T, N), np.float32)
+        if self.taint_toleration_weight:
+            score += self.taint_toleration_weight * MAX_NODE_SCORE
+            tainted = [(ni, n) for ni, n in enumerate(node_infos)
+                       if any(t.get("effect") == "PreferNoSchedule"
+                              for t in n.taints)]
+            for ni, node in tainted:
+                for ti, task in enumerate(tasks):
+                    score[ti, ni] = self.taint_toleration_weight * \
                         taint_toleration_score(task, node)
-                elif self.taint_toleration_weight:
-                    s += self.taint_toleration_weight * MAX_NODE_SCORE
-                score[ti, ni] = s
+        if self.node_affinity_weight:
+            for ti, task in enumerate(tasks):
+                preferred = (task.affinity.get("nodeAffinity", {})
+                             .get("preferredDuringSchedulingIgnoredDuringExecution"))
+                if not preferred:
+                    continue
+                for ni, node in enumerate(node_infos):
+                    score[ti, ni] += self.node_affinity_weight * \
+                        node_affinity_preferred_score(task, node)
         return score
 
     def on_session_open(self, ssn) -> None:

@@ -67,16 +67,19 @@ class PredicatesPlugin(Plugin):
         if self.taint_enable and not taints_tolerated(task, node):
             raise PredicateError(task, node, TAINTS_UNTOLERATED)
 
-    def feasibility_mask(self, ssn, tasks, node_t) -> np.ndarray:
+    def feasibility_mask(self, ssn, tasks, node_t):
         node_infos = [ssn.nodes[name] for name in node_t.names]
         T, N = len(tasks), len(node_infos)
+        any_taints = any(n.taints for n in node_infos)   # O(N), once
+        any_unsched = any(n.unschedulable for n in node_infos)
+        if (not any_taints and not any_unsched
+                and not any(t.node_selector or t.affinity for t in tasks)):
+            return None                                  # all-true mask
         mask = np.ones((T, N), dtype=bool)
         sched = np.asarray([not n.unschedulable for n in node_infos], dtype=bool)
         mask &= sched[None, :]
         for ti, task in enumerate(tasks):
-            simple = (not task.node_selector and not task.affinity
-                      and not any(n.taints for n in node_infos))
-            if simple:
+            if not task.node_selector and not task.affinity and not any_taints:
                 continue
             for ni, node in enumerate(node_infos):
                 if not mask[ti, ni]:

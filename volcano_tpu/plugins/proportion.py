@@ -20,6 +20,9 @@ from .base import Plugin
 
 
 class _QueueAttr:
+    """share is recomputed lazily: allocate/deallocate events are hot (one
+    per task per cycle) while share is only read when queues are ordered."""
+
     def __init__(self, uid: str, name: str, weight: int):
         self.uid = uid
         self.name = name
@@ -29,7 +32,15 @@ class _QueueAttr:
         self.request = Resource()
         self.inqueue = Resource()
         self.capability: Resource = None
-        self.share = 0.0
+        self._share = 0.0
+        self._share_dirty = True
+
+    @property
+    def share(self) -> float:
+        if self._share_dirty:
+            self._share = _share(self.allocated, self.deserved)
+            self._share_dirty = False
+        return self._share
 
 
 def _share(allocated: Resource, deserved: Resource) -> float:
@@ -51,9 +62,13 @@ class ProportionPlugin(Plugin):
         self.total = Resource()
         self.queue_opts: Dict[str, _QueueAttr] = {}
 
+    # below this queue count the numpy twin of the water-filling kernel is
+    # used — identical semantics, no first-cycle device compile
+    DEVICE_MIN_QUEUES = 64
+
     def on_session_open(self, ssn) -> None:
-        import jax.numpy as jnp
-        from ..ops.fairness import proportion_deserved
+        from ..ops.fairness import (proportion_deserved,
+                                    proportion_deserved_numpy)
 
         for node in ssn.nodes.values():
             self.total.add(node.allocatable)
@@ -92,13 +107,19 @@ class ProportionPlugin(Plugin):
                 a.capability.to_vector_inf_fill(rnames) if a.capability is not None
                 else np.full(R, np.inf, np.float32) for a in attrs])
             alloc_v = np.stack([a.allocated.to_vector(rnames) for a in attrs])
-            res = proportion_deserved(jnp.asarray(total_v), jnp.asarray(weight_v),
-                                      jnp.asarray(request_v), jnp.asarray(cap_v),
-                                      jnp.asarray(alloc_v))
+            if len(attrs) < self.DEVICE_MIN_QUEUES:
+                res = proportion_deserved_numpy(total_v, weight_v, request_v,
+                                                cap_v, alloc_v)
+            else:
+                import jax.numpy as jnp
+                res = proportion_deserved(
+                    jnp.asarray(total_v), jnp.asarray(weight_v),
+                    jnp.asarray(request_v), jnp.asarray(cap_v),
+                    jnp.asarray(alloc_v))
             deserved = np.asarray(res.deserved)
             for i, attr in enumerate(attrs):
                 attr.deserved = Resource.from_vector(deserved[i], rnames)
-                attr.share = _share(attr.allocated, attr.deserved)
+                attr._share_dirty = True
                 metrics.update_queue_metrics(
                     attr.name, attr.allocated.cpu, attr.allocated.memory,
                     attr.deserved.cpu, attr.deserved.memory, attr.share,
@@ -173,12 +194,7 @@ class ProportionPlugin(Plugin):
             if attr is None:
                 return
             attr.allocated.add(event.task.resreq)
-            attr.share = _share(attr.allocated, attr.deserved)
-            metrics.update_queue_metrics(attr.name, attr.allocated.cpu,
-                                         attr.allocated.memory,
-                                         attr.deserved.cpu,
-                                         attr.deserved.memory,
-                                         attr.share, attr.weight)
+            attr._share_dirty = True
 
         def on_deallocate(event):
             job = ssn.jobs[event.task.job]
@@ -186,12 +202,19 @@ class ProportionPlugin(Plugin):
             if attr is None:
                 return
             attr.allocated.sub(event.task.resreq)
-            attr.share = _share(attr.allocated, attr.deserved)
+            attr._share_dirty = True
 
         ssn.add_event_handler(EventHandler(allocate_func=on_allocate,
                                            deallocate_func=on_deallocate))
 
     def on_session_close(self, ssn) -> None:
+        # flush final queue gauges once per cycle (the reference updates them
+        # per event; same end-of-cycle values, far cheaper)
+        for attr in self.queue_opts.values():
+            metrics.update_queue_metrics(
+                attr.name, attr.allocated.cpu, attr.allocated.memory,
+                attr.deserved.cpu, attr.deserved.memory, attr.share,
+                attr.weight)
         self.total = Resource()
         self.queue_opts = {}
 

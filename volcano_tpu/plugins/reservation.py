@@ -1,0 +1,44 @@
+"""Reservation plugin: target-job election + node locking.
+
+Mirrors /root/reference/pkg/scheduler/plugins/reservation/reservation.go:44-141.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..utils.reservation import Reservation
+from .base import Plugin
+
+
+class ReservationPlugin(Plugin):
+    NAME = "reservation"
+
+    def on_session_open(self, ssn) -> None:
+        def target_job_fn(jobs):
+            if not jobs:
+                return None
+            highest = max(j.priority for j in jobs)
+            candidates = [j for j in jobs if j.priority == highest]
+            # longest waiting first
+            return min(candidates, key=lambda j: j.creation_timestamp)
+
+        ssn.add_target_job_fn(self.NAME, target_job_fn)
+
+        def reserved_nodes_fn():
+            """Lock the unlocked node with the most idle resources
+            (reservation.go:120-141)."""
+            best = None
+            for node in ssn.nodes.values():
+                if node.name in Reservation.locked_nodes:
+                    continue
+                if best is None or best.idle.less_equal(node.idle):
+                    best = node
+            if best is not None:
+                Reservation.locked_nodes[best.name] = best
+
+        ssn.add_reserved_nodes_fn(self.NAME, reserved_nodes_fn)
+
+
+def New(arguments):
+    return ReservationPlugin(arguments)

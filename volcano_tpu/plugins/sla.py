@@ -1,0 +1,84 @@
+"""SLA plugin: jobs past their waiting-time SLA sort first and force-permit
+enqueue/pipeline.
+
+Mirrors /root/reference/pkg/scheduler/plugins/sla/sla.go:60-150.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Optional
+
+from ..framework.session import ABSTAIN, PERMIT
+from .base import Plugin
+
+JOB_WAITING_TIME = "sla-waiting-time"
+
+_DUR_RE = re.compile(r"(\d+(?:\.\d+)?)(h|m|s|ms|us|µs|ns)")
+_UNITS = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 1e-3, "us": 1e-6,
+          "µs": 1e-6, "ns": 1e-9}
+
+
+def parse_duration(text: str) -> Optional[float]:
+    """Go-style duration ('1h2m3s') -> seconds."""
+    if not text:
+        return None
+    total, matched = 0.0, False
+    for num, unit in _DUR_RE.findall(str(text)):
+        total += float(num) * _UNITS[unit]
+        matched = True
+    return total if matched else None
+
+
+class SLAPlugin(Plugin):
+    NAME = "sla"
+
+    def __init__(self, arguments=None):
+        super().__init__(arguments)
+        self.job_waiting_time: Optional[float] = None
+        jwt = parse_duration(self.arguments.get(JOB_WAITING_TIME, ""))
+        if jwt and jwt > 0:
+            self.job_waiting_time = jwt
+
+    def _jwt(self, job) -> Optional[float]:
+        """Per-job waiting time (annotation/JobInfo) or the global default
+        (sla.go:50-65)."""
+        if job.waiting_time is not None:
+            return job.waiting_time
+        ann = job.podgroup.annotations.get(JOB_WAITING_TIME) if job.podgroup else None
+        if ann:
+            return parse_duration(ann)
+        return self.job_waiting_time
+
+    def on_session_open(self, ssn) -> None:
+        def job_order(l, r) -> int:
+            ljwt, rjwt = self._jwt(l), self._jwt(r)
+            if ljwt is None:
+                return 0 if rjwt is None else 1
+            if rjwt is None:
+                return -1
+            ldeadline = l.creation_timestamp + ljwt
+            rdeadline = r.creation_timestamp + rjwt
+            if ldeadline < rdeadline:
+                return -1
+            if ldeadline > rdeadline:
+                return 1
+            return 0
+
+        ssn.add_job_order_fn(self.NAME, job_order)
+
+        def permitable(job) -> int:
+            jwt = self._jwt(job)
+            if jwt is None:
+                return ABSTAIN
+            if time.time() - job.creation_timestamp < jwt:
+                return ABSTAIN
+            return PERMIT
+
+        ssn.add_job_enqueueable_fn(self.NAME, permitable)
+        ssn.add_job_pipelined_fn(self.NAME, permitable)
+
+
+def New(arguments):
+    return SLAPlugin(arguments)

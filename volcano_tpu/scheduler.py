@@ -1,0 +1,89 @@
+"""Scheduler shell: the periodic scheduling loop.
+
+Mirrors /root/reference/pkg/scheduler/scheduler.go:39-170 — 1s-period
+runOnce over the configured action pipeline, YAML conf hot-reload (mtime
+watch replacing the fsnotify filewatcher, pkg/filewatcher), per-action
+latency metrics (scheduler.go:104-108).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional
+
+from . import metrics
+from .framework import (close_session, get_action, open_session,
+                        parse_scheduler_conf)
+from .framework.conf import SchedulerConfiguration
+
+DEFAULT_SCHEDULE_PERIOD = 1.0
+
+
+class Scheduler:
+    def __init__(self, cache, conf_text: Optional[str] = None,
+                 conf_path: Optional[str] = None,
+                 schedule_period: float = DEFAULT_SCHEDULE_PERIOD):
+        # actions/plugins register on import
+        from . import actions as _actions  # noqa: F401
+        from . import plugins as _plugins  # noqa: F401
+        self.cache = cache
+        self.conf_path = conf_path
+        self.schedule_period = schedule_period
+        self._conf_mtime: Optional[float] = None
+        self._stop = threading.Event()
+        self.conf: SchedulerConfiguration = None
+        self._load_conf(conf_text)
+
+    def _load_conf(self, conf_text: Optional[str] = None) -> None:
+        if conf_text is None and self.conf_path and os.path.exists(self.conf_path):
+            with open(self.conf_path) as f:
+                conf_text = f.read()
+            self._conf_mtime = os.path.getmtime(self.conf_path)
+        self.conf = parse_scheduler_conf(conf_text)
+
+    def _maybe_reload_conf(self) -> None:
+        """Hot-reload on file change (scheduler.go:112-170)."""
+        if not self.conf_path or not os.path.exists(self.conf_path):
+            return
+        mtime = os.path.getmtime(self.conf_path)
+        if mtime != self._conf_mtime:
+            self._load_conf()
+
+    def run_once(self) -> None:
+        """One scheduling cycle (scheduler.go:90-110)."""
+        self._maybe_reload_conf()
+        start = time.perf_counter()
+        ssn = open_session(self.cache, self.conf.tiers,
+                           self.conf.configurations)
+        try:
+            for name in self.conf.actions:
+                action = get_action(name)
+                if action is None:
+                    continue
+                action_start = time.perf_counter()
+                action.execute(ssn)
+                metrics.update_action_duration(
+                    name, time.perf_counter() - action_start)
+        finally:
+            close_session(ssn)
+        metrics.update_e2e_duration(time.perf_counter() - start)
+
+    def run(self) -> None:
+        """wait.Until(runOnce, period) (scheduler.go:81-88)."""
+        while not self._stop.is_set():
+            cycle_start = time.perf_counter()
+            self.run_once()
+            remaining = self.schedule_period - (time.perf_counter() - cycle_start)
+            if remaining > 0:
+                self._stop.wait(remaining)
+
+    def start(self) -> threading.Thread:
+        thread = threading.Thread(target=self.run, daemon=True,
+                                  name="vc-scheduler")
+        thread.start()
+        return thread
+
+    def stop(self) -> None:
+        self._stop.set()

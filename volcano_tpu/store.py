@@ -1,0 +1,167 @@
+"""ObjectStore: the in-process API-server/etcd substitute.
+
+SURVEY.md §5.8: the reference's distributed communication backend IS the
+Kubernetes API server — informer watch streams in, REST writes out. The
+rebuild collapses that into one process: a thread-safe object store with
+watch callbacks (the informer analogue), an admission-hook chain invoked on
+create/update (the webhook-manager analogue), and bind/evict entry points
+that emulate the kubelet side (pod starts running once bound; evicted pods
+are deleted with a condition).
+
+State lives only here — "the store is the checkpoint" (SURVEY.md §5.4):
+every component rebuilds its caches from a relist, exactly like informers
+resyncing after a restart.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, List, Optional
+
+from .apis.objects import Command, Job, Pod, PodGroupCR, QueueCR
+
+ADDED = "added"
+UPDATED = "updated"
+DELETED = "deleted"
+
+
+class AdmissionError(Exception):
+    """Raised by admission hooks to reject a create/update."""
+
+
+class ObjectStore:
+    KINDS = ("Pod", "Job", "PodGroup", "Queue", "Command", "PriorityClass")
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: Dict[str, Dict[str, object]] = {k: {} for k in self.KINDS}
+        self._watchers: Dict[str, List[Callable]] = {k: [] for k in self.KINDS}
+        self._admission_hooks: List[Callable] = []
+        self._rv = 0
+
+    # -- admission (webhook-manager analogue) -------------------------------
+
+    def register_admission_hook(self, hook: Callable) -> None:
+        """hook(operation, kind, obj, old_obj) -> possibly-mutated obj;
+        raises AdmissionError to deny."""
+        self._admission_hooks.append(hook)
+
+    def _admit(self, operation: str, kind: str, obj, old=None):
+        for hook in self._admission_hooks:
+            result = hook(operation, kind, obj, old)
+            if result is not None:
+                obj = result
+        return obj
+
+    # -- watch (informer analogue) ------------------------------------------
+
+    def watch(self, kind: str, handler: Callable[[str, object, Optional[object]], None]) -> None:
+        """handler(event, obj, old_obj); existing objects replay as ADDED."""
+        with self._lock:
+            self._watchers[kind].append(handler)
+            existing = list(self._objects[kind].values())
+        for obj in existing:
+            handler(ADDED, obj, None)
+
+    def _notify(self, kind: str, event: str, obj, old=None) -> None:
+        for handler in list(self._watchers[kind]):
+            handler(event, obj, old)
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, obj) -> object:
+        kind = obj.KIND
+        obj = self._admit("CREATE", kind, obj)
+        with self._lock:
+            key = obj.metadata.key()
+            if key in self._objects[kind]:
+                raise ValueError(f"{kind} {key} already exists")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            self._objects[kind][key] = obj
+        self._notify(kind, ADDED, obj)
+        return obj
+
+    def update(self, obj) -> object:
+        kind = obj.KIND
+        with self._lock:
+            key = obj.metadata.key()
+            old = self._objects[kind].get(key)
+        obj = self._admit("UPDATE", kind, obj, old)
+        with self._lock:
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            self._objects[kind][key] = obj
+        self._notify(kind, UPDATED, obj, old)
+        return obj
+
+    def update_status(self, obj) -> object:
+        """Status subresource: skips admission."""
+        kind = obj.KIND
+        with self._lock:
+            key = obj.metadata.key()
+            old = self._objects[kind].get(key)
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            self._objects[kind][key] = obj
+        self._notify(kind, UPDATED, obj, old)
+        return obj
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            obj = self._objects[kind].pop(f"{namespace}/{name}", None)
+        if obj is not None:
+            self._notify(kind, DELETED, obj)
+
+    def get(self, kind: str, namespace: str, name: str):
+        with self._lock:
+            return self._objects[kind].get(f"{namespace}/{name}")
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List:
+        with self._lock:
+            objs = list(self._objects[kind].values())
+        if namespace is None:
+            return objs
+        return [o for o in objs if o.metadata.namespace == namespace]
+
+    # -- kubelet emulation ---------------------------------------------------
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        """pods/<p>/binding analogue: place + start running."""
+        with self._lock:
+            pod: Pod = self._objects["Pod"].get(f"{namespace}/{name}")
+            if pod is None:
+                raise KeyError(f"pod {namespace}/{name} not found")
+            old = _shallow_status_copy(pod)
+            pod.status.node_name = node_name
+            pod.status.phase = "Running"
+            self._rv += 1
+            pod.metadata.resource_version = self._rv
+        self._notify("Pod", UPDATED, pod, old)
+
+    def evict_pod(self, namespace: str, name: str, reason: str) -> None:
+        """Eviction analogue: condition + delete (cache.go:146-176)."""
+        with self._lock:
+            pod: Pod = self._objects["Pod"].get(f"{namespace}/{name}")
+            if pod is None:
+                return
+            pod.status.conditions.append({"type": "Evicted", "reason": reason})
+        self.delete("Pod", namespace, name)
+
+    def finish_pod(self, namespace: str, name: str, succeeded: bool = True) -> None:
+        """Test/e2e helper: complete a running pod."""
+        with self._lock:
+            pod: Pod = self._objects["Pod"].get(f"{namespace}/{name}")
+            if pod is None:
+                return
+            old = _shallow_status_copy(pod)
+            pod.status.phase = "Succeeded" if succeeded else "Failed"
+            self._rv += 1
+        self._notify("Pod", UPDATED, pod, old)
+
+
+def _shallow_status_copy(pod: Pod) -> Pod:
+    import copy
+    clone = copy.copy(pod)
+    clone.status = copy.deepcopy(pod.status)
+    return clone

@@ -1,0 +1,46 @@
+"""Full-system assembly: a Volcano-equivalent cluster in one process.
+
+Wires together the three control-plane components + CLI surface
+(SURVEY.md §1 layer map): ObjectStore (API server/etcd), webhook router
+(vc-webhook-manager), controllers (vc-controller-manager), and the
+Scheduler over a store-wired cache (vc-scheduler).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .apis.objects import ObjectMeta, QueueCR, QueueSpecCR
+from .cache.store_wiring import wire_cache_to_store
+from .cli.vcctl import JobCommands, QueueCommands
+from .controllers import start_controllers
+from .scheduler import Scheduler
+from .store import ObjectStore
+from .webhooks import register_webhooks
+
+
+class VolcanoSystem:
+    def __init__(self, conf_text: Optional[str] = None,
+                 schedule_period: float = 1.0,
+                 default_queue: str = "default"):
+        self.store = ObjectStore()
+        self.router = register_webhooks(self.store)
+        self.controllers = start_controllers(self.store)
+        if default_queue:
+            self.store.create(QueueCR(
+                metadata=ObjectMeta(name=default_queue, namespace="default"),
+                spec=QueueSpecCR(weight=1)))
+        self.cache = wire_cache_to_store(self.store)
+        self.scheduler = Scheduler(self.cache, conf_text=conf_text,
+                                   schedule_period=schedule_period)
+        self.jobs = JobCommands(self.store)
+        self.queues = QueueCommands(self.store)
+
+    def schedule_once(self) -> None:
+        self.scheduler.run_once()
+
+    def start(self):
+        return self.scheduler.start()
+
+    def stop(self) -> None:
+        self.scheduler.stop()

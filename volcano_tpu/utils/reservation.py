@@ -1,0 +1,21 @@
+"""Global reservation singleton (mirrors the util.Reservation state in
+/root/reference/pkg/scheduler/util/scheduler_helper.go:254-266), shared by
+the elect/reserve actions, the reservation plugin, and allocate's
+locked-node exclusion."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class ResourceReservation:
+    def __init__(self):
+        self.target_job = None
+        self.locked_nodes: Dict[str, object] = {}
+
+    def reset(self) -> None:
+        self.target_job = None
+        self.locked_nodes.clear()
+
+
+Reservation = ResourceReservation()

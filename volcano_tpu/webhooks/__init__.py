@@ -1,0 +1,9 @@
+"""Admission webhooks (mirrors /root/reference/pkg/webhooks): mutating
+defaults + validating rules, registered as ObjectStore admission hooks (the
+in-process analogue of the TLS webhook server + AdmissionReview plumbing in
+pkg/webhooks/router)."""
+
+from .admission import register_webhooks
+from .router import AdmissionService, Router
+
+__all__ = ["AdmissionService", "Router", "register_webhooks"]

@@ -1,0 +1,137 @@
+"""Admission webhook implementations.
+
+- jobs/mutate    /root/reference/pkg/webhooks/admission/jobs/mutate/
+                 mutate_job.go:100-170 — defaults: queue, scheduler name,
+                 maxRetry, minAvailable=Σreplicas, task names.
+- jobs/validate  admission/jobs/validate/admit_job.go:46-330 — task name and
+                 replica consistency, minAvailable bounds, policy legality,
+                 queue existence/state.
+- queues         admission/queues/{validate,mutate} — weight bounds, state
+                 legality; defaults weight=1, reclaimable.
+- pods           admission/pods/admit_pod.go:1-203 — gate bare-pod binding
+                 on its PodGroup being schedulable.
+- podgroups      admission/podgroups/mutate_podgroup.go — default queue.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from ..api import BusAction, BusEvent, QueueState
+from ..apis.objects import Job, PodGroupCR, QueueCR
+from ..store import AdmissionError, ObjectStore
+from .router import AdmissionService, Router, deny
+
+DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+# policy legality table (admit_job.go checkPolicyDuplicate/validatePolicies)
+_VALID_JOB_ACTIONS = set(BusAction)
+_VALID_EVENTS = set(BusEvent)
+
+
+def mutate_job(operation: str, job: Job, old) -> Job:
+    """Defaulting patch (mutate_job.go:100-170)."""
+    if not job.spec.queue:
+        job.spec.queue = "default"
+    if not job.spec.scheduler_name:
+        job.spec.scheduler_name = "volcano"
+    if job.spec.max_retry == 0:
+        job.spec.max_retry = 3
+    for i, task in enumerate(job.spec.tasks):
+        if not task.name:
+            task.name = f"default{i}"
+    if job.spec.min_available == 0:
+        job.spec.min_available = sum(t.replicas for t in job.spec.tasks)
+    return job
+
+
+def make_validate_job(store: ObjectStore):
+    def validate_job(operation: str, job: Job, old) -> None:
+        if not job.spec.tasks:
+            deny("No task specified in job spec")
+        total_replicas = 0
+        names = set()
+        for task in job.spec.tasks:
+            if task.replicas < 0:
+                deny(f"'replicas' < 0 in task: {task.name}")
+            if task.min_available is not None:
+                if task.min_available > task.replicas:
+                    deny(f"'minAvailable' is greater than 'replicas' in task: "
+                         f"{task.name}")
+            total_replicas += task.replicas
+            if task.name in names:
+                deny(f"duplicated task name {task.name}")
+            if not DNS1123.match(task.name):
+                deny(f"task name {task.name} is not a valid DNS-1123 label")
+            names.add(task.name)
+            _validate_policies(task.policies)
+        if job.spec.min_available > total_replicas:
+            deny("job 'minAvailable' should not be greater than total replicas "
+                 "in tasks")
+        if job.spec.min_available < 0:
+            deny("job 'minAvailable' must be >= 0")
+        _validate_policies(job.spec.policies)
+        queue: QueueCR = store.get("Queue", "default", job.spec.queue)
+        if queue is None:
+            deny(f"unable to find job queue: {job.spec.queue}")
+        elif queue.status.state != QueueState.OPEN:
+            deny(f"can only submit job to queue with state `Open`, "
+                 f"queue `{queue.metadata.name}` status is "
+                 f"`{queue.status.state.value}`")
+
+    return validate_job
+
+
+def _validate_policies(policies) -> None:
+    events = set()
+    for policy in policies:
+        if policy.event in events:
+            deny(f"duplicate policy event {policy.event}")
+        events.add(policy.event)
+        if policy.action not in _VALID_JOB_ACTIONS:
+            deny(f"invalid policy action {policy.action}")
+        if policy.event not in _VALID_EVENTS:
+            deny(f"invalid policy event {policy.event}")
+
+
+def mutate_queue(operation: str, queue: QueueCR, old) -> QueueCR:
+    if queue.spec.weight == 0:
+        queue.spec.weight = 1
+    return queue
+
+
+def validate_queue(operation: str, queue: QueueCR, old) -> None:
+    if queue.spec.weight < 1:
+        deny(f"queue weight must be a positive integer, got "
+             f"{queue.spec.weight}")
+    if operation == "CREATE" and queue.status.state not in (
+            QueueState.OPEN, QueueState.CLOSED):
+        deny(f"queue state must be in [Open, Closed], got "
+             f"{queue.status.state.value}")
+
+
+def mutate_podgroup(operation: str, pg: PodGroupCR, old) -> PodGroupCR:
+    if not pg.spec.queue:
+        pg.spec.queue = "default"
+    return pg
+
+
+def register_webhooks(store: ObjectStore) -> Router:
+    """Self-registration analogue (cmd/webhook-manager/app/server.go:41-108):
+    build the router, bind every admission service, attach to the store."""
+    router = Router()
+    router.register(AdmissionService(
+        "/jobs/mutate", ["Job"], ["CREATE"], mutate_job, mutating=True))
+    router.register(AdmissionService(
+        "/jobs/validate", ["Job"], ["CREATE", "UPDATE"],
+        make_validate_job(store)))
+    router.register(AdmissionService(
+        "/queues/mutate", ["Queue"], ["CREATE"], mutate_queue, mutating=True))
+    router.register(AdmissionService(
+        "/queues/validate", ["Queue"], ["CREATE", "UPDATE"], validate_queue))
+    router.register(AdmissionService(
+        "/podgroups/mutate", ["PodGroup"], ["CREATE"], mutate_podgroup,
+        mutating=True))
+    store.register_admission_hook(router.hook)
+    return router
