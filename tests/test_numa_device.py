@@ -1,0 +1,360 @@
+"""GPU device + NUMA topology tests.
+
+Model: the reference's api tests plus numaaware policy tests
+(pkg/scheduler/plugins/numaaware/policy/policy_*_test.go,
+pkg/scheduler/api/device_info.go usage in predicates/gpu.go).
+"""
+
+import pytest
+
+from volcano_tpu.api import (NodeInfo, Resource, TaskInfo, TaskStatus,
+                             GPU_MEMORY_RESOURCE)
+from volcano_tpu.api.device_info import (GPUDevice, make_gpu_devices,
+                                         predicate_gpu, devices_idle_matrix)
+from volcano_tpu.api.numa_info import (CPU, NumatopoInfo, PolicyBestEffort,
+                                       PolicyRestricted, PolicySingleNumaNode,
+                                       TopologyHint, bitmask, is_narrower,
+                                       mask_bits, mask_count,
+                                       merge_filtered_hints)
+from volcano_tpu.plugins.numaaware import (CpuManagerProvider, guaranteed_cpus,
+                                           take_by_topology)
+
+
+def gpu_task(uid, mem):
+    return TaskInfo(uid=uid, name=uid,
+                    resreq=Resource(100, 100, scalars={GPU_MEMORY_RESOURCE: mem}))
+
+
+class TestGPUDevice:
+    def test_make_and_idle(self):
+        devices = make_gpu_devices(16000, 4)
+        assert len(devices) == 4
+        assert devices[0].memory == 4000
+        assert devices[0].idle_memory() == 4000
+
+    def test_predicate_gpu_picks_first_fitting(self):
+        devices = make_gpu_devices(8000, 2)      # 2 cards x 4000
+        devices[0].task_map["other"] = 3500
+        assert predicate_gpu(gpu_task("t", 1000), devices) == 1
+        assert predicate_gpu(gpu_task("t", 500), devices) == 0
+        assert predicate_gpu(gpu_task("t", 4500), devices) is None
+
+    def test_node_accounting_on_add_remove(self):
+        node = NodeInfo(name="n1", allocatable=Resource(
+            4000, 1 << 30, scalars={GPU_MEMORY_RESOURCE: 8000}))
+        node.set_gpu_info(8000, 2)
+        task = gpu_task("t1", 3000)
+        task.status = TaskStatus.ALLOCATED
+        node.add_task(task)
+        assert node.gpu_devices[0].used_memory() == 3000
+        clone = node.clone()
+        assert clone.gpu_devices[0].used_memory() == 3000
+        node.remove_task(task)
+        assert node.gpu_devices[0].used_memory() == 0
+        assert clone.gpu_devices[0].used_memory() == 3000
+
+    def test_auto_wiring_from_capacity_scalars(self):
+        """NodeInfo populates cards from volcano.sh/gpu-memory + gpu-number
+        capacity (node_info.go NewNodeInfo -> setNodeGPUInfo)."""
+        node = NodeInfo(name="n1", allocatable=Resource.from_dict({
+            "cpu": "4", "memory": "8Gi",
+            "volcano.sh/gpu-memory": 8000, "volcano.sh/gpu-number": 2}))
+        assert len(node.gpu_devices) == 2
+        # from_dict milli-scales: 8000 units -> 8000000; per card 4000000,
+        # matching a from_dict task request of 4000 units
+        assert node.gpu_devices[0].memory == 4000 * 1000
+        task = TaskInfo(uid="t", resreq=Resource.from_dict(
+            {"volcano.sh/gpu-memory": 4000}))
+        assert predicate_gpu(task, node.gpu_devices) == 0
+        task_big = TaskInfo(uid="t2", resreq=Resource.from_dict(
+            {"volcano.sh/gpu-memory": 4001}))
+        assert predicate_gpu(task_big, node.gpu_devices) is None
+
+    def test_idle_matrix(self):
+        n1 = NodeInfo(name="n1", allocatable=Resource(1000, 1000))
+        n1.set_gpu_info(8000, 2)
+        n2 = NodeInfo(name="n2", allocatable=Resource(1000, 1000))
+        m = devices_idle_matrix([n1, n2])
+        assert m.shape == (2, 2)
+        assert m[0, 0] == 4000
+        assert m[1, 0] == float("-inf")
+
+
+class TestBitmaskHints:
+    def test_bitmask_roundtrip(self):
+        m = bitmask([0, 2])
+        assert mask_bits(m) == [0, 2]
+        assert mask_count(m) == 2
+
+    def test_is_narrower(self):
+        assert is_narrower(bitmask([0]), bitmask([0, 1]))
+        assert is_narrower(bitmask([0]), bitmask([1]))   # tie: lower value
+
+    def test_merge_prefers_narrow_preferred(self):
+        hints = [[TopologyHint(bitmask([0]), True),
+                  TopologyHint(bitmask([0, 1]), False)]]
+        best = merge_filtered_hints([0, 1], hints)
+        assert best.affinity == bitmask([0])
+        assert best.preferred
+
+    def test_merge_cross_provider_and(self):
+        provider_a = [TopologyHint(bitmask([0, 1]), True)]
+        provider_b = [TopologyHint(bitmask([1]), True)]
+        best = merge_filtered_hints([0, 1], [provider_a, provider_b])
+        assert best.affinity == bitmask([1])
+        assert best.preferred
+
+
+class TestPolicies:
+    def _hints(self, topo, request):
+        provider = CpuManagerProvider()
+        task = TaskInfo(uid="t", resreq=Resource(request * 1000, 0))
+        return provider.get_topology_hints(task, topo, topo.idle_sets())
+
+    def test_best_effort_always_admits(self):
+        topo = NumatopoInfo.uniform("n1", 2, 4)
+        policy = PolicyBestEffort(topo.numa_nodes())
+        hint, admit = policy.predicate([self._hints(topo, 2)])
+        assert admit
+        assert mask_count(hint.affinity) == 1
+
+    def test_restricted_rejects_unpreferred(self):
+        topo = NumatopoInfo.uniform("n1", 2, 4)
+        # 2 CPUs fit one numa node, but only 1 cpu free in each -> hints for
+        # single nodes are impossible; cross-node hint is not preferred.
+        topo.numa_res_map[CPU].allocatable = {0, 4}   # one cpu per numa node
+        policy = PolicyRestricted(topo.numa_nodes())
+        hint, admit = policy.predicate([self._hints(topo, 2)])
+        assert not admit
+
+    def test_single_numa_node_rejects_spanning(self):
+        topo = NumatopoInfo.uniform("n1", 2, 4)
+        topo.numa_res_map[CPU].allocatable = {0, 4}
+        policy = PolicySingleNumaNode(topo.numa_nodes())
+        hint, admit = policy.predicate([self._hints(topo, 2)])
+        assert not admit
+        # and admits when one node has room
+        topo.numa_res_map[CPU].allocatable = {0, 1, 4}
+        hint, admit = policy.predicate([self._hints(topo, 2)])
+        assert admit
+        assert hint.affinity == bitmask([0])
+
+
+class TestTakeByTopology:
+    def test_whole_domain_first(self):
+        topo = NumatopoInfo.uniform("n1", 2, 4)
+        taken = take_by_topology(topo, set(range(8)), 4)
+        numa_ids = {topo.cpu_detail[c].numa_id for c in taken}
+        assert len(taken) == 4
+        assert len(numa_ids) == 1
+
+    def test_insufficient(self):
+        topo = NumatopoInfo.uniform("n1", 2, 4)
+        assert take_by_topology(topo, {0, 1}, 3) is None
+
+    def test_guaranteed_cpus(self):
+        assert guaranteed_cpus(TaskInfo(uid="a", resreq=Resource(2000, 0))) == 2
+        assert guaranteed_cpus(TaskInfo(uid="b", resreq=Resource(2500, 0))) == 0
+        assert guaranteed_cpus(TaskInfo(uid="c", resreq=Resource(0, 0))) == 0
+
+
+class TestNumaAwareIntegration:
+    def _build(self, policy="single-numa-node"):
+        from volcano_tpu.api import (JobInfo, PodGroup, PodGroupPhase,
+                                     QueueInfo)
+        from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+
+        node = NodeInfo(name="n1", allocatable=Resource(8000, 1 << 30,
+                                                        max_task_num=100))
+        node.numa_info = NumatopoInfo.uniform("n1", 2, 4,
+                                              topology_policy=policy)
+        pg = PodGroup(name="j1", queue="default", min_member=1,
+                      phase=PodGroupPhase.INQUEUE)
+        job = JobInfo(uid="j1", name="j1", queue="default", min_available=1,
+                      podgroup=pg)
+        return node, job, SchedulerCache, FakeBinder, FakeEvictor, QueueInfo
+
+    def _run(self, node, job, SchedulerCache, FakeBinder, FakeEvictor,
+             QueueInfo, engine="callbacks"):
+        from volcano_tpu.actions import AllocateAction
+        from volcano_tpu.framework import (PluginOption, Tier, close_session,
+                                           open_session)
+        import volcano_tpu.plugins  # noqa: F401
+
+        binder = FakeBinder()
+        cache = SchedulerCache(binder=binder, evictor=FakeEvictor())
+        cache.add_queue(QueueInfo(name="default", weight=1))
+        cache.add_node(node)
+        cache.add_job(job)
+        tiers = [Tier(plugins=[PluginOption("gang"),
+                               PluginOption("predicates"),
+                               PluginOption("numa-aware"),
+                               PluginOption("nodeorder")])]
+        ssn = open_session(cache, tiers, [])
+        AllocateAction(engine=engine).execute(ssn)
+        close_session(ssn)
+        return binder, cache
+
+    @pytest.mark.parametrize("engine", ["callbacks", "tpu-fused"])
+    def test_fitting_task_binds_and_writes_back(self, engine):
+        node, job, *rest = self._build()
+        task = TaskInfo(uid="t1", name="t1", job="j1",
+                        resreq=Resource(2000, 1000),
+                        topology_policy="single-numa-node")
+        job.add_task_info(task)
+        binder, cache = self._run(node, job, *rest, engine=engine)
+        assert len(binder.binds) == 1
+        # writeback shrank the allocatable cpuset by 2
+        live = cache.nodes["n1"].numa_info
+        assert len(live.numa_res_map[CPU].allocatable) == 6
+
+    @pytest.mark.parametrize("engine", ["callbacks", "tpu-fused"])
+    def test_spanning_task_rejected(self, engine):
+        node, job, *rest = self._build()
+        # 5 CPUs cannot fit in a single numa node of 4
+        task = TaskInfo(uid="t1", name="t1", job="j1",
+                        resreq=Resource(5000, 1000),
+                        topology_policy="single-numa-node")
+        job.add_task_info(task)
+        binder, cache = self._run(node, job, *rest, engine=engine)
+        assert len(binder.binds) == 0
+
+    def test_policy_mismatch_rejected(self):
+        node, job, *rest = self._build(policy="best-effort")
+        task = TaskInfo(uid="t1", name="t1", job="j1",
+                        resreq=Resource(2000, 1000),
+                        topology_policy="single-numa-node")
+        job.add_task_info(task)
+        binder, cache = self._run(node, job, *rest)
+        assert len(binder.binds) == 0
+
+    def test_cpusets_released_on_task_delete(self):
+        node, job, *rest = self._build()
+        task = TaskInfo(uid="t1", name="t1", job="j1",
+                        resreq=Resource(2000, 1000),
+                        topology_policy="single-numa-node")
+        job.add_task_info(task)
+        binder, cache = self._run(node, job, *rest)
+        live = cache.nodes["n1"]
+        assert len(live.numa_info.numa_res_map[CPU].allocatable) == 6
+        bound = cache.jobs["j1"].tasks["t1"]
+        cache.delete_task(bound)
+        assert len(live.numa_info.numa_res_map[CPU].allocatable) == 8
+        assert "t1" not in live.numa_allocations
+
+    @pytest.mark.parametrize("engine", ["callbacks", "tpu-fused"])
+    def test_sibling_tasks_get_disjoint_cpusets(self, engine):
+        """Batched solve must not hand two guaranteed tasks overlapping
+        exclusive cpusets (assign_res is pre-placement state)."""
+        node, job, *rest = self._build()
+        for i in range(3):
+            job.add_task_info(TaskInfo(
+                uid=f"t{i}", name=f"t{i}", job="j1",
+                resreq=Resource(2000, 1000),
+                topology_policy="single-numa-node",
+                creation_timestamp=float(i)))
+        binder, cache = self._run(node, job, *rest, engine=engine)
+        assert len(binder.binds) == 3
+        allocs = cache.nodes["n1"].numa_allocations
+        assert len(allocs) == 3
+        all_cpus = [cpu for sets in allocs.values() for cpu in sets[CPU]]
+        assert len(all_cpus) == len(set(all_cpus)) == 6
+        assert len(cache.nodes["n1"].numa_info.numa_res_map[CPU].allocatable) == 2
+
+
+class TestGPUSharingPredicate:
+    def _run(self, node, job, engine="callbacks"):
+        from volcano_tpu.actions import AllocateAction
+        from volcano_tpu.api import QueueInfo
+        from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+        from volcano_tpu.framework import (PluginOption, Tier, close_session,
+                                           open_session)
+        from volcano_tpu.framework.arguments import Arguments
+        import volcano_tpu.plugins  # noqa: F401
+
+        binder = FakeBinder()
+        cache = SchedulerCache(binder=binder, evictor=FakeEvictor())
+        cache.add_queue(QueueInfo(name="default", weight=1))
+        cache.add_node(node)
+        cache.add_job(job)
+        tiers = [Tier(plugins=[
+            PluginOption("gang"),
+            PluginOption("predicates", arguments=Arguments(
+                {"predicate.GPUSharingEnable": "true"})),
+            PluginOption("nodeorder")])]
+        ssn = open_session(cache, tiers, [])
+        AllocateAction(engine=engine).execute(ssn)
+        close_session(ssn)
+        return binder
+
+    def _job(self, mem):
+        from volcano_tpu.api import JobInfo, PodGroup, PodGroupPhase
+        pg = PodGroup(name="j1", queue="default", min_member=1,
+                      phase=PodGroupPhase.INQUEUE)
+        job = JobInfo(uid="j1", name="j1", queue="default", min_available=1,
+                      podgroup=pg)
+        job.add_task_info(gpu_task("t1", mem))
+        job.tasks["t1"].job = "j1"
+        return job
+
+    @pytest.mark.parametrize("engine", ["callbacks", "tpu-fused"])
+    def test_no_single_card_fits(self, engine):
+        """Aggregate idle GPU memory fits but no single card does ->
+        reject (predicates/gpu.go)."""
+        node = NodeInfo(name="n1", allocatable=Resource(
+            4000, 1 << 30, scalars={GPU_MEMORY_RESOURCE: 8000},
+            max_task_num=100))
+        node.set_gpu_info(8000, 2)               # 2 x 4000
+        node.gpu_devices[0].task_map["other"] = 3500
+        binder = self._run(node, self._job(4500), engine=engine)
+        assert len(binder.binds) == 0
+
+    @pytest.mark.parametrize("engine", ["callbacks", "tpu-fused"])
+    def test_card_fits(self, engine):
+        node = NodeInfo(name="n1", allocatable=Resource(
+            4000, 1 << 30, scalars={GPU_MEMORY_RESOURCE: 8000},
+            max_task_num=100))
+        node.set_gpu_info(8000, 2)
+        binder = self._run(node, self._job(4000), engine=engine)
+        assert len(binder.binds) == 1
+
+
+class TestPredicateCache:
+    def test_stateful_checks_not_cached(self):
+        """CacheEnable must not cache the GPU-share check: after task A
+        consumes a card, same-signature task B must be rejected."""
+        from volcano_tpu.api import TaskStatus
+        from volcano_tpu.framework.arguments import Arguments
+        from volcano_tpu.plugins.predicates import (PredicateError,
+                                                    PredicatesPlugin)
+
+        plugin = PredicatesPlugin(Arguments({
+            "predicate.CacheEnable": "true",
+            "predicate.GPUSharingEnable": "true"}))
+        node = NodeInfo(name="n1", allocatable=Resource(
+            8000, 1 << 30, scalars={GPU_MEMORY_RESOURCE: 4000},
+            max_task_num=100))
+        node.set_gpu_info(4000, 1)
+        a, b = gpu_task("a", 3000), gpu_task("b", 3000)
+        plugin.predicate(a, node)               # fits, cached True
+        a.status = TaskStatus.ALLOCATED
+        node.add_task(a)                        # card now has 1000 idle
+        with pytest.raises(PredicateError):
+            plugin.predicate(b, node)
+
+
+class TestProportionalPredicate:
+    def test_guard_blocks_cpu_hog(self):
+        from volcano_tpu.api import NodeInfo, Resource, TaskInfo
+        from volcano_tpu.plugins.predicates import proportional_ok
+
+        node = NodeInfo(name="n1", allocatable=Resource(
+            10000, 10 * 1024 ** 3, scalars={"nvidia.com/gpu": 2000}))
+        rates = {"nvidia.com/gpu": (2000.0, 1024.0 ** 3)}
+        hog = TaskInfo(uid="t", resreq=Resource(9000, 1024 ** 3))
+        assert not proportional_ok(hog, node, rates)
+        small = TaskInfo(uid="t", resreq=Resource(1000, 1024 ** 3))
+        assert proportional_ok(small, node, rates)
+        gpu_user = TaskInfo(uid="t", resreq=Resource(
+            9000, 1024 ** 3, scalars={"nvidia.com/gpu": 1000}))
+        assert proportional_ok(gpu_user, node, rates)

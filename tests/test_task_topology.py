@@ -1,0 +1,127 @@
+"""task-topology plugin tests.
+
+Model: pkg/scheduler/plugins/task-topology tests — bucket construction from
+affinity annotations, task ordering, and node scoring that pulls bucket
+mates together.
+"""
+
+from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
+                             QueueInfo, Resource, TaskInfo)
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.framework import PluginOption, Tier, close_session, open_session
+from volcano_tpu.actions import AllocateAction
+from volcano_tpu.plugins.task_topology import (AFFINITY_ANNOTATION,
+                                               ANTI_AFFINITY_ANNOTATION,
+                                               TASK_ORDER_ANNOTATION,
+                                               JobManager, TaskTopology,
+                                               read_topology_from_pg_annotations)
+import volcano_tpu.plugins  # noqa: F401
+
+
+def build_job(name, annotations, task_specs, min_avail=1, queue="default"):
+    """task_specs: list of (task_role, count, cpu)."""
+    pg = PodGroup(name=name, queue=queue, min_member=min_avail,
+                  phase=PodGroupPhase.INQUEUE, annotations=annotations)
+    job = JobInfo(uid=name, name=name, queue=queue, min_available=min_avail,
+                  podgroup=pg)
+    i = 0
+    for role, count, cpu in task_specs:
+        for _ in range(count):
+            job.add_task_info(TaskInfo(
+                uid=f"{name}-{i}", name=f"{name}-{role}-{i}", job=name,
+                task_role=role, resreq=Resource(cpu, 100),
+                creation_timestamp=float(i)))
+            i += 1
+    return job
+
+
+class TestAnnotations:
+    def test_parse(self):
+        job = build_job("j1", {AFFINITY_ANNOTATION: "ps,worker",
+                               ANTI_AFFINITY_ANNOTATION: "ps",
+                               TASK_ORDER_ANNOTATION: "worker,ps"},
+                        [("ps", 2, 100), ("worker", 2, 100)])
+        topo = read_topology_from_pg_annotations(job)
+        assert topo.affinity == [["ps", "worker"]]
+        assert topo.anti_affinity == [["ps"]]
+        assert topo.task_order == ["worker", "ps"]
+
+    def test_unknown_task_rejected(self):
+        job = build_job("j1", {AFFINITY_ANNOTATION: "ps,ghost"},
+                        [("ps", 2, 100)])
+        assert read_topology_from_pg_annotations(job) is None
+
+    def test_no_annotations(self):
+        job = build_job("j1", {}, [("ps", 1, 100)])
+        assert read_topology_from_pg_annotations(job) is None
+
+
+class TestBuckets:
+    def test_affinity_tasks_share_bucket(self):
+        job = build_job("j1", {}, [("ps", 1, 100), ("worker", 2, 100)])
+        mgr = JobManager("j1")
+        mgr.apply_task_topology(TaskTopology(affinity=[["ps", "worker"]]))
+        mgr.construct_bucket(job.tasks)
+        assert len(mgr.buckets) == 1
+        assert mgr.bucket_max_size == 3
+
+    def test_self_anti_affinity_splits(self):
+        job = build_job("j1", {}, [("ps", 3, 100)])
+        mgr = JobManager("j1")
+        mgr.apply_task_topology(TaskTopology(anti_affinity=[["ps"]]))
+        mgr.construct_bucket(job.tasks)
+        assert len(mgr.buckets) == 3
+
+    def test_inter_anti_affinity_splits(self):
+        job = build_job("j1", {}, [("ps", 1, 100), ("worker", 1, 100)])
+        mgr = JobManager("j1")
+        mgr.apply_task_topology(TaskTopology(anti_affinity=[["ps", "worker"]]))
+        mgr.construct_bucket(job.tasks)
+        assert len(mgr.buckets) == 2
+
+    def test_untopologized_task_out_of_bucket(self):
+        job = build_job("j1", {}, [("ps", 1, 100), ("other", 1, 100)])
+        mgr = JobManager("j1")
+        mgr.apply_task_topology(TaskTopology(affinity=[["ps"]]))
+        mgr.construct_bucket(job.tasks)
+        out = [t for t in job.tasks.values() if t.task_role == "other"][0]
+        assert mgr.get_bucket(out) is None
+
+
+class TestScheduling:
+    def _run(self, jobs, nodes):
+        binder = FakeBinder()
+        cache = SchedulerCache(binder=binder, evictor=FakeEvictor())
+        cache.add_queue(QueueInfo(name="default", weight=1))
+        for n in nodes:
+            cache.add_node(n)
+        for j in jobs:
+            cache.add_job(j)
+        tiers = [Tier(plugins=[PluginOption("gang"),
+                               PluginOption("predicates"),
+                               PluginOption("task-topology"),
+                               PluginOption("binpack")])]
+        ssn = open_session(cache, tiers, [])
+        AllocateAction(engine="callbacks").execute(ssn)
+        close_session(ssn)
+        return binder
+
+    def test_affinity_mates_land_together(self):
+        job = build_job("j1", {AFFINITY_ANNOTATION: "ps,worker"},
+                        [("ps", 1, 100), ("worker", 2, 100)], min_avail=3)
+        nodes = [NodeInfo(name=f"n{i}",
+                          allocatable=Resource(4000, 4000, max_task_num=10))
+                 for i in range(4)]
+        binder = self._run([job], nodes)
+        assert len(binder.binds) == 3
+        assert len(set(binder.binds.values())) == 1
+
+    def test_anti_affinity_tasks_spread(self):
+        job = build_job("j1", {ANTI_AFFINITY_ANNOTATION: "ps"},
+                        [("ps", 2, 100)], min_avail=2)
+        nodes = [NodeInfo(name=f"n{i}",
+                          allocatable=Resource(4000, 4000, max_task_num=10))
+                 for i in range(2)]
+        binder = self._run([job], nodes)
+        assert len(binder.binds) == 2
+        assert len(set(binder.binds.values())) == 2

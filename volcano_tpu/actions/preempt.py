@@ -103,24 +103,25 @@ class PreemptAction(Action):
                 if assigned:
                     preemptors.push(preemptor_job)
 
-            # Preemption between tasks within one job (preempt.go:146-183).
-            for job in under_request:
-                pq = PriorityQueue(ssn.task_order_fn)
-                for task in job.task_status_index.get(TaskStatus.PENDING,
-                                                      {}).values():
-                    pq.push(task)
-                preemptor_tasks[job.uid] = pq
-                while not preemptor_tasks[job.uid].empty():
-                    preemptor = preemptor_tasks[job.uid].pop()
-                    stmt = ssn.statement()
-                    assigned = self._preempt(
-                        ssn, stmt, preemptor,
-                        lambda task: (task.status == TaskStatus.RUNNING
-                                      and not task.resreq.is_empty()
-                                      and preemptor.job == task.job))
-                    stmt.commit()
-                    if not assigned:
-                        break
+        # Preemption between tasks within one job — ONE pass after the
+        # per-queue loop (preempt.go:146-183 sits outside it).
+        for job in under_request:
+            pq = PriorityQueue(ssn.task_order_fn)
+            for task in job.task_status_index.get(TaskStatus.PENDING,
+                                                  {}).values():
+                pq.push(task)
+            preemptor_tasks[job.uid] = pq
+            while not preemptor_tasks[job.uid].empty():
+                preemptor = preemptor_tasks[job.uid].pop()
+                stmt = ssn.statement()
+                assigned = self._preempt(
+                    ssn, stmt, preemptor,
+                    lambda task: (task.status == TaskStatus.RUNNING
+                                  and not task.resreq.is_empty()
+                                  and preemptor.job == task.job))
+                stmt.commit()
+                if not assigned:
+                    break
 
         self._victim_tasks(ssn)
 

@@ -12,6 +12,12 @@ from .node_info import NodeInfo
 from .queue_info import NamespaceCollection, NamespaceInfo, QueueInfo, QueueSpec
 from .cluster_info import ClusterInfo
 from .unschedule_info import FitError, FitErrors
+from .device_info import (GPU_MEMORY_RESOURCE, GPU_NUMBER_RESOURCE, GPUDevice,
+                          devices_idle_gpu_memory, gpu_memory_of_task,
+                          make_gpu_devices, predicate_gpu)
+from .numa_info import (CPUInfo, NumatopoInfo, ResNumaSets, ResourceInfo,
+                        TopologyHint, generate_node_res_numa_sets,
+                        generate_numa_nodes, get_policy)
 
 __all__ = [
     "CPU", "GPU_RESOURCE_NAME", "INFINITY", "MEMORY", "MIN_RESOURCE", "PODS",
@@ -21,4 +27,9 @@ __all__ = [
     "DisruptionBudget", "JobInfo", "PodGroup", "TaskInfo", "NodeInfo",
     "NamespaceCollection", "NamespaceInfo", "QueueInfo", "QueueSpec",
     "ClusterInfo", "FitError", "FitErrors",
+    "GPU_MEMORY_RESOURCE", "GPU_NUMBER_RESOURCE", "GPUDevice",
+    "devices_idle_gpu_memory", "gpu_memory_of_task", "make_gpu_devices",
+    "predicate_gpu",
+    "CPUInfo", "NumatopoInfo", "ResNumaSets", "ResourceInfo", "TopologyHint",
+    "generate_node_res_numa_sets", "generate_numa_nodes", "get_policy",
 ]

@@ -47,6 +47,7 @@ class TaskInfo:
                  labels: Optional[Dict[str, str]] = None,
                  annotations: Optional[Dict[str, str]] = None,
                  preemptable: bool = False, revocable_zone: str = "",
+                 topology_policy: str = "",
                  creation_timestamp: Optional[float] = None,
                  pod: object = None):
         self.uid = uid or _new_uid("task")
@@ -71,6 +72,9 @@ class TaskInfo:
         self.annotations = dict(annotations or {})
         self.preemptable = preemptable
         self.revocable_zone = revocable_zone
+        # volcano.sh/numa-topology-policy annotation (pod_info.go
+        # TopologyPolicy); consumed by the numaaware plugin.
+        self.topology_policy = topology_policy
         self.creation_timestamp = creation_timestamp if creation_timestamp is not None else _time.time()
         self.pod = pod                      # backing store object, if any
         self.volume_ready = False

@@ -40,8 +40,43 @@ class NodeInfo:
         # ready mirrors NodePhase; nodes flagged not-ready are skipped in
         # Snapshot (cache.go:822-827 analogue handled by the cache layer).
         self.ready = True
-        self.others: Dict[str, object] = {}     # device extensions (GPU/numa)
+        self.others: Dict[str, object] = {}     # device extensions
+        # NumatopoInfo for this node (node_info.go NumaSchedulerInfo),
+        # attached by the cache from Numatopology CRs.
         self.numa_info = None
+        # task uid -> ResNumaSets committed by the numaaware plugin; the
+        # in-process stand-in for the node agent's Numatopology CR resync —
+        # lets the cache release cpusets when the task goes away.
+        self.numa_allocations: Dict[str, dict] = {}
+        # GPU cards (node_info.go:57 GPUDevices). Auto-populated from
+        # volcano.sh/gpu-memory + gpu-number capacity scalars like
+        # NewNodeInfo -> setNodeGPUInfo (node_info.go:102,116), or set
+        # explicitly via set_gpu_info().
+        self.gpu_devices: Dict[int, object] = {}
+        from .device_info import GPU_MEMORY_RESOURCE, GPU_NUMBER_RESOURCE
+        gpu_mem = self.capability.get(GPU_MEMORY_RESOURCE)
+        gpu_num = self.capability.get(GPU_NUMBER_RESOURCE)
+        if gpu_mem > 0 and gpu_num > 0:
+            # scalars are milli-scaled (resource.py from_dict); memory stays
+            # in the milli space so it compares directly with task requests
+            self.set_gpu_info(gpu_mem, int(round(gpu_num / 1000.0)))
+
+    def set_gpu_info(self, total_memory: float, card_count: int) -> None:
+        """node_info.go setNodeGPUInfo:268-291. ``total_memory`` must be in
+        the same (milli-scaled) units as task volcano.sh/gpu-memory
+        requests."""
+        from .device_info import make_gpu_devices
+        self.gpu_devices = make_gpu_devices(total_memory, card_count)
+
+    def _account_gpu(self, task: TaskInfo, add: bool) -> None:
+        from .device_info import (add_gpu_resource, gpu_memory_of_task,
+                                  sub_gpu_resource)
+        if not self.gpu_devices or gpu_memory_of_task(task) <= 0:
+            return
+        if add:
+            add_gpu_resource(self.gpu_devices, task)
+        else:
+            sub_gpu_resource(self.gpu_devices, task)
 
     @property
     def max_task_num(self) -> int:
@@ -84,6 +119,8 @@ class NodeInfo:
         task.node_name = self.name
         ti.node_name = self.name
         self.tasks[ti.uid] = ti
+        if ti.status != TaskStatus.PIPELINED:
+            self._account_gpu(ti, add=True)
 
     def remove_task(self, task: TaskInfo) -> None:
         own = self.tasks.get(task.uid)
@@ -100,6 +137,8 @@ class NodeInfo:
             self.used.sub(own.resreq)
         task.node_name = ""
         del self.tasks[own.uid]
+        if own.status != TaskStatus.PIPELINED:
+            self._account_gpu(own, add=False)
 
     def update_task(self, task: TaskInfo) -> None:
         self.remove_task(task)
@@ -112,9 +151,14 @@ class NodeInfo:
                      annotations=self.annotations)
         n.ready = self.ready
         n.others = dict(self.others)
-        n.numa_info = self.numa_info
+        n.numa_info = self.numa_info.deep_copy() if self.numa_info else None
         for task in self.tasks.values():
             n.add_task(task.clone())
+        # overwrite with exact card assignments (add_task may have re-derived
+        # them in a different order)
+        n.gpu_devices = {i: d.clone() for i, d in self.gpu_devices.items()}
+        n.numa_allocations = {uid: {res: set(ids) for res, ids in sets.items()}
+                              for uid, sets in self.numa_allocations.items()}
         return n
 
     def pods(self) -> List[TaskInfo]:

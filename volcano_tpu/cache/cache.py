@@ -102,7 +102,18 @@ class SchedulerCache:
             if job is not None:
                 job.delete_task_info(task)
             if task.node_name and task.node_name in self.nodes:
-                self.nodes[task.node_name].remove_task(task)
+                node = self.nodes[task.node_name]
+                node.remove_task(task)
+                self._release_numa(node, task.uid)
+
+    @staticmethod
+    def _release_numa(node, task_uid: str) -> None:
+        """Return the task's committed cpusets to the node topology — the
+        in-process equivalent of the node agent refreshing the Numatopology
+        CR after a pod dies (numa_info.go Release)."""
+        sets = node.numa_allocations.pop(task_uid, None)
+        if sets and node.numa_info is not None:
+            node.numa_info.release(sets)
 
     # -- snapshot (cache.go:801-893) ----------------------------------------
 
@@ -203,6 +214,23 @@ class SchedulerCache:
             cached = self.jobs.get(job.uid)
             if cached is not None:
                 cached.podgroup = job.podgroup
+
+    def update_scheduler_numa_info(self, numa_sets) -> None:
+        """Commit cpuset assignments chosen by the numaaware plugin back to
+        the live node topology (cache interface UpdateSchedulerNumaInfo;
+        session.go:435-437). ``numa_sets`` is {node_name: {task_uid:
+        ResNumaSets}}; per-task records let delete_task release them
+        (re-committing a uid first releases its previous assignment, so the
+        writeback is idempotent across sessions)."""
+        with self._lock:
+            for node_name, per_task in numa_sets.items():
+                node = self.nodes.get(node_name)
+                if node is None or node.numa_info is None:
+                    continue
+                for task_uid, res_sets in per_task.items():
+                    self._release_numa(node, task_uid)
+                    node.numa_info.allocate(res_sets)
+                    node.numa_allocations[task_uid] = res_sets
 
     def client(self):
         return None

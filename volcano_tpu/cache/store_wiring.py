@@ -54,6 +54,8 @@ def pod_to_task(pod: Pod) -> TaskInfo:
             "volcano.sh/preemptable", "false") == "true",
         revocable_zone=pod.metadata.annotations.get(
             "volcano.sh/revocable-zone", ""),
+        topology_policy=pod.metadata.annotations.get(
+            "volcano.sh/numa-topology-policy", ""),
         creation_timestamp=pod.metadata.creation_timestamp,
         pod=pod)
 

@@ -384,6 +384,12 @@ class Session:
         self._fire_deallocate(reclaimee)
         self.cache.evict(reclaimee, reason)
 
+    def update_scheduler_numa_info(self, numa_sets) -> None:
+        """session.go:435-437 — forward cpuset assignments to the cache."""
+        update = getattr(self.cache, "update_scheduler_numa_info", None)
+        if update is not None:
+            update(numa_sets)
+
     def update_pod_group_condition(self, job: JobInfo, condition: dict) -> None:
         """Replace the same-type condition (bounded: one entry per type, like
         PodGroup status conditions on the CR); mark dirty only on a real

@@ -5,8 +5,9 @@ Importing this package registers all in-tree plugins.
 
 from ..framework.registry import register_plugin_builder
 from .base import Plugin
-from . import binpack, conformance, drf, gang, nodeorder, overcommit
-from . import predicates, priority, proportion, reservation, sla, tdm
+from . import binpack, conformance, drf, gang, nodeorder, numaaware, overcommit
+from . import predicates, priority, proportion, reservation, sla
+from . import task_topology, tdm
 
 register_plugin_builder("gang", gang.New)
 register_plugin_builder("priority", priority.New)
@@ -20,5 +21,7 @@ register_plugin_builder("overcommit", overcommit.New)
 register_plugin_builder("sla", sla.New)
 register_plugin_builder("tdm", tdm.New)
 register_plugin_builder("reservation", reservation.New)
+register_plugin_builder("task-topology", task_topology.New)
+register_plugin_builder("numa-aware", numaaware.New)
 
 __all__ = ["Plugin"]

@@ -1,7 +1,9 @@
 """Predicates plugin: hard feasibility filters.
 
 Mirrors /root/reference/pkg/scheduler/plugins/predicates/predicates.go:80-362
-(task-count limit, node-unschedulable, node affinity/selector, taints) —
+(task-count limit, node-unschedulable, node affinity/selector, taints,
+optional GPU-sharing predicate gpu.go:1-56, proportional scarce-resource
+guard proportional.go:1-44, predicate cache cache.go:1-88) —
 re-architected for the device path: every static filter contributes to one
 ``bool[T,N]`` feasibility mask (assembled in cache/snapshot.py) so the
 placement kernels never call back to the host. The host PredicateFn remains
@@ -13,13 +15,20 @@ in-kernel because it depends on mutable node state.
 
 from __future__ import annotations
 
+from typing import Dict, Tuple
+
 import numpy as np
 
 from ..api import FitError
+from ..api.device_info import (devices_idle_matrix, gpu_memory_of_task,
+                               predicate_gpu)
 from ..api.types import (NODE_AFFINITY_FAILED, NODE_POD_NUMBER_EXCEEDED,
                          NODE_UNSCHEDULABLE, TAINTS_UNTOLERATED)
 from .base import Plugin
 from .nodeorder import _toleration_matches, match_node_selector_terms
+
+GPU_SHARING_FAILED = "node(s) didn't have a gpu card with enough memory"
+PROPORTIONAL_FAILED = "proportional resource check failed"
 
 
 def node_selector_ok(task, node) -> bool:
@@ -46,6 +55,28 @@ def taints_tolerated(task, node) -> bool:
     return True
 
 
+def proportional_ok(task, node, rates: Dict[str, Tuple[float, float]]) -> bool:
+    """predicates/proportional.go checkNodeResourceIsProportional — refuse
+    placements that would starve the CPU/memory needed to drive the node's
+    idle scarce resource (e.g. GPUs). ``rates`` maps resource name ->
+    (milli-cpu per unit, bytes per unit)."""
+    for rname in rates:
+        if task.resreq.get(rname) > 0:
+            return True
+    for rname, (cpu_rate, mem_rate) in rates.items():
+        idle_scalar = node.idle.get(rname)
+        if idle_scalar <= 0:
+            continue
+        units = idle_scalar / 1000.0        # scalars are stored milli-scaled
+        cpu_reserved = units * cpu_rate
+        mem_reserved = units * mem_rate
+        remaining_cpu = node.idle.cpu - task.resreq.cpu
+        remaining_mem = node.idle.memory - task.resreq.memory
+        if remaining_cpu < cpu_reserved or remaining_mem < mem_reserved:
+            return False
+    return True
+
+
 class PredicatesPlugin(Plugin):
     NAME = "predicates"
 
@@ -55,6 +86,35 @@ class PredicatesPlugin(Plugin):
         self.node_affinity_enable = args.get_bool("predicate.NodeAffinityEnable", True)
         self.taint_enable = args.get_bool("predicate.TaintTolerationEnable", True)
         self.pod_number_enable = args.get_bool("predicate.PodNumberEnable", True)
+        # optional sub-predicates (predicates.go:88-110), off by default like
+        # the reference
+        self.gpu_sharing_enable = args.get_bool("predicate.GPUSharingEnable", False)
+        self.cache_enable = args.get_bool("predicate.CacheEnable", False)
+        self.proportional_enable = args.get_bool("predicate.ProportionalEnable", False)
+        # predicate.proportional.resources: "nvidia.com/gpu" with
+        # .cpu (cores per unit) and .memory (Gi per unit) sub-keys
+        # (proportional.go rates; stored here as milli-cpu/bytes per unit)
+        self.proportional: Dict[str, Tuple[float, float]] = {}
+        for rname in str(args.get("predicate.proportional.resources", "")).split(","):
+            rname = rname.strip()
+            if rname:
+                cpu_rate = args.get_float(f"predicate.proportional.resources.{rname}.cpu", 0.0)
+                mem_rate = args.get_float(f"predicate.proportional.resources.{rname}.memory", 0.0)
+                self.proportional[rname] = (cpu_rate * 1000.0,
+                                            mem_rate * 1024 ** 3)
+        # per-session predicate cache: (node, task equivalence sig) -> reason
+        # or None (predicates/cache.go PredicateWithCache)
+        self._cache: Dict[Tuple[str, Tuple], object] = {}
+
+    @staticmethod
+    def _task_signature(task) -> Tuple:
+        """Equivalence class of a task for predicate caching — only what the
+        CACHEABLE (node-static) predicates read (cache.go caches per
+        pod-template). GPU-share and proportional checks read mutable node
+        state and are never cached."""
+        return (tuple(sorted(task.node_selector.items())),
+                repr(task.affinity) if task.affinity else "",
+                tuple(repr(t) for t in task.tolerations))
 
     def predicate(self, task, node) -> None:
         if self.pod_number_enable and node.max_task_num:
@@ -62,17 +122,54 @@ class PredicatesPlugin(Plugin):
                 raise PredicateError(task, node, NODE_POD_NUMBER_EXCEEDED)
         if node.unschedulable:
             raise PredicateError(task, node, NODE_UNSCHEDULABLE)
+
+        if self.cache_enable:
+            key = (node.name, self._task_signature(task))
+            cached = self._cache.get(key)
+            if cached is None:
+                try:
+                    self._static_predicates(task, node)
+                except PredicateError as err:
+                    self._cache[key] = err.fit_error.reasons[0]
+                    raise
+                self._cache[key] = True
+            elif cached is not True:
+                raise PredicateError(task, node, cached)
+        else:
+            self._static_predicates(task, node)
+        self._stateful_predicates(task, node)
+
+    def _static_predicates(self, task, node) -> None:
+        """Predicates over immutable node/task attributes — safe to cache."""
         if self.node_affinity_enable and not node_selector_ok(task, node):
             raise PredicateError(task, node, NODE_AFFINITY_FAILED)
         if self.taint_enable and not taints_tolerated(task, node):
             raise PredicateError(task, node, TAINTS_UNTOLERATED)
+
+    def _stateful_predicates(self, task, node) -> None:
+        """Predicates over mutable node usage — evaluated every call."""
+        if self.gpu_sharing_enable and gpu_memory_of_task(task) > 0:
+            # gpu.go checkNodeGPUSharingPredicate: some single card must fit
+            if not node.gpu_devices or predicate_gpu(task, node.gpu_devices) is None:
+                raise PredicateError(task, node, GPU_SHARING_FAILED)
+        if self.proportional_enable and self.proportional:
+            if not proportional_ok(task, node, self.proportional):
+                raise PredicateError(task, node, PROPORTIONAL_FAILED)
 
     def feasibility_mask(self, ssn, tasks, node_t):
         node_infos = [ssn.nodes[name] for name in node_t.names]
         T, N = len(tasks), len(node_infos)
         any_taints = any(n.taints for n in node_infos)   # O(N), once
         any_unsched = any(n.unschedulable for n in node_infos)
-        if (not any_taints and not any_unsched
+        gpu_reqs = None
+        if self.gpu_sharing_enable:
+            gpu_reqs = np.asarray([gpu_memory_of_task(t) for t in tasks],
+                                  np.float32)
+            if not gpu_reqs.any():
+                gpu_reqs = None
+        prop_needed = bool(self.proportional_enable and self.proportional)
+        if (not any_taints and not any_unsched and gpu_reqs is None
+                and not prop_needed
                 and not any(t.node_selector or t.affinity for t in tasks)):
             return None                                  # all-true mask
         mask = np.ones((T, N), dtype=bool)
@@ -88,9 +185,22 @@ class PredicatesPlugin(Plugin):
                     mask[ti, ni] = False
                 elif self.taint_enable and not taints_tolerated(task, node):
                     mask[ti, ni] = False
+        if gpu_reqs is not None:
+            # feasible iff the node's best card fits the request (gpu.go)
+            best_card = devices_idle_matrix(node_infos).max(axis=1)  # f32[N]
+            gpu_mask = (gpu_reqs[:, None] <= 0) | \
+                (best_card[None, :] >= gpu_reqs[:, None])
+            mask &= gpu_mask
+        if prop_needed:
+            for ni, node in enumerate(node_infos):
+                for ti, task in enumerate(tasks):
+                    if mask[ti, ni] and not proportional_ok(
+                            task, node, self.proportional):
+                        mask[ti, ni] = False
         return mask
 
     def on_session_open(self, ssn) -> None:
+        self._cache = {}
         ssn.add_predicate_fn(self.NAME, self.predicate)
         ssn.add_feasibility_fn(self.NAME, self.feasibility_mask)
 
