@@ -186,3 +186,49 @@ class TestEngineParity:
                                 for k in binder.binds}
         assert admitted["callbacks"] == admitted["tpu-strict"]
         assert admitted["callbacks"] == admitted["tpu-fused"]
+
+
+class TestStatefulPredicateRecheck:
+    """Batched engines must re-validate device proposals through stateful
+    predicates (gpu card packing): the static feasibility mask sees only
+    pre-placement card state, so a gang whose aggregate fits but whose
+    per-card packing doesn't must lose the overflow task at replay
+    (predicates/gpu.go checkNodeGPUSharingPredicate semantics)."""
+
+    def _gpu_case(self, engine):
+        from volcano_tpu.api.device_info import GPU_MEMORY_RESOURCE
+        pg = PodGroup(name="g", queue="default", min_member=2,
+                      phase=PodGroupPhase.INQUEUE)
+        job = JobInfo(uid="g", name="g", queue="default", min_available=2,
+                      podgroup=pg)
+        for i, mem in enumerate([3000, 3000, 2000]):
+            job.add_task_info(TaskInfo(
+                uid=f"g-{i}", name=f"g-{i}", job="g",
+                resreq=Resource(100, 100,
+                                scalars={GPU_MEMORY_RESOURCE: mem}),
+                creation_timestamp=float(i)))
+        alloc = Resource(8000, 8000, scalars={GPU_MEMORY_RESOURCE: 8000.0})
+        alloc.max_task_num = 100
+        node = NodeInfo(name="n1", allocatable=alloc)
+        node.set_gpu_info(8000, 2)            # 2 cards x 4000
+        cache, binder = build_cache([job], [node])
+        tiers = [
+            Tier(plugins=[PluginOption("gang")]),
+            Tier(plugins=[
+                PluginOption("predicates", arguments=Arguments(
+                    {"predicate.GPUSharingEnable": True})),
+                PluginOption("proportion"), PluginOption("nodeorder"),
+                PluginOption("binpack")]),
+        ]
+        run_allocate(cache, engine, tiers=tiers)
+        return binder, node
+
+    @pytest.mark.parametrize("engine", ENGINES)
+    def test_per_card_invariant(self, engine):
+        binder, node = self._gpu_case(engine)
+        # 3000+3000 fill both 4000-cards to 1000 idle; the 2000 task must
+        # NOT bind even though aggregate scalar idle (2000) would fit it
+        assert len(binder.binds) == 2, binder.binds
+        assert "default/g-2" not in binder.binds
+        used = [d.used_memory() for d in node.gpu_devices.values()]
+        assert sorted(used) == [3000, 3000]

@@ -73,8 +73,10 @@ class AllocateAction(Action):
             _execute_interleaved(ssn, _CallbackJobPlacer(ssn))
         elif engine == "tpu-strict":
             _execute_interleaved(ssn, _DeviceJobPlacer(ssn))
-        elif engine in ("tpu-fused", "tpu-blocks"):
-            _execute_fused(ssn, blocks=(engine == "tpu-blocks"))
+        elif engine in ("tpu-fused", "tpu-blocks", "tpu-scan", "tpu-pallas"):
+            _execute_fused(ssn, blocks=(engine == "tpu-blocks"),
+                           kernel={"tpu-scan": "scan",
+                                   "tpu-pallas": "pallas"}.get(engine, "auto"))
         else:
             raise ValueError(f"unknown allocate engine {engine!r}")
 
@@ -296,15 +298,19 @@ class _DeviceJobPlacer:
         # Replay picks through the Statement for host bookkeeping. All tasks
         # are consumed — the reference pops each task from its queue exactly
         # once per cycle whether or not it placed (allocate.go:187-223).
+        recheck = bool(self.ssn.stateful_predicates)
         for i, task in enumerate(tasks):
             n = int(task_node[i])
             if n == NO_NODE:
                 continue
             node_name = self.node_t.names[n]
+            node = self.ssn.nodes[node_name]
+            if recheck and not _stateful_recheck(self.ssn, task, node):
+                continue
             if pipelined[i]:
                 stmt.pipeline(task, node_name)
             else:
-                stmt.allocate(task, self.ssn.nodes[node_name])
+                stmt.allocate(task, node)
         tasks.clear()
         return False
 
@@ -404,7 +410,8 @@ def _fixed_job_order(ssn, assumed_admitted: Optional[set] = None) -> List:
     return ordered
 
 
-def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4) -> None:
+def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4,
+                   kernel: str = "auto") -> None:
     """Fused executor: iterate (order simulation → one device solve) until
     the admitted-job set stabilizes, then replay the final solve through
     Statements. Convergence is usually immediate; gang rollbacks trigger one
@@ -416,7 +423,7 @@ def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4) -> None:
         ordered_jobs = _fixed_job_order(ssn, assumed)
         if not ordered_jobs:
             return
-        solution = _solve_fused(ssn, ordered_jobs, blocks)
+        solution = _solve_fused(ssn, ordered_jobs, blocks, kernel)
         if solution is None:
             return
         kept_uids = {solution.jobs_list[jx].uid
@@ -445,7 +452,7 @@ class _FusedSolution:
         self.job_kept = job_kept
 
 
-def _solve_fused(ssn, ordered_jobs, blocks: bool):
+def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto"):
     import jax.numpy as jnp
     from ..ops.place import JobMeta, PlacementTasks
     from ..ops.auction import BlockTasks
@@ -492,6 +499,43 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool):
         base_pipelined=jnp.asarray([j.waiting_task_num() for j in jobs_list],
                                    jnp.int32))
 
+    from ..ops import pallas_place
+    use_pallas = (not blocks and kernel != "scan"
+                  and pallas_place.supported(len(rnames), N)
+                  and (kernel == "pallas"
+                       or not pallas_place.use_interpret()))
+    # auto mode picks the pallas kernel only on a real TPU backend (interpret
+    # mode would run the fori_loop in pure python); an unsupported shape
+    # (>8 resource dims, >32k nodes) falls back to the scan kernel even when
+    # pallas is forced.
+    if use_pallas:
+        # VMEM-resident placement kernel (ops/pallas_place.py): the whole
+        # sequential loop in one pallas_call, node state never leaving VMEM.
+        if feas is None and static is None:
+            ms = pallas_place.neutral_masked_static(
+                *pallas_place.padded_shape(T, N), T, N)
+        else:
+            f = np.ones((T, N), bool) if feas is None else feas
+            s = np.zeros((T, N), np.float32) if static is None else static
+            ms = np.where(f, s, pallas_place.NEG).astype(np.float32)
+        res = pallas_place.place_pallas(
+            node_t.idle,
+            node_t.idle + node_t.releasing - node_t.pipelined,
+            node_t.used, node_t.ntasks.astype(np.float32),
+            node_t.allocatable, node_t.max_tasks.astype(np.float32),
+            req, job_ix_np, ms,
+            np.asarray(jobs_meta.min_available),
+            np.asarray(jobs_meta.base_ready),
+            np.asarray(jobs_meta.base_pipelined),
+            np.asarray(weights.binpack_res),
+            binpack_weight=float(weights.binpack_weight),
+            least_weight=float(weights.least_req_weight),
+            most_weight=float(weights.most_req_weight),
+            balanced_weight=float(weights.balanced_weight))
+        return _FusedSolution(tasks, job_ix_np, jobs_list, node_t,
+                              res.task_node, res.task_pipelined,
+                              res.job_ready, res.job_kept)
+
     feas_b = (jnp.ones((T, N), bool) if feas is None else jnp.asarray(feas))
     static_b = (jnp.zeros((T, N), jnp.float32) if static is None
                 else jnp.asarray(static))
@@ -527,12 +571,27 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool):
                           pipelined, job_ready, job_kept)
 
 
+def _stateful_recheck(ssn, task, node) -> bool:
+    """Re-validate a device proposal through the stateful predicate chain
+    (gpu card packing, numa cpusets — anything that mutates as the cycle
+    allocates). The static feasibility mask shipped to the device is
+    necessary but not sufficient for these; the callbacks engine evaluates
+    them per placement, so batched engines must too. Only called when a
+    plugin registered itself in ssn.stateful_predicates."""
+    try:
+        ssn.predicate_fn(task, node)
+        return True
+    except Exception:
+        return False
+
+
 def _replay_fused(ssn, sol: _FusedSolution) -> None:
     """Replay device decisions through Statements, job by job, preserving
     gang atomicity on the host model (statement.go semantics)."""
     per_job_tasks: Dict[int, List[int]] = {}
     for i, jx in enumerate(sol.job_ix):
         per_job_tasks.setdefault(int(jx), []).append(i)
+    recheck = bool(ssn.stateful_predicates)
 
     for jx, task_ids in per_job_tasks.items():
         if not sol.job_kept[jx]:
@@ -544,10 +603,13 @@ def _replay_fused(ssn, sol: _FusedSolution) -> None:
             if n == NO_NODE:
                 continue
             name = sol.node_t.names[n]
+            node = ssn.nodes[name]
+            if recheck and not _stateful_recheck(ssn, sol.tasks[i], node):
+                continue
             if sol.pipelined[i]:
                 stmt.pipeline(sol.tasks[i], name)
             else:
-                stmt.allocate(sol.tasks[i], ssn.nodes[name])
+                stmt.allocate(sol.tasks[i], node)
         if ssn.job_ready(job):
             stmt.commit()
         elif not ssn.job_pipelined(job):

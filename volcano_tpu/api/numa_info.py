@@ -166,12 +166,14 @@ class NumatopoInfo:
             res_reserved=dict(self.res_reserved))
 
     def compare(self, new: "NumatopoInfo") -> bool:
-        """numa_info.go Compare: True iff allocatable is not shrinking."""
+        """numa_info.go Compare: True iff no resource's allocatable set is
+        shrinking in ``new`` (a shrink means running pods must be re-checked
+        against the tighter topology)."""
         for res, info in self.numa_res_map.items():
             new_info = new.numa_res_map.get(res)
-            if new_info is not None and len(info.allocatable) <= len(new_info.allocatable):
-                return True
-        return False
+            if new_info is None or len(new_info.allocatable) < len(info.allocatable):
+                return False
+        return True
 
     def allocate(self, res_sets: ResNumaSets) -> None:
         """numa_info.go Allocate:106-110."""

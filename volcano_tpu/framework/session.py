@@ -98,6 +98,10 @@ class Session:
         self.feasibility_fns: Dict[str, Callable] = {}
         self.static_score_fns: Dict[str, Callable] = {}
         self.dynamic_score_weights: Dict[str, dict] = {}
+        # plugins whose predicate depends on state mutated during the cycle
+        # (gpu card packing, numa cpusets): batched engines must re-validate
+        # device proposals through predicate_fn at replay time
+        self.stateful_predicates: set = set()
 
     # -- registration helpers (AddXxxFn of session_plugins.go) --------------
 

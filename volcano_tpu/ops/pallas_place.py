@@ -1,0 +1,434 @@
+"""Pallas TPU kernel: the whole allocate loop as ONE on-chip program.
+
+``ops/place.place_scan`` expresses the reference's sequential allocate loop
+(/root/reference/pkg/scheduler/actions/allocate/allocate.go:42-277 with
+Statement gang atomicity, statement.go:229-395) as a ``lax.scan``. That is
+correct but pays XLA loop overhead per task: at 10k tasks the scan's serial
+dimension dominates wall-clock.
+
+This kernel removes that overhead by keeping ALL mutable node state
+(idle/future_idle/used/ntasks, plus the Statement snapshot copies) resident
+in VMEM scratch for the entire solve:
+
+- layout: node state is ``f32[R_pad, N_pad]`` (resources on sublanes, nodes
+  on lanes) so every per-task op is a handful of 8x128-lane VPU ops;
+- grid: sequential chunks of C tasks; Pallas DMAs the next chunk's
+  feasibility+static-score block ``[C, N_pad]`` into VMEM while the current
+  chunk computes (automatic double buffering); VMEM scratch persists across
+  the sequential TPU grid, so node state never round-trips to HBM;
+- per task: fit mask vs future-idle, the dynamic scorers of ops/scores.py
+  (binpack / least-allocated / most-allocated / balanced), masked argmax
+  with lowest-index tie-break, allocate-vs-pipeline, gang counters;
+- per job boundary: gang vote and commit/rollback by copying the saved VMEM
+  snapshot back — Statement.Commit/Discard entirely on-chip.
+
+Statically infeasible (task, node) pairs are encoded as ``NEG`` in the
+static-score matrix, which fuses the ``feas`` mask and ``static_score``
+inputs of place_scan into one f32 array (halves HBM traffic).
+
+Falls back to interpret mode off-TPU so unit tests run on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+NEG = -1e30          # static-infeasible sentinel (avoids inf arithmetic)
+NEG_TEST = -1e29     # anything below this is infeasible
+NO_NODE = -1
+
+# out_flags bits
+F_PLACE = 1
+F_PIPE = 2
+F_READY = 4
+F_KEEP = 8
+
+# in flags bits
+_VALID = 1
+_FIRST = 2
+_LAST = 4
+
+R_PAD = 8            # resource rows (f32 sublane tile); >8 falls back to scan
+LANE = 128
+
+
+def _kernel(req_s, flags_s, rdy_s, keep_s, ws_s,
+            ms_ref, idle0, fidle0, used0, nt0, alloc_ref, maxt_ref, rw_ref,
+            out_node, out_flags, fin_idle, fin_fidle, fin_used, fin_nt,
+            t_idle, t_fidle, t_used, t_nt,
+            s_idle, s_fidle, s_used, s_nt,
+            cnt, row_node, row_flags):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    g = pl.program_id(0)
+    C = row_node.shape[1]
+    N = t_idle.shape[1]
+
+    @pl.when(g == 0)
+    def _():
+        t_idle[...] = idle0[...]
+        t_fidle[...] = fidle0[...]
+        t_used[...] = used0[...]
+        t_nt[...] = nt0[...]
+        s_idle[...] = idle0[...]
+        s_fidle[...] = fidle0[...]
+        s_used[...] = used0[...]
+        s_nt[...] = nt0[...]
+        cnt[0] = 0
+        cnt[1] = 0
+        cnt[2] = 0
+
+    row_node[...] = jnp.full((1, C), NO_NODE, jnp.int32)
+    row_flags[...] = jnp.zeros((1, C), jnp.int32)
+
+    lane_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
+    lane_c = jax.lax.broadcasted_iota(jnp.int32, (1, C), 1)
+
+    bw = ws_s[0, 0]
+    lw = ws_s[0, 1]
+    mw = ws_s[0, 2]
+    balw = ws_s[0, 3]
+
+    def scal(ref, i):                    # (1,1,X) SMEM chunk-row scalar read
+        return ref[0, 0, i]
+
+    alloc = alloc_ref[...]                       # [R,N] constant per solve
+    alloc_pos = alloc > 0.0
+    alloc_safe = jnp.where(alloc_pos, alloc, 1.0)
+    rw = rw_ref[...]
+    maxt = maxt_ref[...]
+
+    def body(i, carry):
+        f = scal(flags_s, i)
+        valid = (f & _VALID) != 0
+        firstj = (f & _FIRST) != 0
+        lastj = (f & _LAST) != 0
+
+        # Job boundary open: Statement snapshot (statement.go:229 Allocate
+        # records ops; here the undo-log is "restore the VMEM copy").
+        @pl.when(firstj)
+        def _():
+            s_idle[...] = t_idle[...]
+            s_fidle[...] = t_fidle[...]
+            s_used[...] = t_used[...]
+            s_nt[...] = t_nt[...]
+            cnt[0] = 0
+            cnt[1] = 0
+            cnt[2] = 0
+
+        attempt = jnp.logical_and(valid, cnt[2] == 0)
+
+        @pl.when(attempt)
+        def _():
+            # req column: scalars from SMEM broadcast to [R,N]
+            reqb = jnp.concatenate(
+                [jnp.full((1, N), scal(req_s, i * R_PAD + r), jnp.float32)
+                 for r in range(R_PAD)], axis=0)
+
+            idle = t_idle[...]
+            fidle = t_fidle[...]
+            used = t_used[...]
+            ms = ms_ref[pl.ds(i, 1), :]                       # [1,N]
+
+            fit_fut = (jnp.all(reqb <= fidle, axis=0, keepdims=True)
+                       & (ms > NEG_TEST) & (t_nt[...] < maxt))
+            has = jnp.any(fit_fut)
+            # reference breaks the job's task loop when nothing fits
+            # (allocate.go:206-210)
+            cnt[2] = jnp.where(has, cnt[2], 1)
+
+            @pl.when(has)
+            def _():
+                req_pos = reqb > 0.0
+                used_f = used + reqb
+                # binpack (binpack.go:196-260)
+                per = jnp.where(req_pos & (rw > 0.0) & (used_f <= alloc)
+                                & alloc_pos,
+                                used_f * rw / alloc_safe, 0.0)
+                wsum = jnp.sum(jnp.where(req_pos, rw, 0.0), axis=0,
+                               keepdims=True)
+                binp = jnp.where(wsum > 0.0,
+                                 jnp.sum(per, axis=0, keepdims=True) / wsum,
+                                 0.0) * 100.0 * bw
+                # least-allocated (nodeorder.go:179-190), cpu/mem rows
+                frac_l = jnp.clip(jnp.where(alloc_pos,
+                                            (alloc - used_f) / alloc_safe,
+                                            0.0), 0.0, 1.0)
+                least = jnp.mean(frac_l[0:2, :], axis=0,
+                                 keepdims=True) * 100.0
+                # most-allocated (nodeorder.go:195-202)
+                frac_m = jnp.where(alloc_pos, used_f / alloc_safe, 0.0)
+                frac_m = jnp.where(frac_m > 1.0, 0.0, frac_m)
+                most = jnp.mean(frac_m[0:2, :], axis=0, keepdims=True) * 100.0
+                # balanced allocation (k8s NodeResourcesBalancedAllocation)
+                frac_b = jnp.clip(jnp.where(alloc_pos, used_f / alloc_safe,
+                                            0.0), 0.0, 1.0)[0:2, :]
+                mean_b = jnp.mean(frac_b, axis=0, keepdims=True)
+                std_b = jnp.sqrt(jnp.mean((frac_b - mean_b) ** 2, axis=0,
+                                          keepdims=True))
+                bal = (1.0 - std_b) * 100.0
+
+                score = ms + binp + lw * least + mw * most + balw * bal
+                masked = jnp.where(fit_fut, score, NEG)
+                mval = jnp.max(masked)
+                best = jnp.min(jnp.where(masked == mval, lane_n, N))
+
+                fit_idle = (jnp.all(reqb <= idle, axis=0, keepdims=True)
+                            & fit_fut)
+                onehot_i = (lane_n == best).astype(jnp.int32)
+                do_alloc = jnp.sum(onehot_i * fit_idle.astype(jnp.int32)) > 0
+
+                onehot = onehot_i.astype(jnp.float32)         # [1,N]
+                delta = reqb * onehot                          # [R,N]
+                af = jnp.where(do_alloc, 1.0, 0.0)
+                t_idle[...] = idle - delta * af
+                t_used[...] = used + delta * af
+                # pipeline reserves future resources only (node_info.go
+                # AddTask Pipelined); allocate consumes idle too
+                t_fidle[...] = fidle - delta
+                t_nt[...] = t_nt[...] + onehot
+                cnt[0] = cnt[0] + jnp.where(do_alloc, 1, 0)
+                cnt[1] = cnt[1] + jnp.where(do_alloc, 0, 1)
+
+                here = lane_c == i
+                row_node[...] = jnp.where(here, best, row_node[...])
+                row_flags[...] = row_flags[...] | jnp.where(
+                    here, F_PLACE + jnp.where(do_alloc, 0, F_PIPE), 0)
+
+        # Job boundary close: gang vote (gang.go jobReadyFn) ->
+        # Statement.Commit / Discard.
+        @pl.when(jnp.logical_and(lastj, valid))
+        def _():
+            ready = cnt[0] >= scal(rdy_s, i)
+            keepv = jnp.logical_or(ready, (cnt[0] + cnt[1]) >= scal(keep_s, i))
+            row_flags[...] = row_flags[...] | jnp.where(
+                lane_c == i,
+                jnp.where(ready, F_READY, 0) | jnp.where(keepv, F_KEEP, 0),
+                0)
+
+            @pl.when(jnp.logical_not(keepv))
+            def _():
+                t_idle[...] = s_idle[...]
+                t_fidle[...] = s_fidle[...]
+                t_used[...] = s_used[...]
+                t_nt[...] = s_nt[...]
+
+        return carry
+
+    import jax.lax
+    jax.lax.fori_loop(0, C, body, 0)
+
+    out_node[0] = row_node[...]
+    out_flags[0] = row_flags[...]
+    fin_idle[...] = t_idle[...]
+    fin_fidle[...] = t_fidle[...]
+    fin_used[...] = t_used[...]
+    fin_nt[...] = t_nt[...]
+
+
+def use_interpret() -> bool:
+    """True when the kernel would run in (slow) interpret mode — callers use
+    this to prefer the XLA scan path off-TPU."""
+    import jax
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+@functools.lru_cache(maxsize=32)
+def _build(G: int, C: int, N_pad: int, interpret: bool):
+    """Compile the kernel for (grid, chunk, node) bucket shapes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T_pad = G * C
+    grid = (G,)
+    smem = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
+    vmem = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    full_rn = vmem((R_PAD, N_pad), lambda g: (0, 0))
+    full_1n = vmem((1, N_pad), lambda g: (0, 0))
+    # per-chunk scalar rows are (G, 1, X) arrays with (1, 1, X) blocks: the
+    # trailing two block dims then equal the array dims, which Mosaic requires
+    chunk_row = lambda X, space: space((1, 1, X), lambda g: (g, 0, 0))
+
+    call = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            chunk_row(C * R_PAD, smem),                  # req scalars
+            chunk_row(C, smem),                          # flags
+            chunk_row(C, smem),                          # ready_need
+            chunk_row(C, smem),                          # keep_need
+            smem((1, 8), lambda g: (0, 0)),              # scorer weights
+            vmem((C, N_pad), lambda g: (g, 0)),          # masked static score
+            full_rn, full_rn, full_rn, full_1n,          # idle/fidle/used/nt
+            full_rn,                                     # allocatable
+            full_1n,                                     # max_tasks
+            full_rn,                                     # binpack res weights
+        ],
+        out_specs=[
+            chunk_row(C, vmem),                          # node picks
+            chunk_row(C, vmem),                          # flags out
+            full_rn, full_rn, full_rn, full_1n,          # final state
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((G, 1, C), jnp.int32),
+            jax.ShapeDtypeStruct((G, 1, C), jnp.int32),
+            jax.ShapeDtypeStruct((R_PAD, N_pad), jnp.float32),
+            jax.ShapeDtypeStruct((R_PAD, N_pad), jnp.float32),
+            jax.ShapeDtypeStruct((R_PAD, N_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, N_pad), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((R_PAD, N_pad), jnp.float32),     # tent idle
+            pltpu.VMEM((R_PAD, N_pad), jnp.float32),     # tent future idle
+            pltpu.VMEM((R_PAD, N_pad), jnp.float32),     # tent used
+            pltpu.VMEM((1, N_pad), jnp.float32),         # tent ntasks
+            pltpu.VMEM((R_PAD, N_pad), jnp.float32),     # saved idle
+            pltpu.VMEM((R_PAD, N_pad), jnp.float32),     # saved future idle
+            pltpu.VMEM((R_PAD, N_pad), jnp.float32),     # saved used
+            pltpu.VMEM((1, N_pad), jnp.float32),         # saved ntasks
+            pltpu.SMEM((4,), jnp.int32),                 # cnt_alloc/pipe/broken
+            pltpu.VMEM((1, C), jnp.int32),               # out row: node
+            pltpu.VMEM((1, C), jnp.int32),               # out row: flags
+        ],
+        interpret=interpret,
+    )
+    return jax.jit(call)
+
+
+class PallasPlacement(NamedTuple):
+    task_node: np.ndarray      # i32[T] chosen node or NO_NODE (kept jobs only)
+    task_pipelined: np.ndarray  # bool[T]
+    job_ready: np.ndarray      # bool[J]
+    job_kept: np.ndarray       # bool[J]
+    idle: np.ndarray           # f32[N,R] final committed state
+    future_idle: np.ndarray
+    used: np.ndarray
+    ntasks: np.ndarray
+
+
+def supported(num_resources: int, num_nodes: int) -> bool:
+    """VMEM bound: ~9 [8, N] f32 buffers + one [C, N] block must fit 16MB."""
+    return num_resources <= R_PAD and num_nodes <= 32768
+
+
+def padded_shape(T: int, N: int, chunk: int = 128) -> Tuple[int, int]:
+    """(T_pad, N_pad) the kernel buckets (T, N) to — for callers that build
+    the masked-static matrix on device."""
+    G = 1 << (max(1, -(-T // chunk)) - 1).bit_length()
+    return G * chunk, -(-max(N, LANE) // LANE) * LANE
+
+
+@functools.lru_cache(maxsize=16)
+def neutral_masked_static(T_pad: int, N_pad: int, T: int, N: int):
+    """Device-resident all-feasible/zero-score matrix with NEG padding —
+    avoids shipping O(T*N) floats over PCIe/tunnel when no plugin registers
+    static feasibility or score terms (the default conf)."""
+    import jax.numpy as jnp
+    ms = jnp.zeros((T_pad, N_pad), jnp.float32)
+    ms = ms.at[:, N:].set(NEG)
+    ms = ms.at[T:, :].set(NEG)
+    ms.block_until_ready()
+    return ms
+
+
+def place_pallas(idle: np.ndarray, future_idle: np.ndarray, used: np.ndarray,
+                 ntasks: np.ndarray, allocatable: np.ndarray,
+                 max_tasks: np.ndarray,
+                 req: np.ndarray, job_ix: np.ndarray,
+                 masked_static: np.ndarray,
+                 min_available: np.ndarray, base_ready: np.ndarray,
+                 base_pipelined: np.ndarray,
+                 binpack_res: np.ndarray,
+                 binpack_weight: float = 1.0, least_weight: float = 1.0,
+                 most_weight: float = 0.0, balanced_weight: float = 1.0,
+                 chunk: int = 128) -> PallasPlacement:
+    """Sequential-parity placement, fully on-chip.
+
+    idle/future_idle/used/allocatable: f32[N,R]; ntasks/max_tasks: [N];
+    req: f32[T,R]; job_ix: i32[T] (tasks of a job contiguous);
+    masked_static: f32[T,N] with NEG where statically infeasible;
+    min_available/base_ready/base_pipelined: i32[J].
+    """
+    T, R = req.shape
+    N = idle.shape[0]
+    assert R <= R_PAD, f"{R} resource dims > {R_PAD}; use place_scan"
+    G = max(1, -(-T // chunk))
+    G = 1 << (G - 1).bit_length()                 # pow2 buckets: few recompiles
+    T_pad = G * chunk
+    N_pad = -(-max(N, LANE) // LANE) * LANE
+
+    def padRN(a):                                  # [N,R] -> [R_PAD, N_pad]
+        out = np.zeros((R_PAD, N_pad), np.float32)
+        out[:R, :N] = a.T
+        return out
+
+    req_s = np.zeros((T_pad, R_PAD), np.float32)
+    req_s[:T, :R] = req
+    job_ix = np.asarray(job_ix, np.int32)
+    first = np.zeros(T_pad, bool)
+    last = np.zeros(T_pad, bool)
+    if T:
+        first[0] = True
+        first[1:T] = job_ix[1:] != job_ix[:-1]
+        last[:T - 1] = job_ix[1:] != job_ix[:-1]
+        last[T - 1] = True
+    flags = np.zeros(T_pad, np.int32)
+    flags[:T] = _VALID
+    flags |= first * _FIRST + last * _LAST
+
+    rdy = np.zeros(T_pad, np.int32)
+    keep = np.zeros(T_pad, np.int32)
+    rdy[:T] = (min_available - base_ready)[job_ix]
+    keep[:T] = (min_available - base_ready - base_pipelined)[job_ix]
+
+    if hasattr(masked_static, "devices") \
+            and masked_static.shape == (T_pad, N_pad):
+        ms = masked_static          # pre-padded device array: no host traffic
+    else:
+        ms = np.full((T_pad, N_pad), NEG, np.float32)
+        ms[:T, :N] = masked_static
+
+    ws = np.zeros((1, 8), np.float32)
+    ws[0, :4] = [binpack_weight, least_weight, most_weight, balanced_weight]
+    rw = np.zeros((R_PAD, N_pad), np.float32)
+    rw[:R, :N] = np.asarray(binpack_res, np.float32)[:R, None]
+
+    nt = np.zeros((1, N_pad), np.float32)
+    nt[0, :N] = ntasks
+    mt = np.zeros((1, N_pad), np.float32)
+    mt[0, :N] = max_tasks
+
+    fn = _build(G, chunk, N_pad, use_interpret())
+    out_node, out_flags, f_idle, f_fidle, f_used, f_nt = fn(
+        req_s.reshape(G, 1, chunk * R_PAD), flags.reshape(G, 1, chunk),
+        rdy.reshape(G, 1, chunk), keep.reshape(G, 1, chunk), ws,
+        ms, padRN(idle), padRN(future_idle), padRN(used), nt,
+        padRN(allocatable), mt, rw)
+
+    out_node = np.asarray(out_node).reshape(T_pad)[:T]
+    out_flags = np.asarray(out_flags).reshape(T_pad)[:T]
+
+    J = len(min_available)
+    job_ready = np.zeros(J, bool)
+    job_kept = np.zeros(J, bool)
+    boundary = (out_flags & (F_READY | F_KEEP)) != 0
+    job_ready[job_ix[boundary]] = (out_flags[boundary] & F_READY) != 0
+    job_kept[job_ix[boundary]] = (out_flags[boundary] & F_KEEP) != 0
+
+    task_node = np.where(job_kept[job_ix] & ((out_flags & F_PLACE) != 0),
+                         out_node, NO_NODE).astype(np.int32)
+    pipelined = (out_flags & F_PIPE) != 0
+    return PallasPlacement(
+        task_node=task_node, task_pipelined=pipelined,
+        job_ready=job_ready, job_kept=job_kept,
+        idle=np.asarray(f_idle)[:R, :N].T,
+        future_idle=np.asarray(f_fidle)[:R, :N].T,
+        used=np.asarray(f_used)[:R, :N].T,
+        ntasks=np.asarray(f_nt)[0, :N])

@@ -268,6 +268,10 @@ class NumaAwarePlugin(Plugin):
             self.assign_res.setdefault(task.uid, {})[node.name] = all_assign
 
         ssn.add_predicate_fn(self.NAME, predicate)
+        if self.node_res_sets:
+            # cpusets shrink as siblings allocate: device proposals must be
+            # re-validated through predicate_fn at replay time
+            ssn.stateful_predicates.add(self.NAME)
 
         def feasibility(ssn_, tasks, node_t):
             """Tensor-path mirror of the predicate: bool[T,N] mask for the

@@ -203,6 +203,12 @@ class PredicatesPlugin(Plugin):
         self._cache = {}
         ssn.add_predicate_fn(self.NAME, self.predicate)
         ssn.add_feasibility_fn(self.NAME, self.feasibility_mask)
+        if self.gpu_sharing_enable or (self.proportional_enable
+                                       and self.proportional):
+            # card packing / idle ratios mutate as the cycle allocates: the
+            # static feasibility mask is necessary but not sufficient, so
+            # batched engines re-check proposals through predicate_fn
+            ssn.stateful_predicates.add(self.NAME)
 
 
 class PredicateError(ValueError):
