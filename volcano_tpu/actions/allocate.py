@@ -585,9 +585,91 @@ def _stateful_recheck(ssn, task, node) -> bool:
         return False
 
 
+def _fast_replay_ok(ssn) -> bool:
+    """The batched replay skips the per-task Statement machinery; it is
+    sound only when (a) no stateful predicates need re-checking, (b) every
+    event handler declared itself additive-per-job (drf/proportion), (c) the
+    gang plugin alone decides job readiness/pipelining — so the kernel's
+    gang verdicts (bit-identical to gang.go's formula) are authoritative —
+    and (d) no node carries GPU card state."""
+    if ssn.stateful_predicates:
+        return False
+    if any(not eh.aggregatable for eh in ssn.event_handlers):
+        return False
+    for reg, flag in ((ssn.job_ready_fns, "enabledJobReady"),
+                      (ssn.job_pipelined_fns, "enabledJobPipelined")):
+        owners = [opt.name for tier in ssn.tiers for opt in tier.plugins
+                  if opt.name in reg and (flag is None or opt.is_enabled(flag))]
+        if any(name != "gang" for name in owners):
+            return False
+    if any(n.gpu_devices for n in ssn.nodes.values()):
+        return False
+    return True
+
+
+def _replay_fused_fast(ssn, sol: "_FusedSolution") -> None:
+    """Batched replay: identical end-state to the Statement path, with the
+    per-task Resource arithmetic aggregated per node/job. Status flips match
+    the slow path exactly: committed tasks end BINDING on the session model
+    and BOUND on the live cache (session.dispatch -> cache.bind), pipelined
+    tasks end PIPELINED session-only."""
+    from ..api import Resource
+
+    per_job: Dict[int, List[int]] = {}
+    for i, jx in enumerate(sol.job_ix):
+        per_job.setdefault(int(jx), []).append(i)
+
+    alloc_agg: Dict[str, Resource] = {}
+    pipe_agg: Dict[str, Resource] = {}
+    binds: List[TaskInfo] = []
+    for jx, ids in per_job.items():
+        if not sol.job_kept[jx]:
+            continue
+        job = sol.jobs_list[jx]
+        ready = bool(sol.job_ready[jx])
+        agg = Resource()
+        count = 0
+        for i in ids:
+            n = int(sol.task_node[i])
+            if n == NO_NODE:
+                continue
+            task = sol.tasks[i]
+            host = sol.node_t.names[n]
+            node = ssn.nodes[host]
+            if sol.pipelined[i]:
+                job.update_task_status(task, TaskStatus.PIPELINED)
+                task.node_name = host
+                node.tasks[task.uid] = task.shallow_clone()
+                pipe_agg.setdefault(host, Resource()).add(task.resreq)
+            else:
+                job.update_task_status(
+                    task,
+                    TaskStatus.BINDING if ready else TaskStatus.ALLOCATED)
+                task.node_name = host
+                ti = task.shallow_clone()
+                ti.status = TaskStatus.ALLOCATED
+                node.tasks[task.uid] = ti
+                alloc_agg.setdefault(host, Resource()).add(task.resreq)
+                if ready:
+                    binds.append(task)
+            agg.add(task.resreq)
+            count += 1
+        if count:
+            ssn._fire_allocate(_AggTask(job.uid, agg))
+    for host, r in alloc_agg.items():
+        node = ssn.nodes[host]
+        node.idle.sub(r)
+        node.used.add(r)
+    for host, r in pipe_agg.items():
+        ssn.nodes[host].pipelined.add(r)
+    ssn.cache.bind_batch(binds)
+
+
 def _replay_fused(ssn, sol: _FusedSolution) -> None:
     """Replay device decisions through Statements, job by job, preserving
     gang atomicity on the host model (statement.go semantics)."""
+    if _fast_replay_ok(ssn):
+        return _replay_fused_fast(ssn, sol)
     per_job_tasks: Dict[int, List[int]] = {}
     for i, jx in enumerate(sol.job_ix):
         per_job_tasks.setdefault(int(jx), []).append(i)

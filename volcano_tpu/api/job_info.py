@@ -92,6 +92,14 @@ class TaskInfo:
         t.init_resreq = self.init_resreq.clone()
         return t
 
+    def shallow_clone(self) -> "TaskInfo":
+        """Copy sharing the Resource objects — safe where the copy's resreq
+        is only ever read (node occupancy bookkeeping: remove_task/update_task
+        use it as an operand, never mutate it)."""
+        t = TaskInfo.__new__(TaskInfo)
+        t.__dict__.update(self.__dict__)
+        return t
+
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
 

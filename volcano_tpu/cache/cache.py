@@ -188,6 +188,61 @@ class SchedulerCache:
                 self.err_tasks.append(task)
             self.resync_task(task)
 
+    def bind_batch(self, tasks) -> None:
+        """Batched bind: one optimistic pass with per-node aggregated
+        accounting, then the Binder calls, with per-task rollback on binder
+        failure. Semantics match bind() per task; the aggregation removes the
+        per-task Resource arithmetic that dominates a 10k-bind cycle."""
+        from ..api import Resource
+        agg = {}
+        placed = []
+        with self._lock:
+            for task in tasks:
+                job = self.jobs.get(task.job)
+                if job is None or task.uid not in job.tasks:
+                    continue
+                cached = job.tasks[task.uid]
+                if cached.node_name:
+                    # re-bind of an already-placed task: rare; full path
+                    job.update_task_status(cached, TaskStatus.BOUND)
+                    if cached.node_name in self.nodes:
+                        self.nodes[cached.node_name].update_task(cached)
+                    placed.append((task, False))
+                    continue
+                cached.node_name = task.node_name
+                job.update_task_status(cached, TaskStatus.BOUND)
+                node = self.nodes.get(task.node_name)
+                if node is not None:
+                    if node.gpu_devices:
+                        node.add_task(cached)        # full path: card packing
+                    else:
+                        # the clone keeps status BOUND so a later
+                        # remove_task/update_task re-accounts correctly
+                        node.tasks[cached.uid] = cached.shallow_clone()
+                        agg.setdefault(task.node_name, Resource()).add(
+                            cached.resreq)
+                placed.append((task, True))
+            for name, r in agg.items():
+                node = self.nodes[name]
+                node.idle.sub(r)
+                node.used.add(r)
+        for task, newly in placed:
+            try:
+                self.binder.bind(task, task.node_name)
+            except Exception:
+                with self._lock:
+                    job = self.jobs.get(task.job)
+                    if job is not None and task.uid in job.tasks:
+                        cached = job.tasks[task.uid]
+                        if newly:
+                            node = self.nodes.get(cached.node_name)
+                            if node is not None:
+                                node.remove_task(cached)
+                            job.update_task_status(cached, TaskStatus.PENDING)
+                            cached.node_name = ""
+                    self.err_tasks.append(task)
+                self.resync_task(task)
+
     def evict(self, task: TaskInfo, reason: str) -> None:
         """Execute eviction: pod condition + delete (cache.go:549-599)."""
         try:

@@ -43,9 +43,15 @@ class Event:
 
 class EventHandler:
     def __init__(self, allocate_func: Optional[Callable[[Event], None]] = None,
-                 deallocate_func: Optional[Callable[[Event], None]] = None):
+                 deallocate_func: Optional[Callable[[Event], None]] = None,
+                 aggregatable: bool = False):
+        """aggregatable=True declares the handler's effect is additive in
+        ``event.task.resreq`` within a job (drf/proportion share updates):
+        batched engines may then fire one aggregated event per job instead
+        of one per task."""
         self.allocate_func = allocate_func
         self.deallocate_func = deallocate_func
+        self.aggregatable = aggregatable
 
 
 class Session:

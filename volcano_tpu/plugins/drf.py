@@ -108,7 +108,8 @@ class DRFPlugin(Plugin):
             attr._dirty = True
 
         ssn.add_event_handler(EventHandler(allocate_func=on_allocate,
-                                           deallocate_func=on_deallocate))
+                                           deallocate_func=on_deallocate,
+                                           aggregatable=True))
 
     def on_session_close(self, ssn) -> None:
         self.total = Resource()

@@ -205,7 +205,8 @@ class ProportionPlugin(Plugin):
             attr._share_dirty = True
 
         ssn.add_event_handler(EventHandler(allocate_func=on_allocate,
-                                           deallocate_func=on_deallocate))
+                                           deallocate_func=on_deallocate,
+                                           aggregatable=True))
 
     def on_session_close(self, ssn) -> None:
         # flush final queue gauges once per cycle (the reference updates them
