@@ -64,7 +64,11 @@ class JobController(Controller):
         if event == ADDED:
             self._execute(job, BusAction.SYNC_JOB)
         elif event == UPDATED:
-            if old is not None and old.spec is not job.spec:
+            # value comparison, not identity: stores that serialize (the
+            # native C++ store, a real API server) deliver copies, and a
+            # status-only write must not re-trigger sync (handler.go
+            # updateJob only reacts to spec changes)
+            if old is not None and old.spec != job.spec:
                 self._execute(job, BusAction.SYNC_JOB)
         elif event == DELETED:
             self._delete_job_resources(job)

@@ -22,8 +22,19 @@ from .webhooks import register_webhooks
 class VolcanoSystem:
     def __init__(self, conf_text: Optional[str] = None,
                  schedule_period: float = 1.0,
-                 default_queue: str = "default"):
-        self.store = ObjectStore()
+                 default_queue: str = "default",
+                 store: Optional[ObjectStore] = None,
+                 native_store: bool = False):
+        """native_store=True backs the API-server state with the C++ store
+        (volcano_tpu.native), falling back to the Python ObjectStore when
+        no toolchain is available."""
+        if store is not None:
+            self.store = store
+        elif native_store:
+            from .native import make_object_store
+            self.store = make_object_store(prefer_native=True)
+        else:
+            self.store = ObjectStore()
         self.router = register_webhooks(self.store)
         self.controllers = start_controllers(self.store)
         if default_queue:
