@@ -493,11 +493,13 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto"):
     last[:-1] = job_ix_np[1:] != job_ix_np[:-1]
     last[-1] = True
 
-    jobs_meta = JobMeta(
-        min_available=jnp.asarray([j.min_available for j in jobs_list], jnp.int32),
-        base_ready=jnp.asarray([j.ready_task_num() for j in jobs_list], jnp.int32),
-        base_pipelined=jnp.asarray([j.waiting_task_num() for j in jobs_list],
-                                   jnp.int32))
+    # numpy first: the pallas path consumes these host-side, and converting
+    # jnp->np costs one ~100ms tunnel RTT per array on remote TPU backends
+    min_av_np = np.asarray([j.min_available for j in jobs_list], np.int32)
+    base_r_np = np.asarray([j.ready_task_num() for j in jobs_list], np.int32)
+    base_p_np = np.asarray([j.waiting_task_num() for j in jobs_list], np.int32)
+    jobs_meta = JobMeta(min_available=min_av_np, base_ready=base_r_np,
+                        base_pipelined=base_p_np)
 
     from ..ops import pallas_place
     use_pallas = (not blocks and kernel != "scan"
@@ -524,14 +526,13 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto"):
             node_t.used, node_t.ntasks.astype(np.float32),
             node_t.allocatable, node_t.max_tasks.astype(np.float32),
             req, job_ix_np, ms,
-            np.asarray(jobs_meta.min_available),
-            np.asarray(jobs_meta.base_ready),
-            np.asarray(jobs_meta.base_pipelined),
+            min_av_np, base_r_np, base_p_np,
             np.asarray(weights.binpack_res),
             binpack_weight=float(weights.binpack_weight),
             least_weight=float(weights.least_req_weight),
             most_weight=float(weights.most_req_weight),
-            balanced_weight=float(weights.balanced_weight))
+            balanced_weight=float(weights.balanced_weight),
+            fetch_state=False)
         return _FusedSolution(tasks, job_ix_np, jobs_list, node_t,
                               res.task_node, res.task_pipelined,
                               res.job_ready, res.job_kept)

@@ -111,8 +111,8 @@ def assemble_static_score(ssn, tasks: Sequence[TaskInfo],
 def assemble_weights(ssn, rnames: ResourceNames) -> ScoreWeights:
     """Merge plugin weight contributions into one ScoreWeights. Plugins set
     e.g. {'binpack_weight': 1, 'binpack_res': {...}} or {'least_req_weight': 1}
-    via ssn.set_dynamic_score_weights."""
-    import jax.numpy as jnp
+    via ssn.set_dynamic_score_weights. binpack_res stays numpy — jit converts
+    at dispatch, and host callers avoid a device->host RTT."""
     binpack_res = np.zeros(len(rnames), np.float32)
     vals = {"binpack_weight": 0.0, "least_req_weight": 0.0,
             "most_req_weight": 0.0, "balanced_weight": 0.0}
@@ -123,7 +123,7 @@ def assemble_weights(ssn, rnames: ResourceNames) -> ScoreWeights:
             if rname in rnames.index:
                 binpack_res[rnames.index[rname]] += float(rw)
     return ScoreWeights(binpack_weight=vals["binpack_weight"],
-                        binpack_res=jnp.asarray(binpack_res),
+                        binpack_res=binpack_res,
                         least_req_weight=vals["least_req_weight"],
                         most_req_weight=vals["most_req_weight"],
                         balanced_weight=vals["balanced_weight"])

@@ -57,7 +57,7 @@ LANE = 128
 
 def _kernel(req_s, flags_s, rdy_s, keep_s, ws_s,
             ms_ref, idle0, fidle0, used0, nt0, alloc_ref, maxt_ref, rw_ref,
-            out_node, out_flags, fin_idle, fin_fidle, fin_used, fin_nt,
+            out_packed, fin_state,
             t_idle, t_fidle, t_used, t_nt,
             s_idle, s_fidle, s_used, s_nt,
             cnt, row_node, row_flags):
@@ -223,12 +223,17 @@ def _kernel(req_s, flags_s, rdy_s, keep_s, ws_s,
     import jax.lax
     jax.lax.fori_loop(0, C, body, 0)
 
-    out_node[0] = row_node[...]
-    out_flags[0] = row_flags[...]
-    fin_idle[...] = t_idle[...]
-    fin_fidle[...] = t_fidle[...]
-    fin_used[...] = t_used[...]
-    fin_nt[...] = t_nt[...]
+    # One packed i32 per task — (node+1)<<4 | flags — so the host retrieves
+    # the whole solve in a single device->host fetch (tunnel RTT ~100ms
+    # dominates any payload size at these shapes).
+    out_packed[0] = ((row_node[...] + 1) << 4) | row_flags[...]
+    R = t_idle.shape[0]
+    fin_state[0:R, :] = t_idle[...]
+    fin_state[R:2 * R, :] = t_fidle[...]
+    fin_state[2 * R:3 * R, :] = t_used[...]
+    fin_state[3 * R:3 * R + 1, :] = t_nt[...]
+    fin_state[3 * R + 1:, :] = jnp.zeros(
+        (fin_state.shape[0] - 3 * R - 1, fin_state.shape[1]), jnp.float32)
 
 
 def use_interpret() -> bool:
@@ -272,17 +277,12 @@ def _build(G: int, C: int, N_pad: int, interpret: bool):
             full_rn,                                     # binpack res weights
         ],
         out_specs=[
-            chunk_row(C, vmem),                          # node picks
-            chunk_row(C, vmem),                          # flags out
-            full_rn, full_rn, full_rn, full_1n,          # final state
+            chunk_row(C, vmem),                          # packed node|flags
+            vmem((3 * R_PAD + 8, N_pad), lambda g: (0, 0)),  # final state
         ],
         out_shape=[
             jax.ShapeDtypeStruct((G, 1, C), jnp.int32),
-            jax.ShapeDtypeStruct((G, 1, C), jnp.int32),
-            jax.ShapeDtypeStruct((R_PAD, N_pad), jnp.float32),
-            jax.ShapeDtypeStruct((R_PAD, N_pad), jnp.float32),
-            jax.ShapeDtypeStruct((R_PAD, N_pad), jnp.float32),
-            jax.ShapeDtypeStruct((1, N_pad), jnp.float32),
+            jax.ShapeDtypeStruct((3 * R_PAD + 8, N_pad), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((R_PAD, N_pad), jnp.float32),     # tent idle
@@ -307,8 +307,8 @@ class PallasPlacement(NamedTuple):
     task_pipelined: np.ndarray  # bool[T]
     job_ready: np.ndarray      # bool[J]
     job_kept: np.ndarray       # bool[J]
-    idle: np.ndarray           # f32[N,R] final committed state
-    future_idle: np.ndarray
+    idle: np.ndarray           # f32[N,R] final committed state (None unless
+    future_idle: np.ndarray    # fetch_state — each fetch is a tunnel RTT)
     used: np.ndarray
     ntasks: np.ndarray
 
@@ -318,11 +318,21 @@ def supported(num_resources: int, num_nodes: int) -> bool:
     return num_resources <= R_PAD and num_nodes <= 32768
 
 
+def _grid(T: int, chunk: int) -> int:
+    """Chunk count bucketing: pow2 up to 8 chunks (small solves stay small —
+    40 tasks pad to 128, not 1024), then multiples of 8 (10k tasks: 80
+    chunks, not the pow2 128). Distinct shapes stay ~bounded at 32 below the
+    32k-task ceiling, matching _build's lru_cache."""
+    g = max(1, -(-T // chunk))
+    if g <= 8:
+        return 1 << (g - 1).bit_length()
+    return -(-g // 8) * 8
+
+
 def padded_shape(T: int, N: int, chunk: int = 128) -> Tuple[int, int]:
     """(T_pad, N_pad) the kernel buckets (T, N) to — for callers that build
     the masked-static matrix on device."""
-    G = 1 << (max(1, -(-T // chunk)) - 1).bit_length()
-    return G * chunk, -(-max(N, LANE) // LANE) * LANE
+    return _grid(T, chunk) * chunk, -(-max(N, LANE) // LANE) * LANE
 
 
 @functools.lru_cache(maxsize=16)
@@ -348,7 +358,7 @@ def place_pallas(idle: np.ndarray, future_idle: np.ndarray, used: np.ndarray,
                  binpack_res: np.ndarray,
                  binpack_weight: float = 1.0, least_weight: float = 1.0,
                  most_weight: float = 0.0, balanced_weight: float = 1.0,
-                 chunk: int = 128) -> PallasPlacement:
+                 chunk: int = 128, fetch_state: bool = True) -> PallasPlacement:
     """Sequential-parity placement, fully on-chip.
 
     idle/future_idle/used/allocatable: f32[N,R]; ntasks/max_tasks: [N];
@@ -359,8 +369,7 @@ def place_pallas(idle: np.ndarray, future_idle: np.ndarray, used: np.ndarray,
     T, R = req.shape
     N = idle.shape[0]
     assert R <= R_PAD, f"{R} resource dims > {R_PAD}; use place_scan"
-    G = max(1, -(-T // chunk))
-    G = 1 << (G - 1).bit_length()                 # pow2 buckets: few recompiles
+    G = _grid(T, chunk)
     T_pad = G * chunk
     N_pad = -(-max(N, LANE) // LANE) * LANE
 
@@ -406,14 +415,15 @@ def place_pallas(idle: np.ndarray, future_idle: np.ndarray, used: np.ndarray,
     mt[0, :N] = max_tasks
 
     fn = _build(G, chunk, N_pad, use_interpret())
-    out_node, out_flags, f_idle, f_fidle, f_used, f_nt = fn(
+    out_packed, fin_state = fn(
         req_s.reshape(G, 1, chunk * R_PAD), flags.reshape(G, 1, chunk),
         rdy.reshape(G, 1, chunk), keep.reshape(G, 1, chunk), ws,
         ms, padRN(idle), padRN(future_idle), padRN(used), nt,
         padRN(allocatable), mt, rw)
 
-    out_node = np.asarray(out_node).reshape(T_pad)[:T]
-    out_flags = np.asarray(out_flags).reshape(T_pad)[:T]
+    packed = np.asarray(out_packed).reshape(T_pad)[:T]   # the ONE fetch
+    out_node = (packed >> 4) - 1
+    out_flags = packed & 0xF
 
     J = len(min_available)
     job_ready = np.zeros(J, bool)
@@ -425,10 +435,14 @@ def place_pallas(idle: np.ndarray, future_idle: np.ndarray, used: np.ndarray,
     task_node = np.where(job_kept[job_ix] & ((out_flags & F_PLACE) != 0),
                          out_node, NO_NODE).astype(np.int32)
     pipelined = (out_flags & F_PIPE) != 0
+    if fetch_state:
+        st = np.asarray(fin_state)                       # one more RTT
+        f_idle, f_fidle, f_used = (st[k * R_PAD:k * R_PAD + R, :N].T
+                                   for k in range(3))
+        f_nt = st[3 * R_PAD, :N]
+    else:
+        f_idle = f_fidle = f_used = f_nt = None
     return PallasPlacement(
         task_node=task_node, task_pipelined=pipelined,
         job_ready=job_ready, job_kept=job_kept,
-        idle=np.asarray(f_idle)[:R, :N].T,
-        future_idle=np.asarray(f_fidle)[:R, :N].T,
-        used=np.asarray(f_used)[:R, :N].T,
-        ntasks=np.asarray(f_nt)[0, :N])
+        idle=f_idle, future_idle=f_fidle, used=f_used, ntasks=f_nt)
