@@ -609,54 +609,65 @@ def _fast_replay_ok(ssn) -> bool:
 
 
 def _replay_fused_fast(ssn, sol: "_FusedSolution") -> None:
-    """Batched replay: identical end-state to the Statement path, with the
-    per-task Resource arithmetic aggregated per node/job. Status flips match
-    the slow path exactly: committed tasks end BINDING on the session model
-    and BOUND on the live cache (session.dispatch -> cache.bind), pipelined
-    tasks end PIPELINED session-only."""
+    """Batched replay: identical end-state to the Statement path. The Python
+    loop does only dict bookkeeping (status-index bucket moves + node task
+    mirrors) and exact Resource aggregation per node/job — aggregates use
+    task.resreq doubles, NOT the solve's f32 req matrix, so node accounting
+    stays bit-identical to the Statement path (an f32-rounded delta can
+    fail Resource.sub's sufficiency assert on exactly-packed nodes). Status
+    flips match the slow path exactly: committed tasks end BINDING on the
+    session model and BOUND on the live cache (session.dispatch ->
+    cache.bind), pipelined tasks end PIPELINED session-only."""
     from ..api import Resource
 
-    per_job: Dict[int, List[int]] = {}
-    for i, jx in enumerate(sol.job_ix):
-        per_job.setdefault(int(jx), []).append(i)
+    task_node = np.asarray(sol.task_node)
+    pipelined = np.asarray(sol.pipelined, bool)
+    job_ix = np.asarray(sol.job_ix)
+    kept_t = np.asarray(sol.job_kept, bool)[job_ix]
+    placed = (task_node != NO_NODE) & kept_t
+    pipe_m = placed & pipelined
 
     alloc_agg: Dict[str, Resource] = {}
     pipe_agg: Dict[str, Resource] = {}
+    job_agg: Dict[int, Resource] = {}
+    job_alloc: Dict[int, Resource] = {}
+    ready_j = np.asarray(sol.job_ready, bool)
     binds: List[TaskInfo] = []
-    for jx, ids in per_job.items():
-        if not sol.job_kept[jx]:
-            continue
+    names = sol.node_t.names
+    for i in np.flatnonzero(placed):
+        task = sol.tasks[i]
+        jx = int(job_ix[i])
         job = sol.jobs_list[jx]
-        ready = bool(sol.job_ready[jx])
-        agg = Resource()
-        count = 0
-        for i in ids:
-            n = int(sol.task_node[i])
-            if n == NO_NODE:
-                continue
-            task = sol.tasks[i]
-            host = sol.node_t.names[n]
-            node = ssn.nodes[host]
-            if sol.pipelined[i]:
-                job.update_task_status(task, TaskStatus.PIPELINED)
-                task.node_name = host
-                node.tasks[task.uid] = task.shallow_clone()
-                pipe_agg.setdefault(host, Resource()).add(task.resreq)
+        host = names[task_node[i]]
+        if pipe_m[i]:
+            status = TaskStatus.PIPELINED
+            pipe_agg.setdefault(host, Resource()).add(task.resreq)
+        else:
+            if ready_j[jx]:
+                status = TaskStatus.BINDING
+                binds.append(task)
             else:
-                job.update_task_status(
-                    task,
-                    TaskStatus.BINDING if ready else TaskStatus.ALLOCATED)
-                task.node_name = host
-                ti = task.shallow_clone()
-                ti.status = TaskStatus.ALLOCATED
-                node.tasks[task.uid] = ti
-                alloc_agg.setdefault(host, Resource()).add(task.resreq)
-                if ready:
-                    binds.append(task)
-            agg.add(task.resreq)
-            count += 1
-        if count:
-            ssn._fire_allocate(_AggTask(job.uid, agg))
+                status = TaskStatus.ALLOCATED
+            alloc_agg.setdefault(host, Resource()).add(task.resreq)
+            job_alloc.setdefault(jx, Resource()).add(task.resreq)
+        # inline update_task_status minus the per-task Resource math
+        # (aggregated above): old status is PENDING by construction of
+        # _pending_tasks
+        job._del_index(task)
+        task.status = status
+        job._add_index(task)
+        task.node_name = host
+        ti = task.shallow_clone()
+        if status == TaskStatus.BINDING:
+            ti.status = TaskStatus.ALLOCATED
+        ssn.nodes[host].tasks[task.uid] = ti
+        job_agg.setdefault(jx, Resource()).add(task.resreq)
+
+    for jx, agg in job_agg.items():
+        job = sol.jobs_list[jx]
+        if jx in job_alloc:
+            job.allocated.add(job_alloc[jx])
+        ssn._fire_allocate(_AggTask(job.uid, agg))
     for host, r in alloc_agg.items():
         node = ssn.nodes[host]
         node.idle.sub(r)

@@ -243,7 +243,7 @@ def use_interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
 
-@functools.lru_cache(maxsize=32)
+@functools.lru_cache(maxsize=64)
 def _build(G: int, C: int, N_pad: int, interpret: bool):
     """Compile the kernel for (grid, chunk, node) bucket shapes."""
     import jax
@@ -321,8 +321,8 @@ def supported(num_resources: int, num_nodes: int) -> bool:
 def _grid(T: int, chunk: int) -> int:
     """Chunk count bucketing: pow2 up to 8 chunks (small solves stay small —
     40 tasks pad to 128, not 1024), then multiples of 8 (10k tasks: 80
-    chunks, not the pow2 128). Distinct shapes stay ~bounded at 32 below the
-    32k-task ceiling, matching _build's lru_cache."""
+    chunks, not the pow2 128). Distinct shapes stay ~bounded at 35 below the
+    32k-task ceiling, within _build's lru_cache(64)."""
     g = max(1, -(-T // chunk))
     if g <= 8:
         return 1 << (g - 1).bit_length()
