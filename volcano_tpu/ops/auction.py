@@ -37,11 +37,42 @@ class BlockTasks(NamedTuple):
     static_score: jnp.ndarray  # f32[T,N]
 
 
+K_CAND = 8
+
+
+def _round_contention(req, bid, bidding, avail_bid, base_cnt, maxt_bid):
+    """Exact intra-round capacity contention via a [C,C] same-bid matmul.
+
+    For task i, the demand claimed ahead of it is the sum of req over
+    earlier tasks j<i bidding the same node — a lower-triangular same-bid
+    mask times req (MXU work, no [C,N,R] cumsum). Three waves: count all
+    bidders (conservative), recount with only accepted (recovers tasks
+    displaced by rejected bidders), re-validate the merged set.
+    """
+    C = req.shape[0]
+    lower = jnp.arange(C)[:, None] > jnp.arange(C)[None, :]   # j < i
+    same = (bid[:, None] == bid[None, :]) & lower             # [C,C]
+
+    def wave(mask):
+        live = (mask & bidding).astype(req.dtype)             # [C]
+        m = same * live[None, :]
+        cum = m.astype(req.dtype) @ req                       # [C,R]
+        room = jnp.all(req + cum < avail_bid + EPS, axis=-1)
+        cnt = jnp.sum(m, axis=1)
+        pods_room = base_cnt + cnt < maxt_bid
+        return bidding & room & pods_room
+
+    accept = wave(jnp.ones(C, dtype=bool))
+    accept = accept | wave(accept)
+    return wave(accept)
+
+
 def _chunk_step(allocatable, max_tasks, weights):
     def step(nodes: NodeState, chunk):
         req, job_ix, valid, feas, static_score = chunk
         C, R = req.shape
         N = nodes.idle.shape[0]
+        K = min(K_CAND, N)
 
         pods_ok = nodes.ntasks < max_tasks                       # [N]
         fit = (jnp.all(req[:, None, :] < nodes.idle[None] + EPS, axis=-1)
@@ -49,34 +80,39 @@ def _chunk_step(allocatable, max_tasks, weights):
         score = static_score + combined_dynamic_score(
             req, nodes.used, allocatable, weights)                # [C,N]
         masked = jnp.where(fit, score, -jnp.inf)
-        choice = jnp.argmax(masked, axis=-1)                      # [C]
-        has_node = jnp.any(fit, axis=-1) & valid                  # [C]
+        cand_score, cand = jax.lax.top_k(masked, K)               # [C,K]
 
-        onehot = jax.nn.one_hot(choice, N, dtype=req.dtype) * has_node[:, None]
+        # K bidding rounds: a task rejected at its r-th choice (node filled
+        # by earlier bidders) falls to its (r+1)-th within the same chunk —
+        # without this, homogeneous tasks herd onto one argmax node and each
+        # chunk pass fills a single node.
+        def round_body(_, st):
+            accept, choice, slot = st
+            bid = jnp.take_along_axis(cand, slot[:, None], 1)[:, 0]
+            bscore = jnp.take_along_axis(cand_score, slot[:, None], 1)[:, 0]
+            bidding = ~accept & valid & (bscore > -jnp.inf)
+            # claimed state = accepted choices so far, by construction
+            claimed_hot = (jax.nn.one_hot(choice, N, dtype=req.dtype)
+                           * accept[:, None])
+            claimed = jnp.einsum("cn,cr->nr", claimed_hot, req)
+            claimed_cnt = jnp.sum(claimed_hot, axis=0)
+            avail_bid = nodes.idle[bid] - claimed[bid]
+            base_cnt = nodes.ntasks[bid] + claimed_cnt[bid]
+            acc = _round_contention(req, bid, bidding, avail_bid, base_cnt,
+                                    max_tasks[bid])
+            choice = jnp.where(acc, bid, choice)
+            accept = accept | acc
+            slot = jnp.where(bidding & ~acc,
+                             jnp.minimum(slot + 1, K - 1), slot)
+            return accept, choice, slot
 
-        def contention(accept_mask):
-            """Exclusive prefix of demand claimed on each node by earlier
-            accepted tasks in this chunk; returns the accept mask under it."""
-            live = onehot * accept_mask[:, None]
-            demand = live[:, :, None] * req[:, None, :]           # [C,N,R]
-            cum = jnp.cumsum(demand, axis=0) - demand             # exclusive
-            room = jnp.all(
-                req[:, None, :] + cum[jnp.arange(C), choice][:, None, :]
-                < nodes.idle[choice][:, None, :] + EPS, axis=-1)[:, 0]
-            cum_count = jnp.cumsum(live, axis=0) - live
-            pods_room = (nodes.ntasks[choice]
-                         + cum_count[jnp.arange(C), choice] < max_tasks[choice])
-            return has_node & room & pods_room                    # [C]
+        accept0 = jnp.zeros(C, dtype=bool)
+        choice0 = jnp.zeros(C, dtype=jnp.int32)
+        slot0 = jnp.zeros(C, dtype=jnp.int32)
+        accept, choice, _ = jax.lax.fori_loop(
+            0, K, round_body, (accept0, choice0, slot0))
 
-        # Pass 1 counts every bidder's demand (conservative: a rejected
-        # bidder still blocks later ones); pass 2 recounts with only the
-        # accepted demand, admitting tasks wrongly displaced by rejected
-        # earlier bidders. Remaining misses retry in the next chunk pass.
-        accept = contention(jnp.ones(C, dtype=bool))
-        accept = accept | contention(accept)
-        accept = contention(accept)   # re-validate the merged set
-
-        placed = onehot * accept[:, None]
+        placed = jax.nn.one_hot(choice, N, dtype=req.dtype) * accept[:, None]
         delta = jnp.einsum("cn,cr->nr", placed, req)
         nodes = NodeState(
             idle=nodes.idle - delta,
@@ -92,7 +128,7 @@ def _chunk_step(allocatable, max_tasks, weights):
 def place_blocks(nodes: NodeState, tasks: BlockTasks, jobs: JobMeta,
                  weights: ScoreWeights, allocatable: jnp.ndarray,
                  max_tasks: jnp.ndarray, chunk: int = 256,
-                 sweeps: int = 2, passes: int = 2,
+                 sweeps: int = 3, passes: int = 3,
                  ) -> Tuple[jnp.ndarray, jnp.ndarray, NodeState]:
     """Place tasks; returns (task_node i32[T], job_ready bool[J], nodes).
 

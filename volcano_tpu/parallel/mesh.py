@@ -10,18 +10,22 @@ step: local compute + a small collective across the ring). Gang admission is
 a ``psum`` of per-job placement counts.
 
 All collectives ride ICI inside one jit program; nothing touches the host
-between chunks.
+between chunks. The compiled solver is cached per (mesh, chunk, sweeps) with
+job metadata and score weights as runtime arguments, so a scheduler calling
+it every cycle pays one compile per shape bucket, not per cycle; the
+(assign, ready) results come back in ONE packed device->host fetch (tunnel
+RTT dominates payload size on remote TPU backends).
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.dense import EPS
 from ..ops.place import NO_NODE, JobMeta, NodeState
@@ -29,59 +33,117 @@ from ..ops.scores import ScoreWeights, combined_dynamic_score
 
 NODE_AXIS = "nodes"
 
+# statically-infeasible sentinel, shared with ops/pallas_place.py
+NEG = -1e30
+NEG_TEST = -1e29
+
 
 def make_mesh(devices=None, axis: str = NODE_AXIS) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     return Mesh(np.asarray(devices), (axis,))
 
 
-def _sharded_chunk_step(axis: str):
+K_CAND = 8
+
+
+def _sharded_chunk_step(axis: str, has_ms: bool):
     """One chunk over node-sharded state. Runs inside shard_map: all array
-    args are the per-device shards."""
+    args are the per-device shards.
+
+    Mirrors ops/auction._chunk_step's top-K bidding: every shard offers its
+    local top-K candidates, one all_gather merges them into a global top-K
+    per task, then K contention rounds let a task rejected at its r-th
+    choice fall to its (r+1)-th. Contention for a node is resolved on the
+    shard that owns it; one psum per round merges accept verdicts."""
 
     def step(carry, chunk, *, allocatable, max_tasks, weights, shard_offset):
         nodes: NodeState = carry
-        req, valid = chunk                                  # [C,R] replicated
+        if has_ms:
+            req, valid, ms = chunk          # req/valid replicated, ms sharded
+        else:
+            req, valid = chunk
+            ms = None
         C, R = req.shape
         Nl = nodes.idle.shape[0]                            # local shard size
+        K = min(K_CAND, Nl)
 
         pods_ok = nodes.ntasks < max_tasks
         fit = (jnp.all(req[:, None, :] < nodes.idle[None] + EPS, axis=-1)
                & pods_ok[None])                              # [C,Nl]
         score = combined_dynamic_score(req, nodes.used, allocatable, weights)
+        if ms is not None:
+            fit = fit & (ms > NEG_TEST)
+            score = score + ms
         masked = jnp.where(fit, score, -jnp.inf)
-        local_best = jnp.argmax(masked, axis=-1)             # [C]
-        local_score = masked[jnp.arange(C), local_best]      # [C]
+        lscore, lidx = jax.lax.top_k(masked, K)              # [C,K] local
+        gidx = lidx + shard_offset
 
-        # Resolve the global winner per task with one gather across shards.
-        all_scores = jax.lax.all_gather(local_score, axis)   # [D,C]
-        my_shard = jax.lax.axis_index(axis)
-        winner_shard = jnp.argmax(all_scores, axis=0)        # [C]
-        has_node = jnp.max(all_scores, axis=0) > -jnp.inf
-        mine = (winner_shard == my_shard) & has_node & valid # [C]
+        # merge every shard's candidates into a global per-task top-K:
+        # one gather of [D,C,K] scores + ids across the mesh.
+        all_s = jax.lax.all_gather(lscore, axis)             # [D,C,K]
+        all_i = jax.lax.all_gather(gidx, axis)
+        D = all_s.shape[0]
+        flat_s = jnp.moveaxis(all_s, 0, 1).reshape(C, D * K)
+        flat_i = jnp.moveaxis(all_i, 0, 1).reshape(C, D * K)
+        cand_score, pos = jax.lax.top_k(flat_s, K)           # [C,K] global
+        cand = jnp.take_along_axis(flat_i, pos, axis=1)
 
-        # Local contention resolution for tasks won by this shard
-        # (same two-wave scheme as ops/auction.py).
-        choice = local_best
-        onehot = jax.nn.one_hot(choice, Nl, dtype=req.dtype) * mine[:, None]
+        lower = jnp.arange(C)[:, None] > jnp.arange(C)[None, :]
 
-        def contention(accept_mask):
-            live = onehot * accept_mask[:, None]
-            demand = live[:, :, None] * req[:, None, :]
-            cum = jnp.cumsum(demand, axis=0) - demand
-            room = jnp.all(
-                req[:, None, :] + cum[jnp.arange(C), choice][:, None, :]
-                < nodes.idle[choice][:, None, :] + EPS, axis=-1)[:, 0]
-            cum_count = jnp.cumsum(live, axis=0) - live
-            pods_room = (nodes.ntasks[choice]
-                         + cum_count[jnp.arange(C), choice] < max_tasks[choice])
-            return mine & room & pods_room
+        def round_body(_, st):
+            accept, choice_g, slot = st
+            bid_g = jnp.take_along_axis(cand, slot[:, None], 1)[:, 0]
+            bscore = jnp.take_along_axis(cand_score, slot[:, None], 1)[:, 0]
+            bidding = ~accept & valid & (bscore > -jnp.inf)
+            local = (bid_g >= shard_offset) & (bid_g < shard_offset + Nl)
+            bid_l = jnp.clip(bid_g - shard_offset, 0, Nl - 1)
+            bidding_l = bidding & local
 
-        accept = contention(jnp.ones(C, dtype=bool))
-        accept = accept | contention(accept)
-        accept = contention(accept)
+            # claimed capacity on this shard from earlier-round accepts
+            choice_l = jnp.clip(choice_g - shard_offset, 0, Nl - 1)
+            acc_l = (accept & (choice_g >= shard_offset)
+                     & (choice_g < shard_offset + Nl))
+            claimed_hot = (jax.nn.one_hot(choice_l, Nl, dtype=req.dtype)
+                           * acc_l[:, None])
+            claimed = jnp.einsum("cn,cr->nr", claimed_hot, req)
+            claimed_cnt = jnp.sum(claimed_hot, axis=0)
+            avail_bid = nodes.idle[bid_l] - claimed[bid_l]
+            base_cnt = nodes.ntasks[bid_l] + claimed_cnt[bid_l]
+            maxt_bid = max_tasks[bid_l]
 
-        placed = onehot * accept[:, None]
+            same = (bid_l[:, None] == bid_l[None, :]) & lower
+
+            def wave(mask):
+                live = (mask & bidding_l).astype(req.dtype)
+                m = same * live[None, :]
+                cum = m.astype(req.dtype) @ req
+                room = jnp.all(req + cum < avail_bid + EPS, axis=-1)
+                cnt = jnp.sum(m, axis=1)
+                return bidding_l & room & (base_cnt + cnt < maxt_bid)
+
+            acc = wave(jnp.ones(C, dtype=bool))
+            acc = acc | wave(acc)
+            acc = wave(acc)
+            # each bid node is owned by exactly one shard: psum broadcasts
+            # the owner's verdict to everyone
+            acc_any = jax.lax.psum(acc.astype(jnp.int32), axis) > 0
+            choice_g = jnp.where(acc_any, bid_g, choice_g)
+            accept = accept | acc_any
+            slot = jnp.where(bidding & ~acc_any,
+                             jnp.minimum(slot + 1, K - 1), slot)
+            return accept, choice_g, slot
+
+        accept0 = jnp.zeros(C, dtype=bool)
+        choice0 = jnp.full(C, -1, dtype=jnp.int32)
+        slot0 = jnp.zeros(C, dtype=jnp.int32)
+        accept, choice_g, _ = jax.lax.fori_loop(
+            0, K, round_body, (accept0, choice0, slot0))
+
+        # apply deltas on the owning shard
+        mine = (accept & (choice_g >= shard_offset)
+                & (choice_g < shard_offset + Nl))
+        choice_l = jnp.clip(choice_g - shard_offset, 0, Nl - 1)
+        placed = jax.nn.one_hot(choice_l, Nl, dtype=req.dtype) * mine[:, None]
         delta = jnp.einsum("cn,cr->nr", placed, req)
         nodes = NodeState(
             idle=nodes.idle - delta,
@@ -89,55 +151,47 @@ def _sharded_chunk_step(axis: str):
             used=nodes.used + delta,
             ntasks=nodes.ntasks + jnp.sum(placed, axis=0).astype(jnp.int32))
 
-        # global node index of the accepted pick; psum merges shards (every
-        # non-winning shard contributes 0).
-        local_pick = jnp.where(accept, shard_offset + choice + 1, 0)
-        global_pick = jax.lax.psum(local_pick, axis) - 1     # NO_NODE == -1
-        return nodes, global_pick.astype(jnp.int32)
+        out = jnp.where(accept, choice_g, NO_NODE).astype(jnp.int32)
+        return nodes, out
 
     return step
 
 
-def place_blocks_sharded(mesh: Mesh, nodes: NodeState, req: jnp.ndarray,
-                         valid: jnp.ndarray, job_ix: jnp.ndarray,
-                         jobs: JobMeta, weights: ScoreWeights,
-                         allocatable: jnp.ndarray, max_tasks: jnp.ndarray,
-                         chunk: int = 256, sweeps: int = 2,
-                         ) -> Tuple[jnp.ndarray, jnp.ndarray, NodeState]:
-    """Node-sharded block-greedy placement over ``mesh``.
+_SOLVER_CACHE: dict = {}
 
-    nodes/allocatable/max_tasks are sharded on the node axis; tasks
-    (req/valid/job_ix) and JobMeta are replicated. Returns
-    (task_node i32[T] global indices, job_ready bool[J], sharded NodeState).
-    N must be divisible by the mesh size (pad with zero-capacity nodes).
-    """
-    D = mesh.devices.size
-    N = allocatable.shape[0]
-    assert N % D == 0, f"node count {N} not divisible by mesh size {D}"
-    T = req.shape[0]
-    pad = (-T) % chunk
-    if pad:
-        req = jnp.pad(req, ((0, pad), (0, 0)))
-        valid = jnp.pad(valid, (0, pad))
-        job_ix = jnp.pad(job_ix, (0, pad))
-    Tp = T + pad
-    n_chunks = Tp // chunk
-    J = jobs.min_available.shape[0]
+
+def _sharded_solver(mesh: Mesh, chunk: int, sweeps: int, passes: int,
+                    has_ms: bool):
+    """Compiled node-sharded solve for this mesh. jobs/weights are runtime
+    args (re-tracing per cycle would pay a multi-second compile)."""
+    key = (tuple(d.id for d in mesh.devices.flat), chunk, sweeps, passes,
+           has_ms)
+    if key in _SOLVER_CACHE:
+        return _SOLVER_CACHE[key]
 
     node_sharded = P(NODE_AXIS)
     repl = P()
+    in_specs = [NodeState(*(node_sharded,) * 4), node_sharded, node_sharded,
+                repl, repl, repl,
+                JobMeta(repl, repl, repl),
+                ScoreWeights(repl, repl, repl, repl, repl)]
+    if has_ms:
+        in_specs.append(P(None, NODE_AXIS))
 
-    @partial(jax.shard_map, mesh=mesh,
-             in_specs=(NodeState(*(node_sharded,) * 4), node_sharded,
-                       node_sharded, repl, repl, repl),
-             out_specs=(repl, repl, NodeState(*(node_sharded,) * 4)),
+    @partial(jax.shard_map, mesh=mesh, in_specs=tuple(in_specs),
+             out_specs=(repl, NodeState(*(node_sharded,) * 4)),
              check_vma=False)
-    def solve(nodes, allocatable, max_tasks, req, valid, job_ix):
+    def solve(nodes, allocatable, max_tasks, req, valid, job_ix, jobs,
+              weights, *maybe_ms):
+        Tp = req.shape[0]
+        n_chunks = Tp // chunk
         Nl = allocatable.shape[0]
+        J = jobs.min_available.shape[0]
         shard_offset = jax.lax.axis_index(NODE_AXIS) * Nl
-        step = partial(_sharded_chunk_step(NODE_AXIS),
+        step = partial(_sharded_chunk_step(NODE_AXIS, has_ms),
                        allocatable=allocatable, max_tasks=max_tasks,
                        weights=weights, shard_offset=shard_offset)
+        ms = maybe_ms[0] if has_ms else None
 
         assign0 = jnp.full(Tp, NO_NODE, dtype=jnp.int32)
 
@@ -146,13 +200,15 @@ def place_blocks_sharded(mesh: Mesh, nodes: NodeState, req: jnp.ndarray,
             todo = (assign == NO_NODE) & valid & ~job_dead[job_ix]
             xs = (req.reshape(n_chunks, chunk, -1),
                   todo.reshape(n_chunks, chunk))
+            if has_ms:
+                xs = xs + (ms.reshape(n_chunks, chunk, Nl),)
             nodes, out = jax.lax.scan(step, nodes, xs)
             assign = jnp.where(assign == NO_NODE, out.reshape(Tp), assign)
             return (nodes, assign, job_dead), None
 
         def sweep(carry, _):
             (nodes, assign, job_dead), _ = jax.lax.scan(
-                place_pass, carry, jnp.arange(2))
+                place_pass, carry, jnp.arange(passes))
 
             placed = assign != NO_NODE
             counts = jax.ops.segment_sum(placed.astype(jnp.int32), job_ix,
@@ -177,8 +233,51 @@ def place_blocks_sharded(mesh: Mesh, nodes: NodeState, req: jnp.ndarray,
         (nodes, assign, _), readies = jax.lax.scan(
             sweep, (nodes, assign0, jnp.zeros(J, dtype=bool)),
             jnp.arange(sweeps))
-        return assign, readies[-1], nodes
+        # pack (assign, ready) into one i32 row: one host fetch for the lot
+        packed = jnp.concatenate([assign, readies[-1].astype(jnp.int32)])
+        return packed, nodes
 
-    assign, ready, nodes = solve(nodes, allocatable, max_tasks, req, valid,
-                                 job_ix)
-    return assign[:T], ready, nodes
+    fn = jax.jit(solve)
+    _SOLVER_CACHE[key] = fn
+    return fn
+
+
+def place_blocks_sharded(mesh: Mesh, nodes: NodeState, req: jnp.ndarray,
+                         valid: jnp.ndarray, job_ix: jnp.ndarray,
+                         jobs: JobMeta, weights: ScoreWeights,
+                         allocatable: jnp.ndarray, max_tasks: jnp.ndarray,
+                         chunk: int = 256, sweeps: int = 3, passes: int = 3,
+                         masked_static: Optional[jnp.ndarray] = None,
+                         ) -> Tuple[np.ndarray, np.ndarray, NodeState]:
+    """Node-sharded block-greedy placement over ``mesh``.
+
+    nodes/allocatable/max_tasks are sharded on the node axis; tasks
+    (req/valid/job_ix) and JobMeta are replicated; ``masked_static``
+    (optional f32[T,N], NEG where statically infeasible) is sharded on its
+    node axis. Returns (task_node i32[T] global indices, job_ready bool[J] —
+    both host numpy, from one packed fetch — and the final sharded
+    NodeState, left on device). N must be divisible by the mesh size (pad
+    with zero-capacity nodes).
+    """
+    D = mesh.devices.size
+    N = allocatable.shape[0]
+    assert N % D == 0, f"node count {N} not divisible by mesh size {D}"
+    T = req.shape[0]
+    pad = (-T) % chunk
+    if pad:
+        req = jnp.pad(req, ((0, pad), (0, 0)))
+        valid = jnp.pad(valid, (0, pad))
+        job_ix = jnp.pad(job_ix, (0, pad))
+        if masked_static is not None:
+            masked_static = jnp.pad(masked_static, ((0, pad), (0, 0)),
+                                    constant_values=NEG)
+    Tp = T + pad
+
+    fn = _sharded_solver(mesh, chunk, sweeps, passes,
+                         masked_static is not None)
+    args = [nodes, allocatable, max_tasks, req, valid, job_ix, jobs, weights]
+    if masked_static is not None:
+        args.append(masked_static)
+    packed, out_nodes = fn(*args)
+    packed = np.asarray(packed)                       # the ONE fetch
+    return packed[:T], packed[Tp:].astype(bool), out_nodes
