@@ -1,11 +1,12 @@
-"""Benchmark: allocate-action wall-clock, TPU engines vs the CPU callback
+"""Benchmark: allocate/preempt wall-clock, TPU engines vs the CPU callback
 path (BASELINE.md: ≥10x lower allocate wall-clock at 10k pods / 2k nodes
 with identical gang-admission decisions).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 - value: allocate-action ms/cycle, tpu-fused engine, 10k pods / 2k nodes
-  (BASELINE config 3: 3 queues, drf+proportion).
+  (BASELINE config 3: 3 queues, drf+proportion), best of 3 warm cycles,
+  with the host/device phase breakdown (order/solve/replay) as extras.
 - vs_baseline: measured speedup vs the CPU callbacks engine on the SAME
   workload. The callbacks engine replicates the reference's per-(task,node)
   plugin-callback architecture; at 10k x 2k it is intractable in-process, so
@@ -13,6 +14,10 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
   nodes, BASELINE config 2) — reported as measured, not extrapolated.
 - parity: gang admissions of the TPU engine must equal the callbacks engine
   at the parity config.
+- pods_per_sec: binds / allocate-cycle-seconds at the 10k config.
+- preempt (BASELINE config 4): 5k running + 5k pending / 1k nodes, device
+  engine ms + eviction-parity vs callbacks at a tractable config.
+- gpu (BASELINE config 5): 2k nodes x 8 GPUs topology binpack, tpu-fused.
 """
 
 from __future__ import annotations
@@ -42,7 +47,31 @@ def run_cycle(config: str, engine: str, seed: int = 0):
     return elapsed, admitted, len(binder.binds)
 
 
+def run_preempt(config: str, engine: str, seed: int = 0):
+    """One preempt cycle; returns (seconds, evicted set, pipelined count)."""
+    from volcano_tpu.actions import PreemptAction
+    from volcano_tpu.api import TaskStatus
+    from volcano_tpu.cache.synthetic import baseline_config
+    from volcano_tpu.framework import close_session, open_session, \
+        parse_scheduler_conf
+    import volcano_tpu.plugins  # noqa: F401
+
+    conf = parse_scheduler_conf(None)
+    cache, _, evictor = baseline_config(config, seed=seed)
+    ssn = open_session(cache, conf.tiers, [])
+    action = PreemptAction(engine=engine)
+    start = time.perf_counter()
+    action.execute(ssn)
+    elapsed = time.perf_counter() - start
+    npipe = sum(1 for j in ssn.jobs.values() for t in j.tasks.values()
+                if t.status == TaskStatus.PIPELINED)
+    close_session(ssn)
+    return elapsed, frozenset(evictor.evicts), npipe
+
+
 def main():
+    from volcano_tpu.actions import allocate as alloc_mod
+
     extras = {}
 
     # parity + speedup at config 2 (1k pods / 200 nodes)
@@ -61,9 +90,32 @@ def main():
     binds10k = 0
     for _ in range(3):
         s, _, nb = run_cycle("10k", "tpu-fused")
-        best = min(best, s)
+        if s < best:
+            best = s
+            extras.update(
+                order_ms=round(alloc_mod.LAST_STATS.get("order_s", 0) * 1e3, 1),
+                solve_ms=round(alloc_mod.LAST_STATS.get("solve_s", 0) * 1e3, 1),
+                replay_ms=round(alloc_mod.LAST_STATS.get("replay_s", 0) * 1e3, 1))
         binds10k = nb
-    extras.update(binds_10k=binds10k)
+    extras.update(binds_10k=binds10k,
+                  pods_per_sec=round(binds10k / best, 1))
+
+    # config 4: preempt mix — device engine at full scale, parity at 1/10th
+    p_cpu_s, p_cpu_evicts, _ = run_preempt("preempt-small", "callbacks")
+    run_preempt("preempt-small", "tpu")
+    p_tpu_small_s, p_tpu_evicts, _ = run_preempt("preempt-small", "tpu")
+    run_preempt("preempt", "tpu")                 # warm full-scale shapes
+    p_tpu_s, _, p_pipelined = run_preempt("preempt", "tpu")
+    extras.update(preempt_parity=p_cpu_evicts == p_tpu_evicts,
+                  preempt_cpu_small_ms=round(p_cpu_s * 1e3, 1),
+                  preempt_tpu_small_ms=round(p_tpu_small_s * 1e3, 1),
+                  preempt_tpu_ms=round(p_tpu_s * 1e3, 1),
+                  preempt_pipelined=p_pipelined)
+
+    # config 5: 2k nodes x 8 GPUs topology binpack
+    run_cycle("gpu", "tpu-fused")                 # warm
+    g_s, _, g_binds = run_cycle("gpu", "tpu-fused")
+    extras.update(gpu_ms=round(g_s * 1e3, 1), binds_gpu=g_binds)
 
     vs_baseline = (cpu_s / tpu1k_s) if tpu1k_s > 0 else 0.0
     print(json.dumps({

@@ -55,8 +55,12 @@ PREEMPT_TIERS = [
 ]
 
 
+ENGINES = ["callbacks", "tpu"]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
 class TestPreempt:
-    def test_high_priority_preempts_low(self):
+    def test_high_priority_preempts_low(self, engine):
         """Starving high-priority gang evicts a low-priority running task
         in the same queue and pipelines onto the freed node."""
         low = build_job("low", "default", 1,
@@ -67,13 +71,13 @@ class TestPreempt:
         cache, evictor = wire([low, high], [node],
                               [QueueInfo(name="default", weight=1)])
         ssn = open_session(cache, PREEMPT_TIERS, [])
-        PreemptAction().execute(ssn)
+        PreemptAction(engine=engine).execute(ssn)
         assert evictor.evicts == ["default/low-0"]
         # preemptor pipelined onto the node
         assert ssn.jobs["high"].tasks["high-0"].status == TaskStatus.PIPELINED
         assert ssn.jobs["high"].tasks["high-0"].node_name == "n1"
 
-    def test_no_preempt_equal_priority(self):
+    def test_no_preempt_equal_priority(self, engine):
         low = build_job("a", "default", 1,
                         [(3000, 3000, TaskStatus.RUNNING, "n1")], priority=5)
         high = build_job("b", "default", 1,
@@ -82,10 +86,10 @@ class TestPreempt:
         cache, evictor = wire([low, high], [node],
                               [QueueInfo(name="default", weight=1)])
         ssn = open_session(cache, PREEMPT_TIERS, [])
-        PreemptAction().execute(ssn)
+        PreemptAction(engine=engine).execute(ssn)
         assert evictor.evicts == []
 
-    def test_conformance_protects_critical(self):
+    def test_conformance_protects_critical(self, engine):
         low = build_job("sys", "default", 1,
                         [(3000, 3000, TaskStatus.RUNNING, "n1")], priority=1,
                         namespace="kube-system")
@@ -95,8 +99,86 @@ class TestPreempt:
         cache, evictor = wire([low, high], [node],
                               [QueueInfo(name="default", weight=1)])
         ssn = open_session(cache, PREEMPT_TIERS, [])
-        PreemptAction().execute(ssn)
+        PreemptAction(engine=engine).execute(ssn)
         assert evictor.evicts == []
+
+    def test_intra_job_preemption(self, engine):
+        """Phase 2 (preempt.go:146-183): a starving gang evicts its OWN
+        running task to make room for pending ones. Gang's priority guard
+        (tier 1) returns empty for same-job victims, so the dispatch falls
+        through to the conformance tier, which permits them."""
+        tiers = [Tier(plugins=[PluginOption("gang")]),
+                 Tier(plugins=[PluginOption("conformance")])]
+        job = build_job("j", "default", 2,
+                        [(3000, 3000, TaskStatus.RUNNING, "n1"),
+                         (3000, 3000, TaskStatus.PENDING, None),
+                         (3000, 3000, TaskStatus.PENDING, None)], priority=5)
+        node = NodeInfo(name="n1", allocatable=Resource(4000, 4000))
+        cache, evictor = wire([job], [node],
+                              [QueueInfo(name="default", weight=1)])
+        ssn = open_session(cache, tiers, [])
+        PreemptAction(engine=engine).execute(ssn)
+        assert evictor.evicts == ["default/j-0"]
+        pipelined = [t.uid for t in ssn.jobs["j"].tasks.values()
+                     if t.status == TaskStatus.PIPELINED]
+        assert pipelined == ["j-1"]
+
+    def test_gang_rollback_on_partial_preempt(self, engine):
+        """A starving gang of 2 with capacity for only 1 pipeline must not
+        evict anything (statement discard)."""
+        low = build_job("low", "default", 1,
+                        [(3000, 3000, TaskStatus.RUNNING, "n1")], priority=1)
+        high = build_job("high", "default", 2,
+                         [(3000, 3000, TaskStatus.PENDING, None),
+                          (3000, 3000, TaskStatus.PENDING, None)], priority=10)
+        node = NodeInfo(name="n1", allocatable=Resource(4000, 4000))
+        cache, evictor = wire([low, high], [node],
+                              [QueueInfo(name="default", weight=1)])
+        ssn = open_session(cache, PREEMPT_TIERS, [])
+        PreemptAction(engine=engine).execute(ssn)
+        assert evictor.evicts == []
+        assert ssn.jobs["high"].tasks["high-0"].status == TaskStatus.PENDING
+
+
+def _random_preempt_world(seed):
+    """A mixed cluster: running low-priority gangs + starving high-priority
+    gangs across several nodes."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    nodes = [NodeInfo(name=f"n{i}", allocatable=Resource(8000, 8000))
+             for i in range(6)]
+    jobs = []
+    perm = rng.permutation(6)
+    for i in range(6):       # running fillers, one job per node (capacity-safe)
+        node = f"n{perm[i]}"
+        jobs.append(build_job(
+            f"run{i}", "default", 1,
+            [(2000, 2000, TaskStatus.RUNNING, node) for _ in range(2)],
+            priority=int(rng.randint(1, 4))))
+    for i in range(4):       # starving preemptors
+        jobs.append(build_job(
+            f"hot{i}", "default", 2,
+            [(3000, 3000, TaskStatus.PENDING, None) for _ in range(2)],
+            priority=int(rng.randint(5, 9))))
+    return jobs, nodes, [QueueInfo(name="default", weight=1)]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_preempt_engine_parity(seed):
+    """Cross-engine eviction parity: the device engine and the callback
+    engine must evict the same victim set and pipeline the same preemptor
+    set (VERDICT r1 #3)."""
+    results = {}
+    for engine in ENGINES:
+        jobs, nodes, queues = _random_preempt_world(seed)
+        cache, evictor = wire(jobs, nodes, queues)
+        ssn = open_session(cache, PREEMPT_TIERS, [])
+        PreemptAction(engine=engine).execute(ssn)
+        pipelined = sorted(
+            t.uid for j in ssn.jobs.values() for t in j.tasks.values()
+            if t.status == TaskStatus.PIPELINED)
+        results[engine] = (sorted(evictor.evicts), pipelined)
+    assert results["tpu"] == results["callbacks"]
 
 
 RECLAIM_TIERS = [
@@ -106,8 +188,9 @@ RECLAIM_TIERS = [
 ]
 
 
+@pytest.mark.parametrize("engine", ENGINES)
 class TestReclaim:
-    def test_starved_queue_reclaims_from_overused(self):
+    def test_starved_queue_reclaims_from_overused(self, engine):
         """q2 holds the whole cluster; q1's pending job reclaims its share."""
         hog = build_job("hog", "q2", 1,
                         [(4000, 4000, TaskStatus.RUNNING, "n1")])
@@ -118,11 +201,11 @@ class TestReclaim:
             [hog, needy], [node],
             [QueueInfo(name="q1", weight=1), QueueInfo(name="q2", weight=1)])
         ssn = open_session(cache, RECLAIM_TIERS, [])
-        ReclaimAction().execute(ssn)
+        ReclaimAction(engine=engine).execute(ssn)
         assert evictor.evicts == ["default/hog-0"]
         assert ssn.jobs["needy"].tasks["needy-0"].status == TaskStatus.PIPELINED
 
-    def test_unreclaimable_queue_protected(self):
+    def test_unreclaimable_queue_protected(self, engine):
         hog = build_job("hog", "q2", 1,
                         [(4000, 4000, TaskStatus.RUNNING, "n1")])
         needy = build_job("needy", "q1", 1,
@@ -133,5 +216,40 @@ class TestReclaim:
             [QueueInfo(name="q1", weight=1),
              QueueInfo(name="q2", weight=1, reclaimable=False)])
         ssn = open_session(cache, RECLAIM_TIERS, [])
-        ReclaimAction().execute(ssn)
+        ReclaimAction(engine=engine).execute(ssn)
         assert evictor.evicts == []
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_reclaim_engine_parity(seed):
+    """Cross-engine reclaim parity on a multi-queue cluster."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+
+    def world():
+        nodes = [NodeInfo(name=f"n{i}", allocatable=Resource(8000, 8000))
+                 for i in range(4)]
+        jobs = []
+        for i in range(4):       # q2 hogs most of the cluster
+            node = f"n{i}"
+            jobs.append(build_job(
+                f"hog{i}", "q2", 1,
+                [(3000, 3000, TaskStatus.RUNNING, node) for _ in range(2)]))
+        for i in range(3):       # q1 pending reclaimers
+            jobs.append(build_job(
+                f"needy{i}", "q1", 1,
+                [(3000, 3000, TaskStatus.PENDING, None)]))
+        return jobs, nodes, [QueueInfo(name="q1", weight=1),
+                             QueueInfo(name="q2", weight=1)]
+
+    results = {}
+    for engine in ENGINES:
+        jobs, nodes, queues = world()
+        cache, evictor = wire(jobs, nodes, queues)
+        ssn = open_session(cache, RECLAIM_TIERS, [])
+        ReclaimAction(engine=engine).execute(ssn)
+        pipelined = sorted(
+            t.uid for j in ssn.jobs.values() for t in j.tasks.values()
+            if t.status == TaskStatus.PIPELINED)
+        results[engine] = (sorted(evictor.evicts), pipelined)
+    assert results["tpu"] == results["callbacks"]

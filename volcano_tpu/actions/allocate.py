@@ -412,6 +412,11 @@ def _fixed_job_order(ssn, assumed_admitted: Optional[set] = None) -> List:
     return ordered
 
 
+# Per-cycle phase timers of the last fused execution (seconds) — the
+# host/device breakdown bench.py reports (VERDICT r1 next-round #1).
+LAST_STATS: Dict[str, float] = {}
+
+
 def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4,
                    kernel: str = "auto", sharded: bool = False) -> None:
     """Fused executor: iterate (order simulation → one device solve) until
@@ -421,11 +426,16 @@ def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4,
     and overused gating."""
     assumed: Optional[set] = None
     solution = None
+    t_order = t_solve = 0.0
     for _ in range(max_order_iters):
+        t0 = time.perf_counter()
         ordered_jobs = _fixed_job_order(ssn, assumed)
+        t_order += time.perf_counter() - t0
         if not ordered_jobs:
             return
+        t0 = time.perf_counter()
         solution = _solve_fused(ssn, ordered_jobs, blocks, kernel, sharded)
+        t_solve += time.perf_counter() - t0
         if solution is None:
             return
         kept_uids = {solution.jobs_list[jx].uid
@@ -438,7 +448,10 @@ def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4,
                 and kept_uids == {j.uid for j in ordered_jobs}):
             break
         assumed = kept_uids
+    t0 = time.perf_counter()
     _replay_fused(ssn, solution)
+    LAST_STATS.update(order_s=t_order, solve_s=t_solve,
+                      replay_s=time.perf_counter() - t0)
 
 
 class _FusedSolution:

@@ -36,8 +36,22 @@ def sort_nodes(node_scores) -> List:
 
 class PreemptAction(Action):
     NAME = "preempt"
+    DEFAULT_ENGINE = "callbacks"
+
+    def __init__(self, engine: Optional[str] = None):
+        self.engine = engine or self.DEFAULT_ENGINE
 
     def execute(self, ssn) -> None:
+        engine = self.engine
+        for conf in ssn.configurations:
+            if conf.name == self.NAME:
+                engine = conf.arguments.get("engine", engine)
+        if engine == "tpu":
+            from .evict_tpu import execute_preempt_tpu
+            return execute_preempt_tpu(ssn)
+        return self._execute_callbacks(ssn)
+
+    def _execute_callbacks(self, ssn) -> None:
         preemptors_map = {}
         preemptor_tasks = {}
         under_request = []

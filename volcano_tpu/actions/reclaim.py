@@ -7,6 +7,8 @@ Reclaimable dispatch; eviction is direct (ssn.evict, no statement).
 
 from __future__ import annotations
 
+from typing import Optional
+
 from ..api import PodGroupPhase, Resource, TaskStatus
 from ..utils import PriorityQueue
 from .base import Action
@@ -14,8 +16,22 @@ from .base import Action
 
 class ReclaimAction(Action):
     NAME = "reclaim"
+    DEFAULT_ENGINE = "callbacks"
+
+    def __init__(self, engine: Optional[str] = None):
+        self.engine = engine or self.DEFAULT_ENGINE
 
     def execute(self, ssn) -> None:
+        engine = self.engine
+        for conf in ssn.configurations:
+            if conf.name == self.NAME:
+                engine = conf.arguments.get("engine", engine)
+        if engine == "tpu":
+            from .evict_tpu import execute_reclaim_tpu
+            return execute_reclaim_tpu(ssn)
+        return self._execute_callbacks(ssn)
+
+    def _execute_callbacks(self, ssn) -> None:
         queues = PriorityQueue(ssn.queue_order_fn)
         queue_set = set()
         preemptors_map = {}

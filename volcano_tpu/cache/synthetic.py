@@ -121,6 +121,13 @@ def baseline_config(name: str, seed: int = 0):
         jobs = make_jobs(10000, 200, ["q1", "q2"], running_fraction=0.5,
                          nodes=nodes, seed=seed)
         queues = [QueueInfo(name="q1", weight=1), QueueInfo(name="q2", weight=1)]
+    elif name == "preempt-small":
+        # 1/10th preempt mix — the largest config where the callback engine
+        # stays tractable for the eviction-parity comparison
+        nodes = make_cluster(100, seed=seed)
+        jobs = make_jobs(1000, 20, ["q1", "q2"], running_fraction=0.5,
+                         nodes=nodes, seed=seed)
+        queues = [QueueInfo(name="q1", weight=1), QueueInfo(name="q2", weight=1)]
     elif name == "gpu":
         nodes = make_cluster(2000, gpus=8, seed=seed)
         jobs = make_jobs(8000, 160, ["default"], gpus_per_task=1, seed=seed)

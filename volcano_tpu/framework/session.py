@@ -108,6 +108,9 @@ class Session:
         # (gpu card packing, numa cpusets): batched engines must re-validate
         # device proposals through predicate_fn at replay time
         self.stateful_predicates: set = set()
+        # proportion publishes its per-queue deserved vectors here so the
+        # device reclaim engine can replay its tier in-kernel
+        self.queue_deserved: Dict[str, "Resource"] = {}
 
     # -- registration helpers (AddXxxFn of session_plugins.go) --------------
 

@@ -1,0 +1,353 @@
+"""Device-side victim selection for preempt/reclaim — "negative allocation"
+over the same score matrices the allocate kernels use (SURVEY M3).
+
+The reference's eviction hot loop is per (preemptor, node, running-task)
+Python callbacks (/root/reference/pkg/scheduler/actions/preempt/
+preempt.go:190-269 with the tiered Preemptable dispatch of
+session_plugins.go:187-236). Here the search runs on device, including the
+FULL tier semantics:
+
+- node scores ``f32[P,N]`` are computed ONCE per action — the dynamic
+  scorers (binpack/least/most/balanced) read node ``used``, which eviction
+  does not change (an evicted task moves its resources to ``releasing``;
+  ``used`` drops only when the pod actually terminates), so the matrix is
+  exact for the whole scan;
+- tier dispatch is replayed per (preemptor, node): a tier's verdict stands
+  only if EVERY participating plugin returns a non-empty candidate set on
+  that node; an empty set makes the tier abstain and the next tier rules
+  (session_plugins.go: ``if len(candidates) == 0 { victims = nil; break }``).
+  Static plugin verdicts (priority/gang guards, conformance critical pods,
+  tdm windows) are host-precomputed ``[PJ,V]`` masks; the drf tier is
+  DYNAMIC — job dominant shares are tracked in the scan carry exactly as
+  drf's event handlers would (allocate on pipeline, deallocate on evict),
+  including the within-dispatch sequential subtraction of earlier
+  candidates of the same job (drf.go:308-330) via a candidate-order
+  lower-triangular same-(node,job) matmul;
+- per preemptor: evictable capacity per node via one [V,R]x[V,N] einsum,
+  feasibility = future_idle + evictable >= request AND at least one victim
+  (validate_victims rejects empty lists), best node by argmax of the masked
+  score row, victims evicted lowest-priority-first (host-presorted order)
+  while the node does not yet fit — the reference's pop-until-fit loop;
+- job boundaries carry gang statement semantics: snapshots on the first
+  task of a job, rollback (alive mask, future_idle, shares, victim owners)
+  when the job misses its pipeline quota — Statement.Commit/Discard on
+  device.
+
+The host replays the returned proposals through real Statements (gang
+atomicity, plugin event handlers), so the cache/session end state is
+produced by the same machinery as the callback engine.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .dense import EPS
+
+NO_NODE = -1
+BIG = 1 << 30
+SHARE_DELTA = 1e-6          # plugins/drf.py SHARE_DELTA (drf.go:37)
+
+
+def _share(alloc, total):
+    """calculate_share (drf.go / plugins/drf.py:40-49) vectorized over the
+    trailing resource dim: max over dims of alloc/total (1.0 when total==0
+    but alloc>0)."""
+    ratio = jnp.where(total > 0, alloc / jnp.where(total > 0, total, 1.0),
+                      jnp.where(alloc > 0, 1.0, 0.0))
+    return jnp.max(ratio, axis=-1)
+
+
+@functools.lru_cache(maxsize=16)
+def build_preempt_scan(tier_kinds: Tuple[str, ...],
+                       tier_sizes: Tuple[int, ...],
+                       gang_commit: bool):
+    """Compile a preempt scan for one tier structure.
+
+    tier_kinds[i] is "static" or "drf"; tier_sizes[i] is the number of
+    static plugin masks in tier i (the drf tier may also carry static
+    co-plugins). The returned jitted fn takes:
+
+      (future_idle0 [N,R], vreq [V,R], vnode [V], cand_mask [PJ,V],
+       tier_masks  — tuple per tier of tuples (mask [PJ,V], part [PJ]),
+       preq [P,R], pjob [P], first_of_job [P], score [P,N], needed [PJ],
+       vjob [V], pjg [P], jalloc0 [AJ,R], total [R], same_group [V,V])
+
+    and returns (task_node i32[P], victim_owner i32[V], job_done bool[PJ]).
+    """
+
+    def scan_fn(future_idle0, vreq, vnode, cand_mask, tier_masks,
+                preq, pjob, first_of_job, score, needed,
+                vjob, pjg, jalloc0, total, same_group):
+        N, R = future_idle0.shape
+        V = vreq.shape[0]
+        P = preq.shape[0]
+        PJ = needed.shape[0]
+        AJ = jalloc0.shape[0]
+        node_onehot = jax.nn.one_hot(vnode, N, dtype=preq.dtype)   # [V,N]
+        fdtype = preq.dtype
+
+        def eligibility(alive, jalloc, pj, pjg_i, req):
+            """Replay the tiered dispatch for this preemptor against every
+            node at once; returns the eligible-victim mask [V]."""
+            cand = alive & cand_mask[pj]
+            cand_f = cand.astype(fdtype)
+            decided_n = jnp.zeros(N, bool)
+            elig = jnp.zeros(V, bool)
+            for kind, masks in zip(tier_kinds, tier_masks):
+                tset = cand
+                ok_n = jnp.ones(N, bool)
+                participated = jnp.zeros((), bool)
+                for m, part in masks:
+                    row_on = part[pj]
+                    pm = m[pj] | ~row_on
+                    tset = tset & pm
+                    cnt = jnp.einsum("v,vn->n",
+                                     (cand & m[pj]).astype(fdtype),
+                                     node_onehot)
+                    ok_n = ok_n & ((cnt > 0) | ~row_on)
+                    participated = participated | row_on
+                if kind == "drf":
+                    # drf.go:308-330 — subtract earlier same-job candidates
+                    # (in candidate-list order) before comparing shares
+                    prior = (same_group.astype(fdtype)
+                             * cand_f[None, :]) @ vreq          # [V,R]
+                    ralloc = jalloc[vjob] - prior - vreq
+                    rs = _share(ralloc, total)                   # [V]
+                    ls = _share(jalloc[pjg_i] + req, total)      # scalar
+                    dset = cand & ((ls < rs)
+                                   | (jnp.abs(ls - rs) <= SHARE_DELTA))
+                    tset = tset & dset
+                    dcnt = jnp.einsum("v,vn->n", dset.astype(fdtype),
+                                      node_onehot)
+                    ok_n = ok_n & (dcnt > 0)
+                    participated = jnp.ones((), bool)
+                ok_n = ok_n & participated
+                take_n = ok_n & ~decided_n
+                elig = elig | (tset & take_n[vnode])
+                decided_n = decided_n | ok_n
+            return elig
+
+        class Carry(NamedTuple):
+            alive: jnp.ndarray
+            fidle: jnp.ndarray
+            jalloc: jnp.ndarray
+            pipe_cnt: jnp.ndarray
+            owner: jnp.ndarray
+            stopped: jnp.ndarray
+            s_alive: jnp.ndarray
+            s_fidle: jnp.ndarray
+            s_jalloc: jnp.ndarray
+            s_owner: jnp.ndarray
+
+        def step(c: Carry, xs):
+            p_ix, req, pj, pjg_i, first, prev_pj = xs
+
+            if gang_commit:
+                # close the PREVIOUS job's statement: rollback on missed
+                # quota (the final boundary is handled after the scan)
+                failed = first & (prev_pj >= 0) & \
+                    (c.pipe_cnt[prev_pj] < needed[prev_pj])
+                c = c._replace(
+                    alive=jnp.where(failed, c.s_alive, c.alive),
+                    fidle=jnp.where(failed, c.s_fidle, c.fidle),
+                    jalloc=jnp.where(failed, c.s_jalloc, c.jalloc),
+                    owner=jnp.where(failed, c.s_owner, c.owner),
+                    pipe_cnt=jnp.where(
+                        failed, c.pipe_cnt.at[prev_pj].set(-BIG),
+                        c.pipe_cnt))
+                c = c._replace(
+                    s_alive=jnp.where(first, c.alive, c.s_alive),
+                    s_fidle=jnp.where(first, c.fidle, c.s_fidle),
+                    s_jalloc=jnp.where(first, c.jalloc, c.s_jalloc),
+                    s_owner=jnp.where(first, c.owner, c.s_owner))
+
+            active = c.pipe_cnt[pj] < needed[pj]
+            if not gang_commit:
+                active = active & ~c.stopped[pj]
+
+            elig = eligibility(c.alive, c.jalloc, pj, pjg_i, req)
+            elig_f = elig[:, None].astype(fdtype)
+            evictable = jnp.einsum("vr,vn->nr", vreq * elig_f, node_onehot)
+            # a node is only a preemption target if it hosts at least one
+            # eligible victim (validate_victims rejects empty victim lists)
+            has_victim = jnp.einsum("v,vn->n", elig.astype(fdtype),
+                                    node_onehot) > 0
+            fits = (jnp.all(req[None, :] < c.fidle + evictable + EPS,
+                            axis=-1) & has_victim)
+            row = jnp.where(fits, score[p_ix], -jnp.inf)
+            best = jnp.argmax(row)
+            ok = active & (row[best] > -jnp.inf)
+
+            # pop-until-fit on the chosen node in host-presorted victim
+            # order: victim v is evicted iff the node does not yet fit
+            # before it
+            on_node = (elig & (vnode == best))[:, None].astype(fdtype)
+            cum_excl = jnp.cumsum(vreq * on_node, axis=0) - vreq * on_node
+            fit_before = jnp.all(
+                req[None, :] < c.fidle[best][None] + cum_excl + EPS, axis=-1)
+            evicted = (on_node[:, 0] > 0) & ~fit_before & ok
+
+            freed = jnp.sum(vreq * evicted[:, None].astype(fdtype), axis=0)
+            delta = (freed - req) * ok.astype(fdtype)
+            jalloc = c.jalloc - jax.ops.segment_sum(
+                vreq * evicted[:, None].astype(fdtype), vjob,
+                num_segments=AJ)
+            jalloc = jalloc.at[pjg_i].add(req * ok.astype(fdtype))
+            c = c._replace(
+                fidle=c.fidle.at[best].add(delta),
+                alive=c.alive & ~evicted,
+                jalloc=jalloc,
+                owner=jnp.where(evicted, p_ix, c.owner),
+                pipe_cnt=c.pipe_cnt.at[pj].add(ok.astype(jnp.int32)),
+                stopped=c.stopped.at[pj].set(c.stopped[pj]
+                                             | (active & ~ok)))
+            out_node = jnp.where(ok, best, NO_NODE).astype(jnp.int32)
+            return c, out_node
+
+        c0 = Carry(
+            alive=jnp.ones(V, bool), fidle=future_idle0, jalloc=jalloc0,
+            pipe_cnt=jnp.zeros(PJ, jnp.int32),
+            owner=jnp.full(V, -1, jnp.int32), stopped=jnp.zeros(PJ, bool),
+            s_alive=jnp.ones(V, bool), s_fidle=future_idle0,
+            s_jalloc=jalloc0, s_owner=jnp.full(V, -1, jnp.int32))
+
+        prev_pj = jnp.concatenate([jnp.full(1, -1, jnp.int32), pjob[:-1]])
+        xs = (jnp.arange(P), preq, pjob, pjg, first_of_job, prev_pj)
+        c, task_node = jax.lax.scan(step, c0, xs)
+
+        if gang_commit:
+            last_pj = pjob[-1]
+            failed = c.pipe_cnt[last_pj] < needed[last_pj]
+            c = c._replace(
+                alive=jnp.where(failed, c.s_alive, c.alive),
+                owner=jnp.where(failed, c.s_owner, c.owner),
+                pipe_cnt=jnp.where(failed,
+                                   c.pipe_cnt.at[last_pj].set(-BIG),
+                                   c.pipe_cnt))
+
+        job_done = c.pipe_cnt >= needed
+        if gang_commit:
+            # gang statements: only quota-met jobs keep their placements.
+            # The intra-job phase commits every attempt (needed is a BIG
+            # sentinel there, so this mask would wrongly discard everything).
+            task_node = jnp.where(job_done[pjob], task_node, NO_NODE)
+        return task_node, c.owner, job_done
+
+    return jax.jit(scan_fn)
+
+
+@functools.lru_cache(maxsize=16)
+def build_reclaim_scan(tier_kinds: Tuple[str, ...],
+                       tier_sizes: Tuple[int, ...]):
+    """Compile a reclaim scan for one tier structure (reclaim.go:40-192).
+
+    Node walk takes the FIRST node (index order — the reference iterates
+    ssn.Nodes without scoring) where the eligible victims alone cover the
+    reclaimer's request; victims are evicted until reclaimed >= resreq;
+    evictions are direct (no statement rollback). Rotation quirks are
+    reproduced: a job leaves its queue's rotation at its first failed task,
+    and a queue leaves the action when some job ran all its tasks without a
+    failure (the reference's continue paths skip the queue re-push).
+
+    The "proportion" tier is dynamic: a victim's queue must be allocated
+    above deserved in some dimension and still hold the victim's resources
+    (proportion.go:246-271), with queue allocations tracked in the carry —
+    evictions subtract, reclaimer pipelines add.
+
+    Returned fn takes:
+      (future_idle0 [N,R], vreq [V,R], vnode [V], cand_mask [PJ,V],
+       tier_masks, preq [P,R], pjob [P], pqueue [P], last_of_job [P],
+       vqueue [V], qalloc0 [Q,R], qdeserved [Q,R], n_queues static)
+    and returns (task_node i32[P], victim_owner i32[V]).
+    """
+
+    def scan_fn(future_idle0, vreq, vnode, cand_mask, tier_masks,
+                preq, pjob, pqueue, last_of_job, vqueue, qalloc0, qdeserved):
+        N, R = future_idle0.shape
+        V = vreq.shape[0]
+        P = preq.shape[0]
+        PJ = cand_mask.shape[0]
+        Q = qalloc0.shape[0]
+        node_onehot = jax.nn.one_hot(vnode, N, dtype=preq.dtype)
+        fdtype = preq.dtype
+
+        def eligibility(alive, qalloc, pj):
+            cand = alive & cand_mask[pj]
+            decided_n = jnp.zeros(N, bool)
+            elig = jnp.zeros(V, bool)
+            for kind, masks in zip(tier_kinds, tier_masks):
+                tset = cand
+                ok_n = jnp.ones(N, bool)
+                participated = jnp.zeros((), bool)
+                for m, part in masks:
+                    row_on = part[pj]
+                    pm = m[pj] | ~row_on
+                    tset = tset & pm
+                    cnt = jnp.einsum("v,vn->n",
+                                     (cand & m[pj]).astype(fdtype),
+                                     node_onehot)
+                    ok_n = ok_n & ((cnt > 0) | ~row_on)
+                    participated = participated | row_on
+                if kind == "proportion":
+                    over = jnp.any(qalloc > qdeserved + EPS, axis=-1)  # [Q]
+                    # skip only when allocated < resreq in EVERY dim
+                    # (proportion.go: allocated.Less(reclaimee.Resreq))
+                    holds = jnp.any(qalloc[vqueue] - vreq > -EPS, axis=-1)
+                    pset = cand & over[vqueue] & holds
+                    tset = tset & pset
+                    pcnt = jnp.einsum("v,vn->n", pset.astype(fdtype),
+                                      node_onehot)
+                    ok_n = ok_n & (pcnt > 0)
+                    participated = jnp.ones((), bool)
+                ok_n = ok_n & participated
+                take_n = ok_n & ~decided_n
+                elig = elig | (tset & take_n[vnode])
+                decided_n = decided_n | ok_n
+            return elig
+
+        def step(c, xs):
+            alive, fidle, qalloc, owner, job_stop, queue_stop = c
+            p_ix, req, pj, pq, last = xs
+
+            active = ~job_stop[pj] & ~queue_stop[pq]
+            elig = eligibility(alive, qalloc, pj)
+            elig_f = elig[:, None].astype(fdtype)
+            evictable = jnp.einsum("vr,vn->nr", vreq * elig_f, node_onehot)
+            covers = jnp.all(req[None, :] < fidle + evictable + EPS, axis=-1)
+            enough = jnp.all(req[None, :] < evictable + EPS, axis=-1)
+            fits = covers & enough
+            best = jnp.argmax(fits)              # first feasible node
+            ok = active & fits[best]
+
+            on_node = (elig & (vnode == best))[:, None].astype(fdtype)
+            cum_excl = jnp.cumsum(vreq * on_node, axis=0) - vreq * on_node
+            enough_before = jnp.all(req[None, :] < cum_excl + EPS, axis=-1)
+            evicted = (on_node[:, 0] > 0) & ~enough_before & ok
+
+            freed = jnp.sum(vreq * evicted[:, None].astype(fdtype), axis=0)
+            fidle = fidle.at[best].add((freed - req) * ok.astype(fdtype))
+            qalloc = qalloc - jax.ops.segment_sum(
+                vreq * evicted[:, None].astype(fdtype), vqueue,
+                num_segments=Q)
+            qalloc = qalloc.at[pq].add(req * ok.astype(fdtype))
+            alive = alive & ~evicted
+            owner = jnp.where(evicted, p_ix, owner)
+            job_stop = job_stop.at[pj].set(job_stop[pj] | (active & ~ok))
+            queue_stop = queue_stop.at[pq].set(queue_stop[pq] | (ok & last))
+            out_node = jnp.where(ok, best, NO_NODE).astype(jnp.int32)
+            return (alive, fidle, qalloc, owner, job_stop, queue_stop), \
+                out_node
+
+        c0 = (jnp.ones(V, bool), future_idle0, qalloc0,
+              jnp.full(V, -1, jnp.int32), jnp.zeros(PJ, bool),
+              jnp.zeros(Q, bool))
+        xs = (jnp.arange(P), preq, pjob, pqueue, last_of_job)
+        (_, _, _, owner, _, _), task_node = jax.lax.scan(step, c0, xs)
+        return task_node, owner
+
+    return jax.jit(scan_fn)

@@ -27,23 +27,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops.auction import K_CAND
 from ..ops.dense import EPS
+from ..ops.pallas_place import NEG, NEG_TEST
 from ..ops.place import NO_NODE, JobMeta, NodeState
 from ..ops.scores import ScoreWeights, combined_dynamic_score
 
 NODE_AXIS = "nodes"
 
-# statically-infeasible sentinel, shared with ops/pallas_place.py
-NEG = -1e30
-NEG_TEST = -1e29
-
 
 def make_mesh(devices=None, axis: str = NODE_AXIS) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     return Mesh(np.asarray(devices), (axis,))
-
-
-K_CAND = 8
 
 
 def _sharded_chunk_step(axis: str, has_ms: bool):

@@ -120,6 +120,9 @@ class ProportionPlugin(Plugin):
             for i, attr in enumerate(attrs):
                 attr.deserved = Resource.from_vector(deserved[i], rnames)
                 attr._share_dirty = True
+                # expose deserved to the device reclaim engine's
+                # proportion-tier replay (actions/evict_tpu.py)
+                ssn.queue_deserved[attr.name] = attr.deserved
                 metrics.update_queue_metrics(
                     attr.name, attr.allocated.cpu, attr.allocated.memory,
                     attr.deserved.cpu, attr.deserved.memory, attr.share,
