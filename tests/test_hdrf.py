@@ -1,0 +1,107 @@
+"""Hierarchical DRF tests, ported from the reference's
+pkg/scheduler/plugins/drf/hdrf_test.go: run a real allocate action with the
+drf plugin in hierarchy mode and assert per-job allocated totals."""
+
+import pytest
+
+from volcano_tpu.actions import AllocateAction
+from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
+                             QueueInfo, Resource, TaskInfo)
+from volcano_tpu.api.queue_info import (KUBE_HIERARCHY_ANNOTATION_KEY,
+                                        KUBE_HIERARCHY_WEIGHT_ANNOTATION_KEY)
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.framework import PluginOption, Tier, open_session
+import volcano_tpu.plugins  # noqa: F401
+
+G = 1_000_000_000  # hdrf_test.go uses decimal giga for memory
+
+
+def make_queue(name, hierarchy, weights):
+    return QueueInfo(name=name, weight=1, annotations={
+        KUBE_HIERARCHY_ANNOTATION_KEY: hierarchy,
+        KUBE_HIERARCHY_WEIGHT_ANNOTATION_KEY: weights,
+    })
+
+
+def make_job(pg, queue, num, cpu_milli, mem):
+    podgroup = PodGroup(name=pg, queue=queue, min_member=1,
+                        phase=PodGroupPhase.INQUEUE)
+    job = JobInfo(uid=pg, name=pg, queue=queue, min_available=1,
+                  podgroup=podgroup)
+    for i in range(num):
+        job.add_task_info(TaskInfo(
+            uid=f"{pg}-p{i}", name=f"{pg}-p{i}", job=pg,
+            resreq=Resource(cpu_milli, mem), creation_timestamp=float(i)))
+    return job
+
+
+HDRF_TIERS = [Tier(plugins=[
+    PluginOption("drf", enabled={"enabledHierarchy": True}),
+    PluginOption("gang"),
+])]
+
+
+def run_case(node_res, queues, jobs, engine="callbacks"):
+    binder = FakeBinder()
+    cache = SchedulerCache(binder=binder, evictor=FakeEvictor())
+    for q in queues:
+        cache.add_queue(q)
+    alloc = node_res
+    alloc.max_task_num = 1000
+    cache.add_node(NodeInfo(name="n", allocatable=alloc))
+    for j in jobs:
+        cache.add_job(j)
+    ssn = open_session(cache, HDRF_TIERS, [])
+    AllocateAction(engine=engine).execute(ssn)
+    allocated = {}
+    for job in ssn.jobs.values():
+        total = Resource()
+        for t in job.tasks.values():
+            if t.status.name in ("ALLOCATED", "BINDING", "BOUND"):
+                total.add(t.resreq)
+        allocated[job.uid] = total
+    return allocated
+
+
+def test_hdrf_rescaling():
+    """hdrf_test.go 'rescaling test': sci gets half of both resources;
+    eng splits its half between a cpu-only and a mem-only job."""
+    queues = [
+        make_queue("root-sci", "root/sci", "100/50"),
+        make_queue("root-eng-dev", "root/eng/dev", "100/50/50"),
+        make_queue("root-eng-prod", "root/eng/prod", "100/50/50"),
+    ]
+    jobs = [
+        make_job("pg1", "root-sci", 10, 1000, 1 * G),
+        make_job("pg21", "root-eng-dev", 10, 1000, 0),
+        make_job("pg22", "root-eng-prod", 10, 0, 1 * G),
+    ]
+    allocated = run_case(Resource(10_000, 10 * G), queues, jobs)
+    assert allocated["pg1"].cpu == 5000 and allocated["pg1"].memory == 5 * G
+    assert allocated["pg21"].cpu == 5000 and allocated["pg21"].memory == 0
+    assert allocated["pg22"].cpu == 0 and allocated["pg22"].memory == 5 * G
+
+
+def test_hdrf_blocking_nodes():
+    """hdrf_test.go 'blocking nodes test': a saturated sibling must not
+    block its parent's other children from getting their share."""
+    queues = [
+        make_queue("root-pg1", "root/pg1", "100/25"),
+        make_queue("root-pg2", "root/pg2", "100/25"),
+        make_queue("root-pg3-pg31", "root/pg3/pg31", "100/25/50"),
+        make_queue("root-pg3-pg32", "root/pg3/pg32", "100/25/50"),
+        make_queue("root-pg4", "root/pg4", "100/25"),
+    ]
+    jobs = [
+        make_job("pg1", "root-pg1", 30, 1000, 0),
+        make_job("pg2", "root-pg2", 30, 1000, 0),
+        make_job("pg31", "root-pg3-pg31", 30, 1000, 0),
+        make_job("pg32", "root-pg3-pg32", 30, 0, 1 * G),
+        make_job("pg4", "root-pg4", 30, 0, 1 * G),
+    ]
+    allocated = run_case(Resource(30_000, 30 * G), queues, jobs)
+    assert allocated["pg1"].cpu == 10_000
+    assert allocated["pg2"].cpu == 10_000
+    assert allocated["pg31"].cpu == 10_000
+    assert allocated["pg32"].memory == 15 * G
+    assert allocated["pg4"].memory == 15 * G

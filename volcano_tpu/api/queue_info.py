@@ -13,6 +13,10 @@ from .types import QueueState
 
 DEFAULT_NAMESPACE_WEIGHT = 1
 
+# scheduling/v1beta1 annotation keys (vendor/volcano.sh/apis labels.go:19-21)
+KUBE_HIERARCHY_ANNOTATION_KEY = "volcano.sh/hierarchy"
+KUBE_HIERARCHY_WEIGHT_ANNOTATION_KEY = "volcano.sh/hierarchy-weights"
+
 
 class QueueSpec:
     """scheduling/v1beta1 Queue spec mirror."""
@@ -43,6 +47,15 @@ class QueueInfo:
         self.reclaimable = reclaimable
         self.state = state
         self.annotations = dict(annotations or {})
+
+    @property
+    def hierarchy(self) -> str:
+        """Slash-separated path in the queue tree (queue_info.go:40-55)."""
+        return self.annotations.get(KUBE_HIERARCHY_ANNOTATION_KEY, "")
+
+    @property
+    def hierarchy_weights(self) -> str:
+        return self.annotations.get(KUBE_HIERARCHY_WEIGHT_ANNOTATION_KEY, "")
 
     @classmethod
     def from_spec(cls, spec: QueueSpec) -> "QueueInfo":
