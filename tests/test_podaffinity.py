@@ -1,0 +1,237 @@
+"""Inter-pod affinity/anti-affinity tests — the k8s InterPodAffinity filter
+and batch scorer wrapped by the reference (predicates.go:330-338,
+nodeorder.go:269-340), rebuilt as pairwise mask/score tensors."""
+
+import pytest
+
+from volcano_tpu.actions import AllocateAction
+from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
+                             QueueInfo, Resource, TaskInfo, TaskStatus)
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.framework import PluginOption, Tier, open_session
+from volcano_tpu.plugins.podaffinity import (PodAffinityIndex,
+                                             match_label_selector)
+import volcano_tpu.plugins  # noqa: F401
+
+GI = 1 << 30
+
+TIERS = [Tier(plugins=[PluginOption("gang"), PluginOption("priority"),
+                       PluginOption("predicates"),
+                       PluginOption("nodeorder")])]
+
+
+def build_node(name, labels=None, zone=None):
+    labels = dict(labels or {})
+    labels["kubernetes.io/hostname"] = name
+    if zone:
+        labels["topology.kubernetes.io/zone"] = zone
+    alloc = Resource(8000, 16 * GI)
+    alloc.max_task_num = 110
+    return NodeInfo(name=name, allocatable=alloc, labels=labels)
+
+
+def build_world(nodes, running=(), pending=()):
+    """running: (name, node, labels, affinity); pending: (name, labels,
+    affinity)."""
+    binder = FakeBinder()
+    cache = SchedulerCache(binder=binder, evictor=FakeEvictor())
+    cache.add_queue(QueueInfo(name="default", weight=1))
+    node_map = {n.name: n for n in nodes}
+    for n in nodes:
+        cache.add_node(n)
+    jobs = []
+    for name, node, labels, affinity in running:
+        pg = PodGroup(name=name, queue="default", min_member=1,
+                      phase=PodGroupPhase.RUNNING)
+        job = JobInfo(uid=name, name=name, queue="default", min_available=1,
+                      podgroup=pg)
+        t = TaskInfo(uid=f"{name}-0", name=f"{name}-0", job=name,
+                     resreq=Resource(1000, 1 * GI),
+                     status=TaskStatus.RUNNING, labels=labels,
+                     affinity=affinity or {})
+        job.add_task_info(t)
+        node_map[node].add_task(job.tasks[t.uid])
+        jobs.append(job)
+    for name, labels, affinity in pending:
+        pg = PodGroup(name=name, queue="default", min_member=1,
+                      phase=PodGroupPhase.INQUEUE)
+        job = JobInfo(uid=name, name=name, queue="default", min_available=1,
+                      podgroup=pg)
+        job.add_task_info(TaskInfo(
+            uid=f"{name}-0", name=f"{name}-0", job=name,
+            resreq=Resource(1000, 1 * GI), labels=labels,
+            affinity=affinity or {}))
+        jobs.append(job)
+    for j in jobs:
+        cache.add_job(j)
+    return cache, binder
+
+
+def required(selector, topology="kubernetes.io/hostname"):
+    return {"labelSelector": selector, "topologyKey": topology}
+
+
+ENGINES = ["callbacks", "tpu-fused"]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_required_affinity_colocates(engine):
+    """A pod requiring affinity to app=web must land on the node (hostname
+    domain) hosting the web pod."""
+    nodes = [build_node(f"n{i}") for i in range(4)]
+    aff = {"podAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution":
+            [required({"matchLabels": {"app": "web"}})]}}
+    cache, binder = build_world(
+        nodes,
+        running=[("web", "n2", {"app": "web"}, None)],
+        pending=[("cli", {"app": "cli"}, aff)])
+    ssn = open_session(cache, TIERS, [])
+    AllocateAction(engine=engine).execute(ssn)
+    assert binder.binds == {"default/cli-0": "n2"}
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_required_anti_affinity_spreads(engine):
+    """Anti-affinity to itself: the second replica must avoid the first
+    one's node."""
+    nodes = [build_node(f"n{i}") for i in range(2)]
+    anti = {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution":
+            [required({"matchLabels": {"app": "db"}})]}}
+    cache, binder = build_world(
+        nodes,
+        running=[("db0", "n0", {"app": "db"}, anti)],
+        pending=[("db1", {"app": "db"}, anti)])
+    ssn = open_session(cache, TIERS, [])
+    AllocateAction(engine=engine).execute(ssn)
+    assert binder.binds == {"default/db1-0": "n1"}
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_symmetric_anti_affinity(engine):
+    """An EXISTING pod's required anti-affinity rejects a matching incoming
+    pod from its domain even when the incoming pod has no terms."""
+    nodes = [build_node(f"n{i}") for i in range(2)]
+    anti = {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution":
+            [required({"matchLabels": {"team": "red"}})]}}
+    cache, binder = build_world(
+        nodes,
+        running=[("lonely", "n0", {"team": "blue"}, anti)],
+        pending=[("red", {"team": "red"}, None)])
+    ssn = open_session(cache, TIERS, [])
+    AllocateAction(engine=engine).execute(ssn)
+    assert binder.binds == {"default/red-0": "n1"}
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_zone_topology_domain(engine):
+    """Affinity over a zone topologyKey admits every node of the zone."""
+    nodes = [build_node("n0", zone="a"), build_node("n1", zone="a"),
+             build_node("n2", zone="b")]
+    aff = {"podAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution":
+            [required({"matchLabels": {"app": "web"}},
+                      topology="topology.kubernetes.io/zone")]}}
+    cache, binder = build_world(
+        nodes,
+        running=[("web", "n0", {"app": "web"}, None)],
+        pending=[("cli", {"app": "cli"}, aff)])
+    ssn = open_session(cache, TIERS, [])
+    AllocateAction(engine=engine).execute(ssn)
+    assert binder.binds["default/cli-0"] in ("n0", "n1")
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_in_cycle_anti_affinity(engine):
+    """Two pending replicas with self anti-affinity scheduled in ONE cycle
+    must land on different nodes — the second sees the first's in-cycle
+    placement (stateful predicate re-check on batched engines)."""
+    nodes = [build_node(f"n{i}") for i in range(2)]
+    anti = {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution":
+            [required({"matchLabels": {"app": "db"}})]}}
+    cache, binder = build_world(
+        nodes,
+        pending=[("da", {"app": "db"}, anti), ("db", {"app": "db"}, anti)])
+    ssn = open_session(cache, TIERS, [])
+    AllocateAction(engine=engine).execute(ssn)
+    assert len(binder.binds) == 2
+    assert binder.binds["default/da-0"] != binder.binds["default/db-0"]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_self_affinity_bootstrap(engine):
+    """The first pod of a self-affine group must be able to start the group
+    (k8s bootstrap allowance), and the second must co-locate with it."""
+    nodes = [build_node(f"n{i}") for i in range(3)]
+    aff = {"podAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution":
+            [required({"matchLabels": {"app": "db"}})]}}
+    cache, binder = build_world(
+        nodes,
+        pending=[("da", {"app": "db"}, aff), ("db", {"app": "db"}, aff)])
+    ssn = open_session(cache, TIERS, [])
+    AllocateAction(engine=engine).execute(ssn)
+    assert len(binder.binds) == 2
+    assert binder.binds["default/da-0"] == binder.binds["default/db-0"]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_symmetric_preferred_repulsion(engine):
+    """An existing pod's preferred anti-affinity repels a matching incoming
+    pod from its node (symmetric scoring half)."""
+    nodes = [build_node(f"n{i}") for i in range(2)]
+    pref_anti = {"podAntiAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution":
+            [{"weight": 100, "podAffinityTerm":
+              required({"matchLabels": {"app": "batch"}})}]}}
+    cache, binder = build_world(
+        nodes,
+        running=[("svc", "n0", {"app": "svc"}, pref_anti)],
+        pending=[("batch", {"app": "batch"}, None)])
+    ssn = open_session(cache, TIERS, [])
+    AllocateAction(engine=engine).execute(ssn)
+    assert binder.binds == {"default/batch-0": "n1"}
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_preferred_affinity_scores(engine):
+    """Preferred affinity pulls the pod toward the web pod's node without
+    being a hard requirement."""
+    nodes = [build_node(f"n{i}") for i in range(4)]
+    aff = {"podAffinity": {
+        "preferredDuringSchedulingIgnoredDuringExecution":
+            [{"weight": 100, "podAffinityTerm":
+              required({"matchLabels": {"app": "web"}})}]}}
+    cache, binder = build_world(
+        nodes,
+        running=[("web", "n3", {"app": "web"}, None)],
+        pending=[("cli", {"app": "cli"}, aff)])
+    ssn = open_session(cache, TIERS, [])
+    AllocateAction(engine=engine).execute(ssn)
+    assert binder.binds == {"default/cli-0": "n3"}
+
+
+def test_match_label_selector_expressions():
+    sel = {"matchExpressions": [
+        {"key": "env", "operator": "In", "values": ["prod", "stage"]},
+        {"key": "legacy", "operator": "DoesNotExist"}]}
+    assert match_label_selector(sel, {"env": "prod"})
+    assert not match_label_selector(sel, {"env": "dev"})
+    assert not match_label_selector(sel, {"env": "prod", "legacy": "1"})
+    assert not match_label_selector({}, {"env": "prod"})
+
+
+def test_index_domains_and_counts():
+    nodes = [build_node("n0", zone="a"), build_node("n1", zone="a"),
+             build_node("n2", zone="b")]
+    idx = PodAffinityIndex(nodes)
+    dom, nd = idx.domains("topology.kubernetes.io/zone")
+    assert nd == 2 and dom[0] == dom[1] != dom[2]
+    # nodes without the label are singleton domains
+    nodes.append(NodeInfo(name="n3", allocatable=Resource(1, 1)))
+    idx2 = PodAffinityIndex(nodes)
+    dom2, nd2 = idx2.domains("topology.kubernetes.io/zone")
+    assert nd2 == 3 and dom2[3] not in (dom2[0], dom2[2])

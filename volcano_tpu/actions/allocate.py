@@ -423,35 +423,50 @@ def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4,
     the admitted-job set stabilizes, then replay the final solve through
     Statements. Convergence is usually immediate; gang rollbacks trigger one
     extra iteration because a failed job must stop influencing queue shares
-    and overused gating."""
-    assumed: Optional[set] = None
-    solution = None
-    t_order = t_solve = 0.0
-    for _ in range(max_order_iters):
-        t0 = time.perf_counter()
-        ordered_jobs = _fixed_job_order(ssn, assumed)
-        t_order += time.perf_counter() - t0
-        if not ordered_jobs:
-            return
-        t0 = time.perf_counter()
-        solution = _solve_fused(ssn, ordered_jobs, blocks, kernel, sharded)
-        t_solve += time.perf_counter() - t0
+    and overused gating.
+
+    With stateful predicates (gpu card packing, pod affinity) a device
+    proposal can fail the live re-check at replay because an earlier replay
+    placement changed the state the mask was computed from. Those tasks
+    stay pending; extra rounds re-solve them against the fresh session
+    state — the batched analogue of the callback engine's per-task
+    re-evaluation."""
+    t_order = t_solve = t_replay = 0.0
+    max_rounds = 3 if ssn.stateful_predicates else 1
+    for _ in range(max_rounds):
+        assumed: Optional[set] = None
+        solution = None
+        for _ in range(max_order_iters):
+            t0 = time.perf_counter()
+            ordered_jobs = _fixed_job_order(ssn, assumed)
+            t_order += time.perf_counter() - t0
+            if not ordered_jobs:
+                solution = None
+                break
+            t0 = time.perf_counter()
+            solution = _solve_fused(ssn, ordered_jobs, blocks, kernel,
+                                    sharded)
+            t_solve += time.perf_counter() - t0
+            if solution is None:
+                break
+            kept_uids = {solution.jobs_list[jx].uid
+                         for jx in range(len(solution.jobs_list))
+                         if solution.job_kept[jx]}
+            # assumed=None simulated "all jobs admitted" — if the solve
+            # indeed kept every job the premise held; no re-solve needed.
+            if kept_uids == assumed or (
+                    assumed is None
+                    and kept_uids == {j.uid for j in ordered_jobs}):
+                break
+            assumed = kept_uids
         if solution is None:
-            return
-        kept_uids = {solution.jobs_list[jx].uid
-                     for jx in range(len(solution.jobs_list))
-                     if solution.job_kept[jx]}
-        # assumed=None simulated "all jobs admitted" — if the solve indeed
-        # kept every job the premise held and no re-solve is needed.
-        if kept_uids == assumed or (
-                assumed is None
-                and kept_uids == {j.uid for j in ordered_jobs}):
             break
-        assumed = kept_uids
-    t0 = time.perf_counter()
-    _replay_fused(ssn, solution)
-    LAST_STATS.update(order_s=t_order, solve_s=t_solve,
-                      replay_s=time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        rejected = _replay_fused(ssn, solution)
+        t_replay += time.perf_counter() - t0
+        if not rejected:
+            break
+    LAST_STATS.update(order_s=t_order, solve_s=t_solve, replay_s=t_replay)
 
 
 class _FusedSolution:
@@ -728,16 +743,20 @@ def _replay_fused_fast(ssn, sol: "_FusedSolution") -> None:
     ssn.cache.bind_batch(binds)
 
 
-def _replay_fused(ssn, sol: _FusedSolution) -> None:
+def _replay_fused(ssn, sol: _FusedSolution) -> int:
     """Replay device decisions through Statements, job by job, preserving
-    gang atomicity on the host model (statement.go semantics)."""
+    gang atomicity on the host model (statement.go semantics). Returns the
+    number of proposals rejected by the live stateful re-check (callers
+    re-solve those tasks against fresh state)."""
     if _fast_replay_ok(ssn):
-        return _replay_fused_fast(ssn, sol)
+        _replay_fused_fast(ssn, sol)
+        return 0
     per_job_tasks: Dict[int, List[int]] = {}
     for i, jx in enumerate(sol.job_ix):
         per_job_tasks.setdefault(int(jx), []).append(i)
     recheck = bool(ssn.stateful_predicates)
 
+    rejected = 0
     for jx, task_ids in per_job_tasks.items():
         if not sol.job_kept[jx]:
             continue
@@ -750,6 +769,7 @@ def _replay_fused(ssn, sol: _FusedSolution) -> None:
             name = sol.node_t.names[n]
             node = ssn.nodes[name]
             if recheck and not _stateful_recheck(ssn, sol.tasks[i], node):
+                rejected += 1
                 continue
             if sol.pipelined[i]:
                 stmt.pipeline(sol.tasks[i], name)
@@ -759,6 +779,7 @@ def _replay_fused(ssn, sol: _FusedSolution) -> None:
             stmt.commit()
         elif not ssn.job_pipelined(job):
             stmt.discard()
+    return rejected
 
 
 def _fused_blocks_solver():

@@ -95,6 +95,7 @@ class NodeOrderPlugin(Plugin):
         self.most_req_weight = args.get_int("mostrequested.weight", 0)
         self.balanced_weight = args.get_int("balancedresource.weight", 1)
         self.taint_toleration_weight = args.get_int("tainttoleration.weight", 1)
+        self._ssn = None
 
     # host-path per-(task,node) scorer
     def _score(self, task, node) -> float:
@@ -123,10 +124,29 @@ class NodeOrderPlugin(Plugin):
         return score
 
     def _batch_score(self, task, nodes):
-        if not self.taint_toleration_weight:
-            return {}
-        return {n.name: self.taint_toleration_weight * taint_toleration_score(task, n)
-                for n in nodes}
+        out = {}
+        if self.taint_toleration_weight:
+            for n in nodes:
+                out[n.name] = self.taint_toleration_weight * \
+                    taint_toleration_score(task, n)
+        # batch InterPodAffinity scoring (nodeorder.go:269-340): preferred
+        # affinity/anti-affinity terms against the live pod index,
+        # normalized to [0,100] like the k8s scorer
+        if self.pod_affinity_weight and self._ssn is not None:
+            from .podaffinity import (get_pod_affinity_index,
+                                      normalize_scores,
+                                      session_has_pod_affinity)
+            if session_has_pod_affinity(self._ssn):
+                idx = get_pod_affinity_index(self._ssn)
+                row = idx.score_row(task)
+                if row is not None:
+                    sub = np.asarray([row[idx.node_index[n.name]]
+                                      for n in nodes], np.float32)
+                    norm = normalize_scores(sub)
+                    for k, n in enumerate(nodes):
+                        out[n.name] = out.get(n.name, 0.0) + \
+                            self.pod_affinity_weight * float(norm[k])
+        return out
 
     # device-path static score matrix (preference terms only). Vectorized for
     # the common case — python loops only over tasks with affinity
@@ -141,7 +161,10 @@ class NodeOrderPlugin(Plugin):
             (t.affinity.get("nodeAffinity", {})
              .get("preferredDuringSchedulingIgnoredDuringExecution"))
             for t in tasks)
-        if not has_pref_taints and not has_affinity_prefs:
+        from .podaffinity import session_has_pod_affinity
+        has_pod_aff = bool(self.pod_affinity_weight
+                           and session_has_pod_affinity(ssn))
+        if not has_pref_taints and not has_affinity_prefs and not has_pod_aff:
             # constant per-task offset — no effect on node choice; skip the
             # [T,N] matrix entirely
             return None
@@ -164,9 +187,22 @@ class NodeOrderPlugin(Plugin):
                 for ni, node in enumerate(node_infos):
                     score[ti, ni] += self.node_affinity_weight * \
                         node_affinity_preferred_score(task, node)
+        if self.pod_affinity_weight:
+            from .podaffinity import (get_pod_affinity_index,
+                                      normalize_scores,
+                                      session_has_pod_affinity)
+            if session_has_pod_affinity(ssn):
+                idx = get_pod_affinity_index(ssn)
+                for ti, task in enumerate(tasks):
+                    row = idx.score_row(task)
+                    if row is not None:
+                        sub = row[[idx.node_index[n] for n in node_t.names]]
+                        score[ti] += self.pod_affinity_weight * \
+                            normalize_scores(sub)
         return score
 
     def on_session_open(self, ssn) -> None:
+        self._ssn = ssn
         ssn.add_node_order_fn(self.NAME, self._score)
         ssn.add_batch_node_order_fn(self.NAME, self._batch_score)
         ssn.set_dynamic_score_weights(

@@ -1,0 +1,299 @@
+"""Inter-pod affinity/anti-affinity as precomputed pairwise tensors.
+
+Replaces the reference's per-(task, node) k8s InterPodAffinity filter
+(/root/reference/pkg/scheduler/plugins/predicates/predicates.go:330-338)
+and batch scorer (nodeorder.go:269-340) with a TPU-first design (SURVEY §7
+"precompute pairwise masks on host, ship as bitmask tensors"):
+
+- nodes partition into topology DOMAINS per topologyKey; every affinity
+  term reduces to "does a matching existing pod live in this domain" — a
+  bool per (term, domain) computed once, broadcast to a node vector;
+- required podAffinity terms AND-combine, required podAntiAffinity terms
+  (and their SYMMETRIC form: existing pods' anti-affinity rejecting the
+  incoming task) NAND-combine into the ``feas[T,N]`` mask the placement
+  kernels consume;
+- preferred terms become a ``score[T,N]`` matrix: weight x count of
+  matching existing pods in the node's domain (k8s
+  NodeInterPodAffinityPriority's core), normalized to [0,100] like the k8s
+  scorer before the plugin weight is applied.
+
+In-cycle placements change the existing-pod set mid-action; like the GPU
+card predicate, the plugin registers itself stateful so batched engines
+re-validate proposals against the live host predicate.
+
+Pod affinity spec shape follows the k8s API (dict form):
+  {"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution":
+       [{"labelSelector": {...}, "topologyKey": "...",
+         "namespaces": [...]}, ...],
+    "preferredDuringSchedulingIgnoredDuringExecution":
+       [{"weight": W, "podAffinityTerm": {...}}, ...]},
+   "podAntiAffinity": {...same...}}
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+REQUIRED = "requiredDuringSchedulingIgnoredDuringExecution"
+PREFERRED = "preferredDuringSchedulingIgnoredDuringExecution"
+MAX_NODE_SCORE = 100.0
+
+
+def match_label_selector(selector: dict, labels: Dict[str, str]) -> bool:
+    """k8s metav1.LabelSelector: matchLabels AND matchExpressions
+    (In/NotIn/Exists/DoesNotExist)."""
+    if not selector:
+        return False
+    for k, v in (selector.get("matchLabels") or {}).items():
+        if labels.get(k) != v:
+            return False
+    for expr in selector.get("matchExpressions") or []:
+        key = expr.get("key")
+        op = expr.get("operator")
+        values = expr.get("values") or []
+        if op == "In":
+            if labels.get(key) not in values:
+                return False
+        elif op == "NotIn":
+            if labels.get(key) in values:
+                return False
+        elif op == "Exists":
+            if key not in labels:
+                return False
+        elif op == "DoesNotExist":
+            if key in labels:
+                return False
+        else:
+            return False
+    return True
+
+
+def _term_namespaces(term: dict, own_namespace: str) -> List[str]:
+    """A term with no namespaces list applies to the pod's own namespace."""
+    return term.get("namespaces") or [own_namespace]
+
+
+def _affinity_terms(task, kind: str, required: bool):
+    aff = task.affinity or {}
+    section = aff.get(kind) or {}
+    if required:
+        return section.get(REQUIRED) or []
+    return section.get(PREFERRED) or []
+
+
+def has_pod_affinity(task) -> bool:
+    return bool(_affinity_terms(task, "podAffinity", True)
+                or _affinity_terms(task, "podAntiAffinity", True)
+                or _affinity_terms(task, "podAffinity", False)
+                or _affinity_terms(task, "podAntiAffinity", False))
+
+
+class PodAffinityIndex:
+    """Per-session topology/pod index for vectorized affinity evaluation.
+
+    Live-updated through session allocate/deallocate events so the host
+    predicate sees in-cycle placements (the reference's EventHandler-fed
+    k8s nodeMap, predicates.go:80-110)."""
+
+    def __init__(self, nodes: List):
+        self.nodes = nodes
+        self.node_index = {n.name: i for i, n in enumerate(nodes)}
+        self._domains: Dict[str, Tuple[np.ndarray, Dict[str, int]]] = {}
+        # existing (running/placed) pods: (task, node index)
+        self.existing: List[Tuple[object, int]] = []
+        for ni, node in enumerate(nodes):
+            for t in node.tasks.values():
+                self.existing.append((t, ni))
+        self._mask_cache: Dict[str, Optional[np.ndarray]] = {}
+
+    def add_pod(self, task) -> None:
+        # order-simulation pseudo-events (_AggTask) carry no placement
+        ni = self.node_index.get(getattr(task, "node_name", None))
+        if ni is not None:
+            self.existing.append((task, ni))
+            self._mask_cache.clear()
+
+    def remove_pod(self, task) -> None:
+        uid = getattr(task, "uid", None)
+        if uid is None:
+            return
+        self.existing = [(t, ni) for t, ni in self.existing if t.uid != uid]
+        self._mask_cache.clear()
+
+    def node_mask_cached(self, task) -> Optional[np.ndarray]:
+        if task.uid not in self._mask_cache:
+            self._mask_cache[task.uid] = self.node_mask(task)
+        return self._mask_cache[task.uid]
+
+    def domains(self, key: str) -> Tuple[np.ndarray, int]:
+        """(dom i32[N], n_domains): the node partition for a topologyKey.
+        Nodes missing the label form their own singleton domains (a node
+        without the topology label can never co-locate)."""
+        cached = self._domains.get(key)
+        if cached is not None:
+            return cached
+        values: Dict[str, int] = {}
+        dom = np.zeros(len(self.nodes), np.int32)
+        next_ix = 0
+        for i, node in enumerate(self.nodes):
+            val = node.labels.get(key)
+            if val is None:
+                dom[i] = next_ix
+                next_ix += 1
+            else:
+                if val not in values:
+                    values[val] = next_ix
+                    next_ix += 1
+                dom[i] = values[val]
+        self._domains[key] = (dom, next_ix)
+        return self._domains[key]
+
+    def _term_domain_counts(self, term: dict, namespaces: List[str],
+                            exclude_uid: Optional[str] = None) -> np.ndarray:
+        """count of matching existing pods per domain of term.topologyKey."""
+        key = term.get("topologyKey") or "kubernetes.io/hostname"
+        dom, nd = self.domains(key)
+        counts = np.zeros(nd, np.int64)
+        selector = term.get("labelSelector") or {}
+        nsset = set(namespaces)
+        for t, ni in self.existing:
+            if t.uid == exclude_uid:
+                continue
+            if t.namespace not in nsset:
+                continue
+            if match_label_selector(selector, t.labels):
+                counts[dom[ni]] += 1
+        return counts[dom]          # broadcast back to a per-node vector
+
+    # -- required terms -> feasibility --------------------------------------
+
+    def node_mask(self, task) -> Optional[np.ndarray]:
+        """bool[N] required-term feasibility for one task; None = all-true."""
+        masks = []
+        for term in _affinity_terms(task, "podAffinity", True):
+            namespaces = _term_namespaces(term, task.namespace)
+            cnt = self._term_domain_counts(term, namespaces)
+            if not cnt.any():
+                # k8s bootstrap allowance: with NO existing match anywhere, a
+                # pod matching its own affinity term may start the group on
+                # any node (upstream InterPodAffinity Filter special case)
+                if (task.namespace in namespaces
+                        and match_label_selector(
+                            term.get("labelSelector") or {}, task.labels)):
+                    continue
+            masks.append(cnt > 0)
+        for term in _affinity_terms(task, "podAntiAffinity", True):
+            cnt = self._term_domain_counts(
+                term, _term_namespaces(term, task.namespace),
+                exclude_uid=task.uid)
+            masks.append(cnt == 0)
+        # symmetric anti-affinity: an existing pod's required anti-affinity
+        # term that matches THIS task excludes the pod's whole domain
+        sym = self._symmetric_anti_mask(task)
+        if sym is not None:
+            masks.append(sym)
+        if not masks:
+            return None
+        out = masks[0]
+        for m in masks[1:]:
+            out = out & m
+        return out
+
+    def _symmetric_anti_mask(self, task) -> Optional[np.ndarray]:
+        out = None
+        for t, ni in self.existing:
+            for term in _affinity_terms(t, "podAntiAffinity", True):
+                if task.namespace not in _term_namespaces(term, t.namespace):
+                    continue
+                if not match_label_selector(term.get("labelSelector") or {},
+                                            task.labels):
+                    continue
+                key = term.get("topologyKey") or "kubernetes.io/hostname"
+                dom, _ = self.domains(key)
+                if out is None:
+                    out = np.ones(len(self.nodes), bool)
+                out &= dom != dom[ni]
+        return out
+
+    # -- preferred terms -> scoring -----------------------------------------
+
+    def score_row(self, task) -> Optional[np.ndarray]:
+        """f32[N] raw preferred-term score for one task; None when neither
+        the task nor any existing pod contributes a term. Includes the k8s
+        scorer's SYMMETRIC half: existing pods' preferred terms that match
+        the incoming task attract/repel toward their own domains."""
+        row = None
+        for pref in _affinity_terms(task, "podAffinity", False):
+            term = pref.get("podAffinityTerm") or {}
+            w = float(pref.get("weight", 1))
+            cnt = self._term_domain_counts(
+                term, _term_namespaces(term, task.namespace))
+            row = (row if row is not None else 0) + w * cnt
+        for pref in _affinity_terms(task, "podAntiAffinity", False):
+            term = pref.get("podAffinityTerm") or {}
+            w = float(pref.get("weight", 1))
+            cnt = self._term_domain_counts(
+                term, _term_namespaces(term, task.namespace))
+            row = (row if row is not None else 0) - w * cnt
+        for t, ni in self.existing:
+            for kind, sign in (("podAffinity", 1.0), ("podAntiAffinity", -1.0)):
+                for pref in _affinity_terms(t, kind, False):
+                    term = pref.get("podAffinityTerm") or {}
+                    if task.namespace not in _term_namespaces(
+                            term, t.namespace):
+                        continue
+                    if not match_label_selector(
+                            term.get("labelSelector") or {}, task.labels):
+                        continue
+                    key = term.get("topologyKey") or "kubernetes.io/hostname"
+                    dom, _ = self.domains(key)
+                    w = sign * float(pref.get("weight", 1))
+                    contrib = np.where(dom == dom[ni], w, 0.0)
+                    row = (row if row is not None else 0) + contrib
+        if row is None:
+            return None
+        return np.asarray(row, np.float32)
+
+
+def session_has_pod_affinity(ssn) -> bool:
+    """True when any session task OR any pod already placed on a node
+    (including non-PodGroup pods dropped from ssn.jobs) carries pod
+    affinity/anti-affinity — gates all index construction so the common
+    no-affinity case costs one cached boolean."""
+    flag = getattr(ssn, "_has_pod_affinity", None)
+    if flag is None:
+        flag = (any(has_pod_affinity(t) for job in ssn.jobs.values()
+                    for t in job.tasks.values())
+                or any(has_pod_affinity(t) for node in ssn.nodes.values()
+                       for t in node.tasks.values()))
+        ssn._has_pod_affinity = flag
+    return flag
+
+
+def get_pod_affinity_index(ssn) -> PodAffinityIndex:
+    """Session-cached index, subscribed to allocate/evict events. The
+    handler is NOT aggregatable, so batched engines fall back to the exact
+    Statement replay whenever pod affinity is in play."""
+    idx = getattr(ssn, "_pod_affinity_index", None)
+    if idx is None:
+        from ..framework.session import EventHandler
+        idx = PodAffinityIndex(list(ssn.nodes.values()))
+        ssn._pod_affinity_index = idx
+        ssn.add_event_handler(EventHandler(
+            allocate_func=lambda ev: idx.add_pod(ev.task),
+            deallocate_func=lambda ev: idx.remove_pod(ev.task),
+            aggregatable=False))
+    return idx
+
+
+def normalize_scores(row: np.ndarray) -> np.ndarray:
+    """k8s defaultNormalizeScore over [0, 100] with negatives shifted."""
+    if row.size == 0:
+        return row
+    lo, hi = float(row.min()), float(row.max())
+    if hi == lo:
+        return np.zeros_like(row) if hi == 0 else \
+            np.full_like(row, MAX_NODE_SCORE)
+    return (row - lo) * MAX_NODE_SCORE / (hi - lo)

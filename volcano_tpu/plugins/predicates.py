@@ -26,9 +26,11 @@ from ..api.types import (NODE_AFFINITY_FAILED, NODE_POD_NUMBER_EXCEEDED,
                          NODE_UNSCHEDULABLE, TAINTS_UNTOLERATED)
 from .base import Plugin
 from .nodeorder import _toleration_matches, match_node_selector_terms
+from .podaffinity import get_pod_affinity_index, session_has_pod_affinity
 
 GPU_SHARING_FAILED = "node(s) didn't have a gpu card with enough memory"
 PROPORTIONAL_FAILED = "proportional resource check failed"
+POD_AFFINITY_FAILED = "pod affinity/anti-affinity check failed"
 
 
 def node_selector_ok(task, node) -> bool:
@@ -105,6 +107,7 @@ class PredicatesPlugin(Plugin):
         # per-session predicate cache: (node, task equivalence sig) -> reason
         # or None (predicates/cache.go PredicateWithCache)
         self._cache: Dict[Tuple[str, Tuple], object] = {}
+        self._ssn = None
 
     @staticmethod
     def _task_signature(task) -> Tuple:
@@ -122,6 +125,15 @@ class PredicatesPlugin(Plugin):
                 raise PredicateError(task, node, NODE_POD_NUMBER_EXCEEDED)
         if node.unschedulable:
             raise PredicateError(task, node, NODE_UNSCHEDULABLE)
+        # InterPodAffinity filter (predicates.go:330-338): required terms
+        # plus existing pods' symmetric anti-affinity, over the live index
+        if self._ssn is not None and session_has_pod_affinity(self._ssn):
+            idx = get_pod_affinity_index(self._ssn)
+            mask = idx.node_mask_cached(task)
+            if mask is not None:
+                ni = idx.node_index.get(node.name)
+                if ni is not None and not mask[ni]:
+                    raise PredicateError(task, node, POD_AFFINITY_FAILED)
 
         if self.cache_enable:
             key = (node.name, self._task_signature(task))
@@ -168,11 +180,18 @@ class PredicatesPlugin(Plugin):
             if not gpu_reqs.any():
                 gpu_reqs = None
         prop_needed = bool(self.proportional_enable and self.proportional)
+        pod_aff = session_has_pod_affinity(ssn)
         if (not any_taints and not any_unsched and gpu_reqs is None
-                and not prop_needed
+                and not prop_needed and not pod_aff
                 and not any(t.node_selector or t.affinity for t in tasks)):
             return None                                  # all-true mask
         mask = np.ones((T, N), dtype=bool)
+        if pod_aff:
+            idx = get_pod_affinity_index(ssn)
+            for ti, task in enumerate(tasks):
+                row = idx.node_mask_cached(task)
+                if row is not None:
+                    mask[ti] &= row
         sched = np.asarray([not n.unschedulable for n in node_infos], dtype=bool)
         mask &= sched[None, :]
         for ti, task in enumerate(tasks):
@@ -201,6 +220,7 @@ class PredicatesPlugin(Plugin):
 
     def on_session_open(self, ssn) -> None:
         self._cache = {}
+        self._ssn = ssn
         ssn.add_predicate_fn(self.NAME, self.predicate)
         ssn.add_feasibility_fn(self.NAME, self.feasibility_mask)
         if self.gpu_sharing_enable or (self.proportional_enable
@@ -208,6 +228,10 @@ class PredicatesPlugin(Plugin):
             # card packing / idle ratios mutate as the cycle allocates: the
             # static feasibility mask is necessary but not sufficient, so
             # batched engines re-check proposals through predicate_fn
+            ssn.stateful_predicates.add(self.NAME)
+        if session_has_pod_affinity(ssn):
+            # in-cycle placements change the existing-pod set the affinity
+            # terms match against
             ssn.stateful_predicates.add(self.NAME)
 
 
