@@ -38,21 +38,25 @@ def submit_mpi_job(sys, name="mpi-job", replicas=3, min_available=None,
 
 class TestJobLifecycle:
     def test_submit_schedule_run(self):
-        """Job create → webhook defaults → controller pods+podgroup →
-        scheduler binds gang → pods running → job Running."""
+        """Job create → webhook defaults → controller podgroup → scheduler
+        enqueue admits the gang → controller creates pods (the syncTask
+        gate: no pods while the PodGroup is Pending,
+        job_controller_actions.go:263-280) → scheduler binds → Running."""
         sys = make_system()
         submit_mpi_job(sys)
         # webhook defaulted minAvailable to Σreplicas
         job = sys.store.get("Job", "default", "mpi-job")
         assert job.spec.min_available == 3
-        # controller created pods + podgroup
-        pods = sys.store.list("Pod")
-        assert len(pods) == 3
+        # controller created the podgroup but NOT the pods yet
+        assert sys.store.list("Pod") == []
         pg = sys.store.get("PodGroup", "default", "mpi-job")
         assert pg is not None and pg.spec.min_member == 3
         assert pg.spec.min_resources.cpu == 3000
 
-        sys.schedule_once()
+        sys.schedule_once()          # enqueue admits -> pods created
+        pods = sys.store.list("Pod")
+        assert len(pods) == 3
+        sys.schedule_once()          # allocate binds the gang
 
         pods = sys.store.list("Pod")
         assert all(p.status.phase == "Running" for p in pods)
@@ -78,6 +82,7 @@ class TestJobLifecycle:
         sys = make_system()
         job = submit_mpi_job(sys)
         job.spec.ttl_seconds_after_finished = 0.0
+        sys.schedule_once()
         sys.schedule_once()
         for pod in list(sys.store.list("Pod")):
             sys.store.finish_pod(pod.metadata.namespace, pod.metadata.name)
@@ -121,6 +126,7 @@ class TestJobLifecycle:
                                           action=BusAction.RESTART_JOB)]))
         sys.store.create(job)
         sys.schedule_once()
+        sys.schedule_once()
         pod = sys.store.list("Pod")[0]
         sys.store.finish_pod(pod.metadata.namespace, pod.metadata.name,
                              succeeded=False)
@@ -132,6 +138,7 @@ class TestJobLifecycle:
         sys = make_system()
         submit_mpi_job(sys, name="mpi", plugins={"env": [], "svc": [],
                                                  "ssh": []})
+        sys.schedule_once()          # enqueue -> pods created
         pods = sys.store.list("Pod")
         env = {e["name"]: e["value"] for e in pods[0].template.env}
         assert env["VC_TASK_INDEX"] in ("0", "1", "2")
@@ -181,6 +188,70 @@ class TestAdmission:
                                       TaskSpec(name="a", replicas=1)]))
         with pytest.raises(AdmissionError):
             sys.store.create(job)
+
+
+class TestPodsWebhook:
+    """/pods admission (admit_pod.go:1-203) + the store bind gate."""
+
+    def test_vc_job_pod_denied_while_podgroup_pending(self):
+        """A pod carrying a group annotation pointing at a Pending PodGroup
+        is rejected at creation."""
+        from volcano_tpu.cache.store_wiring import GROUP_NAME_ANNOTATION
+        sys = make_system()
+        submit_mpi_job(sys)        # PodGroup exists, phase Pending
+        rogue = Pod(metadata=ObjectMeta(
+            name="rogue",
+            annotations={GROUP_NAME_ANNOTATION: "mpi-job"}))
+        with pytest.raises(AdmissionError):
+            sys.store.create(rogue)
+        sys.schedule_once()        # enqueue admits the group
+        sys.store.create(rogue)    # now allowed
+
+    def test_unknown_group_annotation_denied(self):
+        from volcano_tpu.cache.store_wiring import GROUP_NAME_ANNOTATION
+        sys = make_system()
+        rogue = Pod(metadata=ObjectMeta(
+            name="orphan", annotations={GROUP_NAME_ANNOTATION: "nope"}))
+        with pytest.raises(AdmissionError):
+            sys.store.create(rogue)
+
+    def test_foreign_scheduler_pod_allowed(self):
+        sys = make_system()
+        pod = Pod(metadata=ObjectMeta(name="other"),
+                  scheduler_name="default-scheduler")
+        sys.store.create(pod)      # not ours; no gate
+
+    def test_jdb_annotations_validated(self):
+        sys = make_system()
+        bad = Pod(metadata=ObjectMeta(
+            name="bad", annotations={"volcano.sh/jdb-min-available": "0"}))
+        with pytest.raises(AdmissionError):
+            sys.store.create(bad)
+        bad2 = Pod(metadata=ObjectMeta(
+            name="bad2",
+            annotations={"volcano.sh/jdb-max-unavailable": "150%"}))
+        with pytest.raises(AdmissionError):
+            sys.store.create(bad2)
+        both = Pod(metadata=ObjectMeta(
+            name="both",
+            annotations={"volcano.sh/jdb-min-available": "1",
+                         "volcano.sh/jdb-max-unavailable": "50%"}))
+        with pytest.raises(AdmissionError):
+            sys.store.create(both)
+        ok = Pod(metadata=ObjectMeta(
+            name="ok", annotations={"volcano.sh/jdb-min-available": "50%"}))
+        sys.store.create(ok)
+
+    def test_bind_gated_on_pending_podgroup(self):
+        """ObjectStore.bind_pod refuses to run a pod whose gang is still
+        Pending (the in-process enforcement of the webhook)."""
+        from volcano_tpu.cache.store_wiring import GROUP_NAME_ANNOTATION
+        sys = make_system()
+        pod = Pod(metadata=ObjectMeta(name="solo"),
+                  template=PodTemplate(resources=Resource(500, 1 << 30)))
+        sys.store.create(pod)      # pg controller creates a Pending group
+        with pytest.raises(AdmissionError):
+            sys.store.bind_pod("default", "solo", "node-0")
 
 
 class TestBarePod:

@@ -38,4 +38,9 @@ class EnqueueAction(Action):
             if job.podgroup.min_resources is None or ssn.job_enqueueable(job):
                 job.podgroup.phase = PodGroupPhase.INQUEUE
                 ssn.job_enqueued(job)
+                # write the phase through immediately (not just at session
+                # close): the job controller's syncTask gate and the store's
+                # bind gate both key off the STORE phase, and allocate may
+                # bind this gang later in the same cycle
+                ssn.cache.update_job_status(job)
             queues.push(queue)

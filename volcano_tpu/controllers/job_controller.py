@@ -59,6 +59,7 @@ class JobController(Controller):
         store.watch("Job", self._on_job)
         store.watch("Pod", self._on_pod)
         store.watch("Command", self._on_command)
+        store.watch("PodGroup", self._on_podgroup)
 
     def _on_job(self, event: str, job: Job, old) -> None:
         if event == ADDED:
@@ -113,6 +114,29 @@ class JobController(Controller):
                 return policy.action
         return BusAction.SYNC_JOB
 
+    def _on_podgroup(self, event: str, pg, old) -> None:
+        """Re-sync the owning job whenever its PodGroup is schedulable —
+        pods are only created once the group left Pending
+        (job_controller_actions.go:263-280 syncTask gate). Status writes
+        mutate in place, so `old` cannot be trusted for transition
+        detection; the sync is idempotent (desired-vs-existing pod diff)."""
+        if event != UPDATED:
+            return
+        if pg.status.phase == PodGroupPhase.PENDING:
+            return
+        job = self.store.get("Job", pg.metadata.namespace, pg.metadata.name)
+        if job is None:
+            return
+        # only sync when pods are actually missing — sync_job itself writes
+        # the PodGroup status, so an unconditional trigger would recurse
+        desired = sum(t.replicas for t in job.spec.tasks)
+        existing = sum(
+            1 for p in self.store.list("Pod", job.metadata.namespace)
+            if p.metadata.annotations.get(JOB_NAME_ANNOTATION)
+            == job.metadata.name)
+        if existing < desired:
+            self._execute(job, BusAction.SYNC_JOB)
+
     def _on_command(self, event: str, cmd: Command, old) -> None:
         """Command CR → state-machine action (handler.go:364-400)."""
         if event != ADDED:
@@ -152,12 +176,20 @@ class JobController(Controller):
                     if p.metadata.annotations.get(JOB_NAME_ANNOTATION)
                     == job.metadata.name}
 
-        for name, (task, i) in desired.items():
-            if name not in existing:
-                self._create_pod(job, task, i)
-        for name, pod in existing.items():
-            if name not in desired:
-                self.store.delete("Pod", job.metadata.namespace, name)
+        # syncTask gate (job_controller_actions.go:263-280): create pods
+        # only once the PodGroup left Pending (the scheduler's enqueue
+        # admitted it); the /pods webhook rejects earlier creations
+        pg = self.store.get("PodGroup", job.metadata.namespace,
+                            job.metadata.name)
+        sync_task = pg is not None and \
+            pg.status.phase != PodGroupPhase.PENDING
+        if sync_task:
+            for name, (task, i) in desired.items():
+                if name not in existing:
+                    self._create_pod(job, task, i)
+            for name, pod in existing.items():
+                if name not in desired:
+                    self.store.delete("Pod", job.metadata.namespace, name)
 
         self._update_status(job)
         job_state._update_phase(job, next_phase(job.status))

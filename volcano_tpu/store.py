@@ -127,11 +127,24 @@ class ObjectStore:
     # -- kubelet emulation ---------------------------------------------------
 
     def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
-        """pods/<p>/binding analogue: place + start running."""
+        """pods/<p>/binding analogue: place + start running. Binds are
+        gated on the pod's PodGroup being schedulable — the in-process
+        enforcement of the /pods admission webhook (admit_pod.go:139-155):
+        a bare pod must not run while its gang is still Pending."""
+        from .api import PodGroupPhase
         with self._lock:
             pod: Pod = self._objects["Pod"].get(f"{namespace}/{name}")
             if pod is None:
                 raise KeyError(f"pod {namespace}/{name} not found")
+            group = pod.metadata.annotations.get(
+                "scheduling.k8s.io/group-name", "")
+            if group:
+                pg = self._objects["PodGroup"].get(f"{namespace}/{group}")
+                if pg is not None and \
+                        pg.status.phase == PodGroupPhase.PENDING:
+                    raise AdmissionError(
+                        f"cannot bind pod {namespace}/{name}: podgroup "
+                        f"{group} phase is Pending")
             old = _shallow_status_copy(pod)
             pod.status.node_name = node_name
             pod.status.phase = "Running"

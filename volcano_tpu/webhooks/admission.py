@@ -117,6 +117,73 @@ def mutate_podgroup(operation: str, pg: PodGroupCR, old) -> PodGroupCR:
     return pg
 
 
+# pods webhook (admit_pod.go:1-203) ------------------------------------------
+
+JDB_MIN_AVAILABLE = "volcano.sh/jdb-min-available"
+JDB_MAX_UNAVAILABLE = "volcano.sh/jdb-max-unavailable"
+
+
+def _validate_int_percentage(key: str, value: str) -> None:
+    """admit_pod.go validateIntPercentageStr: positive int, or 1%-99%."""
+    s = str(value).strip()
+    if s.endswith("%"):
+        try:
+            v = int(s[:-1])
+        except ValueError:
+            deny(f"invalid value {s} for {key}")
+        if v <= 0 or v >= 100:
+            deny(f"invalid value <{s}> for {key}, it must be a valid "
+                 f"percentage which between 1% ~ 99%")
+        return
+    try:
+        v = int(s)
+    except ValueError:
+        deny(f"invalid type: neither int nor percentage for {key}")
+    if v <= 0:
+        deny(f"invalid value <{s}> for {key}, it must be a positive integer")
+
+
+def make_validate_pod(store: ObjectStore, scheduler_name: str = "volcano"):
+    """Gate bare-pod creation on its PodGroup phase (admit_pod.go
+    validatePod): allow when the pod isn't ours, when the group is already
+    schedulable, or when a normal pod has no group yet; deny while the
+    group is Pending. Also validates disruption-budget annotations."""
+    from ..api import PodGroupPhase
+    from ..cache.store_wiring import GROUP_NAME_ANNOTATION
+
+    def check_pg_phase(pod, pg_name: str, is_vc_job: bool) -> None:
+        pg: PodGroupCR = store.get("PodGroup", pod.metadata.namespace,
+                                   pg_name)
+        if pg is None:
+            if is_vc_job:
+                deny(f"failed to get PodGroup for pod "
+                     f"<{pod.metadata.namespace}/{pod.metadata.name}>")
+            return
+        if pg.status.phase == PodGroupPhase.PENDING:
+            deny(f"failed to create pod <{pod.metadata.namespace}/"
+                 f"{pod.metadata.name}> as the podgroup phase is Pending")
+
+    def validate_pod(operation: str, pod, old) -> None:
+        if pod.scheduler_name != scheduler_name:
+            return
+        pg_name = pod.metadata.annotations.get(GROUP_NAME_ANNOTATION, "")
+        if pg_name:
+            check_pg_phase(pod, pg_name, is_vc_job=True)
+        else:
+            # normal pod: the name the podgroup controller would generate
+            check_pg_phase(pod, f"podgroup-{pod.metadata.uid}",
+                           is_vc_job=False)
+        budget_keys = [k for k in (JDB_MIN_AVAILABLE, JDB_MAX_UNAVAILABLE)
+                       if k in pod.metadata.annotations]
+        for key in budget_keys:
+            _validate_int_percentage(key, pod.metadata.annotations[key])
+        if len(budget_keys) > 1:
+            deny(f"not allow configure multiple annotations "
+                 f"<{[JDB_MIN_AVAILABLE, JDB_MAX_UNAVAILABLE]}> at same time")
+
+    return validate_pod
+
+
 def register_webhooks(store: ObjectStore) -> Router:
     """Self-registration analogue (cmd/webhook-manager/app/server.go:41-108):
     build the router, bind every admission service, attach to the store."""
@@ -133,5 +200,7 @@ def register_webhooks(store: ObjectStore) -> Router:
     router.register(AdmissionService(
         "/podgroups/mutate", ["PodGroup"], ["CREATE"], mutate_podgroup,
         mutating=True))
+    router.register(AdmissionService(
+        "/pods", ["Pod"], ["CREATE"], make_validate_pod(store)))
     store.register_admission_hook(router.hook)
     return router
