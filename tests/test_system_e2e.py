@@ -109,7 +109,9 @@ class TestJobLifecycle:
         job = sys.store.get("Job", "default", "mpi-job")
         assert job.status.state in (JobPhase.RESTARTING, JobPhase.PENDING,
                                     JobPhase.RUNNING)
-        # pods recreated after resync
+        # pods recreated once the scheduler re-admits the gang (syncTask
+        # gate: no pods while the PodGroup is Pending)
+        sys.schedule_once()
         assert len(sys.store.list("Pod")) == 3
 
     def test_pod_failure_policy_restart(self):
@@ -267,6 +269,84 @@ class TestBarePod:
         sys.schedule_once()
         pod = sys.store.get("Pod", "default", "solo")
         assert pod.status.phase == "Running"
+
+
+class TestJobVolumes:
+    """PVC lifecycle (createJobIOIfNotExist, job_controller_actions.go:442
+    + the volume binder, cache.go:241-273)."""
+
+    def test_pvc_autocreated_and_bound(self):
+        """A volume with a claim spec gets an owned PVC; it goes Bound when
+        the pods bind."""
+        sys = make_system()
+        job = Job(
+            metadata=ObjectMeta(name="vj"),
+            spec=JobSpec(
+                tasks=[TaskSpec(name="w", replicas=2,
+                                template=PodTemplate(
+                                    resources=Resource(1000, 1 << 30)))],
+                volumes=[{"mountPath": "/data",
+                          "volumeClaim": {"storage": "10Gi"}}]))
+        sys.store.create(job)
+        pvcs = sys.store.list("PersistentVolumeClaim")
+        assert len(pvcs) == 1
+        assert pvcs[0].status.phase == "Pending"
+        assert pvcs[0].metadata.owner_references[0]["name"] == "vj"
+        job = sys.store.get("Job", "default", "vj")
+        assert job.spec.volumes[0]["volumeClaimName"] == pvcs[0].metadata.name
+        assert job.status.controlled_resources == {
+            f"volume-pvc-{pvcs[0].metadata.name}": pvcs[0].metadata.name}
+
+        sys.schedule_once()
+        sys.schedule_once()
+        pods = sys.store.list("Pod")
+        assert pods and all(p.status.phase == "Running" for p in pods)
+        # every pod mounts the claim; the claim is Bound
+        assert all(any(v.get("claimName") == pvcs[0].metadata.name
+                       for v in p.template.volumes) for p in pods)
+        pvc = sys.store.list("PersistentVolumeClaim")[0]
+        assert pvc.status.phase == "Bound"
+        assert pvc.status.node
+
+    def test_missing_referenced_pvc_blocks_job(self):
+        """A volume naming a PVC that doesn't exist keeps the job podless
+        until the PVC appears (reference: job Pending with message)."""
+        from volcano_tpu.apis.objects import PVC
+        sys = make_system()
+        job = Job(
+            metadata=ObjectMeta(name="needs-pvc"),
+            spec=JobSpec(
+                tasks=[TaskSpec(name="w", replicas=1,
+                                template=PodTemplate(
+                                    resources=Resource(1000, 1 << 30)))],
+                volumes=[{"mountPath": "/data",
+                          "volumeClaimName": "shared-data"}]))
+        sys.store.create(job)
+        sys.schedule_once()
+        assert sys.store.list("Pod") == []
+        job = sys.store.get("Job", "default", "needs-pvc")
+        assert "shared-data" in job.status.state_message
+
+        sys.store.create(PVC(metadata=ObjectMeta(name="shared-data")))
+        sys.schedule_once()
+        assert len(sys.store.list("Pod")) == 1
+
+    def test_pvc_cascade_deleted_with_job(self):
+        """Owner-reference GC: deleting the job removes its PVCs."""
+        sys = make_system()
+        job = Job(
+            metadata=ObjectMeta(name="vjgc"),
+            spec=JobSpec(
+                tasks=[TaskSpec(name="w", replicas=1,
+                                template=PodTemplate(
+                                    resources=Resource(1000, 1 << 30)))],
+                volumes=[{"mountPath": "/d",
+                          "volumeClaim": {"storage": "1Gi"}}]))
+        sys.store.create(job)
+        assert len(sys.store.list("PersistentVolumeClaim")) == 1
+        sys.store.delete("Job", "default", "vjgc")
+        assert sys.store.list("PersistentVolumeClaim") == []
+        assert sys.store.get("PodGroup", "default", "vjgc") is None
 
 
 class TestQueueCLI:

@@ -108,6 +108,9 @@ class JobStatus:
     min_available: int = 0
     task_status_count: Dict[str, Dict[str, int]] = field(default_factory=dict)
     conditions: List[dict] = field(default_factory=list)
+    # ControlledResources (job_controller_actions.go:446): resources this
+    # job owns, e.g. "volume-pvc-<name>" -> pvc name
+    controlled_resources: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -135,6 +138,24 @@ class Pod:
     status: PodStatus = field(default_factory=PodStatus)
 
     KIND = "Pod"
+
+
+@dataclass
+class PVCStatus:
+    phase: str = "Pending"            # Pending | Bound
+    node: str = ""                    # assumed/bound topology
+
+
+@dataclass
+class PVC:
+    """PersistentVolumeClaim mirror — the job IO objects
+    createJobIOIfNotExist manages (job_controller_actions.go:442-494)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: Dict = field(default_factory=dict)      # claim spec (size, class)
+    status: PVCStatus = field(default_factory=PVCStatus)
+
+    KIND = "PersistentVolumeClaim"
 
 
 @dataclass

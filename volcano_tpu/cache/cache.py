@@ -169,6 +169,7 @@ class SchedulerCache:
                     if prev_node in self.nodes:
                         self.nodes[prev_node].update_task(cached)
         try:
+            self._bind_volumes(task)
             self.binder.bind(task, task.node_name)
         except Exception:
             # roll back exactly what the optimistic phase did
@@ -228,6 +229,7 @@ class SchedulerCache:
                 node.used.add(r)
         for task, newly in placed:
             try:
+                self._bind_volumes(task)
                 self.binder.bind(task, task.node_name)
             except Exception:
                 with self._lock:
@@ -242,6 +244,17 @@ class SchedulerCache:
                             cached.node_name = ""
                     self.err_tasks.append(task)
                 self.resync_task(task)
+
+    def _bind_volumes(self, task: TaskInfo) -> None:
+        """Volume allocate+bind at pod-bind time. The reference splits this
+        across Statement.Allocate (assume) and Commit (bind,
+        statement.go:230-292); in-process PVC binding carries no node
+        constraint, so the whole sequence runs here with identical end
+        state: the pod's claims go Bound when the pod binds."""
+        volumes = self.volume_binder.get_pod_volumes(
+            task, self.nodes.get(task.node_name))
+        self.volume_binder.allocate_volumes(task, task.node_name, volumes)
+        self.volume_binder.bind_volumes(task, volumes)
 
     def evict(self, task: TaskInfo, reason: str) -> None:
         """Execute eviction: pod condition + delete (cache.go:549-599)."""

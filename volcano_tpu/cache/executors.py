@@ -94,6 +94,47 @@ class StoreEvictor(Evictor):
         self.store.evict_pod(task.namespace, task.name, reason)
 
 
+class StoreVolumeBinder(VolumeBinder):
+    """Volume binder over store PVC objects — the in-process analogue of
+    the k8s SchedulerVolumeBinder wrap (cache.go:241-273): GetPodVolumes
+    finds the pod's unbound claims, AllocateVolumes assumes them onto the
+    host (task.volume_ready mirrors the reference's VolumeReady), and
+    BindVolumes commits Pending -> Bound."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def _claims(self, task: TaskInfo):
+        pod = getattr(task, "pod", None)
+        template = getattr(pod, "template", None)
+        for v in getattr(template, "volumes", None) or []:
+            name = v.get("claimName")
+            if not name:
+                continue
+            pvc = self.store.get("PersistentVolumeClaim", task.namespace,
+                                 name)
+            if pvc is not None:
+                yield pvc
+
+    def get_pod_volumes(self, task: TaskInfo, node) -> Optional[list]:
+        unbound = [p for p in self._claims(task)
+                   if p.status.phase != "Bound"]
+        return unbound or None
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str, volumes) -> None:
+        for pvc in volumes or []:
+            pvc.status.node = hostname
+        task.volume_ready = not volumes
+
+    def bind_volumes(self, task: TaskInfo, volumes) -> None:
+        if task.volume_ready:
+            return
+        for pvc in volumes or []:
+            pvc.status.phase = "Bound"
+            pvc.status.node = task.node_name
+            self.store.update_status(pvc)
+
+
 class StoreStatusUpdater(StatusUpdater):
     """Writes PodGroup status back to the store (the jobUpdater's
     UpdatePodGroup PUT, job_updater.go:95-108)."""

@@ -16,7 +16,8 @@ from ..api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase, QueueInfo,
 from ..apis.objects import Pod, PodGroupCR, QueueCR
 from ..store import ADDED, DELETED, UPDATED, ObjectStore
 from .cache import SchedulerCache
-from .executors import StoreBinder, StoreEvictor, StoreStatusUpdater
+from .executors import (StoreBinder, StoreEvictor, StoreStatusUpdater,
+                        StoreVolumeBinder)
 
 GROUP_NAME_ANNOTATION = "scheduling.k8s.io/group-name"
 
@@ -84,7 +85,8 @@ def wire_cache_to_store(store: ObjectStore,
     if cache is None:
         cache = SchedulerCache(binder=StoreBinder(store),
                                evictor=StoreEvictor(store),
-                               status_updater=StoreStatusUpdater(store))
+                               status_updater=StoreStatusUpdater(store),
+                               volume_binder=StoreVolumeBinder(store))
 
     # PriorityClass name -> value, resolved into JobInfo.priority
     # (event_handlers.go AddPriorityClass:633)

@@ -13,8 +13,9 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from ..api import BusAction, BusEvent, JobPhase, PodGroupPhase, Resource
-from ..apis.objects import (Command, Job, LifecyclePolicy, ObjectMeta, Pod,
-                            PodGroupCR, PodGroupSpec, PodTemplate, TaskSpec)
+from ..apis.objects import (Command, Job, LifecyclePolicy, ObjectMeta, PVC,
+                            Pod, PodGroupCR, PodGroupSpec, PodTemplate,
+                            TaskSpec)
 from ..cache.store_wiring import GROUP_NAME_ANNOTATION
 from ..store import ADDED, DELETED, UPDATED, AdmissionError, ObjectStore
 from . import job_state
@@ -49,6 +50,11 @@ class JobController(Controller):
     def __init__(self):
         self.store: ObjectStore = None
         self._lock = threading.RLock()
+        # per-job reentrancy guard: a sync writes Job/PodGroup status, whose
+        # watch events must not re-enter the same job's state machine (the
+        # reference's workqueue naturally dedups; in-process events are
+        # synchronous)
+        self._in_execute: set = set()
 
     # -- wiring -------------------------------------------------------------
 
@@ -60,6 +66,7 @@ class JobController(Controller):
         store.watch("Pod", self._on_pod)
         store.watch("Command", self._on_command)
         store.watch("PodGroup", self._on_podgroup)
+        store.watch("PersistentVolumeClaim", self._on_pvc)
 
     def _on_job(self, event: str, job: Job, old) -> None:
         if event == ADDED:
@@ -137,6 +144,16 @@ class JobController(Controller):
         if existing < desired:
             self._execute(job, BusAction.SYNC_JOB)
 
+    def _on_pvc(self, event: str, pvc, old) -> None:
+        """A job waiting on a referenced-but-missing PVC re-syncs when it
+        appears (the reference's pvc informer + error resync)."""
+        if event != ADDED:
+            return
+        for job in self.store.list("Job", pvc.metadata.namespace):
+            if any(v.get("volumeClaimName") == pvc.metadata.name
+                   for v in job.spec.volumes):
+                self._execute(job, BusAction.SYNC_JOB)
+
     def _on_command(self, event: str, cmd: Command, old) -> None:
         """Command CR → state-machine action (handler.go:364-400)."""
         if event != ADDED:
@@ -156,8 +173,19 @@ class JobController(Controller):
             self._execute(job, BusAction.SYNC_JOB)
 
     def _execute(self, job: Job, action: BusAction) -> None:
+        # keyed by (job, action, phase): a sync's own status writes must not
+        # re-enter the same state, while a nested execute after a genuine
+        # phase transition (e.g. Restarting -> Pending resync) proceeds
+        key = (job.metadata.namespace, job.metadata.name, action,
+               job.status.state)
         with self._lock:
-            job_state.new_state(job).execute(action)
+            if key in self._in_execute:
+                return
+            self._in_execute.add(key)
+            try:
+                job_state.new_state(job).execute(action)
+            finally:
+                self._in_execute.discard(key)
 
     # -- core sync (job_controller_actions.go:206-440) -----------------------
 
@@ -165,7 +193,7 @@ class JobController(Controller):
         if job.status.state in (JobPhase.COMPLETED, JobPhase.FAILED,
                                 JobPhase.TERMINATED, JobPhase.ABORTED):
             return
-        self._initiate_job(job)
+        io_ok = self._initiate_job(job)
         desired: Dict[str, tuple] = {}
         for task in job.spec.tasks:
             for i in range(task.replicas):
@@ -181,7 +209,7 @@ class JobController(Controller):
         # admitted it); the /pods webhook rejects earlier creations
         pg = self.store.get("PodGroup", job.metadata.namespace,
                             job.metadata.name)
-        sync_task = pg is not None and \
+        sync_task = io_ok and pg is not None and \
             pg.status.phase != PodGroupPhase.PENDING
         if sync_task:
             for name, (task, i) in desired.items():
@@ -213,11 +241,49 @@ class JobController(Controller):
         if job.status.state == JobPhase.PENDING:
             self._execute(job, BusAction.SYNC_JOB)
 
-    def _initiate_job(self, job: Job) -> None:
-        """Finalizer + PodGroup + plugin OnJobAdd
+    def _create_job_io_if_not_exist(self, job: Job) -> bool:
+        """PVC lifecycle (createJobIOIfNotExist,
+        job_controller_actions.go:442-494): generate claim names, create
+        owned PVCs from volumeClaim specs, require referenced PVCs to
+        exist — a missing one keeps the job Pending until it appears."""
+        ok = True
+        for i, volume in enumerate(job.spec.volumes):
+            vc_name = volume.get("volumeClaimName", "")
+            if not vc_name:
+                n = 0
+                while True:
+                    vc_name = f"{job.metadata.name}-pvc-{i}-{n}"
+                    if self.store.get("PersistentVolumeClaim",
+                                      job.metadata.namespace,
+                                      vc_name) is None:
+                        break
+                    n += 1
+                volume["volumeClaimName"] = vc_name
+                if volume.get("volumeClaim") is not None:
+                    self.store.create(PVC(
+                        metadata=ObjectMeta(
+                            name=vc_name,
+                            namespace=job.metadata.namespace,
+                            owner_references=[{"kind": "Job",
+                                               "name": job.metadata.name}]),
+                        spec=dict(volume.get("volumeClaim") or {})))
+                self.store.update(job)
+            elif self.store.get("PersistentVolumeClaim",
+                                job.metadata.namespace, vc_name) is None:
+                job.status.state_message = (
+                    f"pvc {vc_name} is not found, the job will be in the "
+                    f"Pending state until the PVC is created")
+                ok = False
+                continue
+            job.status.controlled_resources[f"volume-pvc-{vc_name}"] = vc_name
+        return ok
+
+    def _initiate_job(self, job: Job) -> bool:
+        """Finalizer + PVCs + PodGroup + plugin OnJobAdd
         (job_controller_actions.go:442-560)."""
         if "volcano.sh/job-finalizer" not in job.metadata.finalizers:
             job.metadata.finalizers.append("volcano.sh/job-finalizer")
+        io_ok = self._create_job_io_if_not_exist(job)
         plugin_on_job_add(self.store, job)
         pg = self.store.get("PodGroup", job.metadata.namespace,
                             job.metadata.name)
@@ -238,6 +304,7 @@ class JobController(Controller):
             pg.spec.min_member = job.spec.min_available
             pg.spec.min_resources = calc_pg_min_resources(job)
             self.store.update(pg)
+        return io_ok
 
     def _create_pod(self, job: Job, task: TaskSpec, index: int) -> None:
         import copy
@@ -255,6 +322,14 @@ class JobController(Controller):
                 owner_references=[{"kind": "Job", "name": job.metadata.name}]),
             template=template,
             scheduler_name=job.spec.scheduler_name)
+        # mount the job's volumes into every pod (createJobPod's volume
+        # wiring, job_controller_util.go)
+        for volume in job.spec.volumes:
+            vc_name = volume.get("volumeClaimName")
+            if vc_name:
+                pod.template.volumes.append(
+                    {"claimName": vc_name,
+                     "mountPath": volume.get("mountPath", "")})
         plugin_on_pod_create(self.store, job, task, index, pod)
         try:
             self.store.create(pod)

@@ -112,7 +112,8 @@ class NativeObjectStore:
     callers that want automatic fallback use :func:`make_object_store`.
     """
 
-    KINDS = ("Pod", "Job", "PodGroup", "Queue", "Command", "PriorityClass")
+    KINDS = ("Pod", "Job", "PodGroup", "Queue", "Command", "PriorityClass",
+             "PersistentVolumeClaim")
 
     def __init__(self, log_capacity: int = 65536):
         lib = _get_lib()

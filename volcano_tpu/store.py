@@ -30,7 +30,8 @@ class AdmissionError(Exception):
 
 
 class ObjectStore:
-    KINDS = ("Pod", "Job", "PodGroup", "Queue", "Command", "PriorityClass")
+    KINDS = ("Pod", "Job", "PodGroup", "Queue", "Command", "PriorityClass",
+             "PersistentVolumeClaim")
 
     def __init__(self):
         self._lock = threading.RLock()
@@ -112,6 +113,22 @@ class ObjectStore:
             obj = self._objects[kind].pop(f"{namespace}/{name}", None)
         if obj is not None:
             self._notify(kind, DELETED, obj)
+            self._cascade_delete(kind, namespace, name)
+
+    def _cascade_delete(self, kind: str, namespace: str, name: str) -> None:
+        """Owner-reference garbage collection (the k8s GC analogue): when
+        an owner goes away, its dependents follow — e.g. a deleted Job
+        takes its PVCs and PodGroup."""
+        for dep_kind in self.KINDS:
+            with self._lock:
+                victims = [
+                    o.metadata.name for o in self._objects[dep_kind].values()
+                    if o.metadata.namespace == namespace
+                    and any(ref.get("kind") == kind
+                            and ref.get("name") == name
+                            for ref in o.metadata.owner_references)]
+            for vname in victims:
+                self.delete(dep_kind, namespace, vname)
 
     def get(self, kind: str, namespace: str, name: str):
         with self._lock:
