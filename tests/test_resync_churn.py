@@ -1,0 +1,152 @@
+"""Failure resync (cache.go:777-799 errTasks) and large-scale churn — the
+job-controller hardening pass (VERDICT r1 #10)."""
+
+import time
+
+import pytest
+
+from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
+                             QueueInfo, Resource, TaskInfo, TaskStatus)
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.cache.cache import RateLimitedQueue
+
+GI = 1 << 30
+
+
+class FlakyBinder(FakeBinder):
+    """Fails the first ``fail_n`` bind attempts."""
+
+    def __init__(self, fail_n: int):
+        super().__init__()
+        self.fail_n = fail_n
+        self.attempts = 0
+
+    def bind(self, task, hostname):
+        self.attempts += 1
+        if self.attempts <= self.fail_n:
+            raise RuntimeError("transient apiserver error")
+        super().bind(task, hostname)
+
+
+def build_world(binder):
+    cache = SchedulerCache(binder=binder, evictor=FakeEvictor())
+    alloc = Resource(8000, 16 * GI)
+    alloc.max_task_num = 110
+    cache.add_node(NodeInfo(name="n0", allocatable=alloc))
+    pg = PodGroup(name="j", queue="default", min_member=1,
+                  phase=PodGroupPhase.INQUEUE)
+    job = JobInfo(uid="j", name="j", queue="default", min_available=1,
+                  podgroup=pg)
+    task = TaskInfo(uid="j-0", name="j-0", job="j",
+                    resreq=Resource(1000, GI))
+    job.add_task_info(task)
+    cache.add_job(job)
+    return cache, job, task
+
+
+class TestResyncQueue:
+    def test_rate_limited_backoff(self):
+        q = RateLimitedQueue(base_delay=0.01, max_delay=1.0)
+        q.add_rate_limited("a", 1)
+        assert q.pop_ready() == []          # backoff not expired
+        time.sleep(0.02)
+        assert q.pop_ready() == [("a", 1)]
+        # second failure doubles the delay
+        q.add_rate_limited("a", 1)
+        time.sleep(0.012)
+        assert q.pop_ready() == []
+        time.sleep(0.015)
+        assert q.pop_ready() == [("a", 1)]
+        q.forget("a")
+        q.add_rate_limited("a", 1)          # counter reset to base
+        time.sleep(0.02)
+        assert q.pop_ready() == [("a", 1)]
+
+    def test_failed_bind_retried_until_success(self):
+        binder = FlakyBinder(fail_n=2)
+        cache, job, task = build_world(binder)
+        task = job.tasks["j-0"]
+        task.node_name = "n0"
+        cache.bind(task)
+        # first attempt failed; cache rolled back, task queued for resync
+        assert binder.binds == {}
+        assert len(cache.resync_queue) == 1
+        assert cache.process_resync_tasks() == 0   # backoff not expired
+        deadline = time.time() + 5
+        while not binder.binds and time.time() < deadline:
+            time.sleep(0.01)
+            cache.process_resync_tasks()
+        assert binder.binds == {"default/j-0": "n0"}
+        assert binder.attempts == 3
+        assert len(cache.resync_queue) == 0
+        assert job.tasks["j-0"].status == TaskStatus.BOUND
+
+    def test_failed_evict_retried(self):
+        class FlakyEvictor(FakeEvictor):
+            def __init__(self):
+                super().__init__()
+                self.fails = 1
+
+            def evict(self, task, reason):
+                if self.fails:
+                    self.fails -= 1
+                    raise RuntimeError("transient")
+                super().evict(task, reason)
+
+        evictor = FlakyEvictor()
+        cache = SchedulerCache(binder=FakeBinder(), evictor=evictor)
+        alloc = Resource(8000, 16 * GI)
+        cache.add_node(NodeInfo(name="n0", allocatable=alloc))
+        pg = PodGroup(name="j", queue="default", min_member=1,
+                      phase=PodGroupPhase.RUNNING)
+        job = JobInfo(uid="j", name="j", queue="default", min_available=1,
+                      podgroup=pg)
+        task = TaskInfo(uid="j-0", name="j-0", job="j",
+                        resreq=Resource(1000, GI),
+                        status=TaskStatus.RUNNING)
+        job.add_task_info(task)
+        cache.add_job(job)
+        cache.nodes["n0"].add_task(task)
+        cache.evict(task, "preempt")
+        assert evictor.evicts == []
+        deadline = time.time() + 5
+        while not evictor.evicts and time.time() < deadline:
+            time.sleep(0.01)
+            cache.process_resync_tasks()
+        assert evictor.evicts == ["default/j-0"]
+
+
+def test_churn_10k_pods():
+    """10k-pod churn through the FULL system: submit, schedule, run, kill —
+    store, webhooks, controllers and scheduler all on the hot path."""
+    from volcano_tpu.apis.objects import (Job, JobSpec, ObjectMeta,
+                                          PodTemplate, TaskSpec)
+    from volcano_tpu.system import VolcanoSystem
+
+    sys_ = VolcanoSystem(schedule_period=10)
+    for i in range(500):
+        alloc = Resource(64000, 256 * GI)
+        alloc.max_task_num = 110
+        sys_.cache.add_node(NodeInfo(name=f"node-{i:04d}", allocatable=alloc))
+
+    t0 = time.perf_counter()
+    sys_.store.create(Job(
+        metadata=ObjectMeta(name="churn"),
+        spec=JobSpec(
+            min_available=10_000,
+            tasks=[TaskSpec(name="w", replicas=10_000,
+                            template=PodTemplate(
+                                resources=Resource(1000, 2 * GI)))])))
+    sys_.schedule_once()                      # enqueue -> pods created
+    pods = sys_.store.list("Pod")
+    assert len(pods) == 10_000
+    sys_.schedule_once()                      # allocate binds the gang
+    pods = sys_.store.list("Pod")
+    running = sum(1 for p in pods if p.status.phase == "Running")
+    assert running == 10_000
+    elapsed = time.perf_counter() - t0
+
+    # teardown churn: kill deletes all 10k pods
+    sys_.jobs.delete("churn")
+    assert sys_.store.list("Pod") == []
+    assert elapsed < 120, f"churn too slow: {elapsed:.1f}s"

@@ -14,15 +14,57 @@ snapshot" guard (cache.go:822-827).
 
 from __future__ import annotations
 
+import heapq
+import itertools
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..api import (ClusterInfo, JobInfo, NamespaceCollection, NamespaceInfo,
                    NodeInfo, PodGroupPhase, QueueInfo, Resource, TaskInfo,
                    TaskStatus)
 from .executors import (Binder, Evictor, FakeBinder, FakeEvictor,
                         StatusUpdater, VolumeBinder)
+
+
+class RateLimitedQueue:
+    """workqueue.RateLimitingInterface analogue (the errTasks queue,
+    cache.go:115,777-799): per-item exponential backoff — the k8s
+    ItemExponentialFailureRateLimiter (base * 2^failures, capped)."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 10.0):
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self._heap: List[Tuple[float, int, str, object]] = []
+        self._failures: Dict[str, int] = {}
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+
+    def add_rate_limited(self, key: str, item: object) -> None:
+        with self._lock:
+            n = self._failures.get(key, 0)
+            self._failures[key] = n + 1
+            delay = min(self.base_delay * (2 ** n), self.max_delay)
+            heapq.heappush(self._heap,
+                           (time.monotonic() + delay, next(self._seq), key,
+                            item))
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._failures.pop(key, None)
+
+    def pop_ready(self) -> List[Tuple[str, object]]:
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            while self._heap and self._heap[0][0] <= now:
+                _, _, key, item = heapq.heappop(self._heap)
+                out.append((key, item))
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._heap)
 
 
 class SchedulerCache:
@@ -43,7 +85,8 @@ class SchedulerCache:
         self.default_queue = default_queue
         if default_queue:
             self.queues.setdefault(default_queue, QueueInfo(name=default_queue))
-        self.err_tasks: List[TaskInfo] = []       # resync queue (cache.go:777-799)
+        self.err_tasks: List[TaskInfo] = []       # failure record (tests)
+        self.resync_queue = RateLimitedQueue()    # errTasks (cache.go:777-799)
         self.binding_tasks: Dict[str, str] = {}   # task uid -> node, in flight
 
     # -- ingestion (event_handlers.go analogues) ----------------------------
@@ -263,7 +306,7 @@ class SchedulerCache:
         except Exception:
             with self._lock:
                 self.err_tasks.append(task)
-            self.resync_task(task)
+            self.resync_task(task, op="evict")
             return
         with self._lock:
             job = self.jobs.get(task.job)
@@ -272,9 +315,43 @@ class SchedulerCache:
                 if task.node_name in self.nodes:
                     self.nodes[task.node_name].update_task(job.tasks[task.uid])
 
-    def resync_task(self, task: TaskInfo) -> None:
-        """Rate-limited retry hook (cache.go:777-799); in-process default just
-        records — the scheduler shell drains err_tasks each cycle."""
+    def resync_task(self, task: TaskInfo, op: str = "bind") -> None:
+        """Queue a failed side effect for rate-limited retry
+        (cache.go:777-799 resyncTask -> errTasks.AddRateLimited)."""
+        self.resync_queue.add_rate_limited(f"{op}/{task.uid}", (op, task))
+
+    def process_resync_tasks(self) -> int:
+        """Retry side effects whose backoff expired (processResyncTask,
+        cache.go:781-799) — the scheduler shell calls this every cycle.
+        Returns the number of successful retries."""
+        done = 0
+        for key, (op, task) in self.resync_queue.pop_ready():
+            try:
+                if op == "bind":
+                    self._bind_volumes(task)
+                    self.binder.bind(task, task.node_name)
+                    with self._lock:
+                        job = self.jobs.get(task.job)
+                        if job is not None and task.uid in job.tasks:
+                            cached = job.tasks[task.uid]
+                            cached.node_name = task.node_name
+                            job.update_task_status(cached, TaskStatus.BOUND)
+                            node = self.nodes.get(task.node_name)
+                            if node is not None \
+                                    and cached.uid not in node.tasks:
+                                node.add_task(cached)
+                else:
+                    self.evictor.evict(task, "resync")
+                    with self._lock:
+                        job = self.jobs.get(task.job)
+                        if job is not None and task.uid in job.tasks:
+                            job.update_task_status(job.tasks[task.uid],
+                                                   TaskStatus.RELEASING)
+                self.resync_queue.forget(key)
+                done += 1
+            except Exception:
+                self.resync_queue.add_rate_limited(key, (op, task))
+        return done
 
     def update_job_status(self, job: JobInfo) -> None:
         self.status_updater.update_pod_group(job)

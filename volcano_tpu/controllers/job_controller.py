@@ -55,6 +55,8 @@ class JobController(Controller):
         # reference's workqueue naturally dedups; in-process events are
         # synchronous)
         self._in_execute: set = set()
+        # jobs with churned pods awaiting a coalesced sync (the workqueue)
+        self._dirty: set = set()
 
     # -- wiring -------------------------------------------------------------
 
@@ -101,8 +103,29 @@ class JobController(Controller):
                 bus_event = BusEvent.POD_EVICTED
             elif pod.status.phase not in ("Succeeded", "Failed"):
                 bus_event = BusEvent.POD_EVICTED
+        if bus_event is None:
+            # plain churn (creates, phase flips to Running, drains): mark
+            # dirty and coalesce — the reference's sharded workqueue dedups
+            # job keys exactly like this; syncing per pod event is O(pods^2)
+            # at 10k pods
+            with self._lock:
+                self._dirty.add((pod.metadata.namespace, job_name))
+            return
         action = self._policy_action(job, pod, bus_event)
         self._execute(job, action)
+
+    def process_dirty(self) -> int:
+        """Sync every job whose pods churned since the last drain — called
+        by the controller loop each scheduler period (the workqueue worker
+        analogue, job_controller.go:256+)."""
+        with self._lock:
+            dirty = list(self._dirty)
+            self._dirty.clear()
+        for ns, name in dirty:
+            job = self.store.get("Job", ns, name)
+            if job is not None:
+                self._execute(job, BusAction.SYNC_JOB)
+        return len(dirty)
 
     def _policy_action(self, job: Job, pod: Pod,
                        event: Optional[BusEvent]) -> BusAction:

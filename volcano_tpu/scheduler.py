@@ -54,6 +54,10 @@ class Scheduler:
     def run_once(self) -> None:
         """One scheduling cycle (scheduler.go:90-110)."""
         self._maybe_reload_conf()
+        # retry failed side effects whose backoff expired (the reference's
+        # errTasks worker goroutine, cache.go:777-799)
+        if hasattr(self.cache, "process_resync_tasks"):
+            self.cache.process_resync_tasks()
         start = time.perf_counter()
         ssn = open_session(self.cache, self.conf.tiers,
                            self.conf.configurations)

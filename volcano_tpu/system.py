@@ -48,7 +48,16 @@ class VolcanoSystem:
         self.queues = QueueCommands(self.store)
 
     def schedule_once(self) -> None:
+        self._drain_controllers()
         self.scheduler.run_once()
+        self._drain_controllers()
+
+    def _drain_controllers(self) -> None:
+        """Coalesced controller work (the workqueue worker analogue): jobs
+        whose pods churned get one sync, not one per pod event."""
+        for c in self.controllers:
+            if hasattr(c, "process_dirty"):
+                c.process_dirty()
 
     def start(self):
         return self.scheduler.start()
