@@ -1,0 +1,134 @@
+"""Snapshot RPC boundary tests (SURVEY.md M2/§5.8): codec round-trip, the
+service running the real pipeline, and the TCP server end-to-end — with
+decision parity against the in-process scheduler on the same snapshot."""
+
+import pytest
+
+from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
+                             QueueInfo, Resource, TaskInfo, TaskStatus)
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.framework import (close_session, get_action, open_session,
+                                   parse_scheduler_conf)
+from volcano_tpu.rpc import (SchedulerService, SnapshotClient,
+                             decode_snapshot, encode_snapshot, serve)
+import volcano_tpu.actions  # noqa: F401
+import volcano_tpu.plugins  # noqa: F401
+
+GI = 1 << 30
+
+
+def build_world(n_nodes=4, n_jobs=3, tasks_per_job=2):
+    nodes = []
+    for i in range(n_nodes):
+        alloc = Resource(8000, 16 * GI)
+        alloc.max_task_num = 110
+        nodes.append(NodeInfo(name=f"n{i}", allocatable=alloc,
+                              labels={"zone": "a" if i < 2 else "b"}))
+    queues = [QueueInfo(name="default", weight=1),
+              QueueInfo(name="best", weight=2)]
+    jobs = []
+    for j in range(n_jobs):
+        queue = "default" if j % 2 == 0 else "best"
+        pg = PodGroup(name=f"job{j}", queue=queue,
+                      min_member=tasks_per_job,
+                      phase=PodGroupPhase.INQUEUE,
+                      min_resources=Resource(1000, GI))
+        job = JobInfo(uid=f"job{j}", name=f"job{j}", queue=queue,
+                      min_available=tasks_per_job, podgroup=pg, priority=j)
+        for t in range(tasks_per_job):
+            job.add_task_info(TaskInfo(
+                uid=f"job{j}-{t}", name=f"job{j}-{t}", job=f"job{j}",
+                resreq=Resource(1000, 2 * GI),
+                creation_timestamp=float(t)))
+        jobs.append(job)
+    # one running filler occupying n0
+    pg = PodGroup(name="filler", queue="default", min_member=1,
+                  phase=PodGroupPhase.RUNNING)
+    filler = JobInfo(uid="filler", name="filler", queue="default",
+                     min_available=1, podgroup=pg)
+    t = TaskInfo(uid="filler-0", name="filler-0", job="filler",
+                 resreq=Resource(2000, 4 * GI), status=TaskStatus.RUNNING)
+    filler.add_task_info(t)
+    t.node_name = "n0"
+    nodes[0].add_task(filler.tasks["filler-0"])
+    jobs.append(filler)
+    return nodes, jobs, queues
+
+
+def inprocess_binds(nodes, jobs, queues):
+    conf = parse_scheduler_conf(None)
+    binder = FakeBinder()
+    cache = SchedulerCache(binder=binder, evictor=FakeEvictor(),
+                           default_queue="")
+    for q in queues:
+        cache.add_queue(q)
+    for n in nodes:
+        cache.add_node(n)
+    for j in jobs:
+        cache.add_job(j)
+    ssn = open_session(cache, conf.tiers, conf.configurations)
+    for name in conf.actions:
+        get_action(name).execute(ssn)
+    close_session(ssn)
+    return dict(binder.binds)
+
+
+def test_codec_roundtrip():
+    nodes, jobs, queues = build_world()
+    msg = encode_snapshot(nodes, jobs, queues)
+    import json
+    msg = json.loads(json.dumps(msg))       # force wire-compatible types
+    nodes2, jobs2, queues2 = decode_snapshot(msg)
+    assert [n.name for n in nodes2] == [n.name for n in nodes]
+    assert nodes2[0].idle.cpu == nodes[0].idle.cpu  # filler accounted
+    assert {j.uid for j in jobs2} == {j.uid for j in jobs}
+    job0 = next(j for j in jobs2 if j.uid == "job0")
+    assert job0.min_available == 2 and len(job0.tasks) == 2
+    filler = next(j for j in jobs2 if j.uid == "filler")
+    assert filler.tasks["filler-0"].status == TaskStatus.RUNNING
+    assert filler.tasks["filler-0"].node_name == "n0"
+    assert {q.name for q in queues2} == {"default", "best"}
+
+
+def test_service_matches_inprocess():
+    nodes, jobs, queues = build_world()
+    expected = inprocess_binds(*build_world())
+    svc = SchedulerService()
+    out = svc.schedule(encode_snapshot(nodes, jobs, queues))
+    got = {f"{b['namespace']}/{b['name']}": b["node"] for b in out["binds"]}
+    assert got == expected
+    phases = {p["uid"]: p["phase"] for p in out["podgroups"]}
+    assert phases["job0"] == "Running"
+
+
+def test_tcp_server_end_to_end():
+    server, thread, port = serve()
+    try:
+        client = SnapshotClient("127.0.0.1", port)
+        nodes, jobs, queues = build_world()
+        out = client.schedule(encode_snapshot(nodes, jobs, queues))
+        expected = inprocess_binds(*build_world())
+        got = {f"{b['namespace']}/{b['name']}": b["node"]
+               for b in out["binds"]}
+        assert got == expected
+        # the connection is reusable: second cycle with the binds applied
+        out2 = client.schedule(encode_snapshot(nodes, jobs, queues))
+        assert "binds" in out2
+        client.close()
+    finally:
+        server.shutdown()
+
+
+def test_server_reports_errors():
+    server, thread, port = serve()
+    try:
+        client = SnapshotClient("127.0.0.1", port)
+        with pytest.raises(RuntimeError):
+            client.schedule({"v": 99})
+        # server keeps serving after an error
+        nodes, jobs, queues = build_world()
+        out = client.schedule(encode_snapshot(nodes, jobs, queues))
+        assert out["binds"]
+        client.close()
+    finally:
+        server.shutdown()

@@ -1,0 +1,28 @@
+"""Snapshot RPC boundary — the Go-shim-facing service (SURVEY.md M2/§5.8).
+
+The north-star deployment keeps a thin Go shim with client-go against a
+real cluster: it serializes the cluster snapshot, ships it here, and
+executes the returned bind/evict decisions through its own unchanged
+Statement machinery. This package defines that boundary so the in-process
+ObjectStore is ONE of two frontends:
+
+- codec:  a versioned JSON wire schema for snapshots (nodes with live
+  usage, jobs/podgroups with task status, queues) and decisions (binds,
+  evictions, podgroup phase/condition writebacks);
+- service: `SchedulerService` runs the real conf pipeline (session,
+  actions, plugins — the same code the in-process scheduler uses) over a
+  cache rebuilt from a decoded snapshot, with recording executors whose
+  output becomes the response;
+- server: a length-prefixed TCP server (`serve(...)`) exposing the
+  service; the protocol is 4-byte big-endian length + UTF-8 JSON both
+  ways, trivially speakable from Go.
+"""
+
+from .codec import (decisions_from_recorders, decode_snapshot,
+                    encode_snapshot)
+from .service import SchedulerService
+from .server import SnapshotClient, serve
+
+__all__ = ["encode_snapshot", "decode_snapshot",
+           "decisions_from_recorders", "SchedulerService",
+           "SnapshotClient", "serve"]

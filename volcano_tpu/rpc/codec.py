@@ -1,0 +1,172 @@
+"""Wire schema for the snapshot RPC (SURVEY.md §5.8: "task x node tensors
+out, bind decisions back").
+
+Versioned JSON — chosen over a binary layout because the payload is
+dominated by per-task rows that a Go shim can emit directly from client-go
+objects without a codegen step; at the 10k-pod benchmark scale the encoded
+snapshot is a few MB, far below the 1s cycle budget on loopback.
+
+Schema (version 1):
+
+  snapshot = {"v": 1,
+    "nodes":  [{"name", "allocatable": RES, "used": RES, "idle": RES,
+                "releasing": RES, "pipelined": RES, "labels", "taints",
+                "unschedulable", "max_task_num"}],
+    "queues": [{"name", "weight", "reclaimable", "capability": RES|null,
+                "annotations"}],
+    "jobs":   [{"uid", "name", "namespace", "queue", "min_available",
+                "priority", "phase", "min_resources": RES|null,
+                "tasks": [{"uid", "name", "status", "node", "resreq": RES,
+                           "priority", "labels", "annotations",
+                           "node_selector", "tolerations", "affinity"}]}]}
+  RES = {"cpu": milli, "memory": bytes, "scalars": {...}}
+
+  decisions = {"v": 1,
+    "binds":  [{"uid", "namespace", "name", "node"}],
+    "evicts": [{"uid", "namespace", "name", "reason"}],
+    "podgroups": [{"uid", "phase", "conditions"}]}
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase, QueueInfo,
+                   Resource, TaskInfo, TaskStatus)
+
+VERSION = 1
+
+
+def _res(r: Resource) -> dict:
+    out = {"cpu": r.cpu, "memory": r.memory}
+    if r.scalars:
+        out["scalars"] = dict(r.scalars)
+    if r.max_task_num is not None:
+        out["max_task_num"] = r.max_task_num
+    return out
+
+
+def _res_from(d: dict) -> Resource:
+    r = Resource(d.get("cpu", 0.0), d.get("memory", 0.0),
+                 d.get("scalars") or None)
+    if "max_task_num" in d:
+        r.max_task_num = d["max_task_num"]
+    return r
+
+
+def encode_snapshot(nodes: List[NodeInfo], jobs: List[JobInfo],
+                    queues: List[QueueInfo]) -> dict:
+    return {
+        "v": VERSION,
+        "nodes": [{
+            "name": n.name,
+            "allocatable": _res(n.allocatable),
+            "used": _res(n.used),
+            "idle": _res(n.idle),
+            "releasing": _res(n.releasing),
+            "pipelined": _res(n.pipelined),
+            "labels": n.labels,
+            "taints": n.taints,
+            "unschedulable": n.unschedulable,
+            "max_task_num": n.allocatable.max_task_num or 0,
+        } for n in nodes],
+        "queues": [{
+            "name": q.name,
+            "weight": q.weight,
+            "reclaimable": q.reclaimable,
+            "capability": _res(q.capability) if q.capability else None,
+            "annotations": q.annotations,
+        } for q in queues],
+        "jobs": [{
+            "uid": j.uid,
+            "name": j.name,
+            "namespace": j.namespace,
+            "queue": j.queue,
+            "min_available": j.min_available,
+            "priority": j.priority,
+            "phase": j.podgroup.phase.value,
+            "min_resources": (_res(j.podgroup.min_resources)
+                              if j.podgroup.min_resources else None),
+            "tasks": [{
+                "uid": t.uid,
+                "name": t.name,
+                "status": t.status.name,
+                "node": t.node_name,
+                "resreq": _res(t.resreq),
+                "priority": t.priority,
+                "labels": t.labels,
+                "annotations": t.annotations,
+                "node_selector": t.node_selector,
+                "tolerations": t.tolerations,
+                "affinity": t.affinity,
+            } for t in j.tasks.values()],
+        } for j in jobs],
+    }
+
+
+def decode_snapshot(msg: dict):
+    """-> (nodes, jobs, queues) live api objects, placed tasks attached to
+    their nodes exactly like the in-process cache snapshot."""
+    if msg.get("v") != VERSION:
+        raise ValueError(f"unsupported snapshot version {msg.get('v')!r}")
+    nodes: Dict[str, NodeInfo] = {}
+    for nd in msg["nodes"]:
+        alloc = _res_from(nd["allocatable"])
+        alloc.max_task_num = nd.get("max_task_num") or alloc.max_task_num
+        node = NodeInfo(name=nd["name"], allocatable=alloc,
+                        labels=nd.get("labels"), taints=nd.get("taints"),
+                        unschedulable=nd.get("unschedulable", False))
+        nodes[node.name] = node
+    queues = [QueueInfo(
+        name=qd["name"], weight=qd.get("weight", 1),
+        reclaimable=qd.get("reclaimable", True),
+        capability=(_res_from(qd["capability"])
+                    if qd.get("capability") else None),
+        annotations=qd.get("annotations")) for qd in msg["queues"]]
+    jobs = []
+    for jd in msg["jobs"]:
+        pg = PodGroup(name=jd["name"], namespace=jd["namespace"],
+                      queue=jd["queue"], min_member=jd["min_available"],
+                      phase=PodGroupPhase(jd["phase"]),
+                      min_resources=(_res_from(jd["min_resources"])
+                                     if jd.get("min_resources") else None))
+        job = JobInfo(uid=jd["uid"], name=jd["name"],
+                      namespace=jd["namespace"], queue=jd["queue"],
+                      min_available=jd["min_available"], podgroup=pg,
+                      priority=jd.get("priority", 1))
+        for td in jd["tasks"]:
+            task = TaskInfo(
+                uid=td["uid"], name=td["name"], namespace=jd["namespace"],
+                job=jd["uid"], resreq=_res_from(td["resreq"]),
+                status=TaskStatus[td["status"]],
+                priority=td.get("priority", 1),
+                labels=td.get("labels"), annotations=td.get("annotations"),
+                node_selector=td.get("node_selector"),
+                tolerations=td.get("tolerations"),
+                affinity=td.get("affinity"))
+            job.add_task_info(task)
+            node = nodes.get(td.get("node") or "")
+            if node is not None:
+                task.node_name = node.name
+                node.add_task(job.tasks[task.uid])
+        jobs.append(job)
+    return list(nodes.values()), jobs, queues
+
+
+def decisions_from_recorders(binder, evictor, jobs: List[JobInfo]) -> dict:
+    """Build the response from the recording executors + session-close
+    PodGroup state."""
+    return {
+        "v": VERSION,
+        "binds": [{"uid": uid, "namespace": key.split("/", 1)[0],
+                   "name": key.split("/", 1)[1], "node": node}
+                  for (key, uid), node in binder.bind_records.items()],
+        "evicts": [{"uid": uid, "namespace": key.split("/", 1)[0],
+                    "name": key.split("/", 1)[1], "reason": reason}
+                   for key, uid, reason in evictor.evict_records],
+        "podgroups": [{
+            "uid": j.uid,
+            "phase": j.podgroup.phase.value,
+            "conditions": list(j.podgroup.conditions),
+        } for j in jobs],
+    }

@@ -1,0 +1,100 @@
+"""Length-prefixed TCP transport for the snapshot RPC.
+
+Protocol (both directions): 4-byte big-endian payload length, then UTF-8
+JSON. Any language with sockets speaks it; the Go shim needs ~20 lines.
+An error response is {"error": "..."} with the same framing.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Optional, Tuple
+
+from .service import SchedulerService
+
+MAX_MSG = 1 << 30
+
+
+def _read_msg(sock) -> Optional[dict]:
+    header = _read_exact(sock, 4)
+    if header is None:
+        return None
+    (length,) = struct.unpack(">I", header)
+    if length > MAX_MSG:
+        raise ValueError(f"message too large: {length}")
+    body = _read_exact(sock, length)
+    if body is None:
+        return None
+    return json.loads(body.decode("utf-8"))
+
+
+def _read_exact(sock, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _write_msg(sock, msg: dict) -> None:
+    body = json.dumps(msg).encode("utf-8")
+    sock.sendall(struct.pack(">I", len(body)) + body)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        while True:
+            try:
+                msg = _read_msg(self.request)
+            except (ConnectionError, ValueError):
+                return
+            if msg is None:
+                return
+            try:
+                out = self.server.service.schedule(msg)
+            except Exception as exc:  # wire errors back, keep serving
+                out = {"error": f"{type(exc).__name__}: {exc}"}
+            _write_msg(self.request, out)
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve(host: str = "127.0.0.1", port: int = 0,
+          conf_text: Optional[str] = None,
+          ) -> Tuple[_Server, threading.Thread, int]:
+    """Start the sidecar; returns (server, thread, bound_port)."""
+    server = _Server((host, port), _Handler)
+    server.service = SchedulerService(conf_text)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="vc-snapshot-rpc")
+    thread.start()
+    return server, thread, server.server_address[1]
+
+
+class SnapshotClient:
+    """The Go shim's role, for tests and Python-side callers: connect,
+    send a snapshot, read decisions."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+
+    def schedule(self, snapshot_msg: dict) -> dict:
+        _write_msg(self.sock, snapshot_msg)
+        out = _read_msg(self.sock)
+        if out is None:
+            raise ConnectionError("server closed the connection")
+        if "error" in out:
+            raise RuntimeError(out["error"])
+        return out
+
+    def close(self) -> None:
+        self.sock.close()

@@ -1,0 +1,73 @@
+"""SchedulerService: one snapshot in -> one cycle of the REAL pipeline ->
+decisions out.
+
+This is the sidecar half of SURVEY.md M2: the Go shim keeps client-go and
+the Statement execution; everything between Snapshot() and Commit() — the
+session, the plugin tiers, the TPU placement kernels — runs here, unmodified
+from the in-process scheduler.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..cache import SchedulerCache
+from ..cache.executors import Binder, Evictor
+from ..framework import close_session, get_action, open_session, \
+    parse_scheduler_conf
+from .codec import decisions_from_recorders, decode_snapshot
+
+
+class RecordingBinder(Binder):
+    """Keyed records with task uids so the shim can map decisions back to
+    pods without name parsing ambiguity."""
+
+    def __init__(self):
+        self.bind_records: Dict[tuple, str] = {}
+
+    def bind(self, task, hostname: str) -> None:
+        self.bind_records[(task.key(), task.uid)] = hostname
+
+
+class RecordingEvictor(Evictor):
+    def __init__(self):
+        self.evict_records = []
+
+    def evict(self, task, reason: str) -> None:
+        self.evict_records.append((task.key(), task.uid, reason))
+
+
+class SchedulerService:
+    """Stateless per-request scheduling: every call rebuilds the cache from
+    the snapshot (the store-is-the-checkpoint stance — SURVEY §5.4 — now
+    with the store on the OTHER side of the wire)."""
+
+    def __init__(self, conf_text: Optional[str] = None):
+        # actions/plugins register on import
+        from .. import actions as _actions  # noqa: F401
+        from .. import plugins as _plugins  # noqa: F401
+        self.conf = parse_scheduler_conf(conf_text)
+
+    def schedule(self, snapshot_msg: dict) -> dict:
+        nodes, jobs, queues = decode_snapshot(snapshot_msg)
+        binder = RecordingBinder()
+        evictor = RecordingEvictor()
+        cache = SchedulerCache(binder=binder, evictor=evictor,
+                               default_queue="")
+        for q in queues:
+            cache.add_queue(q)
+        for n in nodes:
+            cache.add_node(n)
+        for j in jobs:
+            cache.add_job(j)
+
+        ssn = open_session(cache, self.conf.tiers, self.conf.configurations)
+        try:
+            for name in self.conf.actions:
+                action = get_action(name)
+                if action is not None:
+                    action.execute(ssn)
+        finally:
+            close_session(ssn)
+        return decisions_from_recorders(binder, evictor,
+                                        list(ssn.jobs.values()))
