@@ -90,6 +90,30 @@ def test_codec_roundtrip():
     assert {q.name for q in queues2} == {"default", "best"}
 
 
+def test_codec_preserves_external_usage_and_timestamps():
+    """Wire usage vectors are authoritative: resources held by pods
+    OUTSIDE the jobs array (system pods) survive, as do creation
+    timestamps and preemption attributes."""
+    nodes, jobs, queues = build_world()
+    # simulate a daemonset pod the job list knows nothing about
+    ghost = Resource(3000, 6 * GI)
+    nodes[1].idle.sub(ghost)
+    nodes[1].used.add(ghost)
+    jobs[0].tasks["job0-0"].preemptable = True
+    jobs[0].tasks["job0-0"].revocable_zone = "rz1"
+    msg = encode_snapshot(nodes, jobs, queues)
+    import json
+    nodes2, jobs2, _ = decode_snapshot(json.loads(json.dumps(msg)))
+    n1 = next(n for n in nodes2 if n.name == "n1")
+    assert n1.idle.cpu == nodes[1].idle.cpu
+    assert n1.used.memory == nodes[1].used.memory
+    job0 = next(j for j in jobs2 if j.uid == "job0")
+    assert job0.creation_timestamp == jobs[0].creation_timestamp
+    t = job0.tasks["job0-0"]
+    assert t.creation_timestamp == 0.0
+    assert t.preemptable and t.revocable_zone == "rz1"
+
+
 def test_service_matches_inprocess():
     nodes, jobs, queues = build_world()
     expected = inprocess_binds(*build_world())

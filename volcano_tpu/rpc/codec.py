@@ -9,17 +9,25 @@ snapshot is a few MB, far below the 1s cycle budget on loopback.
 Schema (version 1):
 
   snapshot = {"v": 1,
-    "nodes":  [{"name", "allocatable": RES, "used": RES, "idle": RES,
-                "releasing": RES, "pipelined": RES, "labels", "taints",
-                "unschedulable", "max_task_num"}],
+    "nodes":  [{"name", "allocatable": RES, "capability": RES, "used": RES,
+                "idle": RES, "releasing": RES, "pipelined": RES, "labels",
+                "taints", "annotations", "unschedulable"}],
     "queues": [{"name", "weight", "reclaimable", "capability": RES|null,
                 "annotations"}],
     "jobs":   [{"uid", "name", "namespace", "queue", "min_available",
-                "priority", "phase", "min_resources": RES|null,
+                "priority", "phase", "created", "preemptable",
+                "revocable_zone", "min_resources": RES|null,
                 "tasks": [{"uid", "name", "status", "node", "resreq": RES,
-                           "priority", "labels", "annotations",
-                           "node_selector", "tolerations", "affinity"}]}]}
-  RES = {"cpu": milli, "memory": bytes, "scalars": {...}}
+                           "priority", "created", "preemptable",
+                           "revocable_zone", "topology_policy", "labels",
+                           "annotations", "node_selector", "tolerations",
+                           "affinity"}]}]}
+  RES = {"cpu": milli, "memory": bytes, "scalars": {...},
+         "max_task_num": pods}
+
+  Node usage vectors are authoritative on decode: resources consumed by
+  pods OUTSIDE the jobs array (daemonsets, system pods on a real cluster)
+  stay accounted, and placed tasks attach without re-subtracting.
 
   decisions = {"v": 1,
     "binds":  [{"uid", "namespace", "name", "node"}],
@@ -61,14 +69,15 @@ def encode_snapshot(nodes: List[NodeInfo], jobs: List[JobInfo],
         "nodes": [{
             "name": n.name,
             "allocatable": _res(n.allocatable),
+            "capability": _res(n.capability),
             "used": _res(n.used),
             "idle": _res(n.idle),
             "releasing": _res(n.releasing),
             "pipelined": _res(n.pipelined),
             "labels": n.labels,
             "taints": n.taints,
+            "annotations": n.annotations,
             "unschedulable": n.unschedulable,
-            "max_task_num": n.allocatable.max_task_num or 0,
         } for n in nodes],
         "queues": [{
             "name": q.name,
@@ -85,6 +94,9 @@ def encode_snapshot(nodes: List[NodeInfo], jobs: List[JobInfo],
             "min_available": j.min_available,
             "priority": j.priority,
             "phase": j.podgroup.phase.value,
+            "created": j.creation_timestamp,
+            "preemptable": j.preemptable,
+            "revocable_zone": j.revocable_zone,
             "min_resources": (_res(j.podgroup.min_resources)
                               if j.podgroup.min_resources else None),
             "tasks": [{
@@ -94,6 +106,10 @@ def encode_snapshot(nodes: List[NodeInfo], jobs: List[JobInfo],
                 "node": t.node_name,
                 "resreq": _res(t.resreq),
                 "priority": t.priority,
+                "created": t.creation_timestamp,
+                "preemptable": t.preemptable,
+                "revocable_zone": t.revocable_zone,
+                "topology_policy": t.topology_policy,
                 "labels": t.labels,
                 "annotations": t.annotations,
                 "node_selector": t.node_selector,
@@ -111,11 +127,20 @@ def decode_snapshot(msg: dict):
         raise ValueError(f"unsupported snapshot version {msg.get('v')!r}")
     nodes: Dict[str, NodeInfo] = {}
     for nd in msg["nodes"]:
-        alloc = _res_from(nd["allocatable"])
-        alloc.max_task_num = nd.get("max_task_num") or alloc.max_task_num
-        node = NodeInfo(name=nd["name"], allocatable=alloc,
+        node = NodeInfo(name=nd["name"],
+                        allocatable=_res_from(nd["allocatable"]),
+                        capability=(_res_from(nd["capability"])
+                                    if nd.get("capability") else None),
                         labels=nd.get("labels"), taints=nd.get("taints"),
+                        annotations=nd.get("annotations"),
                         unschedulable=nd.get("unschedulable", False))
+        # the wire usage vectors are authoritative — they include pods
+        # outside the jobs array (system pods on a real cluster)
+        node.used = _res_from(nd.get("used") or {})
+        node.idle = (_res_from(nd["idle"]) if nd.get("idle")
+                     else node.allocatable.clone())
+        node.releasing = _res_from(nd.get("releasing") or {})
+        node.pipelined = _res_from(nd.get("pipelined") or {})
         nodes[node.name] = node
     queues = [QueueInfo(
         name=qd["name"], weight=qd.get("weight", 1),
@@ -133,22 +158,37 @@ def decode_snapshot(msg: dict):
         job = JobInfo(uid=jd["uid"], name=jd["name"],
                       namespace=jd["namespace"], queue=jd["queue"],
                       min_available=jd["min_available"], podgroup=pg,
-                      priority=jd.get("priority", 1))
+                      priority=jd.get("priority", 1),
+                      creation_timestamp=jd.get("created"))
+        job.preemptable = jd.get("preemptable", False)
+        job.revocable_zone = jd.get("revocable_zone", "")
         for td in jd["tasks"]:
             task = TaskInfo(
                 uid=td["uid"], name=td["name"], namespace=jd["namespace"],
                 job=jd["uid"], resreq=_res_from(td["resreq"]),
                 status=TaskStatus[td["status"]],
                 priority=td.get("priority", 1),
+                creation_timestamp=td.get("created"),
+                preemptable=td.get("preemptable", False),
+                revocable_zone=td.get("revocable_zone", ""),
+                topology_policy=td.get("topology_policy", ""),
                 labels=td.get("labels"), annotations=td.get("annotations"),
                 node_selector=td.get("node_selector"),
                 tolerations=td.get("tolerations"),
                 affinity=td.get("affinity"))
             job.add_task_info(task)
-            node = nodes.get(td.get("node") or "")
+            # placement survives even when the node is absent from the
+            # snapshot (cordoned / in-flight-bind nodes are skipped, but
+            # their tasks keep node context for affinity and eviction)
+            own = job.tasks[task.uid]
+            own.node_name = td.get("node") or ""
+            node = nodes.get(own.node_name)
             if node is not None:
-                task.node_name = node.name
-                node.add_task(job.tasks[task.uid])
+                # attach WITHOUT re-accounting: the wire usage vectors
+                # already include every placed task
+                clone = own.clone()
+                clone.node_name = node.name
+                node.tasks[clone.uid] = clone
         jobs.append(job)
     return list(nodes.values()), jobs, queues
 

@@ -19,17 +19,26 @@ from .service import SchedulerService
 MAX_MSG = 1 << 30
 
 
+class BadPayload(Exception):
+    """The frame was read intact but its JSON is invalid — recoverable:
+    reply with an error and keep the connection."""
+
+
 def _read_msg(sock) -> Optional[dict]:
     header = _read_exact(sock, 4)
     if header is None:
         return None
     (length,) = struct.unpack(">I", header)
     if length > MAX_MSG:
+        # framing is unrecoverable: we cannot skip what we won't read
         raise ValueError(f"message too large: {length}")
     body = _read_exact(sock, length)
     if body is None:
         return None
-    return json.loads(body.decode("utf-8"))
+    try:
+        return json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise BadPayload(str(exc)) from exc
 
 
 def _read_exact(sock, n: int) -> Optional[bytes]:
@@ -52,6 +61,9 @@ class _Handler(socketserver.BaseRequestHandler):
         while True:
             try:
                 msg = _read_msg(self.request)
+            except BadPayload as exc:
+                _write_msg(self.request, {"error": f"bad payload: {exc}"})
+                continue
             except (ConnectionError, ValueError):
                 return
             if msg is None:
