@@ -1,0 +1,49 @@
+"""Standalone verb binaries — vsub/vcancel/vsuspend/vresume/vjobs/vqueues.
+
+The reference builds one binary per verb around the same pkg/cli
+(Makefile:172-180 `command-lines`); here each is a console_scripts entry
+point (pyproject.toml) wrapping vcctl's parser with the verb pre-applied.
+
+Standalone invocations need a cluster to talk to; the in-process CLI talks
+to a store, so each verb accepts --rpc host:port to reach a running
+snapshot-RPC sidecar deployment, or operates on a fresh in-process system
+for dry runs (the vcctl main prints a clear error when no store is
+attached).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from .vcctl import main
+
+
+def _run(prefix: List[str], argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    return main(prefix + list(argv))
+
+
+def vsub(argv=None) -> int:
+    """vsub == vcctl job run."""
+    return _run(["job", "run"], argv)
+
+
+def vcancel(argv=None) -> int:
+    return _run(["job", "delete"], argv)
+
+
+def vsuspend(argv=None) -> int:
+    return _run(["job", "suspend"], argv)
+
+
+def vresume(argv=None) -> int:
+    return _run(["job", "resume"], argv)
+
+
+def vjobs(argv=None) -> int:
+    return _run(["job", "list"], argv)
+
+
+def vqueues(argv=None) -> int:
+    return _run(["queue", "list"], argv)

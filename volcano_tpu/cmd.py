@@ -1,0 +1,105 @@
+"""Control-plane entry points — the cmd/{scheduler,controller-manager}
+binaries plus the snapshot-RPC sidecar (ref cmd/scheduler/app/
+server.go:57-141, cmd/controller-manager/app/server.go:51-130).
+
+The in-process deployment runs everything in one VolcanoSystem; these
+binaries exist for the split topology: a store (or a Go shim against a
+real API server) on one side, scheduler/controllers as separate processes
+with leader election on the other.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+from typing import List, Optional
+
+
+def scheduler_main(argv: Optional[List[str]] = None) -> int:
+    """vc-scheduler: the full in-process control plane with the scheduling
+    loop in the foreground (flags mirror cmd/scheduler/app/options)."""
+    parser = argparse.ArgumentParser(prog="vc-scheduler")
+    parser.add_argument("--scheduler-conf", default=None,
+                        help="YAML conf path (hot-reloaded on change)")
+    parser.add_argument("--schedule-period", type=float, default=1.0)
+    parser.add_argument("--default-queue", default="default")
+    parser.add_argument("--leader-elect", action="store_true",
+                        help="acquire the store lease before scheduling")
+    parser.add_argument("--native-store", action="store_true",
+                        help="back state with the C++ object store")
+    args = parser.parse_args(argv)
+
+    from .system import VolcanoSystem
+    sys_ = VolcanoSystem(schedule_period=args.schedule_period,
+                         default_queue=args.default_queue,
+                         native_store=args.native_store)
+    sys_.scheduler.conf_path = args.scheduler_conf
+    signal.signal(signal.SIGTERM, lambda *_: sys_.stop())
+    try:
+        if args.leader_elect:
+            sys_.scheduler.run_with_leader_election(sys_.store)
+        else:
+            sys_.scheduler.run()
+    except KeyboardInterrupt:
+        sys_.stop()
+    return 0
+
+
+def controller_manager_main(argv: Optional[List[str]] = None) -> int:
+    """vc-controller-manager: store + webhooks + controllers, no scheduler
+    (the scheduler talks to the same store from its own process via the
+    snapshot RPC)."""
+    parser = argparse.ArgumentParser(prog="vc-controller-manager")
+    parser.add_argument("--leader-elect", action="store_true")
+    parser.add_argument("--native-store", action="store_true")
+    args = parser.parse_args(argv)
+
+    from .controllers import start_controllers
+    from .store import ObjectStore
+    from .webhooks import register_webhooks
+    if args.native_store:
+        from .native import make_object_store
+        store = make_object_store(prefer_native=True)
+    else:
+        store = ObjectStore()
+    register_webhooks(store)
+    start_controllers(store)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+
+    def wait() -> int:
+        try:
+            stop.wait()
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    if args.leader_elect:
+        from .leaderelection import LeaderElector
+        LeaderElector(store, "vc-controller-manager",
+                      on_started_leading=wait).run()
+        return 0
+    return wait()
+
+
+def snapshot_rpc_main(argv: Optional[List[str]] = None) -> int:
+    """vc-snapshot-rpc: the Go-shim-facing scheduler sidecar (SURVEY M2)."""
+    parser = argparse.ArgumentParser(prog="vc-snapshot-rpc")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=7070)
+    parser.add_argument("--scheduler-conf", default=None)
+    args = parser.parse_args(argv)
+
+    conf_text = None
+    if args.scheduler_conf:
+        with open(args.scheduler_conf) as f:
+            conf_text = f.read()
+    from .rpc import serve
+    server, thread, port = serve(args.host, args.port, conf_text)
+    print(f"vc-snapshot-rpc listening on {args.host}:{port}")
+    try:
+        thread.join()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0

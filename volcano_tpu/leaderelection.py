@@ -1,0 +1,141 @@
+"""Leader election over the ObjectStore — the HA story for the scheduler
+and controller-manager (ref /root/reference/cmd/scheduler/app/
+server.go:111-141: resourcelock + leaderelection.RunOrDie).
+
+The store IS the coordination backend (SURVEY §5.8: the API server is the
+bus), so the lock is a Lease-style object in it: holder identity + renew
+deadline. Multiple scheduler/controller replicas point at the same store
+(in-process, the native C++ store, or — through the snapshot RPC shim — a
+real API server); exactly one holds the lease and runs, the rest retry.
+A leader that misses its renew deadline loses the lease to the first
+challenger, mirroring the k8s LeaseDuration/RenewDeadline/RetryPeriod
+semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .apis.objects import ObjectMeta
+
+DEFAULT_LEASE_DURATION = 15.0
+DEFAULT_RENEW_DEADLINE = 10.0
+DEFAULT_RETRY_PERIOD = 2.0
+
+
+@dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease mirror."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder: str = ""
+    renew_time: float = 0.0
+    lease_duration: float = DEFAULT_LEASE_DURATION
+
+    KIND = "Lease"
+
+
+class LeaderElector:
+    """RunOrDie analogue: call run() from the current thread; it blocks,
+    acquiring the lease, invoking on_started_leading, renewing every
+    retry_period, and invoking on_stopped_leading if the lease is lost."""
+
+    def __init__(self, store, name: str,
+                 on_started_leading: Callable[[], None],
+                 on_stopped_leading: Optional[Callable[[], None]] = None,
+                 identity: Optional[str] = None,
+                 namespace: str = "volcano-system",
+                 lease_duration: float = DEFAULT_LEASE_DURATION,
+                 renew_deadline: float = DEFAULT_RENEW_DEADLINE,
+                 retry_period: float = DEFAULT_RETRY_PERIOD):
+        self.store = store
+        self.name = name
+        self.namespace = namespace
+        self.identity = identity or f"{name}-{uuid.uuid4().hex[:8]}"
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self._stop = threading.Event()
+        self.leading = False
+
+    # -- lock primitives ----------------------------------------------------
+
+    def _lease(self) -> Optional[Lease]:
+        return self.store.get("Lease", self.namespace, self.name)
+
+    def try_acquire_or_renew(self, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        lease = self._lease()
+        if lease is None:
+            lease = Lease(metadata=ObjectMeta(name=self.name,
+                                              namespace=self.namespace),
+                          holder=self.identity, renew_time=now,
+                          lease_duration=self.lease_duration)
+            self.store.create(lease)
+            return True
+        if lease.holder == self.identity:
+            lease.renew_time = now
+            self.store.update(lease)
+            return True
+        if now - lease.renew_time > lease.lease_duration:
+            # expired: take it over
+            lease.holder = self.identity
+            lease.renew_time = now
+            self.store.update(lease)
+            return True
+        return False
+
+    def release(self) -> None:
+        lease = self._lease()
+        if lease is not None and lease.holder == self.identity:
+            lease.renew_time = 0.0
+            self.store.update(lease)
+        self.leading = False
+
+    # -- the election loop --------------------------------------------------
+
+    def run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                if self.try_acquire_or_renew():
+                    break
+                self._stop.wait(self.retry_period)
+            if self._stop.is_set():
+                return
+            self.leading = True
+            renewer = threading.Thread(target=self._renew_loop, daemon=True,
+                                       name=f"lease-renew-{self.name}")
+            renewer.start()
+            self.on_started_leading()
+        finally:
+            was_leading = self.leading
+            self.leading = False
+            self._stop.set()
+            if was_leading and self.on_stopped_leading is not None:
+                self.on_stopped_leading()
+
+    def _renew_loop(self) -> None:
+        last_renew = time.monotonic()
+        while not self._stop.is_set():
+            self._stop.wait(self.retry_period)
+            if self._stop.is_set():
+                return
+            if self.try_acquire_or_renew():
+                last_renew = time.monotonic()
+            elif time.monotonic() - last_renew > self.renew_deadline:
+                # lost the lease: stop leading (RunOrDie klog.Fatal analogue
+                # — here we signal the component loop to stop instead)
+                self.leading = False
+                self._stop.set()
+                if self.on_stopped_leading is not None:
+                    self.on_stopped_leading()
+                return
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -113,7 +113,7 @@ class NativeObjectStore:
     """
 
     KINDS = ("Pod", "Job", "PodGroup", "Queue", "Command", "PriorityClass",
-             "PersistentVolumeClaim")
+             "PersistentVolumeClaim", "Lease")
 
     def __init__(self, log_capacity: int = 65536):
         lib = _get_lib()

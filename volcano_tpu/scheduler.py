@@ -83,6 +83,17 @@ class Scheduler:
             if remaining > 0:
                 self._stop.wait(remaining)
 
+    def run_with_leader_election(self, store, name: str = "vc-scheduler",
+                                 **lease_kwargs) -> None:
+        """HA entry point (cmd/scheduler/app/server.go:111-141): block until
+        this replica holds the lease in the store, then run the loop; losing
+        the lease stops it."""
+        from .leaderelection import LeaderElector
+        self._elector = LeaderElector(
+            store, name, on_started_leading=self.run,
+            on_stopped_leading=self.stop, **lease_kwargs)
+        self._elector.run()
+
     def start(self) -> threading.Thread:
         thread = threading.Thread(target=self.run, daemon=True,
                                   name="vc-scheduler")

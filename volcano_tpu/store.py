@@ -31,7 +31,7 @@ class AdmissionError(Exception):
 
 class ObjectStore:
     KINDS = ("Pod", "Job", "PodGroup", "Queue", "Command", "PriorityClass",
-             "PersistentVolumeClaim")
+             "PersistentVolumeClaim", "Lease")
 
     def __init__(self):
         self._lock = threading.RLock()
