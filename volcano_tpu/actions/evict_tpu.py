@@ -56,8 +56,25 @@ def task_requests_of(tasks, rnames, init=True) -> np.ndarray:
     return req
 
 
+def _task_order_chain(ssn) -> List[str]:
+    return [name for tier in ssn.tiers for opt in tier.plugins
+            if opt.is_enabled("enabledTaskOrder")
+            and (name := opt.name) in ssn.task_order_fns]
+
+
 def _eviction_order(ssn, victims: List[TaskInfo]) -> List[TaskInfo]:
-    """Reversed TaskOrderFn — lowest priority first (preempt.go:237-244)."""
+    """Reversed TaskOrderFn — lowest priority first (preempt.go:237-244).
+    Key sort when only the priority plugin orders tasks (the default conf;
+    Python's reverse=True is stable, so tie order matches the stable
+    comparator sort); comparator sort otherwise."""
+    chain = _task_order_chain(ssn)
+    if chain == ["priority"]:
+        return sorted(victims,
+                      key=lambda t: (-t.priority, t.creation_timestamp,
+                                     t.uid), reverse=True)
+    if not chain:
+        return list(victims)
+
     def cmp(l, r):
         if ssn.task_order_fn(l, r):
             return 1
@@ -150,8 +167,11 @@ class _TierStack:
 
 
 def _drf_inputs(ssn, tensors: _EvictTensors, victims, need_group: bool):
-    """(vjob, jalloc0, total, same_group, job_index): global job table for
-    the in-kernel drf share tracking."""
+    """(vjob, jalloc0, total, perm_inputs, job_index): global job table for
+    the in-kernel drf share tracking. perm_inputs = (perm, inv, seg, head):
+    a (node, job, candidate-list order) sort of the victims and its segment
+    structure, so the kernel's within-dispatch exclusive prefix is one O(V)
+    segmented cumsum instead of a [V,V] matmul."""
     job_index = {uid: i for i, uid in enumerate(ssn.jobs)}
     AJ = len(job_index)
     R = len(tensors.rnames)
@@ -164,23 +184,34 @@ def _drf_inputs(ssn, tensors: _EvictTensors, victims, need_group: bool):
                 jalloc[jx] += t.resreq.to_vector(tensors.rnames)
     total = tensors.node_t.allocatable.sum(axis=0)
     vjob = np.asarray([job_index[t.job] for t in victims], np.int32)
-    if need_group:
-        # drf candidate-list order = _collect_victims order; same (node,job)
-        # lower-triangular in that order
+    V = max(1, len(victims))
+    if need_group and victims:
+        # drf candidate-list order = _collect_victims order
         rank = {t.uid: i for i, t in enumerate(_collect_victims(ssn))}
         vrank = np.asarray([rank.get(t.uid, 0) for t in victims])
         vnode = tensors.vnode
-        same_group = ((vnode[:, None] == vnode[None, :])
-                      & (vjob[:, None] == vjob[None, :])
-                      & (vrank[None, :] < vrank[:, None]))
+        perm = np.lexsort((vrank, vjob, vnode)).astype(np.int32)
+        inv = np.empty_like(perm)
+        inv[perm] = np.arange(len(perm), dtype=np.int32)
+        key = vnode[perm].astype(np.int64) * (vjob.max() + 1) + vjob[perm]
+        seg = np.zeros(len(perm), np.int32)
+        seg[1:] = np.cumsum(key[1:] != key[:-1]).astype(np.int32)
+        head = np.zeros(V, np.int32)
+        first = np.r_[True, key[1:] != key[:-1]]
+        head[seg[first]] = np.flatnonzero(first).astype(np.int32)
     else:
-        same_group = np.zeros((1, 1), bool)
-    return vjob, jalloc, total, same_group, job_index
+        perm = np.arange(V, dtype=np.int32)
+        inv = perm.copy()
+        seg = np.zeros(V, np.int32)
+        head = np.zeros(V, np.int32)
+    return vjob, jalloc, total, (perm, inv, seg, head), job_index
 
 
 def _score_matrix(ssn, ptasks, tensors: _EvictTensors):
     """f32[P,N] node scores with static feasibility folded in as -inf —
-    the same assembly the fused allocate engine uses."""
+    the same assembly the fused allocate engine uses. Returned as a DEVICE
+    array: at 5k preemptors x 1k nodes the matrix is ~20MB, and fetching it
+    just to re-upload into the scan costs seconds on a remote backend."""
     import jax.numpy as jnp
     from ..ops.scores import combined_dynamic_score
 
@@ -189,13 +220,13 @@ def _score_matrix(ssn, ptasks, tensors: _EvictTensors):
     feas = assemble_feasibility(ssn, ptasks, node_t)
     static = assemble_static_score(ssn, ptasks, node_t)
     weights = assemble_weights(ssn, tensors.rnames)
-    dyn = combined_dynamic_score(jnp.asarray(preq), jnp.asarray(node_t.used),
-                                 jnp.asarray(node_t.allocatable), weights)
-    score = np.asarray(dyn)
+    score = combined_dynamic_score(jnp.asarray(preq),
+                                   jnp.asarray(node_t.used),
+                                   jnp.asarray(node_t.allocatable), weights)
     if static is not None:
-        score = score + static
+        score = score + jnp.asarray(static)
     if feas is not None:
-        score = np.where(feas, score, -np.inf)
+        score = jnp.where(jnp.asarray(feas), score, -jnp.inf)
     return preq, score
 
 
@@ -226,14 +257,10 @@ def _starving_jobs(ssn):
 
 
 def _pending_in_order(ssn, job) -> List[TaskInfo]:
-    pq = PriorityQueue(ssn.task_order_fn)
-    for t in job.task_status_index.get(TaskStatus.PENDING, {}).values():
-        if not t.resreq.is_empty():
-            pq.push(t)
-    out = []
-    while not pq.empty():
-        out.append(pq.pop())
-    return out
+    """Pending tasks in TaskOrderFn order — same fast paths as the allocate
+    engine's _pending_tasks (actions/allocate.py)."""
+    from .allocate import _pending_tasks
+    return _pending_tasks(ssn, job)
 
 
 def execute_preempt_tpu(ssn) -> None:
@@ -241,12 +268,26 @@ def execute_preempt_tpu(ssn) -> None:
     intra-job, then the host victim_tasks pass."""
     victims = _eviction_order(ssn, _collect_victims(ssn))
     pjobs, under_request = _starving_jobs(ssn)
+    # a job with NO same-queue foreign victim can never preempt: its
+    # candidate row is empty for every tier (drf verdicts are subsets of
+    # the candidate list), so pruning it is exact
+    vq_count: Dict[str, int] = {}
+    vq_own: Dict[tuple, int] = {}
+    for v in victims:
+        q = ssn.jobs[v.job].queue
+        vq_count[q] = vq_count.get(q, 0) + 1
+        vq_own[(q, v.job)] = vq_own.get((q, v.job), 0) + 1
+    pjobs = [j for j in pjobs
+             if vq_count.get(j.queue, 0)
+             - vq_own.get((j.queue, j.uid), 0) > 0]
     if pjobs and victims:
         _preempt_phase(ssn, pjobs, victims, inter_job=True)
     # phase 2: within-job preemption, one pass in underRequest order
-    # (preempt.go:146-183)
+    # (preempt.go:146-183) — only jobs that still have pending tasks AND
+    # own running victims can act
     pjobs2 = [j for j in under_request
-              if j.task_status_index.get(TaskStatus.PENDING)]
+              if j.task_status_index.get(TaskStatus.PENDING)
+              and j.task_status_index.get(TaskStatus.RUNNING)]
     victims2 = _eviction_order(ssn, _collect_victims(ssn))
     if pjobs2 and victims2:
         _preempt_phase(ssn, pjobs2, victims2, inter_job=False)
@@ -291,7 +332,7 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
                        "enabledPreemptable", "drf", cand_filter)
     tensors = _EvictTensors(ssn, victims, ptasks)
     preq, score = _score_matrix(ssn, ptasks, tensors)
-    vjob, jalloc0, total, same_group, job_index = _drf_inputs(
+    vjob, jalloc0, total, (perm, inv, seg, head), job_index = _drf_inputs(
         ssn, tensors, victims, need_group=stack.has_dynamic)
     pjg = np.asarray([job_index[j.uid] for j in kept_jobs], np.int32)[
         np.asarray(pjob_ix, np.int32)]
@@ -306,7 +347,9 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
         jnp.asarray(preq), jnp.asarray(np.asarray(pjob_ix, np.int32)),
         jnp.asarray(np.asarray(first, bool)), jnp.asarray(score),
         jnp.asarray(needed), jnp.asarray(vjob), jnp.asarray(pjg),
-        jnp.asarray(jalloc0), jnp.asarray(total), jnp.asarray(same_group))
+        jnp.asarray(jalloc0), jnp.asarray(total),
+        jnp.asarray(perm), jnp.asarray(inv), jnp.asarray(seg),
+        jnp.asarray(head))
     packed = np.asarray(jnp.concatenate([
         task_node, owner, job_done.astype(jnp.int32)]))     # one fetch
     P, V = len(ptasks), len(victims)

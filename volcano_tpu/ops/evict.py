@@ -21,8 +21,10 @@ FULL tier semantics:
   DYNAMIC — job dominant shares are tracked in the scan carry exactly as
   drf's event handlers would (allocate on pipeline, deallocate on evict),
   including the within-dispatch sequential subtraction of earlier
-  candidates of the same job (drf.go:308-330) via a candidate-order
-  lower-triangular same-(node,job) matmul;
+  candidates of the same job (drf.go:308-330) via an O(V) segmented
+  exclusive cumsum over a host-precomputed (node, job, candidate-order)
+  permutation — not a [V,V] matmul, which dominates the scan at 5k
+  victims;
 - per preemptor: evictable capacity per node via one [V,R]x[V,N] einsum,
   feasibility = future_idle + evictable >= request AND at least one victim
   (validate_victims rejects empty lists), best node by argmax of the masked
@@ -75,27 +77,36 @@ def build_preempt_scan(tier_kinds: Tuple[str, ...],
       (future_idle0 [N,R], vreq [V,R], vnode [V], cand_mask [PJ,V],
        tier_masks  — tuple per tier of tuples (mask [PJ,V], part [PJ]),
        preq [P,R], pjob [P], first_of_job [P], score [P,N], needed [PJ],
-       vjob [V], pjg [P], jalloc0 [AJ,R], total [R], same_group [V,V])
+       vjob [V], pjg [P], jalloc0 [AJ,R], total [R],
+       drf_perm [V], drf_inv [V], drf_seg [V], drf_head [V])
 
-    and returns (task_node i32[P], victim_owner i32[V], job_done bool[PJ]).
+    where drf_perm sorts victims by (node, job, candidate-list order),
+    drf_inv is its inverse, drf_seg the (node, job) segment id per sorted
+    position, and drf_head the sorted position of each segment's first
+    element (indexed by segment id, padded to V). Returns (task_node
+    i32[P], victim_owner i32[V], job_done bool[PJ]).
     """
 
     def scan_fn(future_idle0, vreq, vnode, cand_mask, tier_masks,
                 preq, pjob, first_of_job, score, needed,
-                vjob, pjg, jalloc0, total, same_group):
+                vjob, pjg, jalloc0, total,
+                drf_perm, drf_inv, drf_seg, drf_head):
         N, R = future_idle0.shape
         V = vreq.shape[0]
         P = preq.shape[0]
         PJ = needed.shape[0]
         AJ = jalloc0.shape[0]
-        node_onehot = jax.nn.one_hot(vnode, N, dtype=preq.dtype)   # [V,N]
         fdtype = preq.dtype
+        vreq_sorted = vreq[drf_perm]
+
+        def per_node(mask_f):
+            """segment-sum a [V] (or [V,R]) quantity onto nodes — O(V)."""
+            return jax.ops.segment_sum(mask_f, vnode, num_segments=N)
 
         def eligibility(alive, jalloc, pj, pjg_i, req):
             """Replay the tiered dispatch for this preemptor against every
             node at once; returns the eligible-victim mask [V]."""
             cand = alive & cand_mask[pj]
-            cand_f = cand.astype(fdtype)
             decided_n = jnp.zeros(N, bool)
             elig = jnp.zeros(V, bool)
             for kind, masks in zip(tier_kinds, tier_masks):
@@ -106,25 +117,27 @@ def build_preempt_scan(tier_kinds: Tuple[str, ...],
                     row_on = part[pj]
                     pm = m[pj] | ~row_on
                     tset = tset & pm
-                    cnt = jnp.einsum("v,vn->n",
-                                     (cand & m[pj]).astype(fdtype),
-                                     node_onehot)
+                    cnt = per_node((cand & m[pj]).astype(fdtype))
                     ok_n = ok_n & ((cnt > 0) | ~row_on)
                     participated = participated | row_on
                 if kind == "drf":
                     # drf.go:308-330 — subtract earlier same-job candidates
-                    # (in candidate-list order) before comparing shares
-                    prior = (same_group.astype(fdtype)
-                             * cand_f[None, :]) @ vreq          # [V,R]
+                    # (in candidate-list order) before comparing shares:
+                    # segmented exclusive cumsum in (node, job, order) space
+                    cs = jnp.cumsum(
+                        vreq_sorted * cand[drf_perm][:, None].astype(fdtype),
+                        axis=0)
+                    ecs = cs - vreq_sorted \
+                        * cand[drf_perm][:, None].astype(fdtype)
+                    base = ecs[drf_head[drf_seg]]          # segment starts
+                    prior = (ecs - base)[drf_inv]          # back to V order
                     ralloc = jalloc[vjob] - prior - vreq
                     rs = _share(ralloc, total)                   # [V]
                     ls = _share(jalloc[pjg_i] + req, total)      # scalar
                     dset = cand & ((ls < rs)
                                    | (jnp.abs(ls - rs) <= SHARE_DELTA))
                     tset = tset & dset
-                    dcnt = jnp.einsum("v,vn->n", dset.astype(fdtype),
-                                      node_onehot)
-                    ok_n = ok_n & (dcnt > 0)
+                    ok_n = ok_n & (per_node(dset.astype(fdtype)) > 0)
                     participated = jnp.ones((), bool)
                 ok_n = ok_n & participated
                 take_n = ok_n & ~decided_n
@@ -172,11 +185,10 @@ def build_preempt_scan(tier_kinds: Tuple[str, ...],
 
             elig = eligibility(c.alive, c.jalloc, pj, pjg_i, req)
             elig_f = elig[:, None].astype(fdtype)
-            evictable = jnp.einsum("vr,vn->nr", vreq * elig_f, node_onehot)
+            evictable = per_node(vreq * elig_f)
             # a node is only a preemption target if it hosts at least one
             # eligible victim (validate_victims rejects empty victim lists)
-            has_victim = jnp.einsum("v,vn->n", elig.astype(fdtype),
-                                    node_onehot) > 0
+            has_victim = per_node(elig.astype(fdtype)) > 0
             fits = (jnp.all(req[None, :] < c.fidle + evictable + EPS,
                             axis=-1) & has_victim)
             row = jnp.where(fits, score[p_ix], -jnp.inf)
@@ -273,8 +285,10 @@ def build_reclaim_scan(tier_kinds: Tuple[str, ...],
         P = preq.shape[0]
         PJ = cand_mask.shape[0]
         Q = qalloc0.shape[0]
-        node_onehot = jax.nn.one_hot(vnode, N, dtype=preq.dtype)
         fdtype = preq.dtype
+
+        def per_node(mask_f):
+            return jax.ops.segment_sum(mask_f, vnode, num_segments=N)
 
         def eligibility(alive, qalloc, pj):
             cand = alive & cand_mask[pj]
@@ -288,9 +302,7 @@ def build_reclaim_scan(tier_kinds: Tuple[str, ...],
                     row_on = part[pj]
                     pm = m[pj] | ~row_on
                     tset = tset & pm
-                    cnt = jnp.einsum("v,vn->n",
-                                     (cand & m[pj]).astype(fdtype),
-                                     node_onehot)
+                    cnt = per_node((cand & m[pj]).astype(fdtype))
                     ok_n = ok_n & ((cnt > 0) | ~row_on)
                     participated = participated | row_on
                 if kind == "proportion":
@@ -300,9 +312,7 @@ def build_reclaim_scan(tier_kinds: Tuple[str, ...],
                     holds = jnp.any(qalloc[vqueue] - vreq > -EPS, axis=-1)
                     pset = cand & over[vqueue] & holds
                     tset = tset & pset
-                    pcnt = jnp.einsum("v,vn->n", pset.astype(fdtype),
-                                      node_onehot)
-                    ok_n = ok_n & (pcnt > 0)
+                    ok_n = ok_n & (per_node(pset.astype(fdtype)) > 0)
                     participated = jnp.ones((), bool)
                 ok_n = ok_n & participated
                 take_n = ok_n & ~decided_n
@@ -317,7 +327,7 @@ def build_reclaim_scan(tier_kinds: Tuple[str, ...],
             active = ~job_stop[pj] & ~queue_stop[pq]
             elig = eligibility(alive, qalloc, pj)
             elig_f = elig[:, None].astype(fdtype)
-            evictable = jnp.einsum("vr,vn->nr", vreq * elig_f, node_onehot)
+            evictable = per_node(vreq * elig_f)
             covers = jnp.all(req[None, :] < fidle + evictable + EPS, axis=-1)
             enough = jnp.all(req[None, :] < evictable + EPS, axis=-1)
             fits = covers & enough
