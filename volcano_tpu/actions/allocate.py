@@ -444,8 +444,10 @@ def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4,
                 solution = None
                 break
             t0 = time.perf_counter()
-            solution = _solve_fused(ssn, ordered_jobs, blocks, kernel,
-                                    sharded)
+            from .. import metrics
+            with metrics.solver_trace("allocate-solve"):
+                solution = _solve_fused(ssn, ordered_jobs, blocks, kernel,
+                                        sharded)
             t_solve += time.perf_counter() - t0
             if solution is None:
                 break

@@ -63,6 +63,30 @@ def update_e2e_duration(seconds: float) -> None:
         _e2e.observe(seconds * 1e3)
 
 
+def solver_trace(name: str):
+    """JAX profiler hook around a device solve (SURVEY §5.1): a
+    StepTraceAnnotation so the solve shows up as a named step in a
+    `jax.profiler` trace. Enabled by VOLCANO_TPU_JAX_PROFILE=1; with
+    VOLCANO_TPU_JAX_PROFILE_DIR set, the first annotated solve also starts
+    a trace capture into that directory (stopped at interpreter exit)."""
+    import contextlib
+    import os
+    if not os.environ.get("VOLCANO_TPU_JAX_PROFILE"):
+        return contextlib.nullcontext()
+    import jax
+    trace_dir = os.environ.get("VOLCANO_TPU_JAX_PROFILE_DIR")
+    global _trace_started
+    if trace_dir and not _trace_started:
+        _trace_started = True
+        import atexit
+        jax.profiler.start_trace(trace_dir)
+        atexit.register(jax.profiler.stop_trace)
+    return jax.profiler.StepTraceAnnotation(name)
+
+
+_trace_started = False
+
+
 def update_action_duration(action: str, seconds: float) -> None:
     with _lock:
         _durations[("action", action)].append(seconds * 1e6)
