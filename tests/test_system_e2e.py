@@ -271,6 +271,61 @@ class TestBarePod:
         assert pod.status.phase == "Running"
 
 
+class TestEventsAndScale:
+    def test_scheduled_and_evict_events_recorded(self):
+        """EventRecorder analogue (cache.go:597-641): binds emit Scheduled,
+        evictions emit Evict, unschedulable gangs emit FailedScheduling."""
+        sys = make_system()
+        submit_mpi_job(sys)
+        sys.schedule_once()
+        sys.schedule_once()
+        evs = sys.store.events_for("Pod", "default", "mpi-job-worker-0")
+        assert any(e["reason"] == "Scheduled" for e in evs)
+        # unschedulable gang -> FailedScheduling on the podgroup
+        submit_mpi_job(sys, name="huge", replicas=500)
+        sys.schedule_once()
+        pg_events = sys.store.events_for("PodGroup", "default", "huge")
+        assert any(e["reason"] == "FailedScheduling" for e in pg_events)
+
+    def test_job_scale_up_down(self):
+        """jobp/job_scale_up_down.go analogue: editing replicas grows and
+        shrinks the pod set through the spec-change sync."""
+        sys = make_system()
+        submit_mpi_job(sys, name="elastic", replicas=2, min_available=1)
+        sys.schedule_once()
+        assert len(sys.store.list("Pod")) == 2
+        job = sys.store.get("Job", "default", "elastic")
+        import copy
+        newjob = copy.deepcopy(job)
+        newjob.spec.tasks[0].replicas = 4
+        sys.store.update(newjob)
+        assert len(sys.store.list("Pod")) == 4
+        newjob2 = copy.deepcopy(sys.store.get("Job", "default", "elastic"))
+        newjob2.spec.tasks[0].replicas = 1
+        sys.store.update(newjob2)
+        assert len(sys.store.list("Pod")) == 1
+
+    def test_bind_pod_group_forwards_cluster(self):
+        """Multi-cluster forwarding (cache.go:275-312): the silo-cluster
+        annotation lands on every pod and the PodGroup."""
+        sys = make_system()
+        submit_mpi_job(sys, name="silo")
+        sys.schedule_once()          # pods exist
+        ssn_job = None
+        from volcano_tpu.framework import open_session, close_session
+        ssn = open_session(sys.cache, sys.scheduler.conf.tiers, [])
+        ssn_job = ssn.jobs.get("default/silo")
+        assert ssn_job is not None
+        ssn.bind_pod_group(ssn_job, "silo-cluster-1")
+        close_session(ssn)
+        pg = sys.store.get("PodGroup", "default", "silo")
+        assert pg.metadata.annotations.get("volcano.sh/forward-cluster") \
+            == "silo-cluster-1"
+        for t in ssn_job.tasks.values():
+            assert t.annotations.get("volcano.sh/forward-cluster") \
+                == "silo-cluster-1"
+
+
 class TestJobVolumes:
     """PVC lifecycle (createJobIOIfNotExist, job_controller_actions.go:442
     + the volume binder, cache.go:241-273)."""

@@ -353,6 +353,20 @@ class SchedulerCache:
                 self.resync_queue.add_rate_limited(key, (op, task))
         return done
 
+    FORWARD_CLUSTER_KEY = "volcano.sh/forward-cluster"
+
+    def bind_pod_group(self, job: JobInfo, cluster: str) -> None:
+        """Multi-cluster forwarding (podgroupBinder, cache.go:275-312):
+        annotate every task's pod and the PodGroup with the silo cluster so
+        the target cluster's control plane takes over the gang."""
+        for task in job.tasks.values():
+            task.annotations[self.FORWARD_CLUSTER_KEY] = cluster
+            pod = getattr(task, "pod", None)
+            if pod is not None:
+                pod.metadata.annotations[self.FORWARD_CLUSTER_KEY] = cluster
+        job.podgroup.annotations[self.FORWARD_CLUSTER_KEY] = cluster
+        self.status_updater.update_pod_group(job)
+
     def update_job_status(self, job: JobInfo) -> None:
         self.status_updater.update_pod_group(job)
         with self._lock:

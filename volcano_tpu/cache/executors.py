@@ -148,4 +148,19 @@ class StoreStatusUpdater(StatusUpdater):
             return
         pg.status.phase = job.podgroup.phase
         pg.status.conditions = list(job.podgroup.conditions)
+        # forward-cluster and similar scheduler-written annotations
+        # propagate with the status (podgroupBinder, cache.go:275-312)
+        for k, v in job.podgroup.annotations.items():
+            pg.metadata.annotations.setdefault(k, v)
+        # FailedScheduling events for unschedulable gangs (the cache's
+        # EventRecorder emissions, cache.go:597-641)
+        if hasattr(self.store, "record_event"):
+            for c in pg.status.conditions:
+                if c.get("type") == "Unschedulable" \
+                        and c.get("status") == "True":
+                    self.store.record_event(
+                        "PodGroup", job.namespace, job.podgroup.name,
+                        "Warning", "FailedScheduling",
+                        c.get("message", ""))
+                    break
         self.store.update_status(pg)

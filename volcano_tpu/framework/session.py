@@ -397,6 +397,11 @@ class Session:
         self._fire_deallocate(reclaimee)
         self.cache.evict(reclaimee, reason)
 
+    def bind_pod_group(self, job: JobInfo, cluster: str) -> None:
+        """Forward a gang to a silo cluster (session.go:399-402 ->
+        cache.BindPodGroup, the multi-cluster path)."""
+        self.cache.bind_pod_group(job, cluster)
+
     def update_scheduler_numa_info(self, numa_sets) -> None:
         """session.go:435-437 — forward cpuset assignments to the cache."""
         update = getattr(self.cache, "update_scheduler_numa_info", None)

@@ -127,6 +127,23 @@ class NativeObjectStore:
         # last rv whose watchers have been notified
         self._dispatch_lock = threading.RLock()
         self._dispatched = 0
+        # k8s EventRecorder analogue — Python-side (events are telemetry,
+        # not replayed state)
+        import collections
+        self.events = collections.deque(maxlen=2000)
+
+    def record_event(self, kind: str, namespace: str, name: str,
+                     etype: str, reason: str, message: str) -> None:
+        import time as _time
+        self.events.append({
+            "kind": kind, "namespace": namespace, "name": name,
+            "type": etype, "reason": reason, "message": message,
+            "time": _time.time()})
+
+    def events_for(self, kind: str, namespace: str, name: str):
+        return [e for e in self.events
+                if e["kind"] == kind and e["namespace"] == namespace
+                and e["name"] == name]
 
     def __del__(self):
         try:
@@ -274,9 +291,24 @@ class NativeObjectStore:
         pod = self._read("Pod", f"{namespace}/{name}")
         if pod is None:
             raise KeyError(f"pod {namespace}/{name} not found")
+        # the /pods webhook's in-process enforcement, same as the Python
+        # store: no bind while the pod's gang is Pending
+        group = pod.metadata.annotations.get(
+            "scheduling.k8s.io/group-name", "")
+        if group:
+            from ..api import PodGroupPhase
+            from ..store import AdmissionError
+            pg = self._read("PodGroup", f"{namespace}/{group}")
+            if pg is not None and pg.status.phase == PodGroupPhase.PENDING:
+                raise AdmissionError(
+                    f"cannot bind pod {namespace}/{name}: podgroup "
+                    f"{group} phase is Pending")
         pod.status.node_name = node_name
         pod.status.phase = "Running"
         self._write("Pod", pod, create_only=False)
+        self.record_event("Pod", namespace, name, "Normal", "Scheduled",
+                          f"Successfully assigned {namespace}/{name} "
+                          f"to {node_name}")
         self._drain_events()
 
     def evict_pod(self, namespace: str, name: str, reason: str) -> None:
@@ -285,6 +317,8 @@ class NativeObjectStore:
             return
         pod.status.conditions.append({"type": "Evicted", "reason": reason})
         self._write("Pod", pod, create_only=False)
+        self.record_event("Pod", namespace, name, "Warning", "Evict",
+                          f"Pod is evicted, because of {reason}")
         self.delete("Pod", namespace, name)
 
     def finish_pod(self, namespace: str, name: str,

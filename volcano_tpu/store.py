@@ -15,7 +15,9 @@ resyncing after a restart.
 
 from __future__ import annotations
 
+import collections
 import threading
+import time
 from typing import Callable, Dict, Iterable, List, Optional
 
 from .apis.objects import Command, Job, Pod, PodGroupCR, QueueCR
@@ -39,6 +41,22 @@ class ObjectStore:
         self._watchers: Dict[str, List[Callable]] = {k: [] for k in self.KINDS}
         self._admission_hooks: List[Callable] = []
         self._rv = 0
+        # k8s EventRecorder analogue (cache.go:597-641): bounded event log
+        self.events: "collections.deque" = collections.deque(maxlen=2000)
+
+    # -- events (EventRecorder analogue) ------------------------------------
+
+    def record_event(self, kind: str, namespace: str, name: str,
+                     etype: str, reason: str, message: str) -> None:
+        self.events.append({
+            "kind": kind, "namespace": namespace, "name": name,
+            "type": etype, "reason": reason, "message": message,
+            "time": time.time()})
+
+    def events_for(self, kind: str, namespace: str, name: str) -> List[dict]:
+        return [e for e in self.events
+                if e["kind"] == kind and e["namespace"] == namespace
+                and e["name"] == name]
 
     # -- admission (webhook-manager analogue) -------------------------------
 
@@ -167,6 +185,9 @@ class ObjectStore:
             pod.status.phase = "Running"
             self._rv += 1
             pod.metadata.resource_version = self._rv
+        self.record_event("Pod", namespace, name, "Normal", "Scheduled",
+                          f"Successfully assigned {namespace}/{name} "
+                          f"to {node_name}")
         self._notify("Pod", UPDATED, pod, old)
 
     def evict_pod(self, namespace: str, name: str, reason: str) -> None:
@@ -176,6 +197,8 @@ class ObjectStore:
             if pod is None:
                 return
             pod.status.conditions.append({"type": "Evicted", "reason": reason})
+        self.record_event("Pod", namespace, name, "Warning", "Evict",
+                          f"Pod is evicted, because of {reason}")
         self.delete("Pod", namespace, name)
 
     def finish_pod(self, namespace: str, name: str, succeeded: bool = True) -> None:
