@@ -271,6 +271,72 @@ class TestBarePod:
         assert pod.status.phase == "Running"
 
 
+class TestMinSuccess:
+    def test_job_completes_at_min_success(self):
+        """jobp/min_success.go analogue: the job completes once minSuccess
+        pods succeeded, even while others still run (running.go:61-65)."""
+        sys = make_system()
+        job = Job(
+            metadata=ObjectMeta(name="ms"),
+            spec=JobSpec(
+                min_available=1,
+                tasks=[TaskSpec(name="w", replicas=4,
+                                template=PodTemplate(
+                                    resources=Resource(1000, 1 << 30)))]))
+        job.spec.min_success = 2
+        sys.store.create(job)
+        sys.schedule_once()
+        sys.schedule_once()
+        pods = sys.store.list("Pod")
+        assert len(pods) == 4
+        for pod in pods[:2]:
+            sys.store.finish_pod(pod.metadata.namespace, pod.metadata.name)
+        sys._drain_controllers()
+        job = sys.store.get("Job", "default", "ms")
+        assert job.status.state == JobPhase.COMPLETED
+
+    def test_min_success_floor_fails_job(self):
+        """All pods finished with fewer than minSuccess successes ->
+        Failed (running.go:84-90)."""
+        sys = make_system()
+        job = Job(
+            metadata=ObjectMeta(name="msf"),
+            spec=JobSpec(
+                min_available=1,
+                tasks=[TaskSpec(name="w", replicas=2,
+                                template=PodTemplate(
+                                    resources=Resource(1000, 1 << 30)))]))
+        job.spec.min_success = 2
+        sys.store.create(job)
+        sys.schedule_once()
+        sys.schedule_once()
+        pods = sys.store.list("Pod")
+        sys.store.finish_pod(pods[0].metadata.namespace,
+                             pods[0].metadata.name, succeeded=True)
+        sys.store.finish_pod(pods[1].metadata.namespace,
+                             pods[1].metadata.name, succeeded=False)
+        sys._drain_controllers()
+        job = sys.store.get("Job", "default", "msf")
+        assert job.status.state == JobPhase.FAILED
+
+
+def test_metrics_healthz_endpoint():
+    """--listen-address endpoint (options.go:32,94): /metrics + /healthz."""
+    import urllib.request
+    from volcano_tpu import metrics
+    server = metrics.start_metrics_server(0)
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz") as r:
+            assert r.read() == b"ok"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as r:
+            assert r.status == 200
+    finally:
+        server.shutdown()
+
+
 class TestEventsAndScale:
     def test_scheduled_and_evict_events_recorded(self):
         """EventRecorder analogue (cache.go:597-641): binds emit Scheduled,

@@ -90,6 +90,8 @@ class JobSpec:
     ttl_seconds_after_finished: Optional[float] = None
     priority_class_name: str = ""
     volumes: List[dict] = field(default_factory=list)
+    # job succeeds once this many pods succeeded (job.go:104 MinSuccess)
+    min_success: Optional[int] = None
 
 
 @dataclass

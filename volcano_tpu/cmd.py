@@ -28,7 +28,15 @@ def scheduler_main(argv: Optional[List[str]] = None) -> int:
                         help="acquire the store lease before scheduling")
     parser.add_argument("--native-store", action="store_true",
                         help="back state with the C++ object store")
+    parser.add_argument("--listen-address", type=int, default=0,
+                        metavar="PORT",
+                        help="serve /metrics and /healthz on this port "
+                             "(0 = disabled)")
     args = parser.parse_args(argv)
+
+    if args.listen_address:
+        from . import metrics
+        metrics.start_metrics_server(args.listen_address)
 
     from .system import VolcanoSystem
     sys_ = VolcanoSystem(schedule_period=args.schedule_period,

@@ -69,12 +69,33 @@ class RunningState(State):
             total = sum(t.replicas for t in job.spec.tasks)
 
             def next_phase(status) -> JobPhase:
+                """running.go:54-95: minSuccess early completion, then the
+                all-pods-finished verdict (per-task minAvailable success
+                minima, minSuccess floor, job minAvailable)."""
                 if total == 0:
                     return JobPhase.RUNNING
-                if status.succeeded + status.failed == total:
-                    if status.failed:
-                        return JobPhase.FAILED
+                min_success = job.spec.min_success
+                if min_success is not None \
+                        and status.succeeded >= min_success:
                     return JobPhase.COMPLETED
+                if status.succeeded + status.failed == total:
+                    task_min_total = sum(
+                        t.min_available for t in job.spec.tasks
+                        if t.min_available is not None)
+                    if job.spec.min_available >= task_min_total:
+                        for task in job.spec.tasks:
+                            if task.min_available is None:
+                                continue
+                            succ = status.task_status_count.get(
+                                task.name, {}).get("Succeeded", 0)
+                            if succ < task.min_available:
+                                return JobPhase.FAILED
+                    if min_success is not None \
+                            and status.succeeded < min_success:
+                        return JobPhase.FAILED
+                    if status.succeeded >= job.spec.min_available:
+                        return JobPhase.COMPLETED
+                    return JobPhase.FAILED
                 # succeeded tasks keep counting toward the gang
                 # (running.go:30-60)
                 if status.running + status.succeeded < job.spec.min_available:

@@ -63,6 +63,49 @@ def update_e2e_duration(seconds: float) -> None:
         _e2e.observe(seconds * 1e3)
 
 
+def start_metrics_server(port: int = 8080, host: str = ""):
+    """Serve /metrics (Prometheus exposition) and /healthz — the
+    --listen-address endpoint of cmd/scheduler/app (options.go:32,94).
+    Returns the http.server instance (daemon thread)."""
+    import http.server
+    import threading
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.startswith("/healthz"):
+                body = b"ok"
+                ctype = "text/plain"
+            elif self.path.startswith("/metrics"):
+                if _HAVE_PROM:
+                    from prometheus_client import (CONTENT_TYPE_LATEST,
+                                                   generate_latest)
+                    body = generate_latest()
+                    ctype = CONTENT_TYPE_LATEST
+                else:
+                    with _lock:
+                        lines = [f"# {k}: {v}" for k, v in _gauges.items()]
+                        lines += [f"# {k}: {v}" for k, v in _counters.items()]
+                    body = "\n".join(lines).encode()
+                    ctype = "text/plain"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = http.server.ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="vc-metrics").start()
+    return server
+
+
 def solver_trace(name: str):
     """JAX profiler hook around a device solve (SURVEY §5.1): a
     StepTraceAnnotation so the solve shows up as a named step in a
