@@ -112,6 +112,12 @@ def main():
     extras.update(binds_10k=binds10k,
                   pods_per_sec=round(binds10k / best, 1))
 
+    # the multi-chip engine at the headline config (single-chip mesh here;
+    # the driver's dryrun_multichip exercises the 8-device sharding)
+    run_cycle("10k", "tpu-sharded")               # warm
+    sh10_s, sh10_admitted, _ = run_cycle("10k", "tpu-sharded")
+    extras.update(tpu_sharded_10k_ms=round(sh10_s * 1e3, 2))
+
     # config 4: preempt mix — device engine at full scale, parity at 1/10th
     p_cpu_s, p_cpu_evicts, _ = run_preempt("preempt-small", "callbacks")
     run_preempt("preempt-small", "tpu")
