@@ -187,6 +187,30 @@ class TestEngineParity:
         assert admitted["callbacks"] == admitted["tpu-strict"]
         assert admitted["callbacks"] == admitted["tpu-fused"]
 
+    def test_baseline_config2_parity_all_engines(self):
+        """BASELINE config 2 (1k pods / 200 nodes) as a repo-level parity
+        oracle: callbacks == tpu-strict == tpu-fused gang admissions (the
+        bench asserts this on the live chip; this is the CI regression)."""
+        from volcano_tpu.cache.synthetic import baseline_config
+        from volcano_tpu.framework import (close_session, open_session,
+                                           parse_scheduler_conf)
+        from volcano_tpu.actions import AllocateAction
+
+        conf = parse_scheduler_conf(None)
+        admitted = {}
+        binds = {}
+        for engine in ("callbacks", "tpu-strict", "tpu-fused"):
+            cache, binder, _ = baseline_config("1k", seed=3)
+            ssn = open_session(cache, conf.tiers, [])
+            AllocateAction(engine=engine).execute(ssn)
+            close_session(ssn)
+            admitted[engine] = frozenset(k.rsplit("-", 1)[0]
+                                         for k in binder.binds)
+            binds[engine] = len(binder.binds)
+        assert admitted["callbacks"] == admitted["tpu-strict"]
+        assert admitted["callbacks"] == admitted["tpu-fused"]
+        assert binds["callbacks"] == binds["tpu-strict"] == binds["tpu-fused"]
+
 
 class TestStatefulPredicateRecheck:
     """Batched engines must re-validate device proposals through stateful
