@@ -183,6 +183,30 @@ class TestAdmission:
         with pytest.raises(AdmissionError):
             sys.queues.create("bad", weight=-1)
 
+    def test_queue_hierarchy_validated(self):
+        """validate_queue.go:113-168: weights/path length match, positive
+        numeric weights, no sub-path conflicts."""
+        from volcano_tpu.apis.objects import QueueCR, QueueSpecCR
+        sys = make_system()
+
+        def queue(name, hierarchy, weights):
+            return QueueCR(
+                metadata=ObjectMeta(
+                    name=name, namespace="default",
+                    annotations={"volcano.sh/hierarchy": hierarchy,
+                                 "volcano.sh/hierarchy-weights": weights}),
+                spec=QueueSpecCR(weight=1))
+
+        with pytest.raises(AdmissionError):     # length mismatch
+            sys.store.create(queue("q1", "root/sci", "100"))
+        with pytest.raises(AdmissionError):     # non-numeric weight
+            sys.store.create(queue("q2", "root/sci", "100/abc"))
+        with pytest.raises(AdmissionError):     # non-positive weight
+            sys.store.create(queue("q3", "root/sci", "100/0"))
+        sys.store.create(queue("q4", "root/sci/dev", "100/50/50"))
+        with pytest.raises(AdmissionError):     # sub-path conflict
+            sys.store.create(queue("q5", "root/sci", "100/50"))
+
     def test_duplicate_task_name_denied(self):
         sys = make_system()
         job = Job(metadata=ObjectMeta(name="dup"),

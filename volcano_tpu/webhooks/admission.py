@@ -101,14 +101,50 @@ def mutate_queue(operation: str, queue: QueueCR, old) -> QueueCR:
     return queue
 
 
-def validate_queue(operation: str, queue: QueueCR, old) -> None:
-    if queue.spec.weight < 1:
-        deny(f"queue weight must be a positive integer, got "
-             f"{queue.spec.weight}")
-    if operation == "CREATE" and queue.status.state not in (
-            QueueState.OPEN, QueueState.CLOSED):
-        deny(f"queue state must be in [Open, Closed], got "
-             f"{queue.status.state.value}")
+def make_validate_queue(store: ObjectStore):
+    def validate_queue(operation: str, queue: QueueCR, old) -> None:
+        if queue.spec.weight < 1:
+            deny(f"queue weight must be a positive integer, got "
+                 f"{queue.spec.weight}")
+        if operation == "CREATE" and queue.status.state not in (
+                QueueState.OPEN, QueueState.CLOSED):
+            deny(f"queue state must be in [Open, Closed], got "
+                 f"{queue.status.state.value}")
+        _validate_hierarchy(store, queue)
+    return validate_queue
+
+
+def _validate_hierarchy(store: ObjectStore, queue: QueueCR) -> None:
+    """Hierarchy annotation legality (validate_queue.go:113-168): path and
+    weights lengths match, weights are positive numbers, and no queue may
+    sit on another queue's sub path."""
+    from ..api.queue_info import (KUBE_HIERARCHY_ANNOTATION_KEY,
+                                  KUBE_HIERARCHY_WEIGHT_ANNOTATION_KEY)
+    ann = queue.metadata.annotations
+    hierarchy = ann.get(KUBE_HIERARCHY_ANNOTATION_KEY, "")
+    weights = ann.get(KUBE_HIERARCHY_WEIGHT_ANNOTATION_KEY, "")
+    if not hierarchy and not weights:
+        return
+    paths = hierarchy.split("/")
+    wparts = weights.split("/")
+    if len(paths) != len(wparts):
+        deny(f"{KUBE_HIERARCHY_ANNOTATION_KEY} must have the same length "
+             f"with {KUBE_HIERARCHY_WEIGHT_ANNOTATION_KEY}")
+    for w in wparts:
+        try:
+            wf = float(w)
+        except ValueError:
+            deny(f"{w} in the {weights} is invalid number")
+        else:
+            if wf <= 0:
+                deny(f"{w} in the {weights} must be larger than 0")
+    for other in store.list("Queue"):
+        other_h = other.metadata.annotations.get(
+            KUBE_HIERARCHY_ANNOTATION_KEY, "")
+        if (other_h and other.metadata.name != queue.metadata.name
+                and other_h.startswith(hierarchy)):
+            deny(f"{hierarchy} is not allowed to be in the sub path of "
+                 f"{other_h} of queue {other.metadata.name}")
 
 
 def mutate_podgroup(operation: str, pg: PodGroupCR, old) -> PodGroupCR:
@@ -196,7 +232,8 @@ def register_webhooks(store: ObjectStore) -> Router:
     router.register(AdmissionService(
         "/queues/mutate", ["Queue"], ["CREATE"], mutate_queue, mutating=True))
     router.register(AdmissionService(
-        "/queues/validate", ["Queue"], ["CREATE", "UPDATE"], validate_queue))
+        "/queues/validate", ["Queue"], ["CREATE", "UPDATE"],
+        make_validate_queue(store)))
     router.register(AdmissionService(
         "/podgroups/mutate", ["PodGroup"], ["CREATE"], mutate_podgroup,
         mutating=True))
