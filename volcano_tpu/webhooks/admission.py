@@ -19,6 +19,8 @@ import re
 from typing import Optional
 
 from ..api import BusAction, BusEvent, QueueState
+from ..api.queue_info import (KUBE_HIERARCHY_ANNOTATION_KEY,
+                              KUBE_HIERARCHY_WEIGHT_ANNOTATION_KEY)
 from ..apis.objects import Job, PodGroupCR, QueueCR
 from ..store import AdmissionError, ObjectStore
 from .router import AdmissionService, Router, deny
@@ -118,8 +120,6 @@ def _validate_hierarchy(store: ObjectStore, queue: QueueCR) -> None:
     """Hierarchy annotation legality (validate_queue.go:113-168): path and
     weights lengths match, weights are positive numbers, and no queue may
     sit on another queue's sub path."""
-    from ..api.queue_info import (KUBE_HIERARCHY_ANNOTATION_KEY,
-                                  KUBE_HIERARCHY_WEIGHT_ANNOTATION_KEY)
     ann = queue.metadata.annotations
     hierarchy = ann.get(KUBE_HIERARCHY_ANNOTATION_KEY, "")
     weights = ann.get(KUBE_HIERARCHY_WEIGHT_ANNOTATION_KEY, "")
@@ -131,6 +131,10 @@ def _validate_hierarchy(store: ObjectStore, queue: QueueCR) -> None:
         deny(f"{KUBE_HIERARCHY_ANNOTATION_KEY} must have the same length "
              f"with {KUBE_HIERARCHY_WEIGHT_ANNOTATION_KEY}")
     for w in wparts:
+        # Go's strconv.ParseFloat rejects underscores and surrounding
+        # whitespace that Python's float() tolerates
+        if w != w.strip() or "_" in w:
+            deny(f"{w} in the {weights} is invalid number")
         try:
             wf = float(w)
         except ValueError:
