@@ -31,7 +31,8 @@ class JobCommands:
     def run(self, name: str, namespace: str = "default", queue: str = "default",
             replicas: int = 1, min_available: Optional[int] = None,
             requests: Optional[dict] = None, image: str = "busybox",
-            scheduler: str = "volcano") -> Job:
+            scheduler: str = "volcano",
+            min_success: Optional[int] = None) -> Job:
         """constructLaunchJobFlagsJob (pkg/cli/job/run.go:71-165)."""
         res = Resource.from_dict(requests or {"cpu": "1", "memory": "1Gi"})
         job = Job(
@@ -39,6 +40,7 @@ class JobCommands:
             spec=JobSpec(
                 queue=queue, scheduler_name=scheduler,
                 min_available=min_available or replicas,
+                min_success=min_success,
                 tasks=[TaskSpec(name="default", replicas=replicas,
                                 template=PodTemplate(
                                     resources=res,
@@ -80,10 +82,17 @@ class QueueCommands:
 
     def create(self, name: str, weight: int = 1,
                capability: Optional[dict] = None,
-               reclaimable: bool = True) -> QueueCR:
+               reclaimable: bool = True, hierarchy: str = "",
+               hierarchy_weights: str = "") -> QueueCR:
         cap = Resource.from_dict(capability) if capability else None
+        annotations = {}
+        if hierarchy:
+            annotations["volcano.sh/hierarchy"] = hierarchy
+        if hierarchy_weights:
+            annotations["volcano.sh/hierarchy-weights"] = hierarchy_weights
         return self.store.create(QueueCR(
-            metadata=ObjectMeta(name=name, namespace="default"),
+            metadata=ObjectMeta(name=name, namespace="default",
+                                annotations=annotations),
             spec=QueueSpecCR(weight=weight, capability=cap,
                              reclaimable=reclaimable)))
 
@@ -128,6 +137,8 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--queue", default="default")
     run.add_argument("--replicas", type=int, default=1)
     run.add_argument("--min", type=int, default=None)
+    run.add_argument("--min-success", type=int, default=None,
+                     dest="min_success")
     run.add_argument("--requests", default="cpu=1,memory=1Gi")
     run.add_argument("--image", default="busybox")
     for verb in ("list", "view", "suspend", "resume", "delete"):
@@ -140,6 +151,9 @@ def build_parser() -> argparse.ArgumentParser:
     qc = queue.add_parser("create")
     qc.add_argument("--name", required=True)
     qc.add_argument("--weight", type=int, default=1)
+    qc.add_argument("--hierarchy", default="")
+    qc.add_argument("--hierarchy-weights", default="",
+                    dest="hierarchy_weights")
     for verb in ("get", "delete"):
         queue.add_parser(verb).add_argument("--name", required=True)
     queue.add_parser("list")
@@ -173,7 +187,8 @@ def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
         jc = JobCommands(store)
         if args.verb == "run":
             jc.run(args.name, args.namespace, args.queue, args.replicas,
-                   args.min, parse_requests(args.requests), args.image)
+                   args.min, parse_requests(args.requests), args.image,
+                   min_success=args.min_success)
         elif args.verb == "list":
             for j in jc.list(args.namespace):
                 out(_fmt_job(j))
@@ -190,7 +205,9 @@ def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
     if args.group == "queue":
         qc = QueueCommands(store)
         if args.verb == "create":
-            qc.create(args.name, args.weight)
+            qc.create(args.name, args.weight,
+                      hierarchy=args.hierarchy,
+                      hierarchy_weights=args.hierarchy_weights)
         elif args.verb == "get":
             q = qc.get(args.name)
             out(_fmt_queue(q) if q else f"queue {args.name} not found")
