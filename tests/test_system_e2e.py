@@ -136,6 +136,37 @@ class TestJobLifecycle:
         assert job.status.retry_count == 1
         assert job.status.state in (JobPhase.RESTARTING, JobPhase.PENDING)
 
+    def test_exit_code_policy(self):
+        """exitCode lifecycle policies (job.go:162-164,
+        job_controller_util.go:170-200): a policy keyed on a termination
+        code fires its action; other codes fall through."""
+        sys = make_system()
+        job = Job(
+            metadata=ObjectMeta(name="codes"),
+            spec=JobSpec(
+                tasks=[TaskSpec(name="w", replicas=2,
+                                template=PodTemplate(
+                                    resources=Resource(1000, 1 << 30)))],
+                policies=[LifecyclePolicy(action=BusAction.RESTART_JOB,
+                                          exit_code=137)]))
+        sys.store.create(job)
+        sys.schedule_once()
+        sys.schedule_once()
+        pods = sys.store.list("Pod")
+        # exit 1: policy does not match -> plain sync, no restart
+        sys.store.finish_pod(pods[0].metadata.namespace,
+                             pods[0].metadata.name, succeeded=False,
+                             exit_code=1)
+        job = sys.store.get("Job", "default", "codes")
+        assert job.status.retry_count == 0
+        # exit 137 (OOM-kill style): policy fires RestartJob
+        sys.store.finish_pod(pods[1].metadata.namespace,
+                             pods[1].metadata.name, succeeded=False,
+                             exit_code=137)
+        job = sys.store.get("Job", "default", "codes")
+        assert job.status.retry_count == 1
+        assert job.status.state in (JobPhase.RESTARTING, JobPhase.PENDING)
+
     def test_job_plugins_env_svc(self):
         sys = make_system()
         submit_mpi_job(sys, name="mpi", plugins={"env": [], "svc": [],

@@ -130,6 +130,9 @@ class PodStatus:
     node_name: str = ""
     reason: str = ""
     conditions: List[dict] = field(default_factory=list)
+    # main-container termination code — matched by exitCode lifecycle
+    # policies (job.go:162-164)
+    exit_code: Optional[int] = None
 
 
 @dataclass

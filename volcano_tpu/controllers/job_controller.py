@@ -129,18 +129,27 @@ class JobController(Controller):
 
     def _policy_action(self, job: Job, pod: Pod,
                        event: Optional[BusEvent]) -> BusAction:
-        """LifecyclePolicy events→actions (handler.go:137-351): task policies
-        override job policies; default SyncJob."""
+        """LifecyclePolicy events→actions (handler.go:137-351,
+        job_controller_util.go:170-200): task policies override job
+        policies; an exitCode policy matches the pod's termination code,
+        an event policy the bus event; default SyncJob."""
         if event is None:
             return BusAction.SYNC_JOB
+        exit_code = pod.status.exit_code
+
+        def matches(policy) -> bool:
+            if policy.exit_code is not None:
+                return exit_code is not None and exit_code == policy.exit_code
+            return policy.event in (event, BusEvent.ANY)
+
         task_name = pod.metadata.annotations.get(TASK_SPEC_ANNOTATION, "")
         for task in job.spec.tasks:
             if task.name == task_name:
                 for policy in task.policies:
-                    if policy.event in (event, BusEvent.ANY):
+                    if matches(policy):
                         return policy.action
         for policy in job.spec.policies:
-            if policy.event in (event, BusEvent.ANY):
+            if matches(policy):
                 return policy.action
         return BusAction.SYNC_JOB
 

@@ -322,11 +322,13 @@ class NativeObjectStore:
         self.delete("Pod", namespace, name)
 
     def finish_pod(self, namespace: str, name: str,
-                   succeeded: bool = True) -> None:
+                   succeeded: bool = True, exit_code=None) -> None:
         pod = self._read("Pod", f"{namespace}/{name}")
         if pod is None:
             return
         pod.status.phase = "Succeeded" if succeeded else "Failed"
+        pod.status.exit_code = (exit_code if exit_code is not None
+                                else (0 if succeeded else 1))
         self._write("Pod", pod, create_only=False)
         self._drain_events()
 

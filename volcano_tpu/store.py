@@ -201,14 +201,17 @@ class ObjectStore:
                           f"Pod is evicted, because of {reason}")
         self.delete("Pod", namespace, name)
 
-    def finish_pod(self, namespace: str, name: str, succeeded: bool = True) -> None:
-        """Test/e2e helper: complete a running pod."""
+    def finish_pod(self, namespace: str, name: str, succeeded: bool = True,
+                   exit_code: Optional[int] = None) -> None:
+        """Test/e2e helper: complete a running pod (kubelet analogue)."""
         with self._lock:
             pod: Pod = self._objects["Pod"].get(f"{namespace}/{name}")
             if pod is None:
                 return
             old = _shallow_status_copy(pod)
             pod.status.phase = "Succeeded" if succeeded else "Failed"
+            pod.status.exit_code = (exit_code if exit_code is not None
+                                    else (0 if succeeded else 1))
             self._rv += 1
         self._notify("Pod", UPDATED, pod, old)
 
