@@ -1,0 +1,56 @@
+"""Scheduler shell: conf hot-reload (scheduler.go:112-170 / filewatcher)
+and the resync drain wiring."""
+
+import os
+import time
+
+from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
+                             Resource, TaskInfo)
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.scheduler import Scheduler
+
+GI = 1 << 30
+
+
+def build_cache():
+    binder = FakeBinder()
+    cache = SchedulerCache(binder=binder, evictor=FakeEvictor())
+    alloc = Resource(8000, 16 * GI)
+    alloc.max_task_num = 110
+    cache.add_node(NodeInfo(name="n0", allocatable=alloc))
+    pg = PodGroup(name="j", queue="default", min_member=1,
+                  phase=PodGroupPhase.INQUEUE)
+    job = JobInfo(uid="j", name="j", queue="default", min_available=1,
+                  podgroup=pg)
+    job.add_task_info(TaskInfo(uid="j-0", name="j-0", job="j",
+                               resreq=Resource(1000, GI)))
+    cache.add_job(job)
+    return cache, binder
+
+
+def test_conf_hot_reload(tmp_path):
+    conf_path = tmp_path / "scheduler.conf"
+    # first conf: enqueue only — nothing binds
+    conf_path.write_text('actions: "enqueue"\n')
+    cache, binder = build_cache()
+    sched = Scheduler(cache, conf_path=str(conf_path), schedule_period=0.01)
+    sched.run_once()
+    assert binder.binds == {}
+    assert sched.conf.actions == ["enqueue"]
+
+    # rewrite the conf: allocate joins the pipeline; mtime must change
+    time.sleep(0.01)
+    conf_path.write_text('actions: "enqueue, allocate"\n')
+    os.utime(conf_path)
+    sched.run_once()
+    assert sched.conf.actions == ["enqueue", "allocate"]
+    assert binder.binds == {"default/j-0": "n0"}
+
+
+def test_run_once_drains_resync_queue():
+    cache, binder = build_cache()
+    calls = []
+    cache.process_resync_tasks = lambda: calls.append(1) or 0
+    sched = Scheduler(cache, schedule_period=0.01)
+    sched.run_once()
+    assert calls
