@@ -136,6 +136,21 @@ class TestJobLifecycle:
         assert job.status.retry_count == 1
         assert job.status.state in (JobPhase.RESTARTING, JobPhase.PENDING)
 
+    def test_abort_retains_finished_pods(self):
+        """PodRetainPhaseSoft (state/factory.go:39-44): abort keeps
+        Succeeded/Failed pods, drains the running ones."""
+        sys = make_system()
+        submit_mpi_job(sys, name="soft", min_available=1)
+        sys.schedule_once()
+        sys.schedule_once()
+        pods = sys.store.list("Pod")
+        assert len(pods) == 3
+        sys.store.finish_pod(pods[0].metadata.namespace,
+                             pods[0].metadata.name)   # one Succeeded
+        sys.jobs.suspend("soft")                      # AbortJob
+        remaining = sys.store.list("Pod")
+        assert [p.status.phase for p in remaining] == ["Succeeded"]
+
     def test_exit_code_policy(self):
         """exitCode lifecycle policies (job.go:162-164,
         job_controller_util.go:170-200): a policy keyed on a termination

@@ -257,12 +257,17 @@ class JobController(Controller):
         self._sync_podgroup_phase(job)
 
     def kill_job(self, job: Job, phase: JobPhase,
-                 transition: Optional[Callable] = None) -> None:
-        """Delete all pods, then transition (job_controller_actions.go:43-146)."""
+                 transition: Optional[Callable] = None,
+                 retain_phases: tuple = ()) -> None:
+        """Delete the job's pods except those in ``retain_phases``, then
+        transition (job_controller_actions.go:43-146: PodRetainPhaseSoft
+        keeps Succeeded/Failed pods on abort/terminate/complete;
+        PodRetainPhaseNone on restart drains everything)."""
         job_state._update_phase(job, phase)
         for pod in self.store.list("Pod", job.metadata.namespace):
             if pod.metadata.annotations.get(JOB_NAME_ANNOTATION) \
-                    == job.metadata.name:
+                    == job.metadata.name \
+                    and pod.status.phase not in retain_phases:
                 self.store.delete("Pod", job.metadata.namespace,
                                   pod.metadata.name)
         self._update_status(job)

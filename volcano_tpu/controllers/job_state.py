@@ -17,6 +17,11 @@ from ..apis.objects import Job
 sync_job: Callable = None
 kill_job: Callable = None
 
+# state/factory.go:39-44: PodRetainPhaseNone drains everything (restart);
+# PodRetainPhaseSoft keeps finished pods (abort/terminate/complete)
+POD_RETAIN_PHASE_NONE = ()
+POD_RETAIN_PHASE_SOFT = ("Succeeded", "Failed")
+
 
 class State:
     def __init__(self, job: Job):
@@ -41,11 +46,14 @@ class PendingState(State):
             kill_job(job, JobPhase.RESTARTING)
             job.status.retry_count += 1
         elif action == BusAction.ABORT_JOB:
-            kill_job(job, JobPhase.ABORTING)
+            kill_job(job, JobPhase.ABORTING,
+                     retain_phases=POD_RETAIN_PHASE_SOFT)
         elif action == BusAction.COMPLETE_JOB:
-            kill_job(job, JobPhase.COMPLETING)
+            kill_job(job, JobPhase.COMPLETING,
+                     retain_phases=POD_RETAIN_PHASE_SOFT)
         elif action == BusAction.TERMINATE_JOB:
-            kill_job(job, JobPhase.TERMINATING)
+            kill_job(job, JobPhase.TERMINATING,
+                     retain_phases=POD_RETAIN_PHASE_SOFT)
         else:
             sync_job(job, lambda status: JobPhase.RUNNING
                      if status.running + status.succeeded
@@ -60,11 +68,14 @@ class RunningState(State):
             kill_job(job, JobPhase.RESTARTING)
             job.status.retry_count += 1
         elif action == BusAction.ABORT_JOB:
-            kill_job(job, JobPhase.ABORTING)
+            kill_job(job, JobPhase.ABORTING,
+                     retain_phases=POD_RETAIN_PHASE_SOFT)
         elif action == BusAction.TERMINATE_JOB:
-            kill_job(job, JobPhase.TERMINATING)
+            kill_job(job, JobPhase.TERMINATING,
+                     retain_phases=POD_RETAIN_PHASE_SOFT)
         elif action == BusAction.COMPLETE_JOB:
-            kill_job(job, JobPhase.COMPLETING)
+            kill_job(job, JobPhase.COMPLETING,
+                     retain_phases=POD_RETAIN_PHASE_SOFT)
         else:
             total = sum(t.replicas for t in job.spec.tasks)
 
@@ -131,7 +142,8 @@ class AbortingState(State):
             return
         kill_job(job, JobPhase.ABORTING,
                  transition=lambda status: JobPhase.ABORTED
-                 if not status.terminating else JobPhase.ABORTING)
+                 if not status.terminating else JobPhase.ABORTING,
+                 retain_phases=POD_RETAIN_PHASE_SOFT)
 
 
 class AbortedState(State):
@@ -140,21 +152,24 @@ class AbortedState(State):
             _update_phase(self.job, JobPhase.RESTARTING, "job resumed")
             self.job.status.retry_count += 1
             return
-        kill_job(self.job, JobPhase.ABORTED)
+        kill_job(self.job, JobPhase.ABORTED,
+                 retain_phases=POD_RETAIN_PHASE_SOFT)
 
 
 class CompletingState(State):
     def execute(self, action: BusAction) -> None:
         kill_job(self.job, JobPhase.COMPLETING,
                  transition=lambda status: JobPhase.COMPLETED
-                 if not status.terminating else JobPhase.COMPLETING)
+                 if not status.terminating else JobPhase.COMPLETING,
+                 retain_phases=POD_RETAIN_PHASE_SOFT)
 
 
 class TerminatingState(State):
     def execute(self, action: BusAction) -> None:
         kill_job(self.job, JobPhase.TERMINATING,
                  transition=lambda status: JobPhase.TERMINATED
-                 if not status.terminating else JobPhase.TERMINATING)
+                 if not status.terminating else JobPhase.TERMINATING,
+                 retain_phases=POD_RETAIN_PHASE_SOFT)
 
 
 class FinishedState(State):
