@@ -365,6 +365,31 @@ class TestMinSuccess:
         job = sys.store.get("Job", "default", "ms")
         assert job.status.state == JobPhase.COMPLETED
 
+    def test_min_success_drains_stragglers(self):
+        """finished.go:30: a job completed early by minSuccess drains its
+        still-running pods (Soft retain keeps the succeeded ones)."""
+        sys = make_system()
+        job = Job(
+            metadata=ObjectMeta(name="msd"),
+            spec=JobSpec(
+                min_available=1,
+                tasks=[TaskSpec(name="w", replicas=3,
+                                template=PodTemplate(
+                                    resources=Resource(1000, 1 << 30)))]))
+        job.spec.min_success = 1
+        sys.store.create(job)
+        sys.schedule_once()
+        sys.schedule_once()
+        pods = sys.store.list("Pod")
+        assert len(pods) == 3
+        sys.store.finish_pod(pods[0].metadata.namespace,
+                             pods[0].metadata.name)
+        sys._drain_controllers()
+        job = sys.store.get("Job", "default", "msd")
+        assert job.status.state == JobPhase.COMPLETED
+        remaining = sys.store.list("Pod")
+        assert [p.status.phase for p in remaining] == ["Succeeded"]
+
     def test_min_success_floor_fails_job(self):
         """All pods finished with fewer than minSuccess successes ->
         Failed (running.go:84-90)."""

@@ -43,7 +43,8 @@ class PendingState(State):
     def execute(self, action: BusAction) -> None:
         job = self.job
         if action == BusAction.RESTART_JOB:
-            kill_job(job, JobPhase.RESTARTING)
+            kill_job(job, JobPhase.RESTARTING,
+                     retain_phases=POD_RETAIN_PHASE_NONE)
             job.status.retry_count += 1
         elif action == BusAction.ABORT_JOB:
             kill_job(job, JobPhase.ABORTING,
@@ -65,7 +66,8 @@ class RunningState(State):
     def execute(self, action: BusAction) -> None:
         job = self.job
         if action == BusAction.RESTART_JOB:
-            kill_job(job, JobPhase.RESTARTING)
+            kill_job(job, JobPhase.RESTARTING,
+                     retain_phases=POD_RETAIN_PHASE_NONE)
             job.status.retry_count += 1
         elif action == BusAction.ABORT_JOB:
             kill_job(job, JobPhase.ABORTING,
@@ -130,7 +132,8 @@ class RestartingState(State):
                 return JobPhase.RESTARTING
             return JobPhase.PENDING
 
-        kill_job(job, JobPhase.RESTARTING, transition=next_phase)
+        kill_job(job, JobPhase.RESTARTING, transition=next_phase,
+                 retain_phases=POD_RETAIN_PHASE_NONE)
 
 
 class AbortingState(State):
@@ -174,8 +177,11 @@ class TerminatingState(State):
 
 class FinishedState(State):
     def execute(self, action: BusAction) -> None:
-        # nothing to do; GC handles TTL (garbagecollector.go)
-        return
+        # drain any pods still running when the job finished directly (a
+        # minSuccess early completion leaves stragglers) — finished.go:30
+        # kills with the Soft retain set; TTL deletion is the GC's job
+        kill_job(self.job, self.job.status.state,
+                 retain_phases=POD_RETAIN_PHASE_SOFT)
 
 
 _STATES = {
