@@ -252,9 +252,17 @@ class JobController(Controller):
                     self.store.delete("Pod", job.metadata.namespace, name)
 
         self._update_status(job)
+        prev_state = job.status.state
         job_state._update_phase(job, next_phase(job.status))
         self.store.update_status(job)
         self._sync_podgroup_phase(job)
+        # entering a finished phase runs the Finished state once (the
+        # reference requeues the job after the status write): finished.go:30
+        # drains straggler pods with the Soft retain set
+        if job.status.state in (JobPhase.COMPLETED, JobPhase.FAILED,
+                                JobPhase.TERMINATED) \
+                and job.status.state != prev_state:
+            self._execute(job, BusAction.SYNC_JOB)
 
     def kill_job(self, job: Job, phase: JobPhase,
                  transition: Optional[Callable] = None,
