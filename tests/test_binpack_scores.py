@@ -1,0 +1,84 @@
+"""Binpack scoring exactness, ported from the reference's
+pkg/scheduler/plugins/binpack/binpack_test.go (TestArguments + TestNode
+expected score tables)."""
+
+import pytest
+
+from volcano_tpu.api import NodeInfo, Resource, TaskInfo, TaskStatus
+from volcano_tpu.framework.arguments import Arguments
+from volcano_tpu.plugins.binpack import BinpackPlugin
+
+GI = 1 << 30
+
+
+def test_arguments_parsing_and_clamp():
+    """binpack_test.go TestArguments: weights parse; negative resource
+    weights reset to 1 (binpack.go:123-147)."""
+    plugin = BinpackPlugin(Arguments({
+        "binpack.weight": "10",
+        "binpack.cpu": "5",
+        "binpack.memory": "2",
+        "binpack.resources": "nvidia.com/gpu, example.com/foo",
+        "binpack.resources.nvidia.com/gpu": "7",
+        "binpack.resources.example.com/foo": "-3",
+    }))
+    assert plugin.weight == 10
+    assert plugin.res_weights["cpu"] == 5
+    assert plugin.res_weights["memory"] == 2
+    assert plugin.res_weights["nvidia.com/gpu"] == 7
+    assert plugin.res_weights["example.com/foo"] == 1
+
+
+def build_node(name, cpu, mem, scalars=None):
+    node = NodeInfo(name=name,
+                    allocatable=Resource(cpu, mem, scalars))
+    return node
+
+
+def occupy(node, cpu, mem, scalars=None):
+    t = TaskInfo(resreq=Resource(cpu, mem, scalars),
+                 status=TaskStatus.RUNNING)
+    node.add_task(t)
+
+
+def task(cpu, mem, scalars=None):
+    return TaskInfo(resreq=Resource(cpu, mem, scalars))
+
+
+def test_node_score_table():
+    """binpack_test.go TestNode 'single job' case: exact expected scores
+    for every (pod, node) pair, weights 10/2/3, gpu=7 foo=8."""
+    plugin = BinpackPlugin(Arguments({
+        "binpack.weight": "10",
+        "binpack.cpu": "2",
+        "binpack.memory": "3",
+        "binpack.resources": "nvidia.com/gpu, example.com/foo",
+        "binpack.resources.nvidia.com/gpu": "7",
+        "binpack.resources.example.com/foo": "8",
+    }))
+    # nodes (BuildResourceList: cpu cores, memory Gi); p1 bound on n1,
+    # p2 bound on n3
+    n1 = build_node("n1", 2000, 4 * GI)
+    occupy(n1, 1000, 1 * GI)                     # p1
+    n2 = build_node("n2", 4000, 16 * GI, {"nvidia.com/gpu": 4000})
+    n3 = build_node("n3", 2000, 4 * GI, {"example.com/foo": 16000})
+    occupy(n3, 1500, 0)                          # p2
+
+    p1 = task(1000, 1 * GI)
+    p2 = task(1500, 0)
+    p3 = task(2000, 10 * GI, {"nvidia.com/gpu": 2000})
+    p4 = task(3000, 4 * GI, {"example.com/foo": 3000})
+
+    expected = {
+        ("p1", "n1"): 700, ("p1", "n2"): 137.5, ("p1", "n3"): 150,
+        ("p2", "n1"): 0, ("p2", "n2"): 375, ("p2", "n3"): 0,
+        ("p3", "n1"): 0, ("p3", "n2"): 531.25, ("p3", "n3"): 0,
+        ("p4", "n1"): 0, ("p4", "n2"): 173.076923076,
+        ("p4", "n3"): 346.153846153,
+    }
+    tasks = {"p1": p1, "p2": p2, "p3": p3, "p4": p4}
+    nodes = {"n1": n1, "n2": n2, "n3": n3}
+    for (tname, nname), want in expected.items():
+        got = plugin.score(tasks[tname], nodes[nname])
+        assert got == pytest.approx(want, abs=1e-6), \
+            f"{tname} on {nname}: got {got}, want {want}"

@@ -24,17 +24,21 @@ class BinpackPlugin(Plugin):
         super().__init__(arguments)
         args = self.arguments
         self.weight = args.get_int("binpack.weight", 1)
+        # negative per-resource weights reset to 1 (binpack.go:123-147)
         self.res_weights: Dict[str, int] = {
             CPU: args.get_int("binpack.cpu", 1),
             MEMORY: args.get_int("binpack.memory", 1),
         }
+        for rname in (CPU, MEMORY):
+            if self.res_weights[rname] < 0:
+                self.res_weights[rname] = 1
         # binpack.resources: "nvidia.com/gpu, example.com/foo" with
         # binpack.resources.<name> weights (binpack.go:89-155)
         for rname in str(args.get("binpack.resources", "")).split(","):
             rname = rname.strip()
             if rname:
-                self.res_weights[rname] = args.get_int(
-                    f"binpack.resources.{rname}", 1)
+                w = args.get_int(f"binpack.resources.{rname}", 1)
+                self.res_weights[rname] = w if w >= 0 else 1
 
     def score(self, task, node) -> float:
         """BinPackingScore (binpack.go:196-244)."""
