@@ -143,6 +143,49 @@ def test_tcp_server_end_to_end():
         server.shutdown()
 
 
+def test_rpc_at_benchmark_scale():
+    """The 10k-pod / 2k-node snapshot through the wire: encode, ship over
+    TCP, schedule with the real pipeline, decode 10k binds."""
+    import time
+    from volcano_tpu.cache.synthetic import baseline_config
+
+    cache, _, _ = baseline_config("10k", seed=0)
+    snap = cache.snapshot()
+    msg = encode_snapshot(list(snap.nodes.values()),
+                          list(snap.jobs.values()),
+                          list(snap.queues.values()))
+    conf = ('actions: "enqueue, allocate-tpu, backfill"\n'
+            'tiers:\n'
+            '- plugins:\n'
+            '  - name: priority\n'
+            '  - name: gang\n'
+            '- plugins:\n'
+            '  - name: drf\n'
+            '  - name: predicates\n'
+            '  - name: proportion\n'
+            '  - name: nodeorder\n'
+            'configurations:\n'
+            '- name: allocate-tpu\n'
+            '  arguments:\n'
+            '    engine: tpu-blocks\n')
+    # the wire contract: decisions over TCP == the same service in-process
+    expected = SchedulerService(conf).schedule(msg)
+    server, thread, port = serve(conf_text=conf)
+    try:
+        client = SnapshotClient("127.0.0.1", port, timeout=300)
+        t0 = time.perf_counter()
+        out = client.schedule(msg)
+        elapsed = time.perf_counter() - t0
+        got = {(b["uid"], b["node"]) for b in out["binds"]}
+        want = {(b["uid"], b["node"]) for b in expected["binds"]}
+        assert got == want
+        assert len(got) == 10_000
+        assert elapsed < 120, f"rpc cycle too slow: {elapsed:.1f}s"
+        client.close()
+    finally:
+        server.shutdown()
+
+
 def test_server_reports_errors():
     server, thread, port = serve()
     try:
