@@ -47,9 +47,11 @@ def run_cycle(config: str, engine: str, seed: int = 0):
     return elapsed, admitted, len(binder.binds)
 
 
-def run_preempt(config: str, engine: str, seed: int = 0):
-    """One preempt cycle; returns (seconds, evicted set, pipelined count)."""
-    from volcano_tpu.actions import PreemptAction
+def run_evict(config: str, engine: str, action_name: str = "preempt",
+              seed: int = 0):
+    """One preempt/reclaim cycle; returns (seconds, evicted set,
+    pipelined count)."""
+    from volcano_tpu.actions import PreemptAction, ReclaimAction
     from volcano_tpu.api import TaskStatus
     from volcano_tpu.cache.synthetic import baseline_config
     from volcano_tpu.framework import close_session, open_session, \
@@ -59,7 +61,8 @@ def run_preempt(config: str, engine: str, seed: int = 0):
     conf = parse_scheduler_conf(None)
     cache, _, evictor = baseline_config(config, seed=seed)
     ssn = open_session(cache, conf.tiers, [])
-    action = PreemptAction(engine=engine)
+    cls = PreemptAction if action_name == "preempt" else ReclaimAction
+    action = cls(engine=engine)
     start = time.perf_counter()
     action.execute(ssn)
     elapsed = time.perf_counter() - start
@@ -67,6 +70,10 @@ def run_preempt(config: str, engine: str, seed: int = 0):
                 if t.status == TaskStatus.PIPELINED)
     close_session(ssn)
     return elapsed, frozenset(evictor.evicts), npipe
+
+
+def run_preempt(config: str, engine: str, seed: int = 0):
+    return run_evict(config, engine, "preempt", seed)
 
 
 def main():
@@ -134,6 +141,19 @@ def main():
                   preempt_tpu_small_ms=round(p_tpu_small_s * 1e3, 1),
                   preempt_tpu_ms=round(p_tpu_s * 1e3, 1),
                   preempt_pipelined=p_pipelined)
+
+    # reclaim at the same mix (cross-queue, q1 vs q2)
+    r_cpu_s, r_cpu_evicts, _ = run_evict("preempt-small", "callbacks",
+                                         "reclaim")
+    run_evict("preempt-small", "tpu", "reclaim")
+    r_tpu_s, r_tpu_evicts, _ = run_evict("preempt-small", "tpu", "reclaim")
+    run_evict("preempt", "tpu", "reclaim")      # warm full-scale shapes
+    r_full_s, r_full_evicts, _ = run_evict("preempt", "tpu", "reclaim")
+    extras.update(reclaim_parity=r_cpu_evicts == r_tpu_evicts,
+                  reclaim_cpu_small_ms=round(r_cpu_s * 1e3, 1),
+                  reclaim_tpu_small_ms=round(r_tpu_s * 1e3, 1),
+                  reclaim_tpu_ms=round(r_full_s * 1e3, 1),
+                  reclaim_evicts=len(r_full_evicts))
 
     # config 5: 2k nodes x 8 GPUs topology binpack
     run_cycle("gpu", "tpu-fused")                 # warm
