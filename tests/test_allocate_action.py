@@ -256,3 +256,24 @@ class TestStatefulPredicateRecheck:
         assert "default/g-2" not in binder.binds
         used = [d.used_memory() for d in node.gpu_devices.values()]
         assert sorted(used) == [3000, 3000]
+
+
+class TestFitErrorDiagnostics:
+    """Resource-fit failures must record the fit reason, not a stray
+    exception string (regression: allocate.py previously raised NameError
+    constructing FitError, garbling every unschedulable diagnostic)."""
+
+    def test_resource_fit_reason_recorded(self):
+        from volcano_tpu.api.types import NODE_RESOURCE_FIT_FAILED
+        # 1-task gang asking for more CPU than any node has -> no feasible
+        # node -> nodes_fit_errors populated with the real fit reason.
+        job = build_job("big", "default", 1, [(50000, 50000)])
+        nodes = [build_node("n1", 2000, 2000), build_node("n2", 1000, 1000)]
+        cache, binder = build_cache([job], nodes)
+        ssn = run_allocate(cache, "callbacks")
+        assert not binder.binds
+        errs = ssn.jobs["big"].nodes_fit_errors.get("big-0")
+        assert errs is not None
+        msg = errs.error()
+        assert NODE_RESOURCE_FIT_FAILED in msg, msg
+        assert "not defined" not in msg

@@ -49,7 +49,84 @@ def test_leader_loses_expired_lease_to_challenger():
     b = LeaderElector(store, "x", on_started_leading=lambda: None,
                       identity="b", lease_duration=0.1)
     assert b.try_acquire_or_renew()          # takeover after expiry
-    assert not a.try_acquire_or_renew(time.monotonic())  # a lost it
+    assert not a.try_acquire_or_renew(time.time())  # a lost it
+
+
+def test_racing_challengers_cannot_both_win():
+    """Two challengers racing on an expired lease: both read the same
+    stale resourceVersion; only the first CAS write wins, the loser's
+    update conflicts and it must NOT start leading (split-brain guard)."""
+    store = ObjectStore()
+    dead = LeaderElector(store, "x", on_started_leading=lambda: None,
+                         identity="dead", lease_duration=0.01)
+    assert dead.try_acquire_or_renew()
+    time.sleep(0.05)                          # lease expires
+
+    a = LeaderElector(store, "x", on_started_leading=lambda: None,
+                      identity="a", lease_duration=10)
+    b = LeaderElector(store, "x", on_started_leading=lambda: None,
+                      identity="b", lease_duration=10)
+    # interleave the read-check-update: b reads the expired lease FIRST,
+    # then a completes its takeover, then b attempts its own takeover
+    # against the now-stale rv.
+    stale = store.get("Lease", "volcano-system", "x")
+    assert a.try_acquire_or_renew()
+    real_lease = b._lease
+    b._lease = lambda: stale
+    try:
+        assert not b.try_acquire_or_renew()   # CAS must reject
+    finally:
+        b._lease = real_lease
+    assert store.get("Lease", "volcano-system", "x").holder == "a"
+
+
+def test_racing_creates_cannot_both_win():
+    """Both see no lease; the second create loses and must return False."""
+    store = ObjectStore()
+    a = LeaderElector(store, "y", on_started_leading=lambda: None,
+                      identity="a")
+    b = LeaderElector(store, "y", on_started_leading=lambda: None,
+                      identity="b")
+    real_lease = b._lease
+    b._lease = lambda: None                   # b's read happened pre-create
+    try:
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+    finally:
+        b._lease = real_lease
+    assert store.get("Lease", "volcano-system", "y").holder == "a"
+
+
+def test_store_cas_conflict_python_and_native():
+    """update(expect_rv=...) rejects stale writes on both store backends."""
+    import pytest
+    from volcano_tpu import native as native_mod
+    from volcano_tpu.store import ConflictError
+    stores = [ObjectStore()]
+    if native_mod.available():
+        stores.append(native_mod.NativeObjectStore())
+    for store in stores:
+        from volcano_tpu.leaderelection import Lease
+        from volcano_tpu.apis.objects import ObjectMeta
+        lease = Lease(metadata=ObjectMeta(name="l", namespace="ns"),
+                      holder="h1", renew_time=1.0)
+        store.create(lease)
+        rv = store.get("Lease", "ns", "l").metadata.resource_version
+        ok = Lease(metadata=ObjectMeta(name="l", namespace="ns"),
+                   holder="h2", renew_time=2.0)
+        store.update(ok, expect_rv=rv)        # fresh rv: accepted
+        stale = Lease(metadata=ObjectMeta(name="l", namespace="ns"),
+                      holder="h3", renew_time=3.0)
+        with pytest.raises(ConflictError):
+            store.update(stale, expect_rv=rv)  # rv moved: rejected
+        assert store.get("Lease", "ns", "l").holder == "h2"
+        # expect_rv=0 is create-only on both backends: conflict (exists)
+        with pytest.raises(ConflictError):
+            store.update(stale, expect_rv=0)
+        fresh = Lease(metadata=ObjectMeta(name="l2", namespace="ns"),
+                      holder="h9", renew_time=9.0)
+        store.update(fresh, expect_rv=0)       # absent: created
+        assert store.get("Lease", "ns", "l2").holder == "h9"
 
 
 def test_scheduler_runs_under_election():

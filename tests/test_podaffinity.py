@@ -235,3 +235,26 @@ def test_index_domains_and_counts():
     idx2 = PodAffinityIndex(nodes)
     dom2, nd2 = idx2.domains("topology.kubernetes.io/zone")
     assert nd2 == 3 and dom2[3] not in (dom2[0], dom2[2])
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_partial_bootstrap_denied(engine):
+    """Two required affinity terms, one satisfiable (app=web exists on n1)
+    and one with zero cluster matches that the pod self-matches: upstream
+    InterPodAffinity only allows the bootstrap when NO term has an existing
+    match, so this pod must stay Pending — a per-term waiver would
+    wrongly schedule it."""
+    nodes = [build_node(f"n{i}") for i in range(3)]
+    aff = {"podAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [
+            required({"matchLabels": {"app": "web"}}),
+            required({"matchLabels": {"tier": "db"}}),
+        ]}}
+    cache, binder = build_world(
+        nodes,
+        running=[("web", "n1", {"app": "web"}, None)],
+        # pod matches its own second term (tier=db) but NOT the first
+        pending=[("p", {"tier": "db"}, aff)])
+    ssn = open_session(cache, TIERS, [])
+    AllocateAction(engine=engine).execute(ssn)
+    assert binder.binds == {}

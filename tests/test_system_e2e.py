@@ -578,3 +578,94 @@ class TestQueueCLI:
         assert any("default" in line for line in lines)
         main(["job", "list"], store=sys.store, out=lines.append)
         assert any("mpi-job" in line for line in lines)
+
+
+class TestAdviceRegressions:
+    """Regression tests for reference-semantics deviations found in review."""
+
+    def test_task_without_status_entry_does_not_fail_job(self):
+        """running.go's `if taskStatus, ok := ...; ok` guard: the per-task
+        minAvailable success check only applies to tasks that HAVE a
+        TaskStatusCount entry; a task absent from the map (e.g. its pods
+        drained during a scale-down) must not flip the verdict to Failed."""
+        from volcano_tpu.controllers import job_state
+
+        job = Job(
+            metadata=ObjectMeta(name="shrink"),
+            spec=JobSpec(
+                min_available=2,
+                tasks=[
+                    TaskSpec(name="w", replicas=2, min_available=1,
+                             template=PodTemplate(
+                                 resources=Resource(1000, 1 << 30))),
+                    TaskSpec(name="opt", replicas=0, min_available=0,
+                             template=PodTemplate(
+                                 resources=Resource(1000, 1 << 30))),
+                ]))
+        job.status.state = JobPhase.RUNNING
+        job.status.succeeded = 2
+        job.status.failed = 0
+        # only "w" reported status; "opt" has no entry at all — and give it
+        # a real minimum to prove absence (not min_available=0) is the guard
+        job.spec.tasks[1].min_available = 1
+        job.spec.min_available = 2
+        job.status.task_status_count = {"w": {"Succeeded": 2}}
+
+        phases = []
+        orig = job_state.sync_job
+
+        def capture(j, next_phase):
+            phases.append(next_phase(j.status))
+        job_state.sync_job = capture
+        try:
+            job_state.RunningState(job).execute(BusAction.SYNC_JOB)
+        finally:
+            job_state.sync_job = orig
+        assert phases == [JobPhase.COMPLETED], phases
+
+    def test_policy_event_and_exit_code_clauses_are_independent(self):
+        """applyPolicies (job_controller_util.go:168-200) + admission
+        (validate/util.go:60-66): a policy carries EITHER an event clause
+        OR an exitCode clause, never both — and each clause triggers
+        independently of the other field."""
+        sys = make_system()
+        # both-specified is rejected at admission, like the reference
+        with pytest.raises(AdmissionError,
+                           match="event and exitCode simultaneously"):
+            sys.store.create(Job(
+                metadata=ObjectMeta(name="both"),
+                spec=JobSpec(tasks=[TaskSpec(
+                    name="w", replicas=1,
+                    template=PodTemplate(resources=Resource(1000, 1)))],
+                    policies=[LifecyclePolicy(event=BusEvent.POD_FAILED,
+                                              action=BusAction.RESTART_JOB,
+                                              exit_code=137)])))
+        # an empty policy is rejected too
+        with pytest.raises(AdmissionError,
+                           match="either event and exitCode"):
+            sys.store.create(Job(
+                metadata=ObjectMeta(name="neither"),
+                spec=JobSpec(tasks=[TaskSpec(
+                    name="w", replicas=1,
+                    template=PodTemplate(resources=Resource(1000, 1)))],
+                    policies=[LifecyclePolicy(
+                        action=BusAction.RESTART_JOB)])))
+        # an event clause fires regardless of the pod's exit code
+        job = Job(
+            metadata=ObjectMeta(name="ev"),
+            spec=JobSpec(
+                tasks=[TaskSpec(name="w", replicas=2,
+                                template=PodTemplate(
+                                    resources=Resource(1000, 1 << 30)))],
+                policies=[LifecyclePolicy(event=BusEvent.POD_FAILED,
+                                          action=BusAction.RESTART_JOB)]))
+        sys.store.create(job)
+        sys.schedule_once()
+        sys.schedule_once()
+        pods = sys.store.list("Pod")
+        sys.store.finish_pod(pods[0].metadata.namespace,
+                             pods[0].metadata.name, succeeded=False,
+                             exit_code=42)
+        job = sys.store.get("Job", "default", "ev")
+        assert job.status.retry_count == 1
+        assert job.status.state in (JobPhase.RESTARTING, JobPhase.PENDING)

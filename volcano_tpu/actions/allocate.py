@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..api import (FitErrors, NodeInfo, PodGroupPhase, Resource, TaskInfo,
+from ..api import (FitError, FitErrors, NodeInfo, PodGroupPhase, Resource, TaskInfo,
                    TaskStatus)
 from ..cache.snapshot import (NodeTensors, assemble_feasibility,
                               assemble_static_score, assemble_weights,

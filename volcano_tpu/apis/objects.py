@@ -61,7 +61,9 @@ class PodTemplate:
 class LifecyclePolicy:
     """Job events→actions policy (batch/v1alpha1 LifecyclePolicy)."""
 
-    event: BusEvent = BusEvent.ANY
+    # None = no event clause (an exitCode-only policy); admission rejects
+    # specifying both, matching validate/util.go:60-66
+    event: Optional[BusEvent] = None
     action: BusAction = BusAction.SYNC_JOB
     exit_code: Optional[int] = None
     timeout: Optional[float] = None

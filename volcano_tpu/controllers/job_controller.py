@@ -138,9 +138,15 @@ class JobController(Controller):
         exit_code = pod.status.exit_code
 
         def matches(policy) -> bool:
-            if policy.exit_code is not None:
-                return exit_code is not None and exit_code == policy.exit_code
-            return policy.event in (event, BusEvent.ANY)
+            # two INDEPENDENT checks (applyPolicies
+            # job_controller_util.go:168-200): the event clause when the
+            # policy has one, the exit-code clause when it has one —
+            # admission guarantees a policy carries exactly one of them
+            if policy.event is not None \
+                    and policy.event in (event, BusEvent.ANY):
+                return True
+            return (policy.exit_code is not None and exit_code is not None
+                    and exit_code == policy.exit_code)
 
         task_name = pod.metadata.annotations.get(TASK_SPEC_ANNOTATION, "")
         for task in job.spec.tasks:

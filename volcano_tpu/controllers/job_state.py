@@ -99,9 +99,15 @@ class RunningState(State):
                         for task in job.spec.tasks:
                             if task.min_available is None:
                                 continue
-                            succ = status.task_status_count.get(
-                                task.name, {}).get("Succeeded", 0)
-                            if succ < task.min_available:
+                            # running.go's `if taskStatus, ok := ...; ok`
+                            # guard: the per-task success minimum only
+                            # applies when the task has a status entry at
+                            # all (e.g. a replicas=0 task never does)
+                            counts = status.task_status_count.get(task.name)
+                            if counts is None:
+                                continue
+                            if counts.get("Succeeded", 0) \
+                                    < task.min_available:
                                 return JobPhase.FAILED
                     if min_success is not None \
                             and status.succeeded < min_success:

@@ -70,32 +70,56 @@ class LeaderElector:
         return self.store.get("Lease", self.namespace, self.name)
 
     def try_acquire_or_renew(self, now: Optional[float] = None) -> bool:
-        now = time.monotonic() if now is None else now
+        """One CAS-guarded acquire/renew attempt, mirroring k8s
+        resourcelock semantics: every write carries the resourceVersion it
+        read, so two challengers racing on an expired lease cannot both
+        win — the loser's update conflicts and it returns False.
+
+        Timestamps are wall-clock (``time.time()``): leases are compared
+        across processes (native store / RPC shim replicas), where a
+        per-process monotonic clock is meaningless."""
+        now = time.time() if now is None else now
+        from .store import ConflictError
         lease = self._lease()
         if lease is None:
-            lease = Lease(metadata=ObjectMeta(name=self.name,
+            fresh = Lease(metadata=ObjectMeta(name=self.name,
                                               namespace=self.namespace),
                           holder=self.identity, renew_time=now,
                           lease_duration=self.lease_duration)
-            self.store.create(lease)
+            try:
+                self.store.create(fresh)
+            except ValueError:
+                return False          # lost the create race; retry later
             return True
-        if lease.holder == self.identity:
-            lease.renew_time = now
-            self.store.update(lease)
-            return True
-        if now - lease.renew_time > lease.lease_duration:
-            # expired: take it over
-            lease.holder = self.identity
-            lease.renew_time = now
-            self.store.update(lease)
-            return True
-        return False
+        if lease.holder != self.identity \
+                and now - lease.renew_time <= lease.lease_duration:
+            return False              # live lease held by someone else
+        # renew (ours) or takeover (expired): CAS on the rv we just read
+        claimed = Lease(
+            metadata=ObjectMeta(name=self.name, namespace=self.namespace),
+            holder=self.identity, renew_time=now,
+            lease_duration=self.lease_duration)
+        try:
+            self.store.update(
+                claimed, expect_rv=lease.metadata.resource_version)
+        except ConflictError:
+            return False              # another challenger won this round
+        return True
 
     def release(self) -> None:
+        from .store import ConflictError
         lease = self._lease()
         if lease is not None and lease.holder == self.identity:
-            lease.renew_time = 0.0
-            self.store.update(lease)
+            released = Lease(
+                metadata=ObjectMeta(name=self.name,
+                                    namespace=self.namespace),
+                holder=self.identity, renew_time=0.0,
+                lease_duration=self.lease_duration)
+            try:
+                self.store.update(
+                    released, expect_rv=lease.metadata.resource_version)
+            except ConflictError:
+                pass                  # someone already took it over
         self.leading = False
 
     # -- the election loop --------------------------------------------------

@@ -55,6 +55,10 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.vs_put.restype = ctypes.c_int64
     lib.vs_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
                            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32]
+    lib.vs_put_cas.restype = ctypes.c_int64
+    lib.vs_put_cas.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_char_p, ctypes.c_char_p,
+                               ctypes.c_int64, ctypes.c_int64]
     lib.vs_get.restype = ctypes.c_int64
     lib.vs_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
                            ctypes.c_char_p, ctypes.c_int64]
@@ -249,11 +253,23 @@ class NativeObjectStore:
         self._drain_events()
         return obj
 
-    def update(self, obj):
+    def update(self, obj, expect_rv=None):
         kind = obj.KIND
         old = self._read(kind, obj.metadata.key())
         obj = self._admit("UPDATE", kind, obj, old)
-        self._write(kind, obj, create_only=False)
+        if expect_rv is None:
+            self._write(kind, obj, create_only=False)
+        else:
+            key = obj.metadata.key()
+            data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+            rv = self._lib.vs_put_cas(self._h, kind.encode(), key.encode(),
+                                      data, len(data), int(expect_rv))
+            if rv == -2:
+                from ..store import ConflictError
+                raise ConflictError(
+                    f"{kind} {key}: resourceVersion conflict "
+                    f"(expected {expect_rv})")
+            obj.metadata.resource_version = rv
         self._drain_events()
         return obj
 

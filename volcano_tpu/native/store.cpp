@@ -94,14 +94,21 @@ int64_t vs_rv(void* h) {
     return s->rv;
 }
 
-// create_only=1: fail (-1) if the key exists. Returns the new rv.
-int64_t vs_put(void* h, const char* kind, const char* key,
-               const char* data, int64_t len, int32_t create_only) {
+// Compare-and-swap put (the optimistic-concurrency write k8s clients use:
+// update fails unless metadata.resourceVersion matches the read).
+//   expected_rv < 0 : unconditional update/create (same as vs_put)
+//   expected_rv == 0: create-only — conflict if the key exists
+//   expected_rv > 0 : key must exist with exactly this rv
+// Returns the new rv, or -2 on conflict.
+int64_t vs_put_cas(void* h, const char* kind, const char* key,
+                   const char* data, int64_t len, int64_t expected_rv) {
     Store* s = static_cast<Store*>(h);
     std::lock_guard<std::mutex> g(s->mu);
     auto& m = s->kinds[kind];
     auto it = m.find(key);
-    if (create_only && it != m.end()) return -1;
+    if (expected_rv == 0 && it != m.end()) return -2;
+    if (expected_rv > 0 &&
+        (it == m.end() || it->second.rv != expected_rv)) return -2;
     Event ev;
     ev.type = (it == m.end()) ? EV_ADDED : EV_UPDATED;
     if (it != m.end()) ev.old_data = it->second.data;
@@ -116,6 +123,13 @@ int64_t vs_put(void* h, const char* kind, const char* key,
     m[key] = std::move(e);
     s->push_event(std::move(ev));
     return s->rv;
+}
+
+// create_only=1: fail (-1) if the key exists. Returns the new rv.
+int64_t vs_put(void* h, const char* kind, const char* key,
+               const char* data, int64_t len, int32_t create_only) {
+    int64_t rv = vs_put_cas(h, kind, key, data, len, create_only ? 0 : -1);
+    return rv == -2 ? -1 : rv;
 }
 
 // Two-phase read: returns needed length, copies min(buflen, len) bytes.

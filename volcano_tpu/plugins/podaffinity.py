@@ -172,18 +172,24 @@ class PodAffinityIndex:
     def node_mask(self, task) -> Optional[np.ndarray]:
         """bool[N] required-term feasibility for one task; None = all-true."""
         masks = []
-        for term in _affinity_terms(task, "podAffinity", True):
-            namespaces = _term_namespaces(term, task.namespace)
-            cnt = self._term_domain_counts(term, namespaces)
-            if not cnt.any():
-                # k8s bootstrap allowance: with NO existing match anywhere, a
-                # pod matching its own affinity term may start the group on
-                # any node (upstream InterPodAffinity Filter special case)
-                if (task.namespace in namespaces
-                        and match_label_selector(
-                            term.get("labelSelector") or {}, task.labels)):
-                    continue
-            masks.append(cnt > 0)
+        aff = [(term, _term_namespaces(term, task.namespace))
+               for term in _affinity_terms(task, "podAffinity", True)]
+        counts = [self._term_domain_counts(term, ns) for term, ns in aff]
+        # k8s bootstrap allowance (upstream InterPodAffinity Filter): only
+        # when NO existing pod matches ANY required affinity term AND the
+        # pod matches all of its own terms may it start the group anywhere;
+        # a partial bootstrap (per-term waiver) would schedule pods
+        # upstream leaves Pending.
+        bootstrap = (
+            bool(aff)
+            and all(not cnt.any() for cnt in counts)
+            and all(task.namespace in ns
+                    and match_label_selector(
+                        term.get("labelSelector") or {}, task.labels)
+                    for term, ns in aff))
+        if not bootstrap:
+            for cnt in counts:
+                masks.append(cnt > 0)
         for term in _affinity_terms(task, "podAntiAffinity", True):
             cnt = self._term_domain_counts(
                 term, _term_namespaces(term, task.namespace),

@@ -9,6 +9,7 @@ from the in-process scheduler.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional
 
 from ..cache import SchedulerCache
@@ -47,8 +48,17 @@ class SchedulerService:
         from .. import actions as _actions  # noqa: F401
         from .. import plugins as _plugins  # noqa: F401
         self.conf = parse_scheduler_conf(conf_text)
+        # one snapshot in flight at a time: the transport serves concurrent
+        # connections, but a cycle touches process-global state (engine
+        # stat counters, jit/solver caches), so concurrent cycles would
+        # interleave those in surprising ways
+        self._cycle_lock = threading.Lock()
 
     def schedule(self, snapshot_msg: dict) -> dict:
+        with self._cycle_lock:
+            return self._schedule_locked(snapshot_msg)
+
+    def _schedule_locked(self, snapshot_msg: dict) -> dict:
         nodes, jobs, queues = decode_snapshot(snapshot_msg)
         binder = RecordingBinder()
         evictor = RecordingEvictor()

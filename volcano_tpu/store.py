@@ -27,6 +27,11 @@ UPDATED = "updated"
 DELETED = "deleted"
 
 
+class ConflictError(Exception):
+    """Optimistic-concurrency failure: stored resourceVersion moved past
+    the one the writer read (HTTP 409 analogue)."""
+
+
 class AdmissionError(Exception):
     """Raised by admission hooks to reject a create/update."""
 
@@ -101,13 +106,31 @@ class ObjectStore:
         self._notify(kind, ADDED, obj)
         return obj
 
-    def update(self, obj) -> object:
+    def update(self, obj, expect_rv=None) -> object:
+        """Update; with ``expect_rv`` set, an optimistic-concurrency write
+        that fails with :class:`ConflictError` unless the stored object's
+        resourceVersion still matches (the k8s resourcelock/Update CAS
+        semantics clients rely on for leader election).
+
+        Contract (identical to the native ``vs_put_cas``): ``None`` or a
+        negative value = unconditional; ``0`` = create-only (conflict if
+        the object exists); ``> 0`` = the object must exist with exactly
+        this resourceVersion."""
         kind = obj.KIND
         with self._lock:
             key = obj.metadata.key()
             old = self._objects[kind].get(key)
         obj = self._admit("UPDATE", kind, obj, old)
         with self._lock:
+            cur = self._objects[kind].get(key)
+            if expect_rv is not None and expect_rv >= 0:
+                cur_rv = (cur.metadata.resource_version
+                          if cur is not None else 0)
+                if cur_rv != expect_rv:
+                    raise ConflictError(
+                        f"{kind} {key}: resourceVersion {cur_rv} != "
+                        f"expected {expect_rv}")
+            old = cur
             self._rv += 1
             obj.metadata.resource_version = self._rv
             self._objects[kind][key] = obj

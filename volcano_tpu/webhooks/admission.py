@@ -87,14 +87,28 @@ def make_validate_job(store: ObjectStore):
 
 def _validate_policies(policies) -> None:
     events = set()
+    exit_codes = set()
     for policy in policies:
-        if policy.event in events:
-            deny(f"duplicate policy event {policy.event}")
-        events.add(policy.event)
         if policy.action not in _VALID_JOB_ACTIONS:
             deny(f"invalid policy action {policy.action}")
-        if policy.event not in _VALID_EVENTS:
-            deny(f"invalid policy event {policy.event}")
+        # event and exitCode clauses are mutually exclusive, and a policy
+        # must carry one of them (validate/util.go:60-66)
+        if policy.event is not None and policy.exit_code is not None:
+            deny("must not specify event and exitCode simultaneously")
+        if policy.event is None and policy.exit_code is None:
+            deny("either event and exitCode should be specified")
+        if policy.event is not None:
+            if policy.event in events:
+                deny(f"duplicate policy event {policy.event}")
+            events.add(policy.event)
+            if policy.event not in _VALID_EVENTS:
+                deny(f"invalid policy event {policy.event}")
+        if policy.exit_code is not None:
+            if policy.exit_code == 0:
+                deny("0 is not a valid error code")
+            if policy.exit_code in exit_codes:
+                deny(f"duplicate exitCode {policy.exit_code}")
+            exit_codes.add(policy.exit_code)
 
 
 def mutate_queue(operation: str, queue: QueueCR, old) -> QueueCR:
