@@ -277,3 +277,89 @@ class TestFitErrorDiagnostics:
         msg = errs.error()
         assert NODE_RESOURCE_FIT_FAILED in msg, msg
         assert "not defined" not in msg
+
+
+class TestNodePorts:
+    """NodePorts predicate (reference predicates.go:256-258,321): a pod
+    claiming a hostPort cannot land on a node where that (hostIP, protocol,
+    port) is already claimed; in-cycle placements claim ports too."""
+
+    def _port_job(self, name, port, protocol="TCP", host_ip="0.0.0.0",
+                  priority=0):
+        job = build_job(name, "default", 1, [(100, 100)], priority=priority)
+        for t in job.tasks.values():
+            t.host_ports = [(host_ip, protocol, port)]
+        return job
+
+    def _running_port_holder(self, node, port, protocol="TCP"):
+        pg = PodGroup(name="holder", queue="default", min_member=1,
+                      phase=PodGroupPhase.RUNNING)
+        job = JobInfo(uid="holder", name="holder", queue="default",
+                      min_available=1, podgroup=pg)
+        t = TaskInfo(uid="holder-0", name="holder-0", job="holder",
+                     resreq=Resource(100, 100), status=TaskStatus.RUNNING,
+                     host_ports=[("0.0.0.0", protocol, port)])
+        job.add_task_info(t)
+        node.add_task(t)
+        return job
+
+    @pytest.mark.parametrize("engine", ENGINES)
+    def test_existing_claim_excludes_node(self, engine):
+        n1 = build_node("n1", 8000, 8000)
+        n2 = build_node("n2", 1000, 1000)
+        holder = self._running_port_holder(n1, 8080)
+        job = self._port_job("web", 8080)
+        cache, binder = build_cache([holder, job], [n1, n2])
+        run_allocate(cache, engine)
+        # n1 is bigger (binpack/leastalloc would prefer it) but holds 8080
+        assert binder.binds == {"default/web-0": "n2"}
+
+    @pytest.mark.parametrize("engine", ENGINES)
+    def test_conflict_no_node_free(self, engine):
+        n1 = build_node("n1", 8000, 8000)
+        holder = self._running_port_holder(n1, 8080)
+        job = self._port_job("web", 8080)
+        cache, binder = build_cache([holder, job], [n1])
+        run_allocate(cache, engine)
+        assert "default/web-0" not in binder.binds
+
+    @pytest.mark.parametrize("engine", ENGINES)
+    def test_different_protocol_no_conflict(self, engine):
+        n1 = build_node("n1", 8000, 8000)
+        holder = self._running_port_holder(n1, 8080, protocol="UDP")
+        job = self._port_job("web", 8080, protocol="TCP")
+        cache, binder = build_cache([holder, job], [n1])
+        run_allocate(cache, engine)
+        assert binder.binds == {"default/web-0": "n1"}
+
+    @pytest.mark.parametrize("engine", ENGINES)
+    def test_in_cycle_claims_spread(self, engine):
+        """Two pending pods wanting the same hostPort must land on two
+        different nodes (the second placement sees the first one's claim)."""
+        jobs = [self._port_job("a", 9000, priority=5),
+                self._port_job("b", 9000)]
+        nodes = [build_node("n1", 4000, 4000), build_node("n2", 4000, 4000)]
+        cache, binder = build_cache(jobs, nodes)
+        run_allocate(cache, engine)
+        assert len(binder.binds) == 2
+        assert binder.binds["default/a-0"] != binder.binds["default/b-0"]
+
+    @pytest.mark.parametrize("engine", ENGINES)
+    def test_specific_host_ip_vs_wildcard(self, engine):
+        """A 0.0.0.0 claim conflicts with any hostIP on the same port."""
+        n1 = build_node("n1", 8000, 8000)
+        holder = self._running_port_holder(n1, 7070)   # wildcard IP
+        job = self._port_job("web", 7070, host_ip="10.0.0.7")
+        cache, binder = build_cache([holder, job], [n1])
+        run_allocate(cache, engine)
+        assert "default/web-0" not in binder.binds
+
+    def test_fit_reason_recorded(self):
+        from volcano_tpu.api.types import NODE_PORTS_FAILED
+        n1 = build_node("n1", 8000, 8000)
+        holder = self._running_port_holder(n1, 8080)
+        job = self._port_job("web", 8080)
+        cache, binder = build_cache([holder, job], [n1])
+        ssn = run_allocate(cache, "callbacks")
+        errs = ssn.jobs["web"].nodes_fit_errors.get("web-0")
+        assert errs is not None and NODE_PORTS_FAILED in errs.error()

@@ -125,8 +125,15 @@ def test_store_cas_conflict_python_and_native():
             store.update(stale, expect_rv=0)
         fresh = Lease(metadata=ObjectMeta(name="l2", namespace="ns"),
                       holder="h9", renew_time=9.0)
+        events = []
+        store.watch("Lease", lambda ev, obj, old=None: events.append(ev))
+        del events[:]                          # drop the ADDED replay
         store.update(fresh, expect_rv=0)       # absent: created
         assert store.get("Lease", "ns", "l2").holder == "h9"
+        # creation through the CAS path is an ADD to watchers on both
+        # backends (native vs_put_cas emits EV_ADDED on absent keys)
+        from volcano_tpu.store import ADDED
+        assert events and events[-1] == ADDED
 
 
 def test_scheduler_runs_under_election():

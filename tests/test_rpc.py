@@ -114,6 +114,25 @@ def test_codec_preserves_external_usage_and_timestamps():
     assert t.preemptable and t.revocable_zone == "rz1"
 
 
+def test_codec_host_ports_survive_the_wire():
+    """hostPort claims round-trip: the decoded snapshot rebuilds
+    NodeInfo.used_ports for placed tasks, so the NodePorts predicate works
+    behind the sidecar too (regression: codec silently dropped them)."""
+    import json
+    nodes, jobs, queues = build_world()
+    filler = next(j for j in jobs if j.uid == "filler")
+    filler.tasks["filler-0"].host_ports = [("0.0.0.0", "TCP", 8080)]
+    pending = next(j for j in jobs if j.uid == "job0")
+    pending.tasks["job0-0"].host_ports = [("0.0.0.0", "TCP", 8080)]
+    msg = json.loads(json.dumps(encode_snapshot(nodes, jobs, queues)))
+    nodes2, jobs2, _ = decode_snapshot(msg)
+    n0 = next(n for n in nodes2 if n.name == "n0")
+    assert n0.used_ports == {("0.0.0.0", "TCP", 8080): 1}
+    job0 = next(j for j in jobs2 if j.uid == "job0")
+    assert job0.tasks["job0-0"].host_ports == [("0.0.0.0", "TCP", 8080)]
+    assert n0.has_port_conflict(job0.tasks["job0-0"])
+
+
 def test_service_matches_inprocess():
     nodes, jobs, queues = build_world()
     expected = inprocess_binds(*build_world())

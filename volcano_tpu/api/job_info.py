@@ -25,6 +25,28 @@ def _new_uid(prefix: str) -> str:
     return f"{prefix}-{next(_uid_counter)}"
 
 
+def normalize_host_ports(ports) -> List[tuple]:
+    """Container hostPort declarations → canonical ``(host_ip, protocol,
+    port)`` tuples (the k8s nodeports plugin's GetContainerPorts shape).
+
+    Accepts bare ints, k8s ContainerPort dicts (only entries with
+    ``hostPort > 0`` count), or pre-normalized tuples. ``hostIP`` defaults to
+    the 0.0.0.0 wildcard and ``protocol`` to TCP, matching upstream."""
+    out: List[tuple] = []
+    for p in ports or []:
+        if isinstance(p, int):
+            out.append(("0.0.0.0", "TCP", p))
+        elif isinstance(p, dict):
+            hp = int(p.get("hostPort") or 0)
+            if hp > 0:
+                out.append((p.get("hostIP") or "0.0.0.0",
+                            p.get("protocol") or "TCP", hp))
+        else:
+            ip, proto, port = p
+            out.append((ip or "0.0.0.0", proto or "TCP", int(port)))
+    return out
+
+
 class DisruptionBudget:
     """JobInfo disruption budget (job_info.go:354-365)."""
 
@@ -49,6 +71,7 @@ class TaskInfo:
                  preemptable: bool = False, revocable_zone: str = "",
                  topology_policy: str = "",
                  creation_timestamp: Optional[float] = None,
+                 host_ports: Optional[List] = None,
                  pod: object = None):
         self.uid = uid or _new_uid("task")
         self.name = name or self.uid
@@ -75,6 +98,9 @@ class TaskInfo:
         # volcano.sh/numa-topology-policy annotation (pod_info.go
         # TopologyPolicy); consumed by the numaaware plugin.
         self.topology_policy = topology_policy
+        # (host_ip, protocol, port) tuples the pod claims on its node
+        # (nodeports predicate); treated as immutable after construction.
+        self.host_ports: List[tuple] = normalize_host_ports(host_ports)
         self.creation_timestamp = creation_timestamp if creation_timestamp is not None else _time.time()
         self.pod = pod                      # backing store object, if any
         self.volume_ready = False

@@ -15,6 +15,18 @@ from .job_info import TaskInfo
 from .types import TaskStatus
 
 
+def ports_conflict(want, existing) -> bool:
+    """k8s nodeports conflict rule over canonical (host_ip, protocol, port)
+    tuples: conflict iff protocol and port match and the hostIPs are equal or
+    either side binds the 0.0.0.0 wildcard."""
+    for ip, proto, port in want:
+        for eip, eproto, eport in existing:
+            if (port == eport and proto == eproto
+                    and (ip == eip or ip == "0.0.0.0" or eip == "0.0.0.0")):
+                return True
+    return False
+
+
 class NodeInfo:
     def __init__(self, name: str = "", allocatable: Optional[Resource] = None,
                  capability: Optional[Resource] = None,
@@ -37,6 +49,9 @@ class NodeInfo:
         # nodes (tdm plugin)
         self.revocable_zone = self.labels.get("volcano.sh/revocable-zone", "")
         self.tasks: Dict[str, TaskInfo] = {}
+        # (host_ip, protocol, port) -> claim count for tasks on this node
+        # (k8s nodeports bookkeeping; predicates.go:321 Filter input)
+        self.used_ports: Dict[tuple, int] = {}
         # ready mirrors NodePhase; nodes flagged not-ready are skipped in
         # Snapshot (cache.go:822-827 analogue handled by the cache layer).
         self.ready = True
@@ -119,6 +134,8 @@ class NodeInfo:
         task.node_name = self.name
         ti.node_name = self.name
         self.tasks[ti.uid] = ti
+        for port in ti.host_ports:
+            self.used_ports[port] = self.used_ports.get(port, 0) + 1
         if ti.status != TaskStatus.PIPELINED:
             self._account_gpu(ti, add=True)
 
@@ -137,6 +154,12 @@ class NodeInfo:
             self.used.sub(own.resreq)
         task.node_name = ""
         del self.tasks[own.uid]
+        for port in own.host_ports:
+            left = self.used_ports.get(port, 0) - 1
+            if left > 0:
+                self.used_ports[port] = left
+            else:
+                self.used_ports.pop(port, None)
         if own.status != TaskStatus.PIPELINED:
             self._account_gpu(own, add=False)
 
@@ -160,6 +183,15 @@ class NodeInfo:
         n.numa_allocations = {uid: {res: set(ids) for res, ids in sets.items()}
                               for uid, sets in self.numa_allocations.items()}
         return n
+
+    def has_port_conflict(self, task: TaskInfo) -> bool:
+        """True when any of the task's hostPorts collides with a port already
+        claimed on this node (k8s nodeports Filter semantics: same
+        protocol+port, and hostIPs equal or either the 0.0.0.0 wildcard).
+        Pipelined tasks' ports count too — they claim the node's future."""
+        if not task.host_ports or not self.used_ports:
+            return False
+        return ports_conflict(task.host_ports, self.used_ports)
 
     def pods(self) -> List[TaskInfo]:
         return list(self.tasks.values())

@@ -117,3 +117,4 @@ NODE_UNSCHEDULABLE = "node(s) were unschedulable"
 TAINTS_UNTOLERATED = "node(s) had taints that the pod didn't tolerate"
 NODE_AFFINITY_FAILED = "node(s) didn't match node affinity"
 POD_AFFINITY_FAILED = "node(s) didn't match pod affinity/anti-affinity"
+NODE_PORTS_FAILED = "node(s) didn't have free ports for the requested pod ports"

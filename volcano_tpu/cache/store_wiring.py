@@ -58,6 +58,8 @@ def pod_to_task(pod: Pod) -> TaskInfo:
         topology_policy=pod.metadata.annotations.get(
             "volcano.sh/numa-topology-policy", ""),
         creation_timestamp=pod.metadata.creation_timestamp,
+        host_ports=[p for c in tpl.containers
+                    for p in c.get("ports", [])],
         pod=pod)
 
 

@@ -23,7 +23,8 @@ from ..api import FitError
 from ..api.device_info import (devices_idle_matrix, gpu_memory_of_task,
                                predicate_gpu)
 from ..api.types import (NODE_AFFINITY_FAILED, NODE_POD_NUMBER_EXCEEDED,
-                         NODE_UNSCHEDULABLE, TAINTS_UNTOLERATED)
+                         NODE_PORTS_FAILED, NODE_UNSCHEDULABLE,
+                         TAINTS_UNTOLERATED)
 from .base import Plugin
 from .nodeorder import _toleration_matches, match_node_selector_terms
 from .podaffinity import get_pod_affinity_index, session_has_pod_affinity
@@ -160,6 +161,10 @@ class PredicatesPlugin(Plugin):
 
     def _stateful_predicates(self, task, node) -> None:
         """Predicates over mutable node usage — evaluated every call."""
+        # NodePorts (predicates.go:321 nodePortFilter.Filter): hostPort
+        # claims change as the cycle allocates, so never cached
+        if node.has_port_conflict(task):
+            raise PredicateError(task, node, NODE_PORTS_FAILED)
         if self.gpu_sharing_enable and gpu_memory_of_task(task) > 0:
             # gpu.go checkNodeGPUSharingPredicate: some single card must fit
             if not node.gpu_devices or predicate_gpu(task, node.gpu_devices) is None:
@@ -181,11 +186,19 @@ class PredicatesPlugin(Plugin):
                 gpu_reqs = None
         prop_needed = bool(self.proportional_enable and self.proportional)
         pod_aff = session_has_pod_affinity(ssn)
+        any_ports = any(t.host_ports for t in tasks)
         if (not any_taints and not any_unsched and gpu_reqs is None
-                and not prop_needed and not pod_aff
+                and not prop_needed and not pod_aff and not any_ports
                 and not any(t.node_selector or t.affinity for t in tasks)):
             return None                                  # all-true mask
         mask = np.ones((T, N), dtype=bool)
+        if any_ports:
+            for ni, node in enumerate(node_infos):
+                if not node.used_ports:
+                    continue
+                for ti, task in enumerate(tasks):
+                    if task.host_ports and node.has_port_conflict(task):
+                        mask[ti, ni] = False
         if pod_aff:
             idx = get_pod_affinity_index(ssn)
             for ti, task in enumerate(tasks):
@@ -232,6 +245,11 @@ class PredicatesPlugin(Plugin):
         if session_has_pod_affinity(ssn):
             # in-cycle placements change the existing-pod set the affinity
             # terms match against
+            ssn.stateful_predicates.add(self.NAME)
+        if any(t.host_ports
+               for job in ssn.jobs.values() for t in job.tasks.values()):
+            # each in-cycle placement claims its hostPorts on the node, so
+            # batched proposals must be re-checked through predicate_fn
             ssn.stateful_predicates.add(self.NAME)
 
 

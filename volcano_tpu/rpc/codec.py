@@ -115,6 +115,7 @@ def encode_snapshot(nodes: List[NodeInfo], jobs: List[JobInfo],
                 "node_selector": t.node_selector,
                 "tolerations": t.tolerations,
                 "affinity": t.affinity,
+                "host_ports": [list(p) for p in t.host_ports],
             } for t in j.tasks.values()],
         } for j in jobs],
     }
@@ -175,7 +176,8 @@ def decode_snapshot(msg: dict):
                 labels=td.get("labels"), annotations=td.get("annotations"),
                 node_selector=td.get("node_selector"),
                 tolerations=td.get("tolerations"),
-                affinity=td.get("affinity"))
+                affinity=td.get("affinity"),
+                host_ports=td.get("host_ports"))
             job.add_task_info(task)
             # placement survives even when the node is absent from the
             # snapshot (cordoned / in-flight-bind nodes are skipped, but
@@ -185,10 +187,13 @@ def decode_snapshot(msg: dict):
             node = nodes.get(own.node_name)
             if node is not None:
                 # attach WITHOUT re-accounting: the wire usage vectors
-                # already include every placed task
+                # already include every placed task (hostPort claims are
+                # not part of the usage vectors, so they ARE accounted)
                 clone = own.clone()
                 clone.node_name = node.name
                 node.tasks[clone.uid] = clone
+                for port in clone.host_ports:
+                    node.used_ports[port] = node.used_ports.get(port, 0) + 1
         jobs.append(job)
     return list(nodes.values()), jobs, queues
 

@@ -134,7 +134,9 @@ class ObjectStore:
             self._rv += 1
             obj.metadata.resource_version = self._rv
             self._objects[kind][key] = obj
-        self._notify(kind, UPDATED, obj, old)
+        # creating via the CAS create-only path is an ADD to watchers,
+        # matching the native vs_put_cas EV_ADDED on absent keys
+        self._notify(kind, UPDATED if old is not None else ADDED, obj, old)
         return obj
 
     def update_status(self, obj) -> object:
