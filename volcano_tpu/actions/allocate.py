@@ -561,13 +561,13 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
             ms = np.pad(np.where(f, s, MNEG).astype(np.float32),
                         ((0, 0), (0, n_pad)), constant_values=MNEG)
             ms = jnp.asarray(ms)
-        assign, ready, _ = place_blocks_sharded(
+        assign, pipelined, ready, kept, _ = place_blocks_sharded(
             mesh, state, jnp.asarray(req), jnp.ones(T, bool),
             jnp.asarray(job_ix_np), jobs_meta, weights, jnp.asarray(alloc),
             jnp.asarray(maxt), masked_static=ms)
         task_node = np.where(assign < N, assign, NO_NODE).astype(np.int32)
         return _FusedSolution(tasks, job_ix_np, jobs_list, node_t, task_node,
-                              np.zeros(T, bool), ready, ready)
+                              pipelined, ready, kept)
 
     from ..ops import pallas_place
     use_pallas = (not blocks and kernel != "scan"

@@ -13,8 +13,9 @@ All collectives ride ICI inside one jit program; nothing touches the host
 between chunks. The compiled solver is cached per (mesh, chunk, sweeps) with
 job metadata and score weights as runtime arguments, so a scheduler calling
 it every cycle pays one compile per shape bucket, not per cycle; the
-(assign, ready) results come back in ONE packed device->host fetch (tunnel
-RTT dominates payload size on remote TPU backends).
+(assign, pipelined, ready, kept) results come back in ONE packed
+device->host fetch (tunnel RTT dominates payload size on remote TPU
+backends).
 """
 
 from __future__ import annotations
@@ -63,8 +64,11 @@ def _sharded_chunk_step(axis: str, has_ms: bool):
         K = min(K_CAND, Nl)
 
         pods_ok = nodes.ntasks < max_tasks
-        fit = (jnp.all(req[:, None, :] < nodes.idle[None] + EPS, axis=-1)
-               & pods_ok[None])                              # [C,Nl]
+        # bid eligibility is FutureIdle-based (allocate.go:232-256): a task
+        # that does not fit Idle may still pipeline onto releasing capacity;
+        # the alloc-vs-pipeline split is resolved per accepted task below
+        fit = (jnp.all(req[:, None, :] < nodes.future_idle[None] + EPS,
+                       axis=-1) & pods_ok[None])              # [C,Nl]
         score = combined_dynamic_score(req, nodes.used, allocatable, weights)
         if ms is not None:
             fit = fit & (ms > NEG_TEST)
@@ -102,7 +106,7 @@ def _sharded_chunk_step(axis: str, has_ms: bool):
                            * acc_l[:, None])
             claimed = jnp.einsum("cn,cr->nr", claimed_hot, req)
             claimed_cnt = jnp.sum(claimed_hot, axis=0)
-            avail_bid = nodes.idle[bid_l] - claimed[bid_l]
+            avail_bid = nodes.future_idle[bid_l] - claimed[bid_l]
             base_cnt = nodes.ntasks[bid_l] + claimed_cnt[bid_l]
             maxt_bid = max_tasks[bid_l]
 
@@ -139,15 +143,42 @@ def _sharded_chunk_step(axis: str, has_ms: bool):
                 & (choice_g < shard_offset + Nl))
         choice_l = jnp.clip(choice_g - shard_offset, 0, Nl - 1)
         placed = jax.nn.one_hot(choice_l, Nl, dtype=req.dtype) * mine[:, None]
-        delta = jnp.einsum("cn,cr->nr", placed, req)
+
+        # alloc-vs-pipeline split (allocate.go:232-256 / ops/place.py:119):
+        # within the chunk, a task allocates iff it fits the node's Idle
+        # after the IDLE consumption of earlier-in-chunk allocs on the same
+        # node — pipelined neighbors consume FutureIdle only. Earlier alloc
+        # membership is itself the unknown; iterate the antitone fit map F:
+        # after t applications the first t same-node tasks carry their
+        # exact sequential value, and an ODD iterate is a SUBSET of the
+        # true greedy alloc set (S0=all ⊇ true ⇒ S1=F(S0) ⊆ F(true)=true,
+        # alternating), so any task still undecided at depth >9 falls on
+        # the safe side — pipelined, consuming only the FutureIdle room its
+        # acceptance already validated. Idle can never be oversubscribed.
+        same_node = (choice_l[:, None] == choice_l[None, :]) \
+            & mine[:, None] & mine[None, :] & lower
+        idle_bid = nodes.idle[choice_l]
+
+        def alloc_iter(_, alloc):
+            cum = (same_node * alloc[None, :].astype(req.dtype)) @ req
+            return mine & jnp.all(req + cum < idle_bid + EPS, axis=-1)
+
+        alloc = jax.lax.fori_loop(0, 9, alloc_iter, mine)
+        # one psum so every shard sees the global pipelined verdict
+        alloc_any = jax.lax.psum(alloc.astype(jnp.int32), axis) > 0
+        pipe = accept & ~alloc_any
+
+        alloc_hot = placed * alloc[:, None].astype(req.dtype)
+        delta_alloc = jnp.einsum("cn,cr->nr", alloc_hot, req)
+        delta_all = jnp.einsum("cn,cr->nr", placed, req)
         nodes = NodeState(
-            idle=nodes.idle - delta,
-            future_idle=nodes.future_idle - delta,
-            used=nodes.used + delta,
+            idle=nodes.idle - delta_alloc,
+            future_idle=nodes.future_idle - delta_all,
+            used=nodes.used + delta_alloc,
             ntasks=nodes.ntasks + jnp.sum(placed, axis=0).astype(jnp.int32))
 
         out = jnp.where(accept, choice_g, NO_NODE).astype(jnp.int32)
-        return nodes, out
+        return nodes, (out, pipe)
 
     return step
 
@@ -189,47 +220,62 @@ def _sharded_solver(mesh: Mesh, chunk: int, sweeps: int, passes: int,
         ms = maybe_ms[0] if has_ms else None
 
         assign0 = jnp.full(Tp, NO_NODE, dtype=jnp.int32)
+        pipe0 = jnp.zeros(Tp, dtype=bool)
 
         def place_pass(carry, _):
-            nodes, assign, job_dead = carry
+            nodes, assign, pipe, job_dead = carry
             todo = (assign == NO_NODE) & valid & ~job_dead[job_ix]
             xs = (req.reshape(n_chunks, chunk, -1),
                   todo.reshape(n_chunks, chunk))
             if has_ms:
                 xs = xs + (ms.reshape(n_chunks, chunk, Nl),)
-            nodes, out = jax.lax.scan(step, nodes, xs)
-            assign = jnp.where(assign == NO_NODE, out.reshape(Tp), assign)
-            return (nodes, assign, job_dead), None
+            nodes, (out, out_pipe) = jax.lax.scan(step, nodes, xs)
+            fresh = assign == NO_NODE
+            assign = jnp.where(fresh, out.reshape(Tp), assign)
+            pipe = jnp.where(fresh, out_pipe.reshape(Tp), pipe)
+            return (nodes, assign, pipe, job_dead), None
 
         def sweep(carry, _):
-            (nodes, assign, job_dead), _ = jax.lax.scan(
+            (nodes, assign, pipe, job_dead), _ = jax.lax.scan(
                 place_pass, carry, jnp.arange(passes))
 
             placed = assign != NO_NODE
-            counts = jax.ops.segment_sum(placed.astype(jnp.int32), job_ix,
-                                         num_segments=J)
-            ready = counts + jobs.base_ready >= jobs.min_available
-            drop = placed & ~ready[job_ix]
-            # free dropped demand on the owning shard
+            alloc_cnt = jax.ops.segment_sum(
+                (placed & ~pipe).astype(jnp.int32), job_ix, num_segments=J)
+            pipe_cnt = jax.ops.segment_sum(
+                (placed & pipe).astype(jnp.int32), job_ix, num_segments=J)
+            # gang votes (gang.go:45-216): ready counts allocations only;
+            # a merely-pipelined gang is KEPT (allocate.go:264-270 commits
+            # ready jobs, keeps pipelined ones open)
+            ready = alloc_cnt + jobs.base_ready >= jobs.min_available
+            kept = (alloc_cnt + pipe_cnt + jobs.base_ready
+                    + jobs.base_pipelined >= jobs.min_available)
+            drop = placed & ~kept[job_ix]
+            # free dropped demand on the owning shard (alloc'd drops free
+            # Idle too; pipelined drops only reserved future capacity)
             local = (assign >= shard_offset) & (assign < shard_offset + Nl) & drop
             drop_hot = (jax.nn.one_hot(
                 jnp.where(local, assign - shard_offset, 0), Nl,
                 dtype=req.dtype) * local[:, None])
-            freed = jnp.einsum("tn,tr->nr", drop_hot, req)
+            alloc_hot = drop_hot * (~pipe)[:, None].astype(req.dtype)
+            freed_alloc = jnp.einsum("tn,tr->nr", alloc_hot, req)
+            freed_all = jnp.einsum("tn,tr->nr", drop_hot, req)
             nodes = NodeState(
-                idle=nodes.idle + freed,
-                future_idle=nodes.future_idle + freed,
-                used=nodes.used - freed,
+                idle=nodes.idle + freed_alloc,
+                future_idle=nodes.future_idle + freed_all,
+                used=nodes.used - freed_alloc,
                 ntasks=nodes.ntasks - jnp.sum(drop_hot, axis=0).astype(jnp.int32))
             assign = jnp.where(drop, NO_NODE, assign)
-            job_dead = job_dead | (~ready & (counts > 0))
-            return (nodes, assign, job_dead), ready
+            job_dead = job_dead | (~kept & (alloc_cnt + pipe_cnt > 0))
+            return (nodes, assign, pipe, job_dead), (ready, kept)
 
-        (nodes, assign, _), readies = jax.lax.scan(
-            sweep, (nodes, assign0, jnp.zeros(J, dtype=bool)),
+        (nodes, assign, pipe, _), (readies, kepts) = jax.lax.scan(
+            sweep, (nodes, assign0, pipe0, jnp.zeros(J, dtype=bool)),
             jnp.arange(sweeps))
-        # pack (assign, ready) into one i32 row: one host fetch for the lot
-        packed = jnp.concatenate([assign, readies[-1].astype(jnp.int32)])
+        # pack (assign, pipe, ready, kept) in one i32 row: one host fetch
+        packed = jnp.concatenate([assign, pipe.astype(jnp.int32),
+                                  readies[-1].astype(jnp.int32),
+                                  kepts[-1].astype(jnp.int32)])
         return packed, nodes
 
     fn = jax.jit(solve)
@@ -243,16 +289,17 @@ def place_blocks_sharded(mesh: Mesh, nodes: NodeState, req: jnp.ndarray,
                          allocatable: jnp.ndarray, max_tasks: jnp.ndarray,
                          chunk: int = 256, sweeps: int = 3, passes: int = 3,
                          masked_static: Optional[jnp.ndarray] = None,
-                         ) -> Tuple[np.ndarray, np.ndarray, NodeState]:
+                         ) -> Tuple[np.ndarray, np.ndarray,
+                                    np.ndarray, np.ndarray, NodeState]:
     """Node-sharded block-greedy placement over ``mesh``.
 
     nodes/allocatable/max_tasks are sharded on the node axis; tasks
     (req/valid/job_ix) and JobMeta are replicated; ``masked_static``
     (optional f32[T,N], NEG where statically infeasible) is sharded on its
-    node axis. Returns (task_node i32[T] global indices, job_ready bool[J] —
-    both host numpy, from one packed fetch — and the final sharded
-    NodeState, left on device). N must be divisible by the mesh size (pad
-    with zero-capacity nodes).
+    node axis. Returns (task_node i32[T] global indices, task_pipelined
+    bool[T], job_ready bool[J], job_kept bool[J] — host numpy from one
+    packed fetch — and the final sharded NodeState, left on device). N
+    must be divisible by the mesh size (pad with zero-capacity nodes).
     """
     D = mesh.devices.size
     N = allocatable.shape[0]
@@ -275,4 +322,7 @@ def place_blocks_sharded(mesh: Mesh, nodes: NodeState, req: jnp.ndarray,
         args.append(masked_static)
     packed, out_nodes = fn(*args)
     packed = np.asarray(packed)                       # the ONE fetch
-    return packed[:T], packed[Tp:].astype(bool), out_nodes
+    J = jobs.min_available.shape[0]
+    return (packed[:T], packed[Tp:Tp + T].astype(bool),
+            packed[2 * Tp:2 * Tp + J].astype(bool),
+            packed[2 * Tp + J:].astype(bool), out_nodes)
