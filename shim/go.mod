@@ -1,0 +1,9 @@
+module volcano.sh/vc-shim
+
+go 1.21
+
+require (
+	k8s.io/api v0.29.0
+	k8s.io/apimachinery v0.29.0
+	k8s.io/client-go v0.29.0
+)

@@ -1,0 +1,692 @@
+// vc-shim: the real-cluster leg of the snapshot RPC (SURVEY.md §5.8).
+//
+// A single-file Go program that plays the role the Python SnapshotClient
+// plays in tests: it watches pods/nodes/podgroups/queues/priorityclasses
+// through client-go informers (the reference's event feed,
+// pkg/scheduler/cache/event_handlers.go:47-880), serializes the cluster
+// state into the versioned snapshot JSON of volcano_tpu/rpc/codec.py,
+// ships it over the 4-byte-big-endian length-prefixed TCP framing of
+// volcano_tpu/rpc/server.py, and executes the returned decisions against
+// the API server exactly like the reference cache side effects
+// (pkg/scheduler/cache/cache.go:602-666 Bind, :549-599 Evict,
+// defaultStatusUpdater :178-239).
+//
+// Wire conformance with the Python encoder is pinned by
+// testdata/golden_snapshot.json: shim_test.go builds the fixture cluster
+// from k8s objects and asserts buildSnapshot's output is structurally
+// identical to the golden trace; tests/test_rpc.py asserts the Python
+// encoder produces the same trace from the same fixture. Both sides
+// therefore speak byte-compatible JSON without sharing code.
+//
+// Build: cd shim && go build -o vc-shim .   (requires client-go; see go.mod)
+// Run:   vc-shim --kubeconfig ~/.kube/config --sidecar 127.0.0.1:7521 \
+//               --schedule-period 1s
+package main
+
+import (
+	"bytes"
+	"context"
+	"encoding/binary"
+	"encoding/json"
+	"flag"
+	"fmt"
+	"log"
+	"net"
+	"sort"
+	"time"
+
+	corev1 "k8s.io/api/core/v1"
+	schedulingv1 "k8s.io/api/scheduling/v1"
+	"k8s.io/apimachinery/pkg/api/resource"
+	metav1 "k8s.io/apimachinery/pkg/apis/meta/v1"
+	"k8s.io/apimachinery/pkg/apis/meta/v1/unstructured"
+	"k8s.io/apimachinery/pkg/labels"
+	"k8s.io/apimachinery/pkg/runtime/schema"
+	"k8s.io/apimachinery/pkg/types"
+	"k8s.io/client-go/dynamic"
+	"k8s.io/client-go/dynamic/dynamicinformer"
+	"k8s.io/client-go/informers"
+	"k8s.io/client-go/kubernetes"
+	corelisters "k8s.io/client-go/listers/core/v1"
+	"k8s.io/client-go/tools/cache"
+	"k8s.io/client-go/tools/clientcmd"
+)
+
+func mustParse(s string) resource.Quantity { return resource.MustParse(s) }
+
+const (
+	version             = 1 // codec.py VERSION
+	groupNameAnnotation = "scheduling.k8s.io/group-name"
+	maxMsg              = 1 << 30
+)
+
+var (
+	podGroupGVR = schema.GroupVersionResource{
+		Group: "scheduling.volcano.sh", Version: "v1beta1", Resource: "podgroups"}
+	queueGVR = schema.GroupVersionResource{
+		Group: "scheduling.volcano.sh", Version: "v1beta1", Resource: "queues"}
+)
+
+// ---- wire schema (field names match volcano_tpu/rpc/codec.py) ----------
+
+type res struct {
+	CPU        float64            `json:"cpu"`
+	Memory     float64            `json:"memory"`
+	Scalars    map[string]float64 `json:"scalars,omitempty"`
+	MaxTaskNum *int               `json:"max_task_num,omitempty"`
+}
+
+type wireNode struct {
+	Name          string            `json:"name"`
+	Allocatable   res               `json:"allocatable"`
+	Capability    res               `json:"capability"`
+	Used          res               `json:"used"`
+	Idle          res               `json:"idle"`
+	Releasing     res               `json:"releasing"`
+	Pipelined     res               `json:"pipelined"`
+	Labels        map[string]string `json:"labels"`
+	Taints        []map[string]any  `json:"taints"`
+	Annotations   map[string]string `json:"annotations"`
+	Unschedulable bool              `json:"unschedulable"`
+}
+
+type wireQueue struct {
+	Name        string            `json:"name"`
+	Weight      float64           `json:"weight"`
+	Reclaimable bool              `json:"reclaimable"`
+	Capability  *res              `json:"capability"`
+	Annotations map[string]string `json:"annotations"`
+}
+
+type wireTask struct {
+	UID            string            `json:"uid"`
+	Name           string            `json:"name"`
+	Status         string            `json:"status"`
+	Node           string            `json:"node"`
+	Resreq         res               `json:"resreq"`
+	Priority       float64           `json:"priority"`
+	Created        float64           `json:"created"`
+	Preemptable    bool              `json:"preemptable"`
+	RevocableZone  string            `json:"revocable_zone"`
+	TopologyPolicy string            `json:"topology_policy"`
+	TaskRole       string            `json:"task_role"`
+	Labels         map[string]string `json:"labels"`
+	Annotations    map[string]string `json:"annotations"`
+	NodeSelector   map[string]string `json:"node_selector"`
+	Tolerations    []map[string]any  `json:"tolerations"`
+	Affinity       map[string]any    `json:"affinity"`
+	HostPorts      [][]any           `json:"host_ports"`
+}
+
+type wireJob struct {
+	UID           string     `json:"uid"`
+	Name          string     `json:"name"`
+	Namespace     string     `json:"namespace"`
+	Queue         string     `json:"queue"`
+	MinAvailable  int64      `json:"min_available"`
+	Priority      float64    `json:"priority"`
+	Phase         string     `json:"phase"`
+	Created       float64    `json:"created"`
+	Preemptable   bool       `json:"preemptable"`
+	RevocableZone string     `json:"revocable_zone"`
+	MinResources  *res       `json:"min_resources"`
+	Tasks         []wireTask `json:"tasks"`
+}
+
+type snapshot struct {
+	V      int         `json:"v"`
+	Nodes  []wireNode  `json:"nodes"`
+	Queues []wireQueue `json:"queues"`
+	Jobs   []wireJob   `json:"jobs"`
+}
+
+type decisions struct {
+	V     int `json:"v"`
+	Binds []struct {
+		UID       string `json:"uid"`
+		Namespace string `json:"namespace"`
+		Name      string `json:"name"`
+		Node      string `json:"node"`
+	} `json:"binds"`
+	Evicts []struct {
+		UID       string `json:"uid"`
+		Namespace string `json:"namespace"`
+		Name      string `json:"name"`
+		Reason    string `json:"reason"`
+	} `json:"evicts"`
+	PodGroups []struct {
+		UID        string           `json:"uid"`
+		Phase      string           `json:"phase"`
+		Conditions []map[string]any `json:"conditions"`
+	} `json:"podgroups"`
+	Error string `json:"error,omitempty"`
+}
+
+// ---- resource conversion (codec.py units: milli-CPU, bytes, milli-scaled
+// scalars; Resource.from_dict) ------------------------------------------
+
+func resFromList(rl corev1.ResourceList, pods bool) res {
+	out := res{}
+	for name, q := range rl {
+		switch name {
+		case corev1.ResourceCPU:
+			out.CPU = float64(q.MilliValue())
+		case corev1.ResourceMemory:
+			out.Memory = float64(q.Value())
+		case corev1.ResourcePods:
+			if pods {
+				n := int(q.Value())
+				out.MaxTaskNum = &n
+			}
+		default:
+			if out.Scalars == nil {
+				out.Scalars = map[string]float64{}
+			}
+			// scalar resources ride milli-scaled like Resource.from_dict
+			out.Scalars[string(name)] = float64(q.MilliValue())
+		}
+	}
+	return out
+}
+
+func addRes(a, b res) res {
+	out := res{CPU: a.CPU + b.CPU, Memory: a.Memory + b.Memory}
+	for _, s := range []map[string]float64{a.Scalars, b.Scalars} {
+		for k, v := range s {
+			if out.Scalars == nil {
+				out.Scalars = map[string]float64{}
+			}
+			out.Scalars[k] += v
+		}
+	}
+	if a.MaxTaskNum != nil {
+		out.MaxTaskNum = a.MaxTaskNum
+	}
+	return out
+}
+
+func subRes(a, b res) res {
+	out := res{CPU: a.CPU - b.CPU, Memory: a.Memory - b.Memory,
+		MaxTaskNum: a.MaxTaskNum}
+	for k, v := range a.Scalars {
+		if out.Scalars == nil {
+			out.Scalars = map[string]float64{}
+		}
+		out.Scalars[k] = v
+	}
+	for k, v := range b.Scalars {
+		if out.Scalars == nil {
+			out.Scalars = map[string]float64{}
+		}
+		out.Scalars[k] -= v
+	}
+	return out
+}
+
+func podRequest(pod *corev1.Pod) res {
+	total := res{}
+	for _, c := range pod.Spec.Containers {
+		total = addRes(total, resFromList(c.Resources.Requests, false))
+	}
+	return total
+}
+
+// taskStatus mirrors the reference getTaskStatus (pod_info.go): terminal
+// phases win, then a terminating Running/Pending pod is RELEASING, then
+// nodeName decides Bound vs Pending.
+func taskStatus(pod *corev1.Pod) string {
+	switch pod.Status.Phase {
+	case corev1.PodSucceeded:
+		return "SUCCEEDED"
+	case corev1.PodFailed:
+		return "FAILED"
+	case corev1.PodRunning:
+		if pod.DeletionTimestamp != nil {
+			return "RELEASING"
+		}
+		return "RUNNING"
+	}
+	if pod.DeletionTimestamp != nil {
+		return "RELEASING"
+	}
+	if pod.Spec.NodeName != "" {
+		return "BOUND"
+	}
+	return "PENDING"
+}
+
+func hostPorts(pod *corev1.Pod) [][]any {
+	out := [][]any{}
+	for _, c := range pod.Spec.Containers {
+		for _, p := range c.Ports {
+			if p.HostPort <= 0 {
+				continue
+			}
+			ip := p.HostIP
+			if ip == "" {
+				ip = "0.0.0.0"
+			}
+			proto := string(p.Protocol)
+			if proto == "" {
+				proto = "TCP"
+			}
+			out = append(out, []any{ip, proto, float64(p.HostPort)})
+		}
+	}
+	return out
+}
+
+func tolerationMaps(pod *corev1.Pod) []map[string]any {
+	out := []map[string]any{}
+	for _, t := range pod.Spec.Tolerations {
+		m := map[string]any{}
+		if t.Key != "" {
+			m["key"] = t.Key
+		}
+		if t.Operator != "" {
+			m["operator"] = string(t.Operator)
+		}
+		if t.Value != "" {
+			m["value"] = t.Value
+		}
+		if t.Effect != "" {
+			m["effect"] = string(t.Effect)
+		}
+		out = append(out, m)
+	}
+	return out
+}
+
+func taintMaps(node *corev1.Node) []map[string]any {
+	out := []map[string]any{}
+	for _, t := range node.Spec.Taints {
+		out = append(out, map[string]any{
+			"key": t.Key, "value": t.Value, "effect": string(t.Effect)})
+	}
+	return out
+}
+
+func affinityMap(pod *corev1.Pod) map[string]any {
+	if pod.Spec.Affinity == nil {
+		return map[string]any{}
+	}
+	raw, err := json.Marshal(pod.Spec.Affinity)
+	if err != nil {
+		return map[string]any{}
+	}
+	var out map[string]any
+	_ = json.Unmarshal(raw, &out)
+	return out
+}
+
+// ---- snapshot assembly -------------------------------------------------
+
+// buildSnapshot is the pure core: (nodes, pods, podgroups, queues,
+// priorities) -> the codec.py v1 snapshot. The usage vectors are derived
+// the way the scheduler cache derives them (node_info.go AddTask): every
+// non-terminal pod with a nodeName consumes idle; pods in Releasing
+// (deletionTimestamp set) count in releasing too.
+func buildSnapshot(nodes []*corev1.Node, pods []*corev1.Pod,
+	podgroups []*unstructured.Unstructured,
+	queues []*unstructured.Unstructured,
+	priorities map[string]float64) snapshot {
+
+	snap := snapshot{V: version}
+
+	byNode := map[string][]*corev1.Pod{}
+	for _, p := range pods {
+		if p.Spec.NodeName != "" && p.Status.Phase != corev1.PodSucceeded &&
+			p.Status.Phase != corev1.PodFailed {
+			byNode[p.Spec.NodeName] = append(byNode[p.Spec.NodeName], p)
+		}
+	}
+
+	sort.Slice(nodes, func(i, j int) bool { return nodes[i].Name < nodes[j].Name })
+	for _, n := range nodes {
+		alloc := resFromList(n.Status.Allocatable, true)
+		capab := resFromList(n.Status.Capacity, true)
+		used, releasing := res{}, res{}
+		for _, p := range byNode[n.Name] {
+			req := podRequest(p)
+			used = addRes(used, req)
+			if p.DeletionTimestamp != nil {
+				releasing = addRes(releasing, req)
+			}
+		}
+		// idle inherits allocatable's pod capacity (Resource.clone keeps
+		// max_task_num on the Python side); used/releasing never carry it
+		idle := subRes(alloc, used)
+		snap.Nodes = append(snap.Nodes, wireNode{
+			Name: n.Name, Allocatable: alloc, Capability: capab,
+			Used: used, Idle: idle, Releasing: releasing, Pipelined: res{},
+			Labels: orEmpty(n.Labels), Taints: taintMaps(n),
+			Annotations:   orEmpty(n.Annotations),
+			Unschedulable: n.Spec.Unschedulable,
+		})
+	}
+
+	sort.Slice(queues, func(i, j int) bool {
+		return queues[i].GetName() < queues[j].GetName()
+	})
+	for _, q := range queues {
+		spec, _, _ := unstructured.NestedMap(q.Object, "spec")
+		wq := wireQueue{Name: q.GetName(), Weight: 1, Reclaimable: true,
+			Annotations: orEmpty(q.GetAnnotations())}
+		if w, ok := spec["weight"]; ok {
+			wq.Weight = toFloat(w)
+		}
+		if r, ok := spec["reclaimable"].(bool); ok {
+			wq.Reclaimable = r
+		}
+		if c, ok := spec["capability"].(map[string]any); ok {
+			cr := resFromAnyMap(c)
+			wq.Capability = &cr
+		}
+		snap.Queues = append(snap.Queues, wq)
+	}
+
+	byGroup := map[string][]*corev1.Pod{}
+	for _, p := range pods {
+		if g := p.Annotations[groupNameAnnotation]; g != "" {
+			key := p.Namespace + "/" + g
+			byGroup[key] = append(byGroup[key], p)
+		}
+	}
+
+	sort.Slice(podgroups, func(i, j int) bool {
+		ki := podgroups[i].GetNamespace() + "/" + podgroups[i].GetName()
+		kj := podgroups[j].GetNamespace() + "/" + podgroups[j].GetName()
+		return ki < kj
+	})
+	for _, pg := range podgroups {
+		ns, name := pg.GetNamespace(), pg.GetName()
+		if ns == "" {
+			ns = "default"
+		}
+		uid := ns + "/" + name
+		spec, _, _ := unstructured.NestedMap(pg.Object, "spec")
+		queueName, _ := spec["queue"].(string)
+		if queueName == "" {
+			queueName = "default"
+		}
+		phase, _, _ := unstructured.NestedString(pg.Object, "status", "phase")
+		if phase == "" {
+			phase = "Pending"
+		}
+		minAvail := int64(0)
+		if m, ok := spec["minMember"]; ok {
+			minAvail = int64(toFloat(m))
+		}
+		job := wireJob{
+			UID: uid, Name: name, Namespace: ns, Queue: queueName,
+			MinAvailable: minAvail, Phase: phase,
+			Created: float64(pg.GetCreationTimestamp().Unix()),
+			Tasks:   []wireTask{},
+		}
+		if pc, _, _ := unstructured.NestedString(
+			pg.Object, "spec", "priorityClassName"); pc != "" {
+			job.Priority = priorities[pc]
+		}
+		if mr, ok := spec["minResources"].(map[string]any); ok {
+			r := resFromAnyMap(mr)
+			job.MinResources = &r
+		}
+		group := byGroup[uid]
+		sort.Slice(group, func(i, j int) bool {
+			return group[i].Name < group[j].Name
+		})
+		for _, p := range group {
+			taskRole := p.Annotations["volcano.sh/task-spec"]
+			if taskRole == "" {
+				taskRole = p.Name
+			}
+			prio := float64(1)
+			if p.Spec.Priority != nil {
+				prio = float64(*p.Spec.Priority)
+			}
+			job.Tasks = append(job.Tasks, wireTask{
+				UID: string(p.UID), Name: p.Name, Status: taskStatus(p),
+				Node: p.Spec.NodeName, Resreq: podRequest(p),
+				Priority: prio,
+				Created:  float64(p.CreationTimestamp.Unix()),
+				Preemptable: p.Annotations["volcano.sh/preemptable"] ==
+					"true",
+				RevocableZone:  p.Annotations["volcano.sh/revocable-zone"],
+				TopologyPolicy: p.Annotations["volcano.sh/numa-topology-policy"],
+				TaskRole:       taskRole,
+				Labels:         orEmpty(p.Labels),
+				Annotations:    orEmpty(p.Annotations),
+				NodeSelector:   orEmpty(p.Spec.NodeSelector),
+				Tolerations:    tolerationMaps(p),
+				Affinity:       affinityMap(p),
+				HostPorts:      hostPorts(p),
+			})
+		}
+		snap.Jobs = append(snap.Jobs, job)
+	}
+	return snap
+}
+
+func orEmpty(m map[string]string) map[string]string {
+	if m == nil {
+		return map[string]string{}
+	}
+	return m
+}
+
+func toFloat(v any) float64 {
+	switch x := v.(type) {
+	case int64:
+		return float64(x)
+	case float64:
+		return x
+	}
+	return 0
+}
+
+func resFromAnyMap(m map[string]any) res {
+	rl := corev1.ResourceList{}
+	for k, v := range m {
+		// int-or-string fields: unquoted manifests arrive as numbers
+		switch x := v.(type) {
+		case string:
+			rl[corev1.ResourceName(k)] = mustParse(x)
+		case int64:
+			rl[corev1.ResourceName(k)] = *resource.NewQuantity(
+				x, resource.DecimalSI)
+		case float64:
+			rl[corev1.ResourceName(k)] = *resource.NewMilliQuantity(
+				int64(x*1000), resource.DecimalSI)
+		}
+	}
+	return resFromList(rl, false)
+}
+
+// ---- framing (server.py: 4-byte big-endian length + UTF-8 JSON) --------
+
+func writeMsg(conn net.Conn, v any) error {
+	body, err := json.Marshal(v)
+	if err != nil {
+		return err
+	}
+	header := make([]byte, 4)
+	binary.BigEndian.PutUint32(header, uint32(len(body)))
+	_, err = conn.Write(append(header, body...))
+	return err
+}
+
+func readMsg(conn net.Conn, out any) error {
+	header := make([]byte, 4)
+	if _, err := readFull(conn, header); err != nil {
+		return err
+	}
+	length := binary.BigEndian.Uint32(header)
+	if length > maxMsg {
+		return fmt.Errorf("message too large: %d", length)
+	}
+	body := make([]byte, length)
+	if _, err := readFull(conn, body); err != nil {
+		return err
+	}
+	dec := json.NewDecoder(bytes.NewReader(body))
+	dec.UseNumber()
+	return dec.Decode(out)
+}
+
+func readFull(conn net.Conn, buf []byte) (int, error) {
+	read := 0
+	for read < len(buf) {
+		n, err := conn.Read(buf[read:])
+		if err != nil {
+			return read, err
+		}
+		read += n
+	}
+	return read, nil
+}
+
+// ---- side-effect execution (cache.go:602-666 Bind, :549-599 Evict) -----
+
+func execute(ctx context.Context, kube kubernetes.Interface,
+	dyn dynamic.Interface, dec decisions) {
+	for _, b := range dec.Binds {
+		binding := &corev1.Binding{
+			ObjectMeta: metav1.ObjectMeta{Namespace: b.Namespace, Name: b.Name},
+			Target:     corev1.ObjectReference{Kind: "Node", Name: b.Node},
+		}
+		if err := kube.CoreV1().Pods(b.Namespace).Bind(
+			ctx, binding, metav1.CreateOptions{}); err != nil {
+			log.Printf("bind %s/%s -> %s: %v", b.Namespace, b.Name, b.Node, err)
+		}
+	}
+	for _, e := range dec.Evicts {
+		// condition first, then delete — defaultEvictor semantics
+		patch := []byte(`{"status":{"conditions":[{"type":"Ready",` +
+			`"status":"False","reason":"Evict"}]}}`)
+		_, _ = kube.CoreV1().Pods(e.Namespace).Patch(
+			ctx, e.Name, types.StrategicMergePatchType, patch,
+			metav1.PatchOptions{}, "status")
+		if err := kube.CoreV1().Pods(e.Namespace).Delete(
+			ctx, e.Name, metav1.DeleteOptions{}); err != nil {
+			log.Printf("evict %s/%s: %v", e.Namespace, e.Name, err)
+		}
+	}
+	for _, pg := range dec.PodGroups {
+		ns, name := splitUID(pg.UID)
+		obj, err := dyn.Resource(podGroupGVR).Namespace(ns).Get(
+			ctx, name, metav1.GetOptions{})
+		if err != nil {
+			continue
+		}
+		_ = unstructured.SetNestedField(obj.Object, pg.Phase, "status", "phase")
+		conds := make([]any, 0, len(pg.Conditions))
+		for _, c := range pg.Conditions {
+			conds = append(conds, map[string]any(c))
+		}
+		_ = unstructured.SetNestedSlice(obj.Object, conds,
+			"status", "conditions")
+		if _, err := dyn.Resource(podGroupGVR).Namespace(ns).UpdateStatus(
+			ctx, obj, metav1.UpdateOptions{}); err != nil {
+			log.Printf("podgroup %s status: %v", pg.UID, err)
+		}
+	}
+}
+
+func splitUID(uid string) (string, string) {
+	for i := 0; i < len(uid); i++ {
+		if uid[i] == '/' {
+			return uid[:i], uid[i+1:]
+		}
+	}
+	return "default", uid
+}
+
+// ---- main loop ---------------------------------------------------------
+
+func main() {
+	kubeconfig := flag.String("kubeconfig", "", "path to kubeconfig")
+	master := flag.String("master", "", "API server URL override")
+	sidecar := flag.String("sidecar", "127.0.0.1:7521",
+		"host:port of the volcano_tpu snapshot-RPC sidecar")
+	period := flag.Duration("schedule-period", time.Second,
+		"cycle period (--schedule-period)")
+	flag.Parse()
+
+	cfg, err := clientcmd.BuildConfigFromFlags(*master, *kubeconfig)
+	if err != nil {
+		log.Fatalf("kubeconfig: %v", err)
+	}
+	cfg.QPS, cfg.Burst = 2000, 2000 // options.go:36-37
+	kube := kubernetes.NewForConfigOrDie(cfg)
+	dyn := dynamic.NewForConfigOrDie(cfg)
+
+	factory := informers.NewSharedInformerFactory(kube, 0)
+	podInformer := factory.Core().V1().Pods()
+	nodeInformer := factory.Core().V1().Nodes()
+	pcInformer := factory.Scheduling().V1().PriorityClasses()
+	dynFactory := dynamicinformer.NewDynamicSharedInformerFactory(dyn, 0)
+	pgInformer := dynFactory.ForResource(podGroupGVR)
+	queueInformer := dynFactory.ForResource(queueGVR)
+
+	ctx := context.Background()
+	factory.Start(ctx.Done())
+	dynFactory.Start(ctx.Done())
+	cache.WaitForCacheSync(ctx.Done(),
+		podInformer.Informer().HasSynced,
+		nodeInformer.Informer().HasSynced,
+		pcInformer.Informer().HasSynced,
+		pgInformer.Informer().HasSynced,
+		queueInformer.Informer().HasSynced)
+
+	conn, err := net.Dial("tcp", *sidecar)
+	if err != nil {
+		log.Fatalf("sidecar %s: %v", *sidecar, err)
+	}
+	defer conn.Close()
+	log.Printf("vc-shim: connected to sidecar %s, period %s", *sidecar, *period)
+
+	podLister := podInformer.Lister()
+	nodeLister := nodeInformer.Lister()
+	for range time.Tick(*period) {
+		snap := snapshotFromListers(podLister, nodeLister,
+			pgInformer, queueInformer, pcInformer.Lister().List)
+		if err := writeMsg(conn, snap); err != nil {
+			log.Fatalf("send: %v", err)
+		}
+		var dec decisions
+		if err := readMsg(conn, &dec); err != nil {
+			log.Fatalf("recv: %v", err)
+		}
+		if dec.Error != "" {
+			log.Printf("sidecar error: %s", dec.Error)
+			continue
+		}
+		execute(ctx, kube, dyn, dec)
+	}
+}
+
+func snapshotFromListers(podLister corelisters.PodLister,
+	nodeLister corelisters.NodeLister,
+	pgInformer, queueInformer informers.GenericInformer,
+	listPCs func(selector labels.Selector) ([]*schedulingv1.PriorityClass, error),
+) snapshot {
+	pods, _ := podLister.List(labels.Everything())
+	nodes, _ := nodeLister.List(labels.Everything())
+	pgObjs, _ := pgInformer.Lister().List(labels.Everything())
+	queueObjs, _ := queueInformer.Lister().List(labels.Everything())
+	pcs, _ := listPCs(labels.Everything())
+
+	priorities := map[string]float64{}
+	for _, pc := range pcs {
+		priorities[pc.Name] = float64(pc.Value)
+	}
+	pgs := make([]*unstructured.Unstructured, 0, len(pgObjs))
+	for _, o := range pgObjs {
+		pgs = append(pgs, o.(*unstructured.Unstructured))
+	}
+	queues := make([]*unstructured.Unstructured, 0, len(queueObjs))
+	for _, o := range queueObjs {
+		queues = append(queues, o.(*unstructured.Unstructured))
+	}
+	return buildSnapshot(nodes, pods, pgs, queues, priorities)
+}

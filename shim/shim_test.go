@@ -1,0 +1,204 @@
+// Wire-conformance test: buildSnapshot over a fixture cluster must be
+// structurally identical to testdata/golden_snapshot.json, which the
+// Python side generates (and re-asserts in tests/test_rpc.py) from the
+// same fixture through volcano_tpu/rpc/codec.py. Run with `go test ./...`
+// wherever a Go toolchain is available; the bench image has none, so the
+// golden file is the bridge both sides are pinned to.
+package main
+
+import (
+	"encoding/json"
+	"net"
+	"os"
+	"reflect"
+	"testing"
+	"time"
+
+	corev1 "k8s.io/api/core/v1"
+	"k8s.io/apimachinery/pkg/api/resource"
+	metav1 "k8s.io/apimachinery/pkg/apis/meta/v1"
+	"k8s.io/apimachinery/pkg/apis/meta/v1/unstructured"
+	k8stypes "k8s.io/apimachinery/pkg/types"
+)
+
+func rl(cpu, mem string, extra map[string]string) corev1.ResourceList {
+	out := corev1.ResourceList{
+		corev1.ResourceCPU:    resource.MustParse(cpu),
+		corev1.ResourceMemory: resource.MustParse(mem),
+	}
+	for k, v := range extra {
+		out[corev1.ResourceName(k)] = resource.MustParse(v)
+	}
+	return out
+}
+
+func fixturePod(name, uid, node string, phase corev1.PodPhase,
+	cpu, mem string, scalars map[string]string,
+	ann map[string]string, created int64) *corev1.Pod {
+	prio := int32(5)
+	return &corev1.Pod{
+		ObjectMeta: metav1.ObjectMeta{
+			Name: name, Namespace: "default", UID: k8stypes.UID(uid),
+			Annotations:       ann,
+			CreationTimestamp: metav1.Unix(created, 0),
+		},
+		Spec: corev1.PodSpec{
+			NodeName: node, Priority: &prio,
+			Containers: []corev1.Container{{
+				Name: "main",
+				Resources: corev1.ResourceRequirements{
+					Requests: rl(cpu, mem, scalars)},
+			}},
+		},
+		Status: corev1.PodStatus{Phase: phase},
+	}
+}
+
+func TestSnapshotMatchesGolden(t *testing.T) {
+	podsCap := resource.MustParse("110")
+
+	nodeA := &corev1.Node{
+		ObjectMeta: metav1.ObjectMeta{Name: "n-a",
+			Labels: map[string]string{"zone": "a"}},
+		Spec: corev1.NodeSpec{Taints: []corev1.Taint{{
+			Key: "dedicated", Value: "infra",
+			Effect: corev1.TaintEffectNoSchedule}}},
+		Status: corev1.NodeStatus{
+			Allocatable: corev1.ResourceList{
+				corev1.ResourceCPU:    resource.MustParse("8"),
+				corev1.ResourceMemory: resource.MustParse("16Gi"),
+				corev1.ResourcePods:   podsCap,
+				"nvidia.com/gpu":      resource.MustParse("4"),
+			},
+			Capacity: corev1.ResourceList{
+				corev1.ResourceCPU:    resource.MustParse("8"),
+				corev1.ResourceMemory: resource.MustParse("16Gi"),
+				corev1.ResourcePods:   podsCap,
+				"nvidia.com/gpu":      resource.MustParse("4"),
+			},
+		},
+	}
+	nodeB := &corev1.Node{
+		ObjectMeta: metav1.ObjectMeta{Name: "n-b"},
+		Spec:       corev1.NodeSpec{Unschedulable: true},
+		Status: corev1.NodeStatus{
+			Allocatable: rlWithPods("4", "8Gi", podsCap),
+			Capacity:    rlWithPods("4", "8Gi", podsCap),
+		},
+	}
+
+	groupAnn := map[string]string{groupNameAnnotation: "train"}
+	pod0 := fixturePod("train-0", "uid-0", "n-a", corev1.PodRunning,
+		"1", "1Gi", nil, map[string]string{
+			groupNameAnnotation:       "train",
+			"volcano.sh/preemptable":  "true",
+			"volcano.sh/task-spec":    "worker",
+		}, 1700000001)
+	pod0.Labels = map[string]string{"app": "t"}
+	pod0.Spec.Tolerations = []corev1.Toleration{{
+		Key: "dedicated", Operator: corev1.TolerationOpEqual,
+		Value: "infra", Effect: corev1.TaintEffectNoSchedule}}
+	pod0.Spec.Containers[0].Ports = []corev1.ContainerPort{{
+		HostPort: 8080, ContainerPort: 8080,
+		Protocol: corev1.ProtocolTCP}}
+
+	pod1 := fixturePod("train-1", "uid-1", "", corev1.PodPending,
+		"1", "1Gi", nil, groupAnn, 1700000002)
+	pod1.Spec.NodeSelector = map[string]string{"zone": "a"}
+	pod1.Spec.Tolerations = []corev1.Toleration{{
+		Key: "dedicated", Operator: corev1.TolerationOpEqual,
+		Value: "infra", Effect: corev1.TaintEffectNoSchedule}}
+
+	pod2 := fixturePod("train-2", "uid-2", "n-a", corev1.PodRunning,
+		"2", "2Gi", map[string]string{"nvidia.com/gpu": "1"},
+		map[string]string{
+			groupNameAnnotation:         "train",
+			"volcano.sh/revocable-zone": "rz1",
+		}, 1700000003)
+	now := metav1.NewTime(time.Unix(1700000100, 0))
+	pod2.DeletionTimestamp = &now
+
+	pg := &unstructured.Unstructured{Object: map[string]any{
+		"apiVersion": "scheduling.volcano.sh/v1beta1",
+		"kind":       "PodGroup",
+		"metadata": map[string]any{
+			"name": "train", "namespace": "default",
+			"creationTimestamp": time.Unix(1700000000, 0).
+				UTC().Format(time.RFC3339),
+		},
+		"spec": map[string]any{
+			"minMember":         int64(2),
+			"queue":             "default",
+			"priorityClassName": "high",
+			"minResources": map[string]any{
+				"cpu": "2", "memory": "2Gi"},
+		},
+		"status": map[string]any{"phase": "Inqueue"},
+	}}
+	queue := &unstructured.Unstructured{Object: map[string]any{
+		"apiVersion": "scheduling.volcano.sh/v1beta1",
+		"kind":       "Queue",
+		"metadata":   map[string]any{"name": "default"},
+		"spec": map[string]any{
+			"weight":      int64(2),
+			"reclaimable": true,
+			"capability":  map[string]any{"cpu": "6", "memory": "32Gi"},
+		},
+	}}
+
+	snap := buildSnapshot(
+		[]*corev1.Node{nodeB, nodeA}, // order-insensitive: sorted inside
+		[]*corev1.Pod{pod2, pod0, pod1},
+		[]*unstructured.Unstructured{pg},
+		[]*unstructured.Unstructured{queue},
+		map[string]float64{"high": 9})
+
+	raw, err := json.Marshal(snap)
+	if err != nil {
+		t.Fatal(err)
+	}
+	var got any
+	if err := json.Unmarshal(raw, &got); err != nil {
+		t.Fatal(err)
+	}
+
+	goldenRaw, err := os.ReadFile("testdata/golden_snapshot.json")
+	if err != nil {
+		t.Fatal(err)
+	}
+	var want any
+	if err := json.Unmarshal(goldenRaw, &want); err != nil {
+		t.Fatal(err)
+	}
+	if !reflect.DeepEqual(got, want) {
+		gotPretty, _ := json.MarshalIndent(got, "", " ")
+		t.Fatalf("snapshot diverges from golden trace:\n%s", gotPretty)
+	}
+}
+
+func rlWithPods(cpu, mem string, pods resource.Quantity) corev1.ResourceList {
+	out := rl(cpu, mem, nil)
+	out[corev1.ResourcePods] = pods
+	return out
+}
+
+func newPipe(t *testing.T) (net.Conn, net.Conn) {
+	a, b := net.Pipe()
+	t.Cleanup(func() { _ = a.Close(); _ = b.Close() })
+	return a, b
+}
+
+func TestFraming(t *testing.T) {
+	// server.py framing: 4-byte big-endian length + UTF-8 JSON
+	left, right := newPipe(t)
+	go func() {
+		_ = writeMsg(left, map[string]any{"v": 1, "ping": "pong"})
+	}()
+	var out map[string]any
+	if err := readMsg(right, &out); err != nil {
+		t.Fatal(err)
+	}
+	if out["ping"] != "pong" {
+		t.Fatalf("round trip lost payload: %v", out)
+	}
+}

@@ -218,3 +218,102 @@ def test_server_reports_errors():
         client.close()
     finally:
         server.shutdown()
+
+
+def _shim_fixture():
+    """The fixture cluster mirrored in shim/shim_test.go — both languages
+    must serialize it to shim/testdata/golden_snapshot.json."""
+    from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
+                                 QueueInfo, Resource, TaskInfo, TaskStatus)
+    GI = 1 << 30
+    a_alloc = Resource(8000, 16 * GI, {"nvidia.com/gpu": 4000.0})
+    a_alloc.max_task_num = 110
+    na = NodeInfo(name="n-a", allocatable=a_alloc, labels={"zone": "a"},
+                  taints=[{"key": "dedicated", "value": "infra",
+                           "effect": "NoSchedule"}])
+    b_alloc = Resource(4000, 8 * GI)
+    b_alloc.max_task_num = 110
+    nb = NodeInfo(name="n-b", allocatable=b_alloc, unschedulable=True)
+    q = QueueInfo(name="default", weight=2, reclaimable=True,
+                  capability=Resource(6000, 32 * GI))
+    pg = PodGroup(name="train", namespace="default", queue="default",
+                  min_member=2, phase=PodGroupPhase.INQUEUE,
+                  min_resources=Resource(2000, 2 * GI))
+    job = JobInfo(uid="default/train", name="train", namespace="default",
+                  queue="default", min_available=2, podgroup=pg,
+                  priority=9, creation_timestamp=1700000000.0)
+    t0 = TaskInfo(uid="uid-0", name="train-0", namespace="default",
+                  job="default/train", resreq=Resource(1000, 1 * GI),
+                  status=TaskStatus.RUNNING, priority=5, task_role="worker",
+                  labels={"app": "t"},
+                  annotations={"scheduling.k8s.io/group-name": "train",
+                               "volcano.sh/preemptable": "true",
+                               "volcano.sh/task-spec": "worker"},
+                  tolerations=[{"key": "dedicated", "operator": "Equal",
+                                "value": "infra", "effect": "NoSchedule"}],
+                  host_ports=[("0.0.0.0", "TCP", 8080)],
+                  preemptable=True, creation_timestamp=1700000001.0)
+    t1 = TaskInfo(uid="uid-1", name="train-1", namespace="default",
+                  job="default/train", resreq=Resource(1000, 1 * GI),
+                  status=TaskStatus.PENDING, priority=5,
+                  annotations={"scheduling.k8s.io/group-name": "train"},
+                  node_selector={"zone": "a"},
+                  tolerations=[{"key": "dedicated", "operator": "Equal",
+                                "value": "infra", "effect": "NoSchedule"}],
+                  creation_timestamp=1700000002.0)
+    t2 = TaskInfo(uid="uid-2", name="train-2", namespace="default",
+                  job="default/train",
+                  resreq=Resource(2000, 2 * GI, {"nvidia.com/gpu": 1000.0}),
+                  status=TaskStatus.RELEASING, priority=5,
+                  annotations={"scheduling.k8s.io/group-name": "train",
+                               "volcano.sh/revocable-zone": "rz1"},
+                  revocable_zone="rz1", creation_timestamp=1700000003.0)
+    for t in (t0, t1, t2):
+        job.add_task_info(t)
+    na.add_task(t0)
+    na.add_task(t2)
+    return [na, nb], [job], [q]
+
+
+def test_shim_golden_trace_conformance():
+    """Cross-language wire conformance (VERDICT r2 #3): the Python encoder
+    and the Go shim (shim/main.go buildSnapshot, pinned by
+    shim/shim_test.go) serialize the same fixture cluster to the same
+    bytes-on-the-wire. The golden file is the bridge: this test pins the
+    Python side, `go test ./shim` pins the Go side."""
+    import json
+    import pathlib
+    nodes, jobs, queues = _shim_fixture()
+    got = json.loads(json.dumps(encode_snapshot(nodes, jobs, queues)))
+    golden_path = (pathlib.Path(__file__).parent.parent
+                   / "shim" / "testdata" / "golden_snapshot.json")
+    want = json.loads(golden_path.read_text())
+    assert got == want
+    # the Go source pins the same protocol version and framing
+    shim_src = (pathlib.Path(__file__).parent.parent
+                / "shim" / "main.go").read_text()
+    import re
+    assert re.search(r"version\s*=\s*1\b", shim_src)
+    assert "binary.BigEndian.PutUint32" in shim_src
+
+
+def test_shim_golden_trace_schedules_through_the_wire():
+    """The golden snapshot is not just shape-compatible — the sidecar
+    schedules it: the pending task of the Inqueue gang binds (the gang's
+    running member plus one pending placement meet minMember=2)."""
+    import json
+    import pathlib
+    golden_path = (pathlib.Path(__file__).parent.parent
+                   / "shim" / "testdata" / "golden_snapshot.json")
+    snap = json.loads(golden_path.read_text())
+    server, thread, port = serve()
+    try:
+        client = SnapshotClient("127.0.0.1", port)
+        out = client.schedule(snap)
+        client.close()
+    finally:
+        server.shutdown()
+    binds = {b["name"]: b["node"] for b in out["binds"]}
+    assert binds.get("train-1") == "n-a"   # zone=a selector
+    phases = {p["uid"]: p["phase"] for p in out["podgroups"]}
+    assert phases["default/train"] == "Running"

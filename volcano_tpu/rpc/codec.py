@@ -19,9 +19,9 @@ Schema (version 1):
                 "revocable_zone", "min_resources": RES|null,
                 "tasks": [{"uid", "name", "status", "node", "resreq": RES,
                            "priority", "created", "preemptable",
-                           "revocable_zone", "topology_policy", "labels",
-                           "annotations", "node_selector", "tolerations",
-                           "affinity"}]}]}
+                           "revocable_zone", "topology_policy", "task_role",
+                           "labels", "annotations", "node_selector",
+                           "tolerations", "affinity", "host_ports"}]}]}
   RES = {"cpu": milli, "memory": bytes, "scalars": {...},
          "max_task_num": pods}
 
@@ -110,6 +110,7 @@ def encode_snapshot(nodes: List[NodeInfo], jobs: List[JobInfo],
                 "preemptable": t.preemptable,
                 "revocable_zone": t.revocable_zone,
                 "topology_policy": t.topology_policy,
+                "task_role": t.task_role,
                 "labels": t.labels,
                 "annotations": t.annotations,
                 "node_selector": t.node_selector,
@@ -173,6 +174,7 @@ def decode_snapshot(msg: dict):
                 preemptable=td.get("preemptable", False),
                 revocable_zone=td.get("revocable_zone", ""),
                 topology_policy=td.get("topology_policy", ""),
+                task_role=td.get("task_role", ""),
                 labels=td.get("labels"), annotations=td.get("annotations"),
                 node_selector=td.get("node_selector"),
                 tolerations=td.get("tolerations"),
