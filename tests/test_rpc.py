@@ -317,3 +317,118 @@ def test_shim_golden_trace_schedules_through_the_wire():
     assert binds.get("train-1") == "n-a"   # zone=a selector
     phases = {p["uid"]: p["phase"] for p in out["podgroups"]}
     assert phases["default/train"] == "Running"
+
+
+class TestAdmissionOverWire:
+    """VERDICT r2 #9: topology-3 writes validated through the sidecar
+    protocol (cmd/webhook-manager/app/server.go:41-108 analogue)."""
+
+    def _client(self):
+        server, thread, port = serve()
+        return server, SnapshotClient("127.0.0.1", port)
+
+    def test_bad_job_denied_through_the_wire(self):
+        from volcano_tpu.rpc.admission import to_wire
+        from volcano_tpu.apis.objects import (Job, JobSpec, ObjectMeta,
+                                              TaskSpec)
+        server, client = self._client()
+        try:
+            # minAvailable exceeding total replicas is rejected by
+            # jobs/validate (admit_job.go:46-330 analogue)
+            bad = Job(metadata=ObjectMeta(name="bad"),
+                      spec=JobSpec(min_available=5,
+                                   tasks=[TaskSpec(name="w", replicas=2)]))
+            out = client.admit("Job", "CREATE", to_wire(bad))
+            assert out["allowed"] is False
+            assert "minAvailable" in out["message"] or "replicas" in \
+                out["message"], out
+            # duplicate task names denied too
+            dup = Job(metadata=ObjectMeta(name="dup"),
+                      spec=JobSpec(tasks=[TaskSpec(name="w", replicas=1),
+                                          TaskSpec(name="w", replicas=1)]))
+            out = client.admit("Job", "CREATE", to_wire(dup))
+            assert out["allowed"] is False
+        finally:
+            client.close()
+            server.shutdown()
+
+    def test_mutation_defaults_returned(self):
+        """jobs/mutate defaults travel back as the patched object
+        (mutate_job.go:100-170: queue=default, minAvailable=sum
+        replicas)."""
+        from volcano_tpu.rpc.admission import from_wire, to_wire
+        from volcano_tpu.apis.objects import (Job, JobSpec, ObjectMeta,
+                                              QueueCR, TaskSpec)
+        server, client = self._client()
+        try:
+            job = Job(metadata=ObjectMeta(name="j"),
+                      spec=JobSpec(min_available=0,
+                                   tasks=[TaskSpec(name="w", replicas=3)]))
+            ctx = {"queues": [to_wire(QueueCR(
+                metadata=ObjectMeta(name="default")))]}
+            out = client.admit("Job", "CREATE", to_wire(job), context=ctx)
+            assert out["allowed"] is True
+            assert out["patched"] is not None
+            patched = from_wire(Job, out["patched"])
+            assert patched.spec.min_available == 3
+        finally:
+            client.close()
+            server.shutdown()
+
+    def test_queue_state_context_consulted(self):
+        """jobs/validate refuses jobs targeting a closed queue — cluster
+        state arrives as review context, keeping the sidecar stateless."""
+        from volcano_tpu.rpc.admission import to_wire
+        from volcano_tpu.apis.objects import (Job, JobSpec, ObjectMeta,
+                                              QueueCR, QueueStatus,
+                                              TaskSpec)
+        from volcano_tpu.api.types import QueueState
+        server, client = self._client()
+        try:
+            closed = QueueCR(metadata=ObjectMeta(name="batch"),
+                             status=QueueStatus(state=QueueState.CLOSED))
+            job = Job(metadata=ObjectMeta(name="j"),
+                      spec=JobSpec(queue="batch",
+                                   tasks=[TaskSpec(name="w", replicas=1)]))
+            ctx = {"queues": [to_wire(closed)]}
+            out = client.admit("Job", "CREATE", to_wire(job), context=ctx)
+            assert out["allowed"] is False, out
+            # with the queue open, the same job passes
+            open_q = QueueCR(metadata=ObjectMeta(name="batch"))
+            out = client.admit("Job", "CREATE", to_wire(job),
+                               context={"queues": [to_wire(open_q)]})
+            assert out["allowed"] is True, out
+        finally:
+            client.close()
+            server.shutdown()
+
+    def test_invalid_queue_weight_denied(self):
+        from volcano_tpu.rpc.admission import to_wire
+        from volcano_tpu.apis.objects import ObjectMeta, QueueCR, QueueSpecCR
+        server, client = self._client()
+        try:
+            q = QueueCR(metadata=ObjectMeta(name="q"),
+                        spec=QueueSpecCR(weight=-2))
+            out = client.admit("Queue", "CREATE", to_wire(q))
+            assert out["allowed"] is False
+        finally:
+            client.close()
+            server.shutdown()
+
+    def test_malformed_review_denied_not_errored(self):
+        """Wrong-typed wire data is a deny verdict, not a protocol error
+        (and never silently decodes into fabricated objects)."""
+        server, client = self._client()
+        try:
+            out = client.admit("Job", "CREATE",
+                               {"spec": {"tasks": "oops"}})
+            assert out["allowed"] is False
+            assert "malformed" in out["message"]
+            out = client.admit("Job", "CREATE",
+                               {"metadata": {"labels": ["a"]}})
+            assert out["allowed"] is False
+            out = client.schedule({"v": 2, "op": "admit", "review": {}})
+            assert out["allowed"] is False and "version" in out["message"]
+        finally:
+            client.close()
+            server.shutdown()

@@ -69,7 +69,10 @@ class _Handler(socketserver.BaseRequestHandler):
             if msg is None:
                 return
             try:
-                out = self.server.service.schedule(msg)
+                if msg.get("op") == "admit":
+                    out = self.server.admission.admit(msg)
+                else:
+                    out = self.server.service.schedule(msg)
             except Exception as exc:  # wire errors back, keep serving
                 out = {"error": f"{type(exc).__name__}: {exc}"}
             _write_msg(self.request, out)
@@ -86,6 +89,8 @@ def serve(host: str = "127.0.0.1", port: int = 0,
     """Start the sidecar; returns (server, thread, bound_port)."""
     server = _Server((host, port), _Handler)
     server.service = SchedulerService(conf_text)
+    from .admission import AdmissionOverWire
+    server.admission = AdmissionOverWire()
     thread = threading.Thread(target=server.serve_forever, daemon=True,
                               name="vc-snapshot-rpc")
     thread.start()
@@ -107,6 +112,16 @@ class SnapshotClient:
         if "error" in out:
             raise RuntimeError(out["error"])
         return out
+
+    def admit(self, kind: str, operation: str, obj: dict,
+              old: Optional[dict] = None,
+              context: Optional[dict] = None) -> dict:
+        """Run one admission review through the wire (the webhook-manager
+        role for topology 3); returns {"allowed", "message", "patched"}."""
+        return self.schedule({
+            "v": 1, "op": "admit",
+            "review": {"kind": kind, "operation": operation, "object": obj,
+                       "old": old, "context": context or {}}})
 
     def close(self) -> None:
         self.sock.close()
