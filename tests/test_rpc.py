@@ -432,3 +432,31 @@ class TestAdmissionOverWire:
         finally:
             client.close()
             server.shutdown()
+
+    def test_camelcase_review_and_unknown_fields(self):
+        """k8s-style camelCase reviews decode via aliases; genuinely
+        unknown fields fail closed."""
+        server, client = self._client()
+        try:
+            from volcano_tpu.rpc.admission import to_wire
+            from volcano_tpu.apis.objects import ObjectMeta, QueueCR
+            ctx = {"queues": [to_wire(QueueCR(
+                metadata=ObjectMeta(name="default")))]}
+            job = {"metadata": {"name": "j"},
+                   "spec": {"minAvailable": 9,
+                            "tasks": [{"name": "w", "replicas": 2}]}}
+            out = client.admit("Job", "CREATE", job, context=ctx)
+            # minAvailable alias decoded: 9 > 2 replicas -> denied
+            assert out["allowed"] is False, out
+            bad = {"spec": {"noSuchField": 1}}
+            out = client.admit("Job", "CREATE", bad)
+            assert out["allowed"] is False
+            assert "unknown field" in out["message"]
+            # duplicate context objects deny, not protocol-error
+            out = client.admit("Job", "CREATE", {"metadata": {"name": "x"}},
+                               context={"queues": [ctx["queues"][0],
+                                                   ctx["queues"][0]]})
+            assert out["allowed"] is False
+        finally:
+            client.close()
+            server.shutdown()

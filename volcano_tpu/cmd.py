@@ -97,15 +97,43 @@ def snapshot_rpc_main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=7070)
     parser.add_argument("--scheduler-conf", default=None)
+    parser.add_argument("--listen-address", type=int, default=0,
+                        help="serve /metrics and /healthz on this port "
+                             "(0 = off)")
     args = parser.parse_args(argv)
 
     conf_text = None
     if args.scheduler_conf:
         with open(args.scheduler_conf) as f:
             conf_text = f.read()
+    if args.listen_address:
+        from . import metrics
+        metrics.start_metrics_server(args.listen_address)
     from .rpc import serve
     server, thread, port = serve(args.host, args.port, conf_text)
     print(f"vc-snapshot-rpc listening on {args.host}:{port}")
+    if args.scheduler_conf:
+        # conf hot-reload: mtime watch on the mounted file, applied
+        # between cycles (pkg/filewatcher + scheduler.go:112-170)
+        import os
+        import threading
+        import time as _time
+
+        def watch():
+            last = os.stat(args.scheduler_conf).st_mtime
+            while True:
+                _time.sleep(2.0)
+                try:
+                    mtime = os.stat(args.scheduler_conf).st_mtime
+                except OSError:
+                    continue
+                if mtime != last:
+                    last = mtime
+                    with open(args.scheduler_conf) as f:
+                        server.service.reload_conf(f.read())
+                    print("vc-snapshot-rpc: scheduler conf reloaded")
+        threading.Thread(target=watch, daemon=True,
+                         name="conf-watch").start()
     try:
         thread.join()
     except KeyboardInterrupt:

@@ -32,8 +32,8 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import re
 import typing
-from typing import Optional
 
 from ..api.resource import Resource
 from ..apis.objects import Job, Pod, PodGroupCR, QueueCR
@@ -62,6 +62,10 @@ def to_wire(obj):
     return obj
 
 
+def _snake(name: str) -> str:
+    return re.sub(r"(?<!^)([A-Z])", r"_\1", name).lower()
+
+
 def _strip_optional(tp):
     if typing.get_origin(tp) is typing.Union:
         args = [a for a in typing.get_args(tp) if a is not type(None)]
@@ -85,10 +89,17 @@ def from_wire(tp, data):
             raise TypeError(f"{tp.__name__} expects an object, "
                             f"got {type(data).__name__}")
         hints = typing.get_type_hints(tp)
+        names = {f.name for f in dataclasses.fields(tp)}
         kwargs = {}
-        for f in dataclasses.fields(tp):
-            if f.name in data:
-                kwargs[f.name] = from_wire(hints[f.name], data[f.name])
+        for key, value in data.items():
+            # accept k8s camelCase aliases (a webhook front end forwards
+            # AdmissionReview objects verbatim); anything else is a
+            # malformed review and must fail CLOSED — silently dropping
+            # unknown keys would admit objects with defaulted fields
+            name = key if key in names else _snake(key)
+            if name not in names:
+                raise TypeError(f"{tp.__name__}: unknown field {key!r}")
+            kwargs[name] = from_wire(hints[name], value)
         return tp(**kwargs)
     origin = typing.get_origin(tp)
     if origin in (list, tuple):
@@ -135,16 +146,15 @@ class AdmissionOverWire:
                          for qd in ctx.get("queues") or []]
                         + [from_wire(PodGroupCR, pgd)
                            for pgd in ctx.get("podgroups") or []])
+            # seed context BEFORE the hooks attach: already-admitted
+            # cluster state must not re-run admission
+            store = ObjectStore()
+            for ctx_obj in ctx_objs:
+                store.create(ctx_obj)
         except (TypeError, ValueError, KeyError, AttributeError) as exc:
             return {"v": VERSION, "allowed": False,
                     "message": f"malformed object: {exc}", "patched": None}
         before = to_wire(obj)
-
-        # seed context BEFORE the hooks attach: already-admitted cluster
-        # state must not re-run admission
-        store = ObjectStore()
-        for ctx_obj in ctx_objs:
-            store.create(ctx_obj)
         router = register_webhooks(store)
 
         try:

@@ -118,8 +118,9 @@ class SnapshotClient:
               context: Optional[dict] = None) -> dict:
         """Run one admission review through the wire (the webhook-manager
         role for topology 3); returns {"allowed", "message", "patched"}."""
+        from .codec import VERSION
         return self.schedule({
-            "v": 1, "op": "admit",
+            "v": VERSION, "op": "admit",
             "review": {"kind": kind, "operation": operation, "object": obj,
                        "old": old, "context": context or {}}})
 

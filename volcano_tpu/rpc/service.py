@@ -58,6 +58,13 @@ class SchedulerService:
         with self._cycle_lock:
             return self._schedule_locked(snapshot_msg)
 
+    def reload_conf(self, conf_text: Optional[str]) -> None:
+        """Swap the scheduler conf between cycles (the sidecar's
+        filewatcher hot-reload — scheduler.go:112-170 analogue)."""
+        conf = parse_scheduler_conf(conf_text)
+        with self._cycle_lock:
+            self.conf = conf
+
     def _schedule_locked(self, snapshot_msg: dict) -> dict:
         nodes, jobs, queues = decode_snapshot(snapshot_msg)
         binder = RecordingBinder()
