@@ -730,3 +730,227 @@ class TestDeployArtifacts:
         import pytest
         with pytest.raises(SystemExit):
             cmd_mod.snapshot_rpc_main(["--help"])
+
+
+class TestJobErrorHandlingMatrix:
+    """The reference's failure-path scenario table
+    (test/e2e/jobseq/job_error_handling.go): pod fail/evict/complete x
+    RestartJob/AbortJob/TerminateJob/CompleteJob at both job and task
+    level, plus the unschedulable->JobUnknown path."""
+
+    def _run_job(self, policies=None, task_policies=None, replicas=2,
+                 name="ej"):
+        sys = make_system()
+        job = Job(
+            metadata=ObjectMeta(name=name),
+            spec=JobSpec(
+                tasks=[TaskSpec(name="worker", replicas=replicas,
+                                template=PodTemplate(
+                                    resources=Resource(1000, 1 << 30)),
+                                policies=task_policies or [])],
+                policies=policies or []))
+        sys.store.create(job)
+        sys.schedule_once()
+        sys.schedule_once()
+        pods = sys.store.list("Pod")
+        assert pods and all(p.status.phase == "Running" for p in pods), \
+            [p.status.phase for p in pods]
+        return sys
+
+    def _job(self, sys, name="ej"):
+        return sys.store.get("Job", "default", name)
+
+    # --- job-level: PodFailed x three actions ---------------------------
+
+    def test_podfailed_restart_job(self):
+        sys = self._run_job([LifecyclePolicy(event=BusEvent.POD_FAILED,
+                                             action=BusAction.RESTART_JOB)])
+        pod = sys.store.list("Pod")[0]
+        sys.store.finish_pod("default", pod.metadata.name, succeeded=False)
+        job = self._job(sys)
+        assert job.status.retry_count == 1
+        for _ in range(3):
+            sys.schedule_once()
+        job = self._job(sys)
+        assert job.status.state == JobPhase.RUNNING
+        assert all(p.status.phase == "Running"
+                   for p in sys.store.list("Pod"))
+
+    def test_podfailed_terminate_job(self):
+        sys = self._run_job([LifecyclePolicy(event=BusEvent.POD_FAILED,
+                                             action=BusAction.TERMINATE_JOB)])
+        pod = sys.store.list("Pod")[0]
+        sys.store.finish_pod("default", pod.metadata.name, succeeded=False)
+        sys.schedule_once()
+        job = self._job(sys)
+        assert job.status.state in (JobPhase.TERMINATING,
+                                    JobPhase.TERMINATED)
+        assert not any(p.status.phase == "Running"
+                       for p in sys.store.list("Pod"))
+
+    def test_podfailed_abort_job(self):
+        sys = self._run_job([LifecyclePolicy(event=BusEvent.POD_FAILED,
+                                             action=BusAction.ABORT_JOB)])
+        pod = sys.store.list("Pod")[0]
+        sys.store.finish_pod("default", pod.metadata.name, succeeded=False)
+        sys.schedule_once()
+        job = self._job(sys)
+        assert job.status.state in (JobPhase.ABORTING, JobPhase.ABORTED)
+
+    # --- job-level: PodEvicted x three actions --------------------------
+
+    def test_podevicted_restart_job(self):
+        sys = self._run_job([LifecyclePolicy(event=BusEvent.POD_EVICTED,
+                                             action=BusAction.RESTART_JOB)])
+        pod = sys.store.list("Pod")[0]
+        sys.store.evict_pod("default", pod.metadata.name, "preempt")
+        job = self._job(sys)
+        assert job.status.retry_count == 1
+        for _ in range(3):
+            sys.schedule_once()
+        assert self._job(sys).status.state == JobPhase.RUNNING
+
+    def test_podevicted_terminate_job(self):
+        sys = self._run_job([LifecyclePolicy(event=BusEvent.POD_EVICTED,
+                                             action=BusAction.TERMINATE_JOB)])
+        pod = sys.store.list("Pod")[0]
+        sys.store.evict_pod("default", pod.metadata.name, "preempt")
+        sys.schedule_once()
+        assert self._job(sys).status.state in (JobPhase.TERMINATING,
+                                               JobPhase.TERMINATED)
+
+    def test_podevicted_abort_job(self):
+        sys = self._run_job([LifecyclePolicy(event=BusEvent.POD_EVICTED,
+                                             action=BusAction.ABORT_JOB)])
+        pod = sys.store.list("Pod")[0]
+        sys.store.evict_pod("default", pod.metadata.name, "preempt")
+        sys.schedule_once()
+        assert self._job(sys).status.state in (JobPhase.ABORTING,
+                                               JobPhase.ABORTED)
+
+    # --- job-level: Any / TaskCompleted / exit codes --------------------
+
+    def test_any_event_restart_job(self):
+        sys = self._run_job([LifecyclePolicy(event=BusEvent.ANY,
+                                             action=BusAction.RESTART_JOB)])
+        pod = sys.store.list("Pod")[0]
+        sys.store.evict_pod("default", pod.metadata.name, "node drained")
+        assert self._job(sys).status.retry_count == 1
+
+    def test_taskcompleted_complete_job(self):
+        sys = self._run_job([LifecyclePolicy(
+            event=BusEvent.TASK_COMPLETED, action=BusAction.COMPLETE_JOB)])
+        for pod in list(sys.store.list("Pod")):
+            sys.store.finish_pod("default", pod.metadata.name,
+                                 succeeded=True)
+        sys.schedule_once()
+        assert self._job(sys).status.state in (JobPhase.COMPLETING,
+                                               JobPhase.COMPLETED)
+
+    def test_exit_code_restart_job(self):
+        sys = self._run_job([LifecyclePolicy(exit_code=3,
+                                             action=BusAction.RESTART_JOB)])
+        pods = sys.store.list("Pod")
+        # exit code 1 does not match the policy -> no restart
+        sys.store.finish_pod("default", pods[0].metadata.name,
+                             succeeded=False, exit_code=1)
+        assert self._job(sys).status.retry_count == 0
+        # exit code 3 does
+        sys.store.finish_pod("default", pods[1].metadata.name,
+                             succeeded=False, exit_code=3)
+        assert self._job(sys).status.retry_count == 1
+
+    def test_event_list_either_fires(self):
+        """The reference's Events-list policy: either PodEvicted or
+        PodFailed triggers TerminateJob (modeled as two policies)."""
+        policies = [LifecyclePolicy(event=BusEvent.POD_EVICTED,
+                                    action=BusAction.TERMINATE_JOB),
+                    LifecyclePolicy(event=BusEvent.POD_FAILED,
+                                    action=BusAction.TERMINATE_JOB)]
+        sys = self._run_job(policies)
+        pod = sys.store.list("Pod")[0]
+        sys.store.finish_pod("default", pod.metadata.name, succeeded=False)
+        assert self._job(sys).status.state in (JobPhase.TERMINATING,
+                                               JobPhase.TERMINATED)
+        sys = self._run_job(policies)
+        pod = sys.store.list("Pod")[0]
+        sys.store.evict_pod("default", pod.metadata.name, "preempt")
+        assert self._job(sys).status.state in (JobPhase.TERMINATING,
+                                               JobPhase.TERMINATED)
+
+    # --- task-level policies --------------------------------------------
+
+    def test_task_level_podfailed_restart(self):
+        sys = self._run_job(task_policies=[LifecyclePolicy(
+            event=BusEvent.POD_FAILED, action=BusAction.RESTART_JOB)])
+        pod = sys.store.list("Pod")[0]
+        sys.store.finish_pod("default", pod.metadata.name, succeeded=False)
+        assert self._job(sys).status.retry_count == 1
+
+    def test_task_level_podevicted_terminate(self):
+        sys = self._run_job(task_policies=[LifecyclePolicy(
+            event=BusEvent.POD_EVICTED, action=BusAction.TERMINATE_JOB)])
+        pod = sys.store.list("Pod")[0]
+        sys.store.evict_pod("default", pod.metadata.name, "preempt")
+        assert self._job(sys).status.state in (JobPhase.TERMINATING,
+                                               JobPhase.TERMINATED)
+
+    def test_task_level_taskcompleted_complete(self):
+        sys = self._run_job(task_policies=[LifecyclePolicy(
+            event=BusEvent.TASK_COMPLETED, action=BusAction.COMPLETE_JOB)])
+        for pod in list(sys.store.list("Pod")):
+            sys.store.finish_pod("default", pod.metadata.name,
+                                 succeeded=True)
+        sys.schedule_once()
+        assert self._job(sys).status.state in (JobPhase.COMPLETING,
+                                               JobPhase.COMPLETED)
+
+    def test_task_policy_overrides_job_policy(self):
+        """job: PodFailed->AbortJob; task: PodFailed->RestartJob — the
+        task-level policy wins (job_controller_util.go:170-200)."""
+        sys = self._run_job(
+            policies=[LifecyclePolicy(event=BusEvent.POD_FAILED,
+                                      action=BusAction.ABORT_JOB)],
+            task_policies=[LifecyclePolicy(event=BusEvent.POD_FAILED,
+                                           action=BusAction.RESTART_JOB)])
+        pod = sys.store.list("Pod")[0]
+        sys.store.finish_pod("default", pod.metadata.name, succeeded=False)
+        job = self._job(sys)
+        assert job.status.retry_count == 1
+        assert job.status.state not in (JobPhase.ABORTING, JobPhase.ABORTED)
+
+    # --- unschedulable -> JobUnknown ------------------------------------
+
+    def test_unschedulable_running_job_fires_job_unknown(self):
+        """A running gang whose evicted members cannot reschedule turns the
+        PodGroup Unknown (session.go:176-214), which raises JobUnknown
+        against the job's policies (job_controller_handler.go:405-433)."""
+        sys = make_system()
+        job = Job(
+            metadata=ObjectMeta(name="unsched"),
+            spec=JobSpec(
+                min_available=2,
+                tasks=[TaskSpec(name="w", replicas=2,
+                                template=PodTemplate(
+                                    resources=Resource(6000, 8 << 30)))],
+                policies=[LifecyclePolicy(event=BusEvent.JOB_UNKNOWN,
+                                          action=BusAction.RESTART_JOB)]))
+        sys.store.create(job)
+        for _ in range(3):
+            sys.schedule_once()
+        running = [p for p in sys.store.list("Pod")
+                   if p.metadata.name.startswith("unsched")
+                   and p.status.phase == "Running"]
+        assert len(running) == 2, [p.status.phase
+                                   for p in sys.store.list("Pod")]
+        # cordon every node (the reference taints them), then evict one
+        # member: the replacement cannot schedule while the other keeps
+        # running -> gang split -> Unknown -> RestartJob
+        for node in sys.cache.nodes.values():
+            node.unschedulable = True
+        sys.store.evict_pod("default", running[0].metadata.name, "drain")
+        before = sys.store.get("Job", "default", "unsched").status.retry_count
+        for _ in range(4):
+            sys.schedule_once()
+        job = sys.store.get("Job", "default", "unsched")
+        assert job.status.retry_count > before, job.status.state

@@ -57,6 +57,9 @@ class JobController(Controller):
         self._in_execute: set = set()
         # jobs with churned pods awaiting a coalesced sync (the workqueue)
         self._dirty: set = set()
+        # last observed PodGroup phase per job, for Unknown-transition
+        # detection (status writes mutate in place, so watch `old` lies)
+        self._pg_phases: dict = {}
 
     # -- wiring -------------------------------------------------------------
 
@@ -165,6 +168,10 @@ class JobController(Controller):
         (job_controller_actions.go:263-280 syncTask gate). Status writes
         mutate in place, so `old` cannot be trusted for transition
         detection; the sync is idempotent (desired-vs-existing pod diff)."""
+        if event == DELETED:
+            self._pg_phases.pop((pg.metadata.namespace, pg.metadata.name),
+                                None)
+            return
         if event != UPDATED:
             return
         if pg.status.phase == PodGroupPhase.PENDING:
@@ -172,6 +179,20 @@ class JobController(Controller):
         job = self.store.get("Job", pg.metadata.namespace, pg.metadata.name)
         if job is None:
             return
+        # a PodGroup turning Unknown (running members + a fresh
+        # Unschedulable condition: the gang split) raises the JobUnknown
+        # bus event against the job's lifecycle policies
+        # (job_controller_handler.go:405-433); transition-tracked here
+        # because status writes mutate in place
+        key = (pg.metadata.namespace, pg.metadata.name)
+        prev_phase = self._pg_phases.get(key)
+        self._pg_phases[key] = pg.status.phase
+        if (pg.status.phase == PodGroupPhase.UNKNOWN
+                and prev_phase != PodGroupPhase.UNKNOWN):
+            action = self._unknown_policy_action(job)
+            if action != BusAction.SYNC_JOB:
+                self._execute(job, action)
+                return
         # only sync when pods are actually missing — sync_job itself writes
         # the PodGroup status, so an unconditional trigger would recurse
         desired = sum(t.replicas for t in job.spec.tasks)
@@ -181,6 +202,12 @@ class JobController(Controller):
             == job.metadata.name)
         if existing < desired:
             self._execute(job, BusAction.SYNC_JOB)
+
+    def _unknown_policy_action(self, job: Job) -> BusAction:
+        for policy in job.spec.policies:
+            if policy.event in (BusEvent.JOB_UNKNOWN, BusEvent.ANY):
+                return policy.action
+        return BusAction.SYNC_JOB
 
     def _on_pvc(self, event: str, pvc, old) -> None:
         """A job waiting on a referenced-but-missing PVC re-syncs when it

@@ -14,13 +14,29 @@ def job_terminated(job) -> bool:
                for t in job.tasks.values()) and bool(job.tasks)
 
 
-def _phase_for(job) -> PodGroupPhase:
+def _phase_for(job, ssn_uid: str) -> PodGroupPhase:
+    """jobStatus (session.go:176-214): running tasks + an Unschedulable
+    condition from THIS session -> Unknown (the split-gang signal the job
+    controller turns into a JobUnknown event); else enough allocated (or
+    succeeded) members -> Running; else non-Inqueue groups fall back to
+    Pending."""
     if job.podgroup.phase == PodGroupPhase.PENDING:
         return PodGroupPhase.PENDING
+    unschedulable = any(
+        c.get("type") == "Unschedulable" and c.get("status") == "True"
+        and c.get("transitionID") == ssn_uid
+        for c in job.podgroup.conditions)
     running = sum(1 for t in job.tasks.values()
-                  if t.status == TaskStatus.RUNNING or allocated_status(t.status))
-    if running >= job.min_available and job.min_available > 0:
+                  if t.status == TaskStatus.RUNNING)
+    if running and unschedulable:
+        return PodGroupPhase.UNKNOWN
+    allocated = sum(1 for t in job.tasks.values()
+                    if allocated_status(t.status)
+                    or t.status == TaskStatus.SUCCEEDED)
+    if allocated >= job.min_available and job.min_available > 0:
         return PodGroupPhase.RUNNING
+    if job.podgroup.phase != PodGroupPhase.INQUEUE:
+        return PodGroupPhase.PENDING
     return job.podgroup.phase
 
 
@@ -33,7 +49,7 @@ def update_all(ssn) -> None:
                         if t.status == TaskStatus.SUCCEEDED)
         failed = sum(1 for t in job.tasks.values()
                      if t.status == TaskStatus.FAILED)
-        new_phase = _phase_for(job)
+        new_phase = _phase_for(job, ssn.uid)
         changed = (pg.running != running or pg.succeeded != succeeded
                    or pg.failed != failed or pg.phase != new_phase
                    or pg.conditions_dirty)
