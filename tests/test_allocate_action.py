@@ -363,3 +363,33 @@ class TestNodePorts:
         ssn = run_allocate(cache, "callbacks")
         errs = ssn.jobs["web"].nodes_fit_errors.get("web-0")
         assert errs is not None and NODE_PORTS_FAILED in errs.error()
+
+
+class TestParallelCallbacksEngine:
+    """callbacks-parallel (the scheduler_helper.go:121 16-way mirror) must
+    make bit-identical decisions to the serial callbacks engine — it is
+    the benchmark's CPU comparator at the headline config."""
+
+    def test_node_level_parity_with_serial(self):
+        import random
+        rng = random.Random(11)
+        nodes = [build_node(f"n{i}", rng.choice([2000, 4000, 8000]),
+                            rng.choice([4000, 8000, 16000]))
+                 for i in range(10)]
+        jobs = []
+        for j in range(10):
+            k = rng.randint(1, 3)
+            reqs = [(rng.choice([500, 1000, 2000]),
+                     rng.choice([500, 1000, 2000]))] * k
+            jobs.append(build_job(f"job{j}", "default", k, reqs,
+                                  priority=rng.randint(0, 5)))
+        binds = {}
+        for engine in ("callbacks", "callbacks-parallel"):
+            cache, binder = build_cache(
+                [j.clone() for j in jobs],
+                [NodeInfo(name=n.name, allocatable=n.allocatable)
+                 for n in nodes])
+            run_allocate(cache, engine)
+            binds[engine] = dict(binder.binds)
+        # node-level (not just admission-level) parity
+        assert binds["callbacks"] == binds["callbacks-parallel"]

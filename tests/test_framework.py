@@ -141,3 +141,58 @@ class TestSessionDispatch:
             close_session(ssn)
         assert len(pg.conditions) == 1
         assert pg.conditions[0]["type"] == "Unschedulable"
+
+
+class TestReservationElection:
+    def test_target_job_longest_wait_by_schedule_start(self):
+        """reservation.go:66-117: among the highest-priority pending jobs
+        the elected target is the one waiting longest on its
+        ScheduleStartTimestamp (NOT its creation timestamp)."""
+        from volcano_tpu.actions import ElectAction
+        from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup,
+                                     PodGroupPhase, QueueInfo, Resource)
+        from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+        from volcano_tpu.framework import (PluginOption, Tier, close_session,
+                                           open_session)
+        from volcano_tpu.utils.reservation import Reservation
+        import volcano_tpu.plugins  # noqa: F401
+
+        Reservation.reset()
+        cache = SchedulerCache(binder=FakeBinder(), evictor=FakeEvictor())
+        cache.add_queue(QueueInfo(name="default", weight=1))
+        cache.add_node(NodeInfo(name="n0", allocatable=Resource(8000, 8000)))
+        jobs = {}
+        # young was CREATED first but entered scheduling last; old entered
+        # scheduling first -> old waits longer -> old is the target
+        for name, created, sched_start in (("young", 1.0, 300.0),
+                                           ("old", 2.0, 100.0)):
+            pg = PodGroup(name=name, queue="default", min_member=1,
+                          phase=PodGroupPhase.PENDING)
+            job = JobInfo(uid=name, name=name, queue="default",
+                          min_available=1, podgroup=pg, priority=5,
+                          creation_timestamp=created)
+            job.schedule_start_timestamp = sched_start
+            cache.add_job(job)
+            jobs[name] = job
+        # a higher-priority job trumps wait time
+        pg = PodGroup(name="vip", queue="default", min_member=1,
+                      phase=PodGroupPhase.PENDING)
+        vip = JobInfo(uid="vip", name="vip", queue="default",
+                      min_available=1, podgroup=pg, priority=9,
+                      creation_timestamp=3.0)
+        vip.schedule_start_timestamp = 400.0
+
+        tiers = [Tier(plugins=[PluginOption("reservation")])]
+        ssn = open_session(cache, tiers, [])
+        ElectAction().execute(ssn)
+        assert Reservation.target_job is not None
+        assert Reservation.target_job.uid == "old"
+        close_session(ssn)
+        Reservation.reset()
+
+        cache.add_job(vip)
+        ssn = open_session(cache, tiers, [])
+        ElectAction().execute(ssn)
+        assert Reservation.target_job.uid == "vip"
+        close_session(ssn)
+        Reservation.reset()

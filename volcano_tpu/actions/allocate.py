@@ -71,6 +71,15 @@ class AllocateAction(Action):
                 engine = conf.arguments.get("engine", engine)
         if engine == "callbacks":
             _execute_interleaved(ssn, _CallbackJobPlacer(ssn))
+        elif engine == "callbacks-parallel":
+            # scheduler_helper.go:121,157 16-way mirror — the honest CPU
+            # comparator at benchmark scale (callbacks_parallel.py)
+            from .callbacks_parallel import ParallelCallbackJobPlacer
+            placer = ParallelCallbackJobPlacer(ssn)
+            try:
+                _execute_interleaved(ssn, placer)
+            finally:
+                placer.close()
         elif engine == "tpu-strict":
             _execute_interleaved(ssn, _DeviceJobPlacer(ssn))
         elif engine in ("tpu-fused", "tpu-blocks", "tpu-scan", "tpu-pallas",
@@ -181,10 +190,17 @@ def _execute_interleaved(ssn, placer) -> None:
         stmt = ssn.statement()
         readded = placer.place(job, tasks, stmt, jobs)
 
+        ops = list(stmt.operations)
         if ssn.job_ready(job):
             stmt.commit()
+            committed = True
         elif not ssn.job_pipelined(job):
             stmt.discard()
+            committed = False
+        else:
+            committed = True               # kept open: pipelined gang
+        if hasattr(placer, "statement_closed"):
+            placer.statement_closed(job, committed, ops)
 
         namespaces.push(ns)
 

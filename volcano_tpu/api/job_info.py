@@ -174,6 +174,10 @@ class JobInfo:
         self.priority = priority
         self.min_available = min_available
         self.waiting_time: Optional[float] = None
+        # when the scheduler first saw this job (job_info.go:216
+        # ScheduleStartTimestamp) — the reservation plugin elects the
+        # longest-waiting job by it; stamped by the cache on add
+        self.schedule_start_timestamp: Optional[float] = None
 
         self.job_fit_errors = ""
         self.nodes_fit_errors: Dict[str, "FitErrors"] = {}
@@ -308,6 +312,7 @@ class JobInfo:
                       min_available=self.min_available, podgroup=self.podgroup,
                       creation_timestamp=self.creation_timestamp)
         job.waiting_time = self.waiting_time
+        job.schedule_start_timestamp = self.schedule_start_timestamp
         job.task_min_available = dict(self.task_min_available)
         job.task_min_available_total = self.task_min_available_total
         job.preemptable = self.preemptable

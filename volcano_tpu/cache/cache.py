@@ -109,6 +109,8 @@ class SchedulerCache:
 
     def add_job(self, job: JobInfo) -> None:
         with self._lock:
+            if job.schedule_start_timestamp is None:
+                job.schedule_start_timestamp = time.time()
             self.jobs[job.uid] = job
 
     def remove_job(self, uid: str) -> None:

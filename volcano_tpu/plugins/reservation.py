@@ -16,12 +16,18 @@ class ReservationPlugin(Plugin):
 
     def on_session_open(self, ssn) -> None:
         def target_job_fn(jobs):
+            """Highest priority, then the longest-waiting job by
+            ScheduleStartTimestamp (reservation.go:66-117 getTargetJob:
+            max now-minus-start = min start; ties keep the earlier
+            candidate in list order like the reference's strict > compare)."""
             if not jobs:
                 return None
             highest = max(j.priority for j in jobs)
             candidates = [j for j in jobs if j.priority == highest]
-            # longest waiting first
-            return min(candidates, key=lambda j: j.creation_timestamp)
+            return min(candidates,
+                       key=lambda j: (j.schedule_start_timestamp
+                                      if j.schedule_start_timestamp
+                                      is not None else j.creation_timestamp))
 
         ssn.add_target_job_fn(self.NAME, target_job_fn)
 
