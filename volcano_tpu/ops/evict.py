@@ -112,8 +112,8 @@ def _tier_eval(tier_kinds, masks_g, cand, dynamic_fn):
     dynamic_fn(cand_x) -> bool[n, W] dynamic verdict (drf share compare /
     proportion over-deserved) or None when the conf has no dynamic tier.
     Returns (elig bool[n, W], dyn_decided bool[n] — node was ruled by a
-    tier containing the dynamic plugin; feeds the free-fill expiry cap —
-    and dyn_extra, the dynamic plugin's side data: drf returns the victim
+    tier containing the dynamic plugin; feeds the fill expiry cap —
+    dyn_extra, the dynamic plugin's side data: drf returns the victim
     shares rs f32[n, W], else None).
     """
     n = cand.shape[0]
@@ -203,36 +203,78 @@ def _pop_until_fit(nw: EvictNW, best, elig_row, req, have, ok):
     return evicted, freed
 
 
-# free-fill horizon: a same-request run longer than this re-evaluates once
-# per KMAX placements (the [KMAX, R] fill vectors stay tiny)
+# fill horizon: a same-request run longer than this re-evaluates once
+# per KMAX placements (the [KMAX, W] fill matrices stay tiny)
 KMAX = 64
 
 
-def _fill_count(fidle_b, elig_row, rs_row, dyn_dec_b, req, jalloc_p,
-                total, run_left_i, quota_left, has_drf):
-    """Closed-form count of consecutive idle-only placements on one node
-    (the free-fill). Exact because a fill evicts nobody: static tier
-    counts are frozen, so the arbitration and the static eligible set
-    cannot change mid-fill; the only decay is drf expiry — the preemptor's
-    dominant share after m placements, ls_m, grows monotonically, and a
-    victim stays in the drf verdict while ls_m <= rs_v + delta — which
-    only caps the fill when the drf tier ruled the node (dyn_dec_b)."""
+def _fill_schedule(vreq_row, fidle_b, elig_row, rs_row, dyn_dec_b, req,
+                   jalloc_p, total, run_left_i, quota_left, has_drf):
+    """Closed-form schedule for a whole same-node run — WITH evictions.
+
+    Attempt m of a run places the m-th identical task on the node,
+    evicting the minimal row-order prefix of the eligible victims that
+    makes it fit (the serial pop-until-fit). Because evictions within the
+    run only remove row-order prefixes of a FIXED eligible set, the whole
+    schedule is closed-form: victim w (exclusive eligible-prefix capacity
+    ``cum_w``) is first wanted at
+
+        t_w = 1 + #{m: all_d(m*r_d < fidle_d + cum_w_d + EPS)}
+
+    and the run length k is the minimum of:
+      - k_cap: attempts for which even ALL eligible capacity fits the
+        cumulative demand;
+      - k_hv: attempts with >=1 eligible unevicted victim at their start
+        (has_victim; drf-ruled nodes also drop victims whose share expires
+        at m_v, from the monotone ls_m = share(jalloc_p + m*req));
+      - k_exp (drf-ruled): the first expiry of an UNEVICTED victim — from
+        there the eligible prefix shifts and the schedule is stale;
+      - the quota and same-request run length.
+
+    A tier-flip cap is NOT needed: every eligible victim is a member of
+    every participating mask of the deciding tier (tset = cand & all
+    masks), so a participating mask can only drain after the last
+    eligible victim is gone — at which point k_hv has already ended the
+    run. Everything after attempt k re-evaluates serially, so truncation
+    only costs speed, never exactness. Returns (k i32, evicted bool[W],
+    t_w i32[W], K+1 = never wanted)."""
     K = KMAX
-    m_vec = (jnp.arange(1, K + 1, dtype=req.dtype)[:, None]
-             * req[None, :])                                  # [K, R]
-    idle_ok = jnp.all(m_vec < fidle_b[None, :] + EPS, axis=-1)
-    k_idle = jnp.sum(idle_ok.astype(jnp.int32))
+    fdtype = req.dtype
+    elig_f = elig_row[:, None].astype(fdtype)
+    masked = vreq_row * elig_f
+    cum_excl = jnp.cumsum(masked, axis=0) - masked           # [W, R]
+    cum_total = jnp.sum(masked, axis=0)                      # [R]
+    m_req = (jnp.arange(1, K + 1, dtype=fdtype)[:, None]
+             * req[None, :])                                 # [K, R]
+    m_idx = jnp.arange(1, K + 1, dtype=jnp.int32)
+    fit_kw = jnp.all(m_req[:, None, :] < fidle_b[None, None, :]
+                     + cum_excl[None, :, :] + EPS, axis=-1)  # [K, W]
+    t_w = (1 + jnp.sum(fit_kw.astype(jnp.int32), axis=0))    # [W]
+    k_cap = jnp.sum(jnp.all(m_req < fidle_b[None, :] + cum_total[None, :]
+                            + EPS, axis=-1).astype(jnp.int32))
+
+    unevicted_km = elig_row[None, :] & (t_w[None, :] >= m_idx[:, None])
     if has_drf:
-        ls_vec = _share(jalloc_p[None, :] + m_vec, total)     # [K]
+        ls_vec = _share(jalloc_p[None, :] + m_req, total)    # [K]
         m_v = jnp.sum((ls_vec[:, None] <= rs_row[None, :] + SHARE_DELTA)
-                      .astype(jnp.int32), axis=0)             # [W]
-        k_hv = jnp.max(jnp.where(elig_row, m_v, 0))
-        k_hv = jnp.where(dyn_dec_b, k_hv, K)
+                      .astype(jnp.int32), axis=0)            # [W]
+        k_exp = jnp.min(jnp.where(elig_row & (m_v < t_w), m_v, K))
+        k_exp = jnp.where(dyn_dec_b, k_exp, K).astype(jnp.int32)
+        hv_dyn = jnp.sum((unevicted_km
+                          & (m_v[None, :] >= m_idx[:, None]))
+                         .astype(jnp.int32), axis=1) > 0
+        hv_static = jnp.sum(unevicted_km.astype(jnp.int32), axis=1) > 0
+        hv_ok = jnp.where(dyn_dec_b, hv_dyn, hv_static)      # [K]
     else:
-        k_hv = jnp.asarray(K, jnp.int32)
-    k = jnp.minimum(jnp.minimum(k_idle, k_hv),
-                    jnp.minimum(run_left_i, quota_left))
-    return jnp.maximum(k, 0).astype(jnp.int32)
+        k_exp = jnp.asarray(K, jnp.int32)
+        hv_ok = jnp.sum(unevicted_km.astype(jnp.int32), axis=1) > 0
+    k_hv = jnp.sum(jnp.cumprod(hv_ok.astype(jnp.int32)))
+
+    k = jnp.minimum(jnp.minimum(k_cap, k_hv), k_exp)
+    k = jnp.minimum(k, jnp.minimum(run_left_i, quota_left))
+    k = jnp.clip(k, 0, K).astype(jnp.int32)
+    evicted = elig_row & (t_w <= k)
+    return k, evicted, t_w
 
 
 @functools.lru_cache(maxsize=16)
@@ -338,12 +380,12 @@ def build_preempt_scan(tier_kinds: Tuple[str, ...],
                     row = jnp.where(fits, score[p_ix], -jnp.inf)
                     best = jnp.argmax(row).astype(jnp.int32)
                     found = row[best] > -jnp.inf
-                    k = _fill_count(
-                        c.fidle[best], elig[best],
+                    k, evicted, t_w = _fill_schedule(
+                        nw.vreq[best], c.fidle[best], elig[best],
                         rs[best] if has_drf else None,
                         dyn_dec[best], req, c.jalloc[pjg_i], total,
                         run_left_i, quota_left, has_drf)
-                    return best, found, elig[best], k
+                    return best, found, k, evicted, t_w
 
                 def cheap_attempt():
                     # node-local re-evaluation on the previous node (exact
@@ -370,38 +412,38 @@ def build_preempt_scan(tier_kinds: Tuple[str, ...],
                                      + EPS) & jnp.any(elig_b)
 
                     def keep_node():
-                        k = _fill_count(
-                            c.fidle[b0], elig_b,
+                        k, evicted, t_w = _fill_schedule(
+                            nw.vreq[b0], c.fidle[b0], elig_b,
                             rs_b[0] if has_drf else None,
                             dyn_dec_b[0], req, c.jalloc[pjg_i], total,
                             run_left_i, quota_left, has_drf)
-                        return b0, jnp.ones((), bool), elig_b, k
+                        return b0, jnp.ones((), bool), k, evicted, t_w
                     return jax.lax.cond(fits_b, keep_node, full_eval)
 
                 def failed_eval():
                     return (jnp.zeros((), jnp.int32), jnp.zeros((), bool),
-                            jnp.zeros(W, bool), jnp.zeros((), jnp.int32))
+                            jnp.zeros((), jnp.int32), jnp.zeros(W, bool),
+                            jnp.zeros(W, jnp.int32))
 
                 try_cheap = (jnp.asarray(allow_cheap) & same_prev_i
                              & c.prev_ok)
                 skip_fail = same_prev_i & c.prev_fail & ~c.prev_ok
-                best, found, elig_row, k = jax.lax.cond(
+                best, found, k, evicted, t_w = jax.lax.cond(
                     skip_fail, failed_eval,
                     lambda: jax.lax.cond(try_cheap, cheap_attempt,
                                          full_eval))
                 if not allow_cheap:
-                    # the free-fill shares the same exactness precondition
-                    # as the same-node shortcut (dynamic tier last): a
-                    # mid-stack dynamic tier could drain mid-fill and hand
-                    # another node to a lower tier, growing its verdict
+                    # multi-placement fills share the same exactness
+                    # precondition as the same-node shortcut (dynamic tier
+                    # last): a mid-stack dynamic tier could drain mid-fill
+                    # and hand another node to a lower tier
                     k = jnp.minimum(k, 1)
                 ok = found & ~skip_fail
-                fill = ok & (k >= 1)
+                k = jnp.where(ok, jnp.maximum(k, 1), 0)
+                evicted = evicted & (t_w <= k) & ok
 
                 def apply_evictions(carry):
                     alive, owner, jalloc = carry
-                    evicted, freed = _pop_until_fit(
-                        nw, best, elig_row, req, c.fidle[best], ok)
                     vjob_row = nw.vgroup[best]                # [W]
                     AJ1 = jalloc.shape[0]
                     job_onehot = jax.nn.one_hot(vjob_row, AJ1,
@@ -409,29 +451,31 @@ def build_preempt_scan(tier_kinds: Tuple[str, ...],
                     jalloc = jalloc - job_onehot.T @ (
                         nw.vreq[best] * evicted[:, None].astype(fdtype))
                     alive = alive.at[best].set(alive[best] & ~evicted)
+                    # victims belong to the run step of the attempt that
+                    # wanted them — the replay groups evictions per task
                     owner = owner.at[best].set(
-                        jnp.where(evicted, p_ix, owner[best]))
+                        jnp.where(evicted, p_ix + t_w - 1, owner[best]))
+                    freed = jnp.sum(
+                        nw.vreq[best] * evicted[:, None].astype(fdtype),
+                        axis=0)
                     return (alive, owner, jalloc), freed
 
                 (alive, owner, jalloc), freed = jax.lax.cond(
-                    ok & ~fill, apply_evictions,
+                    jnp.any(evicted), apply_evictions,
                     lambda carry: (carry, jnp.zeros(R, fdtype)),
                     (c.alive, c.owner, c.jalloc))
-                placed = jnp.where(fill, k, ok.astype(jnp.int32)) \
-                    .astype(fdtype)
-                delta = (freed - req * placed) * ok.astype(fdtype)
-                jalloc = jalloc.at[pjg_i].add(req * placed
-                                              * ok.astype(fdtype))
+                placed = k.astype(fdtype)
+                delta = freed - req * placed
+                jalloc = jalloc.at[pjg_i].add(req * placed)
                 c = c._replace(
                     fidle=c.fidle.at[best].add(delta),
                     alive=alive,
                     jalloc=jalloc,
                     owner=owner,
-                    pipe_cnt=c.pipe_cnt.at[pj].add(
-                        jnp.where(ok, placed.astype(jnp.int32), 0)),
+                    pipe_cnt=c.pipe_cnt.at[pj].add(k),
                     stopped=c.stopped.at[pj].set(c.stopped[pj] | ~ok),
                     prev_node=best, prev_ok=ok, prev_fail=~ok,
-                    countdown=jnp.where(fill, k - 1, 0))
+                    countdown=jnp.where(ok, k - 1, 0))
                 out_node = jnp.where(ok, best, NO_NODE).astype(jnp.int32)
                 return c, out_node
 
