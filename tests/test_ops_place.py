@@ -199,7 +199,7 @@ class TestPlaceBlocks:
         jobs = JobMeta(min_available=jnp.array([3]),
                        base_ready=jnp.array([0]),
                        base_pipelined=jnp.array([0]))
-        assign, ready, _ = place_blocks(nodes, tasks, jobs, default_weights(R),
+        assign, _, ready, _, _ = place_blocks(nodes, tasks, jobs, default_weights(R),
                                         jnp.asarray(alloc, jnp.float32),
                                         jnp.full(2, 100, jnp.int32), chunk=4)
         assert bool(ready[0])
@@ -217,7 +217,7 @@ class TestPlaceBlocks:
         jobs = JobMeta(min_available=jnp.array([2, 1]),
                        base_ready=jnp.array([0, 0]),
                        base_pipelined=jnp.array([0, 0]))
-        assign, ready, _ = place_blocks(nodes, tasks, jobs, default_weights(R),
+        assign, _, ready, _, _ = place_blocks(nodes, tasks, jobs, default_weights(R),
                                         jnp.asarray(alloc, jnp.float32),
                                         jnp.full(1, 100, jnp.int32), chunk=2)
         assert not bool(ready[0]) and bool(ready[1])
@@ -232,7 +232,7 @@ class TestPlaceBlocks:
         jobs = JobMeta(min_available=jnp.ones(4, jnp.int32),
                        base_ready=jnp.zeros(4, jnp.int32),
                        base_pipelined=jnp.zeros(4, jnp.int32))
-        assign, ready, nodes_out = place_blocks(
+        assign, _, ready, _, nodes_out = place_blocks(
             nodes, tasks, jobs, default_weights(R),
             jnp.asarray(alloc, jnp.float32), jnp.full(1, 100, jnp.int32),
             chunk=4, sweeps=1)

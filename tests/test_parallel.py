@@ -41,7 +41,7 @@ def test_sharded_matches_single_device_admissions():
                     valid=jnp.ones(T, bool),
                     feas=jnp.ones((T, N), bool),
                     static_score=jnp.zeros((T, N), jnp.float32))
-    assign1, ready1, _ = place_blocks(nodes, bt, jobs, w, jnp.asarray(alloc),
+    assign1, _, ready1, _, _ = place_blocks(nodes, bt, jobs, w, jnp.asarray(alloc),
                                       max_tasks, chunk=16)
 
     mesh = make_mesh()
@@ -169,7 +169,7 @@ def test_sharded_pipelines_onto_releasing_capacity():
 
     conf = parse_scheduler_conf(None)
     results = {}
-    for engine in ("tpu-fused", "tpu-sharded"):
+    for engine in ("tpu-fused", "tpu-sharded", "tpu-blocks"):
         cache, binder = build()
         ssn = open_session(cache, conf.tiers, [])
         AllocateAction(engine=engine).execute(ssn)
@@ -181,6 +181,7 @@ def test_sharded_pipelines_onto_releasing_capacity():
         results[engine] = (admitted, len(binder.binds), piped)
     fused, sharded = results["tpu-fused"], results["tpu-sharded"]
     assert sharded == fused, results
+    assert results["tpu-blocks"] == fused, results
     # all 8 gangs survive: 7 bind onto idle capacity and exactly one rides
     # the releasing node as a PIPELINED task (kept, not bound). Which gang
     # pipelines is a scoring choice (binpack prefers the fuller node) —

@@ -628,13 +628,13 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
         bt = BlockTasks(req=jnp.asarray(req), job_ix=jnp.asarray(job_ix_np),
                         valid=jnp.ones(T, bool), feas=feas_b,
                         static_score=static_b)
-        assign, ready, _ = _fused_blocks_solver()(
+        assign, pipe, ready, kept, _ = _fused_blocks_solver()(
             node_t.node_state(), bt, jobs_meta, weights,
             jnp.asarray(node_t.allocatable), jnp.asarray(node_t.max_tasks))
         task_node = np.asarray(assign)
-        pipelined = np.zeros(T, bool)
+        pipelined = np.asarray(pipe, bool)
         job_ready = np.asarray(ready)
-        job_kept = job_ready
+        job_kept = np.asarray(kept)
     else:
         pt = PlacementTasks(
             req=jnp.asarray(np.pad(req, ((0, pad), (0, 0)))),

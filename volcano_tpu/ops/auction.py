@@ -75,8 +75,11 @@ def _chunk_step(allocatable, max_tasks, weights):
         K = min(K_CAND, N)
 
         pods_ok = nodes.ntasks < max_tasks                       # [N]
-        fit = (jnp.all(req[:, None, :] < nodes.idle[None] + EPS, axis=-1)
-               & feas & pods_ok[None])                            # [C,N]
+        # bids are FutureIdle-based (allocate.go:232-256): a task that does
+        # not fit Idle may pipeline onto releasing capacity; alloc-vs-pipe
+        # is split per accepted task below
+        fit = (jnp.all(req[:, None, :] < nodes.future_idle[None] + EPS,
+                       axis=-1) & feas & pods_ok[None])           # [C,N]
         score = static_score + combined_dynamic_score(
             req, nodes.used, allocatable, weights)                # [C,N]
         masked = jnp.where(fit, score, -jnp.inf)
@@ -96,7 +99,7 @@ def _chunk_step(allocatable, max_tasks, weights):
                            * accept[:, None])
             claimed = jnp.einsum("cn,cr->nr", claimed_hot, req)
             claimed_cnt = jnp.sum(claimed_hot, axis=0)
-            avail_bid = nodes.idle[bid] - claimed[bid]
+            avail_bid = nodes.future_idle[bid] - claimed[bid]
             base_cnt = nodes.ntasks[bid] + claimed_cnt[bid]
             acc = _round_contention(req, bid, bidding, avail_bid, base_cnt,
                                     max_tasks[bid])
@@ -113,14 +116,35 @@ def _chunk_step(allocatable, max_tasks, weights):
             0, K, round_body, (accept0, choice0, slot0))
 
         placed = jax.nn.one_hot(choice, N, dtype=req.dtype) * accept[:, None]
-        delta = jnp.einsum("cn,cr->nr", placed, req)
+
+        # alloc-vs-pipeline split (same construction as parallel/mesh.py):
+        # a task allocates iff it fits Idle after the IDLE consumption of
+        # earlier-in-chunk same-node allocs; iterate the antitone fit map —
+        # an ODD iterate under-approximates the true greedy alloc set, so
+        # deep same-node ties fall safely to pipeline and Idle can never
+        # be oversubscribed (exact for up to 9 same-node contenders)
+        C_lower = jnp.arange(C)[:, None] > jnp.arange(C)[None, :]
+        same_node = (choice[:, None] == choice[None, :]) \
+            & accept[:, None] & accept[None, :] & C_lower
+        idle_bid = nodes.idle[choice]
+
+        def alloc_iter(_, alloc):
+            cum = (same_node * alloc[None, :].astype(req.dtype)) @ req
+            return accept & jnp.all(req + cum < idle_bid + EPS, axis=-1)
+
+        alloc = jax.lax.fori_loop(0, 9, alloc_iter, accept)
+        pipe = accept & ~alloc
+
+        alloc_hot = placed * alloc[:, None].astype(req.dtype)
+        delta_alloc = jnp.einsum("cn,cr->nr", alloc_hot, req)
+        delta_all = jnp.einsum("cn,cr->nr", placed, req)
         nodes = NodeState(
-            idle=nodes.idle - delta,
-            future_idle=nodes.future_idle - delta,
-            used=nodes.used + delta,
+            idle=nodes.idle - delta_alloc,
+            future_idle=nodes.future_idle - delta_all,
+            used=nodes.used + delta_alloc,
             ntasks=nodes.ntasks + jnp.sum(placed, axis=0).astype(jnp.int32))
         out = jnp.where(accept, choice, NO_NODE).astype(jnp.int32)
-        return nodes, out
+        return nodes, (out, pipe)
 
     return step
 
@@ -129,8 +153,10 @@ def place_blocks(nodes: NodeState, tasks: BlockTasks, jobs: JobMeta,
                  weights: ScoreWeights, allocatable: jnp.ndarray,
                  max_tasks: jnp.ndarray, chunk: int = 256,
                  sweeps: int = 3, passes: int = 3,
-                 ) -> Tuple[jnp.ndarray, jnp.ndarray, NodeState]:
-    """Place tasks; returns (task_node i32[T], job_ready bool[J], nodes).
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                            jnp.ndarray, NodeState]:
+    """Place tasks; returns (task_node i32[T], task_pipelined bool[T],
+    job_ready bool[J], job_kept bool[J], nodes).
 
     Each sweep runs ``passes`` placement passes — a task rejected in pass k
     (its chosen node filled up inside the chunk) retries against updated node
@@ -154,45 +180,54 @@ def place_blocks(nodes: NodeState, tasks: BlockTasks, jobs: JobMeta,
 
     J = jobs.min_available.shape[0]
     assign = jnp.full(Tp, NO_NODE, dtype=jnp.int32)
+    pipe0 = jnp.zeros(Tp, dtype=bool)
 
     def place_pass(carry, _):
-        nodes, assign, job_dead = carry
+        nodes, assign, pipe, job_dead = carry
         todo = (assign == NO_NODE) & tasks.valid & ~job_dead[tasks.job_ix]
         xs = (reshape(tasks.req), reshape(tasks.job_ix), reshape(todo),
               reshape(tasks.feas), reshape(tasks.static_score))
-        nodes, out = jax.lax.scan(
+        nodes, (out, out_pipe) = jax.lax.scan(
             _chunk_step(allocatable, max_tasks, weights), nodes, xs)
-        assign = jnp.where(assign == NO_NODE, out.reshape(Tp), assign)
-        return (nodes, assign, job_dead), None
+        fresh = assign == NO_NODE
+        assign = jnp.where(fresh, out.reshape(Tp), assign)
+        pipe = jnp.where(fresh, out_pipe.reshape(Tp), pipe)
+        return (nodes, assign, pipe, job_dead), None
 
     def sweep(carry, _):
-        (nodes, new_assign, job_dead), _ = jax.lax.scan(
+        (nodes, new_assign, pipe, job_dead), _ = jax.lax.scan(
             place_pass, carry, jnp.arange(passes))
 
-        # Gang check + vectorized rollback of non-admitted jobs (batched
-        # Statement.Discard). A rolled-back job does not retry in later
-        # sweeps — the reference pops each job once and discards for good
-        # (allocate.go:264-270).
+        # Gang votes + vectorized rollback of non-kept jobs (batched
+        # Statement.Discard): ready counts allocations only; a
+        # merely-pipelined gang is KEPT open (allocate.go:264-270). A
+        # rolled-back job does not retry in later sweeps — the reference
+        # pops each job once and discards for good.
         placed = new_assign != NO_NODE
-        counts = jax.ops.segment_sum(placed.astype(jnp.int32),
-                                     tasks.job_ix, num_segments=J)
-        ready = counts + jobs.base_ready >= jobs.min_available
-        keep_task = ready[tasks.job_ix] & placed
-        drop = placed & ~keep_task
+        alloc_cnt = jax.ops.segment_sum((placed & ~pipe).astype(jnp.int32),
+                                        tasks.job_ix, num_segments=J)
+        pipe_cnt = jax.ops.segment_sum((placed & pipe).astype(jnp.int32),
+                                       tasks.job_ix, num_segments=J)
+        ready = alloc_cnt + jobs.base_ready >= jobs.min_available
+        kept = (alloc_cnt + pipe_cnt + jobs.base_ready
+                + jobs.base_pipelined >= jobs.min_available)
+        drop = placed & ~kept[tasks.job_ix]
         drop_hot = (jax.nn.one_hot(jnp.where(drop, new_assign, 0),
                                    nodes.idle.shape[0], dtype=tasks.req.dtype)
                     * drop[:, None])
-        freed = jnp.einsum("tn,tr->nr", drop_hot, tasks.req)
+        alloc_hot = drop_hot * (~pipe)[:, None].astype(tasks.req.dtype)
+        freed_alloc = jnp.einsum("tn,tr->nr", alloc_hot, tasks.req)
+        freed_all = jnp.einsum("tn,tr->nr", drop_hot, tasks.req)
         nodes = NodeState(
-            idle=nodes.idle + freed,
-            future_idle=nodes.future_idle + freed,
-            used=nodes.used - freed,
+            idle=nodes.idle + freed_alloc,
+            future_idle=nodes.future_idle + freed_all,
+            used=nodes.used - freed_alloc,
             ntasks=nodes.ntasks - jnp.sum(drop_hot, axis=0).astype(jnp.int32))
         new_assign = jnp.where(drop, NO_NODE, new_assign)
-        job_dead = job_dead | (~ready & (counts > 0))
-        return (nodes, new_assign, job_dead), ready
+        job_dead = job_dead | (~kept & (alloc_cnt + pipe_cnt > 0))
+        return (nodes, new_assign, pipe, job_dead), (ready, kept)
 
     job_dead = jnp.zeros(J, dtype=bool)
-    (nodes, assign, _), readies = jax.lax.scan(
-        sweep, (nodes, assign, job_dead), jnp.arange(sweeps))
-    return assign[:T], readies[-1], nodes
+    (nodes, assign, pipe, _), (readies, kepts) = jax.lax.scan(
+        sweep, (nodes, assign, pipe0, job_dead), jnp.arange(sweeps))
+    return assign[:T], pipe[:T], readies[-1], kepts[-1], nodes
