@@ -24,7 +24,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..api import PodGroupPhase, TaskInfo, TaskStatus
+from ..api import PodGroupPhase, Resource, TaskInfo, TaskStatus
 from ..cache.snapshot import (NodeTensors, assemble_feasibility,
                               assemble_static_score, assemble_weights,
                               discover_resource_names, task_requests)
@@ -44,6 +44,12 @@ BIG = 1 << 30
 # quirks and stays cheap at small scale. Override with the action
 # configuration key ``device-min-victims``.
 DEVICE_MIN_VICTIMS = {"preempt": 0, "reclaim": 1024}
+
+# above this many victims on ONE node the dense [N, W] slot layout
+# degenerates (mostly pads; with a drf tier the walk also materializes an
+# [N, W, W] prefix tensor on device), so the engine delegates the cycle to
+# the callbacks path — decisions are identical by the parity contract
+MAX_W = 64
 
 
 def _device_min_victims(ssn, action_name: str) -> int:
@@ -100,41 +106,22 @@ class _EvictTensors:
         backends). ``vgroup``: per-victim tracked-table index (job for
         preempt, queue for reclaim); pads point at the zeroed extra row
         ``n_groups``. ``vrank``: per-victim candidate-list rank for the
-        dynamic tier's intra-row (group, cand-order) sort; None ->
-        identity rows."""
+        dynamic tier's within-dispatch subtraction order; None -> no
+        dynamic tier, the rank table is never read (the walk only expands
+        it to the [N, W, W] ``before`` tensor when a drf tier exists)."""
         from ..ops.evict import EvictNW
 
         N, W = self.vslot.shape
         group_pad = np.r_[vgroup.astype(np.int64), n_groups]
         group_nw = group_pad[self.vslot].astype(np.int32)
         if vrank is None:
-            sort_order = np.tile(np.arange(W, dtype=np.int32), (N, 1))
-            sort_inv = sort_order.copy()
-            seg_head = np.zeros((N, W), np.int32)
-            vreq_sorted = self.vreq_nw
+            rank_nw = np.zeros((N, W), np.int32)
         else:
-            rank_pad = np.r_[vrank.astype(np.int64), BIG]
-            rank_nw = rank_pad[self.vslot]
-            flat = np.lexsort((rank_nw.ravel(), group_nw.ravel(),
-                               np.repeat(np.arange(N), W)))
-            sort_order = (flat.reshape(N, W)
-                          - np.arange(N)[:, None] * W).astype(np.int32)
-            sort_inv = np.empty_like(sort_order)
-            np.put_along_axis(sort_inv, sort_order,
-                              np.tile(np.arange(W, dtype=np.int32), (N, 1)),
-                              axis=1)
-            g_sorted = np.take_along_axis(group_nw, sort_order, axis=1)
-            first = np.ones((N, W), bool)
-            first[:, 1:] = g_sorted[:, 1:] != g_sorted[:, :-1]
-            seg_head = np.maximum.accumulate(
-                np.where(first, np.arange(W, dtype=np.int64)[None, :], -1),
-                axis=1).astype(np.int32)
-            vreq_sorted = np.take_along_axis(
-                self.vreq_nw, sort_order[..., None], axis=1)
+            rank_pad = np.r_[np.minimum(vrank.astype(np.int64), BIG), BIG]
+            rank_nw = rank_pad[self.vslot].astype(np.int32)
         return EvictNW(
             vslot=self.vslot, valid=self.valid_nw, vreq=self.vreq_nw,
-            vgroup=group_nw, sort_order=sort_order, sort_inv=sort_inv,
-            seg_head=seg_head, vreq_sorted=vreq_sorted)
+            vgroup=group_nw, rank=rank_nw)
 
     def owner_nw_to_victims(self, owner_nw: np.ndarray) -> Dict[int, list]:
         """owner [N, W] (step index or -1) -> step -> victims."""
@@ -159,20 +146,21 @@ def task_requests_of(tasks, rnames, init=True) -> np.ndarray:
     return req
 
 
-def _run_lengths(same_prev: np.ndarray) -> np.ndarray:
-    """run_left[i] = how many consecutive tasks starting at i share the
-    same (job, request, score-row) — the kernel's free-fill horizon,
-    capped at ops.evict.KMAX."""
-    from ..ops.evict import KMAX
-    P = len(same_prev)
-    # run-length idiom: segment ids advance where same_prev breaks; the
-    # distance to the segment end is the remaining run length
-    brk = np.r_[True, ~same_prev[1:]]
-    seg = np.cumsum(brk) - 1
-    seg_end = np.zeros(seg[-1] + 1 if P else 0, np.int64)
-    np.maximum.at(seg_end, seg, np.arange(P))
-    out = (seg_end[seg] - np.arange(P) + 1).astype(np.int32)
-    return np.minimum(out, KMAX)
+def _max_per_node(victims: List[TaskInfo]) -> int:
+    """Largest victim count on any one node — the W of the [N, W] layout."""
+    counts: Dict[str, int] = {}
+    for t in victims:
+        counts[t.node_name] = counts.get(t.node_name, 0) + 1
+    return max(counts.values(), default=0)
+
+
+def _segment_ends(is_last: np.ndarray) -> np.ndarray:
+    """For each position, the index of its segment's LAST element, given a
+    bool[P] marking segment-final positions — the walk kernels' cursor-jump
+    targets (run_end / job_end / queue_end)."""
+    ends = np.flatnonzero(is_last)
+    return ends[np.searchsorted(ends, np.arange(len(is_last)))] \
+        .astype(np.int32)
 
 
 def _task_order_chain(ssn) -> List[str]:
@@ -371,6 +359,9 @@ class _TierStack:
         self.sizes = tuple(len(m) for m in masks)
         self.masks = tuple(masks)
         self.has_dynamic = dynamic_name in self.kinds
+        # custom (non-stock) plugins participated: their live callbacks must
+        # re-validate every proposal at replay (no batched fast replay)
+        self.generic = bool(generic_names)
         # the same-node-run shortcut is exact only when every dynamic tier
         # is the last tier (see ops/evict.py docstring)
         self.allow_cheap = all(k == "static" for k in self.kinds[:-1])
@@ -400,17 +391,16 @@ def _drf_inputs(ssn, tensors: _EvictTensors, victims, need_group: bool):
     """(vjob, jalloc0 [AJ+1,R], total, vrank, job_index): global job table
     for the in-kernel drf share tracking; jalloc carries a zeroed pad row
     for [N,W] pad slots. vrank is the candidate-list order rank
-    (drf.go:308-330 within-dispatch subtraction order)."""
+    (drf.go:308-330 within-dispatch subtraction order). ``job.allocated``
+    is maintained as exactly the sum of allocated-status task resreqs
+    (api/job_info.py update_task_status), so one to_vector per job replaces
+    the per-task accumulation."""
     job_index = {uid: i for i, uid in enumerate(ssn.jobs)}
     AJ = len(job_index)
     R = len(tensors.rnames)
     jalloc = np.zeros((AJ + 1, R), np.float32)
-    from ..api.types import allocated_status
     for uid, job in ssn.jobs.items():
-        jx = job_index[uid]
-        for t in job.tasks.values():
-            if allocated_status(t.status):
-                jalloc[jx] += t.resreq.to_vector(tensors.rnames)
+        jalloc[job_index[uid]] = job.allocated.to_vector(tensors.rnames)
     total = tensors.node_t.allocatable.sum(axis=0)
     vjob = np.asarray([job_index[t.job] for t in victims], np.int32)
     vrank = None
@@ -421,14 +411,19 @@ def _drf_inputs(ssn, tensors: _EvictTensors, victims, need_group: bool):
     return vjob, jalloc, total, vrank, job_index
 
 
-def _score_matrix(ssn, ptasks, tensors: _EvictTensors):
-    """f32[P,N] node scores with static feasibility folded in as -inf —
-    the same assembly the fused allocate engine uses. Returned as a DEVICE
-    array: at 5k preemptors x 1k nodes the matrix is ~20MB, and fetching it
-    just to re-upload into the scan costs seconds on a remote backend.
-    Also returns the same-prev vector: task i equals task i-1 in job,
-    request, feasibility row, and static-score row — the exactness
-    precondition of the kernel's same-node-run shortcut."""
+def _score_rows(ssn, ptasks, tensors: _EvictTensors, pjob_arr: np.ndarray):
+    """One score row per same-request RUN instead of the full [P,N] matrix.
+
+    Tasks are grouped into maximal runs with identical (job, request,
+    feasibility row, static-score row) — the exactness precondition of the
+    walk kernels' same-node-run shortcut AND of the row dedup: within a
+    run every task's score row (dynamic + static, -inf where infeasible)
+    is identical, so the device only needs ``score_g`` f32[G,N] plus the
+    ``run_id`` i32[P] indirection. At 5k preemptors in ~100 runs that cuts
+    the per-cycle host->device transfer from ~20MB+ (the [P,N] f32 plus a
+    [P,N] bool whose upload conversion alone costs >100ms on a remote
+    tunnel) to ~0.5MB. Returns (preq, score_g device array, run_id,
+    run_end)."""
     import jax.numpy as jnp
     from ..ops.scores import combined_dynamic_score
 
@@ -437,22 +432,32 @@ def _score_matrix(ssn, ptasks, tensors: _EvictTensors):
     feas = assemble_feasibility(ssn, ptasks, node_t)
     static = assemble_static_score(ssn, ptasks, node_t)
     weights = assemble_weights(ssn, tensors.rnames)
-    score = combined_dynamic_score(jnp.asarray(preq),
-                                   jnp.asarray(node_t.used),
-                                   jnp.asarray(node_t.allocatable), weights)
-    if static is not None:
-        score = score + jnp.asarray(static)
-    if feas is not None:
-        score = jnp.where(jnp.asarray(feas), score, -jnp.inf)
 
     P = len(ptasks)
     same = np.zeros(P, bool)
     if P > 1:
         same[1:] = np.all(preq[1:] == preq[:-1], axis=-1)
+        same[1:] &= pjob_arr[1:] == pjob_arr[:-1]
         for arr in (feas, static):
             if arr is not None:
                 same[1:] &= np.all(arr[1:] == arr[:-1], axis=-1)
-    return preq, score, same
+    run_id = (np.cumsum(~same) - 1).astype(np.int32)
+    rep = np.flatnonzero(~same)                      # run-start indices
+    run_end = _segment_ends(np.r_[~same[1:], True])
+
+    ms = None
+    if feas is not None or static is not None:
+        N = len(node_t.names)
+        s = (np.zeros((len(rep), N), np.float32) if static is None
+             else static[rep].astype(np.float32))
+        ms = s if feas is None else np.where(feas[rep], s, -np.inf) \
+            .astype(np.float32)
+    score_g = combined_dynamic_score(jnp.asarray(preq[rep]),
+                                     jnp.asarray(node_t.used),
+                                     jnp.asarray(node_t.allocatable), weights)
+    if ms is not None:
+        score_g = score_g + jnp.asarray(ms)
+    return preq, score_g, run_id, run_end
 
 
 def _starving_jobs(ssn):
@@ -492,7 +497,8 @@ def execute_preempt_tpu(ssn) -> None:
     """Device preempt: phase 1 inter-job (gang statements), phase 2
     intra-job, then the host victim_tasks pass."""
     victims = _eviction_order(ssn, _collect_victims(ssn))
-    if len(victims) < _device_min_victims(ssn, "preempt"):
+    if len(victims) < _device_min_victims(ssn, "preempt") \
+            or _max_per_node(victims) > MAX_W:
         from .preempt import PreemptAction
         return PreemptAction(engine="callbacks")._execute_callbacks(ssn)
     pjobs, under_request = _starving_jobs(ssn)
@@ -524,7 +530,7 @@ def execute_preempt_tpu(ssn) -> None:
 
 def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
     import jax.numpy as jnp
-    from ..ops.evict import build_preempt_scan
+    from ..ops.evict import build_preempt_walk
 
     ptasks: List[TaskInfo] = []
     pjob_ix: List[int] = []
@@ -555,10 +561,11 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
     stack = _TierStack(ssn, kept_jobs, victims, ssn.preemptable_fns,
                        "enabledPreemptable", "drf", cand_kind)
     tensors = _EvictTensors(ssn, victims, ptasks)
-    preq, score, same_prev = _score_matrix(ssn, ptasks, tensors)
     pjob_arr = np.asarray(pjob_ix, np.int32)
-    same_prev[1:] &= pjob_arr[1:] == pjob_arr[:-1]
-    run_left = _run_lengths(same_prev)
+    preq, score_g, run_id, run_end = _score_rows(ssn, ptasks, tensors,
+                                                 pjob_arr)
+    first_np = np.asarray(first, bool)
+    job_end = _segment_ends(np.r_[first_np[1:], True])
     vjob, jalloc0, total, vrank, job_index = _drf_inputs(
         ssn, tensors, victims, need_group=stack.has_dynamic)
     nw = tensors.nw_inputs(vjob, len(job_index), vrank)
@@ -571,19 +578,19 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
     # a non-chosen node's drf verdict can grow mid-run. Inter-job excludes
     # own-job victims, so only phase 1 keeps the shortcut with drf.
     allow_cheap = stack.allow_cheap and (inter_job or not stack.has_dynamic)
-    fn = build_preempt_scan(stack.kinds, stack.sizes, inter_job,
+    fn = build_preempt_walk(stack.kinds, stack.sizes, inter_job,
                             allow_cheap)
     import jax
     inputs = jax.device_put((
         tensors.future_idle0(), nw, stack.padded_cand_mask(),
-        stack.device_masks(), preq, pjob_arr,
-        np.asarray(first, bool), same_prev, run_left,
-        needed, pjg, jalloc0, total))                       # one upload
-    (fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, first_d,
-     same_d, run_d, needed_d, pjg_d, jalloc_d, total_d) = inputs
+        stack.device_masks(), preq, pjob_arr, pjg, first_np,
+        run_id, run_end, job_end,
+        needed, jalloc0, total))                            # one upload
+    (fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, pjg_d, first_d,
+     rid_d, rend_d, jend_d, needed_d, jalloc_d, total_d) = inputs
     task_node, owner_nw, job_done = fn(
-        fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, first_d,
-        same_d, run_d, score, needed_d, pjg_d, jalloc_d, total_d)
+        fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, pjg_d, first_d,
+        rid_d, rend_d, jend_d, score_g, needed_d, jalloc_d, total_d)
     N, W = tensors.vslot.shape
     P = len(ptasks)
     packed = np.asarray(jnp.concatenate([
@@ -594,14 +601,156 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
     job_done = packed[P + N * W:].astype(bool)
 
     _replay_preempt(ssn, ptasks, pjob_ix, kept_jobs, tensors,
-                    task_node, owner_nw, job_done, inter_job)
+                    task_node, owner_nw, job_done, inter_job, stack)
+
+
+def _fast_evict_ok(ssn, stack: "_TierStack") -> bool:
+    """Batched eviction replay skips the per-task Statement machinery and
+    the live preemptable/reclaimable re-validation. Sound only when every
+    participating eviction plugin is a stock fast-path one — the kernel
+    replays exactly their semantics, including the dynamic tier's tracked
+    state, in the same order the replay applies them, so the live chain
+    could never veto a kernel verdict — plus allocate's batched-replay
+    conditions (no stateful predicates, additive handlers, gang-owned
+    readiness/pipelining, no GPU card state)."""
+    from .allocate import _fast_replay_ok
+    return not stack.generic and _fast_replay_ok(ssn)
+
+
+def _fast_pipeline(ssn, task: TaskInfo, host: str) -> None:
+    """PENDING -> PIPELINED bookkeeping, identical end-state to
+    Statement.pipeline minus the per-task event fire (aggregated by the
+    caller; handlers are additive under _fast_evict_ok)."""
+    ssn.jobs[task.job].update_task_status(task, TaskStatus.PIPELINED)
+    task.node_name = host
+    node = ssn.nodes[host]
+    ti = task.shallow_clone()
+    node.tasks[task.uid] = ti
+    for port in ti.host_ports:
+        node.used_ports[port] = node.used_ports.get(port, 0) + 1
+    node.pipelined.add(task.resreq)
+
+
+def _fast_unpipeline(ssn, task: TaskInfo) -> None:
+    """Exact reverse of _fast_pipeline (Statement._unpipeline analogue)."""
+    ssn.jobs[task.job].update_task_status(task, TaskStatus.PENDING)
+    node = ssn.nodes.get(task.node_name)
+    if node is not None:
+        node.tasks.pop(task.uid, None)
+        for port in task.host_ports:
+            left = node.used_ports.get(port, 0) - 1
+            if left > 0:
+                node.used_ports[port] = left
+            else:
+                node.used_ports.pop(port, None)
+        node.pipelined.sub(task.resreq)
+    task.node_name = ""
+
+
+def _fast_evict(ssn, vt: TaskInfo) -> TaskInfo:
+    """RUNNING -> RELEASING bookkeeping, identical end-state to
+    Statement.evict minus the fire and the cache side effect (both done by
+    the caller after the gang gate): job status index + allocated, node
+    mirror status + releasing accounting (update_task's remove/add nets to
+    releasing.add for RUNNING -> RELEASING)."""
+    job = ssn.jobs[vt.job]
+    own = job.tasks[vt.uid]
+    job.update_task_status(own, TaskStatus.RELEASING)
+    node = ssn.nodes.get(own.node_name)
+    if node is not None:
+        mirror = node.tasks.get(own.uid)
+        if mirror is not None:
+            mirror.status = TaskStatus.RELEASING
+            node.releasing.add(own.resreq)
+    return own
+
+
+def _fast_unevict(ssn, own: TaskInfo) -> None:
+    """Exact reverse of _fast_evict (Statement._unevict analogue)."""
+    ssn.jobs[own.job].update_task_status(own, TaskStatus.RUNNING)
+    node = ssn.nodes.get(own.node_name)
+    if node is not None:
+        mirror = node.tasks.get(own.uid)
+        if mirror is not None:
+            mirror.status = TaskStatus.RUNNING
+            node.releasing.sub(own.resreq)
+
+
+def _replay_preempt_fast(ssn, ptasks, pjob_ix, kept_jobs, tensors,
+                         task_node, victims_by_step,
+                         inter_job: bool) -> None:
+    """Batched preempt replay (the eviction analogue of allocate's
+    _replay_fused_fast): dict bookkeeping + aggregated event fires, no
+    Statements. The kernel already enforced gang atomicity (task_node is
+    NO_NODE for rolled-back jobs) and fit (fidle tracked in-kernel), and
+    _fast_evict_ok guaranteed the live chain could not veto placements.
+
+    One live gate survives from the slow path: a preemptor job can itself
+    LOSE RUNNING tasks to an earlier same-queue preemptor in this very
+    action, dropping its ready count below what the kernel's snapshot-time
+    quota assumed — so phase 1 re-checks gang's job_pipelined vote after
+    applying each job and rolls that job back (pipelines AND its
+    evictions) exactly as Statement.discard would. Event fires and
+    cache.evict side effects happen only for committed jobs, which is why
+    the per-op helpers defer both."""
+    from .allocate import _AggTask
+    from .. import metrics
+
+    names = tensors.node_t.names
+    per_job: Dict[int, List[int]] = {}
+    for i, jx in enumerate(pjob_ix):
+        per_job.setdefault(jx, []).append(i)
+
+    alloc_agg: Dict[int, Resource] = {}
+    dealloc_agg: Dict[str, Resource] = {}
+    cache_evicts: List[TaskInfo] = []
+    for jx, ids in per_job.items():
+        job = kept_jobs[jx]
+        applied_p: List[TaskInfo] = []
+        applied_v: List[TaskInfo] = []
+        for i in ids:
+            if task_node[i] == NO_NODE:
+                continue
+            evicted = victims_by_step.get(i, [])
+            for vt in evicted:
+                applied_v.append(_fast_evict(ssn, vt))
+            metrics.update_preemption_victims(len(evicted))
+            metrics.register_preemption_attempt()
+            _fast_pipeline(ssn, ptasks[i], names[task_node[i]])
+            applied_p.append(ptasks[i])
+        if not applied_p and not applied_v:
+            continue
+        if inter_job and not ssn.job_pipelined(job):
+            for t in reversed(applied_p):
+                _fast_unpipeline(ssn, t)
+            for v in reversed(applied_v):
+                _fast_unevict(ssn, v)
+            continue
+        for t in applied_p:
+            alloc_agg.setdefault(jx, Resource()).add(t.resreq)
+        for v in applied_v:
+            dealloc_agg.setdefault(v.job, Resource()).add(v.resreq)
+            cache_evicts.append(v)
+
+    for jx, r in alloc_agg.items():
+        ssn._fire_allocate(_AggTask(kept_jobs[jx].uid, r))
+    for uid, r in dealloc_agg.items():
+        ssn._fire_deallocate(_AggTask(uid, r))
+    for v in cache_evicts:
+        ssn.cache.evict(v, "preempt")
 
 
 def _replay_preempt(ssn, ptasks, pjob_ix, kept_jobs, tensors,
-                    task_node, owner_nw, job_done, inter_job: bool) -> None:
+                    task_node, owner_nw, job_done, inter_job: bool,
+                    stack: "_TierStack") -> None:
     from .. import metrics
 
     victims_by_step = tensors.owner_nw_to_victims(owner_nw)
+
+    if _fast_evict_ok(ssn, stack):
+        _replay_preempt_fast(ssn, ptasks, pjob_ix, kept_jobs, tensors,
+                             task_node, victims_by_step, inter_job)
+        return
 
     per_job: Dict[int, List[int]] = {}
     for i, jx in enumerate(pjob_ix):
@@ -656,13 +805,14 @@ def execute_reclaim_tpu(ssn) -> None:
     """Device reclaim: victims from other, reclaimable queues; direct
     evictions (reclaim.go semantics, no statement)."""
     import jax.numpy as jnp
-    from ..ops.evict import build_reclaim_scan
+    from ..ops.evict import build_reclaim_walk
 
     # reclaim evicts in candidate-list order — node.tasks insertion order,
     # NOT the reversed TaskOrderFn that preempt uses (reclaim.go walks the
     # Reclaimable result as-is)
     victims = _collect_victims(ssn)
-    if len(victims) < _device_min_victims(ssn, "reclaim"):
+    if len(victims) < _device_min_victims(ssn, "reclaim") \
+            or _max_per_node(victims) > MAX_W:
         from .reclaim import ReclaimAction
         return ReclaimAction(engine="callbacks")._execute_callbacks(ssn)
 
@@ -715,11 +865,19 @@ def execute_reclaim_tpu(ssn) -> None:
     tensors = _EvictTensors(ssn, victims, ptasks)
     preq = task_requests(ptasks, tensors.rnames)
     pjob_arr = np.asarray(pjob_ix, np.int32)
+    pqueue_arr = np.asarray(pqueue_ix, np.int32)
     P = len(ptasks)
     same_prev = np.zeros(P, bool)
     if P > 1:
         same_prev[1:] = (pjob_arr[1:] == pjob_arr[:-1]) \
             & np.all(preq[1:] == preq[:-1], axis=-1)
+    run_id = (np.cumsum(~same_prev) - 1).astype(np.int32)
+    job_brk = np.ones(P, bool)
+    job_brk[1:] = pjob_arr[1:] != pjob_arr[:-1]
+    job_end = _segment_ends(np.r_[job_brk[1:], True])
+    queue_brk = np.ones(P, bool)
+    queue_brk[1:] = pqueue_arr[1:] != pqueue_arr[:-1]
+    queue_end = _segment_ends(np.r_[queue_brk[1:], True])
 
     # proportion state: queue allocated/deserved vectors (proportion.go),
     # with a zeroed pad row for [N,W] pad slots
@@ -729,13 +887,13 @@ def execute_reclaim_tpu(ssn) -> None:
     qalloc = np.zeros((Qall + 1, R), np.float32)
     qdeserved = np.full((Qall + 1, R), np.float32(1e30))
     qdeserved[Qall] = 0.0               # pad row: never over-deserved
-    from ..api.types import allocated_status
+    # job.allocated is maintained as exactly the sum of allocated-status
+    # task resreqs (api/job_info.py update_task_status) — one to_vector
+    # per job, same invariant _drf_inputs relies on
     for job in ssn.jobs.values():
         if job.queue in all_queues:
-            qx = all_queues[job.queue]
-            for t in job.tasks.values():
-                if allocated_status(t.status):
-                    qalloc[qx] += t.resreq.to_vector(tensors.rnames)
+            qalloc[all_queues[job.queue]] += \
+                job.allocated.to_vector(tensors.rnames)
     for name, r in ssn.queue_deserved.items():
         if name in all_queues:
             qdeserved[all_queues[name]] = r.to_vector(tensors.rnames)
@@ -746,13 +904,13 @@ def execute_reclaim_tpu(ssn) -> None:
         [all_queues[qorder[qx].uid] for qx in pqueue_ix], np.int32)
     nw = tensors.nw_inputs(vqueue, Qall, None)
 
-    fn = build_reclaim_scan(stack.kinds, stack.sizes, stack.allow_cheap)
+    fn = build_reclaim_walk(stack.kinds, stack.sizes, stack.allow_cheap)
     import jax
     inputs = jax.device_put((
         tensors.future_idle0(), nw, stack.padded_cand_mask(),
         stack.device_masks(), preq, pjob_arr, pqueue_all,
-        np.asarray(last_of_job, bool), same_prev,
-        qalloc, qdeserved))                                 # one upload
+        run_id, job_end, queue_end,
+        np.asarray(last_of_job, bool), qalloc, qdeserved))  # one upload
     task_node, owner_nw = fn(*inputs)
     N, W = tensors.vslot.shape
     packed = np.asarray(jnp.concatenate([
@@ -762,7 +920,28 @@ def execute_reclaim_tpu(ssn) -> None:
 
     victims_by_step = tensors.owner_nw_to_victims(owner_nw)
 
-    from ..api import Resource
+    if _fast_evict_ok(ssn, stack):
+        # no gang gate here: reclaim evicts directly with no statement
+        # (reclaim.go has no rollback), so committed = applied
+        from .allocate import _AggTask
+        names = tensors.node_t.names
+        dealloc_agg: Dict[str, Resource] = {}
+        alloc_agg: Dict[str, Resource] = {}
+        for i in np.flatnonzero(task_node != NO_NODE):
+            i = int(i)
+            for vt in victims_by_step.get(i, []):
+                own = _fast_evict(ssn, vt)
+                dealloc_agg.setdefault(own.job, Resource()).add(own.resreq)
+                ssn.cache.evict(own, "reclaim")
+            _fast_pipeline(ssn, ptasks[i], names[task_node[i]])
+            alloc_agg.setdefault(ptasks[i].job, Resource()) \
+                .add(ptasks[i].resreq)
+        for uid, r in alloc_agg.items():
+            ssn._fire_allocate(_AggTask(uid, r))
+        for uid, r in dealloc_agg.items():
+            ssn._fire_deallocate(_AggTask(uid, r))
+        return
+
     for i, task in enumerate(ptasks):
         n = int(task_node[i])
         if n == NO_NODE:

@@ -76,7 +76,7 @@ def _share(alloc, total):
 
 
 class EvictNW(NamedTuple):
-    """Static device inputs shared by both scans (the [N, W] victim
+    """Static device inputs shared by both walks (the [N, W] victim
     layout). ``vslot`` indexes the compact victim axis (V = pad sentinel,
     so per-victim tables carry one trailing pad entry)."""
 
@@ -86,10 +86,12 @@ class EvictNW(NamedTuple):
     vgroup: jnp.ndarray         # i32[N, W] victim job (preempt) / queue
     #                             (reclaim) index; pad rows point at the
     #                             zeroed extra row of the tracked table
-    sort_order: jnp.ndarray     # i32[N, W] intra-row (group, cand-order)
-    sort_inv: jnp.ndarray       # i32[N, W] inverse of sort_order
-    seg_head: jnp.ndarray       # i32[N, W] sorted pos of segment head
-    vreq_sorted: jnp.ndarray    # f32[N, W, R] vreq in sort_order
+    rank: jnp.ndarray           # i32[N, W] candidate-list rank per slot
+    #                             (pads BIG) — the drf tier's
+    #                             within-dispatch subtraction order; the
+    #                             walk prologue expands it to the [N, W, W]
+    #                             ``before`` tensor ON DEVICE, so the host
+    #                             never builds or uploads the W^2 array
 
 
 def _gather_tier_masks(tier_masks, pj, vslot):
@@ -147,26 +149,37 @@ def _tier_eval(tier_kinds, masks_g, cand, dynamic_fn):
     return elig, dyn_decided, dyn_extra
 
 
-def _drf_dynamic(nw: EvictNW, jalloc, total, ls, rows=None):
+def expand_before(nw: EvictNW) -> jnp.ndarray:
+    """f32[N, W, W] before[n, u, w] = 1 iff slot u shares w's group and
+    precedes it in candidate-list order — computed once per walk call from
+    the [N, W] rank/group tables (never uploaded: the host would otherwise
+    ship an O(N*W^2) array that blows up on skewed victim distributions)."""
+    same_g = nw.vgroup[:, :, None] == nw.vgroup[:, None, :]
+    earlier = nw.rank[:, :, None] < nw.rank[:, None, :]
+    return (same_g & earlier & nw.valid[:, :, None]).astype(jnp.float32)
+
+
+def _drf_dynamic(nw: EvictNW, before, jalloc, total, ls, rows=None):
     """drf.go:308-330 — victim stays a candidate iff the preemptor's share
     (with the task) stays <= the victim job's share after losing the victim
     and every earlier same-(node, job) candidate. The within-dispatch
-    exclusive prefix is a per-row segmented cumsum in (job, cand-order)
-    space. ``rows``: optional i32[n] node-row restriction."""
-    order = nw.sort_order if rows is None else nw.sort_order[rows]
-    inv = nw.sort_inv if rows is None else nw.sort_inv[rows]
-    head = nw.seg_head if rows is None else nw.seg_head[rows]
-    vreq_s = nw.vreq_sorted if rows is None else nw.vreq_sorted[rows]
+    exclusive prefix is one batched matmul against the ``before`` tensor:
+    prior[n,w,r] = sum_u before[n,u,w] * cand[n,u] * vreq[n,u,r] — a
+    [W, W] x [W, R] matmul per node instead of the v2 kernels'
+    sort/cumsum/unsort chain (take_along_axis costs ~40us per op inside a
+    device loop; the einsum is one). ``rows``: optional i32[n] node-row
+    restriction."""
+    before = before if rows is None else before[rows]
     vreq = nw.vreq if rows is None else nw.vreq[rows]
     vgroup = nw.vgroup if rows is None else nw.vgroup[rows]
 
     def fn(cand):
-        c_s = jnp.take_along_axis(cand, order, axis=1)
-        masked = vreq_s * c_s[..., None]
-        cs = jnp.cumsum(masked, axis=1)
-        ecs = cs - masked
-        base = jnp.take_along_axis(ecs, head[..., None], axis=1)
-        prior = jnp.take_along_axis(ecs - base, inv[..., None], axis=1)
+        masked = vreq * cand[..., None]
+        # HIGHEST precision: the TPU default would run this matmul in
+        # bf16, perturbing rs by far more than SHARE_DELTA and flipping
+        # verdicts vs the exact-f32 CPU comparator
+        prior = jnp.einsum("nuw,nur->nwr", before, masked,
+                           precision=jax.lax.Precision.HIGHEST)
         ralloc = jalloc[vgroup] - prior - vreq
         rs = _share(ralloc, total)
         return cand & ((ls < rs) | (jnp.abs(ls - rs) <= SHARE_DELTA)), rs
@@ -278,93 +291,139 @@ def _fill_schedule(vreq_row, fidle_b, elig_row, rs_row, dyn_dec_b, req,
 
 
 @functools.lru_cache(maxsize=16)
-def build_preempt_scan(tier_kinds: Tuple[str, ...],
+def build_preempt_walk(tier_kinds: Tuple[str, ...],
                        tier_sizes: Tuple[int, ...],
                        gang_commit: bool,
                        allow_cheap: bool = True):
-    """Compile a preempt scan for one tier structure.
+    """Compile a preempt walk for one tier structure.
 
     tier_kinds[i] is "static" or "drf"; tier_sizes[i] is the number of
     static plugin masks in tier i (the drf tier may also carry static
-    co-plugins). Returns a jitted fn; see the module docstring for the
-    dispatch semantics. ``allow_cheap`` must be False when a dynamic tier
-    is not the last tier (the same-node-run shortcut's monotone-shrink
-    argument would not hold)."""
+    co-plugins). ``allow_cheap`` must be False when a dynamic tier is not
+    the last tier (the same-node-run shortcut's monotone-shrink argument
+    would not hold).
 
-    def scan_fn(future_idle0, nw: EvictNW, cand_mask, tier_masks,
-                preq, pjob, first_of_job, same_prev, run_left, score,
-                needed, pjg, jalloc0, total):
+    The walk is a ``lax.while_loop`` over a TASK CURSOR, not a per-task
+    scan: each iteration evaluates ONE dispatch (full or node-local cheap)
+    and places a whole same-request CHUNK via the closed-form fill
+    schedule, then jumps the cursor — past the chunk on success, past the
+    rest of the run on failure (a failed attempt mutates nothing, so every
+    identical task re-fails), past the rest of the job when its quota is
+    met. Iteration count is therefore the number of dispatch evaluations
+    the serial algorithm needs (~jobs x nodes-touched), not the task
+    count — at 5k preemptors in ~100 same-request runs that is ~100
+    device steps instead of 5k, which is what keeps the whole action
+    inside the reference's 1 s cycle budget on a remote-tunnel TPU.
+
+    Decisions are bit-identical to the per-task formulation: the fill
+    schedule (``_fill_schedule``) already encoded chunk semantics for the
+    scan's free-fill countdown; the walk merely stops paying for the
+    pass-through steps.
+
+    ``score_g`` carries one score row per same-request RUN (``run_id``
+    indexes it) — runs are maximal stretches with identical (job, request,
+    feasibility row, static score row), so the dedup is exact and the
+    device never sees the [P, N] matrix."""
+
+    def walk_fn(future_idle0, nw: EvictNW, cand_mask, tier_masks,
+                preq, pjob, pjg, first_of_job, run_id, run_end, job_end,
+                score_g, needed, jalloc0, total):
         N, W, R = nw.vreq.shape
         P = preq.shape[0]
         fdtype = preq.dtype
         has_drf = any(k == "drf" for k in tier_kinds)
+        iota_p = jnp.arange(P, dtype=jnp.int32)
+        before = expand_before(nw) if has_drf else None
 
         class Carry(NamedTuple):
+            i: jnp.ndarray           # i32[] task cursor
+            last_pj: jnp.ndarray     # i32[] job of last visited task
             alive: jnp.ndarray       # bool[N, W]
             fidle: jnp.ndarray       # f32[N, R]
             jalloc: jnp.ndarray      # f32[AJ+1, R]
             pipe_cnt: jnp.ndarray    # i32[PJ]
             owner: jnp.ndarray       # i32[N, W]
-            stopped: jnp.ndarray     # bool[PJ]
+            task_node: jnp.ndarray   # i32[P]
             prev_node: jnp.ndarray   # i32[]
             prev_ok: jnp.ndarray     # bool[]
-            prev_fail: jnp.ndarray   # bool[]
-            countdown: jnp.ndarray   # i32[] free-fill placements left
+            prev_rid: jnp.ndarray    # i32[] run of the last evaluation
             s_alive: jnp.ndarray
             s_fidle: jnp.ndarray
             s_jalloc: jnp.ndarray
             s_owner: jnp.ndarray
 
-        def step(c: Carry, xs):
-            p_ix, req, pj, pjg_i, first, same_prev_i, run_left_i, \
-                prev_pj = xs
+        def body(c: Carry) -> Carry:
+            i = c.i
+            req = preq[i]
+            pj = pjob[i]
+            pjg_i = pjg[i]
+            rid = run_id[i]
+            rend = run_end[i]
+            jend = job_end[i]
 
             if gang_commit:
-                # close the PREVIOUS job's statement: rollback on missed
-                # quota (final boundary handled after the scan). Rollback
-                # and snapshot only happen on job boundaries, so the
-                # [N, W]-sized selects hide behind the cond
+                # job boundary: close the previous job's statement
+                # (rollback on missed quota) and snapshot for this one.
+                # Every job's first task is visited — cursor jumps only
+                # land within the current job or on the next job's first
+                # task — so no boundary is ever skipped.
                 def close_and_snapshot(c):
-                    failed = (prev_pj >= 0) & \
-                        (c.pipe_cnt[prev_pj] < needed[prev_pj])
+                    prev = c.last_pj
+                    failed = (prev >= 0) & \
+                        (c.pipe_cnt[prev] < needed[prev])
                     c = c._replace(
                         alive=jnp.where(failed, c.s_alive, c.alive),
                         fidle=jnp.where(failed, c.s_fidle, c.fidle),
                         jalloc=jnp.where(failed, c.s_jalloc, c.jalloc),
                         owner=jnp.where(failed, c.s_owner, c.owner),
                         pipe_cnt=jnp.where(
-                            failed, c.pipe_cnt.at[prev_pj].set(-BIG),
+                            failed, c.pipe_cnt.at[prev].set(-BIG),
                             c.pipe_cnt))
                     return c._replace(s_alive=c.alive, s_fidle=c.fidle,
                                       s_jalloc=c.jalloc, s_owner=c.owner)
-                c = jax.lax.cond(first, close_and_snapshot, lambda c: c, c)
-
-            def countdown_step(c):
-                # inside a free-fill run: the state was pre-applied at the
-                # fill step; just emit the node and tick down
-                c = c._replace(countdown=c.countdown - 1)
-                return c, c.prev_node
-
-            def eval_step(c):
-                active = c.pipe_cnt[pj] < needed[pj]
-                if not gang_commit:
-                    active = active & ~c.stopped[pj]
-                return jax.lax.cond(active, active_step, inactive_step, c)
+                c = jax.lax.cond(first_of_job[i], close_and_snapshot,
+                                 lambda c: c, c)
 
             def inactive_step(c):
-                return c._replace(prev_ok=jnp.zeros((), bool)), \
-                    jnp.asarray(NO_NODE, jnp.int32)
+                # quota met: every remaining task of the job is inactive
+                # too — skip the whole job
+                return c._replace(i=jend + 1, last_pj=pj,
+                                  prev_ok=jnp.zeros((), bool))
 
             def active_step(c):
                 cand_v = cand_mask[pj]                       # [V+1]
                 ls = _share(c.jalloc[pjg_i] + req, total) if has_drf \
                     else None
                 quota_left = needed[pj] - c.pipe_cnt[pj]
+                run_left_i = rend - i + 1
 
                 def dynamic_for(rows):
                     if not has_drf:
                         return lambda cand_x: (cand_x, None)
-                    return _drf_dynamic(nw, c.jalloc, total, ls, rows=rows)
+                    return _drf_dynamic(nw, before, c.jalloc, total, ls,
+                                        rows=rows)
+
+                # row-local re-evaluation on the previous node: exact tier
+                # dispatch restricted to one row, W-sized ops, computed
+                # unconditionally (it is tiny next to the [N, W] dispatch)
+                # so the full dispatch is traced exactly ONCE
+                b0 = c.prev_node
+                slots_b = nw.vslot[b0]                       # [W]
+                cand_b = c.alive[b0] & cand_v[slots_b] & nw.valid[b0]
+                masks_b = [((stk[:, pj, :][:, slots_b][:, None]
+                             if stk.shape[0] else stk), part[:, pj])
+                           for stk, part in tier_masks]
+                elig_b, dyn_dec_b, rs_b = _tier_eval(
+                    tier_kinds, masks_b, cand_b[None],
+                    dynamic_for(b0[None]))
+                elig_b = elig_b[0]
+                evictable_b = jnp.sum(
+                    nw.vreq[b0] * elig_b[:, None].astype(fdtype),
+                    axis=0)
+                fits_b = jnp.all(req < c.fidle[b0] + evictable_b
+                                 + EPS) & jnp.any(elig_b)
+                can_cheap = (jnp.asarray(allow_cheap) & (rid == c.prev_rid)
+                             & c.prev_ok & fits_b)
 
                 def full_eval():
                     masks_g = _gather_tier_masks(tier_masks, pj, nw.vslot)
@@ -377,68 +436,31 @@ def build_preempt_scan(tier_kinds: Tuple[str, ...],
                     fits = (jnp.all(
                         req[None, :] < c.fidle + evictable + EPS,
                         axis=-1) & has_victim)
-                    row = jnp.where(fits, score[p_ix], -jnp.inf)
+                    row = jnp.where(fits, score_g[rid], -jnp.inf)
                     best = jnp.argmax(row).astype(jnp.int32)
                     found = row[best] > -jnp.inf
-                    k, evicted, t_w = _fill_schedule(
-                        nw.vreq[best], c.fidle[best], elig[best],
-                        rs[best] if has_drf else None,
-                        dyn_dec[best], req, c.jalloc[pjg_i], total,
-                        run_left_i, quota_left, has_drf)
-                    return best, found, k, evicted, t_w
+                    return (best, found, elig[best],
+                            rs[best] if has_drf else rs,
+                            dyn_dec[best])
 
-                def cheap_attempt():
-                    # node-local re-evaluation on the previous node (exact
-                    # tier dispatch restricted to one row; W-sized ops);
-                    # falls back to the full dispatch when the node no
-                    # longer fits. full_eval is deliberately traced into
-                    # both this fallback and the outer cond — costs one
-                    # extra HLO copy at (cached) compile time, but full
-                    # steps skip the row-local eval entirely at runtime
-                    b0 = c.prev_node
-                    slots_b = nw.vslot[b0]                   # [W]
-                    cand_b = c.alive[b0] & cand_v[slots_b] & nw.valid[b0]
-                    masks_b = [((stk[:, pj, :][:, slots_b][:, None]
-                                 if stk.shape[0] else stk), part[:, pj])
-                               for stk, part in tier_masks]
-                    elig_b, dyn_dec_b, rs_b = _tier_eval(
-                        tier_kinds, masks_b, cand_b[None],
-                        dynamic_for(b0[None]))
-                    elig_b = elig_b[0]
-                    evictable_b = jnp.sum(
-                        nw.vreq[b0] * elig_b[:, None].astype(fdtype),
-                        axis=0)
-                    fits_b = jnp.all(req < c.fidle[b0] + evictable_b
-                                     + EPS) & jnp.any(elig_b)
+                def cheap_eval():
+                    return (b0, jnp.ones((), bool), elig_b,
+                            rs_b[0] if has_drf else rs_b,
+                            dyn_dec_b[0])
 
-                    def keep_node():
-                        k, evicted, t_w = _fill_schedule(
-                            nw.vreq[b0], c.fidle[b0], elig_b,
-                            rs_b[0] if has_drf else None,
-                            dyn_dec_b[0], req, c.jalloc[pjg_i], total,
-                            run_left_i, quota_left, has_drf)
-                        return b0, jnp.ones((), bool), k, evicted, t_w
-                    return jax.lax.cond(fits_b, keep_node, full_eval)
-
-                def failed_eval():
-                    return (jnp.zeros((), jnp.int32), jnp.zeros((), bool),
-                            jnp.zeros((), jnp.int32), jnp.zeros(W, bool),
-                            jnp.zeros(W, jnp.int32))
-
-                try_cheap = (jnp.asarray(allow_cheap) & same_prev_i
-                             & c.prev_ok)
-                skip_fail = same_prev_i & c.prev_fail & ~c.prev_ok
-                best, found, k, evicted, t_w = jax.lax.cond(
-                    skip_fail, failed_eval,
-                    lambda: jax.lax.cond(try_cheap, cheap_attempt,
-                                         full_eval))
+                best, found, elig_row, rs_row, dyn_dec_b0 = jax.lax.cond(
+                    can_cheap, cheap_eval, full_eval)
+                k, evicted, t_w = _fill_schedule(
+                    nw.vreq[best], c.fidle[best], elig_row, rs_row,
+                    dyn_dec_b0, req, c.jalloc[pjg_i], total,
+                    run_left_i, quota_left, has_drf)
                 if not allow_cheap:
                     # multi-placement fills share the same exactness
                     # precondition as the same-node shortcut (dynamic tier
                     # last): a mid-stack dynamic tier could drain mid-fill
                     # and hand another node to a lower tier
                     k = jnp.minimum(k, 1)
-                ok = found & ~skip_fail
+                ok = found
                 k = jnp.where(ok, jnp.maximum(k, 1), 0)
                 evicted = evicted & (t_w <= k) & ok
 
@@ -451,10 +473,10 @@ def build_preempt_scan(tier_kinds: Tuple[str, ...],
                     jalloc = jalloc - job_onehot.T @ (
                         nw.vreq[best] * evicted[:, None].astype(fdtype))
                     alive = alive.at[best].set(alive[best] & ~evicted)
-                    # victims belong to the run step of the attempt that
+                    # victims belong to the chunk step of the attempt that
                     # wanted them — the replay groups evictions per task
                     owner = owner.at[best].set(
-                        jnp.where(evicted, p_ix + t_w - 1, owner[best]))
+                        jnp.where(evicted, i + t_w - 1, owner[best]))
                     freed = jnp.sum(
                         nw.vreq[best] * evicted[:, None].astype(fdtype),
                         axis=0)
@@ -467,41 +489,45 @@ def build_preempt_scan(tier_kinds: Tuple[str, ...],
                 placed = k.astype(fdtype)
                 delta = freed - req * placed
                 jalloc = jalloc.at[pjg_i].add(req * placed)
-                c = c._replace(
+                task_node = jnp.where((iota_p >= i) & (iota_p < i + k),
+                                      best, c.task_node)
+                # fail: the rest of the run re-fails (skip to rend+1 in
+                # phase 1; phase 2 stops the whole job at first failure —
+                # jobs are cursor-contiguous, so the jump IS the stop)
+                fail_to = rend + 1 if gang_commit else jend + 1
+                next_i = jnp.where(ok, i + k, fail_to)
+                return c._replace(
+                    i=next_i, last_pj=pj,
                     fidle=c.fidle.at[best].add(delta),
                     alive=alive,
                     jalloc=jalloc,
                     owner=owner,
+                    task_node=task_node,
                     pipe_cnt=c.pipe_cnt.at[pj].add(k),
-                    stopped=c.stopped.at[pj].set(c.stopped[pj] | ~ok),
-                    prev_node=best, prev_ok=ok, prev_fail=~ok,
-                    countdown=jnp.where(ok, k - 1, 0))
-                out_node = jnp.where(ok, best, NO_NODE).astype(jnp.int32)
-                return c, out_node
+                    prev_node=best, prev_ok=ok, prev_rid=rid)
 
-            return jax.lax.cond(c.countdown > 0, countdown_step,
-                                eval_step, c)
+            active = c.pipe_cnt[pj] < needed[pj]
+            return jax.lax.cond(active, active_step, inactive_step, c)
 
         PJ = needed.shape[0]
         c0 = Carry(
+            i=jnp.zeros((), jnp.int32),
+            last_pj=jnp.full((), -1, jnp.int32),
             alive=jnp.ones((N, W), bool), fidle=future_idle0,
             jalloc=jalloc0, pipe_cnt=jnp.zeros(PJ, jnp.int32),
             owner=jnp.full((N, W), -1, jnp.int32),
-            stopped=jnp.zeros(PJ, bool),
+            task_node=jnp.full(P, NO_NODE, jnp.int32),
             prev_node=jnp.zeros((), jnp.int32),
-            prev_ok=jnp.zeros((), bool), prev_fail=jnp.zeros((), bool),
-            countdown=jnp.zeros((), jnp.int32),
+            prev_ok=jnp.zeros((), bool),
+            prev_rid=jnp.full((), -1, jnp.int32),
             s_alive=jnp.ones((N, W), bool), s_fidle=future_idle0,
             s_jalloc=jalloc0, s_owner=jnp.full((N, W), -1, jnp.int32))
 
-        prev_pj = jnp.concatenate([jnp.full(1, -1, jnp.int32), pjob[:-1]])
-        xs = (jnp.arange(P), preq, pjob, pjg, first_of_job, same_prev,
-              run_left, prev_pj)
-        c, task_node = jax.lax.scan(step, c0, xs)
+        c = jax.lax.while_loop(lambda c: c.i < P, body, c0)
 
         if gang_commit:
-            last_pj = pjob[-1]
-            failed = c.pipe_cnt[last_pj] < needed[last_pj]
+            last_pj = c.last_pj
+            failed = (last_pj >= 0) & (c.pipe_cnt[last_pj] < needed[last_pj])
             c = c._replace(
                 alive=jnp.where(failed, c.s_alive, c.alive),
                 owner=jnp.where(failed, c.s_owner, c.owner),
@@ -510,6 +536,7 @@ def build_preempt_scan(tier_kinds: Tuple[str, ...],
                                    c.pipe_cnt))
 
         job_done = c.pipe_cnt >= needed
+        task_node = c.task_node
         if gang_commit:
             # gang statements: only quota-met jobs keep their placements.
             # The intra-job phase commits every attempt (needed is a BIG
@@ -517,14 +544,14 @@ def build_preempt_scan(tier_kinds: Tuple[str, ...],
             task_node = jnp.where(job_done[pjob], task_node, NO_NODE)
         return task_node, c.owner, job_done
 
-    return jax.jit(scan_fn)
+    return jax.jit(walk_fn)
 
 
 @functools.lru_cache(maxsize=16)
-def build_reclaim_scan(tier_kinds: Tuple[str, ...],
+def build_reclaim_walk(tier_kinds: Tuple[str, ...],
                        tier_sizes: Tuple[int, ...],
                        allow_cheap: bool = True):
-    """Compile a reclaim scan for one tier structure (reclaim.go:40-192).
+    """Compile a reclaim walk for one tier structure (reclaim.go:40-192).
 
     Node walk takes the FIRST node (index order — the reference iterates
     ssn.Nodes without scoring) where the eligible victims alone cover the
@@ -541,114 +568,127 @@ def build_reclaim_scan(tier_kinds: Tuple[str, ...],
     cheap node-local step: within a run, candidate queues only lose
     allocation (the reclaimer's own queue gains, but its victims are
     excluded by the cross-queue candidate filter), so the first-feasible
-    node can only move later, never earlier. Reclaim placements always
-    evict (the evictions alone must cover the request), so there is no
-    free-fill countdown here.
+    node can only move later, never earlier.
+
+    Like the preempt walk, this is a ``lax.while_loop`` over a task
+    cursor: each successful placement costs one iteration, a FAILED task
+    jumps the cursor past the whole job (the job leaves its queue's
+    rotation at its first failure), and a job completing all its tasks
+    jumps past the whole queue (the queue leaves the action). Tasks are
+    assembled queue-contiguous then job-contiguous, so the jumps are index
+    arithmetic. Iterations ~= successful placements + failed jobs, not
+    the pending-task count.
     """
 
-    def scan_fn(future_idle0, nw: EvictNW, cand_mask, tier_masks,
-                preq, pjob, pqueue, last_of_job, same_prev,
-                qalloc0, qdeserved):
+    def walk_fn(future_idle0, nw: EvictNW, cand_mask, tier_masks,
+                preq, pjob, pqueue, run_id, job_end, queue_end,
+                last_of_job, qalloc0, qdeserved):
         N, W, R = nw.vreq.shape
         P = preq.shape[0]
-        PJ = cand_mask.shape[0]
         Q1 = qalloc0.shape[0]
         fdtype = preq.dtype
         has_prop = any(k == "proportion" for k in tier_kinds)
 
-        def step(c, xs):
-            alive, fidle, qalloc, owner, job_stop, queue_stop, \
-                prev_node, prev_ok = c
-            p_ix, req, pj, pq, last, same_prev_i = xs
+        class Carry(NamedTuple):
+            i: jnp.ndarray           # i32[] task cursor
+            alive: jnp.ndarray       # bool[N, W]
+            fidle: jnp.ndarray       # f32[N, R]
+            qalloc: jnp.ndarray      # f32[Q+1, R]
+            owner: jnp.ndarray       # i32[N, W]
+            task_node: jnp.ndarray   # i32[P]
+            prev_node: jnp.ndarray   # i32[]
+            prev_ok: jnp.ndarray     # bool[]
+            prev_rid: jnp.ndarray    # i32[]
 
-            def inactive_step(c):
-                (alive, fidle, qalloc, owner, job_stop, queue_stop,
-                 prev_node, _) = c
-                return (alive, fidle, qalloc, owner, job_stop, queue_stop,
-                        prev_node, jnp.zeros((), bool)), \
-                    jnp.asarray(NO_NODE, jnp.int32)
+        def body(c: Carry) -> Carry:
+            i = c.i
+            req = preq[i]
+            pj = pjob[i]
+            pq = pqueue[i]
+            rid = run_id[i]
+            last = last_of_job[i]
+            cand_v = cand_mask[pj]
 
-            def active_step(c):
-                alive, fidle, qalloc, owner, job_stop, queue_stop, \
-                    prev_node, prev_ok = c
-                cand_v = cand_mask[pj]
+            def dynamic_for(rows):
+                if not has_prop:
+                    return lambda cand_x: (cand_x, None)
+                return _proportion_dynamic(nw, c.qalloc, qdeserved,
+                                           rows=rows)
 
-                def dynamic_for(rows):
-                    if not has_prop:
-                        return lambda cand_x: (cand_x, None)
-                    return _proportion_dynamic(nw, qalloc, qdeserved,
-                                               rows=rows)
+            b0 = c.prev_node
+            slots_b = nw.vslot[b0]
+            cand_b = c.alive[b0] & cand_v[slots_b] & nw.valid[b0]
+            masks_b = [((stk[:, pj, :][:, slots_b][:, None]
+                         if stk.shape[0] else stk), part[:, pj])
+                       for stk, part in tier_masks]
+            elig_b = _tier_eval(tier_kinds, masks_b, cand_b[None],
+                                dynamic_for(b0[None]))[0][0]
+            evictable_b = jnp.sum(
+                nw.vreq[b0] * elig_b[:, None].astype(fdtype), axis=0)
+            fits_b = (jnp.all(req < c.fidle[b0] + evictable_b + EPS)
+                      & jnp.all(req < evictable_b + EPS))
 
-                b0 = prev_node
-                slots_b = nw.vslot[b0]
-                cand_b = alive[b0] & cand_v[slots_b] & nw.valid[b0]
-                masks_b = [((stk[:, pj, :][:, slots_b][:, None]
-                             if stk.shape[0] else stk), part[:, pj])
-                           for stk, part in tier_masks]
-                elig_b = _tier_eval(tier_kinds, masks_b, cand_b[None],
-                                    dynamic_for(b0[None]))[0][0]
-                evictable_b = jnp.sum(
-                    nw.vreq[b0] * elig_b[:, None].astype(fdtype), axis=0)
-                fits_b = (jnp.all(req < fidle[b0] + evictable_b + EPS)
-                          & jnp.all(req < evictable_b + EPS))
+            can_cheap = (jnp.asarray(allow_cheap) & (rid == c.prev_rid)
+                         & c.prev_ok & fits_b)
+            need_full = ~can_cheap
 
-                can_cheap = (jnp.asarray(allow_cheap) & same_prev_i
-                             & prev_ok & fits_b)
-                need_full = ~can_cheap
+            def full_eval():
+                masks_g = _gather_tier_masks(tier_masks, pj, nw.vslot)
+                cand = c.alive & cand_v[nw.vslot] & nw.valid
+                elig = _tier_eval(tier_kinds, masks_g, cand,
+                                  dynamic_for(None))[0]
+                elig_f = elig.astype(fdtype)
+                evictable = jnp.sum(nw.vreq * elig_f[..., None], axis=1)
+                covers = jnp.all(
+                    req[None, :] < c.fidle + evictable + EPS, axis=-1)
+                enough = jnp.all(req[None, :] < evictable + EPS, axis=-1)
+                fits = covers & enough
+                best = jnp.argmax(fits).astype(jnp.int32)
+                return best, fits[best], elig[best]
 
-                def full_eval():
-                    masks_g = _gather_tier_masks(tier_masks, pj, nw.vslot)
-                    cand = alive & cand_v[nw.vslot] & nw.valid
-                    elig = _tier_eval(tier_kinds, masks_g, cand,
-                                      dynamic_for(None))[0]
-                    elig_f = elig.astype(fdtype)
-                    evictable = jnp.sum(nw.vreq * elig_f[..., None],
-                                        axis=1)
-                    covers = jnp.all(
-                        req[None, :] < fidle + evictable + EPS, axis=-1)
-                    enough = jnp.all(req[None, :] < evictable + EPS,
-                                     axis=-1)
-                    fits = covers & enough
-                    best = jnp.argmax(fits).astype(jnp.int32)
-                    return best, fits[best], elig[best]
+            def cheap_eval():
+                return b0, fits_b, elig_b
 
-                def cheap_eval():
-                    return b0, fits_b, elig_b
+            best, found, elig_row = jax.lax.cond(
+                need_full, full_eval, cheap_eval)
+            ok = jnp.where(need_full, found, can_cheap)
 
-                best, found, elig_row = jax.lax.cond(
-                    need_full, full_eval, cheap_eval)
-                ok = jnp.where(need_full, found, can_cheap)
+            # reclaim evicts until the EVICTIONS alone cover the
+            # request (reclaim.go:93-96), independent of node idle
+            evicted, freed = _pop_until_fit(
+                nw, best, elig_row, req, jnp.zeros(R, fdtype), ok)
+            fidle = c.fidle.at[best].add((freed - req) * ok.astype(fdtype))
+            vq_row = nw.vgroup[best]
+            q_onehot = jax.nn.one_hot(vq_row, Q1, dtype=fdtype)
+            qalloc2 = c.qalloc - q_onehot.T @ (
+                nw.vreq[best] * evicted[:, None].astype(fdtype))
+            qalloc2 = qalloc2.at[pq].add(req * ok.astype(fdtype))
+            alive = c.alive.at[best].set(c.alive[best] & ~evicted)
+            owner = c.owner.at[best].set(
+                jnp.where(evicted, i, c.owner[best]))
+            task_node = jnp.where(ok, c.task_node.at[i].set(best),
+                                  c.task_node)
+            # fail -> the job leaves its queue's rotation: skip its
+            # remaining tasks. ok & last -> the queue leaves the action:
+            # skip its remaining jobs.
+            next_i = jnp.where(ok,
+                               jnp.where(last, queue_end[i] + 1, i + 1),
+                               job_end[i] + 1)
+            return Carry(i=next_i, alive=alive, fidle=fidle,
+                         qalloc=qalloc2, owner=owner, task_node=task_node,
+                         prev_node=best, prev_ok=ok, prev_rid=rid)
 
-                # reclaim evicts until the EVICTIONS alone cover the
-                # request (reclaim.go:93-96), independent of node idle
-                evicted, freed = _pop_until_fit(
-                    nw, best, elig_row, req, jnp.zeros(R, fdtype), ok)
-                fidle = fidle.at[best].add(
-                    (freed - req) * ok.astype(fdtype))
-                vq_row = nw.vgroup[best]
-                q_onehot = jax.nn.one_hot(vq_row, Q1, dtype=fdtype)
-                qalloc2 = qalloc - q_onehot.T @ (
-                    nw.vreq[best] * evicted[:, None].astype(fdtype))
-                qalloc2 = qalloc2.at[pq].add(req * ok.astype(fdtype))
-                alive = alive.at[best].set(alive[best] & ~evicted)
-                owner = owner.at[best].set(
-                    jnp.where(evicted, p_ix, owner[best]))
-                job_stop = job_stop.at[pj].set(job_stop[pj] | ~ok)
-                queue_stop = queue_stop.at[pq].set(queue_stop[pq]
-                                                   | (ok & last))
-                out_node = jnp.where(ok, best, NO_NODE).astype(jnp.int32)
-                return (alive, fidle, qalloc2, owner, job_stop,
-                        queue_stop, best, ok), out_node
+        c0 = Carry(
+            i=jnp.zeros((), jnp.int32),
+            alive=jnp.ones((N, W), bool), fidle=future_idle0,
+            qalloc=qalloc0,
+            owner=jnp.full((N, W), -1, jnp.int32),
+            task_node=jnp.full(P, NO_NODE, jnp.int32),
+            prev_node=jnp.zeros((), jnp.int32),
+            prev_ok=jnp.zeros((), bool),
+            prev_rid=jnp.full((), -1, jnp.int32))
 
-            active = ~job_stop[pj] & ~queue_stop[pq]
-            return jax.lax.cond(active, active_step, inactive_step, c)
+        c = jax.lax.while_loop(lambda c: c.i < P, body, c0)
+        return c.task_node, c.owner
 
-        c0 = (jnp.ones((N, W), bool), future_idle0, qalloc0,
-              jnp.full((N, W), -1, jnp.int32), jnp.zeros(PJ, bool),
-              jnp.zeros(Q1, bool), jnp.zeros((), jnp.int32),
-              jnp.zeros((), bool))
-        xs = (jnp.arange(P), preq, pjob, pqueue, last_of_job, same_prev)
-        c, task_node = jax.lax.scan(step, c0, xs)
-        return task_node, c[3]
-
-    return jax.jit(scan_fn)
+    return jax.jit(walk_fn)
