@@ -393,3 +393,22 @@ class TestParallelCallbacksEngine:
             binds[engine] = dict(binder.binds)
         # node-level (not just admission-level) parity
         assert binds["callbacks"] == binds["callbacks-parallel"]
+
+
+def test_gpu_config_capacity_and_parity():
+    """BASELINE config 5 correctness (VERDICT r3 #4) at the tractable
+    gpu-small scale: tpu-fused admissions must equal the callbacks engine
+    with GPU predicates on, and the bind count must equal the capacity
+    truth certified by bench.gpu_capacity_truth's independent first-fit
+    packer."""
+    from bench import gpu_capacity_truth, run_cycle
+
+    expected = gpu_capacity_truth("gpu-small")
+    _, adm_c, binds_c = run_cycle("gpu-small", "callbacks")
+    _, adm_t, binds_t = run_cycle("gpu-small", "tpu-fused")
+    assert adm_c == adm_t
+    assert binds_c == binds_t
+    # FFD placing everything certifies full-packing feasibility; this
+    # config is built to be certifiable (16k GPUs for 800 1-GPU tasks)
+    assert expected is not None
+    assert binds_t == expected

@@ -260,3 +260,44 @@ def test_reclaim_engine_parity(seed):
             if t.status == TaskStatus.PIPELINED)
         results[engine] = (sorted(evictor.evicts), pipelined)
     assert results["tpu"] == results["callbacks"]
+
+
+def test_f64_score_replica_bit_identity():
+    """The vectorized f64 scorer must be BIT-identical to the live python
+    node_order chain — the rank upload reproduces exact f64 ordering only
+    if the matrix itself is exact (evict_tpu._f64_scores)."""
+    import numpy as np
+    from volcano_tpu.actions.evict_tpu import _f64_scores
+    from volcano_tpu.cache.snapshot import NodeTensors, discover_resource_names
+    from volcano_tpu.cache.synthetic import baseline_config
+    from volcano_tpu.framework import close_session, open_session, \
+        parse_scheduler_conf
+
+    conf = parse_scheduler_conf(None)
+    cache, _, _ = baseline_config("preempt-small", seed=0)
+    ssn = open_session(cache, conf.tiers, [])
+    try:
+        tasks = [t for j in ssn.jobs.values() for t in j.tasks.values()
+                 if not t.resreq.is_empty()][:7]
+        nodes = list(ssn.nodes.values())
+        rnames = discover_resource_names(nodes, tasks)
+        node_t = NodeTensors(nodes, rnames)
+        mat = _f64_scores(ssn, tasks, node_t)
+        assert mat is not None
+        for g, task in enumerate(tasks):
+            py = np.asarray([ssn.node_order_fn(task, n) for n in nodes],
+                            np.float64)
+            batch = ssn.batch_node_order_fn(task, nodes) or {}
+            for name, s in batch.items():
+                py[node_t.index[name]] += s
+            # the replica may skip provably rank-constant terms (the stock
+            # batch taint score on a taint-free cluster), so the pinned
+            # invariant is DENSE-RANK equality — exactly what the device
+            # argmax consumes — via bit-identity up to a constant shift
+            diff = mat[g] - py
+            assert np.all(diff == diff[0]), np.max(np.abs(diff - diff[0]))
+            _, inv_m = np.unique(mat[g], return_inverse=True)
+            _, inv_p = np.unique(py, return_inverse=True)
+            assert np.array_equal(inv_m, inv_p)
+    finally:
+        close_session(ssn)

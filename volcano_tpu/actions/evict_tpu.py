@@ -27,7 +27,7 @@ import numpy as np
 from ..api import PodGroupPhase, Resource, TaskInfo, TaskStatus
 from ..cache.snapshot import (NodeTensors, assemble_feasibility,
                               assemble_static_score, assemble_weights,
-                              discover_resource_names, task_requests)
+                              discover_resource_names)
 from ..framework.session import ABSTAIN
 from ..utils import PriorityQueue
 
@@ -40,10 +40,10 @@ BIG = 1 << 30
 # decisions are identical by the parity contract either way. Preempt's
 # callbacks path does per-(task, node) predicate+score loops and loses to
 # the device even at a few hundred victims, so it never delegates by
-# default; reclaim's callbacks path exits early through the rotation
-# quirks and stays cheap at small scale. Override with the action
-# configuration key ``device-min-victims``.
-DEVICE_MIN_VICTIMS = {"preempt": 0, "reclaim": 1024}
+# default. Override with the action configuration key
+# ``device-min-victims``. (Reclaim has no device kernel anymore — its
+# exact screened rotation runs on host at every scale.)
+DEVICE_MIN_VICTIMS = {"preempt": 0}
 
 # above this many victims on ONE node the dense [N, W] slot layout
 # degenerates (mostly pads; with a drf tier the walk also materializes an
@@ -60,17 +60,82 @@ def _device_min_victims(ssn, action_name: str) -> int:
     return default
 
 
+def _res_rows_f64(resources, rnames) -> np.ndarray:
+    """[M, R] float64 straight from the Resource doubles (to_vector would
+    round through f32 first, destroying the integer-exactness the scaled
+    device arithmetic depends on). Column-wise comprehensions — the naive
+    per-(resource, name) .get() costs ~100ms per eviction cycle at 10k
+    tasks on the 1-CPU bench host."""
+    out = np.empty((len(resources), len(rnames)), np.float64)
+    for k, n in enumerate(rnames.names):
+        if n == "cpu":
+            out[:, k] = [r.cpu for r in resources]
+        elif n == "memory":
+            out[:, k] = [r.memory for r in resources]
+        else:
+            out[:, k] = [r.scalars.get(n, 0.0) for r in resources]
+    return out
+
+
+def _dim_scale(vals: np.ndarray) -> np.ndarray:
+    """Per-dimension GCD of every quantity the device will see.
+
+    Dividing by it turns memory-scale values (~1e11 bytes, f32 ULP ~8e3 —
+    far above the 0.1 epsilon the host Resource comparisons use) into
+    SMALL EXACT f32 integers, so every in-kernel sum and fit comparison is
+    exact rational arithmetic and decisions match the callback engine's
+    f64 bit-for-bit. Dimensions with non-integral or overflowing values
+    keep scale 1 (no worse than the unscaled engine)."""
+    R = vals.shape[1]
+    scale = np.ones(R, np.float64)
+    for r in range(R):
+        v = vals[:, r]
+        v = v[np.isfinite(v) & (v != 0)]
+        if v.size == 0:
+            continue
+        if not np.all(v == np.floor(v)) or np.any(np.abs(v) >= 2 ** 62):
+            continue
+        g = float(np.gcd.reduce(np.abs(v).astype(np.int64)))
+        if g > 1:
+            scale[r] = g
+    return scale
+
+
 class _EvictTensors:
     """Shared device-side inputs for one eviction action, including the
-    [N, W] node-major victim slot layout (ops/evict.py EvictNW)."""
+    [N, W] node-major victim slot layout (ops/evict.py EvictNW).
+
+    All resource quantities are divided by the per-dimension GCD
+    (``self.scale``) so the device works in small exact integers — see
+    _dim_scale. Shares and scores are scale-invariant ratios; fit
+    comparisons become exact."""
 
     def __init__(self, ssn, victims: List[TaskInfo],
                  preemptors: List[TaskInfo]):
         self.victims = victims
         self.rnames = discover_resource_names(
             list(ssn.nodes.values()), victims + preemptors)
-        self.node_t = NodeTensors(list(ssn.nodes.values()), self.rnames)
-        self.vreq = task_requests_of(victims, self.rnames, init=False)
+        nodes = list(ssn.nodes.values())
+        self.node_t = NodeTensors(nodes, self.rnames)
+        idle64 = _res_rows_f64([n.idle for n in nodes], self.rnames)
+        rel64 = _res_rows_f64([n.releasing for n in nodes], self.rnames)
+        pip64 = _res_rows_f64([n.pipelined for n in nodes], self.rnames)
+        alloc64 = _res_rows_f64([n.allocatable for n in nodes], self.rnames)
+        vreq64 = _res_rows_f64([t.resreq for t in victims], self.rnames)
+        preq64 = _res_rows_f64([t.init_resreq for t in preemptors],
+                               self.rnames)
+        self._jobs_order = list(ssn.jobs)
+        jalloc64 = _res_rows_f64(
+            [j.allocated for j in ssn.jobs.values()], self.rnames)
+        self.scale = _dim_scale(np.vstack(
+            [idle64, rel64, pip64, alloc64, vreq64, preq64, jalloc64]))
+        self._fidle0 = ((idle64 + rel64 - pip64) / self.scale) \
+            .astype(np.float32)
+        self.alloc_total = (alloc64 / self.scale).sum(axis=0) \
+            .astype(np.float32)
+        self.jalloc_scaled = (jalloc64 / self.scale).astype(np.float32)
+        self.preq = (preq64 / self.scale).astype(np.float32)
+        self.vreq = (vreq64 / self.scale).astype(np.float32)
         self.vnode = np.asarray(
             [self.node_t.index[t.node_name] for t in victims], np.int32)
         V = len(victims)
@@ -95,8 +160,7 @@ class _EvictTensors:
         self.vreq_nw = vreq_pad[self.vslot]
 
     def future_idle0(self):
-        return (self.node_t.idle + self.node_t.releasing
-                - self.node_t.pipelined)
+        return self._fidle0
 
     def nw_inputs(self, vgroup: np.ndarray, n_groups: int,
                   vrank: Optional[np.ndarray]):
@@ -136,14 +200,6 @@ class _EvictTensors:
                 out.setdefault(int(flat_owner[k]), []).append(
                     self.victims[v])
         return out
-
-
-def task_requests_of(tasks, rnames, init=True) -> np.ndarray:
-    req = np.zeros((len(tasks), len(rnames)), np.float32)
-    for i, t in enumerate(tasks):
-        r = t.init_resreq if init else t.resreq
-        req[i] = r.to_vector(rnames)
-    return req
 
 
 def _max_per_node(victims: List[TaskInfo]) -> int:
@@ -395,13 +451,11 @@ def _drf_inputs(ssn, tensors: _EvictTensors, victims, need_group: bool):
     is maintained as exactly the sum of allocated-status task resreqs
     (api/job_info.py update_task_status), so one to_vector per job replaces
     the per-task accumulation."""
-    job_index = {uid: i for i, uid in enumerate(ssn.jobs)}
-    AJ = len(job_index)
+    job_index = {uid: i for i, uid in enumerate(tensors._jobs_order)}
     R = len(tensors.rnames)
-    jalloc = np.zeros((AJ + 1, R), np.float32)
-    for uid, job in ssn.jobs.items():
-        jalloc[job_index[uid]] = job.allocated.to_vector(tensors.rnames)
-    total = tensors.node_t.allocatable.sum(axis=0)
+    jalloc = np.vstack([tensors.jalloc_scaled,
+                        np.zeros((1, R), np.float32)])
+    total = tensors.alloc_total
     vjob = np.asarray([job_index[t.job] for t in victims], np.int32)
     vrank = None
     if need_group and victims:
@@ -409,6 +463,165 @@ def _drf_inputs(ssn, tensors: _EvictTensors, victims, need_group: bool):
         vrank = np.asarray([rank.get(t.uid, 0) for t in victims],
                            np.int64)
     return vjob, jalloc, total, vrank, job_index
+
+
+def _stock_node_order_chain(ssn):
+    """The enabled node-order chain when EVERY entry is a stock scorer with
+    an exact f64 vectorization below — [(kind, plugin), ...] in tier order,
+    or None when an unknown scorer participates."""
+    out = []
+    for _, fn in ssn._enabled_fns(ssn.node_order_fns, "enabledNodeOrder"):
+        mod = getattr(fn, "__module__", "")
+        qn = getattr(fn, "__qualname__", "")
+        owner = getattr(fn, "__self__", None)
+        if mod == "volcano_tpu.plugins.nodeorder" and \
+                qn.endswith("._score") and owner is not None:
+            out.append(("nodeorder", owner))
+        elif mod == "volcano_tpu.plugins.binpack" and \
+                qn.endswith(".score") and owner is not None:
+            out.append(("binpack", owner))
+        else:
+            return None
+    return out
+
+
+def _f64_rank_scores(ssn, rep_tasks, node_t) -> Optional[np.ndarray]:
+    """f32[G, N] DENSE RANKS of the exact f64 node scores the callback
+    engine computes.
+
+    The callback path scores per (task, node) in Python doubles; shipping
+    f32 scores to the device flips near-ties, which picks a different
+    (equal-fitness) node and therefore different victim identities — the
+    only full-scale preempt divergence r4 found. Ranks sidestep precision
+    entirely: the host replicates the stock scorers' arithmetic in f64
+    (same expressions, same accumulation order, straight from the Resource
+    doubles — NOT the f32 NodeTensors), adds the live batch-scorer
+    contributions, and dense-ranks each row; the device argmax over ranks
+    then reproduces the exact f64 ordering with the same first-index
+    tie-break as sort_nodes/select_best_node. Ranks < 2^24 are exact in
+    f32. Returns None when a non-stock scorer or per-node preferred
+    node-affinity term participates (callers fall back to f32 scores)."""
+    total = _f64_scores(ssn, rep_tasks, node_t)
+    if total is None:
+        return None
+    G, N = total.shape
+    ranks = np.empty((G, N), np.float32)
+    for g in range(G):
+        _, inv = np.unique(total[g], return_inverse=True)
+        ranks[g] = inv.astype(np.float32)
+    return ranks
+
+
+def _f64_scores(ssn, rep_tasks, node_t) -> Optional[np.ndarray]:
+    """f64[G, N] bit-exact replica of the callback scorer chain (see
+    _f64_rank_scores; tests pin bit-identity against ssn.node_order_fn)."""
+    chain = _stock_node_order_chain(ssn)
+    if chain is None:
+        return None
+    for task in rep_tasks:
+        if (task.affinity.get("nodeAffinity", {})
+                .get("preferredDuringSchedulingIgnoredDuringExecution")):
+            return None            # per-node python term; no exact replica
+    from ..plugins.podaffinity import session_has_pod_affinity
+    if session_has_pod_affinity(ssn):
+        # the batch pod-affinity scorer normalizes over the candidate
+        # LIST, which differs per attempt — no exact replica
+        return None
+    nodes = [ssn.nodes[name] for name in node_t.names]
+    N, G = len(nodes), len(rep_tasks)
+    stock_batch = all(
+        getattr(fn, "__module__", "") == "volcano_tpu.plugins.nodeorder"
+        for _, fn in ssn._enabled_fns(ssn.batch_node_order_fns,
+                                      "enabledNodeOrder"))
+    need_batch = not stock_batch or any(n.taints for n in nodes)
+    alloc_c = np.asarray([n.allocatable.cpu for n in nodes], np.float64)
+    alloc_m = np.asarray([n.allocatable.memory for n in nodes], np.float64)
+    used_c0 = np.asarray([n.used.cpu for n in nodes], np.float64)
+    used_m0 = np.asarray([n.used.memory for n in nodes], np.float64)
+    sc_safe = np.where(alloc_c != 0, alloc_c, 1.0)
+    sm_safe = np.where(alloc_m != 0, alloc_m, 1.0)
+    MAXS = 100.0                   # MAX_NODE_SCORE
+
+    res_cache: Dict[str, tuple] = {}
+
+    def res_vecs(rname):
+        if rname not in res_cache:
+            res_cache[rname] = (
+                np.asarray([n.allocatable.get(rname) for n in nodes],
+                           np.float64),
+                np.asarray([n.used.get(rname) for n in nodes], np.float64))
+        return res_cache[rname]
+
+    total = np.zeros((G, N), np.float64)
+    for g, task in enumerate(rep_tasks):
+        row = np.zeros(N, np.float64)
+        for kind, plugin in chain:
+            if kind == "nodeorder":
+                # exact replica of NodeOrderPlugin._score (f64, same op
+                # order); the node-affinity term is identically 0.0 here
+                # (preferred-affinity tasks bailed above), and x + 0.0
+                # preserves every f64 bit
+                uc = used_c0 + task.resreq.cpu
+                um = used_m0 + task.resreq.memory
+                s = np.zeros(N, np.float64)
+                if plugin.least_req_weight:
+                    fc = np.where(alloc_c != 0,
+                                  np.maximum(0.0, (alloc_c - uc) / sc_safe),
+                                  0.0)
+                    fm = np.where(alloc_m != 0,
+                                  np.maximum(0.0, (alloc_m - um) / sm_safe),
+                                  0.0)
+                    s = s + plugin.least_req_weight * (fc + fm) / 2 * MAXS
+                if plugin.most_req_weight:
+                    fc = np.where(alloc_c != 0, uc / sc_safe, 0.0)
+                    fm = np.where(alloc_m != 0, um / sm_safe, 0.0)
+                    fc = np.where(fc > 1, 0.0, fc)
+                    fm = np.where(fm > 1, 0.0, fm)
+                    s = s + plugin.most_req_weight * (fc + fm) / 2 * MAXS
+                if plugin.balanced_weight:
+                    fc = np.where(alloc_c != 0,
+                                  np.minimum(1.0, uc / sc_safe), 0.0)
+                    fm = np.where(alloc_m != 0,
+                                  np.minimum(1.0, um / sm_safe), 0.0)
+                    mean = (fc + fm) / 2
+                    std = (((fc - mean) ** 2 + (fm - mean) ** 2) / 2) ** 0.5
+                    s = s + plugin.balanced_weight * (1.0 - std) * MAXS
+                row = row + s
+            else:                  # binpack — exact replica of .score
+                s = np.zeros(N, np.float64)
+                weight_sum = 0
+                for rname in task.resreq.resource_names():
+                    request = task.resreq.get(rname)
+                    if request == 0:
+                        continue
+                    w = plugin.res_weights.get(rname)
+                    if w is None:
+                        continue
+                    allocatable, used = res_vecs(rname)
+                    ok = ((allocatable != 0) & bool(w != 0)
+                          & (used + request <= allocatable))
+                    safe = np.where(allocatable != 0, allocatable, 1.0)
+                    s = s + np.where(ok, (used + request) * w / safe, 0.0)
+                    weight_sum += w
+                if weight_sum > 0:
+                    s = s / weight_sum
+                row = row + s * MAXS * plugin.weight
+        # batch scorers (taint toleration) run as the live python fns —
+        # already f64, one call per representative; per-node independent,
+        # so scoring all nodes equals scoring the feasible subset on every
+        # feasible entry (infeasible rows are masked -inf by the caller).
+        # Skipped entirely when provably rank-constant: the stock batch
+        # scorer adds the same taint score to every node of a taint-free
+        # cluster, and a constant row shift cannot change dense ranks —
+        # calling it would cost ~1000 python calls per representative.
+        if need_batch:
+            for name, s in (ssn.batch_node_order_fn(task, nodes)
+                            or {}).items():
+                ix = node_t.index.get(name)
+                if ix is not None:
+                    row[ix] = row[ix] + s
+        total[g] = row
+    return total
 
 
 def _score_rows(ssn, ptasks, tensors: _EvictTensors, pjob_arr: np.ndarray):
@@ -428,7 +641,7 @@ def _score_rows(ssn, ptasks, tensors: _EvictTensors, pjob_arr: np.ndarray):
     from ..ops.scores import combined_dynamic_score
 
     node_t = tensors.node_t
-    preq = task_requests(ptasks, tensors.rnames)
+    preq = tensors.preq               # gcd-scaled exact integers
     feas = assemble_feasibility(ssn, ptasks, node_t)
     static = assemble_static_score(ssn, ptasks, node_t)
     weights = assemble_weights(ssn, tensors.rnames)
@@ -445,6 +658,17 @@ def _score_rows(ssn, ptasks, tensors: _EvictTensors, pjob_arr: np.ndarray):
     rep = np.flatnonzero(~same)                      # run-start indices
     run_end = _segment_ends(np.r_[~same[1:], True])
 
+    ranks = None
+    if static is None:
+        # f64-exact path: host ranks reproduce the callback engine's exact
+        # f64 score ordering (see _f64_rank_scores) — f32 scores flip
+        # near-ties and pick different equal-fitness nodes
+        ranks = _f64_rank_scores(ssn, [ptasks[i] for i in rep], node_t)
+    if ranks is not None:
+        if feas is not None:
+            ranks = np.where(feas[rep], ranks, -np.inf).astype(np.float32)
+        return preq, jnp.asarray(ranks), run_id, run_end
+
     ms = None
     if feas is not None or static is not None:
         N = len(node_t.names)
@@ -452,7 +676,10 @@ def _score_rows(ssn, ptasks, tensors: _EvictTensors, pjob_arr: np.ndarray):
              else static[rep].astype(np.float32))
         ms = s if feas is None else np.where(feas[rep], s, -np.inf) \
             .astype(np.float32)
-    score_g = combined_dynamic_score(jnp.asarray(preq[rep]),
+    # fallback scorers want the ORIGINAL units (node_t is unscaled)
+    preq_units = (preq[rep].astype(np.float64)
+                  * tensors.scale[None, :]).astype(np.float32)
+    score_g = combined_dynamic_score(jnp.asarray(preq_units),
                                      jnp.asarray(node_t.used),
                                      jnp.asarray(node_t.allocatable), weights)
     if ms is not None:
@@ -801,161 +1028,289 @@ def _victim_tasks_host(ssn) -> None:
     stmt.commit()
 
 
-def execute_reclaim_tpu(ssn) -> None:
-    """Device reclaim: victims from other, reclaimable queues; direct
-    evictions (reclaim.go semantics, no statement)."""
-    import jax.numpy as jnp
-    from ..ops.evict import build_reclaim_walk
 
-    # reclaim evicts in candidate-list order — node.tasks insertion order,
-    # NOT the reversed TaskOrderFn that preempt uses (reclaim.go walks the
-    # Reclaimable result as-is)
-    victims = _collect_victims(ssn)
-    if len(victims) < _device_min_victims(ssn, "reclaim") \
-            or _max_per_node(victims) > MAX_W:
-        from .reclaim import ReclaimAction
-        return ReclaimAction(engine="callbacks")._execute_callbacks(ssn)
 
-    # reclaimers: pending tasks of valid jobs in non-overused queues, in
-    # (queue share, job order, task order) interleave — fixed per action
-    per_queue: Dict[str, PriorityQueue] = {}
-    queues = {}
-    for job in ssn.jobs.values():
-        if job.podgroup.phase == PodGroupPhase.PENDING:
-            continue
-        vr = ssn.job_valid(job)
-        if vr is not None and not vr.passed:
-            continue
-        queue = ssn.queues.get(job.queue)
-        if queue is None or ssn.overused(queue):
-            continue
-        if not job.task_status_index.get(TaskStatus.PENDING):
-            continue
-        queues[queue.uid] = queue
-        per_queue.setdefault(job.queue,
-                             PriorityQueue(ssn.job_order_fn)).push(job)
+class _ReclaimScreener:
+    """Conservative node pre-filter for the EXACT reclaim rotation.
 
-    kept_jobs: List = []
-    ptasks: List[TaskInfo] = []
-    pjob_ix: List[int] = []
-    pqueue_ix: List[int] = []
-    last_of_job: List[bool] = []
-    qorder = sorted(queues.values(),
-                    key=cmp_to_key(lambda l, r: -1 if ssn.queue_order_fn(l, r)
-                                   else 1))
-    for qx, queue in enumerate(qorder):
-        jobs_pq = per_queue.get(queue.uid)
-        while jobs_pq is not None and not jobs_pq.empty():
-            job = jobs_pq.pop()
-            tasks = _pending_in_order(ssn, job)
-            if not tasks:
+    The reference's reclaim is a serial one-task-per-queue-pop rotation
+    whose job/queue ordering re-evaluates between pops
+    (reclaim.go:128-185) — queue-contiguous batching (the r3/r4 device
+    kernel) fires the "queue leaves when a job exhausts its tasks" exit
+    far too early at scale, and a per-attempt device round trip would pay
+    the ~100ms tunnel RTT each. So reclaim runs the LITERAL callback
+    rotation (ReclaimAction._execute_callbacks — live PriorityQueues, live
+    comparators, the real per-node body) and this screener only shrinks
+    the per-attempt node walk from O(N) python to a vectorized f64 mask.
+
+    Superset proof (the body can only ACT on a screened node — evict or
+    pipeline — so screening never changes a decision):
+    - the body needs at least one cross-queue reclaimable-queue RUNNING
+      victim and future_idle + all victims to cover init_resreq
+      (reclaim.py:92-99); it evicts even when the victims alone cannot
+      cover the request (only the pipeline is skipped then), so the
+      screen must NOT require pool-alone coverage;
+    - the screen tests exactly that necessary condition, widened by
+      MIN_RESOURCE per dimension, against LIVE totals: the rotation body
+      reports every eviction (victim leaves the pool, its resreq joins
+      future-idle — the same releasing bump session.evict applies) and
+      every pipeline (future-idle drops) through note_evict /
+      note_pipeline, so head + pool equals the body's own
+      future_idle-plus-victims test at every attempt. A stale-totals
+      screen would NOT be a superset: an eviction by one queue's
+      reclaimer frees head capacity that another SAME-queue-as-victim
+      reclaimer could use, which static totals undercount;
+    - feasibility rows come from the same plugin feasibility fns every
+      device engine uses as predicate-equivalents (cache/snapshot.py),
+      assembled once per job.
+    """
+
+    def __init__(self, ssn):
+        self.ssn = ssn
+        self.nodes = list(ssn.nodes.values())
+        self.names = [n.name for n in self.nodes]
+        self.node_index = {n: i for i, n in enumerate(self.names)}
+        tasks = [t for j in ssn.jobs.values() for t in j.tasks.values()]
+        self.rnames = discover_resource_names(self.nodes, tasks)
+        self.node_t = NodeTensors(self.nodes, self.rnames)
+        N, R = len(self.nodes), len(self.rnames)
+        self.queue_ix = {uid: i for i, uid in enumerate(ssn.queues)}
+        Q = len(self.queue_ix)
+        self.head = _res_rows_f64(
+            [n.future_idle() for n in self.nodes], self.rnames)
+        # victim pools binned by (node, queue, victim-job priority): the
+        # default conf's first reclaimable tier (priority + gang) rules
+        # with exactly the lower-priority victim set whenever that set is
+        # non-empty, so the screen can test coverage against the
+        # lower-priority pool when one exists on the node and the full
+        # pool otherwise — exact-necessary either way. Non-stock tier-1
+        # confs fall back to the full pool (still a superset: tiers only
+        # shrink eligibility).
+        self.tier1_priority = self._tier1_is_priority(ssn)
+        self.tier2_proportion = self._tier2_is_proportion(ssn)
+        # live queue allocations mirror proportion's attrs: evictions
+        # subtract (deallocate event), pipelines add to the reclaimer's
+        # queue (allocate event) — so the tier-2 over-deserved gate below
+        # tracks exactly what proportion.reclaimable will see
+        self.qalloc = np.zeros((Q, R), np.float64)
+        for job in ssn.jobs.values():
+            qx = self.queue_ix.get(job.queue)
+            if qx is not None:
+                self.qalloc[qx] += _res_rows_f64([job.allocated],
+                                                 self.rnames)[0]
+        self.qdeserved = np.full((Q, R), np.inf, np.float64)
+        self.q_has_attr = np.zeros(Q, bool)
+        for name, r in ssn.queue_deserved.items():
+            qx = self.queue_ix.get(name)
+            if qx is not None:
+                self.qdeserved[qx] = _res_rows_f64([r], self.rnames)[0]
+                self.q_has_attr[qx] = True
+        # the BODY's candidate filter, not _collect_victims: the rotation
+        # includes empty-resreq RUNNING tasks too (reclaim.py:81-91), so
+        # they must keep nodes in the walk (they contribute 0 resources
+        # but satisfy the victim-exists gate)
+        victims = [t for node in self.nodes for t in node.tasks.values()
+                   if t.status == TaskStatus.RUNNING and t.job in ssn.jobs]
+        prios = sorted({ssn.jobs[t.job].priority for t in victims} | {0})
+        self.pr_vals = np.asarray(prios, np.int64)
+        self.pr_ix = {p: i for i, p in enumerate(prios)}
+        P = len(prios)
+        vrows = _res_rows_f64([t.resreq for t in victims], self.rnames)
+        self._row_cache: Dict[str, np.ndarray] = {
+            t.uid: vrows[i] for i, t in enumerate(victims)}
+        pools = np.zeros((N, Q, P, R), np.float64)
+        counts = np.zeros((N, Q, P), np.float64)
+        for i, t in enumerate(victims):
+            vq = ssn.jobs[t.job].queue
+            queue = ssn.queues.get(vq)
+            if queue is None or not queue.reclaimable:
                 continue
-            jx = len(kept_jobs)
-            kept_jobs.append(job)
-            for k, t in enumerate(tasks):
-                ptasks.append(t)
-                pjob_ix.append(jx)
-                pqueue_ix.append(qx)
-                last_of_job.append(k == len(tasks) - 1)
-    if not ptasks or not victims:
-        return
+            qx = self.queue_ix.get(vq)
+            n = self.node_index.get(t.node_name)
+            if qx is None or n is None:
+                continue
+            px = self.pr_ix[ssn.jobs[t.job].priority]
+            pools[n, qx, px] += vrows[i]
+            counts[n, qx, px] += 1
+        # aggregates maintained INCREMENTALLY (a per-attempt einsum over
+        # [N, Q, P, R] costs ~1ms x hundreds of attempts; these slices
+        # cost ~20us each): cumulative-over-priority pools for the tier-1
+        # lower-priority test, over-deserved-queue pools for tier 2
+        self.cumP = np.concatenate(
+            [np.zeros((N, Q, 1, R)), np.cumsum(pools, axis=2)], axis=2)
+        self.cumP_all = self.cumP.sum(axis=1)            # [N, P+1, R]
+        self.ccntP = np.concatenate(
+            [np.zeros((N, Q, 1)), np.cumsum(counts, axis=2)], axis=2)
+        self.ccntP_all = self.ccntP.sum(axis=1)          # [N, P+1]
+        self.pool_q = pools.sum(axis=2)                  # [N, Q, R]
+        self.cnt_q = counts.sum(axis=2)                  # [N, Q]
+        self.pool_all = self.pool_q.sum(axis=1)          # [N, R]
+        self.cnt_all = self.cnt_q.sum(axis=1)            # [N]
+        self.over = self._over_now()
+        overf = self.over.astype(np.float64)
+        self.pool_over = np.einsum("nqr,q->nr", self.pool_q, overf)
+        self.cnt_over = self.cnt_q @ overf
+        self._feas_cache: Dict[str, np.ndarray] = {}
+        self._all_true = np.ones(N, bool)
 
-    stack = _TierStack(ssn, kept_jobs, victims, ssn.reclaimable_fns,
-                       "enabledReclaimable", "proportion", "cross-queue")
-    tensors = _EvictTensors(ssn, victims, ptasks)
-    preq = task_requests(ptasks, tensors.rnames)
-    pjob_arr = np.asarray(pjob_ix, np.int32)
-    pqueue_arr = np.asarray(pqueue_ix, np.int32)
-    P = len(ptasks)
-    same_prev = np.zeros(P, bool)
-    if P > 1:
-        same_prev[1:] = (pjob_arr[1:] == pjob_arr[:-1]) \
-            & np.all(preq[1:] == preq[:-1], axis=-1)
-    run_id = (np.cumsum(~same_prev) - 1).astype(np.int32)
-    job_brk = np.ones(P, bool)
-    job_brk[1:] = pjob_arr[1:] != pjob_arr[:-1]
-    job_end = _segment_ends(np.r_[job_brk[1:], True])
-    queue_brk = np.ones(P, bool)
-    queue_brk[1:] = pqueue_arr[1:] != pqueue_arr[:-1]
-    queue_end = _segment_ends(np.r_[queue_brk[1:], True])
+    def _over_now(self) -> np.ndarray:
+        """Queues possibly allocated above deserved (conservative: only a
+        queue with EVERY dimension below deserved - eps is certainly not
+        over, proportion.py:164-171)."""
+        return self.q_has_attr & np.any(
+            self.qalloc >= self.qdeserved - self.MINR, axis=-1)
 
-    # proportion state: queue allocated/deserved vectors (proportion.go),
-    # with a zeroed pad row for [N,W] pad slots
-    all_queues = {q.uid: i for i, q in enumerate(ssn.queues.values())}
-    Qall = len(all_queues)
-    R = len(tensors.rnames)
-    qalloc = np.zeros((Qall + 1, R), np.float32)
-    qdeserved = np.full((Qall + 1, R), np.float32(1e30))
-    qdeserved[Qall] = 0.0               # pad row: never over-deserved
-    # job.allocated is maintained as exactly the sum of allocated-status
-    # task resreqs (api/job_info.py update_task_status) — one to_vector
-    # per job, same invariant _drf_inputs relies on
-    for job in ssn.jobs.values():
-        if job.queue in all_queues:
-            qalloc[all_queues[job.queue]] += \
-                job.allocated.to_vector(tensors.rnames)
-    for name, r in ssn.queue_deserved.items():
-        if name in all_queues:
-            qdeserved[all_queues[name]] = r.to_vector(tensors.rnames)
-    vqueue = np.asarray(
-        [all_queues.get(ssn.jobs[t.job].queue, Qall) for t in victims],
-        np.int32)
-    pqueue_all = np.asarray(
-        [all_queues[qorder[qx].uid] for qx in pqueue_ix], np.int32)
-    nw = tensors.nw_inputs(vqueue, Qall, None)
+    def _refresh_over(self, qx: int) -> None:
+        now = bool(self.q_has_attr[qx] and np.any(
+            self.qalloc[qx] >= self.qdeserved[qx] - self.MINR))
+        if now == bool(self.over[qx]):
+            return
+        sign = 1.0 if now else -1.0
+        self.pool_over += sign * self.pool_q[:, qx]
+        self.cnt_over += sign * self.cnt_q[:, qx]
+        self.over[qx] = now
 
-    fn = build_reclaim_walk(stack.kinds, stack.sizes, stack.allow_cheap)
-    import jax
-    inputs = jax.device_put((
-        tensors.future_idle0(), nw, stack.padded_cand_mask(),
-        stack.device_masks(), preq, pjob_arr, pqueue_all,
-        run_id, job_end, queue_end,
-        np.asarray(last_of_job, bool), qalloc, qdeserved))  # one upload
-    task_node, owner_nw = fn(*inputs)
-    N, W = tensors.vslot.shape
-    packed = np.asarray(jnp.concatenate([
-        task_node, owner_nw.reshape(-1)]))                  # one fetch
-    task_node = packed[:P]
-    owner_nw = packed[P:].reshape(N, W)
+    @staticmethod
+    def _tier1_is_priority(ssn) -> bool:
+        """True when the FIRST tier with reclaimable participants consists
+        only of the stock priority/gang lower-priority filters."""
+        for tier in ssn.tiers:
+            entries = [opt.name for opt in tier.plugins
+                       if opt.is_enabled("enabledReclaimable")
+                       and opt.name in ssn.reclaimable_fns]
+            if not entries:
+                continue
+            return all(
+                name in ("priority", "gang")
+                and getattr(ssn.reclaimable_fns[name], "__module__", "")
+                == f"volcano_tpu.plugins.{name}" for name in entries)
+        return False
 
-    victims_by_step = tensors.owner_nw_to_victims(owner_nw)
+    @staticmethod
+    def _tier2_is_proportion(ssn) -> bool:
+        """True when the SECOND tier with reclaimable participants is
+        exactly the stock proportion plugin AND no later tier
+        participates — its over-deserved gate then bounds everything a
+        tier-1 abstention can reach."""
+        per_tier = []
+        for tier in ssn.tiers:
+            entries = [opt.name for opt in tier.plugins
+                       if opt.is_enabled("enabledReclaimable")
+                       and opt.name in ssn.reclaimable_fns]
+            if entries:
+                per_tier.append(entries)
+        return (len(per_tier) == 2 and per_tier[1] == ["proportion"]
+                and getattr(ssn.reclaimable_fns["proportion"],
+                            "__module__", "")
+                == "volcano_tpu.plugins.proportion")
 
-    if _fast_evict_ok(ssn, stack):
-        # no gang gate here: reclaim evicts directly with no statement
-        # (reclaim.go has no rollback), so committed = applied
-        from .allocate import _AggTask
-        names = tensors.node_t.names
-        dealloc_agg: Dict[str, Resource] = {}
-        alloc_agg: Dict[str, Resource] = {}
-        for i in np.flatnonzero(task_node != NO_NODE):
-            i = int(i)
-            for vt in victims_by_step.get(i, []):
-                own = _fast_evict(ssn, vt)
-                dealloc_agg.setdefault(own.job, Resource()).add(own.resreq)
-                ssn.cache.evict(own, "reclaim")
-            _fast_pipeline(ssn, ptasks[i], names[task_node[i]])
-            alloc_agg.setdefault(ptasks[i].job, Resource()) \
-                .add(ptasks[i].resreq)
-        for uid, r in alloc_agg.items():
-            ssn._fire_allocate(_AggTask(uid, r))
-        for uid, r in dealloc_agg.items():
-            ssn._fire_deallocate(_AggTask(uid, r))
-        return
+    def _feas_row(self, task) -> np.ndarray:
+        row = self._feas_cache.get(task.uid)
+        if row is not None:
+            return row
+        job = self.ssn.jobs.get(task.job)
+        pend = list(job.task_status_index.get(TaskStatus.PENDING,
+                                              {}).values()) if job else []
+        if task.uid not in {t.uid for t in pend}:
+            pend.append(task)
+        feas = assemble_feasibility(self.ssn, pend, self.node_t)
+        for i, t in enumerate(pend):
+            self._feas_cache[t.uid] = (self._all_true if feas is None
+                                       else feas[i])
+        return self._feas_cache[task.uid]
 
-    for i, task in enumerate(ptasks):
-        n = int(task_node[i])
-        if n == NO_NODE:
-            continue
-        evicted = victims_by_step.get(i, [])
-        validated = {t.uid for t in ssn.reclaimable(task, evicted)} \
-            if evicted else set()
-        reclaimed = Resource()
-        for vt in evicted:
-            if vt.uid in validated and vt.uid in ssn.jobs[vt.job].tasks:
-                ssn.evict(ssn.jobs[vt.job].tasks[vt.uid], "reclaim")
-                reclaimed.add(vt.resreq)
-        # pipeline only when the validated evictions alone cover the
-        # request (reclaim.go:93-96) — a live-chain veto must not
-        # overcommit the node
-        if task.init_resreq.less_equal(reclaimed):
-            ssn.pipeline(task, tensors.node_t.names[n])
+    MINR = 0.1      # api/resource.py MIN_RESOURCE — widens the screen
+
+    def note_evict(self, victim) -> None:
+        """Rotation callback: victim left the pool, its resreq joined the
+        node's future-idle (session.evict's releasing bump)."""
+        n = self.node_index.get(victim.node_name)
+        qx = self.queue_ix.get(self.ssn.jobs[victim.job].queue)
+        if n is None:
+            return
+        r = self._row_cache.get(victim.uid)
+        if r is None:
+            r = _res_rows_f64([victim.resreq], self.rnames)[0]
+        self.head[n] += r
+        px = self.pr_ix.get(self.ssn.jobs[victim.job].priority)
+        if qx is not None and px is not None:
+            self.cumP[n, qx, px + 1:] -= r
+            self.cumP_all[n, px + 1:] -= r
+            self.ccntP[n, qx, px + 1:] -= 1
+            self.ccntP_all[n, px + 1:] -= 1
+            self.pool_q[n, qx] -= r
+            self.cnt_q[n, qx] -= 1
+            self.pool_all[n] -= r
+            self.cnt_all[n] -= 1
+            if self.over[qx]:
+                self.pool_over[n] -= r
+                self.cnt_over[n] -= 1
+        if qx is not None:
+            self.qalloc[qx] -= r
+            self._refresh_over(qx)
+
+    def note_pipeline(self, task, node) -> None:
+        """Rotation callback: the pipelined reclaimer reserves the node's
+        future-idle (node_info.add_task PIPELINED) and grows its queue's
+        allocation (proportion's allocate handler)."""
+        n = self.node_index.get(node.name)
+        r = self._row_cache.get(task.uid)
+        if r is None:
+            r = _res_rows_f64([task.resreq], self.rnames)[0]
+            self._row_cache[task.uid] = r
+        if n is not None:
+            self.head[n] -= r
+        qx = self.queue_ix.get(self.ssn.jobs[task.job].queue)
+        if qx is not None:
+            self.qalloc[qx] += r
+            self._refresh_over(qx)
+
+    def nodes_for(self, task) -> List:
+        qx = self.queue_ix.get(self.ssn.jobs[task.job].queue)
+        if qx is None:
+            return self.nodes
+        req = self._row_cache.get(task.uid)
+        if req is None:
+            req = _res_rows_f64([task.init_resreq], self.rnames)[0]
+            self._row_cache[task.uid] = req
+        pool_full = self.pool_all - self.pool_q[:, qx]
+        cnt_full = self.cnt_all - self.cnt_q[:, qx]
+        # NO pool-alone-covers clause: the reference body evicts even when
+        # the victims cannot cover the request (it only skips the PIPELINE
+        # then, reclaim.py:101-112), so such nodes must stay in the walk
+        if self.tier1_priority:
+            p = self.ssn.jobs[task.job].priority
+            pix = int(np.searchsorted(self.pr_vals, p))  # #priorities < p
+            pool_lp = self.cumP_all[:, pix] - self.cumP[:, qx, pix]
+            cnt_lp = self.ccntP_all[:, pix] - self.ccntP[:, qx, pix]
+            if self.tier2_proportion:
+                # tier 2 (proportion) only ever accepts victims of queues
+                # currently allocated above deserved; a queue certainly
+                # NOT over-deserved contributes nothing to tier 2
+                if self.over[qx]:
+                    pool_t2 = self.pool_over - self.pool_q[:, qx]
+                    cnt_t2 = self.cnt_over - self.cnt_q[:, qx]
+                else:
+                    pool_t2, cnt_t2 = self.pool_over, self.cnt_over
+            else:
+                pool_t2, cnt_t2 = pool_full, cnt_full
+            # lower-priority victims present -> tier 1 RULES with exactly
+            # that set; otherwise tier 1 abstains and tier 2 rules
+            pool = np.where((cnt_lp > 0)[:, None], pool_lp, pool_t2)
+            cnt = np.where(cnt_lp > 0, cnt_lp, cnt_t2)
+        else:
+            pool, cnt = pool_full, cnt_full
+        ok = ((cnt > 0)
+              & np.all(self.head + pool + self.MINR >= req, axis=-1)
+              & self._feas_row(task))
+        return [self.nodes[i] for i in np.flatnonzero(ok)]
+
+
+def execute_reclaim_tpu(ssn) -> None:
+    """Reclaim engine: the exact reference rotation through the screener
+    (see _ReclaimScreener). Decisions are the callback engine's by
+    construction; the screener only removes provably-hopeless nodes from
+    each attempt's walk."""
+    from .reclaim import ReclaimAction
+    ReclaimAction(engine="callbacks")._execute_callbacks(
+        ssn, screener=_ReclaimScreener(ssn))

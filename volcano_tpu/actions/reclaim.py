@@ -31,7 +31,15 @@ class ReclaimAction(Action):
             return execute_reclaim_tpu(ssn)
         return self._execute_callbacks(ssn)
 
-    def _execute_callbacks(self, ssn) -> None:
+    def _execute_callbacks(self, ssn, screener=None) -> None:
+        """The reference rotation verbatim. ``screener`` (optional) is a
+        conservative node pre-filter — it must return a SUPERSET of the
+        nodes whose per-node body could succeed, in ssn.nodes order; the
+        exact per-node logic below is what decides, so a screener can only
+        skip work, never change a decision (evict_tpu._ReclaimScreener
+        proves the superset property from the invariant that an eviction
+        moves exactly its resreq from the evictable pool into future-idle).
+        note_pipeline keeps the screener's headroom conservative."""
         queues = PriorityQueue(ssn.queue_order_fn)
         queue_set = set()
         preemptors_map = {}
@@ -72,7 +80,9 @@ class ReclaimAction(Action):
             task = tasks.pop()
 
             assigned = False
-            for node in ssn.nodes.values():
+            node_iter = (screener.nodes_for(task) if screener is not None
+                         else ssn.nodes.values())
+            for node in node_iter:
                 try:
                     ssn.predicate_fn(task, node)
                 except Exception:
@@ -103,11 +113,15 @@ class ReclaimAction(Action):
                 for reclaimee in victims:
                     ssn.evict(ssn.jobs[reclaimee.job].tasks[reclaimee.uid],
                               "reclaim")
+                    if screener is not None:
+                        screener.note_evict(reclaimee)
                     reclaimed.add(reclaimee.resreq)
                     if resreq.less_equal(reclaimed):
                         break
                 if task.init_resreq.less_equal(reclaimed):
                     ssn.pipeline(task, node.name)
+                    if screener is not None:
+                        screener.note_pipeline(task, node)
                     assigned = True
                     break
 

@@ -132,6 +132,12 @@ def baseline_config(name: str, seed: int = 0):
         nodes = make_cluster(2000, gpus=8, seed=seed)
         jobs = make_jobs(8000, 160, ["default"], gpus_per_task=1, seed=seed)
         queues = [QueueInfo(name="default", weight=1)]
+    elif name == "gpu-small":
+        # 1/10th gpu mix — the largest GPU config where the callback engine
+        # stays tractable for the admission-parity comparison
+        nodes = make_cluster(200, gpus=8, seed=seed)
+        jobs = make_jobs(800, 16, ["default"], gpus_per_task=1, seed=seed)
+        queues = [QueueInfo(name="default", weight=1)]
     else:
         raise ValueError(f"unknown baseline config {name!r}")
 

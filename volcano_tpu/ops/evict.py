@@ -186,38 +186,6 @@ def _drf_dynamic(nw: EvictNW, before, jalloc, total, ls, rows=None):
     return fn
 
 
-def _proportion_dynamic(nw: EvictNW, qalloc, qdeserved, rows=None):
-    """proportion.go:246-271 — victim queues must be allocated above
-    deserved in some dimension and still hold the victim's resources."""
-    vgroup = nw.vgroup if rows is None else nw.vgroup[rows]
-    vreq = nw.vreq if rows is None else nw.vreq[rows]
-
-    def fn(cand):
-        over = jnp.any(qalloc > qdeserved + EPS, axis=-1)       # [Q+1]
-        holds = jnp.any(qalloc[vgroup] - vreq > -EPS, axis=-1)  # [n, W]
-        return cand & over[vgroup] & holds, None
-    return fn
-
-
-def _pop_until_fit(nw: EvictNW, best, elig_row, req, have, ok):
-    """Evict the chosen node's eligible victims in row (eviction) order
-    until the request fits — the reference's pop-until-fit loop, as one
-    W-length exclusive cumsum on the chosen row. ``have``: the resources
-    already counted toward the fit (future_idle for preempt, nothing for
-    reclaim's covers-by-evictions-alone rule)."""
-    vreq_row = nw.vreq[best]                                   # [W, R]
-    on = elig_row[:, None].astype(vreq_row.dtype)
-    cum_excl = jnp.cumsum(vreq_row * on, axis=0) - vreq_row * on
-    fit_before = jnp.all(req[None, :] < have[None, :] + cum_excl + EPS,
-                         axis=-1)
-    evicted = elig_row & ~fit_before & ok
-    freed = jnp.sum(vreq_row * evicted[:, None].astype(vreq_row.dtype),
-                    axis=0)
-    return evicted, freed
-
-
-# fill horizon: a same-request run longer than this re-evaluates once
-# per KMAX placements (the [KMAX, W] fill matrices stay tiny)
 KMAX = 64
 
 
@@ -547,148 +515,3 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
     return jax.jit(walk_fn)
 
 
-@functools.lru_cache(maxsize=16)
-def build_reclaim_walk(tier_kinds: Tuple[str, ...],
-                       tier_sizes: Tuple[int, ...],
-                       allow_cheap: bool = True):
-    """Compile a reclaim walk for one tier structure (reclaim.go:40-192).
-
-    Node walk takes the FIRST node (index order — the reference iterates
-    ssn.Nodes without scoring) where the eligible victims alone cover the
-    reclaimer's request; victims are evicted until reclaimed >= resreq;
-    evictions are direct (no statement rollback). Rotation quirks are
-    reproduced: a job leaves its queue's rotation at its first failed task,
-    and a queue leaves the action when some job ran all its tasks without a
-    failure (the reference's continue paths skip the queue re-push).
-
-    The "proportion" tier is dynamic: a victim's queue must be allocated
-    above deserved in some dimension and still hold the victim's resources
-    (proportion.go:246-271), with queue allocations tracked in the carry —
-    evictions subtract, reclaimer pipelines add. Same-job runs use the
-    cheap node-local step: within a run, candidate queues only lose
-    allocation (the reclaimer's own queue gains, but its victims are
-    excluded by the cross-queue candidate filter), so the first-feasible
-    node can only move later, never earlier.
-
-    Like the preempt walk, this is a ``lax.while_loop`` over a task
-    cursor: each successful placement costs one iteration, a FAILED task
-    jumps the cursor past the whole job (the job leaves its queue's
-    rotation at its first failure), and a job completing all its tasks
-    jumps past the whole queue (the queue leaves the action). Tasks are
-    assembled queue-contiguous then job-contiguous, so the jumps are index
-    arithmetic. Iterations ~= successful placements + failed jobs, not
-    the pending-task count.
-    """
-
-    def walk_fn(future_idle0, nw: EvictNW, cand_mask, tier_masks,
-                preq, pjob, pqueue, run_id, job_end, queue_end,
-                last_of_job, qalloc0, qdeserved):
-        N, W, R = nw.vreq.shape
-        P = preq.shape[0]
-        Q1 = qalloc0.shape[0]
-        fdtype = preq.dtype
-        has_prop = any(k == "proportion" for k in tier_kinds)
-
-        class Carry(NamedTuple):
-            i: jnp.ndarray           # i32[] task cursor
-            alive: jnp.ndarray       # bool[N, W]
-            fidle: jnp.ndarray       # f32[N, R]
-            qalloc: jnp.ndarray      # f32[Q+1, R]
-            owner: jnp.ndarray       # i32[N, W]
-            task_node: jnp.ndarray   # i32[P]
-            prev_node: jnp.ndarray   # i32[]
-            prev_ok: jnp.ndarray     # bool[]
-            prev_rid: jnp.ndarray    # i32[]
-
-        def body(c: Carry) -> Carry:
-            i = c.i
-            req = preq[i]
-            pj = pjob[i]
-            pq = pqueue[i]
-            rid = run_id[i]
-            last = last_of_job[i]
-            cand_v = cand_mask[pj]
-
-            def dynamic_for(rows):
-                if not has_prop:
-                    return lambda cand_x: (cand_x, None)
-                return _proportion_dynamic(nw, c.qalloc, qdeserved,
-                                           rows=rows)
-
-            b0 = c.prev_node
-            slots_b = nw.vslot[b0]
-            cand_b = c.alive[b0] & cand_v[slots_b] & nw.valid[b0]
-            masks_b = [((stk[:, pj, :][:, slots_b][:, None]
-                         if stk.shape[0] else stk), part[:, pj])
-                       for stk, part in tier_masks]
-            elig_b = _tier_eval(tier_kinds, masks_b, cand_b[None],
-                                dynamic_for(b0[None]))[0][0]
-            evictable_b = jnp.sum(
-                nw.vreq[b0] * elig_b[:, None].astype(fdtype), axis=0)
-            fits_b = (jnp.all(req < c.fidle[b0] + evictable_b + EPS)
-                      & jnp.all(req < evictable_b + EPS))
-
-            can_cheap = (jnp.asarray(allow_cheap) & (rid == c.prev_rid)
-                         & c.prev_ok & fits_b)
-            need_full = ~can_cheap
-
-            def full_eval():
-                masks_g = _gather_tier_masks(tier_masks, pj, nw.vslot)
-                cand = c.alive & cand_v[nw.vslot] & nw.valid
-                elig = _tier_eval(tier_kinds, masks_g, cand,
-                                  dynamic_for(None))[0]
-                elig_f = elig.astype(fdtype)
-                evictable = jnp.sum(nw.vreq * elig_f[..., None], axis=1)
-                covers = jnp.all(
-                    req[None, :] < c.fidle + evictable + EPS, axis=-1)
-                enough = jnp.all(req[None, :] < evictable + EPS, axis=-1)
-                fits = covers & enough
-                best = jnp.argmax(fits).astype(jnp.int32)
-                return best, fits[best], elig[best]
-
-            def cheap_eval():
-                return b0, fits_b, elig_b
-
-            best, found, elig_row = jax.lax.cond(
-                need_full, full_eval, cheap_eval)
-            ok = jnp.where(need_full, found, can_cheap)
-
-            # reclaim evicts until the EVICTIONS alone cover the
-            # request (reclaim.go:93-96), independent of node idle
-            evicted, freed = _pop_until_fit(
-                nw, best, elig_row, req, jnp.zeros(R, fdtype), ok)
-            fidle = c.fidle.at[best].add((freed - req) * ok.astype(fdtype))
-            vq_row = nw.vgroup[best]
-            q_onehot = jax.nn.one_hot(vq_row, Q1, dtype=fdtype)
-            qalloc2 = c.qalloc - q_onehot.T @ (
-                nw.vreq[best] * evicted[:, None].astype(fdtype))
-            qalloc2 = qalloc2.at[pq].add(req * ok.astype(fdtype))
-            alive = c.alive.at[best].set(c.alive[best] & ~evicted)
-            owner = c.owner.at[best].set(
-                jnp.where(evicted, i, c.owner[best]))
-            task_node = jnp.where(ok, c.task_node.at[i].set(best),
-                                  c.task_node)
-            # fail -> the job leaves its queue's rotation: skip its
-            # remaining tasks. ok & last -> the queue leaves the action:
-            # skip its remaining jobs.
-            next_i = jnp.where(ok,
-                               jnp.where(last, queue_end[i] + 1, i + 1),
-                               job_end[i] + 1)
-            return Carry(i=next_i, alive=alive, fidle=fidle,
-                         qalloc=qalloc2, owner=owner, task_node=task_node,
-                         prev_node=best, prev_ok=ok, prev_rid=rid)
-
-        c0 = Carry(
-            i=jnp.zeros((), jnp.int32),
-            alive=jnp.ones((N, W), bool), fidle=future_idle0,
-            qalloc=qalloc0,
-            owner=jnp.full((N, W), -1, jnp.int32),
-            task_node=jnp.full(P, NO_NODE, jnp.int32),
-            prev_node=jnp.zeros((), jnp.int32),
-            prev_ok=jnp.zeros((), bool),
-            prev_rid=jnp.full((), -1, jnp.int32))
-
-        c = jax.lax.while_loop(lambda c: c.i < P, body, c0)
-        return c.task_node, c.owner
-
-    return jax.jit(walk_fn)
