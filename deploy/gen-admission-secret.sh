@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Cert bootstrap for the admission webhook front (the reference's
+# installer/dockerfile/webhook-manager gen-admission-secret.sh analogue):
+# self-signed CA + server cert for the in-cluster service DNS name,
+# stored as a TLS secret the shim mounts, with the CA bundle substituted
+# into deploy/kubernetes/webhook.yaml before applying it.
+#
+# Usage: deploy/gen-admission-secret.sh [namespace] [service-name]
+set -euo pipefail
+
+NAMESPACE="${1:-volcano-tpu-system}"
+SERVICE="${2:-volcano-admission-service}"
+SECRET="volcano-admission-secret"
+WORKDIR="$(mktemp -d)"
+trap 'rm -rf "$WORKDIR"' EXIT
+
+CN="${SERVICE}.${NAMESPACE}.svc"
+
+openssl genrsa -out "$WORKDIR/ca.key" 2048
+openssl req -x509 -new -nodes -key "$WORKDIR/ca.key" -days 3650 \
+  -subj "/CN=volcano-admission-ca" -out "$WORKDIR/ca.crt"
+
+openssl genrsa -out "$WORKDIR/tls.key" 2048
+openssl req -new -key "$WORKDIR/tls.key" -subj "/CN=${CN}" \
+  -out "$WORKDIR/server.csr"
+cat > "$WORKDIR/ext.cnf" <<EOF
+subjectAltName = DNS:${SERVICE}, DNS:${SERVICE}.${NAMESPACE}, DNS:${CN}
+EOF
+openssl x509 -req -in "$WORKDIR/server.csr" -CA "$WORKDIR/ca.crt" \
+  -CAkey "$WORKDIR/ca.key" -CAcreateserial -days 3650 \
+  -extfile "$WORKDIR/ext.cnf" -out "$WORKDIR/tls.crt"
+
+kubectl -n "$NAMESPACE" create secret tls "$SECRET" \
+  --cert="$WORKDIR/tls.crt" --key="$WORKDIR/tls.key" \
+  --dry-run=client -o yaml | kubectl apply -f -
+
+CA_BUNDLE="$(base64 < "$WORKDIR/ca.crt" | tr -d '\n')"
+sed "s|\${CA_BUNDLE}|${CA_BUNDLE}|g" \
+  "$(dirname "$0")/kubernetes/webhook.yaml" | kubectl apply -f -
+
+echo "admission secret ${SECRET} created; webhook configurations applied"

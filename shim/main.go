@@ -610,6 +610,13 @@ func main() {
 		"host:port of the volcano_tpu snapshot-RPC sidecar")
 	period := flag.Duration("schedule-period", time.Second,
 		"cycle period (--schedule-period)")
+	webhookAddr := flag.String("webhook-addr", "",
+		"serve the AdmissionReview webhook front on this addr "+
+			"(e.g. :8443); empty disables it")
+	tlsCert := flag.String("tls-cert-file", "/admission.local.config/"+
+		"certificates/tls.crt", "webhook TLS certificate")
+	tlsKey := flag.String("tls-private-key-file", "/admission.local.config/"+
+		"certificates/tls.key", "webhook TLS private key")
 	flag.Parse()
 
 	cfg, err := clientcmd.BuildConfigFromFlags(*master, *kubeconfig)
@@ -637,6 +644,11 @@ func main() {
 		pcInformer.Informer().HasSynced,
 		pgInformer.Informer().HasSynced,
 		queueInformer.Informer().HasSynced)
+
+	if *webhookAddr != "" {
+		startWebhook(*webhookAddr, *tlsCert, *tlsKey, *sidecar,
+			queueInformer, pgInformer)
+	}
 
 	conn, err := net.Dial("tcp", *sidecar)
 	if err != nil {

@@ -1,0 +1,79 @@
+// Wire-conformance test for the admission leg: k8sToWire over the golden
+// k8s fixtures must produce exactly the admit requests the Python sidecar
+// was recorded answering (testdata/golden_admission.json, generated and
+// re-asserted by tests/test_rpc.py). Both sides are pinned to the same
+// trace without sharing code, like the snapshot golden.
+package main
+
+import (
+	"encoding/json"
+	"os"
+	"reflect"
+	"testing"
+)
+
+type admissionGoldenCase struct {
+	Name                string           `json:"name"`
+	K8s                 map[string]any   `json:"k8s"`
+	K8sContextQueues    []map[string]any `json:"k8s_context_queues"`
+	K8sContextPodgroups []map[string]any `json:"k8s_context_podgroups"`
+	Request             map[string]any   `json:"request"`
+	Response            map[string]any   `json:"response"`
+}
+
+func TestAdmissionGolden(t *testing.T) {
+	raw, err := os.ReadFile("testdata/golden_admission.json")
+	if err != nil {
+		t.Fatalf("golden: %v", err)
+	}
+	var cases []admissionGoldenCase
+	if err := json.Unmarshal(raw, &cases); err != nil {
+		t.Fatalf("golden decode: %v", err)
+	}
+	for _, c := range cases {
+		t.Run(c.Name, func(t *testing.T) {
+			kind, _ := c.K8s["kind"].(string)
+			wireObj, err := k8sToWire(kind, c.K8s)
+			if err != nil {
+				t.Fatalf("k8sToWire: %v", err)
+			}
+			ctx := admitContext{}
+			for _, q := range c.K8sContextQueues {
+				wq, err := k8sToWire("Queue", q)
+				if err != nil {
+					t.Fatalf("queue context: %v", err)
+				}
+				ctx.Queues = append(ctx.Queues, wq)
+			}
+			for _, pg := range c.K8sContextPodgroups {
+				wpg, err := k8sToWire("PodGroup", pg)
+				if err != nil {
+					t.Fatalf("podgroup context: %v", err)
+				}
+				ctx.Podgroups = append(ctx.Podgroups, wpg)
+			}
+			req := admitRequest{
+				V:  version,
+				Op: "admit",
+				Review: admitReview{
+					Kind:      kind,
+					Operation: "CREATE",
+					Object:    wireObj,
+					Context:   ctx,
+				},
+			}
+			// normalize through JSON so numeric types compare by value
+			var got, want map[string]any
+			gb, _ := json.Marshal(req)
+			json.Unmarshal(gb, &got)
+			wb, _ := json.Marshal(c.Request)
+			json.Unmarshal(wb, &want)
+			if !reflect.DeepEqual(got, want) {
+				gs, _ := json.MarshalIndent(got, "", " ")
+				ws, _ := json.MarshalIndent(want, "", " ")
+				t.Fatalf("admit request mismatch\n got: %s\nwant: %s",
+					gs, ws)
+			}
+		})
+	}
+}

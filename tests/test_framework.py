@@ -196,3 +196,85 @@ class TestReservationElection:
         assert Reservation.target_job.uid == "vip"
         close_session(ssn)
         Reservation.reset()
+
+
+def test_queue_delete_admission():
+    """validate_queue DELETE leg (validate_queue.go:199-215): default queue
+    undeletable; only Closed queues may go."""
+    import pytest
+
+    from volcano_tpu.api import QueueState
+    from volcano_tpu.apis.objects import ObjectMeta, QueueCR, QueueStatus
+    from volcano_tpu.store import AdmissionError, ObjectStore
+    from volcano_tpu.webhooks.admission import register_webhooks
+
+    store = ObjectStore()
+    router = register_webhooks(store)
+    open_q = QueueCR(metadata=ObjectMeta(name="live"),
+                     status=QueueStatus(state=QueueState.OPEN))
+    store.create(open_q)
+    with pytest.raises(AdmissionError, match="default.*can not be deleted"):
+        router.hook("DELETE", "Queue",
+                    QueueCR(metadata=ObjectMeta(name="default")), None)
+    with pytest.raises(AdmissionError, match="state `Closed`"):
+        router.hook("DELETE", "Queue", open_q, None)
+    closed = QueueCR(metadata=ObjectMeta(name="done"),
+                     status=QueueStatus(state=QueueState.CLOSED))
+    router.hook("DELETE", "Queue", closed, None)   # allowed
+
+
+def test_resource_quota_namespace_weights():
+    """ResourceQuota -> namespace weight path (VERDICT r3 #7, reference
+    event_handlers.go:740-837): quotas carrying volcano.sh/namespace.weight
+    flow store -> cache -> snapshot, the max across a namespace's quotas
+    wins, deletion reverts, and drf's namespace order prefers the heavier
+    namespace."""
+    from volcano_tpu.apis.objects import ObjectMeta, ResourceQuota
+    from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+    from volcano_tpu.cache.store_wiring import wire_cache_to_store
+    from volcano_tpu.framework import close_session, open_session, \
+        parse_scheduler_conf
+    from volcano_tpu.store import ObjectStore
+    import volcano_tpu.plugins  # noqa: F401
+
+    store = ObjectStore()
+    cache = SchedulerCache(binder=FakeBinder(), evictor=FakeEvictor())
+    wire_cache_to_store(store, cache)
+    store.create(ResourceQuota(
+        metadata=ObjectMeta(name="rq-a", namespace="heavy"),
+        hard={"volcano.sh/namespace.weight": 8, "cpu": 100}))
+    store.create(ResourceQuota(
+        metadata=ObjectMeta(name="rq-b", namespace="heavy"),
+        hard={"volcano.sh/namespace.weight": 3}))
+    store.create(ResourceQuota(
+        metadata=ObjectMeta(name="rq-c", namespace="light"),
+        hard={"cpu": 10}))                  # no weight key -> default
+
+    snap = cache.snapshot()
+    assert snap.namespaces["heavy"].get_weight() == 8    # max of 8, 3
+    assert snap.namespaces["light"].get_weight() == 1    # default
+
+    # drf's namespace order must prefer the heavier namespace
+    conf = parse_scheduler_conf("""
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+    enableNamespaceOrder: true
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+""")
+    ssn = open_session(cache, conf.tiers, [])
+    try:
+        assert ssn.namespace_order_fn("heavy", "light")
+        assert not ssn.namespace_order_fn("light", "heavy")
+    finally:
+        close_session(ssn)
+
+    # max drops when the heaviest quota goes away
+    store.delete("ResourceQuota", "heavy", "rq-a")
+    assert cache.snapshot().namespaces["heavy"].get_weight() == 3

@@ -460,3 +460,47 @@ class TestAdmissionOverWire:
         finally:
             client.close()
             server.shutdown()
+
+
+def test_admission_golden_trace_through_the_wire():
+    """The shim webhook front's side of the admission protocol: every
+    golden request (shim/testdata/golden_admission.json — exactly what
+    shim/webhook.go's k8sToWire builds from the embedded k8s fixtures,
+    asserted by its TestAdmissionGolden) must produce the recorded
+    verdict when framed through the real TCP sidecar. A bad vcjob is
+    denied END-TO-END through the shim-format request (VERDICT r3 #3)."""
+    import json as _json
+    import pathlib
+
+    golden = _json.loads(
+        (pathlib.Path(__file__).parent.parent / "shim" / "testdata"
+         / "golden_admission.json").read_text())
+    assert len(golden) >= 6
+    server, thread, port = serve()
+    client = SnapshotClient("127.0.0.1", port)
+    try:
+        for case in golden:
+            out = client.schedule(case["request"])
+            # normalize the nondeterministic fields the golden strips
+            # (generated uid, dataclass status timestamps)
+            if isinstance(out.get("patched"), dict):
+                out["patched"].pop("status", None)
+                out["patched"].get("metadata", {}).pop("uid", None)
+            assert out == case["response"], case["name"]
+        by_name = {c["name"]: c for c in golden}
+        assert by_name["job-min-available-over-replicas"]["response"][
+            "allowed"] is False
+        assert by_name["job-closed-queue-denied"]["response"][
+            "allowed"] is False
+        patched = by_name["job-defaulting-patch"]["response"]["patched"]
+        assert patched["spec"]["min_available"] == 2
+        assert patched["spec"]["tasks"][0]["name"] == "default0"
+        assert by_name["queue-zero-weight-denied"]["response"][
+            "allowed"] is False
+        assert by_name["podgroup-queue-defaulted"]["response"][
+            "allowed"] is True
+        assert by_name["bare-pod-pending-group-denied"]["response"][
+            "allowed"] is False
+    finally:
+        client.close()
+        server.shutdown()

@@ -711,7 +711,8 @@ class TestDeployArtifacts:
                 "Deployment", "Service"} <= kinds
         # the scheduler-conf ConfigMap parses with the real conf parser
         from volcano_tpu.framework import parse_scheduler_conf
-        cm = next(d for d in docs if d["kind"] == "ConfigMap")
+        cm = next(d for d in docs if d["kind"] == "ConfigMap"
+                  and "scheduler.conf" in d.get("data", {}))
         conf = parse_scheduler_conf(cm["data"]["scheduler.conf"])
         assert "allocate-tpu" in conf.actions
         # every container command/flag exists

@@ -217,6 +217,19 @@ class QueueCR:
 
 
 @dataclass
+class ResourceQuota:
+    """core/v1 ResourceQuota mirror — the scheduler reads ONLY the
+    volcano.sh/namespace.weight key of spec.hard, which feeds drf's
+    namespace fairness (event_handlers.go:740-770 updateResourceQuota,
+    namespace_info.go NamespaceWeightKey)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    hard: Dict[str, float] = field(default_factory=dict)   # spec.hard
+
+    KIND = "ResourceQuota"
+
+
+@dataclass
 class PriorityClass:
     """scheduling.k8s.io PriorityClass (resolved into JobInfo.priority by the
     cache wiring, mirroring event_handlers.go AddPriorityClass:633)."""

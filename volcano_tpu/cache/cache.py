@@ -162,6 +162,23 @@ class SchedulerCache:
 
     # -- snapshot (cache.go:801-893) ----------------------------------------
 
+    def add_resource_quota(self, quota) -> None:
+        """AddResourceQuota (event_handlers.go:740-770): track the
+        volcano.sh/namespace.weight key of spec.hard per namespace; the
+        snapshot's NamespaceInfo takes the max across the namespace's
+        quotas (namespace_info.go quotaItem semantics)."""
+        ns = quota.metadata.namespace
+        col = self.namespace_collections.setdefault(
+            ns, NamespaceCollection(ns))
+        weight = int(quota.hard.get(NamespaceCollection.WEIGHT_KEY, 0))
+        col.update(quota.metadata.name, weight)
+
+    def delete_resource_quota(self, quota) -> None:
+        """DeleteResourceQuota (event_handlers.go:790-812)."""
+        col = self.namespace_collections.get(quota.metadata.namespace)
+        if col is not None:
+            col.delete(quota.metadata.name)
+
     def snapshot(self) -> ClusterInfo:
         with self._lock:
             ci = ClusterInfo()

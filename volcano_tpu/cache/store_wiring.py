@@ -161,6 +161,15 @@ def wire_cache_to_store(store: ObjectStore,
         elif event == DELETED:
             cache.remove_queue(q.metadata.name)
 
+    def on_resource_quota(event: str, quota, old) -> None:
+        # namespace weights for drf's namespace fairness
+        # (event_handlers.go:740-837)
+        if event == DELETED:
+            cache.delete_resource_quota(quota)
+        else:
+            cache.add_resource_quota(quota)
+
+    store.watch("ResourceQuota", on_resource_quota)
     store.watch("PriorityClass", on_priority_class)
     store.watch("Pod", on_pod)
     store.watch("PodGroup", on_podgroup)

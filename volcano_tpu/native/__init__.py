@@ -117,7 +117,7 @@ class NativeObjectStore:
     """
 
     KINDS = ("Pod", "Job", "PodGroup", "Queue", "Command", "PriorityClass",
-             "PersistentVolumeClaim", "Lease")
+             "PersistentVolumeClaim", "Lease", "ResourceQuota")
 
     def __init__(self, log_capacity: int = 65536):
         lib = _get_lib()

@@ -6,11 +6,16 @@ reach the API server.
 Mirrors /root/reference/cmd/webhook-manager/app/server.go:41-108: where
 the reference serves AdmissionReview over TLS HTTP, the sidecar accepts
 an ``{"op": "admit"}`` message on the same length-prefixed TCP framing
-the snapshot RPC uses. The review is self-contained — the caller (the Go
-shim, which fronts the actual ValidatingWebhookConfiguration endpoint)
-attaches the cluster context the validators consult (queues for
-jobs/validate queue-state checks, podgroups for the pods gate), keeping
-the sidecar stateless per request exactly like the scheduling op.
+the snapshot RPC uses. The TLS front is the Go shim's webhook server
+(shim/webhook.go, enabled with --webhook-addr and registered by
+deploy/kubernetes/webhook.yaml + deploy/gen-admission-secret.sh): it
+terminates the API server's AdmissionReview POSTs on the reference
+router paths, translates the object to this wire schema, and attaches
+the cluster context the validators consult (queues for jobs/validate
+queue-state checks, podgroups for the pods gate), keeping the sidecar
+stateless per request exactly like the scheduling op. Both sides of the
+wire format are pinned to shim/testdata/golden_admission.json
+(tests/test_rpc.py here, TestAdmissionGolden on the Go side).
 
 Request:
   {"v": 1, "op": "admit",

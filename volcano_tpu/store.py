@@ -38,7 +38,7 @@ class AdmissionError(Exception):
 
 class ObjectStore:
     KINDS = ("Pod", "Job", "PodGroup", "Queue", "Command", "PriorityClass",
-             "PersistentVolumeClaim", "Lease")
+             "PersistentVolumeClaim", "Lease", "ResourceQuota")
 
     def __init__(self):
         self._lock = threading.RLock()

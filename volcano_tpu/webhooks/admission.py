@@ -119,6 +119,20 @@ def mutate_queue(operation: str, queue: QueueCR, old) -> QueueCR:
 
 def make_validate_queue(store: ObjectStore):
     def validate_queue(operation: str, queue: QueueCR, old) -> None:
+        if operation == "DELETE":
+            # validate_queue.go:199-215: the default queue is undeletable,
+            # and only Closed queues may be deleted. k8s sends the object
+            # being deleted as OldObject.
+            target = old if old is not None else queue
+            if target.metadata.name == "default":
+                deny("`default` queue can not be deleted")
+            live = store.get("Queue", target.metadata.namespace,
+                             target.metadata.name) or target
+            if live.status.state != QueueState.CLOSED:
+                deny(f"only queue with state `Closed` can be deleted, "
+                     f"queue `{live.metadata.name}` state is "
+                     f"`{live.status.state.value}`")
+            return
         if queue.spec.weight < 1:
             deny(f"queue weight must be a positive integer, got "
                  f"{queue.spec.weight}")
@@ -250,7 +264,7 @@ def register_webhooks(store: ObjectStore) -> Router:
     router.register(AdmissionService(
         "/queues/mutate", ["Queue"], ["CREATE"], mutate_queue, mutating=True))
     router.register(AdmissionService(
-        "/queues/validate", ["Queue"], ["CREATE", "UPDATE"],
+        "/queues/validate", ["Queue"], ["CREATE", "UPDATE", "DELETE"],
         make_validate_queue(store)))
     router.register(AdmissionService(
         "/podgroups/mutate", ["PodGroup"], ["CREATE"], mutate_podgroup,
