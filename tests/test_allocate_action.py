@@ -409,6 +409,45 @@ def test_gpu_config_capacity_and_parity():
     assert adm_c == adm_t
     assert binds_c == binds_t
     # FFD placing everything certifies full-packing feasibility; this
-    # config is built to be certifiable (16k GPUs for 800 1-GPU tasks)
+    # config is built to be certifiable (1600 GPUs for 800 1-GPU tasks)
     assert expected is not None
     assert binds_t == expected
+
+
+def test_strict_batched_multiqueue_parity():
+    """The batched strict oracle must match callbacks admissions exactly
+    on a multi-queue snapshot where proportion shares evolve mid-cycle —
+    the case that forces pop mispredictions and the prefix-rebuild path.
+    A batch of 3 over ~30 jobs crosses many batch boundaries."""
+    from volcano_tpu.cache.synthetic import make_cluster, make_jobs
+    from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+    from volcano_tpu.api import QueueInfo
+    from volcano_tpu.framework import (Configuration, close_session,
+                                       open_session, parse_scheduler_conf)
+    from volcano_tpu.framework.arguments import Arguments
+
+    def build():
+        binder, evictor = FakeBinder(), FakeEvictor()
+        cache = SchedulerCache(binder=binder, evictor=evictor)
+        for q, w in (("q1", 3), ("q2", 2), ("q3", 1)):
+            cache.add_queue(QueueInfo(name=q, weight=w))
+        for n in make_cluster(40, seed=7):
+            cache.add_node(n)
+        for j in make_jobs(300, 30, ["q1", "q2", "q3"], seed=7):
+            cache.add_job(j)
+        return cache, binder
+
+    conf = parse_scheduler_conf(None)
+
+    def run(engine, confs=()):
+        cache, binder = build()
+        ssn = open_session(cache, conf.tiers, list(confs))
+        AllocateAction(engine=engine).execute(ssn)
+        close_session(ssn)
+        return frozenset(binder.binds)
+
+    cb = run("callbacks")
+    assert run("tpu-strict") == cb
+    small_batches = [Configuration(name="allocate",
+                                   arguments=Arguments({"strict-batch": 3}))]
+    assert run("tpu-strict", small_batches) == cb

@@ -9,11 +9,13 @@ Engines:
 
 - ``callbacks``  the reference architecture verbatim: per-(task,node) plugin
   callbacks through PredicateNodes/PrioritizeNodes. The CPU baseline.
-- ``tpu-strict`` identical interleave, but each popped job's task placement is
-  one device solve (ops/place.place_scan with J=1): node state lives on
-  device between jobs, the host replays the picks through the Statement so
-  every plugin event handler and gang vote sees exactly what the reference
-  would. Decision-parity mode.
+- ``tpu-strict`` identical interleave — the same _pop_next against the live
+  session decides every job — with the device solves BATCHED: the next B
+  pops are predicted by clone-simulating the interleave, solved in one
+  carried-state device program, and verified pop-by-pop at replay; a
+  mispredicted pop discards the rest of the batch and re-solves the
+  verified prefix. Decision-parity mode at ~B jobs per device round trip
+  (``tpu-strict-perjob`` keeps the r3 one-RTT-per-job formulation).
 - ``tpu-fused``  the whole action is ONE device program: job order is fixed
   up front (same priority rules, without mid-cycle queue re-ordering), all
   pending tasks solve in a single place_scan, results replay through
@@ -81,6 +83,12 @@ class AllocateAction(Action):
             finally:
                 placer.close()
         elif engine == "tpu-strict":
+            batch = 16
+            for conf in ssn.configurations:
+                if conf.name in (self.NAME, "allocate"):
+                    batch = int(conf.arguments.get("strict-batch", batch))
+            _execute_strict_batched(ssn, batch=batch)
+        elif engine == "tpu-strict-perjob":
             _execute_interleaved(ssn, _DeviceJobPlacer(ssn))
         elif engine in ("tpu-fused", "tpu-blocks", "tpu-scan", "tpu-pallas",
                         "tpu-sharded"):
@@ -139,10 +147,11 @@ def _pending_tasks(ssn, job) -> List[TaskInfo]:
     return out
 
 
-def _execute_interleaved(ssn, placer) -> None:
+def _build_interleave(ssn):
+    """The namespace -> queue -> job PQ structures the popping loop
+    mutates (allocate.go:123-142)."""
     namespaces = PriorityQueue(ssn.namespace_order_fn)
     jobs_map: Dict[str, Dict[str, PriorityQueue]] = {}
-
     for job in _eligible_jobs(ssn):
         ns = job.namespace
         if ns not in jobs_map:
@@ -151,13 +160,24 @@ def _execute_interleaved(ssn, placer) -> None:
         if job.queue not in jobs_map[ns]:
             jobs_map[ns][job.queue] = PriorityQueue(ssn.job_order_fn)
         jobs_map[ns][job.queue].push(job)
+    return namespaces, jobs_map
 
-    pending: Dict[str, List[TaskInfo]] = {}
 
+def _pop_next(ssn, namespaces, jobs_map):
+    """ONE pop of the reference interleave (allocate.go:143-180), with its
+    queue-deletion side effects; returns (job, jobs_pq, ns) or
+    (None, None, None) when drained. Shared verbatim by the live loop and
+    the strict engine's verification, so 'the job the loop would pop next'
+    has one definition.
+
+    The namespace is NOT re-pushed here: the reference re-inserts it only
+    after the popped job's statement closes, so a state-dependent
+    namespace order (drf's share-based comparator) sees POST-placement
+    shares at re-insert time. Callers must push ``ns`` back after
+    processing the job."""
     while not namespaces.empty():
         ns = namespaces.pop()
         queue_jobs = jobs_map[ns]
-
         queue = None
         for qid in list(queue_jobs):
             q = ssn.queues[qid]
@@ -175,13 +195,24 @@ def _execute_interleaved(ssn, placer) -> None:
                     continue
                 namespaces.push(ns)
             continue
-
         jobs = queue_jobs[queue.uid]
         if jobs.empty():
             del queue_jobs[queue.uid]
             namespaces.push(ns)
             continue
         job = jobs.pop()
+        return job, jobs, ns
+    return None, None, None
+
+
+def _execute_interleaved(ssn, placer) -> None:
+    namespaces, jobs_map = _build_interleave(ssn)
+    pending: Dict[str, List[TaskInfo]] = {}
+
+    while True:
+        job, jobs, ns = _pop_next(ssn, namespaces, jobs_map)
+        if job is None:
+            break
 
         if job.uid not in pending:
             pending[job.uid] = _pending_tasks(ssn, job)
@@ -201,8 +232,7 @@ def _execute_interleaved(ssn, placer) -> None:
             committed = True               # kept open: pipelined gang
         if hasattr(placer, "statement_closed"):
             placer.statement_closed(job, committed, ops)
-
-        namespaces.push(ns)
+        namespaces.push(ns)                # post-placement, like allocate.go
 
 
 class _CallbackJobPlacer:
@@ -275,40 +305,15 @@ class _DeviceJobPlacer:
         if not tasks or not self.node_t.names:
             tasks.clear()
             return False
-        jnp = self.jnp
-        from ..ops.place import JobMeta, PlacementTasks
-
-        req = task_requests(tasks, self.rnames)
-        feas = assemble_feasibility(self.ssn, tasks, self.node_t)
-        static = assemble_static_score(self.ssn, tasks, self.node_t)
-        T = len(tasks)
-        N = len(self.node_t.names)
-        bucket = _bucket(T)
-        pad = bucket - T
-        feas_d = (jnp.ones((bucket, N), bool) if feas is None
-                  else jnp.asarray(np.pad(feas, ((0, pad), (0, 0)))))
-        static_d = (jnp.zeros((bucket, N), jnp.float32) if static is None
-                    else jnp.asarray(np.pad(static, ((0, pad), (0, 0)))))
-        pt = PlacementTasks(
-            req=jnp.asarray(np.pad(req, ((0, pad), (0, 0)))),
-            job_ix=jnp.zeros(bucket, jnp.int32),
-            valid=jnp.asarray(np.r_[np.ones(T, bool), np.zeros(pad, bool)]),
-            feas=feas_d,
-            static_score=static_d,
-            first_of_job=jnp.asarray(np.r_[[True], np.zeros(bucket - 1, bool)]),
-            last_of_job=jnp.asarray(
-                np.r_[np.zeros(T - 1, bool), [True], np.zeros(pad, bool)]))
-        jobs_meta = JobMeta(
-            min_available=jnp.asarray([job.min_available], jnp.int32),
-            base_ready=jnp.asarray([job.ready_task_num()], jnp.int32),
-            base_pipelined=jnp.asarray([job.waiting_task_num()], jnp.int32))
-
         from ..ops.place import unpack_placement
-        packed, new_state = self._solve(self.state, pt, jobs_meta,
-                                        self.weights, self.allocatable,
-                                        self.max_tasks)
+
+        T = len(tasks)
+        packed, new_state, bucket, J, _ = _solve_job_batch(
+            self.ssn, [(job, tasks)], self.state, self.node_t, self.rnames,
+            self.weights, self.allocatable, self.max_tasks, self._solve,
+            j_pad=1)
         task_node, pipelined, _, job_kept = unpack_placement(
-            np.asarray(packed), bucket, 1)
+            np.asarray(packed), bucket, J)
         task_node, pipelined = task_node[:T], pipelined[:T]
         if bool(job_kept[0]):
             self.state = new_state
@@ -339,6 +344,237 @@ def _bucket(n: int) -> int:
     while b < n:
         b *= 2
     return b
+
+
+# ---------------------------------------------------------------------------
+# batched strict engine (VERDICT r3 #5)
+# ---------------------------------------------------------------------------
+
+def _predict_pops(ssn, namespaces, jobs_map, n: int, first=None) -> List:
+    """Simulate the next ``n`` pops of the interleave WITHOUT touching the
+    live structures: clone the PQs (sequence-faithful — PriorityQueue.clone)
+    and fire the fused engine's aggregated assume-all-allocated events per
+    popped job so overused gating and share-driven ordering evolve the way
+    the live loop usually will, undoing every event before returning. The
+    prediction is OPTIMISTIC, never authoritative: the caller verifies each
+    entry against the live _pop_next during replay. ``first`` force-seeds a
+    job the live loop already popped (a prior batch's mismatch carry)."""
+    sim_ns = namespaces.clone()
+    sim_map = {ns: {qid: pq.clone() for qid, pq in qmap.items()}
+               for ns, qmap in jobs_map.items()}
+    predicted: List = [] if first is None else [first]
+    simulated: List[_AggTask] = []
+    try:
+        for job in predicted:
+            agg = _assume_allocated(ssn, job)
+            if agg is not None:
+                simulated.append(agg)
+        while len(predicted) < n:
+            job, _, ns = _pop_next(ssn, sim_ns, sim_map)
+            if job is None:
+                break
+            predicted.append(job)
+            agg = _assume_allocated(ssn, job)
+            if agg is not None:
+                simulated.append(agg)
+            sim_ns.push(ns)          # post-placement, like the live loop
+    finally:
+        for agg in reversed(simulated):
+            ssn._fire_deallocate(agg)
+    return predicted
+
+
+def _assume_allocated(ssn, job) -> Optional[_AggTask]:
+    """One aggregated allocate-event as if every pending task placed
+    (the _fixed_job_order simulation, per job)."""
+    total = Resource()
+    count = 0
+    for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
+        if task.resreq.is_empty():
+            continue
+        total.add(task.resreq)
+        count += 1
+    if not count:
+        return None
+    agg = _AggTask(job.uid, total)
+    ssn._fire_allocate(agg)
+    return agg
+
+
+def _solve_job_batch(ssn, jobs_with_tasks, state, node_t, rnames, weights,
+                     allocatable_d, max_tasks_d, solver, j_pad: int):
+    """One device program over a batch of jobs' pending tasks, node state
+    carried in-kernel across jobs with per-job gang snapshots (the same
+    place_scan the fused engine uses). The job axis pads to ``j_pad`` and
+    the task axis to a pow2 bucket so every batch hits the same compiled
+    program (pad jobs own no tasks and never affect state). Returns
+    (packed_np, new_state, bucket, J_padded, task_slices)."""
+    import jax.numpy as jnp
+    from ..ops.place import JobMeta, PlacementTasks
+
+    tasks: List[TaskInfo] = []
+    job_ix: List[int] = []
+    slices: List[tuple] = []
+    for jx, (_, jtasks) in enumerate(jobs_with_tasks):
+        slices.append((len(tasks), len(tasks) + len(jtasks)))
+        tasks.extend(jtasks)
+        job_ix.extend([jx] * len(jtasks))
+    T = len(tasks)
+    J = max(len(jobs_with_tasks), 1)
+    J = max(J, j_pad)
+    jpad = J - len(jobs_with_tasks)
+    req = task_requests(tasks, rnames)
+    feas = assemble_feasibility(ssn, tasks, node_t)
+    static = assemble_static_score(ssn, tasks, node_t)
+    N = len(node_t.names)
+    bucket = _bucket(T)
+    pad = bucket - T
+    job_ix_np = np.asarray(job_ix, np.int32)
+    first = np.zeros(T, bool)
+    last = np.zeros(T, bool)
+    first[0] = True
+    first[1:] = job_ix_np[1:] != job_ix_np[:-1]
+    last[:-1] = job_ix_np[1:] != job_ix_np[:-1]
+    last[-1] = True
+    pt = PlacementTasks(
+        req=jnp.asarray(np.pad(req, ((0, pad), (0, 0)))),
+        job_ix=jnp.asarray(np.pad(job_ix_np, (0, pad))),
+        valid=jnp.asarray(np.r_[np.ones(T, bool), np.zeros(pad, bool)]),
+        feas=(jnp.ones((bucket, N), bool) if feas is None
+              else jnp.asarray(np.pad(feas, ((0, pad), (0, 0))))),
+        static_score=(jnp.zeros((bucket, N), jnp.float32) if static is None
+                      else jnp.asarray(np.pad(static, ((0, pad), (0, 0))))),
+        first_of_job=jnp.asarray(np.pad(first, (0, pad))),
+        last_of_job=jnp.asarray(np.pad(last, (0, pad))))
+    jobs_meta = JobMeta(
+        min_available=jnp.asarray(
+            [j.min_available for j, _ in jobs_with_tasks]
+            + [1] * jpad, jnp.int32),
+        base_ready=jnp.asarray(
+            [j.ready_task_num() for j, _ in jobs_with_tasks]
+            + [0] * jpad, jnp.int32),
+        base_pipelined=jnp.asarray(
+            [j.waiting_task_num() for j, _ in jobs_with_tasks]
+            + [0] * jpad, jnp.int32))
+    packed, new_state = solver(state, pt, jobs_meta, weights,
+                               allocatable_d, max_tasks_d)
+    return packed, new_state, bucket, J, slices
+
+
+def _execute_strict_batched(ssn, batch: int = 16) -> None:
+    """The strict oracle with batched device solves (VERDICT r3 #5).
+
+    Pop-by-pop the engine is IDENTICAL to the callbacks loop — the same
+    _pop_next against the live session decides every job, and every
+    placement replays through a live Statement with the same
+    commit/discard votes. The device round trips are what's batched:
+    the next B pops are PREDICTED (clone-simulated interleave under the
+    assume-all-allocated events), solved in one carried-state device
+    program, and each prediction is verified against the live pop during
+    replay. A mismatch discards the remaining solves, rebuilds the device
+    state by re-solving the verified prefix from the batch-start state
+    (dispatch only — no fetch), and restarts prediction from the job the
+    live loop actually popped. Worst case (every prediction wrong) this
+    degrades to one job per RTT — the r3 per-job engine; typically it is
+    ~B jobs per RTT, which is what brings tpu_strict under the CPU
+    comparator it replays."""
+    import jax.numpy as jnp
+    from ..ops.place import unpack_placement
+
+    if not ssn.nodes:
+        return
+    tasks_all = [t for j in ssn.jobs.values() for t in j.tasks.values()]
+    rnames = discover_resource_names(list(ssn.nodes.values()), tasks_all)
+    node_t = NodeTensors(list(ssn.nodes.values()), rnames)
+    state = node_t.node_state()
+    allocatable_d = jnp.asarray(node_t.allocatable)
+    max_tasks_d = jnp.asarray(node_t.max_tasks)
+    weights = assemble_weights(ssn, rnames)
+    solver = _job_solver()
+    recheck = bool(ssn.stateful_predicates)
+    if recheck:
+        # stateful predicates (hostPorts, gpu cards, pod affinity) change
+        # as replay proceeds, and a batch's feasibility is assembled
+        # BEFORE its jobs replay — a later job in the batch would miss an
+        # earlier job's claims and get vetoed at recheck instead of
+        # re-solved. One job per batch reassembles feasibility after
+        # every replay, which is exactly the per-job engine's behavior.
+        batch = 1
+
+    namespaces, jobs_map = _build_interleave(ssn)
+    pending: Dict[str, List[TaskInfo]] = {}
+    carry = None        # (job, ns) a mismatch live-popped but left unprocessed
+
+    def live_tasks(job):
+        if job.uid not in pending:
+            pending[job.uid] = _pending_tasks(ssn, job)
+        return pending[job.uid]
+
+    while True:
+        carried_job, carried_ns = carry if carry is not None else (None, None)
+        predicted = _predict_pops(ssn, namespaces, jobs_map, batch,
+                                  first=carried_job)
+        carry = None
+        if not predicted:
+            break
+        with_tasks = [(j, live_tasks(j)) for j in predicted]
+        solvable = [(j, t) for j, t in with_tasks if t]
+        if solvable:
+            packed_d, new_state, bucket, J, slices = _solve_job_batch(
+                ssn, solvable, state, node_t, rnames, weights,
+                allocatable_d, max_tasks_d, solver, j_pad=batch)
+            packed = np.asarray(packed_d)            # the batch's ONE fetch
+            task_node, pipelined, _, job_kept = unpack_placement(
+                packed, bucket, J)
+        solved_ix = {id(j): k for k, (j, _) in enumerate(solvable)}
+
+        verified_prefix: List[tuple] = []
+        ok = True
+        for idx, job in enumerate(predicted):
+            if idx == 0 and carried_job is job:
+                actual, ns = job, carried_ns  # popped by the previous batch
+            else:
+                actual, _, ns = _pop_next(ssn, namespaces, jobs_map)
+            if actual is not job:
+                # live loop diverged (or drained: actual None)
+                carry = None if actual is None else (actual, ns)
+                ok = False
+                break
+            tasks = live_tasks(job)
+            stmt = ssn.statement()
+            k = solved_ix.get(id(job))
+            if k is not None:
+                lo, hi = slices[k]
+                for i, task in enumerate(tasks):
+                    n = int(task_node[lo + i])
+                    if n == NO_NODE:
+                        continue
+                    name = node_t.names[n]
+                    node = ssn.nodes[name]
+                    if recheck and not _stateful_recheck(ssn, task, node):
+                        continue
+                    if pipelined[lo + i]:
+                        stmt.pipeline(task, name)
+                    else:
+                        stmt.allocate(task, node)
+                verified_prefix.append((job, list(tasks)))
+                tasks.clear()
+            if ssn.job_ready(job):
+                stmt.commit()
+            elif not ssn.job_pipelined(job):
+                stmt.discard()
+            namespaces.push(ns)      # post-placement, like allocate.go
+        if ok and solvable:
+            state = new_state
+        elif verified_prefix:
+            # rebuild device state: re-solve just the verified prefix from
+            # the batch-start state (deterministic -> same placements); the
+            # dispatch is async and never fetched
+            _, state, _, _, _ = _solve_job_batch(
+                ssn, verified_prefix, state, node_t, rnames, weights,
+                allocatable_d, max_tasks_d, solver, j_pad=batch)
+        if carry is None and not ok:
+            break                            # live loop drained mid-batch
 
 
 _SOLVER_CACHE: dict = {}

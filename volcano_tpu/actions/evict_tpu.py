@@ -1205,6 +1205,12 @@ class _ReclaimScreener:
                 == "volcano_tpu.plugins.proportion")
 
     def _feas_row(self, task) -> np.ndarray:
+        if self.ssn.stateful_predicates:
+            # stateful predicates (pod affinity, gpu cards, ports) can
+            # LOOSEN as the rotation pipelines/evicts, so a cached static
+            # row is not a superset — skip feasibility screening entirely
+            # (the body's live predicate_fn still decides)
+            return self._all_true
         row = self._feas_cache.get(task.uid)
         if row is not None:
             return row

@@ -186,6 +186,8 @@ def _drf_dynamic(nw: EvictNW, before, jalloc, total, ls, rows=None):
     return fn
 
 
+# fill horizon: a same-request run longer than this re-evaluates once per
+# KMAX placements (the [KMAX, W] fill matrices stay tiny)
 KMAX = 64
 
 

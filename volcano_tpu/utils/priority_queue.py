@@ -22,6 +22,17 @@ class PriorityQueue:
     def push(self, it: Any) -> None:
         heapq.heappush(self._heap, _Item(it, self._less, next(self._counter)))
 
+    def clone(self) -> "PriorityQueue":
+        """Faithful copy INCLUDING insertion-sequence tie-breaks: popping
+        the clone yields exactly the order the original would (re-pushing
+        values would assign fresh sequences and reorder equal-key items).
+        Used by the strict engine's pop-prediction simulation."""
+        out = PriorityQueue(self._less)
+        out._heap = list(self._heap)          # _Item is never mutated
+        next_seq = max((it._seq for it in self._heap), default=-1) + 1
+        out._counter = itertools.count(next_seq)
+        return out
+
     def pop(self) -> Any:
         if not self._heap:
             return None
