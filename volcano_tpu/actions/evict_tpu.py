@@ -1,20 +1,28 @@
-"""Host side of the device preempt/reclaim engines (SURVEY M3, VERDICT r1
-#3): assemble victim/preemptor tensors, precompute per-tier per-plugin veto
-masks through the REAL plugin callbacks, run the ops/evict.py scans (which
-replay the tier dispatch per (preemptor, node) including drf's dynamic
-dominant-share tier), and replay the proposals through genuine Statements
-so gang atomicity and plugin event handlers see exactly what the callback
-engine would produce.
+"""Host side of the eviction engines (SURVEY M3).
 
-Victims ship to the device in a dense node-major ``[N, W]`` slot layout
-(ops/evict.py EvictNW) so per-node reductions are axis sums, and host mask
-assembly uses vectorized fast paths for the stock priority/gang/conformance
-callbacks (generic per-job Python dispatch remains for custom plugins).
+PREEMPT assembles victim/preemptor tensors, precomputes per-tier
+per-plugin veto masks through the REAL plugin callbacks, runs the
+ops/evict.py cursor walk (which replays the tier dispatch per
+(preemptor, node) including drf's dynamic dominant-share tier), and
+replays the proposals on the host — through genuine Statements with
+live-chain re-validation for custom-plugin confs, or the batched fast
+replay (aggregated deltas + the live gang job_pipelined gate) for stock
+confs. Victims ship to the device in a dense node-major ``[N, W]`` slot
+layout (ops/evict.py EvictNW); every resource quantity is gcd-scaled to
+exact small integers and node preferences travel as dense ranks of
+host-f64 scores, which is what makes the device decisions bit-identical
+to the callback engine at full benchmark scale (r4).
 
-Fixed-order caveat (same stance as the fused allocate engine): queue/job
-order is precomputed once per action on the opening snapshot instead of per
-pop; every proposal is re-validated through the live plugin chain at
-replay, so a divergence can only skip work, never evict a vetoed victim.
+Preempt's fixed-order caveat: queue/job order is precomputed once per
+action on the opening snapshot — exact for the reference's preempt,
+whose per-queue loop processes each starving job's tasks contiguously.
+
+RECLAIM runs the LITERAL callback rotation (reclaim.py) through the
+conservative vectorized node screener below (_ReclaimScreener): the
+reference's one-task-per-queue-pop rotation re-orders jobs/queues
+between pops, which no fixed-order device batching reproduces at scale,
+so reclaim keeps the rotation on host and vectorizes only the per-attempt
+node walk. Exact by construction.
 """
 
 from __future__ import annotations

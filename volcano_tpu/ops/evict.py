@@ -1,5 +1,7 @@
-"""Device-side victim selection for preempt/reclaim — "negative allocation"
-over the same score matrices the allocate kernels use (SURVEY M3).
+"""Device-side victim selection for preempt — "negative allocation"
+over the same preference machinery the allocate kernels use (SURVEY M3).
+(Reclaim has no device kernel since r4 — see actions/evict_tpu.py
+_ReclaimScreener for why its rotation stays on host.)
 
 The reference's eviction hot loop is per (preemptor, node, running-task)
 Python callbacks (/root/reference/pkg/scheduler/actions/preempt/
@@ -18,13 +20,15 @@ FULL tier semantics, in a dense per-node victim layout:
   that node; an empty set makes the tier abstain and the next tier rules
   (session_plugins.go: ``if len(candidates) == 0 { victims = nil; break }``).
   Static plugin verdicts (priority/gang guards, conformance critical pods,
-  tdm windows) are host-precomputed ``[PJ, V]`` masks gathered into the
-  ``[N, W]`` layout per step; the drf tier is DYNAMIC — job dominant shares
-  are tracked in the scan carry exactly as drf's event handlers would
-  (allocate on pipeline, deallocate on evict), including the
-  within-dispatch sequential subtraction of earlier candidates of the same
-  job (drf.go:308-330) via a per-row segmented exclusive cumsum over a
-  host-precomputed intra-row (job, candidate-order) permutation;
+  tdm windows) are host-precomputed ``[PJ, V]`` masks pre-expanded into
+  the ``[N, W]`` layout, with the CURRENT job's rows cached in the loop
+  carry (refreshed at job boundaries — an in-loop dynamic row gather from
+  an HBM-resident table costs ~30us of latency per iteration); the drf
+  tier is DYNAMIC — job dominant shares are tracked in the carry exactly
+  as drf's event handlers would (allocate on pipeline, deallocate on
+  evict), including the within-dispatch sequential subtraction of earlier
+  candidates of the same job (drf.go:308-330) as a broadcast-sum against
+  the device-expanded ``[N, W, W]`` precedence tensor;
 - **same-node runs take a cheap step.** Within one job, consecutive tasks
   with identical requests re-choose the previous node whenever it still
   fits, skipping the full dispatch: scores are static, ``fidle`` changes
@@ -39,8 +43,8 @@ FULL tier semantics, in a dense per-node victim layout:
   to a lower tier and *grow* its verdict; the host disables the cheap path
   (``allow_cheap=False``) for such confs. Failed attempts short-circuit the
   same way: an attempt mutates nothing, so the next identical task of the
-  job re-fails without re-evaluating (preempt phase 1; phase 2 and reclaim
-  already stop the job at its first failure);
+  job re-fails without re-evaluating (phase 1; phase 2 stops the whole job
+  at its first failure);
 - job boundaries carry gang statement semantics: snapshots on the first
   task of a job, rollback (alive mask, future_idle, shares, victim owners)
   when the job misses its pipeline quota — Statement.Commit/Discard on
@@ -92,19 +96,6 @@ class EvictNW(NamedTuple):
     #                             walk prologue expands it to the [N, W, W]
     #                             ``before`` tensor ON DEVICE, so the host
     #                             never builds or uploads the W^2 array
-
-
-def _gather_tier_masks(tier_masks, pj, vslot):
-    """Per-step gather: [Mt, PJ, V+1] stacked masks + [Mt, PJ]
-    participation -> ([Mt, N, W] masks, [Mt] participation) per tier."""
-    out = []
-    for stk, part in tier_masks:
-        if stk.shape[0] == 0:
-            out.append((stk, part))
-            continue
-        rows = stk[:, pj, :]                       # [Mt, V+1]
-        out.append((rows[:, vslot], part[:, pj]))  # [Mt, N, W], [Mt]
-    return out
 
 
 def _tier_eval(tier_kinds, masks_g, cand, dynamic_fn):
@@ -163,23 +154,24 @@ def _drf_dynamic(nw: EvictNW, before, jalloc, total, ls, rows=None):
     """drf.go:308-330 — victim stays a candidate iff the preemptor's share
     (with the task) stays <= the victim job's share after losing the victim
     and every earlier same-(node, job) candidate. The within-dispatch
-    exclusive prefix is one batched matmul against the ``before`` tensor:
-    prior[n,w,r] = sum_u before[n,u,w] * cand[n,u] * vreq[n,u,r] — a
-    [W, W] x [W, R] matmul per node instead of the v2 kernels'
-    sort/cumsum/unsort chain (take_along_axis costs ~40us per op inside a
-    device loop; the einsum is one). ``rows``: optional i32[n] node-row
-    restriction."""
+    exclusive prefix is a broadcast-sum against the ``before`` precedence
+    tensor: prior[n,w,r] = sum_u before[n,u,w] * cand[n,u] * vreq[n,u,r]
+    — replacing the v2 kernels' sort/cumsum/unsort chain (take_along_axis
+    costs ~40us per op inside a device loop). ``rows``: optional i32[n]
+    node-row restriction."""
     before = before if rows is None else before[rows]
     vreq = nw.vreq if rows is None else nw.vreq[rows]
     vgroup = nw.vgroup if rows is None else nw.vgroup[rows]
 
     def fn(cand):
         masked = vreq * cand[..., None]
-        # HIGHEST precision: the TPU default would run this matmul in
-        # bf16, perturbing rs by far more than SHARE_DELTA and flipping
-        # verdicts vs the exact-f32 CPU comparator
-        prior = jnp.einsum("nuw,nur->nwr", before, masked,
-                           precision=jax.lax.Precision.HIGHEST)
+        # explicit broadcast-sum, NOT a matmul: einsum would go through
+        # the MXU (bf16 by default — verdict flips vs the f64 comparator;
+        # HIGHEST fixes that but costs ~100us per walk iteration at these
+        # tiny shapes). The [n, W, W, R] product is ~150k elements, the
+        # operands are gcd-scaled small integers, so pure VPU f32
+        # multiply-add is both exact and fast.
+        prior = jnp.sum(before[..., None] * masked[:, :, None, :], axis=1)
         ralloc = jalloc[vgroup] - prior - vreq
         rs = _share(ralloc, total)
         return cand & ((ls < rs) | (jnp.abs(ls - rs) <= SHARE_DELTA)), rs
@@ -304,6 +296,13 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
         has_drf = any(k == "drf" for k in tier_kinds)
         iota_p = jnp.arange(P, dtype=jnp.int32)
         before = expand_before(nw) if has_drf else None
+        # the CURRENT job's candidate/veto rows live in the carry as
+        # [N, W] expansions, refreshed only at job boundaries (~PJ times):
+        # an in-loop dynamic row gather from an HBM-resident [PJ, V+1]
+        # table costs ~25-35us of latency PER ITERATION on TPU. Only the
+        # compact [*, PJ, V+1] tables stay resident — expanding ALL jobs
+        # to [PJ, N, W] up front would blow up by N*W/(V+1) on skewed
+        # victim distributions.
 
         class Carry(NamedTuple):
             i: jnp.ndarray           # i32[] task cursor
@@ -317,6 +316,8 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
             prev_node: jnp.ndarray   # i32[]
             prev_ok: jnp.ndarray     # bool[]
             prev_rid: jnp.ndarray    # i32[] run of the last evaluation
+            cur_cand: jnp.ndarray    # bool[N, W] current job's candidates
+            cur_masks: tuple         # per tier ([Mt, N, W], [Mt])
             s_alive: jnp.ndarray
             s_fidle: jnp.ndarray
             s_jalloc: jnp.ndarray
@@ -331,13 +332,13 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
             rend = run_end[i]
             jend = job_end[i]
 
-            if gang_commit:
-                # job boundary: close the previous job's statement
-                # (rollback on missed quota) and snapshot for this one.
-                # Every job's first task is visited — cursor jumps only
-                # land within the current job or on the next job's first
-                # task — so no boundary is ever skipped.
-                def close_and_snapshot(c):
+            # job boundary: refresh the carry-cached per-job rows, and
+            # (gang mode) close the previous job's statement — rollback on
+            # missed quota — then snapshot for this one. Every job's first
+            # task is visited: cursor jumps only land within the current
+            # job or on the next job's first task.
+            def job_boundary(c):
+                if gang_commit:
                     prev = c.last_pj
                     failed = (prev >= 0) & \
                         (c.pipe_cnt[prev] < needed[prev])
@@ -349,10 +350,17 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                         pipe_cnt=jnp.where(
                             failed, c.pipe_cnt.at[prev].set(-BIG),
                             c.pipe_cnt))
-                    return c._replace(s_alive=c.alive, s_fidle=c.fidle,
-                                      s_jalloc=c.jalloc, s_owner=c.owner)
-                c = jax.lax.cond(first_of_job[i], close_and_snapshot,
-                                 lambda c: c, c)
+                    c = c._replace(s_alive=c.alive, s_fidle=c.fidle,
+                                   s_jalloc=c.jalloc, s_owner=c.owner)
+                return c._replace(
+                    cur_cand=cand_mask[pj][nw.vslot] & nw.valid,
+                    cur_masks=tuple(
+                        ((stk[:, pj, :][:, nw.vslot] if stk.shape[0]
+                          else jnp.zeros((0, N, W), bool)),
+                         part[:, pj])
+                        for stk, part in tier_masks))
+            c = jax.lax.cond(first_of_job[i], job_boundary,
+                             lambda c: c, c)
 
             def inactive_step(c):
                 # quota met: every remaining task of the job is inactive
@@ -361,7 +369,6 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                                   prev_ok=jnp.zeros((), bool))
 
             def active_step(c):
-                cand_v = cand_mask[pj]                       # [V+1]
                 ls = _share(c.jalloc[pjg_i] + req, total) if has_drf \
                     else None
                 quota_left = needed[pj] - c.pipe_cnt[pj]
@@ -378,11 +385,9 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                 # unconditionally (it is tiny next to the [N, W] dispatch)
                 # so the full dispatch is traced exactly ONCE
                 b0 = c.prev_node
-                slots_b = nw.vslot[b0]                       # [W]
-                cand_b = c.alive[b0] & cand_v[slots_b] & nw.valid[b0]
-                masks_b = [((stk[:, pj, :][:, slots_b][:, None]
-                             if stk.shape[0] else stk), part[:, pj])
-                           for stk, part in tier_masks]
+                cand_b = c.alive[b0] & c.cur_cand[b0]
+                masks_b = [(m_nw[:, b0][:, None], part)
+                           for m_nw, part in c.cur_masks]
                 elig_b, dyn_dec_b, rs_b = _tier_eval(
                     tier_kinds, masks_b, cand_b[None],
                     dynamic_for(b0[None]))
@@ -396,8 +401,8 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                              & c.prev_ok & fits_b)
 
                 def full_eval():
-                    masks_g = _gather_tier_masks(tier_masks, pj, nw.vslot)
-                    cand = c.alive & cand_v[nw.vslot] & nw.valid
+                    masks_g = c.cur_masks
+                    cand = c.alive & c.cur_cand
                     elig, dyn_dec, rs = _tier_eval(
                         tier_kinds, masks_g, cand, dynamic_for(None))
                     elig_f = elig.astype(fdtype)
@@ -490,6 +495,12 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
             prev_node=jnp.zeros((), jnp.int32),
             prev_ok=jnp.zeros((), bool),
             prev_rid=jnp.full((), -1, jnp.int32),
+            # overwritten at the first job boundary before any read
+            cur_cand=jnp.zeros((N, W), bool),
+            cur_masks=tuple(
+                (jnp.zeros(stk.shape[:1] + (N, W), bool),
+                 jnp.zeros(part.shape[:1], bool))
+                for stk, part in tier_masks),
             s_alive=jnp.ones((N, W), bool), s_fidle=future_idle0,
             s_jalloc=jalloc0, s_owner=jnp.full((N, W), -1, jnp.int32))
 
