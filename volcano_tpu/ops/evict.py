@@ -164,18 +164,25 @@ def _drf_dynamic(nw: EvictNW, before, jalloc, total, ls, rows=None):
     vgroup = nw.vgroup if rows is None else nw.vgroup[rows]
 
     def fn(cand):
-        masked = vreq * cand[..., None]
-        # explicit broadcast-sum, NOT a matmul: einsum would go through
-        # the MXU (bf16 by default — verdict flips vs the f64 comparator;
-        # HIGHEST fixes that but costs ~100us per walk iteration at these
-        # tiny shapes). The [n, W, W, R] product is ~150k elements, the
-        # operands are gcd-scaled small integers, so pure VPU f32
-        # multiply-add is both exact and fast.
-        prior = jnp.sum(before[..., None] * masked[:, :, None, :], axis=1)
-        ralloc = jalloc[vgroup] - prior - vreq
-        rs = _share(ralloc, total)
-        return cand & ((ls < rs) | (jnp.abs(ls - rs) <= SHARE_DELTA)), rs
+        return _drf_keep(vreq, before, vgroup, jalloc, total, ls, cand)
     return fn
+
+
+def _drf_keep(vreq, before, vgroup, jalloc, total, ls, cand):
+    """The drf verdict core over a leading node axis of any size —
+    SHARED by the full dispatch and the walk's carry-cached row path so
+    the keep-rule can never diverge between them."""
+    masked = vreq * cand[..., None]
+    # explicit broadcast-sum, NOT a matmul: einsum would go through
+    # the MXU (bf16 by default — verdict flips vs the f64 comparator;
+    # HIGHEST fixes that but costs ~100us per walk iteration at these
+    # tiny shapes). The [n, W, W, R] product is ~150k elements, the
+    # operands are gcd-scaled small integers, so pure VPU f32
+    # multiply-add is both exact and fast.
+    prior = jnp.sum(before[..., None] * masked[..., :, None, :], axis=-3)
+    ralloc = jalloc[vgroup] - prior - vreq
+    rs = _share(ralloc, total)
+    return cand & ((ls < rs) | (jnp.abs(ls - rs) <= SHARE_DELTA)), rs
 
 
 # fill horizon: a same-request run longer than this re-evaluates once per
@@ -318,6 +325,18 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
             prev_rid: jnp.ndarray    # i32[] run of the last evaluation
             cur_cand: jnp.ndarray    # bool[N, W] current job's candidates
             cur_masks: tuple         # per tier ([Mt, N, W], [Mt])
+            # chosen-node ROW caches (refreshed on node switches in
+            # full_eval; mutated alongside the [N, *] arrays): the cheap
+            # path reads ONLY these, avoiding per-iteration dynamic row
+            # gathers from HBM tables. Stale values are harmless — every
+            # read is gated by can_cheap, which is False whenever the run
+            # or node changed.
+            b_vreq: jnp.ndarray      # f32[W, R]
+            b_fidle: jnp.ndarray     # f32[R]
+            b_alive: jnp.ndarray     # bool[W]
+            b_cand: jnp.ndarray      # bool[W]
+            b_before: object         # f32[W, W] (None without a drf tier)
+            b_vgroup: jnp.ndarray    # i32[W]
             s_alive: jnp.ndarray
             s_fidle: jnp.ndarray
             s_jalloc: jnp.ndarray
@@ -380,22 +399,33 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                     return _drf_dynamic(nw, before, c.jalloc, total, ls,
                                         rows=rows)
 
+                def dynamic_row_cached(cand_w):
+                    # row-restricted drf over the CARRY-CACHED node rows —
+                    # no HBM row gathers (the [N, W, (W)] tables live in
+                    # HBM; a dynamic row read costs ~25-35us of latency)
+                    if not has_drf:
+                        return cand_w, None
+                    return _drf_keep(c.b_vreq, c.b_before, c.b_vgroup,
+                                     c.jalloc, total, ls, cand_w)
+
                 # row-local re-evaluation on the previous node: exact tier
-                # dispatch restricted to one row, W-sized ops, computed
-                # unconditionally (it is tiny next to the [N, W] dispatch)
-                # so the full dispatch is traced exactly ONCE
+                # dispatch restricted to one row, W-sized carry-cached
+                # ops, computed unconditionally (it is tiny next to the
+                # [N, W] dispatch) so the full dispatch is traced ONCE
+                def dyn_row(cand_x):           # [1, W] -> ([1, W], extra)
+                    keep, rs = dynamic_row_cached(cand_x[0])
+                    return keep[None], (None if rs is None else rs[None])
+
                 b0 = c.prev_node
-                cand_b = c.alive[b0] & c.cur_cand[b0]
+                cand_b = c.b_alive & c.b_cand
                 masks_b = [(m_nw[:, b0][:, None], part)
                            for m_nw, part in c.cur_masks]
                 elig_b, dyn_dec_b, rs_b = _tier_eval(
-                    tier_kinds, masks_b, cand_b[None],
-                    dynamic_for(b0[None]))
+                    tier_kinds, masks_b, cand_b[None], dyn_row)
                 elig_b = elig_b[0]
                 evictable_b = jnp.sum(
-                    nw.vreq[b0] * elig_b[:, None].astype(fdtype),
-                    axis=0)
-                fits_b = jnp.all(req < c.fidle[b0] + evictable_b
+                    c.b_vreq * elig_b[:, None].astype(fdtype), axis=0)
+                fits_b = jnp.all(req < c.b_fidle + evictable_b
                                  + EPS) & jnp.any(elig_b)
                 can_cheap = (jnp.asarray(allow_cheap) & (rid == c.prev_rid)
                              & c.prev_ok & fits_b)
@@ -414,19 +444,28 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                     row = jnp.where(fits, score_g[rid], -jnp.inf)
                     best = jnp.argmax(row).astype(jnp.int32)
                     found = row[best] > -jnp.inf
+                    # node switch: load the chosen node's rows (the only
+                    # HBM row gathers on this path, ~#full_evals times)
                     return (best, found, elig[best],
                             rs[best] if has_drf else rs,
-                            dyn_dec[best])
+                            dyn_dec[best], nw.vreq[best], c.fidle[best],
+                            c.alive[best], c.cur_cand[best],
+                            before[best] if has_drf else rs,
+                            nw.vgroup[best])
 
                 def cheap_eval():
                     return (b0, jnp.ones((), bool), elig_b,
                             rs_b[0] if has_drf else rs_b,
-                            dyn_dec_b[0])
+                            dyn_dec_b[0], c.b_vreq, c.b_fidle,
+                            c.b_alive, c.b_cand,
+                            c.b_before if has_drf else rs_b,
+                            c.b_vgroup)
 
-                best, found, elig_row, rs_row, dyn_dec_b0 = jax.lax.cond(
-                    can_cheap, cheap_eval, full_eval)
+                (best, found, elig_row, rs_row, dyn_dec_b0, b_vreq,
+                 b_fidle, b_alive, b_cand, b_before,
+                 b_vgroup) = jax.lax.cond(can_cheap, cheap_eval, full_eval)
                 k, evicted, t_w = _fill_schedule(
-                    nw.vreq[best], c.fidle[best], elig_row, rs_row,
+                    b_vreq, b_fidle, elig_row, rs_row,
                     dyn_dec_b0, req, c.jalloc[pjg_i], total,
                     run_left_i, quota_left, has_drf)
                 if not allow_cheap:
@@ -439,21 +478,22 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                 k = jnp.where(ok, jnp.maximum(k, 1), 0)
                 evicted = evicted & (t_w <= k) & ok
 
+                new_alive_row = b_alive & ~evicted
+
                 def apply_evictions(carry):
                     alive, owner, jalloc = carry
-                    vjob_row = nw.vgroup[best]                # [W]
                     AJ1 = jalloc.shape[0]
-                    job_onehot = jax.nn.one_hot(vjob_row, AJ1,
+                    job_onehot = jax.nn.one_hot(b_vgroup, AJ1,
                                                 dtype=fdtype)
                     jalloc = jalloc - job_onehot.T @ (
-                        nw.vreq[best] * evicted[:, None].astype(fdtype))
-                    alive = alive.at[best].set(alive[best] & ~evicted)
+                        b_vreq * evicted[:, None].astype(fdtype))
+                    alive = alive.at[best].set(new_alive_row)
                     # victims belong to the chunk step of the attempt that
                     # wanted them — the replay groups evictions per task
                     owner = owner.at[best].set(
                         jnp.where(evicted, i + t_w - 1, owner[best]))
                     freed = jnp.sum(
-                        nw.vreq[best] * evicted[:, None].astype(fdtype),
+                        b_vreq * evicted[:, None].astype(fdtype),
                         axis=0)
                     return (alive, owner, jalloc), freed
 
@@ -479,7 +519,12 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                     owner=owner,
                     task_node=task_node,
                     pipe_cnt=c.pipe_cnt.at[pj].add(k),
-                    prev_node=best, prev_ok=ok, prev_rid=rid)
+                    prev_node=best, prev_ok=ok, prev_rid=rid,
+                    # node-row caches track the (possibly new) chosen
+                    # node's post-apply state
+                    b_vreq=b_vreq, b_fidle=b_fidle + delta,
+                    b_alive=new_alive_row, b_cand=b_cand,
+                    b_before=b_before, b_vgroup=b_vgroup)
 
             active = c.pipe_cnt[pj] < needed[pj]
             return jax.lax.cond(active, active_step, inactive_step, c)
@@ -501,6 +546,12 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                 (jnp.zeros(stk.shape[:1] + (N, W), bool),
                  jnp.zeros(part.shape[:1], bool))
                 for stk, part in tier_masks),
+            b_vreq=jnp.zeros((W, R), preq.dtype),
+            b_fidle=jnp.zeros(R, preq.dtype),
+            b_alive=jnp.zeros(W, bool),
+            b_cand=jnp.zeros(W, bool),
+            b_before=(jnp.zeros((W, W), jnp.float32) if has_drf else None),
+            b_vgroup=jnp.zeros(W, jnp.int32),
             s_alive=jnp.ones((N, W), bool), s_fidle=future_idle0,
             s_jalloc=jalloc0, s_owner=jnp.full((N, W), -1, jnp.int32))
 
