@@ -337,6 +337,7 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
             b_cand: jnp.ndarray      # bool[W]
             b_before: object         # f32[W, W] (None without a drf tier)
             b_vgroup: jnp.ndarray    # i32[W]
+            b_mrow: tuple            # per tier ([Mt, 1, W], [Mt]) mask rows
             s_alive: jnp.ndarray
             s_fidle: jnp.ndarray
             s_jalloc: jnp.ndarray
@@ -418,10 +419,8 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
 
                 b0 = c.prev_node
                 cand_b = c.b_alive & c.b_cand
-                masks_b = [(m_nw[:, b0][:, None], part)
-                           for m_nw, part in c.cur_masks]
                 elig_b, dyn_dec_b, rs_b = _tier_eval(
-                    tier_kinds, masks_b, cand_b[None], dyn_row)
+                    tier_kinds, c.b_mrow, cand_b[None], dyn_row)
                 elig_b = elig_b[0]
                 evictable_b = jnp.sum(
                     c.b_vreq * elig_b[:, None].astype(fdtype), axis=0)
@@ -451,7 +450,9 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                             dyn_dec[best], nw.vreq[best], c.fidle[best],
                             c.alive[best], c.cur_cand[best],
                             before[best] if has_drf else rs,
-                            nw.vgroup[best])
+                            nw.vgroup[best],
+                            tuple((m_nw[:, best][:, None], part)
+                                  for m_nw, part in c.cur_masks))
 
                 def cheap_eval():
                     return (b0, jnp.ones((), bool), elig_b,
@@ -459,11 +460,11 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                             dyn_dec_b[0], c.b_vreq, c.b_fidle,
                             c.b_alive, c.b_cand,
                             c.b_before if has_drf else rs_b,
-                            c.b_vgroup)
+                            c.b_vgroup, c.b_mrow)
 
                 (best, found, elig_row, rs_row, dyn_dec_b0, b_vreq,
-                 b_fidle, b_alive, b_cand, b_before,
-                 b_vgroup) = jax.lax.cond(can_cheap, cheap_eval, full_eval)
+                 b_fidle, b_alive, b_cand, b_before, b_vgroup,
+                 b_mrow) = jax.lax.cond(can_cheap, cheap_eval, full_eval)
                 k, evicted, t_w = _fill_schedule(
                     b_vreq, b_fidle, elig_row, rs_row,
                     dyn_dec_b0, req, c.jalloc[pjg_i], total,
@@ -524,7 +525,7 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                     # node's post-apply state
                     b_vreq=b_vreq, b_fidle=b_fidle + delta,
                     b_alive=new_alive_row, b_cand=b_cand,
-                    b_before=b_before, b_vgroup=b_vgroup)
+                    b_before=b_before, b_vgroup=b_vgroup, b_mrow=b_mrow)
 
             active = c.pipe_cnt[pj] < needed[pj]
             return jax.lax.cond(active, active_step, inactive_step, c)
@@ -552,6 +553,10 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
             b_cand=jnp.zeros(W, bool),
             b_before=(jnp.zeros((W, W), jnp.float32) if has_drf else None),
             b_vgroup=jnp.zeros(W, jnp.int32),
+            b_mrow=tuple(
+                (jnp.zeros(stk.shape[:1] + (1, W), bool),
+                 jnp.zeros(part.shape[:1], bool))
+                for stk, part in tier_masks),
             s_alive=jnp.ones((N, W), bool), s_fidle=future_idle0,
             s_jalloc=jalloc0, s_owner=jnp.full((N, W), -1, jnp.int32))
 
