@@ -272,14 +272,22 @@ func k8sToWire(kind string, obj map[string]any) (map[string]any, error) {
 	spec := mapOf(obj["spec"])
 	switch kind {
 	case "Job":
-		out["spec"] = jobSpecToWire(spec)
+		s, err := jobSpecToWire(spec)
+		if err != nil {
+			return nil, err
+		}
+		out["spec"] = s
 	case "Queue":
 		s := map[string]any{}
 		if w, ok := spec["weight"]; ok {
 			s["weight"] = w
 		}
 		if c, ok := spec["capability"]; ok && c != nil {
-			s["capability"] = resListToWire(mapOf(c))
+			capRes, err := resListToWire(mapOf(c))
+			if err != nil {
+				return nil, err
+			}
+			s["capability"] = capRes
 		}
 		if rc, ok := spec["reclaimable"]; ok && rc != nil {
 			s["reclaimable"] = rc
@@ -301,7 +309,11 @@ func k8sToWire(kind string, obj map[string]any) (map[string]any, error) {
 			s["priority_class_name"] = pc
 		}
 		if mr, ok := spec["minResources"]; ok && mr != nil {
-			s["min_resources"] = resListToWire(mapOf(mr))
+			mres, err := resListToWire(mapOf(mr))
+			if err != nil {
+				return nil, err
+			}
+			s["min_resources"] = mres
 		}
 		out["spec"] = s
 		// podgroup phase drives the bare-pod gate
@@ -314,7 +326,11 @@ func k8sToWire(kind string, obj map[string]any) (map[string]any, error) {
 		if sn, ok := spec["schedulerName"]; ok {
 			out["scheduler_name"] = sn
 		}
-		out["template"] = podTemplateToWire(spec, mapOf(obj["metadata"]))
+		tpl, err := podTemplateToWire(spec, mapOf(obj["metadata"]))
+		if err != nil {
+			return nil, err
+		}
+		out["template"] = tpl
 	default:
 		return nil, fmt.Errorf("unsupported kind %q", kind)
 	}
@@ -354,7 +370,7 @@ func metaToWire(md map[string]any) map[string]any {
 	return out
 }
 
-func jobSpecToWire(spec map[string]any) map[string]any {
+func jobSpecToWire(spec map[string]any) (map[string]any, error) {
 	out := map[string]any{}
 	copyIf(out, spec, "schedulerName", "scheduler_name")
 	copyIf(out, spec, "queue", "queue")
@@ -379,14 +395,18 @@ func jobSpecToWire(spec map[string]any) map[string]any {
 			task["policies"] = policiesToWire(pol)
 		}
 		tpl := mapOf(tm["template"])
-		task["template"] = podTemplateToWire(mapOf(tpl["spec"]),
+		wtpl, err := podTemplateToWire(mapOf(tpl["spec"]),
 			mapOf(tpl["metadata"]))
+		if err != nil {
+			return nil, err
+		}
+		task["template"] = wtpl
 		tasks = append(tasks, task)
 	}
 	if tasks != nil {
 		out["tasks"] = tasks
 	}
-	return out
+	return out, nil
 }
 
 func policiesToWire(pol []any) []any {
@@ -405,8 +425,10 @@ func policiesToWire(pol []any) []any {
 
 // podTemplateToWire maps a core/v1 PodSpec (+ template metadata) onto the
 // PodTemplate dataclass mirror, summing container requests into the codec
-// res dict exactly like buildSnapshot's podRequest.
-func podTemplateToWire(podSpec, md map[string]any) map[string]any {
+// res dict exactly like buildSnapshot's podRequest. Malformed quantities
+// propagate as errors so the AdmissionReview is denied with the decode
+// error rather than admitted on under-counted resources.
+func podTemplateToWire(podSpec, md map[string]any) (map[string]any, error) {
 	out := map[string]any{}
 	copyIf(out, podSpec, "nodeSelector", "node_selector")
 	copyIf(out, podSpec, "tolerations", "tolerations")
@@ -425,7 +447,11 @@ func podTemplateToWire(podSpec, md map[string]any) map[string]any {
 		cm := mapOf(c)
 		containers = append(containers, cm)
 		reqs := mapOf(mapOf(cm["resources"])["requests"])
-		total = addRes(total, resFromStringMap(reqs))
+		r, err := resFromStringMap(reqs)
+		if err != nil {
+			return nil, err
+		}
+		total = addRes(total, r)
 	}
 	if containers != nil {
 		out["containers"] = containers
@@ -433,10 +459,14 @@ func podTemplateToWire(podSpec, md map[string]any) map[string]any {
 	if total.MilliCPU != 0 || total.Memory != 0 || len(total.Scalars) > 0 {
 		out["resources"] = resToWire(total)
 	}
-	return out
+	return out, nil
 }
 
-func resFromStringMap(m map[string]any) res {
+// resFromStringMap decodes a core/v1 ResourceList. A malformed quantity is
+// an ERROR, not a skip: silently under-counting a request would let the
+// sidecar admit on wrong data, while every other decode failure on this
+// path is fail-closed (the DecodeJob stance).
+func resFromStringMap(m map[string]any) (res, error) {
 	out := res{Scalars: map[string]float64{}}
 	for name, v := range m {
 		s, ok := v.(string)
@@ -444,12 +474,13 @@ func resFromStringMap(m map[string]any) res {
 			if f, okf := v.(float64); okf {
 				s = fmt.Sprintf("%v", f)
 			} else {
-				continue
+				return out, fmt.Errorf(
+					"resource %q: unsupported quantity type %T", name, v)
 			}
 		}
 		q, err := resource.ParseQuantity(s)
 		if err != nil {
-			continue
+			return out, fmt.Errorf("resource %q: %v", name, err)
 		}
 		switch name {
 		case "cpu":
@@ -462,11 +493,15 @@ func resFromStringMap(m map[string]any) res {
 			}
 		}
 	}
-	return out
+	return out, nil
 }
 
-func resListToWire(m map[string]any) map[string]any {
-	return resToWire(resFromStringMap(m))
+func resListToWire(m map[string]any) (map[string]any, error) {
+	r, err := resFromStringMap(m)
+	if err != nil {
+		return nil, err
+	}
+	return resToWire(r), nil
 }
 
 func resToWire(r res) map[string]any {

@@ -369,6 +369,11 @@ def _predict_pops(ssn, namespaces, jobs_map, n: int, first=None) -> List:
             agg = _assume_allocated(ssn, job)
             if agg is not None:
                 simulated.append(agg)
+            # the live loop popped this carried job's namespace and will
+            # push it back once the job's statement closes — mirror that,
+            # or a single-namespace sim PQ drains after one carry and
+            # every later batch degenerates to the carried job alone
+            sim_ns.push(job.namespace)
         while len(predicted) < n:
             job, _, ns = _pop_next(ssn, sim_ns, sim_map)
             if job is None:

@@ -59,6 +59,18 @@ DEVICE_MIN_VICTIMS = {"preempt": 0}
 # the callbacks path — decisions are identical by the parity contract
 MAX_W = 64
 
+# transient-HBM budget for the walk's largest intermediates: the [N, W, W]
+# ``before`` tensor and the drf dispatch's [N, W, W, R] broadcast product
+# (f32 elements). MAX_W alone does not bound them — 10k+ nodes with
+# near-MAX_W victim skew would allocate GBs per full_eval. ~256M f32
+# elements ≈ 1 GiB of transient HBM, comfortable on a 16 GiB chip.
+MAX_NWWR_ELEMS = 256 << 20
+
+
+def _device_shape_ok(n_nodes: int, victims, n_res: int) -> bool:
+    w = _max_per_node(victims)
+    return w <= MAX_W and n_nodes * w * w * max(n_res, 1) <= MAX_NWWR_ELEMS
+
 
 def _device_min_victims(ssn, action_name: str) -> int:
     default = DEVICE_MIN_VICTIMS[action_name]
@@ -537,11 +549,17 @@ def _f64_scores(ssn, rep_tasks, node_t) -> Optional[np.ndarray]:
         return None
     nodes = [ssn.nodes[name] for name in node_t.names]
     N, G = len(nodes), len(rep_tasks)
+    # a non-stock batch scorer may depend on the node LIST it is handed
+    # (the callback comparator scores the per-attempt feasible subset, we
+    # would score all nodes once) — no exact replica, like the pod-affinity
+    # bail-out above. The stock taint scorer is per-node independent.
     stock_batch = all(
         getattr(fn, "__module__", "") == "volcano_tpu.plugins.nodeorder"
         for _, fn in ssn._enabled_fns(ssn.batch_node_order_fns,
                                       "enabledNodeOrder"))
-    need_batch = not stock_batch or any(n.taints for n in nodes)
+    if not stock_batch:
+        return None
+    need_batch = any(n.taints for n in nodes)
     alloc_c = np.asarray([n.allocatable.cpu for n in nodes], np.float64)
     alloc_m = np.asarray([n.allocatable.memory for n in nodes], np.float64)
     used_c0 = np.asarray([n.used.cpu for n in nodes], np.float64)
@@ -732,8 +750,20 @@ def execute_preempt_tpu(ssn) -> None:
     """Device preempt: phase 1 inter-job (gang statements), phase 2
     intra-job, then the host victim_tasks pass."""
     victims = _eviction_order(ssn, _collect_victims(ssn))
+    # R for the budget gate is the UNION of resource names the kernel will
+    # see (discover_resource_names over nodes + victims + preemptors), not
+    # a per-node max — undercounting R here would defeat the OOM guard on
+    # heterogeneous clusters. Pending tasks over-approximate preemptors.
+    names = set()
+    for n in ssn.nodes.values():
+        names.update(n.allocatable.resource_names())
+    for v in victims:
+        names.update(v.resreq.resource_names())
+    for job in ssn.jobs.values():
+        for t in job.task_status_index.get(TaskStatus.PENDING, {}).values():
+            names.update(t.resreq.resource_names())
     if len(victims) < _device_min_victims(ssn, "preempt") \
-            or _max_per_node(victims) > MAX_W:
+            or not _device_shape_ok(len(ssn.nodes), victims, len(names)):
         from .preempt import PreemptAction
         return PreemptAction(engine="callbacks")._execute_callbacks(ssn)
     pjobs, under_request = _starving_jobs(ssn)
@@ -753,17 +783,25 @@ def execute_preempt_tpu(ssn) -> None:
         _preempt_phase(ssn, pjobs, victims, inter_job=True)
     # phase 2: within-job preemption, one pass in underRequest order
     # (preempt.go:146-183) — only jobs that still have pending tasks AND
-    # own running victims can act
+    # own running victims can act (victims re-collected only then: the
+    # phase-1 statements may have flipped RUNNING tasks to RELEASING)
     pjobs2 = [j for j in under_request
               if j.task_status_index.get(TaskStatus.PENDING)
               and j.task_status_index.get(TaskStatus.RUNNING)]
-    victims2 = _eviction_order(ssn, _collect_victims(ssn))
-    if pjobs2 and victims2:
-        _preempt_phase(ssn, pjobs2, victims2, inter_job=False)
+    if pjobs2:
+        victims2 = _eviction_order(ssn, _collect_victims(ssn))
+        if victims2:
+            _preempt_phase(ssn, pjobs2, victims2, inter_job=False)
     _victim_tasks_host(ssn)
 
 
+# Per-cycle phase timers of the last device preempt (seconds) — the
+# host/device breakdown bench.py reports, keyed per phase.
+LAST_STATS: Dict[str, float] = {}
+
+
 def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
+    import time
     import jax.numpy as jnp
     from ..ops.evict import build_preempt_walk
 
@@ -786,12 +824,12 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
 
     if inter_job:
         cand_kind = "inter-queue"
-        needed = np.asarray(
+        needed_j = np.asarray(
             [max(0, j.min_available - j.ready_task_num()
                  - j.waiting_task_num()) for j in kept_jobs], np.int32)
     else:
         cand_kind = "intra-job"
-        needed = np.full(len(kept_jobs), BIG, np.int32)
+        needed_j = np.full(len(kept_jobs), BIG, np.int32)
 
     stack = _TierStack(ssn, kept_jobs, victims, ssn.preemptable_fns,
                        "enabledPreemptable", "drf", cand_kind)
@@ -804,8 +842,12 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
     vjob, jalloc0, total, vrank, job_index = _drf_inputs(
         ssn, tensors, victims, need_group=stack.has_dynamic)
     nw = tensors.nw_inputs(vjob, len(job_index), vrank)
-    pjg = np.asarray([job_index[j.uid] for j in kept_jobs],
-                     np.int32)[pjob_arr]
+    pjg_job = np.asarray([job_index[j.uid] for j in kept_jobs], np.int32)
+    pjg = pjg_job[pjob_arr]
+    # pipeline quota keyed by ALLOC-GROUP index — the walk tracks it as
+    # the fused last column of its jstate matrix (ops/evict.py)
+    needed = np.zeros(len(job_index) + 1, np.float32)
+    needed[pjg_job] = needed_j
 
     # intra-job preemption breaks the same-node-run shrink argument when a
     # dynamic tier is present: the victim job IS the preemptor's job, so
@@ -816,6 +858,8 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
     fn = build_preempt_walk(stack.kinds, stack.sizes, inter_job,
                             allow_cheap)
     import jax
+    key = "p1" if inter_job else "p2"
+    t0 = time.perf_counter()
     inputs = jax.device_put((
         tensors.future_idle0(), nw, stack.padded_cand_mask(),
         stack.device_masks(), preq, pjob_arr, pjg, first_np,
@@ -823,20 +867,27 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
         needed, jalloc0, total))                            # one upload
     (fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, pjg_d, first_d,
      rid_d, rend_d, jend_d, needed_d, jalloc_d, total_d) = inputs
-    task_node, owner_nw, job_done = fn(
+    LAST_STATS[key + "_upload_s"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    task_node, owner_nw, job_done, iters = fn(
         fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, pjg_d, first_d,
         rid_d, rend_d, jend_d, score_g, needed_d, jalloc_d, total_d)
     N, W = tensors.vslot.shape
     P = len(ptasks)
     packed = np.asarray(jnp.concatenate([
         task_node, owner_nw.reshape(-1),
-        job_done.astype(jnp.int32)]))                       # one fetch
+        job_done.astype(jnp.int32), iters[None]]))          # one fetch
+    LAST_STATS[key + "_solve_s"] = time.perf_counter() - t0
     task_node = packed[:P]
     owner_nw = packed[P:P + N * W].reshape(N, W)
-    job_done = packed[P + N * W:].astype(bool)
+    # per-group verdicts -> per kept job via its alloc-group index
+    job_done = packed[P + N * W:-1].astype(bool)[pjg_job]
+    LAST_STATS[key + "_iters"] = int(packed[-1])
 
+    t0 = time.perf_counter()
     _replay_preempt(ssn, ptasks, pjob_ix, kept_jobs, tensors,
                     task_node, owner_nw, job_done, inter_job, stack)
+    LAST_STATS[key + "_replay_s"] = time.perf_counter() - t0
 
 
 def _fast_evict_ok(ssn, stack: "_TierStack") -> bool:
@@ -939,6 +990,8 @@ def _replay_preempt_fast(ssn, ptasks, pjob_ix, kept_jobs, tensors,
     alloc_agg: Dict[int, Resource] = {}
     dealloc_agg: Dict[str, Resource] = {}
     cache_evicts: List[TaskInfo] = []
+    rolled_back = False
+    n_attempts = last_victims = 0
     for jx, ids in per_job.items():
         job = kept_jobs[jx]
         applied_p: List[TaskInfo] = []
@@ -949,9 +1002,19 @@ def _replay_preempt_fast(ssn, ptasks, pjob_ix, kept_jobs, tensors,
             evicted = victims_by_step.get(i, [])
             for vt in evicted:
                 applied_v.append(_fast_evict(ssn, vt))
-            metrics.update_preemption_victims(len(evicted))
-            metrics.register_preemption_attempt()
-            _fast_pipeline(ssn, ptasks[i], names[task_node[i]])
+            n_attempts += 1
+            last_victims = len(evicted)
+            host = names[task_node[i]]
+            # Until a host-side rollback happens, live future_idle matches
+            # the kernel's in-device fidle exactly, so fit holds by kernel
+            # invariant. After one, an earlier job's un-done evictions can
+            # leave a node below what the kernel assumed — re-check the
+            # slow path's pre-pipeline fit gate (preempt.go:263-267) and
+            # skip the pipeline (evictions stand, as in the slow path).
+            if rolled_back and not ptasks[i].init_resreq.less_equal(
+                    ssn.nodes[host].future_idle()):
+                continue
+            _fast_pipeline(ssn, ptasks[i], host)
             applied_p.append(ptasks[i])
         if not applied_p and not applied_v:
             continue
@@ -960,6 +1023,7 @@ def _replay_preempt_fast(ssn, ptasks, pjob_ix, kept_jobs, tensors,
                 _fast_unpipeline(ssn, t)
             for v in reversed(applied_v):
                 _fast_unevict(ssn, v)
+            rolled_back = True
             continue
         for t in applied_p:
             alloc_agg.setdefault(jx, Resource()).add(t.resreq)
@@ -967,6 +1031,12 @@ def _replay_preempt_fast(ssn, ptasks, pjob_ix, kept_jobs, tensors,
             dealloc_agg.setdefault(v.job, Resource()).add(v.resreq)
             cache_evicts.append(v)
 
+    # last-attempt gauge semantics, matching the per-attempt set of the
+    # slow replay and the callbacks engine (last write wins); no attempts
+    # -> gauge untouched, exactly as the per-attempt formulation behaves
+    if n_attempts:
+        metrics.update_preemption_victims(last_victims)
+        metrics.register_preemption_attempt(n_attempts)
     for jx, r in alloc_agg.items():
         ssn._fire_allocate(_AggTask(kept_jobs[jx].uid, r))
     for uid, r in dealloc_agg.items():

@@ -165,11 +165,11 @@ def update_preemption_victims(count: int) -> None:
         _preempt_victims.set(count)
 
 
-def register_preemption_attempt() -> None:
+def register_preemption_attempt(n: int = 1) -> None:
     with _lock:
-        _counters[("preemption_attempts",)] += 1
+        _counters[("preemption_attempts",)] += n
     if _HAVE_PROM:
-        _preempt_total.inc()
+        _preempt_total.inc(n)
 
 
 def update_unschedule_task_count(job_id: str, count: int) -> None:

@@ -150,28 +150,14 @@ def expand_before(nw: EvictNW) -> jnp.ndarray:
     return (same_g & earlier & nw.valid[:, :, None]).astype(jnp.float32)
 
 
-def _drf_dynamic(nw: EvictNW, before, jalloc, total, ls, rows=None):
-    """drf.go:308-330 — victim stays a candidate iff the preemptor's share
-    (with the task) stays <= the victim job's share after losing the victim
-    and every earlier same-(node, job) candidate. The within-dispatch
-    exclusive prefix is a broadcast-sum against the ``before`` precedence
-    tensor: prior[n,w,r] = sum_u before[n,u,w] * cand[n,u] * vreq[n,u,r]
-    — replacing the v2 kernels' sort/cumsum/unsort chain (take_along_axis
-    costs ~40us per op inside a device loop). ``rows``: optional i32[n]
-    node-row restriction."""
-    before = before if rows is None else before[rows]
-    vreq = nw.vreq if rows is None else nw.vreq[rows]
-    vgroup = nw.vgroup if rows is None else nw.vgroup[rows]
-
-    def fn(cand):
-        return _drf_keep(vreq, before, vgroup, jalloc, total, ls, cand)
-    return fn
-
-
 def _drf_keep(vreq, before, vgroup, jalloc, total, ls, cand):
-    """The drf verdict core over a leading node axis of any size —
-    SHARED by the full dispatch and the walk's carry-cached row path so
-    the keep-rule can never diverge between them."""
+    """The drf verdict core (drf.go:308-330) over a leading node axis of
+    any size — a victim stays a candidate iff the preemptor's share (with
+    the task) stays <= the victim job's share after losing the victim and
+    every earlier same-(node, job) candidate, the exclusive prefix being a
+    broadcast-sum against the ``before`` precedence tensor. SHARED by the
+    run-entry full dispatch and the fill loop's row path so the keep-rule
+    can never diverge between them."""
     masked = vreq * cand[..., None]
     # explicit broadcast-sum, NOT a matmul: einsum would go through
     # the MXU (bf16 by default — verdict flips vs the f64 comparator;
@@ -256,7 +242,7 @@ def _fill_schedule(vreq_row, fidle_b, elig_row, rs_row, dyn_dec_b, req,
     k = jnp.minimum(k, jnp.minimum(run_left_i, quota_left))
     k = jnp.clip(k, 0, K).astype(jnp.int32)
     evicted = elig_row & (t_w <= k)
-    return k, evicted, t_w
+    return k, evicted, t_w, k_exp
 
 
 @functools.lru_cache(maxsize=16)
@@ -269,25 +255,34 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
     tier_kinds[i] is "static" or "drf"; tier_sizes[i] is the number of
     static plugin masks in tier i (the drf tier may also carry static
     co-plugins). ``allow_cheap`` must be False when a dynamic tier is not
-    the last tier (the same-node-run shortcut's monotone-shrink argument
-    would not hold).
+    the last tier (the monotone-shrink argument below would not hold);
+    the fill loop then takes one dispatch-fresh placement at a time.
 
-    The walk is a ``lax.while_loop`` over a TASK CURSOR, not a per-task
-    scan: each iteration evaluates ONE dispatch (full or node-local cheap)
-    and places a whole same-request CHUNK via the closed-form fill
-    schedule, then jumps the cursor — past the chunk on success, past the
-    rest of the run on failure (a failed attempt mutates nothing, so every
-    identical task re-fails), past the rest of the job when its quota is
-    met. Iteration count is therefore the number of dispatch evaluations
-    the serial algorithm needs (~jobs x nodes-touched), not the task
-    count — at 5k preemptors in ~100 same-request runs that is ~100
-    device steps instead of 5k, which is what keeps the whole action
-    inside the reference's 1 s cycle budget on a remote-tunnel TPU.
+    The walk is a ``lax.while_loop`` over a TASK CURSOR whose iterations
+    are same-request RUNS, each run processed as ONE full [N, W] tier
+    dispatch followed by an inner fill loop of node-row-local steps:
 
-    Decisions are bit-identical to the per-task formulation: the fill
-    schedule (``_fill_schedule``) already encoded chunk semantics for the
-    scan's free-fill countdown; the walk merely stops paying for the
-    pass-through steps.
+    - the dispatch computes every node's eligible-victim set and a
+      ``fits0`` over-approximation at the run's entry state;
+    - each inner step picks the best still-alive scoring node, re-derives
+      its verdict row EXACTLY at the current state (shares, evictions),
+      places a chunk via the closed-form fill schedule, and applies the
+      effects as one fused pack-row + one fused jstate-row scatter;
+    - during a same-request run every per-node verdict set only SHRINKS
+      (the preemptor's dominant share grows monotonically, victim jobs
+      only lose allocation, static masks are frozen — the r4 same-node
+      shortcut's argument, now covering node switches too), so a node
+      whose stale ``fits0`` no longer holds yields k=0 at its row
+      re-evaluation, is marked dead, and the next-best node is probed —
+      exactly the node order the serial algorithm visits.
+
+    Device latency is therefore ~#runs full dispatches plus ~#node-fills
+    cheap W-sized steps — at 5k preemptors in ~100 runs over ~1.2k node
+    fills that is ~100 heavy + ~1.3k light steps instead of 1.3k heavy
+    ones, which is what keeps the whole action inside the reference's 1 s
+    cycle budget on a remote-tunnel TPU. Decisions are bit-identical to
+    the per-task formulation (tests pin eviction parity against the
+    callbacks engine; preempt.go:190-269 is the loop being replaced).
 
     ``score_g`` carries one score row per same-request RUN (``run_id``
     indexes it) — runs are maximal stretches with identical (job, request,
@@ -297,12 +292,26 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
     def walk_fn(future_idle0, nw: EvictNW, cand_mask, tier_masks,
                 preq, pjob, pjg, first_of_job, run_id, run_end, job_end,
                 score_g, needed, jalloc0, total):
+        # ``needed`` is f32[AJ+1] keyed by ALLOC-GROUP index (pjg), not by
+        # kept-job index: the pipeline quota count lives fused as the last
+        # column of the jstate matrix (see Carry.jstate), and one index
+        # space for both halves keeps the per-iteration update a single
+        # row scatter. Pad/victim-only groups carry 0.
         N, W, R = nw.vreq.shape
         P = preq.shape[0]
         fdtype = preq.dtype
         has_drf = any(k == "drf" for k in tier_kinds)
         iota_p = jnp.arange(P, dtype=jnp.int32)
         before = expand_before(nw) if has_drf else None
+        # per-task scalar tables fused into one [P, R+6] f32 matrix (all
+        # values integral < 2^24, exact in f32): the body reads ONE row per
+        # iteration instead of seven scalar gathers (~2-3us each of pure
+        # latency per gather inside the device loop)
+        tpack = jnp.concatenate([
+            preq.astype(fdtype),
+            jnp.stack([pjob, pjg, run_id, run_end, job_end,
+                       first_of_job.astype(jnp.int32)], axis=1
+                      ).astype(fdtype)], axis=1)
         # the CURRENT job's candidate/veto rows live in the carry as
         # [N, W] expansions, refreshed only at job boundaries (~PJ times):
         # an in-loop dynamic row gather from an HBM-resident [PJ, V+1]
@@ -313,44 +322,37 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
 
         class Carry(NamedTuple):
             i: jnp.ndarray           # i32[] task cursor
-            last_pj: jnp.ndarray     # i32[] job of last visited task
-            alive: jnp.ndarray       # bool[N, W]
-            fidle: jnp.ndarray       # f32[N, R]
-            jalloc: jnp.ndarray      # f32[AJ+1, R]
-            pipe_cnt: jnp.ndarray    # i32[PJ]
-            owner: jnp.ndarray       # i32[N, W]
+            iters: jnp.ndarray       # i32[] loop iterations (diagnostics)
+            last_g: jnp.ndarray      # i32[] alloc-group of last visited task
+            # the per-node mutable state — future_idle f32[N, R], alive
+            # bool-as-f32[N, W], eviction owner step f32[N, W] (exact:
+            # step indices < 2^24) — lives FUSED in one [N, R+2W] matrix:
+            # the walk mutates exactly one node row per iteration, and one
+            # fused row scatter costs a third of three (scatter latency
+            # ~12us each inside a device loop, measured on v5e)
+            pack: jnp.ndarray        # f32[N, R+2W]  fidle | alive | owner
+            # per-job tracked state, same fusion trick on the job axis:
+            # jalloc f32[AJ+1, R] | pipeline-quota count f32[AJ+1, 1]
+            # (counts are small integers, exact in f32; -BIG marks a
+            # gang-rolled-back job)
+            jstate: jnp.ndarray      # f32[AJ+1, R+1]
             task_node: jnp.ndarray   # i32[P]
-            prev_node: jnp.ndarray   # i32[]
-            prev_ok: jnp.ndarray     # bool[]
-            prev_rid: jnp.ndarray    # i32[] run of the last evaluation
             cur_cand: jnp.ndarray    # bool[N, W] current job's candidates
             cur_masks: tuple         # per tier ([Mt, N, W], [Mt])
-            # chosen-node ROW caches (refreshed on node switches in
-            # full_eval; mutated alongside the [N, *] arrays): the cheap
-            # path reads ONLY these, avoiding per-iteration dynamic row
-            # gathers from HBM tables. Stale values are harmless — every
-            # read is gated by can_cheap, which is False whenever the run
-            # or node changed.
-            b_vreq: jnp.ndarray      # f32[W, R]
-            b_fidle: jnp.ndarray     # f32[R]
-            b_alive: jnp.ndarray     # bool[W]
-            b_cand: jnp.ndarray      # bool[W]
-            b_before: object         # f32[W, W] (None without a drf tier)
-            b_vgroup: jnp.ndarray    # i32[W]
-            b_mrow: tuple            # per tier ([Mt, 1, W], [Mt]) mask rows
-            s_alive: jnp.ndarray
-            s_fidle: jnp.ndarray
-            s_jalloc: jnp.ndarray
-            s_owner: jnp.ndarray
+            s_pack: jnp.ndarray
+            s_jstate: jnp.ndarray
 
         def body(c: Carry) -> Carry:
+            c = c._replace(iters=c.iters + 1)
             i = c.i
-            req = preq[i]
-            pj = pjob[i]
-            pjg_i = pjg[i]
-            rid = run_id[i]
-            rend = run_end[i]
-            jend = job_end[i]
+            trow = tpack[i]
+            req = trow[:R]
+            pj = trow[R].astype(jnp.int32)
+            pjg_i = trow[R + 1].astype(jnp.int32)
+            rid = trow[R + 2].astype(jnp.int32)
+            rend = trow[R + 3].astype(jnp.int32)
+            jend = trow[R + 4].astype(jnp.int32)
+            first_i = trow[R + 5] > 0.5
 
             # job boundary: refresh the carry-cached per-job rows, and
             # (gang mode) close the previous job's statement — rollback on
@@ -359,19 +361,20 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
             # job or on the next job's first task.
             def job_boundary(c):
                 if gang_commit:
-                    prev = c.last_pj
+                    prev = c.last_g
                     failed = (prev >= 0) & \
-                        (c.pipe_cnt[prev] < needed[prev])
+                        (c.jstate[prev, R] < needed[prev])
+                    # rollback restores jalloc AND every other group's
+                    # count (only prev's changed since the snapshot);
+                    # prev's count then takes the -BIG failure sentinel
+                    js = jnp.where(failed, c.s_jstate, c.jstate)
+                    js = js.at[prev, R].set(
+                        jnp.where(failed, jnp.asarray(-BIG, fdtype),
+                                  js[prev, R]))
                     c = c._replace(
-                        alive=jnp.where(failed, c.s_alive, c.alive),
-                        fidle=jnp.where(failed, c.s_fidle, c.fidle),
-                        jalloc=jnp.where(failed, c.s_jalloc, c.jalloc),
-                        owner=jnp.where(failed, c.s_owner, c.owner),
-                        pipe_cnt=jnp.where(
-                            failed, c.pipe_cnt.at[prev].set(-BIG),
-                            c.pipe_cnt))
-                    c = c._replace(s_alive=c.alive, s_fidle=c.fidle,
-                                   s_jalloc=c.jalloc, s_owner=c.owner)
+                        pack=jnp.where(failed, c.s_pack, c.pack),
+                        jstate=js)
+                    c = c._replace(s_pack=c.pack, s_jstate=c.jstate)
                 return c._replace(
                     cur_cand=cand_mask[pj][nw.vslot] & nw.valid,
                     cur_masks=tuple(
@@ -379,207 +382,270 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                           else jnp.zeros((0, N, W), bool)),
                          part[:, pj])
                         for stk, part in tier_masks))
-            c = jax.lax.cond(first_of_job[i], job_boundary,
+            c = jax.lax.cond(first_i, job_boundary,
                              lambda c: c, c)
 
             def inactive_step(c):
                 # quota met: every remaining task of the job is inactive
                 # too — skip the whole job
-                return c._replace(i=jend + 1, last_pj=pj,
-                                  prev_ok=jnp.zeros((), bool))
+                return c._replace(i=jend + 1, last_g=pjg_i)
 
             def active_step(c):
-                ls = _share(c.jalloc[pjg_i] + req, total) if has_drf \
-                    else None
-                quota_left = needed[pj] - c.pipe_cnt[pj]
-                run_left_i = rend - i + 1
+                run_len = rend - i + 1
+                score_row = score_g[rid]             # f32[N], once per run
 
-                def dynamic_for(rows):
-                    if not has_drf:
-                        return lambda cand_x: (cand_x, None)
-                    return _drf_dynamic(nw, before, c.jalloc, total, ls,
-                                        rows=rows)
+                # ---- ONE full dispatch at the run's entry state --------
+                alive_full = c.pack[:, R:R + W] > 0.5
+                cand = alive_full & c.cur_cand
+                ls0 = _share(c.jstate[pjg_i, :R] + req, total) \
+                    if has_drf else None
+                if has_drf:
+                    # within-dispatch exclusive prefix at the run's entry
+                    # candidate set; for nodes the run never touches this
+                    # is INVARIANT (prior changes only through evictions
+                    # on the node itself), which is what makes the fill
+                    # loop's global refresh below exact
+                    masked0 = nw.vreq * cand[..., None].astype(fdtype)
+                    prior0 = jnp.sum(
+                        before[..., None] * masked0[..., :, None, :],
+                        axis=-3)                         # [N, W, R]
 
-                def dynamic_row_cached(cand_w):
-                    # row-restricted drf over the CARRY-CACHED node rows —
-                    # no HBM row gathers (the [N, W, (W)] tables live in
-                    # HBM; a dynamic row read costs ~25-35us of latency)
-                    if not has_drf:
-                        return cand_w, None
-                    return _drf_keep(c.b_vreq, c.b_before, c.b_vgroup,
-                                     c.jalloc, total, ls, cand_w)
+                    def dynamic_full(cand_x):
+                        ralloc = (c.jstate[:, :R][nw.vgroup]
+                                  - prior0 - nw.vreq)
+                        rs = _share(ralloc, total)
+                        return cand_x & ((ls0 < rs)
+                                         | (jnp.abs(ls0 - rs)
+                                            <= SHARE_DELTA)), rs
+                else:
+                    prior0 = None
 
-                # row-local re-evaluation on the previous node: exact tier
-                # dispatch restricted to one row, W-sized carry-cached
-                # ops, computed unconditionally (it is tiny next to the
-                # [N, W] dispatch) so the full dispatch is traced ONCE
-                def dyn_row(cand_x):           # [1, W] -> ([1, W], extra)
-                    keep, rs = dynamic_row_cached(cand_x[0])
-                    return keep[None], (None if rs is None else rs[None])
+                    def dynamic_full(cand_x):
+                        return cand_x, None
 
-                b0 = c.prev_node
-                cand_b = c.b_alive & c.b_cand
-                elig_b, dyn_dec_b, rs_b = _tier_eval(
-                    tier_kinds, c.b_mrow, cand_b[None], dyn_row)
-                elig_b = elig_b[0]
-                evictable_b = jnp.sum(
-                    c.b_vreq * elig_b[:, None].astype(fdtype), axis=0)
-                fits_b = jnp.all(req < c.b_fidle + evictable_b
-                                 + EPS) & jnp.any(elig_b)
-                can_cheap = (jnp.asarray(allow_cheap) & (rid == c.prev_rid)
-                             & c.prev_ok & fits_b)
+                elig0, dyn_dec0, _ = _tier_eval(
+                    tier_kinds, c.cur_masks, cand, dynamic_full)
+                if has_drf:
+                    # the drf tier's candidate set after its static
+                    # co-masks, BEFORE the share verdict — the refresh
+                    # re-intersects it with the current-share keep rule
+                    drf_pre0 = cand
+                    for kind, (m_nw, part) in zip(tier_kinds,
+                                                  c.cur_masks):
+                        if kind != "static" and m_nw.shape[0]:
+                            pm = m_nw | ~part[:, None, None]
+                            drf_pre0 = cand & jnp.all(pm, axis=0)
 
-                def full_eval():
-                    masks_g = c.cur_masks
-                    cand = c.alive & c.cur_cand
-                    elig, dyn_dec, rs = _tier_eval(
-                        tier_kinds, masks_g, cand, dynamic_for(None))
-                    elig_f = elig.astype(fdtype)
-                    evictable = jnp.sum(nw.vreq * elig_f[..., None], axis=1)
-                    has_victim = jnp.any(elig, axis=1)
+                # ---- inner fill loop: serial node fills over the run ---
+                # During a same-request run every per-node verdict set
+                # only SHRINKS (the r4 same-node shortcut's monotone
+                # argument: the preemptor's dominant share grows, victim
+                # jobs only lose allocation, static masks are frozen), and
+                # for nodes the run has NOT touched the entry prefix
+                # ``prior0`` and tier cascade stay exact — so each probe
+                # re-derives the CURRENT global fit picture from a handful
+                # of [N, W] ops instead of the full multi-tier dispatch,
+                # picks the best node, and evaluates its verdict row
+                # exactly. For TOUCHED nodes (evictions change their
+                # cascade and prefix) the formula under-approximates, so
+                # their fitness is tracked via ``t_fit`` instead: any
+                # successful fill leaves its node re-probeable (the
+                # closed-form schedule is conservative — its truncation
+                # never proves deadness), and only an exact k=0 probe
+                # retires a node for the rest of the run. One heavy
+                # dispatch per run + light probes per node fill, at
+                # decisions bit-identical to the serial algorithm.
+
+                class Fill(NamedTuple):
+                    pack: jnp.ndarray
+                    jstate: jnp.ndarray
+                    task_node: jnp.ndarray
+                    m: jnp.ndarray        # i32[] placed so far this visit
+                    probes: jnp.ndarray   # i32[] inner iterations
+                    touched: jnp.ndarray  # bool[N] filled/probed this run
+                    t_fit: jnp.ndarray    # bool[N] exact fit for touched
+                    cont: jnp.ndarray     # bool[]
+
+                def fill_cond(s: Fill):
+                    return s.cont
+
+                def fill_body(s: Fill) -> Fill:
+                    alive_cur = s.pack[:, R:R + W] > 0.5
+                    if has_drf:
+                        ls_cur = _share(s.jstate[pjg_i, :R] + req, total)
+                        ralloc = (s.jstate[:, :R][nw.vgroup]
+                                  - prior0 - nw.vreq)
+                        rs_all = _share(ralloc, total)
+                        keep = drf_pre0 & ((ls_cur < rs_all)
+                                           | (jnp.abs(ls_cur - rs_all)
+                                              <= SHARE_DELTA))
+                        elig_cur = jnp.where(dyn_dec0[:, None], keep,
+                                             elig0) & alive_cur
+                    else:
+                        elig_cur = elig0 & alive_cur
+                    evictable = jnp.sum(
+                        nw.vreq * elig_cur[..., None].astype(fdtype),
+                        axis=1)
                     fits = (jnp.all(
-                        req[None, :] < c.fidle + evictable + EPS,
-                        axis=-1) & has_victim)
-                    row = jnp.where(fits, score_g[rid], -jnp.inf)
+                        req[None, :] < s.pack[:, :R] + evictable + EPS,
+                        axis=-1) & jnp.any(elig_cur, axis=1))
+                    cand_n = jnp.where(s.touched, s.t_fit, fits)
+                    row = jnp.where(cand_n, score_row, -jnp.inf)
                     best = jnp.argmax(row).astype(jnp.int32)
                     found = row[best] > -jnp.inf
-                    # node switch: load the chosen node's rows (the only
-                    # HBM row gathers on this path, ~#full_evals times)
-                    return (best, found, elig[best],
-                            rs[best] if has_drf else rs,
-                            dyn_dec[best], nw.vreq[best], c.fidle[best],
-                            c.alive[best], c.cur_cand[best],
-                            before[best] if has_drf else rs,
-                            nw.vgroup[best],
-                            tuple((m_nw[:, best][:, None], part)
-                                  for m_nw, part in c.cur_masks))
+                    prow = s.pack[best]
+                    b_fidle = prow[:R]
+                    b_alive = prow[R:R + W] > 0.5
+                    b_owner = prow[R + W:]
+                    b_vreq = nw.vreq[best]
+                    b_vgroup = nw.vgroup[best]
+                    b_mrow = tuple((m_nw[:, best][:, None], part)
+                                   for m_nw, part in c.cur_masks)
+                    jrow = s.jstate[pjg_i]
+                    jalloc_p = jrow[:R]
+                    quota_left = (needed[pjg_i] - jrow[R]) \
+                        .astype(jnp.int32)
+                    ls = _share(jalloc_p + req, total) if has_drf else None
 
-                def cheap_eval():
-                    return (b0, jnp.ones((), bool), elig_b,
-                            rs_b[0] if has_drf else rs_b,
-                            dyn_dec_b[0], c.b_vreq, c.b_fidle,
-                            c.b_alive, c.b_cand,
-                            c.b_before if has_drf else rs_b,
-                            c.b_vgroup, c.b_mrow)
+                    def dyn_row(cand_x):       # [1, W] -> ([1, W], extra)
+                        if not has_drf:
+                            return cand_x, None
+                        keep, rs = _drf_keep(
+                            b_vreq, before[best], b_vgroup,
+                            s.jstate[:, :R], total, ls, cand_x[0])
+                        return keep[None], rs[None]
 
-                (best, found, elig_row, rs_row, dyn_dec_b0, b_vreq,
-                 b_fidle, b_alive, b_cand, b_before, b_vgroup,
-                 b_mrow) = jax.lax.cond(can_cheap, cheap_eval, full_eval)
-                k, evicted, t_w = _fill_schedule(
-                    b_vreq, b_fidle, elig_row, rs_row,
-                    dyn_dec_b0, req, c.jalloc[pjg_i], total,
-                    run_left_i, quota_left, has_drf)
-                if not allow_cheap:
-                    # multi-placement fills share the same exactness
-                    # precondition as the same-node shortcut (dynamic tier
-                    # last): a mid-stack dynamic tier could drain mid-fill
-                    # and hand another node to a lower tier
-                    k = jnp.minimum(k, 1)
-                ok = found
-                k = jnp.where(ok, jnp.maximum(k, 1), 0)
-                evicted = evicted & (t_w <= k) & ok
+                    cand_b = (b_alive & c.cur_cand[best])[None]
+                    elig_b, dyn_dec_b, rs_b = _tier_eval(
+                        tier_kinds, b_mrow, cand_b, dyn_row)
+                    elig_row = elig_b[0]
+                    rs_row = rs_b[0] if has_drf else rs_b
+                    k, evicted, t_w, _ = _fill_schedule(
+                        b_vreq, b_fidle, elig_row, rs_row,
+                        dyn_dec_b[0], req, jalloc_p, total,
+                        run_len - s.m, quota_left, has_drf)
+                    if not allow_cheap:
+                        # without the shrink guarantee (dynamic tier not
+                        # last) only the dispatch-fresh first probe is
+                        # exact, one placement at a time
+                        k = jnp.minimum(k, 1)
+                    k = jnp.where(found, k, 0)
+                    evicted = evicted & (t_w <= k) & found
 
-                new_alive_row = b_alive & ~evicted
-
-                def apply_evictions(carry):
-                    alive, owner, jalloc = carry
-                    AJ1 = jalloc.shape[0]
+                    # apply — all unconditional (empty evicted set is a
+                    # mathematical no-op); one fused pack-row scatter +
+                    # one fused jstate-row scatter
+                    new_alive_row = b_alive & ~evicted
+                    evicted_f = evicted[:, None].astype(fdtype)
+                    AJ1 = s.jstate.shape[0]
                     job_onehot = jax.nn.one_hot(b_vgroup, AJ1,
                                                 dtype=fdtype)
-                    jalloc = jalloc - job_onehot.T @ (
-                        b_vreq * evicted[:, None].astype(fdtype))
-                    alive = alive.at[best].set(new_alive_row)
-                    # victims belong to the chunk step of the attempt that
-                    # wanted them — the replay groups evictions per task
-                    owner = owner.at[best].set(
-                        jnp.where(evicted, i + t_w - 1, owner[best]))
-                    freed = jnp.sum(
-                        b_vreq * evicted[:, None].astype(fdtype),
-                        axis=0)
-                    return (alive, owner, jalloc), freed
+                    evict_delta = job_onehot.T @ (b_vreq * evicted_f)
+                    freed = jnp.sum(b_vreq * evicted_f, axis=0)
+                    # victims belong to the chunk step of the attempt
+                    # that wanted them (replay groups evictions per task)
+                    new_owner = jnp.where(
+                        evicted, (i + s.m + t_w - 1).astype(fdtype),
+                        b_owner)
+                    placed = k.astype(fdtype)
+                    delta = freed - req * placed
+                    new_row = jnp.concatenate([
+                        b_fidle + delta, new_alive_row.astype(fdtype),
+                        new_owner])
+                    jstate = (s.jstate
+                              - jnp.pad(evict_delta, ((0, 0), (0, 1)))
+                              ).at[pjg_i].add(
+                        jnp.concatenate([req * placed, placed[None]]))
+                    lo = i + s.m
+                    task_node = jnp.where(
+                        (iota_p >= lo) & (iota_p < lo + k),
+                        best, s.task_node)
+                    m = s.m + k
+                    # a successful fill leaves its node re-probeable: the
+                    # closed-form schedule is CONSERVATIVE (prefix-capacity
+                    # model — truncation "only costs speed, never
+                    # exactness"), so its end never proves the node dead;
+                    # the follow-up exact probe decides, and a k=0 probe
+                    # retires the node for the rest of the run
+                    touched = jnp.where(found, s.touched.at[best].set(True),
+                                        s.touched)
+                    t_fit = jnp.where(found, s.t_fit.at[best].set(k > 0),
+                                      s.t_fit)
+                    cont = (found & (m < run_len)
+                            & (m < quota_left + s.m))
+                    if not allow_cheap:
+                        cont = jnp.zeros((), bool)
+                    return Fill(pack=s.pack.at[best].set(new_row),
+                                jstate=jstate, task_node=task_node,
+                                m=m, probes=s.probes + 1,
+                                touched=touched, t_fit=t_fit,
+                                cont=cont)
 
-                (alive, owner, jalloc), freed = jax.lax.cond(
-                    jnp.any(evicted), apply_evictions,
-                    lambda carry: (carry, jnp.zeros(R, fdtype)),
-                    (c.alive, c.owner, c.jalloc))
-                placed = k.astype(fdtype)
-                delta = freed - req * placed
-                jalloc = jalloc.at[pjg_i].add(req * placed)
-                task_node = jnp.where((iota_p >= i) & (iota_p < i + k),
-                                      best, c.task_node)
+                s = jax.lax.while_loop(fill_cond, fill_body, Fill(
+                    pack=c.pack, jstate=c.jstate, task_node=c.task_node,
+                    m=jnp.zeros((), jnp.int32),
+                    probes=jnp.zeros((), jnp.int32),
+                    touched=jnp.zeros(N, bool),
+                    t_fit=jnp.zeros(N, bool),
+                    cont=jnp.ones((), bool)))
+
+                ok = s.m > 0
                 # fail: the rest of the run re-fails (skip to rend+1 in
                 # phase 1; phase 2 stops the whole job at first failure —
-                # jobs are cursor-contiguous, so the jump IS the stop)
+                # jobs are cursor-contiguous, so the jump IS the stop).
+                # A failed visit (m=0) wrote only identity rows, so the
+                # inner-loop state carries over unconditionally.
                 fail_to = rend + 1 if gang_commit else jend + 1
-                next_i = jnp.where(ok, i + k, fail_to)
+                next_i = jnp.where(ok, i + s.m, fail_to)
                 return c._replace(
-                    i=next_i, last_pj=pj,
-                    fidle=c.fidle.at[best].add(delta),
-                    alive=alive,
-                    jalloc=jalloc,
-                    owner=owner,
-                    task_node=task_node,
-                    pipe_cnt=c.pipe_cnt.at[pj].add(k),
-                    prev_node=best, prev_ok=ok, prev_rid=rid,
-                    # node-row caches track the (possibly new) chosen
-                    # node's post-apply state
-                    b_vreq=b_vreq, b_fidle=b_fidle + delta,
-                    b_alive=new_alive_row, b_cand=b_cand,
-                    b_before=b_before, b_vgroup=b_vgroup, b_mrow=b_mrow)
+                    i=next_i, last_g=pjg_i, iters=c.iters + s.probes,
+                    pack=s.pack, jstate=s.jstate, task_node=s.task_node)
 
-            active = c.pipe_cnt[pj] < needed[pj]
+            active = c.jstate[pjg_i, R] < needed[pjg_i]
             return jax.lax.cond(active, active_step, inactive_step, c)
 
-        PJ = needed.shape[0]
+        pack0 = jnp.concatenate([
+            future_idle0.astype(fdtype),
+            jnp.ones((N, W), fdtype),
+            jnp.full((N, W), -1.0, fdtype)], axis=1)
+        jstate0 = jnp.pad(jalloc0.astype(fdtype), ((0, 0), (0, 1)))
         c0 = Carry(
             i=jnp.zeros((), jnp.int32),
-            last_pj=jnp.full((), -1, jnp.int32),
-            alive=jnp.ones((N, W), bool), fidle=future_idle0,
-            jalloc=jalloc0, pipe_cnt=jnp.zeros(PJ, jnp.int32),
-            owner=jnp.full((N, W), -1, jnp.int32),
+            iters=jnp.zeros((), jnp.int32),
+            last_g=jnp.full((), -1, jnp.int32),
+            pack=pack0,
+            jstate=jstate0,
             task_node=jnp.full(P, NO_NODE, jnp.int32),
-            prev_node=jnp.zeros((), jnp.int32),
-            prev_ok=jnp.zeros((), bool),
-            prev_rid=jnp.full((), -1, jnp.int32),
             # overwritten at the first job boundary before any read
             cur_cand=jnp.zeros((N, W), bool),
             cur_masks=tuple(
                 (jnp.zeros(stk.shape[:1] + (N, W), bool),
                  jnp.zeros(part.shape[:1], bool))
                 for stk, part in tier_masks),
-            b_vreq=jnp.zeros((W, R), preq.dtype),
-            b_fidle=jnp.zeros(R, preq.dtype),
-            b_alive=jnp.zeros(W, bool),
-            b_cand=jnp.zeros(W, bool),
-            b_before=(jnp.zeros((W, W), jnp.float32) if has_drf else None),
-            b_vgroup=jnp.zeros(W, jnp.int32),
-            b_mrow=tuple(
-                (jnp.zeros(stk.shape[:1] + (1, W), bool),
-                 jnp.zeros(part.shape[:1], bool))
-                for stk, part in tier_masks),
-            s_alive=jnp.ones((N, W), bool), s_fidle=future_idle0,
-            s_jalloc=jalloc0, s_owner=jnp.full((N, W), -1, jnp.int32))
+            s_pack=pack0, s_jstate=jstate0)
 
         c = jax.lax.while_loop(lambda c: c.i < P, body, c0)
 
         if gang_commit:
-            last_pj = c.last_pj
-            failed = (last_pj >= 0) & (c.pipe_cnt[last_pj] < needed[last_pj])
+            last_g = c.last_g
+            failed = (last_g >= 0) & (c.jstate[last_g, R] < needed[last_g])
+            js = c.jstate.at[last_g, R].set(
+                jnp.where(failed, jnp.asarray(-BIG, fdtype),
+                          c.jstate[last_g, R]))
             c = c._replace(
-                alive=jnp.where(failed, c.s_alive, c.alive),
-                owner=jnp.where(failed, c.s_owner, c.owner),
-                pipe_cnt=jnp.where(failed,
-                                   c.pipe_cnt.at[last_pj].set(-BIG),
-                                   c.pipe_cnt))
+                pack=jnp.where(failed, c.s_pack, c.pack),
+                jstate=js)
 
-        job_done = c.pipe_cnt >= needed
+        # per-GROUP quota verdicts (the caller maps kept jobs via pjg)
+        job_done = c.jstate[:, R] >= needed
         task_node = c.task_node
         if gang_commit:
             # gang statements: only quota-met jobs keep their placements.
             # The intra-job phase commits every attempt (needed is a BIG
             # sentinel there, so this mask would wrongly discard everything).
-            task_node = jnp.where(job_done[pjob], task_node, NO_NODE)
-        return task_node, c.owner, job_done
+            task_node = jnp.where(job_done[pjg], task_node, NO_NODE)
+        owner = jnp.round(c.pack[:, R + W:]).astype(jnp.int32)
+        return task_node, owner, job_done, c.iters
 
     return jax.jit(walk_fn)
 
