@@ -11,6 +11,9 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from .resource import Resource
+from .device_info import (GPU_MEMORY_RESOURCE, GPU_NUMBER_RESOURCE,
+                          add_gpu_resource, gpu_memory_of_task,
+                          make_gpu_devices, sub_gpu_resource)
 from .job_info import TaskInfo
 from .types import TaskStatus
 
@@ -68,7 +71,6 @@ class NodeInfo:
         # NewNodeInfo -> setNodeGPUInfo (node_info.go:102,116), or set
         # explicitly via set_gpu_info().
         self.gpu_devices: Dict[int, object] = {}
-        from .device_info import GPU_MEMORY_RESOURCE, GPU_NUMBER_RESOURCE
         gpu_mem = self.capability.get(GPU_MEMORY_RESOURCE)
         gpu_num = self.capability.get(GPU_NUMBER_RESOURCE)
         if gpu_mem > 0 and gpu_num > 0:
@@ -80,12 +82,9 @@ class NodeInfo:
         """node_info.go setNodeGPUInfo:268-291. ``total_memory`` must be in
         the same (milli-scaled) units as task volcano.sh/gpu-memory
         requests."""
-        from .device_info import make_gpu_devices
         self.gpu_devices = make_gpu_devices(total_memory, card_count)
 
     def _account_gpu(self, task: TaskInfo, add: bool) -> None:
-        from .device_info import (add_gpu_resource, gpu_memory_of_task,
-                                  sub_gpu_resource)
         if not self.gpu_devices or gpu_memory_of_task(task) <= 0:
             return
         if add:
@@ -168,6 +167,12 @@ class NodeInfo:
         self.add_task(task)
 
     def clone(self) -> "NodeInfo":
+        """Snapshot copy with DIRECT aggregate transfer: replaying add_task
+        per task would re-derive idle/used/releasing/pipelined (and GPU card
+        state, in a possibly different order) with two Resource clones and
+        a sub/add per task — ~60% of the whole-cache snapshot cost at 10k
+        bound tasks. The aggregates are exact invariants of the task set,
+        so copying them IS the replay's end state."""
         n = NodeInfo(name=self.name, allocatable=self.allocatable,
                      capability=self.capability, labels=self.labels,
                      taints=self.taints, unschedulable=self.unschedulable,
@@ -175,10 +180,15 @@ class NodeInfo:
         n.ready = self.ready
         n.others = dict(self.others)
         n.numa_info = self.numa_info.deep_copy() if self.numa_info else None
-        for task in self.tasks.values():
-            n.add_task(task.clone())
-        # overwrite with exact card assignments (add_task may have re-derived
-        # them in a different order)
+        n.idle = self.idle.clone()
+        n.used = self.used.clone()
+        n.releasing = self.releasing.clone()
+        n.pipelined = self.pipelined.clone()
+        n.used_ports = dict(self.used_ports)
+        for uid, task in self.tasks.items():
+            ti = task.clone()
+            ti.node_name = self.name
+            n.tasks[uid] = ti
         n.gpu_devices = {i: d.clone() for i, d in self.gpu_devices.items()}
         n.numa_allocations = {uid: {res: set(ids) for res, ids in sets.items()}
                               for uid, sets in self.numa_allocations.items()}

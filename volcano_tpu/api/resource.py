@@ -82,7 +82,14 @@ class Resource:
         return r
 
     def clone(self) -> "Resource":
-        return Resource(self.cpu, self.memory, dict(self.scalars), self.max_task_num)
+        # bypasses __init__ (float() coercions): clone is the hottest
+        # Resource path — node aggregates on every snapshot
+        r = Resource.__new__(Resource)
+        r.cpu = self.cpu
+        r.memory = self.memory
+        r.scalars = dict(self.scalars)
+        r.max_task_num = self.max_task_num
+        return r
 
     # -- accessors ----------------------------------------------------------
 

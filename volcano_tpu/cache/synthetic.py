@@ -35,10 +35,12 @@ def make_jobs(num_tasks: int, num_jobs: int, queues: List[str],
               gang_fraction: float = 1.0, gpus_per_task: int = 0,
               running_fraction: float = 0.0, nodes: Optional[List[NodeInfo]] = None,
               seed: int = 0, phase: PodGroupPhase = PodGroupPhase.INQUEUE,
+              name_prefix: str = "",
               ) -> List[JobInfo]:
     """num_tasks split over num_jobs; each job is a gang
     (minAvailable = task count * gang_fraction). running_fraction of jobs
-    is pre-placed onto nodes (for preempt/reclaim configs)."""
+    is pre-placed onto nodes (for preempt/reclaim configs). ``name_prefix``
+    keeps arrival batches' uids distinct from a live cluster's (churn)."""
     rng = random.Random(seed)
     sizes = _split(num_tasks, num_jobs, rng)
     jobs: List[JobInfo] = []
@@ -47,7 +49,7 @@ def make_jobs(num_tasks: int, num_jobs: int, queues: List[str],
         queue = queues[j % len(queues)]
         running = rng.random() < running_fraction
         min_avail = max(1, int(size * gang_fraction))
-        name = f"job-{j:05d}"
+        name = f"{name_prefix}job-{j:05d}"
         pg = PodGroup(name=name, queue=queue, min_member=min_avail,
                       phase=PodGroupPhase.RUNNING if running else phase)
         job = JobInfo(uid=name, name=name, queue=queue,
@@ -114,6 +116,12 @@ def baseline_config(name: str, seed: int = 0):
     elif name == "10k":
         nodes = make_cluster(2000, seed=seed)
         jobs = make_jobs(10000, 200, ["q1", "q2", "q3"], seed=seed)
+        queues = [QueueInfo(name="q1", weight=3), QueueInfo(name="q2", weight=2),
+                  QueueInfo(name="q3", weight=1)]
+    elif name == "20k":
+        # the long-axis scale config (SURVEY §5.7: nodes 2k -> tens of k)
+        nodes = make_cluster(5000, seed=seed)
+        jobs = make_jobs(20000, 400, ["q1", "q2", "q3"], seed=seed)
         queues = [QueueInfo(name="q1", weight=3), QueueInfo(name="q2", weight=2),
                   QueueInfo(name="q3", weight=1)]
     elif name == "preempt":

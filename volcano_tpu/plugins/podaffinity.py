@@ -84,10 +84,21 @@ def _affinity_terms(task, kind: str, required: bool):
 
 
 def has_pod_affinity(task) -> bool:
-    return bool(_affinity_terms(task, "podAffinity", True)
-                or _affinity_terms(task, "podAntiAffinity", True)
-                or _affinity_terms(task, "podAffinity", False)
-                or _affinity_terms(task, "podAntiAffinity", False))
+    # TaskInfo memoizes this at build time (affinity is immutable after
+    # construction and clones carry the flag), turning the every-cycle
+    # whole-session scan into attribute reads; the fallback covers
+    # task-like objects built outside TaskInfo.__init__
+    cached = getattr(task, "_has_pod_affinity", None)
+    if cached is None:
+        cached = bool(_affinity_terms(task, "podAffinity", True)
+                      or _affinity_terms(task, "podAntiAffinity", True)
+                      or _affinity_terms(task, "podAffinity", False)
+                      or _affinity_terms(task, "podAntiAffinity", False))
+        try:
+            task._has_pod_affinity = cached
+        except AttributeError:
+            pass
+    return cached
 
 
 class PodAffinityIndex:
