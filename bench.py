@@ -392,6 +392,15 @@ def main():
                   preempt_tpu_ms=round(p_tpu_s * 1e3, 1),
                   preempt_pipelined=p_pipelined)
 
+    # the node-sharded preempt walk (VERDICT r5 #3) at full scale — a
+    # 1-chip mesh here; the driver's dryrun + tests/test_parallel.py pin
+    # the 8-device decision parity. Victim identity must match the
+    # single-device engine exactly.
+    run_preempt("preempt", "tpu-sharded")         # warm
+    ps_s, ps_evicts, _ = run_preempt("preempt", "tpu-sharded")
+    extras.update(preempt_sharded_ms=round(ps_s * 1e3, 1),
+                  preempt_sharded_parity=ps_evicts == p_full_evicts)
+
     # reclaim at the same mix (cross-queue, q1 vs q2) — the screened exact
     # rotation at every scale (r4: the r3 device kernel's queue-contiguous
     # approximation diverged at full scale and was replaced)

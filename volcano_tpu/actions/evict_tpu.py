@@ -746,9 +746,11 @@ def _pending_in_order(ssn, job) -> List[TaskInfo]:
     return _pending_tasks(ssn, job)
 
 
-def execute_preempt_tpu(ssn) -> None:
+def execute_preempt_tpu(ssn, sharded: bool = False) -> None:
     """Device preempt: phase 1 inter-job (gang statements), phase 2
-    intra-job, then the host victim_tasks pass."""
+    intra-job, then the host victim_tasks pass. ``sharded`` runs the walk
+    node-sharded over the full device mesh (ops/evict.py
+    build_preempt_walk_sharded) — decisions are bit-identical."""
     victims = _eviction_order(ssn, _collect_victims(ssn))
     # R for the budget gate is the UNION of resource names the kernel will
     # see (discover_resource_names over nodes + victims + preemptors), not
@@ -780,7 +782,8 @@ def execute_preempt_tpu(ssn) -> None:
              if vq_count.get(j.queue, 0)
              - vq_own.get((j.queue, j.uid), 0) > 0]
     if pjobs and victims:
-        _preempt_phase(ssn, pjobs, victims, inter_job=True)
+        _preempt_phase(ssn, pjobs, victims, inter_job=True,
+                       sharded=sharded)
     # phase 2: within-job preemption, one pass in underRequest order
     # (preempt.go:146-183) — only jobs that still have pending tasks AND
     # own running victims can act (victims re-collected only then: the
@@ -791,7 +794,8 @@ def execute_preempt_tpu(ssn) -> None:
     if pjobs2:
         victims2 = _eviction_order(ssn, _collect_victims(ssn))
         if victims2:
-            _preempt_phase(ssn, pjobs2, victims2, inter_job=False)
+            _preempt_phase(ssn, pjobs2, victims2, inter_job=False,
+                           sharded=sharded)
     _victim_tasks_host(ssn)
 
 
@@ -800,10 +804,11 @@ def execute_preempt_tpu(ssn) -> None:
 LAST_STATS: Dict[str, float] = {}
 
 
-def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
+def _preempt_phase(ssn, pjobs, victims, inter_job: bool,
+                   sharded: bool = False) -> None:
     import time
     import jax.numpy as jnp
-    from ..ops.evict import build_preempt_walk
+    from ..ops.evict import build_preempt_walk, build_preempt_walk_sharded
 
     ptasks: List[TaskInfo] = []
     pjob_ix: List[int] = []
@@ -855,13 +860,41 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
     # a non-chosen node's drf verdict can grow mid-run. Inter-job excludes
     # own-job victims, so only phase 1 keeps the shortcut with drf.
     allow_cheap = stack.allow_cheap and (inter_job or not stack.has_dynamic)
-    fn = build_preempt_walk(stack.kinds, stack.sizes, inter_job,
-                            allow_cheap)
     import jax
+    fidle0 = tensors.future_idle0()
+    score_arr = score_g
+    if sharded:
+        from ..ops.evict import EvictNW
+        from ..parallel.mesh import make_mesh
+        mesh = make_mesh(jax.devices())
+        D = int(mesh.devices.size)
+        N0 = tensors.vslot.shape[0]
+        n_pad = (-N0) % D
+        if n_pad:
+            # pad the node axis with victim-free rows: vslot points at the
+            # pad victim (valid False), so they can never be chosen
+            V = len(tensors.victims)
+            fidle0 = np.pad(fidle0, ((0, n_pad), (0, 0)))
+            nw = EvictNW(
+                vslot=np.pad(nw.vslot, ((0, n_pad), (0, 0)),
+                             constant_values=V),
+                valid=np.pad(nw.valid, ((0, n_pad), (0, 0))),
+                vreq=np.pad(nw.vreq, ((0, n_pad), (0, 0), (0, 0))),
+                vgroup=np.pad(nw.vgroup, ((0, n_pad), (0, 0)),
+                              constant_values=jalloc0.shape[0] - 1),
+                rank=np.pad(nw.rank, ((0, n_pad), (0, 0)),
+                            constant_values=BIG))
+            score_arr = np.pad(score_g, ((0, 0), (0, n_pad)),
+                               constant_values=-1e30)
+        fn = build_preempt_walk_sharded(mesh, stack.kinds, stack.sizes,
+                                        inter_job, allow_cheap)
+    else:
+        fn = build_preempt_walk(stack.kinds, stack.sizes, inter_job,
+                                allow_cheap)
     key = "p1" if inter_job else "p2"
     t0 = time.perf_counter()
     inputs = jax.device_put((
-        tensors.future_idle0(), nw, stack.padded_cand_mask(),
+        fidle0, nw, stack.padded_cand_mask(),
         stack.device_masks(), preq, pjob_arr, pjg, first_np,
         run_id, run_end, job_end,
         needed, jalloc0, total))                            # one upload
@@ -871,17 +904,18 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool) -> None:
     t0 = time.perf_counter()
     task_node, owner_nw, job_done, iters = fn(
         fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, pjg_d, first_d,
-        rid_d, rend_d, jend_d, score_g, needed_d, jalloc_d, total_d)
-    N, W = tensors.vslot.shape
+        rid_d, rend_d, jend_d, score_arr, needed_d, jalloc_d, total_d)
+    N, W = tensors.vslot.shape            # UNPADDED dims for the replay
+    Np = fidle0.shape[0]                  # includes any mesh padding
     P = len(ptasks)
     packed = np.asarray(jnp.concatenate([
         task_node, owner_nw.reshape(-1),
         job_done.astype(jnp.int32), iters[None]]))          # one fetch
     LAST_STATS[key + "_solve_s"] = time.perf_counter() - t0
     task_node = packed[:P]
-    owner_nw = packed[P:P + N * W].reshape(N, W)
+    owner_nw = packed[P:P + Np * W].reshape(Np, W)[:N]
     # per-group verdicts -> per kept job via its alloc-group index
-    job_done = packed[P + N * W:-1].astype(bool)[pjg_job]
+    job_done = packed[P + Np * W:-1].astype(bool)[pjg_job]
     LAST_STATS[key + "_iters"] = int(packed[-1])
 
     t0 = time.perf_counter()

@@ -49,6 +49,9 @@ class PreemptAction(Action):
         if engine == "tpu":
             from .evict_tpu import execute_preempt_tpu
             return execute_preempt_tpu(ssn)
+        if engine == "tpu-sharded":
+            from .evict_tpu import execute_preempt_tpu
+            return execute_preempt_tpu(ssn, sharded=True)
         return self._execute_callbacks(ssn)
 
     def _execute_callbacks(self, ssn) -> None:

@@ -156,3 +156,23 @@ def baseline_config(name: str, seed: int = 0):
     for j in jobs:
         cache.add_job(j)
     return cache, binder, evictor
+
+
+def preempt_mix_cache(n_nodes: int = 200, n_tasks: int = 1000,
+                      n_jobs: int = 40, seed: int = 0):
+    """The standard running+pending preempt scenario shared by the
+    multichip dryrun (__graft_entry__) and the 8-vs-1 parity tests
+    (tests/test_parallel.py) — ONE definition so they pin the same mix.
+    Returns (cache, binder, evictor)."""
+    binder, evictor = FakeBinder(), FakeEvictor()
+    cache = SchedulerCache(binder=binder, evictor=evictor)
+    nodes = make_cluster(n_nodes, seed=seed)
+    jobs = make_jobs(n_tasks, n_jobs, ["q1", "q2"], running_fraction=0.5,
+                     nodes=nodes, seed=seed)
+    for q in (QueueInfo(name="q1", weight=1), QueueInfo(name="q2", weight=1)):
+        cache.add_queue(q)
+    for n in nodes:
+        cache.add_node(n)
+    for j in jobs:
+        cache.add_job(j)
+    return cache, binder, evictor

@@ -58,7 +58,7 @@ produced by the same machinery as the callback engine.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -249,7 +249,8 @@ def _fill_schedule(vreq_row, fidle_b, elig_row, rs_row, dyn_dec_b, req,
 def build_preempt_walk(tier_kinds: Tuple[str, ...],
                        tier_sizes: Tuple[int, ...],
                        gang_commit: bool,
-                       allow_cheap: bool = True):
+                       allow_cheap: bool = True,
+                       axis: Optional[str] = None):
     """Compile a preempt walk for one tier structure.
 
     tier_kinds[i] is "static" or "drf"; tier_sizes[i] is the number of
@@ -287,7 +288,19 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
     ``score_g`` carries one score row per same-request RUN (``run_id``
     indexes it) — runs are maximal stretches with identical (job, request,
     feasibility row, static score row), so the dedup is exact and the
-    device never sees the [P, N] matrix."""
+    device never sees the [P, N] matrix.
+
+    With ``axis`` set the SAME walk runs node-sharded under ``shard_map``
+    (build_preempt_walk_sharded): every [N, ...] input/carry becomes the
+    device's local shard, the per-task tables and jstate are replicated,
+    and each probe adds exactly two collectives — an all_gather of the
+    per-shard (score, global-id) maxima to pick the eviction node (lowest
+    global index among ties, matching the unsharded argmax), and one psum
+    broadcasting the owner shard's node-row bundle so every shard computes
+    the identical fill schedule and jstate update (the owner alone writes
+    its pack row). Decisions are bit-identical to the single-device walk;
+    the gang pipeline-quota column rides the replicated jstate, so the
+    psum IS the quota synchronization."""
 
     def walk_fn(future_idle0, nw: EvictNW, cand_mask, tier_masks,
                 preq, pjob, pjg, first_of_job, run_id, run_end, job_end,
@@ -491,16 +504,70 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                         axis=-1) & jnp.any(elig_cur, axis=1))
                     cand_n = jnp.where(s.touched, s.t_fit, fits)
                     row = jnp.where(cand_n, score_row, -jnp.inf)
-                    best = jnp.argmax(row).astype(jnp.int32)
-                    found = row[best] > -jnp.inf
-                    prow = s.pack[best]
+                    lbest = jnp.argmax(row).astype(jnp.int32)
+                    if axis is None:
+                        best = lbest             # global == local
+                        li = lbest
+                        found = row[lbest] > -jnp.inf
+                        is_owner = jnp.ones((), bool)
+                    else:
+                        # global node pick: one all_gather of per-shard
+                        # (score, global-id) maxima; ties resolve to the
+                        # lowest global index, matching the unsharded
+                        # argmax (per-shard argmax already picks the
+                        # lowest local index)
+                        Nl = row.shape[0]
+                        off = (jax.lax.axis_index(axis) * Nl) \
+                            .astype(jnp.int32)
+                        all_sc = jax.lax.all_gather(row[lbest], axis)
+                        all_id = jax.lax.all_gather(off + lbest, axis)
+                        gmax = jnp.max(all_sc)
+                        found = gmax > -jnp.inf
+                        best = jnp.min(jnp.where(all_sc == gmax, all_id,
+                                                 BIG)).astype(jnp.int32)
+                        li = jnp.clip(best - off, 0, Nl - 1)
+                        is_owner = (best >= off) & (best < off + Nl)
+                    prow = s.pack[li]
+                    b_vreq = nw.vreq[li]
+                    b_vgroup = nw.vgroup[li]
+                    b_cand = c.cur_cand[li]
+                    mrows = [m_nw[:, li] for m_nw, _ in c.cur_masks]
+                    before_row = before[li] if has_drf else None
+                    if axis is not None:
+                        # broadcast the owner's node-row bundle in ONE
+                        # psum (non-owners contribute zeros); every shard
+                        # then computes the identical fill schedule and
+                        # replicated jstate update. All values are exact
+                        # in f32 (GCD-scaled ints, group ids < 2^24).
+                        ownf = is_owner.astype(fdtype)
+                        parts = [prow, b_vreq.ravel(),
+                                 b_vgroup.astype(fdtype),
+                                 b_cand.astype(fdtype)]
+                        parts += [m.astype(fdtype).ravel() for m in mrows]
+                        if has_drf:
+                            parts.append(before_row.ravel())
+                        sizes = [int(p.shape[0]) for p in parts]
+                        bundle = jax.lax.psum(
+                            jnp.concatenate(parts) * ownf, axis)
+                        pieces = []
+                        o = 0
+                        for sz in sizes:
+                            pieces.append(bundle[o:o + sz])
+                            o += sz
+                        prow = pieces[0]
+                        b_vreq = pieces[1].reshape(W, R)
+                        b_vgroup = jnp.round(pieces[2]).astype(jnp.int32)
+                        b_cand = pieces[3] > 0.5
+                        mrows = [pieces[4 + t].reshape(m.shape) > 0.5
+                                 for t, m in enumerate(mrows)]
+                        if has_drf:
+                            before_row = pieces[-1].reshape(W, W)
                     b_fidle = prow[:R]
                     b_alive = prow[R:R + W] > 0.5
                     b_owner = prow[R + W:]
-                    b_vreq = nw.vreq[best]
-                    b_vgroup = nw.vgroup[best]
-                    b_mrow = tuple((m_nw[:, best][:, None], part)
-                                   for m_nw, part in c.cur_masks)
+                    b_mrow = tuple(
+                        (mrows[t][:, None, :], part)
+                        for t, (_, part) in enumerate(c.cur_masks))
                     jrow = s.jstate[pjg_i]
                     jalloc_p = jrow[:R]
                     quota_left = (needed[pjg_i] - jrow[R]) \
@@ -511,11 +578,11 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                         if not has_drf:
                             return cand_x, None
                         keep, rs = _drf_keep(
-                            b_vreq, before[best], b_vgroup,
+                            b_vreq, before_row, b_vgroup,
                             s.jstate[:, :R], total, ls, cand_x[0])
                         return keep[None], rs[None]
 
-                    cand_b = (b_alive & c.cur_cand[best])[None]
+                    cand_b = (b_alive & b_cand)[None]
                     elig_b, dyn_dec_b, rs_b = _tier_eval(
                         tier_kinds, b_mrow, cand_b, dyn_row)
                     elig_row = elig_b[0]
@@ -566,16 +633,19 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                     # model — truncation "only costs speed, never
                     # exactness"), so its end never proves the node dead;
                     # the follow-up exact probe decides, and a k=0 probe
-                    # retires the node for the rest of the run
-                    touched = jnp.where(found, s.touched.at[best].set(True),
-                                        s.touched)
-                    t_fit = jnp.where(found, s.t_fit.at[best].set(k > 0),
-                                      s.t_fit)
+                    # retires the node for the rest of the run. Only the
+                    # OWNER shard's local row takes the writes.
+                    wrote = found & is_owner
+                    touched = s.touched.at[li].set(s.touched[li] | wrote)
+                    t_fit = s.t_fit.at[li].set(
+                        jnp.where(wrote, k > 0, s.t_fit[li]))
+                    pack = s.pack.at[li].set(
+                        jnp.where(wrote, new_row, s.pack[li]))
                     cont = (found & (m < run_len)
                             & (m < quota_left + s.m))
                     if not allow_cheap:
                         cont = jnp.zeros((), bool)
-                    return Fill(pack=s.pack.at[best].set(new_row),
+                    return Fill(pack=pack,
                                 jstate=jstate, task_node=task_node,
                                 m=m, probes=s.probes + 1,
                                 touched=touched, t_fit=t_fit,
@@ -647,6 +717,53 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
         owner = jnp.round(c.pack[:, R + W:]).astype(jnp.int32)
         return task_node, owner, job_done, c.iters
 
-    return jax.jit(walk_fn)
+    # with an axis the caller (build_preempt_walk_sharded) wraps walk_fn
+    # in shard_map + jit; collectives inside require the mesh context
+    return walk_fn if axis is not None else jax.jit(walk_fn)
+
+
+_SHARDED_WALK_CACHE: dict = {}
+
+
+def build_preempt_walk_sharded(mesh, tier_kinds: Tuple[str, ...],
+                               tier_sizes: Tuple[int, ...],
+                               gang_commit: bool,
+                               allow_cheap: bool = True):
+    """The preempt walk node-sharded over ``mesh`` (jax.sharding.Mesh with
+    one axis): pack/EvictNW/candidate masks/score rows are sharded on the
+    node axis, per-task tables and the jstate quota matrix are replicated,
+    and the walk's two per-probe collectives (see build_preempt_walk)
+    resolve the global node pick and broadcast the owner's row bundle.
+    The caller pads the node axis to a multiple of the mesh size with
+    victim-free rows (they can never be chosen). Decisions are
+    bit-identical to the single-device walk — tests pin 8-vs-1 parity."""
+    from jax.sharding import PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    key = (tuple(d.id for d in mesh.devices.flat), tier_kinds, tier_sizes,
+           gang_commit, allow_cheap)
+    if key in _SHARDED_WALK_CACHE:
+        return _SHARDED_WALK_CACHE[key]
+    if len(_SHARDED_WALK_CACHE) >= 16:
+        # bound like build_preempt_walk's lru_cache(16): a long-lived
+        # scheduler with churning tier structures must not pin compiled
+        # shard_map executables forever
+        _SHARDED_WALK_CACHE.clear()
+
+    fn = build_preempt_walk(tier_kinds, tier_sizes, gang_commit,
+                            allow_cheap, axis=axis)
+    node = P(axis)
+    repl = P()
+    nw_spec = EvictNW(vslot=node, valid=node, vreq=node, vgroup=node,
+                      rank=node)
+    masks_spec = tuple((repl, repl) for _ in tier_sizes)
+    in_specs = (node, nw_spec, repl, masks_spec,
+                repl, repl, repl, repl, repl, repl, repl,
+                P(None, axis), repl, repl, repl)
+    out_specs = (repl, node, repl, repl)
+    wrapped = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                    out_specs=out_specs, check_vma=False))
+    _SHARDED_WALK_CACHE[key] = wrapped
+    return wrapped
 
 
