@@ -2,6 +2,7 @@
 driving the full submission flow of SURVEY.md §3.2 — the e2e analogue of
 the reference's kind-cluster suites (test/e2e/jobp, jobseq, vcctl)."""
 
+import copy
 import pytest
 
 from volcano_tpu.api import (BusEvent, BusAction, JobPhase, PodGroupPhase,
@@ -955,3 +956,95 @@ class TestJobErrorHandlingMatrix:
             sys.schedule_once()
         job = sys.store.get("Job", "default", "unsched")
         assert job.status.retry_count > before, job.status.state
+
+
+class TestElasticScale:
+    """Elastic scale-up/down e2e (job_scale_up_down.go,
+    job_controller_actions.go:179-195): sync_job's desired-vs-existing pod
+    diff IS the elastic mechanism — growing replicas creates exactly the
+    missing pods, shrinking deletes exactly the excess, and the PodGroup's
+    minMember/minResources follow the spec through createOrUpdatePodGroup."""
+
+    def test_scale_up_then_down(self):
+        sys = make_system()
+        submit_mpi_job(sys, name="elastic", replicas=2)
+        sys.schedule_once()
+        sys.schedule_once()
+        pods = sys.store.list("Pod")
+        assert len(pods) == 2
+        assert all(p.status.phase == "Running" for p in pods)
+
+        # ---- scale UP 2 -> 5: only the three new pods are created (the
+        # two running ones are untouched), the PodGroup quota follows
+        before = {p.metadata.name for p in sys.store.list("Pod")}
+        # real clients send a NEW object; mutating the store's live
+        # reference would alias old==new and suppress the update event
+        job = copy.deepcopy(sys.store.get("Job", "default", "elastic"))
+        job.spec.tasks[0].replicas = 5
+        job.spec.min_available = 5       # webhook default Σreplicas
+        sys.store.update(job)
+        sys.schedule_once()
+        sys.schedule_once()
+        pods = sys.store.list("Pod")
+        assert len(pods) == 5
+        assert before <= {p.metadata.name for p in pods}  # no churn of old
+        assert all(p.status.phase == "Running" for p in pods)
+        pg = sys.store.get("PodGroup", "default", "elastic")
+        assert pg.spec.min_member == 5
+        assert pg.spec.min_resources.cpu == 5000
+        job = sys.store.get("Job", "default", "elastic")
+        assert job.status.running == 5
+        assert job.status.state == JobPhase.RUNNING
+
+        # ---- scale DOWN 5 -> 2: exactly the excess indices are deleted,
+        # MinAvailable tracks the shrink (gang stays satisfied — the job
+        # must NOT dip through Restarting/Unknown), quota shrinks
+        job = copy.deepcopy(sys.store.get("Job", "default", "elastic"))
+        job.spec.tasks[0].replicas = 2
+        job.spec.min_available = 2
+        sys.store.update(job)
+        sys.schedule_once()
+        sys.schedule_once()
+        pods = sys.store.list("Pod")
+        assert len(pods) == 2
+        kept = {p.metadata.name for p in pods}
+        assert kept == {"elastic-worker-0", "elastic-worker-1"}
+        assert all(p.status.phase == "Running" for p in pods)
+        pg = sys.store.get("PodGroup", "default", "elastic")
+        assert pg.spec.min_member == 2
+        assert pg.spec.min_resources.cpu == 2000
+        job = sys.store.get("Job", "default", "elastic")
+        assert job.status.running == 2
+        assert job.status.state == JobPhase.RUNNING
+
+    def test_template_change_syncs_min_resources(self):
+        """A spec change that moves minResources but NOT minMember (a
+        template resource bump at constant minAvailable) must still reach
+        the PodGroup: createOrUpdatePodGroup compares minResources too
+        (job_controller_actions.go:584-589) — the scheduler's enqueue
+        quota math reads minResources, not the replica count. minResources
+        itself covers only the first minAvailable tasks
+        (calcPGMinResources, job_controller_actions.go:638-660), so a
+        replica-only change at constant minAvailable correctly leaves it."""
+        sys = make_system()
+        submit_mpi_job(sys, name="fixedmin", replicas=2, min_available=2)
+        sys.schedule_once()
+        sys.schedule_once()
+        # replica-only growth: minMember AND minResources stay
+        job = copy.deepcopy(sys.store.get("Job", "default", "fixedmin"))
+        job.spec.tasks[0].replicas = 4       # minAvailable stays 2
+        sys.store.update(job)
+        sys.schedule_once()
+        sys.schedule_once()
+        pg = sys.store.get("PodGroup", "default", "fixedmin")
+        assert pg.spec.min_member == 2
+        assert pg.spec.min_resources.cpu == 2000
+        assert len(sys.store.list("Pod")) == 4
+        # template bump: minResources follows while minMember stays
+        job = copy.deepcopy(sys.store.get("Job", "default", "fixedmin"))
+        job.spec.tasks[0].template.resources = Resource(1500, 1 << 30)
+        sys.store.update(job)
+        sys.schedule_once()
+        pg = sys.store.get("PodGroup", "default", "fixedmin")
+        assert pg.spec.min_member == 2
+        assert pg.spec.min_resources.cpu == 3000

@@ -33,14 +33,18 @@ def pod_name(job: Job, task: TaskSpec, index: int) -> str:
 
 def calc_pg_min_resources(job: Job) -> Resource:
     """Sum of the first minAvailable pod requests, tasks in priority order
-    (job_controller_actions.go:638-660)."""
-    reqs: List[Resource] = []
-    for task in sorted(job.spec.tasks, key=lambda t: -t.template.priority):
-        for _ in range(task.replicas):
-            reqs.append(task.template.resources or Resource())
+    (job_controller_actions.go:638-660). Runs on every job sync, so it
+    stops at minAvailable instead of materializing all replicas."""
     total = Resource()
-    for r in reqs[: job.spec.min_available]:
-        total.add(r)
+    left = job.spec.min_available
+    for task in sorted(job.spec.tasks, key=lambda t: -t.template.priority):
+        if left <= 0:
+            break
+        take = min(left, task.replicas)
+        r = task.template.resources or Resource()
+        for _ in range(take):
+            total.add(r)
+        left -= take
     return total
 
 
@@ -365,6 +369,7 @@ class JobController(Controller):
         plugin_on_job_add(self.store, job)
         pg = self.store.get("PodGroup", job.metadata.namespace,
                             job.metadata.name)
+        min_res = calc_pg_min_resources(job)       # runs on EVERY sync
         if pg is None:
             pg = PodGroupCR(
                 metadata=ObjectMeta(name=job.metadata.name,
@@ -376,14 +381,18 @@ class JobController(Controller):
                     min_member=job.spec.min_available,
                     queue=job.spec.queue,
                     priority_class_name=job.spec.priority_class_name,
-                    min_resources=calc_pg_min_resources(job)))
+                    min_resources=min_res))
             self.store.create(pg)
         elif (pg.spec.min_member != job.spec.min_available
-              or pg.spec.priority_class_name != job.spec.priority_class_name):
+              or pg.spec.priority_class_name != job.spec.priority_class_name
+              or pg.spec.min_resources != min_res):
             # job_controller_actions.go:530-636 createOrUpdatePodGroup syncs
-            # minMember, minResources AND priorityClassName on job updates
+            # minMember, minResources AND priorityClassName on job updates —
+            # minResources must be compared too, or an elastic template
+            # change at constant minAvailable never reaches the scheduler's
+            # enqueue quota math
             pg.spec.min_member = job.spec.min_available
-            pg.spec.min_resources = calc_pg_min_resources(job)
+            pg.spec.min_resources = min_res
             pg.spec.priority_class_name = job.spec.priority_class_name
             self.store.update(pg)
         return io_ok
