@@ -617,6 +617,15 @@ func main() {
 		"certificates/tls.crt", "webhook TLS certificate")
 	tlsKey := flag.String("tls-private-key-file", "/admission.local.config/"+
 		"certificates/tls.key", "webhook TLS private key")
+	caCert := flag.String("ca-cert-file", "/admission.local.config/"+
+		"certificates/ca.crt", "CA bundle injected into the webhook "+
+		"registrations (webhook self-registration)")
+	webhookService := flag.String("webhook-service-name", "",
+		"Service the webhook registrations point at; setting it enables "+
+			"webhook SELF-registration at startup (empty: apply the "+
+			"static webhook.yaml instead)")
+	webhookNS := flag.String("webhook-service-namespace",
+		"volcano-tpu-system", "namespace of --webhook-service-name")
 	flag.Parse()
 
 	cfg, err := clientcmd.BuildConfigFromFlags(*master, *kubeconfig)
@@ -648,6 +657,26 @@ func main() {
 	if *webhookAddr != "" {
 		startWebhook(*webhookAddr, *tlsCert, *tlsKey, *sidecar,
 			queueInformer, pgInformer)
+		if *webhookService != "" {
+			// the reference webhook-manager registers its configurations
+			// at startup with the CA bundle (server.go:41-108). The cert
+			// secret may appear AFTER the pod starts (the chart's
+			// admission-init Job races the Deployment), so retry until
+			// the CA file reads — the same treatment as the TLS serve
+			// loop; per-path upsert failures log inside and do not block.
+			go func() {
+				for {
+					err := registerWebhookConfigs(ctx, kube,
+						*webhookService, *webhookNS, *caCert)
+					if err == nil {
+						return
+					}
+					log.Printf("vc-shim: webhook self-registration: %v "+
+						"(retrying in 10s)", err)
+					time.Sleep(10 * time.Second)
+				}
+			}()
+		}
 	}
 
 	conn, err := net.Dial("tcp", *sidecar)

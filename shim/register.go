@@ -1,0 +1,201 @@
+// Programmatic webhook self-registration: on startup the shim creates or
+// updates the (Validating|Mutating)WebhookConfigurations for every served
+// admission path, injecting the CA bundle read from disk — the reference's
+// webhook-manager startup dance (cmd/webhook-manager/app/server.go:41-108,
+// util.go registerWebhookConfig), replacing the statically applied
+// deploy/kubernetes/webhook.yaml + gen-admission-secret.sh substitution.
+// The static YAML remains applyable for clusters that prefer declarative
+// registration; the in-process path wins on conflicts (update semantics).
+package main
+
+import (
+	"context"
+	"fmt"
+	"log"
+	"os"
+
+	admregv1 "k8s.io/api/admissionregistration/v1"
+	apierrors "k8s.io/apimachinery/pkg/api/errors"
+	metav1 "k8s.io/apimachinery/pkg/apis/meta/v1"
+	"k8s.io/client-go/kubernetes"
+)
+
+// webhookRule describes one served path's registration (the analogue of a
+// router.AdmissionService entry).
+type webhookRule struct {
+	path      string
+	hookName  string
+	mutating  bool
+	failOpen  bool // Ignore policy (the bare-pod gate must not block)
+	exemptNS  bool // skip system + own namespaces
+	apiGroups []string
+	versions  []string
+	ops       []admregv1.OperationType
+	resources []string
+}
+
+var webhookRules = []webhookRule{
+	{path: "/jobs/validate", hookName: "validatejob.volcano.sh",
+		apiGroups: []string{"batch.volcano.sh"}, versions: []string{"v1alpha1"},
+		ops:       []admregv1.OperationType{admregv1.Create, admregv1.Update},
+		resources: []string{"jobs"}},
+	{path: "/jobs/mutate", hookName: "mutatejob.volcano.sh", mutating: true,
+		apiGroups: []string{"batch.volcano.sh"}, versions: []string{"v1alpha1"},
+		ops:       []admregv1.OperationType{admregv1.Create},
+		resources: []string{"jobs"}},
+	{path: "/queues/validate", hookName: "validatequeue.volcano.sh",
+		apiGroups: []string{"scheduling.volcano.sh"}, versions: []string{"v1beta1"},
+		ops: []admregv1.OperationType{admregv1.Create, admregv1.Update,
+			admregv1.Delete},
+		resources: []string{"queues"}},
+	{path: "/queues/mutate", hookName: "mutatequeue.volcano.sh", mutating: true,
+		apiGroups: []string{"scheduling.volcano.sh"}, versions: []string{"v1beta1"},
+		ops:       []admregv1.OperationType{admregv1.Create},
+		resources: []string{"queues"}},
+	{path: "/podgroups/mutate", hookName: "mutatepodgroup.volcano.sh",
+		mutating:  true,
+		apiGroups: []string{"scheduling.volcano.sh"}, versions: []string{"v1beta1"},
+		ops:       []admregv1.OperationType{admregv1.Create},
+		resources: []string{"podgroups"}},
+	{path: "/pods", hookName: "validatepod.volcano.sh",
+		failOpen: true, exemptNS: true,
+		apiGroups: []string{""}, versions: []string{"v1"},
+		ops:       []admregv1.OperationType{admregv1.Create},
+		resources: []string{"pods"}},
+}
+
+// configName mirrors the reference's webhookConfigName(serviceName, path):
+// one configuration object per path.
+func configName(service, path string) string {
+	name := path
+	for i := 0; i < len(name); i++ {
+		if name[i] == '/' {
+			name = name[:i] + "-" + name[i+1:]
+		}
+	}
+	for len(name) > 0 && name[0] == '-' {
+		name = name[1:]
+	}
+	return service + "-" + name
+}
+
+// registerWebhookConfigs creates or updates one WebhookConfiguration per
+// served path, pointing the API server at serviceNS/serviceName with the
+// CA bundle from caCertFile. Registration failures are logged, not fatal —
+// the statically applied YAML may already cover the paths (matching the
+// reference's klog.Errorf-and-continue in registerWebhookConfig).
+func registerWebhookConfigs(ctx context.Context, kube kubernetes.Interface,
+	serviceName, serviceNS, caCertFile string) error {
+	caBundle, err := os.ReadFile(caCertFile)
+	if err != nil {
+		return fmt.Errorf("read ca bundle %s: %w", caCertFile, err)
+	}
+	sideEffects := admregv1.SideEffectClassNone
+	for _, r := range webhookRules {
+		path := r.path
+		clientCfg := admregv1.WebhookClientConfig{
+			CABundle: caBundle,
+			Service: &admregv1.ServiceReference{
+				Name:      serviceName,
+				Namespace: serviceNS,
+				Path:      &path,
+			},
+		}
+		policy := admregv1.Fail
+		if r.failOpen {
+			policy = admregv1.Ignore
+		}
+		var nsSelector *metav1.LabelSelector
+		if r.exemptNS {
+			nsSelector = &metav1.LabelSelector{
+				MatchExpressions: []metav1.LabelSelectorRequirement{{
+					Key:      "kubernetes.io/metadata.name",
+					Operator: metav1.LabelSelectorOpNotIn,
+					Values: []string{"kube-system", "kube-public",
+						"kube-node-lease", serviceNS},
+				}},
+			}
+		}
+		rules := []admregv1.RuleWithOperations{{
+			Operations: r.ops,
+			Rule: admregv1.Rule{
+				APIGroups:   r.apiGroups,
+				APIVersions: r.versions,
+				Resources:   r.resources,
+			},
+		}}
+		name := configName(serviceName, r.path)
+		if r.mutating {
+			cfg := &admregv1.MutatingWebhookConfiguration{
+				ObjectMeta: metav1.ObjectMeta{Name: name},
+				Webhooks: []admregv1.MutatingWebhook{{
+					Name:                    r.hookName,
+					AdmissionReviewVersions: []string{"v1"},
+					SideEffects:             &sideEffects,
+					FailurePolicy:           &policy,
+					NamespaceSelector:       nsSelector,
+					ClientConfig:            clientCfg,
+					Rules:                   rules,
+				}},
+			}
+			if err := upsertMutating(ctx, kube, cfg); err != nil {
+				log.Printf("vc-shim: register mutating webhook %s: %v",
+					r.path, err)
+			} else {
+				log.Printf("vc-shim: registered mutating webhook %s", r.path)
+			}
+		} else {
+			cfg := &admregv1.ValidatingWebhookConfiguration{
+				ObjectMeta: metav1.ObjectMeta{Name: name},
+				Webhooks: []admregv1.ValidatingWebhook{{
+					Name:                    r.hookName,
+					AdmissionReviewVersions: []string{"v1"},
+					SideEffects:             &sideEffects,
+					FailurePolicy:           &policy,
+					NamespaceSelector:       nsSelector,
+					ClientConfig:            clientCfg,
+					Rules:                   rules,
+				}},
+			}
+			if err := upsertValidating(ctx, kube, cfg); err != nil {
+				log.Printf("vc-shim: register validating webhook %s: %v",
+					r.path, err)
+			} else {
+				log.Printf("vc-shim: registered validating webhook %s", r.path)
+			}
+		}
+	}
+	return nil
+}
+
+func upsertMutating(ctx context.Context, kube kubernetes.Interface,
+	cfg *admregv1.MutatingWebhookConfiguration) error {
+	client := kube.AdmissionregistrationV1().MutatingWebhookConfigurations()
+	_, err := client.Create(ctx, cfg, metav1.CreateOptions{})
+	if !apierrors.IsAlreadyExists(err) {
+		return err
+	}
+	existing, err := client.Get(ctx, cfg.Name, metav1.GetOptions{})
+	if err != nil {
+		return err
+	}
+	cfg.ResourceVersion = existing.ResourceVersion
+	_, err = client.Update(ctx, cfg, metav1.UpdateOptions{})
+	return err
+}
+
+func upsertValidating(ctx context.Context, kube kubernetes.Interface,
+	cfg *admregv1.ValidatingWebhookConfiguration) error {
+	client := kube.AdmissionregistrationV1().ValidatingWebhookConfigurations()
+	_, err := client.Create(ctx, cfg, metav1.CreateOptions{})
+	if !apierrors.IsAlreadyExists(err) {
+		return err
+	}
+	existing, err := client.Get(ctx, cfg.Name, metav1.GetOptions{})
+	if err != nil {
+		return err
+	}
+	cfg.ResourceVersion = existing.ResourceVersion
+	_, err = client.Update(ctx, cfg, metav1.UpdateOptions{})
+	return err
+}
