@@ -242,7 +242,7 @@ def _fill_schedule(vreq_row, fidle_b, elig_row, rs_row, dyn_dec_b, req,
     k = jnp.minimum(k, jnp.minimum(run_left_i, quota_left))
     k = jnp.clip(k, 0, K).astype(jnp.int32)
     evicted = elig_row & (t_w <= k)
-    return k, evicted, t_w, k_exp
+    return k, evicted, t_w
 
 
 @functools.lru_cache(maxsize=16)
@@ -587,7 +587,7 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                         tier_kinds, b_mrow, cand_b, dyn_row)
                     elig_row = elig_b[0]
                     rs_row = rs_b[0] if has_drf else rs_b
-                    k, evicted, t_w, _ = _fill_schedule(
+                    k, evicted, t_w = _fill_schedule(
                         b_vreq, b_fidle, elig_row, rs_row,
                         dyn_dec_b[0], req, jalloc_p, total,
                         run_len - s.m, quota_left, has_drf)
@@ -628,17 +628,26 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                         (iota_p >= lo) & (iota_p < lo + k),
                         best, s.task_node)
                     m = s.m + k
-                    # a successful fill leaves its node re-probeable: the
-                    # closed-form schedule is CONSERVATIVE (prefix-capacity
-                    # model — truncation "only costs speed, never
-                    # exactness"), so its end never proves the node dead;
-                    # the follow-up exact probe decides, and a k=0 probe
-                    # retires the node for the rest of the run. Only the
-                    # OWNER shard's local row takes the writes.
+                    # a successful fill leaves its node re-probeable UNLESS
+                    # provably capacity-dead: attempt k+1 must fail even
+                    # with EVERY still-alive candidate evicted — candidates
+                    # (alive & job mask) only shrink during a run, and any
+                    # future tier verdict (including a cascade flip after
+                    # a mask drains) is a subset of them, so this bound
+                    # survives everything the conservative expiry/hv
+                    # cutoffs do not. Non-dead truncations defer to a
+                    # follow-up exact probe; a k=0 probe retires the node.
+                    # Only the OWNER shard's local row takes the writes.
+                    cand_post = cand_b[0] & ~evicted
+                    cum_cand_post = jnp.sum(
+                        b_vreq * cand_post[:, None].astype(fdtype), axis=0)
+                    cap_dead = ~jnp.all(
+                        req < new_row[:R] + cum_cand_post + EPS)
                     wrote = found & is_owner
                     touched = s.touched.at[li].set(s.touched[li] | wrote)
                     t_fit = s.t_fit.at[li].set(
-                        jnp.where(wrote, k > 0, s.t_fit[li]))
+                        jnp.where(wrote, (k > 0) & ~cap_dead,
+                                  s.t_fit[li]))
                     pack = s.pack.at[li].set(
                         jnp.where(wrote, new_row, s.pack[li]))
                     cont = (found & (m < run_len)
