@@ -835,10 +835,17 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
             ms = np.pad(np.where(f, s, MNEG).astype(np.float32),
                         ((0, 0), (0, n_pad)), constant_values=MNEG)
             ms = jnp.asarray(ms)
+        # contention grows with the task count: at the 20k/5k long-axis
+        # config the default sweeps=3/passes=3 budget leaves ~1.5% of a
+        # full packing on the table (19700/20000); raising BOTH to
+        # sweeps=5/passes=4 recovers the full packing (measured together —
+        # the split between the two knobs was not isolated)
+        big = T > 12000
         assign, pipelined, ready, kept, _ = place_blocks_sharded(
             mesh, state, jnp.asarray(req), jnp.ones(T, bool),
             jnp.asarray(job_ix_np), jobs_meta, weights, jnp.asarray(alloc),
-            jnp.asarray(maxt), masked_static=ms)
+            jnp.asarray(maxt), masked_static=ms,
+            sweeps=5 if big else 3, passes=4 if big else 3)
         task_node = np.where(assign < N, assign, NO_NODE).astype(np.int32)
         return _FusedSolution(tasks, job_ix_np, jobs_list, node_t, task_node,
                               pipelined, ready, kept)
