@@ -278,3 +278,59 @@ tiers:
     # max drops when the heaviest quota goes away
     store.delete("ResourceQuota", "heavy", "rq-a")
     assert cache.snapshot().namespaces["heavy"].get_weight() == 3
+
+
+class TestSessionGCWindow:
+    """open_session suspends automatic GC for the cycle (a gen-1/2
+    collection mid-action costs ~130ms at 10k pods); close_session
+    resumes it LATCH-PROOF — no sequence of unpaired opens or failing
+    hooks may permanently record 'disabled' (framework.py _gc_suspend)."""
+
+    def _cache(self):
+        from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+        return SchedulerCache(binder=FakeBinder(), evictor=FakeEvictor())
+
+    def test_suspend_resume(self):
+        import gc
+        from volcano_tpu.framework import (close_session, open_session,
+                                           parse_scheduler_conf)
+        conf = parse_scheduler_conf(None)
+        assert gc.isenabled()
+        ssn = open_session(self._cache(), conf.tiers, [])
+        assert not gc.isenabled()
+        close_session(ssn)
+        assert gc.isenabled()
+
+    def test_unpaired_open_does_not_latch(self):
+        import gc
+        from volcano_tpu.framework import (close_session, open_session,
+                                           parse_scheduler_conf)
+        conf = parse_scheduler_conf(None)
+        leaked = open_session(self._cache(), conf.tiers, [])   # never closed
+        assert not gc.isenabled()
+        ssn = open_session(self._cache(), conf.tiers, [])
+        close_session(ssn)
+        assert gc.isenabled(), \
+            "a paired session must restore GC despite the earlier leak"
+        close_session(leaked)
+        assert gc.isenabled()
+
+    def test_failing_close_hook_still_resumes(self):
+        import gc
+        from volcano_tpu.framework import (close_session, open_session,
+                                           parse_scheduler_conf)
+        conf = parse_scheduler_conf(None)
+        ssn = open_session(self._cache(), conf.tiers, [])
+
+        class Boom:
+            def name(self):
+                return "boom"
+
+            def on_session_close(self, ssn):
+                raise RuntimeError("close hook failed")
+
+        ssn.plugins["boom"] = Boom()
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError):
+            close_session(ssn)
+        assert gc.isenabled(), "restore must run in the finally"

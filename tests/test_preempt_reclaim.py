@@ -301,3 +301,21 @@ def test_f64_score_replica_bit_identity():
             assert np.array_equal(inv_m, inv_p)
     finally:
         close_session(ssn)
+
+
+@pytest.mark.parametrize("engine", ["tpu", "tpu-sharded"])
+def test_preempt_mid_size_parity_regression_seed(engine):
+    """The (200 nodes, 1000 tasks, 40 jobs, seed=2) mix that exposed BOTH
+    r5 walk bugs: (1) trusting the conservative fill schedule's truncation
+    as node-deadness (the within-fill expiry model under-estimates rs
+    after same-group evictions), and (2) freezing the tier cascade for
+    touched nodes (a drained static mask hands the node to drf and the
+    verdict GROWS). Exact victim-set equality against the callbacks
+    ground truth — a count match is not enough; both bugs swapped victim
+    identities within a job at equal counts."""
+    from tests.test_parallel import _preempt_mix
+
+    cb = _preempt_mix("callbacks", 2)
+    dev = _preempt_mix(engine, 2)
+    assert dev[0] == cb[0], sorted(cb[0] ^ dev[0])[:8]
+    assert dev[1] == cb[1]

@@ -893,9 +893,13 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
         bt = BlockTasks(req=jnp.asarray(req), job_ix=jnp.asarray(job_ix_np),
                         valid=jnp.ones(T, bool), feas=feas_b,
                         static_score=static_b)
+        # same size-scaled sweep budget as the sharded engine above, so
+        # the two block-auction paths keep identical admissions at any T
+        big_b = T > 12000
         assign, pipe, ready, kept, _ = _fused_blocks_solver()(
             node_t.node_state(), bt, jobs_meta, weights,
-            jnp.asarray(node_t.allocatable), jnp.asarray(node_t.max_tasks))
+            jnp.asarray(node_t.allocatable), jnp.asarray(node_t.max_tasks),
+            sweeps=5 if big_b else 3, passes=4 if big_b else 3)
         task_node = np.asarray(assign)
         pipelined = np.asarray(pipe, bool)
         job_ready = np.asarray(ready)
