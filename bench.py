@@ -383,9 +383,10 @@ def main():
     p_tpu_small_s, p_tpu_evicts, _ = run_preempt("preempt-small", "tpu")
     run_preempt("preempt", "tpu")                 # warm full-scale shapes
     p_tpu_s, p_full_evicts, p_pipelined = run_preempt("preempt", "tpu")
-    s, ev, pp = run_preempt("preempt", "tpu")     # best-of-2 (tunnel jitter)
-    if s < p_tpu_s:
-        p_tpu_s, p_full_evicts, p_pipelined = s, ev, pp
+    for _ in range(2):                 # best-of-3, same damping policy as
+        s, ev, pp = run_preempt("preempt", "tpu")  # the headline metric
+        if s < p_tpu_s:
+            p_tpu_s, p_full_evicts, p_pipelined = s, ev, pp
     extras.update(preempt_parity=p_cpu_evicts == p_tpu_evicts,
                   preempt_cpu_small_ms=round(p_cpu_s * 1e3, 1),
                   preempt_tpu_small_ms=round(p_tpu_small_s * 1e3, 1),
@@ -410,9 +411,10 @@ def main():
     r_tpu_s, r_tpu_evicts, _ = run_evict("preempt-small", "tpu", "reclaim")
     run_evict("preempt", "tpu", "reclaim")      # warm full-scale shapes
     r_full_s, r_full_evicts, _ = run_evict("preempt", "tpu", "reclaim")
-    s, ev, _ = run_evict("preempt", "tpu", "reclaim")   # best-of-2
-    if s < r_full_s:
-        r_full_s, r_full_evicts = s, ev
+    for _ in range(2):                                  # best-of-3
+        s, ev, _ = run_evict("preempt", "tpu", "reclaim")
+        if s < r_full_s:
+            r_full_s, r_full_evicts = s, ev
     extras.update(reclaim_parity=r_cpu_evicts == r_tpu_evicts,
                   reclaim_cpu_small_ms=round(r_cpu_s * 1e3, 1),
                   reclaim_tpu_small_ms=round(r_tpu_s * 1e3, 1),
