@@ -167,24 +167,34 @@ class NodeInfo:
         self.add_task(task)
 
     def clone(self) -> "NodeInfo":
-        """Snapshot copy with DIRECT aggregate transfer: replaying add_task
-        per task would re-derive idle/used/releasing/pipelined (and GPU card
-        state, in a possibly different order) with two Resource clones and
-        a sub/add per task — ~60% of the whole-cache snapshot cost at 10k
-        bound tasks. The aggregates are exact invariants of the task set,
-        so copying them IS the replay's end state."""
-        n = NodeInfo(name=self.name, allocatable=self.allocatable,
-                     capability=self.capability, labels=self.labels,
-                     taints=self.taints, unschedulable=self.unschedulable,
-                     annotations=self.annotations)
-        n.ready = self.ready
-        n.others = dict(self.others)
-        n.numa_info = self.numa_info.deep_copy() if self.numa_info else None
+        """Snapshot copy with DIRECT state transfer, bypassing __init__:
+        replaying add_task per task would re-derive idle/used/releasing/
+        pipelined (and GPU card state, in a possibly different order) with
+        two Resource clones and a sub/add per task, and the constructor
+        itself re-clones allocatable/capability and re-runs the GPU scan —
+        together ~70% of the whole-cache snapshot cost at 10k bound tasks.
+        The aggregates are exact invariants of the task set, and
+        allocatable/capability/labels/taints/annotations are IMMUTABLE
+        after construction (no mutation site in the tree; cache updates
+        replace the NodeInfo), so clones share them."""
+        n = NodeInfo.__new__(NodeInfo)
+        n.name = self.name
+        n.allocatable = self.allocatable
+        n.capability = self.capability
         n.idle = self.idle.clone()
         n.used = self.used.clone()
         n.releasing = self.releasing.clone()
         n.pipelined = self.pipelined.clone()
+        n.labels = self.labels
+        n.taints = self.taints
+        n.unschedulable = self.unschedulable
+        n.annotations = self.annotations
+        n.revocable_zone = self.revocable_zone
         n.used_ports = dict(self.used_ports)
+        n.ready = self.ready
+        n.others = dict(self.others)
+        n.numa_info = self.numa_info.deep_copy() if self.numa_info else None
+        n.tasks = {}
         for uid, task in self.tasks.items():
             ti = task.clone()
             ti.node_name = self.name
