@@ -54,6 +54,12 @@ def run_cycle(config: str, engine: str, seed: int = 0):
     action.execute(ssn)
     elapsed = time.perf_counter() - start
     close_session(ssn)
+    from volcano_tpu.actions import allocate as alloc_mod
+    # a silently degraded solve would compare callbacks against callbacks
+    # and report fake parity/speedup — fail loudly instead
+    assert not alloc_mod.LAST_FALLBACK, (
+        f"engine {engine} degraded to the sequential fallback mid-bench: "
+        f"{alloc_mod.LAST_FALLBACK}")
     admitted = frozenset(k.rsplit("-", 1)[0] for k in binder.binds)
     return elapsed, admitted, len(binder.binds)
 
@@ -109,6 +115,10 @@ def run_cycle_e2e(config: str, engine: str, seed: int = 0):
     t2 = time.perf_counter()
     close_session(ssn)
     t3 = time.perf_counter()
+    from volcano_tpu.actions import allocate as alloc_mod
+    assert not alloc_mod.LAST_FALLBACK, (
+        f"engine {engine} degraded to the sequential fallback mid-bench: "
+        f"{alloc_mod.LAST_FALLBACK}")
     return t3 - t0, t1 - t0, t2 - t1, t3 - t2
 
 
@@ -149,6 +159,25 @@ class _CompileCounter:
             lg.propagate = self._propagate.get(lg.name, True)
 
 
+def compile_canary() -> int:
+    """Prove _CompileCounter actually observes XLA compilations before the
+    churn gate relies on it: jit a fresh function at a shape nothing else
+    in the bench uses and count its guaranteed-cold first compile. If jax
+    renames the log_compiles logger (it moved modules before), the counter
+    goes deaf and churn_steady_ok would read all-zero compiles as "steady"
+    — this canary turns that silent disarm into a loud assert in main().
+    Returns the compile count observed for the cold cycle (must be > 0)."""
+    import jax
+    import jax.numpy as jnp
+
+    with _CompileCounter() as cc:
+        # a new lambda is a new jit cache entry: the first call always
+        # compiles; the shape is arbitrary
+        jax.jit(lambda x: (x * 2.0 + 1.0).sum())(
+            jnp.zeros((3, 41), jnp.float32)).block_until_ready()
+    return cc.count
+
+
 def run_churn(n_cycles: int = 6, churn_jobs: int = 5, seed: int = 0):
     """Steady-state churn: the scheduler SHELL's cycle (scheduler.go:87
     wait.Until loop) run ``n_cycles`` times over the 10k/2k cluster with
@@ -175,6 +204,8 @@ def run_churn(n_cycles: int = 6, churn_jobs: int = 5, seed: int = 0):
         "- name: allocate-tpu\n"
         "  arguments:\n"
         "    engine: tpu-fused\n")
+    from volcano_tpu.actions import allocate as alloc_mod
+
     cache, binder, _ = baseline_config("10k", seed=seed)
     sched = Scheduler(cache, conf_text=conf_text)
     times = []
@@ -184,9 +215,17 @@ def run_churn(n_cycles: int = 6, churn_jobs: int = 5, seed: int = 0):
         for cyc in range(n_cycles):
             seen = cc.count
             t0 = time.perf_counter()
-            sched.run_once()
+            errs = sched.run_once()
             times.append(time.perf_counter() - t0)
             compiles.append(cc.count - seen)
+            # run_once isolates action faults and the engine can degrade
+            # to the sequential placer — either would make the churn
+            # numbers (and the zero-recompile gate) measure the wrong
+            # thing silently
+            assert not errs, f"churn cycle {cyc} had action faults: {errs}"
+            assert not alloc_mod.LAST_FALLBACK, (
+                f"churn cycle {cyc} degraded to the sequential fallback: "
+                f"{alloc_mod.LAST_FALLBACK}")
             _churn_step(cache, cyc, churn_jobs, arrival_seed + cyc)
     return times, compiles, len(binder.binds)
 
@@ -357,6 +396,16 @@ def main():
                   cycle_open_ms=round(e2e_best[1] * 1e3, 1),
                   cycle_action_ms=round(e2e_best[2] * 1e3, 1),
                   cycle_close_ms=round(e2e_best[3] * 1e3, 1))
+
+    # compile-counter canary: the cold compile MUST register before the
+    # churn gate below may claim "zero recompiles" means anything
+    canary = compile_canary()
+    assert canary > 0, (
+        "compile-counter canary failed: a guaranteed-cold jit compile was "
+        "not observed — jax's log_compiles logger names no longer match "
+        "_CompileCounter's (jax._src.dispatch / jax._src.interpreters."
+        "pxla); churn_steady_ok would be vacuously true")
+    extras.update(compile_canary=canary)
 
     # steady-state churn (VERDICT r5 #4): 6 consecutive shell cycles at
     # 10k/2k with 5 gangs completing + 5 arriving between cycles; after

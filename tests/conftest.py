@@ -15,3 +15,19 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _gc_window_rebalance():
+    """Session GC windows are DEPTH-counted (framework.py): several tests
+    deliberately leave a session un-closed to inspect its state, which
+    would keep automatic GC suspended for every later test. Close any
+    windows the test leaked — window closes are idempotent, so a leaked
+    session's weakref finalizer firing later is a no-op and cannot steal
+    a later test's suspension."""
+    yield
+    from volcano_tpu.framework import framework as fw
+    for window in list(fw._GC_OPEN_WINDOWS):
+        fw._gc_resume(window)

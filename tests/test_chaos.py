@@ -1,0 +1,375 @@
+"""Chaos e2e: the fault-isolation layer under seeded fault injection
+(docs/robustness.md; volcano_tpu.chaos).
+
+Every test is deterministic from its SEED constant and embeds it in the
+assertion message, so a CI failure line alone reproduces the run.
+"""
+
+import gc
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from volcano_tpu import metrics
+from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
+                             Resource, TaskInfo, TaskStatus)
+from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.chaos import (ActionFaultInjector, ChaosBinder, ChaosError,
+                               ChaosEvictor)
+from volcano_tpu.scheduler import Scheduler
+
+GI = 1 << 30
+SEED = 20260803
+
+pytestmark = pytest.mark.chaos
+
+
+class CountingBinder(FakeBinder):
+    """Records EVERY successful bind call (not just the last per key), so
+    a double-bind is visible even when the dict would mask it."""
+
+    def __init__(self):
+        super().__init__()
+        self.calls = []
+
+    def bind(self, task, hostname):
+        with self._lock:
+            self.calls.append((task.key(), hostname))
+        super().bind(task, hostname)
+
+
+class CountingEvictor(FakeEvictor):
+    pass          # FakeEvictor.evicts already records every call
+
+
+def make_world(binder, evictor=None, n_nodes=4, n_jobs=8, tasks_per_job=5,
+               **cache_kw):
+    cache = SchedulerCache(binder=binder, evictor=evictor or FakeEvictor(),
+                           **cache_kw)
+    for i in range(n_nodes):
+        alloc = Resource(16000, 32 * GI)
+        alloc.max_task_num = 110
+        cache.add_node(NodeInfo(name=f"n{i}", allocatable=alloc))
+    for j in range(n_jobs):
+        pg = PodGroup(name=f"j{j}", queue="default",
+                      min_member=tasks_per_job,
+                      phase=PodGroupPhase.INQUEUE)
+        job = JobInfo(uid=f"j{j}", name=f"j{j}", queue="default",
+                      min_available=tasks_per_job, podgroup=pg)
+        for k in range(tasks_per_job):
+            job.add_task_info(TaskInfo(uid=f"j{j}-{k}", name=f"j{j}-{k}",
+                                       job=f"j{j}",
+                                       resreq=Resource(1000, GI)))
+        cache.add_job(job)
+    return cache
+
+
+def assert_exact_accounting(cache, seed):
+    """Every node's idle/used must equal allocatable minus exactly the
+    resreqs of the tasks it carries — the no-drift/no-double-count
+    invariant of the chaos runs."""
+    for node in cache.nodes.values():
+        expected = Resource()
+        for t in node.tasks.values():
+            if t.status not in (TaskStatus.PIPELINED, TaskStatus.RELEASING):
+                expected.add(t.resreq)
+        assert node.used == expected, \
+            f"seed={seed}: node {node.name} used drifted: " \
+            f"<{node.used}> != <{expected}>"
+        want_idle = node.allocatable.clone().sub(expected)
+        assert node.idle == want_idle, \
+            f"seed={seed}: node {node.name} idle drifted: " \
+            f"<{node.idle}> != <{want_idle}>"
+
+
+def test_chaos_bind_convergence_e2e():
+    """~20% seeded bind failures over >= 10 cycles: every gang converges
+    to fully BOUND through the resync queue, with exact idle/used
+    accounting, zero double-binds and zero lost tasks."""
+    inner = CountingBinder()
+    binder = ChaosBinder(inner, failure_rate=0.2, seed=SEED)
+    cache = make_world(binder)
+    sched = Scheduler(cache, schedule_period=0.01)
+
+    total = sum(len(j.tasks) for j in cache.jobs.values())
+    deadline = time.time() + 60
+    cycles = 0
+    while time.time() < deadline:
+        sched.run_once()
+        cycles += 1
+        bound = sum(1 for j in cache.jobs.values()
+                    for t in j.tasks.values()
+                    if t.status == TaskStatus.BOUND)
+        if bound == total and len(cache.resync_queue) == 0 and cycles >= 10:
+            break
+        time.sleep(0.01)
+
+    assert binder.failures > 0, \
+        f"seed={SEED}: chaos injected no failures — rate/seed rig broken"
+    bound = [t for j in cache.jobs.values() for t in j.tasks.values()
+             if t.status == TaskStatus.BOUND]
+    assert len(bound) == total, \
+        f"seed={SEED}: only {len(bound)}/{total} tasks bound " \
+        f"after {cycles} cycles (lost tasks)"
+    # zero double-binds: the inner binder saw each task exactly once
+    keys = [k for k, _ in inner.calls]
+    assert len(keys) == len(set(keys)) == total, \
+        f"seed={SEED}: double-bind detected: " \
+        f"{sorted(k for k in keys if keys.count(k) > 1)}"
+    # every task is mirrored on exactly one node, and accounting is exact
+    placements = {}
+    for node in cache.nodes.values():
+        for uid in node.tasks:
+            assert uid not in placements, \
+                f"seed={SEED}: task {uid} on two nodes " \
+                f"({placements[uid]}, {node.name})"
+            placements[uid] = node.name
+    assert len(placements) == total, f"seed={SEED}: node mirrors lost"
+    assert_exact_accounting(cache, SEED)
+    assert not cache.dead_letter, \
+        f"seed={SEED}: transient faults must not dead-letter: " \
+        f"{list(cache.dead_letter)}"
+
+
+def test_chaos_evict_convergence():
+    """~20% seeded evict failures: every eviction eventually executes
+    exactly once through the resync queue."""
+    inner = CountingEvictor()
+    evictor = ChaosEvictor(inner, failure_rate=0.2, seed=SEED + 1)
+    cache = make_world(FakeBinder(), evictor=evictor, n_jobs=4)
+    tasks = []
+    nodes = list(cache.nodes)
+    for j, job in enumerate(cache.jobs.values()):
+        job.podgroup.phase = PodGroupPhase.RUNNING
+        for t in job.tasks.values():
+            job.update_task_status(t, TaskStatus.RUNNING)
+            cache.nodes[nodes[j % len(nodes)]].add_task(t)
+            tasks.append(t)
+    for t in tasks:
+        cache.evict(t, "chaos")
+    deadline = time.time() + 30
+    while len(inner.evicts) < len(tasks) and time.time() < deadline:
+        time.sleep(0.01)
+        cache.process_resync_tasks()
+    assert evictor.failures > 0, f"seed={SEED + 1}: no failures injected"
+    assert sorted(inner.evicts) == sorted(t.key() for t in tasks), \
+        f"seed={SEED + 1}: evictions lost or duplicated: {inner.evicts}"
+
+
+def test_action_fault_isolated_session_closes():
+    """An injected exception in one action: the action is skipped and
+    counted, later actions still run, the session still closes (GC
+    window restored), and run_once reports the failure."""
+    metrics.reset_local()
+    inner = CountingBinder()
+    cache = make_world(inner, n_jobs=2)
+    sched = Scheduler(cache, schedule_period=0.01)
+    injector = ActionFaultInjector({"enqueue": [1]}, seed=SEED)
+    sched.action_fault_hook = injector
+
+    errors = sched.run_once()
+    assert [name for name, _ in errors] == ["enqueue"], \
+        f"seed={SEED}: expected the injected enqueue fault, got {errors}"
+    assert isinstance(errors[0][1], ChaosError)
+    # the later allocate action still ran: every task bound
+    total = sum(len(j.tasks) for j in cache.jobs.values())
+    assert len(inner.binds) == total, \
+        f"seed={SEED}: allocate did not run after the enqueue fault"
+    assert gc.isenabled(), "session did not close (GC still suspended)"
+    assert metrics.local_counters().get(("action_failures", "enqueue")) == 1
+    # clean second cycle: no errors
+    assert sched.run_once() == []
+
+
+def test_crash_loop_guard_backoff_and_recovery():
+    """A persistently failing action keeps run() alive in degraded state
+    with backoff; removing the fault recovers to healthy."""
+    metrics.reset_local()
+    cache = make_world(FakeBinder(), n_jobs=1)
+    sched = Scheduler(cache, schedule_period=0.005, backoff_base=0.005,
+                      backoff_max=0.02, backoff_jitter=0.0)
+    sched.action_fault_hook = ActionFaultInjector(
+        {"allocate": ()}, failure_rate=1.0, seed=SEED)
+    thread = sched.start()
+    deadline = time.time() + 10
+    while sched.consecutive_failures < 3 and time.time() < deadline:
+        time.sleep(0.005)
+    assert sched.consecutive_failures >= 3, \
+        f"seed={SEED}: crash-loop guard never engaged"
+    assert thread.is_alive(), "run() thread died on action faults"
+    state, fails = metrics.health()
+    assert state == metrics.DEGRADED and fails >= 3
+
+    sched.action_fault_hook = None          # fault fixed
+    deadline = time.time() + 10
+    while metrics.health()[0] != metrics.HEALTHY and time.time() < deadline:
+        time.sleep(0.005)
+    assert metrics.health() == (metrics.HEALTHY, 0), \
+        f"seed={SEED}: did not recover after the fault cleared"
+    assert sched.consecutive_failures == 0
+    sched.stop()
+    thread.join(timeout=5)
+    assert not thread.is_alive()
+
+
+def test_healthz_reports_degraded():
+    """/healthz flips 200 ok <-> 503 degraded with the health state."""
+    metrics.reset_local()
+    server = metrics.start_metrics_server(port=0, host="127.0.0.1")
+    try:
+        port = server.server_address[1]
+
+        def get():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz") as r:
+                    return r.status, r.read()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read()
+
+        assert get() == (200, b"ok")
+        metrics.set_health(metrics.DEGRADED, 4)
+        code, body = get()
+        assert code == 503 and b"degraded" in body and b"4" in body
+        metrics.set_health(metrics.HEALTHY, 0)
+        assert get() == (200, b"ok")
+    finally:
+        server.shutdown()
+
+
+def test_solver_fault_falls_back_to_sequential(monkeypatch):
+    """An injected fused-solver failure completes the SAME cycle through
+    the sequential placer, with gang admissions identical to the callbacks
+    engine on the same world."""
+    from volcano_tpu.actions import allocate as alloc_mod
+
+    metrics.reset_local()
+    # reference run: callbacks engine on an identical world
+    ref_binder = CountingBinder()
+    ref_cache = make_world(ref_binder)
+    Scheduler(ref_cache,
+              conf_text='actions: "enqueue, allocate, backfill"\n'
+                        'configurations:\n'
+                        '- name: allocate\n'
+                        '  arguments: {engine: callbacks}\n',
+              schedule_period=0.01).run_once()
+
+    # faulty run: tpu-fused whose solve raises mid-cycle
+    def boom(*a, **kw):
+        raise RuntimeError(f"chaos: injected solver failure (seed={SEED})")
+    monkeypatch.setattr(alloc_mod, "_solve_fused", boom)
+    binder = CountingBinder()
+    cache = make_world(binder)
+    sched = Scheduler(cache,
+                      conf_text='actions: "enqueue, allocate, backfill"\n'
+                                'configurations:\n'
+                                '- name: allocate\n'
+                                '  arguments: {engine: tpu-fused}\n',
+                      schedule_period=0.01)
+    errors = sched.run_once()
+
+    assert errors == [], \
+        f"seed={SEED}: fallback must absorb the solver fault, got {errors}"
+    assert binder.binds == ref_binder.binds, \
+        f"seed={SEED}: degraded-mode admissions diverged from callbacks"
+    assert metrics.local_counters().get(("solver_fallback", "allocate")) == 1
+    assert alloc_mod.LAST_FALLBACK.get("engine") == "tpu-fused"
+
+
+def test_replay_fault_is_not_absorbed_by_fallback(monkeypatch):
+    """A failure inside the statement-free batched replay mutates session
+    state outside the Statement undo log — the degradation chain must
+    re-raise (run_once isolates it) instead of running the sequential
+    placer on phantom allocations."""
+    from volcano_tpu.actions import allocate as alloc_mod
+
+    metrics.reset_local()
+
+    def boom(ssn, sol):
+        raise AssertionError("mid-apply accounting fault")
+    monkeypatch.setattr(alloc_mod, "_replay_fused_fast", boom)
+    binder = CountingBinder()
+    cache = make_world(binder)
+    sched = Scheduler(cache,
+                      conf_text='actions: "enqueue, allocate, backfill"\n'
+                                'configurations:\n'
+                                '- name: allocate\n'
+                                '  arguments: {engine: tpu-fused}\n',
+                      schedule_period=0.01)
+    errors = sched.run_once()
+    assert [name for name, _ in errors] == ["allocate"], errors
+    assert isinstance(errors[0][1], alloc_mod.ReplayFault)
+    assert metrics.local_counters().get(("solver_fallback", "allocate")) \
+        is None, "ReplayFault must not be converted into a fallback"
+
+
+def test_resync_dead_letter_and_redrive():
+    """A permanently failing bind stops spinning after its retry budget,
+    lands in the dead-letter set, and redrive_dead_letter() recovers it
+    once the fault is fixed."""
+    metrics.reset_local()
+
+    class BrokenBinder(FakeBinder):
+        def __init__(self):
+            super().__init__()
+            self.broken = True
+
+        def bind(self, task, hostname):
+            if self.broken:
+                raise RuntimeError("permanent apiserver rejection")
+            super().bind(task, hostname)
+
+    binder = BrokenBinder()
+    cache = make_world(binder, n_jobs=1, tasks_per_job=1,
+                       resync_max_retries=3)
+    cache.resync_queue.base_delay = 0.001
+    job = next(iter(cache.jobs.values()))
+    task = next(iter(job.tasks.values()))
+    placed = task.clone()        # the session's copy, like dispatch sends
+    placed.node_name = "n0"
+    cache.bind(placed)
+    assert len(cache.resync_queue) == 1
+
+    deadline = time.time() + 10
+    while not cache.dead_letter and time.time() < deadline:
+        time.sleep(0.005)
+        cache.process_resync_tasks()
+    assert list(cache.dead_letter) == [f"bind/{task.uid}"], \
+        f"dead letter never filled: queue={len(cache.resync_queue)}"
+    assert len(cache.resync_queue) == 0, \
+        "dead-lettered item still spinning in the resync queue"
+    assert metrics.local_counters().get(("resync_dead_letter", "bind")) == 1
+    # the accounting rolled back: nothing bound, node clean
+    assert_exact_accounting(cache, SEED)
+
+    binder.broken = False                     # operator fixed the fault
+    assert cache.redrive_dead_letter() == 1
+    deadline = time.time() + 10
+    while not binder.binds and time.time() < deadline:
+        time.sleep(0.005)
+        cache.process_resync_tasks()
+    assert binder.binds == {task.key(): "n0"}
+    assert not cache.dead_letter
+    assert job.tasks[task.uid].status == TaskStatus.BOUND
+
+
+def test_chaos_binder_deterministic_from_seed():
+    """Same seed -> same injected failure pattern (the reproducibility
+    contract the printed seed relies on)."""
+    def pattern(seed):
+        b = ChaosBinder(FakeBinder(), failure_rate=0.5, seed=seed)
+        out = []
+        t = TaskInfo(uid="t", name="t", job="j", resreq=Resource(1, 1))
+        for _ in range(32):
+            try:
+                b.bind(t, "n0")
+                out.append(True)
+            except ChaosError:
+                out.append(False)
+        return out
+
+    assert pattern(SEED) == pattern(SEED)
+    assert pattern(SEED) != pattern(SEED + 1), \
+        "distinct seeds produced identical fault patterns (degenerate rig)"

@@ -283,8 +283,10 @@ tiers:
 class TestSessionGCWindow:
     """open_session suspends automatic GC for the cycle (a gen-1/2
     collection mid-action costs ~130ms at 10k pods); close_session
-    resumes it LATCH-PROOF — no sequence of unpaired opens or failing
-    hooks may permanently record 'disabled' (framework.py _gc_suspend)."""
+    resumes it DEPTH-COUNTED — overlapping session windows (controller
+    probe sessions, nested opens) each suspend/resume symmetrically, and
+    collection re-enables only when the OUTERMOST window closes
+    (framework.py _gc_suspend/_gc_resume)."""
 
     def _cache(self):
         from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
@@ -301,18 +303,37 @@ class TestSessionGCWindow:
         close_session(ssn)
         assert gc.isenabled()
 
-    def test_unpaired_open_does_not_latch(self):
+    def test_overlapping_sessions_keep_gc_suspended(self):
+        """An inner session's close must NOT re-enable GC inside the outer
+        session's window (the boolean-latch bug the suspension depth
+        counter replaces); only the outermost close re-enables."""
         import gc
         from volcano_tpu.framework import (close_session, open_session,
                                            parse_scheduler_conf)
         conf = parse_scheduler_conf(None)
-        leaked = open_session(self._cache(), conf.tiers, [])   # never closed
+        outer = open_session(self._cache(), conf.tiers, [])
+        inner = open_session(self._cache(), conf.tiers, [])
         assert not gc.isenabled()
+        close_session(inner)
+        assert not gc.isenabled(), \
+            "inner close re-enabled GC inside the outer session's window"
+        close_session(outer)
+        assert gc.isenabled()
+
+    def test_extra_resume_does_not_underflow(self):
+        """A spurious extra close (double close_session on the same
+        session object) clamps at depth zero: GC stays enabled and the
+        next open/close pair still behaves."""
+        import gc
+        from volcano_tpu.framework import (close_session, open_session,
+                                           parse_scheduler_conf)
+        from volcano_tpu.framework.framework import _gc_resume
+        conf = parse_scheduler_conf(None)
+        _gc_resume()                      # unpaired: clamped, no underflow
+        assert gc.isenabled()
         ssn = open_session(self._cache(), conf.tiers, [])
+        assert not gc.isenabled()
         close_session(ssn)
-        assert gc.isenabled(), \
-            "a paired session must restore GC despite the earlier leak"
-        close_session(leaked)
         assert gc.isenabled()
 
     def test_failing_close_hook_still_resumes(self):

@@ -319,3 +319,67 @@ def test_preempt_mid_size_parity_regression_seed(engine):
     dev = _preempt_mix(engine, 2)
     assert dev[0] == cb[0], sorted(cb[0] ^ dev[0])[:8]
     assert dev[1] == cb[1]
+
+
+def test_walk_two_dynamic_tiers_accumulates_co_masks():
+    """Regression for the drf_pre0 accumulator (ops/evict.py): with TWO
+    dynamic tiers each carrying static co-masks, the run-entry refresh
+    mask must INTERSECT every dynamic tier's co-masks. The overwrite bug
+    kept only the last tier's, so the fill loop scored node A (best
+    static score) as evictable on the strength of a victim only the last
+    tier's mask allows; the exact row dispatch then rejected it (k=0) and
+    — allow_cheap=False, the two-dynamic-tier setting — the whole task
+    failed, where the serial walk evicts on node B.
+
+    Hand-built [N=2, W=2] world: node A holds v0 (small, passes both
+    masks) and v1 (large, blocked by tier 1's co-mask); node B holds v2
+    (large, passes both). The preemptor needs the large request; only B
+    can serve it, but A outscores B."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from volcano_tpu.ops.evict import BIG, EvictNW, build_preempt_walk
+
+    N, W, R, V = 2, 2, 1, 3
+    fidle0 = jnp.zeros((N, R), jnp.float32)
+    # slots: node0 -> v0, v1; node1 -> v2, pad(V)
+    vslot = np.array([[0, 1], [2, V]], np.int32)
+    valid = vslot < V
+    vreq = np.array([[[1.0], [4.0]], [[4.0], [0.0]]], np.float32)
+    # alloc-groups: 0 = preemptor job, 1/2/3 = victim jobs, 4 = pad row
+    vgroup = np.array([[1, 2], [3, 4]], np.int32)
+    rank = np.array([[0, 1], [2, BIG]], np.int32)
+    nw = EvictNW(vslot=jnp.asarray(vslot), valid=jnp.asarray(valid),
+                 vreq=jnp.asarray(vreq), vgroup=jnp.asarray(vgroup),
+                 rank=jnp.asarray(rank))
+    # one preemptor job: every victim is a candidate ([PJ=1, V+1])
+    cand = jnp.asarray(np.array([[True, True, True, False]]))
+    # tier 1 (drf + static co-mask): blocks v1; tier 2 (drf + static
+    # co-mask): allows all — the overwrite bug makes tier 2's mask the
+    # only one the refresh sees
+    m1 = np.array([[[True, False, True, False]]])
+    m2 = np.array([[[True, True, True, False]]])
+    part = np.ones((1, 1), bool)
+    tier_masks = ((jnp.asarray(m1), jnp.asarray(part)),
+                  (jnp.asarray(m2), jnp.asarray(part)))
+    preq = jnp.asarray(np.array([[4.0]], np.float32))
+    zeros1 = jnp.zeros(1, jnp.int32)
+    # shares trivially pass: victim jobs own 50/100, preemptor 0
+    jalloc0 = jnp.asarray(np.array(
+        [[0.0], [50.0], [50.0], [50.0], [0.0]], np.float32))
+    total = jnp.asarray(np.array([100.0], np.float32))
+    needed = jnp.asarray(np.array([BIG, 0, 0, 0, 0], np.float32))
+    score_g = jnp.asarray(np.array([[10.0, 5.0]], np.float32))
+
+    walk = build_preempt_walk(("drf", "drf"), (1, 1), gang_commit=False,
+                              allow_cheap=False)
+    task_node, owner, job_done, _ = walk(
+        fidle0, nw, cand, tier_masks, preq, zeros1, zeros1,
+        jnp.asarray(np.array([True])), zeros1, zeros1, zeros1,
+        score_g, needed, jalloc0, total)
+
+    assert int(task_node[0]) == 1, (
+        "two-dynamic-tier dispatch dead-ended on the over-approximated "
+        f"node instead of evicting on node B (task_node={task_node})")
+    owner = np.asarray(owner)
+    assert owner[1, 0] == 0 and (owner[0] == -1).all(), owner

@@ -110,3 +110,51 @@ class TestQuantity:
         assert r.memory == 2**30
         assert r.max_task_num == 110
         assert r.scalars["nvidia.com/gpu"] == 1000
+
+
+class TestImmutabilityGuard:
+    """The shared-across-clones contract (Resource docstring): clone sites
+    share resreq/init_resreq/allocatable, so debug mode freezes them and
+    in-place mutation raises. Off by default — zero contract change for
+    production paths."""
+
+    def test_freeze_asserts_only_under_guard(self):
+        from volcano_tpu.api import resource as res_mod
+
+        r = Resource(1000, 1 << 30)
+        r.freeze()
+        r.add(Resource(1, 1))            # guard off: freeze is inert
+        res_mod.set_mutation_guard(True)
+        try:
+            with pytest.raises(AssertionError, match="frozen"):
+                r.add(Resource(1, 1))
+            with pytest.raises(AssertionError, match="frozen"):
+                r.sub(Resource(1, 1))
+            # clones of a frozen Resource are freshly mutable
+            r.clone().add(Resource(1, 1))
+        finally:
+            res_mod.set_mutation_guard(False)
+
+    def test_clone_sites_freeze_shared_fields(self):
+        from volcano_tpu.api import TaskInfo
+        from volcano_tpu.api import resource as res_mod
+        from volcano_tpu.api.node_info import NodeInfo
+
+        res_mod.set_mutation_guard(True)
+        try:
+            t = TaskInfo(uid="t", name="t", job="j",
+                         resreq=Resource(1000, 1 << 30))
+            t.clone()
+            with pytest.raises(AssertionError, match="frozen"):
+                t.resreq.add(Resource(1, 1))
+
+            alloc = Resource(8000, 16 << 30)
+            node = NodeInfo(name="n0", allocatable=alloc)
+            node.clone()
+            with pytest.raises(AssertionError, match="frozen"):
+                node.allocatable.multi(2.0)
+            # the aggregates the clones COPY stay mutable (snapshot
+            # arithmetic runs on them every cycle)
+            node.idle.sub(Resource(1000, 1 << 30))
+        finally:
+            res_mod.set_mutation_guard(False)

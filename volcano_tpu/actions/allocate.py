@@ -30,6 +30,7 @@ drop-in.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -45,7 +46,33 @@ from ..utils.scheduler_helper import (predicate_nodes, prioritize_nodes,
                                       select_best_node)
 from .base import Action
 
+log = logging.getLogger(__name__)
+
 NO_NODE = -1
+
+
+class SolverFault(RuntimeError):
+    """A batched device solve produced an unusable result (non-finite
+    scores propagated into garbage placements, shape mismatch, compile
+    failure surfaced as a value error). Raised so the degradation chain
+    in AllocateAction.execute can complete the cycle sequentially."""
+
+
+class ReplayFault(RuntimeError):
+    """A failure inside the BATCHED (statement-free) replay: its
+    incremental aggregate mutations are not statement-tracked, so the
+    session cannot be proven consistent and the sequential fallback must
+    NOT run. ``poisons_session`` makes the scheduler shell abort the
+    REST of the cycle too (later actions would schedule against the
+    phantom aggregates); the next cycle opens a fresh snapshot."""
+
+    poisons_session = True
+
+
+# What the last degradation event did, for bench/ops introspection:
+# {"engine": failed engine, "error": repr} — empty when the last cycle
+# ran its configured engine end to end.
+LAST_FALLBACK: Dict[str, str] = {}
 
 
 class _AggTask:
@@ -68,9 +95,12 @@ class AllocateAction(Action):
 
     def execute(self, ssn) -> None:
         engine = self.engine
+        fallback = True
         for conf in ssn.configurations:
             if conf.name in (self.NAME, "allocate"):
                 engine = conf.arguments.get("engine", engine)
+                fallback = conf.arguments.get_bool("solver-fallback", True)
+        LAST_FALLBACK.clear()
         if engine == "callbacks":
             _execute_interleaved(ssn, _CallbackJobPlacer(ssn))
         elif engine == "callbacks-parallel":
@@ -87,17 +117,52 @@ class AllocateAction(Action):
             for conf in ssn.configurations:
                 if conf.name in (self.NAME, "allocate"):
                     batch = int(conf.arguments.get("strict-batch", batch))
-            _execute_strict_batched(ssn, batch=batch)
+            self._with_fallback(
+                ssn, engine, fallback,
+                lambda: _execute_strict_batched(ssn, batch=batch))
         elif engine == "tpu-strict-perjob":
-            _execute_interleaved(ssn, _DeviceJobPlacer(ssn))
+            self._with_fallback(
+                ssn, engine, fallback,
+                lambda: _execute_interleaved(ssn, _DeviceJobPlacer(ssn)))
         elif engine in ("tpu-fused", "tpu-blocks", "tpu-scan", "tpu-pallas",
                         "tpu-sharded"):
-            _execute_fused(ssn, blocks=(engine == "tpu-blocks"),
-                           sharded=(engine == "tpu-sharded"),
-                           kernel={"tpu-scan": "scan",
-                                   "tpu-pallas": "pallas"}.get(engine, "auto"))
+            self._with_fallback(
+                ssn, engine, fallback,
+                lambda: _execute_fused(
+                    ssn, blocks=(engine == "tpu-blocks"),
+                    sharded=(engine == "tpu-sharded"),
+                    kernel={"tpu-scan": "scan",
+                            "tpu-pallas": "pallas"}.get(engine, "auto")))
         else:
             raise ValueError(f"unknown allocate engine {engine!r}")
+
+    def _with_fallback(self, ssn, engine: str, enabled: bool, run) -> None:
+        """Graceful degradation (docs/robustness.md): if the batched JAX
+        solve raises — compile error, shape mismatch, SolverFault on
+        non-finite/garbage output — finish the SAME cycle with the
+        sequential per-task placer. The fused/strict engines only mutate
+        session state when replaying a completed solve through Statements,
+        and every replay loop discards its open Statement on a raise — so
+        at the point of failure every un-replayed task is still PENDING
+        and the interleave loop picks them all up; tasks an earlier
+        committed statement already placed are no longer PENDING and stay
+        placed. The one statement-free path (_replay_fused_fast) raises
+        ReplayFault instead, which is NOT absorbed here. Disable with the
+        action configuration key ``solver-fallback: false`` (parity
+        benches want the raw error)."""
+        try:
+            run()
+        except ReplayFault:
+            raise            # session not provably consistent — no fallback
+        except Exception as exc:
+            if not enabled:
+                raise
+            from .. import metrics
+            log.exception("allocate engine %s failed; completing the cycle "
+                          "with the sequential placer", engine)
+            metrics.register_solver_fallback(self.NAME)
+            LAST_FALLBACK.update(engine=engine, error=repr(exc))
+            _execute_interleaved(ssn, _CallbackJobPlacer(ssn))
 
 
 class AllocateTPUAction(AllocateAction):
@@ -219,7 +284,13 @@ def _execute_interleaved(ssn, placer) -> None:
         tasks = pending[job.uid]
 
         stmt = ssn.statement()
-        readded = placer.place(job, tasks, stmt, jobs)
+        try:
+            readded = placer.place(job, tasks, stmt, jobs)
+        except Exception:
+            # keep the session consistent for the caller's degradation
+            # chain: every op of the failed job rolls back
+            stmt.discard()
+            raise
 
         ops = list(stmt.operations)
         if ssn.job_ready(job):
@@ -558,18 +629,23 @@ def _execute_strict_batched(ssn, batch: int = 16) -> None:
             k = solved_ix.get(id(job))
             if k is not None:
                 lo, hi = slices[k]
-                for i, task in enumerate(tasks):
-                    n = int(task_node[lo + i])
-                    if n == NO_NODE:
-                        continue
-                    name = node_t.names[n]
-                    node = ssn.nodes[name]
-                    if recheck and not _stateful_recheck(ssn, task, node):
-                        continue
-                    if pipelined[lo + i]:
-                        stmt.pipeline(task, name)
-                    else:
-                        stmt.allocate(task, node)
+                try:
+                    for i, task in enumerate(tasks):
+                        n = int(task_node[lo + i])
+                        if n == NO_NODE:
+                            continue
+                        name = node_t.names[n]
+                        node = ssn.nodes[name]
+                        if recheck and not _stateful_recheck(ssn, task,
+                                                             node):
+                            continue
+                        if pipelined[lo + i]:
+                            stmt.pipeline(task, name)
+                        else:
+                            stmt.allocate(task, node)
+                except Exception:
+                    stmt.discard()      # session stays fallback-safe
+                    raise
                 verified_prefix.append((job, list(tasks)))
                 tasks.clear()
             if ssn.job_ready(job):
@@ -640,49 +716,53 @@ def _fixed_job_order(ssn, assumed_admitted: Optional[set] = None) -> List:
 
     ordered: List = []
     simulated: List[TaskInfo] = []
-    while not namespaces.empty():
-        ns = namespaces.pop()
-        queue_jobs = jobs_map[ns]
-        queue = None
-        for qid in list(queue_jobs):
-            q = ssn.queues[qid]
-            if ssn.overused(q):
-                del queue_jobs[qid]
-                continue
-            if queue_jobs[qid].empty():
-                continue
-            if queue is None or ssn.queue_order_fn(q, queue):
-                queue = q
-        if queue is None:
-            continue
-        jobs = queue_jobs[queue.uid]
-        if jobs.empty():
-            del queue_jobs[queue.uid]
-            namespaces.push(ns)
-            continue
-        job = jobs.pop()
-        ordered.append(job)
-        if assumed_admitted is None or job.uid in assumed_admitted:
-            # one aggregated pseudo-event per job: allocate-event handlers
-            # (drf/proportion) are additive in task.resreq, so summing the
-            # job's pending requests into a single event is equivalent and
-            # O(jobs) instead of O(tasks)
-            total = Resource()
-            count = 0
-            for task in job.task_status_index.get(TaskStatus.PENDING,
-                                                  {}).values():
-                if task.resreq.is_empty():
+    try:
+        while not namespaces.empty():
+            ns = namespaces.pop()
+            queue_jobs = jobs_map[ns]
+            queue = None
+            for qid in list(queue_jobs):
+                q = ssn.queues[qid]
+                if ssn.overused(q):
+                    del queue_jobs[qid]
                     continue
-                total.add(task.resreq)
-                count += 1
-            if count:
-                agg = _AggTask(job.uid, total)
-                ssn._fire_allocate(agg)
-                simulated.append(agg)
-        namespaces.push(ns)
-
-    for task in reversed(simulated):
-        ssn._fire_deallocate(task)
+                if queue_jobs[qid].empty():
+                    continue
+                if queue is None or ssn.queue_order_fn(q, queue):
+                    queue = q
+            if queue is None:
+                continue
+            jobs = queue_jobs[queue.uid]
+            if jobs.empty():
+                del queue_jobs[queue.uid]
+                namespaces.push(ns)
+                continue
+            job = jobs.pop()
+            ordered.append(job)
+            if assumed_admitted is None or job.uid in assumed_admitted:
+                # one aggregated pseudo-event per job: allocate-event
+                # handlers (drf/proportion) are additive in task.resreq,
+                # so summing the job's pending requests into a single
+                # event is equivalent and O(jobs) instead of O(tasks)
+                total = Resource()
+                count = 0
+                for task in job.task_status_index.get(TaskStatus.PENDING,
+                                                      {}).values():
+                    if task.resreq.is_empty():
+                        continue
+                    total.add(task.resreq)
+                    count += 1
+                if count:
+                    agg = _AggTask(job.uid, total)
+                    ssn._fire_allocate(agg)
+                    simulated.append(agg)
+            namespaces.push(ns)
+    finally:
+        # always undo the simulated events — the sequential fallback runs
+        # in this same session and must not see phantom queue shares
+        # (same contract as _predict_pops)
+        for task in reversed(simulated):
+            ssn._fire_deallocate(task)
     return ordered
 
 
@@ -748,6 +828,15 @@ def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4,
 class _FusedSolution:
     def __init__(self, tasks, job_ix, jobs_list, node_t, task_node,
                  pipelined, job_ready, job_kept):
+        # garbage-output guard: an out-of-range node index here would
+        # corrupt host accounting at replay — classify it as a solver
+        # fault so the degradation chain takes over
+        tn = np.asarray(task_node)
+        if tn.size and (int(tn.min()) < NO_NODE
+                        or int(tn.max()) >= len(node_t.names)):
+            raise SolverFault(
+                f"device solve returned node indices outside "
+                f"[{NO_NODE}, {len(node_t.names)})")
         self.tasks = tasks
         self.job_ix = job_ix
         self.jobs_list = jobs_list
@@ -786,6 +875,21 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
     feas = assemble_feasibility(ssn, tasks, node_t)
     static = assemble_static_score(ssn, tasks, node_t)
     weights = assemble_weights(ssn, rnames)
+    # Non-finite values in the assembled tensors flow through the kernels
+    # into silently wrong placements (an inf score times a zero weight is
+    # NaN, and argmax over NaN rows returns in-range indices) — surface
+    # them as a SolverFault so the sequential fallback completes the
+    # cycle instead. Feasibility masking applies NEG separately, so the
+    # raw static scores and weights are finite by construction.
+    if not np.isfinite(req).all() or (
+            static is not None
+            and not np.isfinite(np.asarray(static)).all()):
+        raise SolverFault("non-finite task requests or static scores")
+    if not (np.isfinite(weights.binpack_res).all()
+            and all(np.isfinite(w) for w in (
+                weights.binpack_weight, weights.least_req_weight,
+                weights.most_req_weight, weights.balanced_weight))):
+        raise SolverFault("non-finite score weights")
 
     T = len(tasks)
     N = len(node_t.names)
@@ -1036,7 +1140,15 @@ def _replay_fused(ssn, sol: _FusedSolution) -> int:
     number of proposals rejected by the live stateful re-check (callers
     re-solve those tasks against fresh state)."""
     if _fast_replay_ok(ssn):
-        _replay_fused_fast(ssn, sol)
+        try:
+            _replay_fused_fast(ssn, sol)
+        except Exception as exc:
+            # the fast replay's aggregate mutations are not
+            # statement-tracked: a mid-replay raise leaves state the
+            # fallback cannot reason about — classify so the degradation
+            # chain re-raises instead of running on phantom allocations
+            raise ReplayFault(
+                f"batched replay failed mid-apply: {exc!r}") from exc
         return 0
     per_job_tasks: Dict[int, List[int]] = {}
     for i, jx in enumerate(sol.job_ix):
@@ -1049,19 +1161,24 @@ def _replay_fused(ssn, sol: _FusedSolution) -> int:
             continue
         job = sol.jobs_list[jx]
         stmt = ssn.statement()
-        for i in task_ids:
-            n = int(sol.task_node[i])
-            if n == NO_NODE:
-                continue
-            name = sol.node_t.names[n]
-            node = ssn.nodes[name]
-            if recheck and not _stateful_recheck(ssn, sol.tasks[i], node):
-                rejected += 1
-                continue
-            if sol.pipelined[i]:
-                stmt.pipeline(sol.tasks[i], name)
-            else:
-                stmt.allocate(sol.tasks[i], node)
+        try:
+            for i in task_ids:
+                n = int(sol.task_node[i])
+                if n == NO_NODE:
+                    continue
+                name = sol.node_t.names[n]
+                node = ssn.nodes[name]
+                if recheck and not _stateful_recheck(ssn, sol.tasks[i],
+                                                     node):
+                    rejected += 1
+                    continue
+                if sol.pipelined[i]:
+                    stmt.pipeline(sol.tasks[i], name)
+                else:
+                    stmt.allocate(sol.tasks[i], node)
+        except Exception:
+            stmt.discard()              # session stays fallback-safe
+            raise
         if ssn.job_ready(job):
             stmt.commit()
         elif not ssn.job_pipelined(job):

@@ -12,6 +12,7 @@ import itertools
 import time as _time
 from typing import Dict, List, Optional, TYPE_CHECKING
 
+from . import resource as _res
 from .resource import Resource
 from .types import PodGroupPhase, TaskStatus, allocated_status
 
@@ -125,9 +126,14 @@ class TaskInfo:
         construction: no mutation site exists in the tree (all arithmetic
         happens on node/job aggregate Resources, statuses flip via
         update_task_status), so sharing them is exact and 40k Resource
-        copies per 10k-task snapshot vanish."""
+        copies per 10k-task snapshot vanish. The contract is documented on
+        Resource (api/resource.py) and enforced in debug runs by freezing
+        the shared instances here."""
         t = TaskInfo.__new__(TaskInfo)
         t.__dict__.update(self.__dict__)
+        if _res._MUTATION_GUARD:
+            self.resreq.freeze()
+            self.init_resreq.freeze()
         return t
 
     # historical alias from when clone deep-copied the resource vectors;

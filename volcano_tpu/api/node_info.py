@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from . import resource as _res
 from .resource import Resource
 from .device_info import (GPU_MEMORY_RESOURCE, GPU_NUMBER_RESOURCE,
                           add_gpu_resource, gpu_memory_of_task,
@@ -176,11 +177,17 @@ class NodeInfo:
         The aggregates are exact invariants of the task set, and
         allocatable/capability/labels/taints/annotations are IMMUTABLE
         after construction (no mutation site in the tree; cache updates
-        replace the NodeInfo), so clones share them."""
+        replace the NodeInfo), so clones share them — the contract is
+        documented on Resource (api/resource.py) and enforced in debug
+        runs by freezing the shared instances here."""
         n = NodeInfo.__new__(NodeInfo)
         n.name = self.name
         n.allocatable = self.allocatable
         n.capability = self.capability
+        if _res._MUTATION_GUARD:
+            self.allocatable.freeze()
+            if self.capability is not None:
+                self.capability.freeze()
         n.idle = self.idle.clone()
         n.used = self.used.clone()
         n.releasing = self.releasing.clone()

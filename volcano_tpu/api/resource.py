@@ -12,9 +12,22 @@ losslessly into fixed-width ``float32`` vectors (see
 from __future__ import annotations
 
 import math
+import os
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
+
+# Debug-mode mutation guard for the shared-across-clones immutability
+# contract (see Resource docstring): when on, Resources marked frozen()
+# raise on any in-place mutation. Off by default — the check costs one
+# branch on the hottest arithmetic in the tree. Enable with the env var
+# below or set_mutation_guard(True) (chaos/regression rigs).
+_MUTATION_GUARD = bool(os.environ.get("VOLCANO_TPU_DEBUG_RESOURCE_FREEZE"))
+
+
+def set_mutation_guard(on: bool) -> None:
+    global _MUTATION_GUARD
+    _MUTATION_GUARD = bool(on)
 
 # Epsilon used by the reference for all comparisons
 # (resource_info.go:36 `minResource float64 = 0.1`).
@@ -45,9 +58,25 @@ class Resource:
 
     ``max_task_num`` mirrors ``MaxTaskNum`` (resource_info.go:57-59): only used
     by predicates (pod-count capacity), never part of arithmetic.
+
+    **Shared-across-clones immutability contract.** The snapshot hot paths
+    deliberately SHARE Resource instances instead of copying them:
+    ``TaskInfo.clone`` shares ``resreq``/``init_resreq`` and
+    ``NodeInfo.clone`` shares ``allocatable``/``capability`` between the
+    live cache object and every per-cycle snapshot clone. That is exact
+    only because those fields are never mutated after construction — all
+    arithmetic happens on the node/job AGGREGATE Resources (idle, used,
+    releasing, pipelined, allocated), which the clones do copy. Any new
+    code that wants to change a task's request or a node's allocatable
+    must REPLACE the Resource (build a new one via clone().add(...)),
+    never mutate it in place, or every snapshot sharing it silently
+    corrupts. ``freeze()`` plus the VOLCANO_TPU_DEBUG_RESOURCE_FREEZE env
+    var (or set_mutation_guard) turn a violation into an immediate
+    AssertionError in debug runs: clone sites freeze the shared instances,
+    and every in-place mutator checks the mark.
     """
 
-    __slots__ = ("cpu", "memory", "scalars", "max_task_num")
+    __slots__ = ("cpu", "memory", "scalars", "max_task_num", "_frozen")
 
     def __init__(self, cpu: float = 0.0, memory: float = 0.0,
                  scalars: Optional[Dict[str, float]] = None,
@@ -83,13 +112,29 @@ class Resource:
 
     def clone(self) -> "Resource":
         # bypasses __init__ (float() coercions): clone is the hottest
-        # Resource path — node aggregates on every snapshot
+        # Resource path — node aggregates on every snapshot. Clones are
+        # freshly mutable: the frozen mark (debug guard) is not copied.
         r = Resource.__new__(Resource)
         r.cpu = self.cpu
         r.memory = self.memory
         r.scalars = dict(self.scalars)
         r.max_task_num = self.max_task_num
         return r
+
+    # -- debug-mode immutability guard (class docstring contract) -----------
+
+    def freeze(self) -> "Resource":
+        """Mark this instance as shared/immutable; only enforced when the
+        mutation guard is on (clone() output is always fresh/unfrozen)."""
+        self._frozen = True
+        return self
+
+    def _mutation_check(self) -> None:
+        if getattr(self, "_frozen", False):
+            raise AssertionError(
+                "in-place mutation of a frozen (shared-across-clones) "
+                f"Resource <{self}> — replace it instead; see the "
+                "immutability contract in api/resource.py")
 
     # -- accessors ----------------------------------------------------------
 
@@ -101,6 +146,8 @@ class Resource:
         return self.scalars.get(name, 0.0)
 
     def set(self, name: str, value: float) -> None:
+        if _MUTATION_GUARD:
+            self._mutation_check()
         if name == CPU:
             self.cpu = value
         elif name == MEMORY:
@@ -123,6 +170,8 @@ class Resource:
     # -- arithmetic (in place, returning self, like the reference) ----------
 
     def add(self, rr: "Resource") -> "Resource":
+        if _MUTATION_GUARD:
+            self._mutation_check()
         self.cpu += rr.cpu
         self.memory += rr.memory
         for n, q in rr.scalars.items():
@@ -131,6 +180,8 @@ class Resource:
 
     def sub(self, rr: "Resource") -> "Resource":
         """Subtract; asserts sufficiency like the reference (resource_info.go:191-206)."""
+        if _MUTATION_GUARD:
+            self._mutation_check()
         assert rr.less_equal(self, ZERO), \
             f"resource is not sufficient to do operation: <{self}> sub <{rr}>"
         self.cpu -= rr.cpu
@@ -141,6 +192,8 @@ class Resource:
         return self
 
     def multi(self, ratio: float) -> "Resource":
+        if _MUTATION_GUARD:
+            self._mutation_check()
         self.cpu *= ratio
         self.memory *= ratio
         for n in self.scalars:
@@ -149,6 +202,8 @@ class Resource:
 
     def set_max_resource(self, rr: "Resource") -> "Resource":
         """Per-dimension max (resource_info.go:218-247)."""
+        if _MUTATION_GUARD:
+            self._mutation_check()
         self.cpu = max(self.cpu, rr.cpu)
         self.memory = max(self.memory, rr.memory)
         for n, q in rr.scalars.items():
@@ -158,6 +213,8 @@ class Resource:
     def fit_delta(self, rr: "Resource") -> "Resource":
         """Available-minus-requested with epsilon margin; negative dimensions
         mark insufficiency (resource_info.go:249-276)."""
+        if _MUTATION_GUARD:
+            self._mutation_check()
         if rr.cpu > 0:
             self.cpu -= rr.cpu + MIN_RESOURCE
         if rr.memory > 0:
@@ -170,6 +227,8 @@ class Resource:
     def min_dimension_resource(self, rr: "Resource") -> "Resource":
         """Per-dimension min against rr; dimensions missing from rr are
         treated as zero (resource_info.go:428-455)."""
+        if _MUTATION_GUARD:
+            self._mutation_check()
         self.cpu = min(self.cpu, rr.cpu)
         self.memory = min(self.memory, rr.memory)
         for n in list(self.scalars):

@@ -30,24 +30,42 @@ from .executors import (Binder, Evictor, FakeBinder, FakeEvictor,
 class RateLimitedQueue:
     """workqueue.RateLimitingInterface analogue (the errTasks queue,
     cache.go:115,777-799): per-item exponential backoff — the k8s
-    ItemExponentialFailureRateLimiter (base * 2^failures, capped)."""
+    ItemExponentialFailureRateLimiter (base * 2^failures, capped) — plus a
+    per-item retry budget: once an item has failed ``max_retries`` times,
+    add_rate_limited refuses it (returns False) so a permanently failing
+    side effect cannot spin in the queue forever. The caller dead-letters
+    refused items (SchedulerCache.dead_letter)."""
 
-    def __init__(self, base_delay: float = 0.005, max_delay: float = 10.0):
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 10.0,
+                 max_retries: Optional[int] = None):
         self.base_delay = base_delay
         self.max_delay = max_delay
+        self.max_retries = max_retries
         self._heap: List[Tuple[float, int, str, object]] = []
         self._failures: Dict[str, int] = {}
         self._seq = itertools.count()
         self._lock = threading.Lock()
 
-    def add_rate_limited(self, key: str, item: object) -> None:
+    def add_rate_limited(self, key: str, item: object) -> bool:
         with self._lock:
             n = self._failures.get(key, 0)
+            if self.max_retries is not None and n >= self.max_retries:
+                # keep the failure count: a later add for the same key
+                # (e.g. the scheduler re-placing the rolled-back task onto
+                # the same broken path) is refused again instead of
+                # restarting a full retry burst — only forget() (redrive)
+                # grants a fresh budget
+                return False
             self._failures[key] = n + 1
             delay = min(self.base_delay * (2 ** n), self.max_delay)
             heapq.heappush(self._heap,
                            (time.monotonic() + delay, next(self._seq), key,
                             item))
+            return True
+
+    def failures(self, key: str) -> int:
+        with self._lock:
+            return self._failures.get(key, 0)
 
     def forget(self, key: str) -> None:
         with self._lock:
@@ -67,12 +85,21 @@ class RateLimitedQueue:
             return len(self._heap)
 
 
+# A bind/evict that fails this many RETRIES (after the initial attempt)
+# dead-letters instead of re-queueing — with the default 5ms base delay
+# the budget spans ~20s of exponential backoff, past any transient
+# apiserver hiccup the resync queue is meant to absorb.
+DEFAULT_RESYNC_MAX_RETRIES = 12
+
+
 class SchedulerCache:
     def __init__(self, binder: Optional[Binder] = None,
                  evictor: Optional[Evictor] = None,
                  status_updater: Optional[StatusUpdater] = None,
                  volume_binder: Optional[VolumeBinder] = None,
-                 default_queue: str = "default"):
+                 default_queue: str = "default",
+                 resync_max_retries: Optional[int]
+                 = DEFAULT_RESYNC_MAX_RETRIES):
         self._lock = threading.RLock()
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
@@ -86,7 +113,13 @@ class SchedulerCache:
         if default_queue:
             self.queues.setdefault(default_queue, QueueInfo(name=default_queue))
         self.err_tasks: List[TaskInfo] = []       # failure record (tests)
-        self.resync_queue = RateLimitedQueue()    # errTasks (cache.go:777-799)
+        self.resync_queue = RateLimitedQueue(     # errTasks (cache.go:777-799)
+            max_retries=resync_max_retries)
+        # side effects that exhausted their retry budget, key -> (op, task).
+        # Never retried automatically (the failure is not transient by
+        # definition of the budget); ops inspect it and redrive_dead_letter
+        # re-queues after the underlying fault is fixed.
+        self.dead_letter: Dict[str, Tuple[str, TaskInfo]] = {}
         self.binding_tasks: Dict[str, str] = {}   # task uid -> node, in flight
 
     # -- ingestion (event_handlers.go analogues) ----------------------------
@@ -115,7 +148,10 @@ class SchedulerCache:
 
     def remove_job(self, uid: str) -> None:
         with self._lock:
-            self.jobs.pop(uid, None)
+            job = self.jobs.pop(uid, None)
+            if job is not None:
+                for task_uid in job.tasks:
+                    self._drop_retry_state(task_uid)
 
     def get_or_create_job(self, uid: str, **kwargs) -> JobInfo:
         with self._lock:
@@ -150,6 +186,7 @@ class SchedulerCache:
                 node = self.nodes[task.node_name]
                 node.remove_task(task)
                 self._release_numa(node, task.uid)
+            self._drop_retry_state(task.uid)
 
     @staticmethod
     def _release_numa(node, task_uid: str) -> None:
@@ -336,15 +373,70 @@ class SchedulerCache:
 
     def resync_task(self, task: TaskInfo, op: str = "bind") -> None:
         """Queue a failed side effect for rate-limited retry
-        (cache.go:777-799 resyncTask -> errTasks.AddRateLimited)."""
-        self.resync_queue.add_rate_limited(f"{op}/{task.uid}", (op, task))
+        (cache.go:777-799 resyncTask -> errTasks.AddRateLimited); a task
+        past its retry budget moves to the dead-letter set instead."""
+        self._resync_or_dead_letter(f"{op}/{task.uid}", op, task)
+
+    def _resync_or_dead_letter(self, key: str, op: str,
+                               task: TaskInfo) -> None:
+        if not self.resync_queue.add_rate_limited(key, (op, task)):
+            with self._lock:
+                fresh = key not in self.dead_letter
+                self.dead_letter[key] = (op, task)
+            if fresh:
+                # count logical events, not cycles: a PENDING-rolled-back
+                # task re-placed every cycle keeps hitting the refused
+                # budget, but it is still ONE dead-lettered side effect
+                from .. import metrics
+                metrics.register_dead_letter(op)
+
+    def _drop_retry_state(self, task_uid: str) -> None:
+        """A deleted task's queued retries and dead-letter entry are moot
+        — purge them so dead_letter cannot pin TaskInfo objects (and their
+        job/node references) forever. Caller holds self._lock."""
+        for key in (f"bind/{task_uid}", f"evict/{task_uid}"):
+            self.dead_letter.pop(key, None)
+            self.resync_queue.forget(key)
+
+    def redrive_dead_letter(self) -> int:
+        """Re-queue every dead-lettered side effect with a fresh retry
+        budget — the operator affordance for after the underlying fault
+        (bad node, apiserver outage) is fixed. Returns how many moved."""
+        with self._lock:
+            items = list(self.dead_letter.items())
+            self.dead_letter.clear()
+        for key, (op, task) in items:
+            self.resync_queue.forget(key)
+            self.resync_queue.add_rate_limited(key, (op, task))
+        return len(items)
+
+    def _resync_stale(self, op: str, task: TaskInfo) -> bool:
+        """A queued retry is STALE when the cluster moved on while it sat
+        in backoff: the task was deleted, or (bind) a later scheduling
+        cycle already re-placed the rolled-back task — retrying then would
+        bind the pod a second time (possibly onto a different node) and
+        double-count it on two nodes' accounting."""
+        with self._lock:
+            job = self.jobs.get(task.job)
+            cached = job.tasks.get(task.uid) if job is not None else None
+            if cached is None:
+                return True
+            if op == "bind" and (cached.status == TaskStatus.BOUND
+                                 or (cached.node_name
+                                     and cached.node_name != task.node_name)):
+                return True
+        return False
 
     def process_resync_tasks(self) -> int:
         """Retry side effects whose backoff expired (processResyncTask,
         cache.go:781-799) — the scheduler shell calls this every cycle.
-        Returns the number of successful retries."""
+        Returns the number of successful retries. Stale entries (see
+        _resync_stale) are dropped, not retried."""
         done = 0
         for key, (op, task) in self.resync_queue.pop_ready():
+            if self._resync_stale(op, task):
+                self.resync_queue.forget(key)
+                continue
             try:
                 if op == "bind":
                     self._bind_volumes(task)
@@ -369,7 +461,7 @@ class SchedulerCache:
                 self.resync_queue.forget(key)
                 done += 1
             except Exception:
-                self.resync_queue.add_rate_limited(key, (op, task))
+                self._resync_or_dead_letter(key, op, task)
         return done
 
     FORWARD_CLUSTER_KEY = "volcano.sh/forward-cluster"

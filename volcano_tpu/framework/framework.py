@@ -4,7 +4,9 @@
 from __future__ import annotations
 
 import gc
+import threading
 import time
+import weakref
 from typing import List
 
 from .. import metrics
@@ -19,17 +21,60 @@ from .session import Session
 # "disabled" state into every later session's restore decision.
 _GC_ON_OUTSIDE: bool = gc.isenabled()
 
+# Suspension DEPTH, not a boolean latch: overlapping session windows
+# (controller threads opening an inner session while the scheduler's is
+# live, or a plugin opening a nested probe session) each open one
+# _GCWindow on suspend and close it on resume, and collection re-enables
+# only when the last open window closes — an inner close_session can no
+# longer re-enable GC in the middle of the outer session's cycle. Each
+# window closes AT MOST ONCE (resume is idempotent per window), so a
+# double close_session or a late-firing leak finalizer cannot steal
+# another live session's suspension. A session that is never closed
+# cannot pin collection off forever either: open_session attaches a
+# weakref finalizer that closes the leaked window when the session object
+# itself dies (refcount collection still runs while automatic GC is off).
+_GC_LOCK = threading.Lock()
+_GC_OPEN_WINDOWS: List["_GCWindow"] = []
 
-def _gc_suspend() -> None:
+
+class _GCWindow:
+    __slots__ = ("closed",)
+
+    def __init__(self):
+        self.closed = False
+
+
+def _gc_suspend() -> "_GCWindow":
     global _GC_ON_OUTSIDE
-    if gc.isenabled():
-        _GC_ON_OUTSIDE = True
-    gc.disable()
+    window = _GCWindow()
+    with _GC_LOCK:
+        if not _GC_OPEN_WINDOWS and gc.isenabled():
+            _GC_ON_OUTSIDE = True
+        _GC_OPEN_WINDOWS.append(window)
+        gc.disable()
+    return window
 
 
-def _gc_resume() -> None:
-    if _GC_ON_OUTSIDE:
+def _gc_resume(window: "_GCWindow" = None) -> None:
+    """Close one suspension window; no-op if that window already closed.
+    ``window=None`` (legacy direct callers) closes the most recent open
+    window, and is a no-op when none is open."""
+    collect = False
+    with _GC_LOCK:
+        if window is None:
+            window = _GC_OPEN_WINDOWS[-1] if _GC_OPEN_WINDOWS else None
+        if window is None or window.closed:
+            return
+        window.closed = True
+        try:
+            _GC_OPEN_WINDOWS.remove(window)
+        except ValueError:       # pragma: no cover - closed implies present
+            pass
+        if _GC_OPEN_WINDOWS or not _GC_ON_OUTSIDE:
+            return
         gc.enable()
+        collect = True
+    if collect:
         gc.collect(1)
 
 
@@ -46,7 +91,7 @@ def open_session(cache, tiers: List[Tier],
     # point. close_session resumes collection and runs one bounded
     # young-gen pass to reclaim cycle garbage.
     ssn = Session(cache, tiers, list(configurations))
-    _gc_suspend()
+    window = _gc_suspend()
     try:
         for tier in tiers:
             for opt in tier.plugins:
@@ -60,8 +105,13 @@ def open_session(cache, tiers: List[Tier],
                 metrics.update_plugin_duration(plugin.name(), "OnSessionOpen",
                                                time.perf_counter() - start)
     except BaseException:
-        _gc_resume()
+        _gc_resume(window)
         raise
+    ssn._gc_window = window
+    # leak guard: if this session is never close_session'd, close its
+    # window when the object dies instead of pinning GC off forever (a
+    # no-op if close_session ran — windows close at most once)
+    weakref.finalize(ssn, _gc_resume, window)
     return ssn
 
 
@@ -76,4 +126,8 @@ def close_session(ssn: Session) -> None:
         from .job_updater import update_all
         update_all(ssn)
     finally:
-        _gc_resume()
+        # idempotent per window: a double close (or the leak finalizer
+        # firing later) cannot steal another live session's suspension.
+        # Sessions not built by open_session carry no window — legacy
+        # most-recent-window resume.
+        _gc_resume(getattr(ssn, "_gc_window", None))

@@ -25,6 +25,13 @@ _durations: Dict[Tuple[str, ...], list] = collections.defaultdict(list)
 _gauges: Dict[Tuple[str, ...], float] = {}
 _counters: Dict[Tuple[str, ...], float] = collections.defaultdict(float)
 
+# scheduler health (docs/robustness.md): "healthy" | "degraded", plus the
+# consecutive-failed-cycle count the crash-loop guard exports. /healthz
+# answers 200/503 from this.
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+_health = {"state": HEALTHY, "consecutive_failures": 0}
+
 if _HAVE_PROM:
     _e2e = Histogram(f"{_SUBSYSTEM}_e2e_scheduling_latency_milliseconds",
                      "E2e scheduling latency in ms")
@@ -54,6 +61,16 @@ if _HAVE_PROM:
                             "Queue deserved memory", ["queue_name"])
     _q_share = Gauge(f"{_SUBSYSTEM}_queue_share", "Queue share", ["queue_name"])
     _q_weight = Gauge(f"{_SUBSYSTEM}_queue_weight", "Queue weight", ["queue_name"])
+    _health_g = Gauge(f"{_SUBSYSTEM}_scheduler_healthy",
+                      "1 healthy, 0 degraded (crash-loop guard)")
+    _action_fail = Counter(f"{_SUBSYSTEM}_action_failures_total",
+                           "Actions that raised and were skipped", ["action"])
+    _solver_fb = Counter(f"{_SUBSYSTEM}_solver_fallback_total",
+                         "Device-solver failures degraded to the sequential "
+                         "placer", ["action"])
+    _dead_letter = Counter(f"{_SUBSYSTEM}_resync_dead_letter_total",
+                           "Side effects dropped from the resync queue after "
+                           "the per-item retry cap", ["op"])
 
 
 def update_e2e_duration(seconds: float) -> None:
@@ -63,17 +80,69 @@ def update_e2e_duration(seconds: float) -> None:
         _e2e.observe(seconds * 1e3)
 
 
+def set_health(state: str, consecutive_failures: int = 0) -> None:
+    """Publish the scheduler shell's health verdict (the crash-loop guard
+    in scheduler.run calls this every cycle; docs/robustness.md)."""
+    with _lock:
+        _health["state"] = state
+        _health["consecutive_failures"] = consecutive_failures
+        _gauges[("scheduler_healthy",)] = 1.0 if state == HEALTHY else 0.0
+    if _HAVE_PROM:
+        _health_g.set(1.0 if state == HEALTHY else 0.0)
+
+
+def health() -> Tuple[str, int]:
+    with _lock:
+        return _health["state"], _health["consecutive_failures"]
+
+
+def register_action_failure(action: str) -> None:
+    """An action raised inside run_once and was isolated/skipped."""
+    with _lock:
+        _counters[("action_failures", action)] += 1
+    if _HAVE_PROM:
+        _action_fail.labels(action=action).inc()
+
+
+def register_solver_fallback(action: str) -> None:
+    """A batched device solve failed and the cycle completed through the
+    sequential per-task placer instead."""
+    with _lock:
+        _counters[("solver_fallback", action)] += 1
+    if _HAVE_PROM:
+        _solver_fb.labels(action=action).inc()
+
+
+def register_dead_letter(op: str) -> None:
+    """A failed side effect exhausted its resync retry budget and was
+    parked in the cache's dead-letter set."""
+    with _lock:
+        _counters[("resync_dead_letter", op)] += 1
+    if _HAVE_PROM:
+        _dead_letter.labels(op=op).inc()
+
+
 def start_metrics_server(port: int = 8080, host: str = ""):
     """Serve /metrics (Prometheus exposition) and /healthz — the
     --listen-address endpoint of cmd/scheduler/app (options.go:32,94).
+    /healthz answers 200 "ok" while the shell is healthy and 503
+    "degraded (N consecutive failed cycles)" once the crash-loop guard
+    trips, so a liveness probe can distinguish slow from crash-looping.
     Returns the http.server instance (daemon thread)."""
     import http.server
     import threading
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
+            status = 200
             if self.path.startswith("/healthz"):
-                body = b"ok"
+                state, fails = health()
+                if state == HEALTHY:
+                    body = b"ok"
+                else:
+                    status = 503
+                    body = (f"degraded ({fails} consecutive failed "
+                            f"cycles)").encode()
                 ctype = "text/plain"
             elif self.path.startswith("/metrics"):
                 if _HAVE_PROM:
@@ -91,7 +160,7 @@ def start_metrics_server(port: int = 8080, host: str = ""):
                 self.send_response(404)
                 self.end_headers()
                 return
-            self.send_response(200)
+            self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
@@ -212,8 +281,15 @@ def local_durations() -> Dict[Tuple[str, ...], list]:
         return {k: list(v) for k, v in _durations.items()}
 
 
+def local_counters() -> Dict[Tuple[str, ...], float]:
+    with _lock:
+        return dict(_counters)
+
+
 def reset_local() -> None:
     with _lock:
         _durations.clear()
         _gauges.clear()
         _counters.clear()
+        _health["state"] = HEALTHY
+        _health["consecutive_failures"] = 0

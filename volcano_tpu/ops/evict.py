@@ -439,15 +439,21 @@ def build_preempt_walk(tier_kinds: Tuple[str, ...],
                 elig0, dyn_dec0, _ = _tier_eval(
                     tier_kinds, c.cur_masks, cand, dynamic_full)
                 if has_drf:
-                    # the drf tier's candidate set after its static
+                    # the dynamic tiers' candidate set after their static
                     # co-masks, BEFORE the share verdict — the refresh
-                    # re-intersects it with the current-share keep rule
+                    # re-intersects it with the current-share keep rule.
+                    # ACCUMULATE across dynamic tiers: with two of them
+                    # (each carrying static co-plugins) overwriting would
+                    # keep only the last tier's co-masks and let the fill
+                    # loop probe nodes whose extra "eligible" victims the
+                    # exact row dispatch then rejects — a k=0 dead end
+                    # where the serial walk would have moved on
                     drf_pre0 = cand
                     for kind, (m_nw, part) in zip(tier_kinds,
                                                   c.cur_masks):
                         if kind != "static" and m_nw.shape[0]:
                             pm = m_nw | ~part[:, None, None]
-                            drf_pre0 = cand & jnp.all(pm, axis=0)
+                            drf_pre0 = drf_pre0 & jnp.all(pm, axis=0)
 
                 # ---- inner fill loop: serial node fills over the run ---
                 # During a same-request run every per-node verdict set
@@ -770,8 +776,9 @@ def build_preempt_walk_sharded(mesh, tier_kinds: Tuple[str, ...],
                 repl, repl, repl, repl, repl, repl, repl,
                 P(None, axis), repl, repl, repl)
     out_specs = (repl, node, repl, repl)
-    wrapped = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                                    out_specs=out_specs, check_vma=False))
+    from ..parallel.mesh import shard_map_compat
+    wrapped = jax.jit(shard_map_compat(fn, mesh=mesh, in_specs=in_specs,
+                                       out_specs=out_specs))
     _SHARDED_WALK_CACHE[key] = wrapped
     return wrapped
 

@@ -42,6 +42,36 @@ def make_mesh(devices=None, axis: str = NODE_AXIS) -> Mesh:
     return Mesh(np.asarray(devices), (axis,))
 
 
+def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+    """shard_map across jax releases: ``jax.shard_map(..., check_vma=)`` on
+    new jax, ``jax.experimental.shard_map.shard_map(..., check_rep=)``
+    before the promotion. Without this shim the whole multi-chip engine
+    family dies with an AttributeError on one side of the move — a
+    toolchain-version fault, not a scheduling fault, so it is absorbed
+    here instead of crashing the cycle (docs/robustness.md)."""
+    import inspect
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    # the replication/VMA check must stay OFF (the solvers' out_specs are
+    # not provably replicated), under whichever keyword this jax spells
+    # it. Probe the signature rather than catching TypeError — a genuine
+    # TypeError from shard_map's own argument validation must surface as
+    # itself, not as a bogus incompatibility retry.
+    params = inspect.signature(sm).parameters
+    if "check_vma" in params:
+        kw = {"check_vma": False}
+    elif "check_rep" in params:
+        kw = {"check_rep": False}
+    else:
+        raise TypeError(
+            "installed jax's shard_map accepts neither check_vma nor "
+            "check_rep; cannot disable the replication check the sharded "
+            "solvers require")
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
 def _sharded_chunk_step(axis: str, has_ms: bool):
     """One chunk over node-sharded state. Runs inside shard_map: all array
     args are the per-device shards.
@@ -204,9 +234,8 @@ def _sharded_solver(mesh: Mesh, chunk: int, sweeps: int, passes: int,
     if has_ms:
         in_specs.append(P(None, NODE_AXIS))
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=tuple(in_specs),
-             out_specs=(repl, NodeState(*(node_sharded,) * 4)),
-             check_vma=False)
+    @partial(shard_map_compat, mesh=mesh, in_specs=tuple(in_specs),
+             out_specs=(repl, NodeState(*(node_sharded,) * 4)))
     def solve(nodes, allocatable, max_tasks, req, valid, job_ix, jobs,
               weights, *maybe_ms):
         Tp = req.shape[0]

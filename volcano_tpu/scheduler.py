@@ -4,36 +4,71 @@ Mirrors /root/reference/pkg/scheduler/scheduler.go:39-170 — 1s-period
 runOnce over the configured action pipeline, YAML conf hot-reload (mtime
 watch replacing the fsnotify filewatcher, pkg/filewatcher), per-action
 latency metrics (scheduler.go:104-108).
+
+Fault isolation (docs/robustness.md): one raised exception anywhere in an
+action must not abort the cycle or kill the run() thread. run_once
+isolates each action — a failing action is logged, counted
+(metrics.register_action_failure) and skipped while the session still
+closes and later actions still run — and run() wraps the whole cycle in a
+crash-loop guard: consecutive failed cycles back off exponentially with
+jitter and flip the exported health state to "degraded" (the /healthz
+endpoint of metrics.start_metrics_server answers 503 until a clean cycle
+resets it).
 """
 
 from __future__ import annotations
 
+import logging
 import os
+import random
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional, Tuple
 
 from . import metrics
 from .framework import (close_session, get_action, open_session,
                         parse_scheduler_conf)
 from .framework.conf import SchedulerConfiguration
 
+log = logging.getLogger(__name__)
+
 DEFAULT_SCHEDULE_PERIOD = 1.0
+
+# crash-loop guard defaults: first failed cycle waits backoff_base, each
+# consecutive failure doubles it up to backoff_max, each wait is stretched
+# by up to backoff_jitter (uniform) so a fleet of replicas crash-looping on
+# the same poison input doesn't retry in lockstep.
+DEFAULT_BACKOFF_BASE = 1.0
+DEFAULT_BACKOFF_MAX = 60.0
+DEFAULT_BACKOFF_JITTER = 0.2
 
 
 class Scheduler:
     def __init__(self, cache, conf_text: Optional[str] = None,
                  conf_path: Optional[str] = None,
-                 schedule_period: float = DEFAULT_SCHEDULE_PERIOD):
+                 schedule_period: float = DEFAULT_SCHEDULE_PERIOD,
+                 backoff_base: float = DEFAULT_BACKOFF_BASE,
+                 backoff_max: float = DEFAULT_BACKOFF_MAX,
+                 backoff_jitter: float = DEFAULT_BACKOFF_JITTER):
         # actions/plugins register on import
         from . import actions as _actions  # noqa: F401
         from . import plugins as _plugins  # noqa: F401
         self.cache = cache
         self.conf_path = conf_path
         self.schedule_period = schedule_period
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.backoff_jitter = backoff_jitter
         self._conf_mtime: Optional[float] = None
         self._stop = threading.Event()
         self.conf: SchedulerConfiguration = None
+        # pre-action hook (name, session) -> None; raising makes the action
+        # count as failed. The chaos harness's ActionFaultInjector plugs in
+        # here (volcano_tpu.chaos) — tests and soak rigs inject action
+        # faults without reaching into the global action registry.
+        self.action_fault_hook: Optional[Callable] = None
+        # crash-loop guard state, exported through metrics.set_health
+        self.consecutive_failures = 0
         self._load_conf(conf_text)
 
     def _load_conf(self, conf_text: Optional[str] = None) -> None:
@@ -51,13 +86,26 @@ class Scheduler:
         if mtime != self._conf_mtime:
             self._load_conf()
 
-    def run_once(self) -> None:
-        """One scheduling cycle (scheduler.go:90-110)."""
+    def run_once(self) -> List[Tuple[str, BaseException]]:
+        """One scheduling cycle (scheduler.go:90-110).
+
+        Returns the isolated per-action failures of the cycle, [] when
+        clean. A failing action is skipped — the session still closes and
+        the remaining pipeline still runs; only a failure OUTSIDE the
+        action loop (conf reload, snapshot/open_session, close_session)
+        propagates to the caller, where run()'s guard catches it."""
         self._maybe_reload_conf()
         # retry failed side effects whose backoff expired (the reference's
-        # errTasks worker goroutine, cache.go:777-799)
+        # errTasks worker goroutine, cache.go:777-799). Isolated like an
+        # action: a cache retry fault must not cost the scheduling cycle.
+        errors: List[Tuple[str, BaseException]] = []
         if hasattr(self.cache, "process_resync_tasks"):
-            self.cache.process_resync_tasks()
+            try:
+                self.cache.process_resync_tasks()
+            except Exception as exc:
+                log.exception("resync processing failed")
+                metrics.register_action_failure("resync")
+                errors.append(("resync", exc))
         start = time.perf_counter()
         ssn = open_session(self.cache, self.conf.tiers,
                            self.conf.configurations)
@@ -67,18 +115,71 @@ class Scheduler:
                 if action is None:
                     continue
                 action_start = time.perf_counter()
-                action.execute(ssn)
-                metrics.update_action_duration(
-                    name, time.perf_counter() - action_start)
+                try:
+                    if self.action_fault_hook is not None:
+                        self.action_fault_hook(name, ssn)
+                    action.execute(ssn)
+                except Exception as exc:
+                    log.exception("action %s failed; skipping it this cycle",
+                                  name)
+                    metrics.register_action_failure(name)
+                    errors.append((name, exc))
+                    if getattr(exc, "poisons_session", False):
+                        # the action mutated session state outside any
+                        # undo log (allocate.ReplayFault): later actions
+                        # would schedule against phantom aggregates —
+                        # abort the rest of the cycle, keep the loop alive
+                        log.error("action %s poisoned the session; "
+                                  "aborting the remaining actions this "
+                                  "cycle", name)
+                        break
+                finally:
+                    metrics.update_action_duration(
+                        name, time.perf_counter() - action_start)
         finally:
             close_session(ssn)
         metrics.update_e2e_duration(time.perf_counter() - start)
+        return errors
+
+    def _backoff(self, cap: float) -> float:
+        """Exponential backoff with jitter for the current consecutive
+        failure count (>= 1), capped at ``cap``."""
+        n = max(self.consecutive_failures, 1)
+        delay = min(self.backoff_base * (2 ** (n - 1)), cap)
+        return delay * (1.0 + random.uniform(0.0, self.backoff_jitter))
 
     def run(self) -> None:
-        """wait.Until(runOnce, period) (scheduler.go:81-88)."""
+        """wait.Until(runOnce, period) (scheduler.go:81-88), with the
+        crash-loop guard: a failed cycle increments the consecutive
+        failure count, flips health to degraded and waits a jittered
+        exponential backoff instead of the schedule period; a clean cycle
+        resets both. The backoff cap depends on the blast radius: an
+        exception ESCAPING run_once (snapshot/session machinery — nothing
+        scheduled) backs off up to backoff_max, while isolated per-action
+        faults (the rest of the pipeline ran fine) cap near the schedule
+        period — one chronically failing action must not throttle healthy
+        actions and the resync retries to crash-loop cadence."""
         while not self._stop.is_set():
             cycle_start = time.perf_counter()
-            self.run_once()
+            cycle_fault = False
+            try:
+                errors = self.run_once()
+            except Exception as exc:
+                log.exception("scheduling cycle failed outside the action "
+                              "pipeline")
+                errors = [("cycle", exc)]
+                cycle_fault = True
+            if errors:
+                self.consecutive_failures += 1
+                metrics.set_health(metrics.DEGRADED,
+                                   self.consecutive_failures)
+                cap = self.backoff_max if cycle_fault else \
+                    max(self.schedule_period, self.backoff_base)
+                self._stop.wait(self._backoff(cap))
+                continue
+            if self.consecutive_failures:
+                self.consecutive_failures = 0
+            metrics.set_health(metrics.HEALTHY, 0)
             remaining = self.schedule_period - (time.perf_counter() - cycle_start)
             if remaining > 0:
                 self._stop.wait(remaining)

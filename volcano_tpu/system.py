@@ -47,10 +47,16 @@ class VolcanoSystem:
         self.jobs = JobCommands(self.store)
         self.queues = QueueCommands(self.store)
 
-    def schedule_once(self) -> None:
+    def schedule_once(self):
+        """One drained scheduling cycle. Returns the cycle's isolated
+        per-action failures ([] when clean) — a misconfigured action (say
+        an unknown allocate engine) no longer raises out of run_once, so
+        programmatic callers must check the returned list (the shell's
+        run() loop does the equivalent via its crash-loop guard)."""
         self._drain_controllers()
-        self.scheduler.run_once()
+        errors = self.scheduler.run_once()
         self._drain_controllers()
+        return errors
 
     def _drain_controllers(self) -> None:
         """Coalesced controller work (the workqueue worker analogue): jobs
