@@ -25,9 +25,16 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
   assembly, OnSessionOpen) + allocate + close_session — the reference's
   e2e_scheduling_latency_milliseconds definition (metrics.go:38-45; the
   scheduler shell publishes the same metric per cycle).
+- pipeline_e2e: the FULL configured pipeline — enqueue, allocate-tpu,
+  preempt, reclaim, backfill (the chart's scheduler.conf chain) — as ONE
+  shell session at 10k/2k with half the gangs pre-placed running, with
+  the per-action breakdown (the r5 verdict's "never measured as one
+  session" gap; reported even when it exceeds the 1 s period).
 - churn: 6 consecutive shell cycles with gang completions/arrivals between
-  them; churn_steady_ok asserts zero XLA recompiles once the arrival
-  shape bucket is warm (the 1 s wait.Until steady state, scheduler.go:87).
+  them, shape buckets precompiled via Scheduler.prewarm (no cold-bucket
+  stall in the loop — asserted: no post-warmup cycle over 2x the median);
+  churn_steady_ok asserts zero XLA recompiles once the arrival shape
+  bucket is warm (the 1 s wait.Until steady state, scheduler.go:87).
 - alloc_20k: the long-axis 20k pods / 5k nodes config, fused + sharded.
 """
 
@@ -35,6 +42,17 @@ from __future__ import annotations
 
 import json
 import time
+
+
+def _assert_no_fallback(context: str) -> None:
+    """A silently degraded solve would compare callbacks against callbacks
+    and report fake parity/speedup — every engine-timed stage fails loudly
+    instead (one definition; LAST_FALLBACK is the introspection contract
+    of actions/allocate)."""
+    from volcano_tpu.actions import allocate as alloc_mod
+    assert not alloc_mod.LAST_FALLBACK, (
+        f"{context} degraded to the sequential fallback: "
+        f"{alloc_mod.LAST_FALLBACK}")
 
 
 def run_cycle(config: str, engine: str, seed: int = 0):
@@ -54,12 +72,7 @@ def run_cycle(config: str, engine: str, seed: int = 0):
     action.execute(ssn)
     elapsed = time.perf_counter() - start
     close_session(ssn)
-    from volcano_tpu.actions import allocate as alloc_mod
-    # a silently degraded solve would compare callbacks against callbacks
-    # and report fake parity/speedup — fail loudly instead
-    assert not alloc_mod.LAST_FALLBACK, (
-        f"engine {engine} degraded to the sequential fallback mid-bench: "
-        f"{alloc_mod.LAST_FALLBACK}")
+    _assert_no_fallback(f"engine {engine}")
     admitted = frozenset(k.rsplit("-", 1)[0] for k in binder.binds)
     return elapsed, admitted, len(binder.binds)
 
@@ -115,10 +128,7 @@ def run_cycle_e2e(config: str, engine: str, seed: int = 0):
     t2 = time.perf_counter()
     close_session(ssn)
     t3 = time.perf_counter()
-    from volcano_tpu.actions import allocate as alloc_mod
-    assert not alloc_mod.LAST_FALLBACK, (
-        f"engine {engine} degraded to the sequential fallback mid-bench: "
-        f"{alloc_mod.LAST_FALLBACK}")
+    _assert_no_fallback(f"engine {engine}")
     return t3 - t0, t1 - t0, t2 - t1, t3 - t2
 
 
@@ -178,12 +188,21 @@ def compile_canary() -> int:
     return cc.count
 
 
-def run_churn(n_cycles: int = 6, churn_jobs: int = 5, seed: int = 0):
+def run_churn(n_cycles: int = 6, churn_jobs: int = 5, seed: int = 0,
+              prewarm: bool = True):
     """Steady-state churn: the scheduler SHELL's cycle (scheduler.go:87
     wait.Until loop) run ``n_cycles`` times over the 10k/2k cluster with
     synthetic completions + arrivals between cycles (churn_jobs full gangs
     finish, the same number of fresh gangs arrive — constant shape buckets).
-    Returns (per_cycle_seconds, compiles_per_cycle, binds_total)."""
+
+    With ``prewarm`` (the default), Scheduler.prewarm compiles BOTH shape
+    buckets the loop will hit — the initial 10k-pending solve and the
+    churn arrival batch — before cycle 0, so the 6.5 s cold-bucket stall
+    the r5 verdict flagged (churn cycle 2: 8 compiles) moves out of the
+    steady-state loop; main() asserts no post-warmup cycle exceeds 2x the
+    median. Returns (per_cycle_seconds, compiles_per_cycle, binds_total,
+    prewarm_seconds, prewarm_compiles)."""
+    from volcano_tpu.api import TaskStatus
     from volcano_tpu.cache.synthetic import baseline_config
     from volcano_tpu.scheduler import Scheduler
     import volcano_tpu.plugins  # noqa: F401
@@ -204,14 +223,29 @@ def run_churn(n_cycles: int = 6, churn_jobs: int = 5, seed: int = 0):
         "- name: allocate-tpu\n"
         "  arguments:\n"
         "    engine: tpu-fused\n")
-    from volcano_tpu.actions import allocate as alloc_mod
 
     cache, binder, _ = baseline_config("10k", seed=seed)
     sched = Scheduler(cache, conf_text=conf_text)
     times = []
     compiles = []
+    prewarm_s = 0.0
+    prewarm_compiles = 0
     arrival_seed = seed + 1000
     with _CompileCounter() as cc:
+        if prewarm:
+            # the two cycle shapes of this rig: the full initial backlog
+            # (derived from the live cache) and the churn arrival batch
+            pend = sum(
+                1 for j in cache.jobs.values()
+                for t in j.task_status_index.get(TaskStatus.PENDING,
+                                                 {}).values()
+                if not t.resreq.is_empty())
+            jobs = sum(1 for j in cache.jobs.values()
+                       if j.task_status_index.get(TaskStatus.PENDING))
+            t0 = time.perf_counter()
+            sched.prewarm([(pend, jobs), (churn_jobs * 50, churn_jobs)])
+            prewarm_s = time.perf_counter() - t0
+            prewarm_compiles = cc.count
         for cyc in range(n_cycles):
             seen = cc.count
             t0 = time.perf_counter()
@@ -223,11 +257,9 @@ def run_churn(n_cycles: int = 6, churn_jobs: int = 5, seed: int = 0):
             # numbers (and the zero-recompile gate) measure the wrong
             # thing silently
             assert not errs, f"churn cycle {cyc} had action faults: {errs}"
-            assert not alloc_mod.LAST_FALLBACK, (
-                f"churn cycle {cyc} degraded to the sequential fallback: "
-                f"{alloc_mod.LAST_FALLBACK}")
+            _assert_no_fallback(f"churn cycle {cyc}")
             _churn_step(cache, cyc, churn_jobs, arrival_seed + cyc)
-    return times, compiles, len(binder.binds)
+    return times, compiles, len(binder.binds), prewarm_s, prewarm_compiles
 
 
 def _churn_step(cache, cyc: int, churn_jobs: int, arrival_seed: int) -> None:
@@ -245,6 +277,83 @@ def _churn_step(cache, cyc: int, churn_jobs: int, arrival_seed: int) -> None:
                       seed=arrival_seed, name_prefix=f"churn{cyc}-")
     for j in fresh:
         cache.add_job(j)
+
+
+PIPELINE_CONF = (
+    'actions: "enqueue, allocate-tpu, preempt, reclaim, backfill"\n'
+    "tiers:\n"
+    "- plugins:\n"
+    "  - name: priority\n"
+    "  - name: gang\n"
+    "- plugins:\n"
+    "  - name: drf\n"
+    "  - name: predicates\n"
+    "  - name: proportion\n"
+    "  - name: nodeorder\n"
+    'configurations:\n'
+    "- name: allocate-tpu\n"
+    "  arguments:\n"
+    "    engine: tpu-fused\n"
+    "- name: preempt\n"
+    "  arguments:\n"
+    "    engine: tpu\n"
+    "- name: reclaim\n"
+    "  arguments:\n"
+    "    engine: tpu\n")
+
+
+def _pipeline_world(seed: int = 0):
+    """10k pods / 2k nodes with half the gangs pre-placed RUNNING — the
+    headline scale carrying work for every action in the chart pipeline
+    (a fully-pending world would make preempt/reclaim no-ops)."""
+    from volcano_tpu.api import QueueInfo
+    from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+    from volcano_tpu.cache.synthetic import make_cluster, make_jobs
+
+    binder, evictor = FakeBinder(), FakeEvictor()
+    cache = SchedulerCache(binder=binder, evictor=evictor)
+    nodes = make_cluster(2000, seed=seed)
+    jobs = make_jobs(10000, 200, ["q1", "q2", "q3"], running_fraction=0.5,
+                     nodes=nodes, seed=seed)
+    for q in (QueueInfo(name="q1", weight=3), QueueInfo(name="q2", weight=2),
+              QueueInfo(name="q3", weight=1)):
+        cache.add_queue(q)
+    for n in nodes:
+        cache.add_node(n)
+    for j in jobs:
+        cache.add_job(j)
+    return cache, binder, evictor
+
+
+def run_pipeline_e2e(seed: int = 0):
+    """ONE shell session running the FULL configured pipeline — enqueue,
+    allocate-tpu, preempt, reclaim, backfill, the chart's scheduler.conf
+    action chain — at 10k/2k, timed end to end through Scheduler.run_once
+    (the r5 verdict's explicit gap: the per-action numbers had never been
+    measured as one session). A warm-up run on an identical throwaway
+    world pays every engine's compile first, so the measured session is
+    the steady-state cycle. Returns (e2e_seconds, per_action_ms dict,
+    binds, evicts)."""
+    from volcano_tpu import metrics as vmetrics
+    from volcano_tpu.scheduler import Scheduler
+
+    warm_cache, _, _ = _pipeline_world(seed)
+    warm_errs = Scheduler(warm_cache, conf_text=PIPELINE_CONF).run_once()
+    assert not warm_errs, f"pipeline warm-up cycle had faults: {warm_errs}"
+
+    cache, binder, evictor = _pipeline_world(seed)
+    sched = Scheduler(cache, conf_text=PIPELINE_CONF)
+    mark = vmetrics.durations_mark()
+    start = time.perf_counter()
+    errs = sched.run_once()
+    e2e = time.perf_counter() - start
+    assert not errs, f"pipeline cycle had action faults: {errs}"
+    _assert_no_fallback("pipeline cycle")
+    actions_ms = {
+        key[1]: round(vals[-1] / 1e3, 1)
+        for key, vals in vmetrics.durations_since(mark).items()
+        if len(key) == 2 and key[0] == "action" and vals}
+    return e2e, actions_ms, len(binder.binds), len(evictor.evicts)
 
 
 def gpu_capacity_truth(config: str, seed: int = 0):
@@ -407,12 +516,40 @@ def main():
         "pxla); churn_steady_ok would be vacuously true")
     extras.update(compile_canary=canary)
 
+    # the FULL configured pipeline as ONE session (VERDICT r5: "never
+    # measured end-to-end"): enqueue + allocate-tpu + preempt + reclaim +
+    # backfill at 10k/2k with half the gangs pre-placed running. Reported
+    # even when it exceeds the 1 s period — not gated yet.
+    pipe_e2e, pipe_actions, pipe_binds, pipe_evicts = run_pipeline_e2e()
+    extras.update(pipeline_e2e_ms=round(pipe_e2e * 1e3, 1),
+                  pipeline_actions_ms=pipe_actions,
+                  pipeline_binds=pipe_binds,
+                  pipeline_evicts=pipe_evicts)
+
     # steady-state churn (VERDICT r5 #4): 6 consecutive shell cycles at
-    # 10k/2k with 5 gangs completing + 5 arriving between cycles; after
-    # the arrival bucket warms (cycle 2) NO per-cycle recompilation
-    churn_times, churn_compiles, _ = run_churn(6, 5)
+    # 10k/2k with 5 gangs completing + 5 arriving between cycles, the
+    # shape buckets prewarmed (Scheduler.prewarm) so no cycle pays a
+    # cold-bucket XLA compile; after the arrival bucket warms (cycle 2)
+    # NO per-cycle recompilation
+    churn_times, churn_compiles, _, churn_prewarm_s, churn_prewarm_c = \
+        run_churn(6, 5)
+    # the compile counter must have OBSERVED the cold compiles prewarm
+    # moved out of the loop — all-zero churn_compiles with a deaf counter
+    # would read as "steady" (ADVICE r5: assert the counter is wired)
+    assert churn_prewarm_c > 0, (
+        "prewarm observed zero compiles: either the shape buckets were "
+        "already warm (prewarm measured nothing) or _CompileCounter went "
+        "deaf — churn_steady_ok would be vacuous")
+    med = sorted(churn_times)[len(churn_times) // 2]
+    assert max(churn_times[1:]) <= 2 * med, (
+        f"post-warmup churn cycle exceeded 2x the median "
+        f"({[round(t * 1e3, 1) for t in churn_times]} ms, median "
+        f"{med * 1e3:.1f} ms): a cold shape bucket is back inside the "
+        f"steady-state loop")
     extras.update(churn_cycle_ms=[round(t * 1e3, 1) for t in churn_times],
                   churn_compiles=churn_compiles,
+                  churn_prewarm_ms=round(churn_prewarm_s * 1e3, 1),
+                  churn_prewarm_compiles=churn_prewarm_c,
                   churn_steady_ok=all(c == 0 for c in churn_compiles[2:]))
 
     # long-axis scale (VERDICT r5 #5): 20k pods / 5k nodes, fused +

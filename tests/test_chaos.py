@@ -15,7 +15,8 @@ import pytest
 from volcano_tpu import metrics
 from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
                              Resource, TaskInfo, TaskStatus)
-from volcano_tpu.cache import FakeBinder, FakeEvictor, SchedulerCache
+from volcano_tpu.cache import (FakeBinder, FakeEvictor, SchedulerCache,
+                               SequenceBinder)
 from volcano_tpu.chaos import (ActionFaultInjector, ChaosBinder, ChaosError,
                                ChaosEvictor)
 from volcano_tpu.scheduler import Scheduler
@@ -26,18 +27,15 @@ SEED = 20260803
 pytestmark = pytest.mark.chaos
 
 
-class CountingBinder(FakeBinder):
-    """Records EVERY successful bind call (not just the last per key), so
-    a double-bind is visible even when the dict would mask it."""
+class CountingBinder(SequenceBinder):
+    """Records EVERY successful bind call in order (not just the last per
+    key), so a double-bind is visible even when the dict would mask it —
+    the shared SequenceBinder recorder; ``calls`` aliases its sequence
+    ((task uid, node) pairs; uid == ns-less key in these worlds)."""
 
-    def __init__(self):
-        super().__init__()
-        self.calls = []
-
-    def bind(self, task, hostname):
-        with self._lock:
-            self.calls.append((task.key(), hostname))
-        super().bind(task, hostname)
+    @property
+    def calls(self):
+        return self.sequence
 
 
 class CountingEvictor(FakeEvictor):

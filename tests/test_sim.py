@@ -1,0 +1,378 @@
+"""Trace-driven simulation subsystem (volcano_tpu/sim; docs/simulation.md).
+
+The load-bearing contract here is DETERMINISM: the same trace + seed +
+conf must reproduce the bind sequence, the JCTs and the decision-plane
+report JSON byte-for-byte — that is what makes the sim a regression
+harness rather than a demo. Chaos tests compose the seeded fault
+injectors (volcano_tpu.chaos) with the sim's virtual-time resync queue.
+"""
+
+import json
+import logging
+
+import pytest
+
+from volcano_tpu.chaos import ChaosBinder
+from volcano_tpu.sim import (SimRunner, TraceEvent, VirtualClock,
+                             baseline_trace, deterministic_json, load_trace,
+                             make_scenario, synthetic_trace, write_trace)
+
+pytestmark = pytest.mark.sim
+
+SEED = 20260803
+
+
+# -- trace schema ----------------------------------------------------------
+
+def test_trace_roundtrip(tmp_path):
+    """write -> load reproduces the trace exactly, and the re-serialized
+    bytes are identical (the replay contract's precondition)."""
+    trace = synthetic_trace(40, 6, seed=SEED, arrival_rate=3.0)
+    path = tmp_path / "t.jsonl"
+    assert write_trace(path, trace) == len(trace)
+    loaded = load_trace(path)
+    assert loaded == trace
+    assert [ev.to_line() for ev in loaded] == [ev.to_line() for ev in trace]
+
+
+def test_trace_validation_rejects_garbage(tmp_path):
+    with pytest.raises(ValueError, match="unknown trace event kind"):
+        TraceEvent(0.0, "job_arrivel", {})
+    with pytest.raises(ValueError, match="payload mismatch"):
+        TraceEvent(0.0, "node_add", {"name": "n0"})
+    # referential integrity: arrival into an undeclared queue
+    bad = [TraceEvent(0.0, "node_add", {"name": "n0", "cpu_milli": 1000,
+                                        "mem": 1 << 30, "pods": 10,
+                                        "gpus": 0}),
+           TraceEvent(1.0, "job_arrival", {
+               "name": "j0", "queue": "nope", "priority": 0, "tasks": 1,
+               "min_available": 1, "cpu_milli": 100, "mem": 1 << 20,
+               "gpus": 0, "duration": 1.0})]
+    path = tmp_path / "bad.jsonl"
+    with open(path, "w") as f:
+        for ev in bad:
+            f.write(ev.to_line() + "\n")
+    with pytest.raises(ValueError, match="unknown queue"):
+        load_trace(path)
+
+
+def test_generator_deterministic():
+    a = synthetic_trace(100, 8, seed=7)
+    b = synthetic_trace(100, 8, seed=7)
+    c = synthetic_trace(100, 8, seed=8)
+    assert a == b
+    assert a != c, "distinct seeds produced identical traces"
+
+
+# -- replay determinism ----------------------------------------------------
+
+def test_sim_deterministic_replay(tmp_path):
+    """Same trace + seed => identical bind sequence, JCTs and
+    byte-identical decision-plane report JSON — including a pass through
+    the JSONL file format."""
+    trace = make_scenario("smoke", seed=SEED)
+    path = tmp_path / "smoke.jsonl"
+    write_trace(path, trace)
+
+    r1 = SimRunner(trace, seed=SEED, scenario="smoke")
+    rep1 = r1.run()
+    r2 = SimRunner(load_trace(path), seed=SEED, scenario="smoke")
+    rep2 = r2.run()
+
+    assert r1.binder.sequence == r2.binder.sequence, \
+        f"seed={SEED}: bind sequences diverged"
+    assert r1.evictor.sequence == r2.evictor.sequence
+    assert r1.jct == r2.jct, f"seed={SEED}: JCTs diverged"
+    assert deterministic_json(rep1) == deterministic_json(rep2), \
+        f"seed={SEED}: decision-plane report JSON not byte-identical"
+    # the run did real work and finished it
+    assert rep1["jobs"]["arrived"] == 60
+    assert rep1["jobs"]["completed"] == 60
+    assert rep1["jobs"]["unfinished"] == 0
+    assert rep1["binds"] >= 60
+    # the report carries the first-class metric set
+    for key in ("jct_s", "queueing_delay_s", "gang_admission_s"):
+        assert {"p50", "p95", "p99", "mean", "max"} <= set(rep1[key])
+    assert rep1["utilization"]["cpu_mean"] > 0
+    assert "drf_gap_mean" in rep1["fairness"]
+    assert "pipeline_e2e_ms" in rep1["wallclock"]
+    assert rep1["wallclock"]["pipeline_e2e_ms"]["p50"] > 0
+
+
+def test_sim_deterministic_with_tpu_engine():
+    """The sim drives the device engines too: a small trace through
+    allocate-tpu (fused solver) replays deterministically."""
+    conf = (
+        'actions: "enqueue, allocate-tpu, backfill"\n'
+        "tiers:\n"
+        "- plugins:\n"
+        "  - name: priority\n"
+        "  - name: gang\n"
+        "- plugins:\n"
+        "  - name: drf\n"
+        "  - name: predicates\n"
+        "  - name: proportion\n"
+        "  - name: nodeorder\n")
+    trace = synthetic_trace(6, 4, seed=SEED, arrival_rate=3.0,
+                            duration_mean=2.0, duration_cap=6.0,
+                            gang_sizes=((1, 0.6), (2, 0.4)))
+    rep1 = SimRunner(trace, conf_text=conf, seed=SEED).run()
+    rep2 = SimRunner(trace, conf_text=conf, seed=SEED).run()
+    assert deterministic_json(rep1) == deterministic_json(rep2)
+    assert rep1["jobs"]["completed"] == 6
+    assert rep1["action_failures"] == 0, \
+        "device engine raised inside the sim pipeline"
+
+
+# -- chaos composition -----------------------------------------------------
+
+@pytest.mark.chaos
+def test_sim_chaos_bind_faults_converge():
+    """20% seeded bind faults over >= 50 virtual cycles: every gang still
+    admits and completes through the (virtual-time) resync queue, each
+    task binds exactly once, and the drained cluster's accounting is
+    exact."""
+    trace = synthetic_trace(80, 8, seed=SEED, arrival_rate=1.6,
+                            duration_mean=5.0, duration_cap=20.0)
+    runner = SimRunner(trace, seed=SEED,
+                       binder_wrap=lambda b: ChaosBinder(
+                           b, failure_rate=0.2, seed=SEED))
+    report = runner.run()
+
+    chaos = runner.cache.binder
+    assert chaos.failures > 0, \
+        f"seed={SEED}: chaos injected no failures — rig broken"
+    assert report["cycles"] >= 50, \
+        f"seed={SEED}: only {report['cycles']} virtual cycles"
+    assert report["jobs"]["completed"] == 80, \
+        f"seed={SEED}: {report['jobs']} (lost gangs under bind faults)"
+    assert report["dead_letter"] == 0, \
+        f"seed={SEED}: transient faults must not dead-letter"
+    # exactly-once: no task bound twice (no evictions in this world)
+    uids = [uid for uid, _ in runner.binder.sequence]
+    assert len(uids) == len(set(uids)), \
+        f"seed={SEED}: double-bind: " \
+        f"{sorted(u for u in uids if uids.count(u) > 1)}"
+    # the cluster drained: exact accounting on every node
+    for node in runner.cache.nodes.values():
+        assert not node.tasks, \
+            f"seed={SEED}: node {node.name} still carries tasks"
+        assert node.used.is_empty(), \
+            f"seed={SEED}: node {node.name} used drifted: <{node.used}>"
+        assert node.idle == node.allocatable, \
+            f"seed={SEED}: node {node.name} idle drifted: <{node.idle}>"
+
+
+@pytest.mark.chaos
+def test_sim_chaos_deterministic():
+    """Chaos replays too: retry backoff rides the VIRTUAL clock, so the
+    same chaos seed yields the identical fault pattern, bind sequence and
+    report."""
+    def run():
+        trace = synthetic_trace(30, 6, seed=SEED, arrival_rate=2.0,
+                                duration_mean=4.0, duration_cap=12.0)
+        runner = SimRunner(trace, seed=SEED,
+                           binder_wrap=lambda b: ChaosBinder(
+                               b, failure_rate=0.25, seed=SEED + 1))
+        rep = runner.run()
+        return runner.binder.sequence, deterministic_json(rep)
+
+    seq1, js1 = run()
+    seq2, js2 = run()
+    assert seq1 == seq2, f"seed={SEED}: chaos bind sequences diverged"
+    assert js1 == js2, f"seed={SEED}: chaos report JSON diverged"
+
+
+# -- node lifecycle --------------------------------------------------------
+
+def test_sim_node_drain_and_fail():
+    """A drained node stops receiving placements but its tasks finish; a
+    failed node's tasks re-queue and their gangs re-admit elsewhere —
+    everything still completes."""
+    events = [TraceEvent(10.0, "node_drain", {"name": "node-00000"}),
+              TraceEvent(12.0, "node_fail", {"name": "node-00001"}),
+              TraceEvent(30.0, "node_restore", {"name": "node-00000"})]
+    trace = synthetic_trace(50, 5, seed=SEED, arrival_rate=1.5,
+                            duration_mean=6.0, duration_cap=20.0,
+                            extra_events=events)
+    runner = SimRunner(trace, seed=SEED)
+    report = runner.run()
+    assert "node-00001" not in runner.cache.nodes, "failed node lingers"
+    assert report["jobs"]["completed"] == 50, report["jobs"]
+    assert report["requeues"] > 0, \
+        "node_fail lost no tasks — the event did nothing"
+    assert runner.cache.nodes["node-00000"].ready, "restore did not apply"
+    # requeued gangs admitted more times than jobs arrived
+    assert report["jobs"]["admitted"] >= report["jobs"]["arrived"]
+
+
+def test_sim_preemption_requeues_and_completes():
+    """A high-priority wave over a saturated queue preempts running
+    gangs; the preempted gangs re-admit after the wave and everything
+    completes (the bounded-preemption scenario shape)."""
+    trace = make_scenario("preempt-burst", seed=0)
+    runner = SimRunner(trace, seed=0, scenario="preempt-burst",
+                       max_cycles=3000)
+    report = runner.run()
+    assert report["evicts"] > 0, "the wave preempted nothing"
+    assert report["requeues"] == report["evicts"]
+    assert report["jobs"]["completed"] == report["jobs"]["arrived"], \
+        report["jobs"]
+
+
+# -- degenerate BASELINE worlds -------------------------------------------
+
+def test_baseline_degenerate_trace():
+    """BASELINE config 'tiny' as a trace: the one gang of 3 binds in the
+    first cycle and completes after its duration."""
+    trace = baseline_trace("tiny", seed=0, duration=3.0)
+    runner = SimRunner(trace, seed=0, scenario="baseline-tiny")
+    report = runner.run()
+    assert report["jobs"] == {"arrived": 1, "admitted": 1, "completed": 1,
+                              "unfinished": 0}
+    assert report["binds"] == 3
+    assert report["gang_admission_s"]["max"] == 0.0  # admitted at t=0
+    assert report["jct_s"]["max"] >= 3.0
+
+
+# -- scheduler shell hooks -------------------------------------------------
+
+def test_scheduler_virtual_clock_no_wall_sleep():
+    """Scheduler.run paces through the injected clock: with a virtual
+    clock, N one-second cycles advance N virtual seconds in wall
+    milliseconds."""
+    import time as walltime
+
+    from volcano_tpu.cache import SchedulerCache
+    from volcano_tpu.scheduler import Scheduler
+
+    class StoppingClock(VirtualClock):
+        def __init__(self, n):
+            super().__init__()
+            self.n = n
+            self.sched = None
+
+        def sleep(self, seconds):
+            super().sleep(seconds)
+            self.n -= 1
+            if self.n <= 0:
+                self.sched.stop()
+
+    clock = StoppingClock(5)
+    sched = Scheduler(SchedulerCache(), conf_text='actions: "enqueue"\n',
+                      schedule_period=1.0, clock=clock)
+    clock.sched = sched
+    t0 = walltime.perf_counter()
+    sched.run()                        # returns: the clock stops it
+    wall = walltime.perf_counter() - t0
+    assert clock.time() >= 4.0, "virtual clock did not advance per cycle"
+    assert wall < 2.0, f"virtual-clock run still slept {wall:.1f}s of wall"
+
+
+def test_prewarm_compiles_ahead_of_cycle():
+    """Scheduler.prewarm at the cycle's shape bucket: the cold XLA
+    compiles land in prewarm and the following cycle compiles nothing."""
+    import jax
+
+    from volcano_tpu.cache.synthetic import baseline_config
+    from volcano_tpu.scheduler import Scheduler
+
+    compiles = []
+
+    class Handler(logging.Handler):
+        def emit(self, record):
+            if "Compiling" in record.getMessage():
+                compiles.append(record.getMessage().split(" with")[0])
+
+    conf = ('actions: "allocate-tpu"\n'
+            "tiers:\n"
+            "- plugins:\n"
+            "  - name: priority\n"
+            "  - name: gang\n"
+            "- plugins:\n"
+            "  - name: drf\n"
+            "  - name: predicates\n"
+            "  - name: proportion\n"
+            "  - name: nodeorder\n")
+    cache, binder, _ = baseline_config("tiny")
+    sched = Scheduler(cache, conf_text=conf)
+    handler = Handler()
+    loggers = [logging.getLogger("jax._src.dispatch"),
+               logging.getLogger("jax._src.interpreters.pxla")]
+    jax.config.update("jax_log_compiles", True)
+    state = [(lg, lg.propagate) for lg in loggers]
+    for lg in loggers:
+        lg.addHandler(handler)
+        lg.propagate = False
+    try:
+        assert sched.prewarm([(3, 1)]) == 1
+        warm = len(compiles)
+        assert warm > 0, "prewarm compiled nothing (counter deaf or " \
+                         "shapes already warm)"
+        compiles.clear()
+        assert sched.run_once() == []
+        assert compiles == [], \
+            f"cycle still compiled after prewarm: {compiles}"
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        for lg, prop in state:
+            lg.removeHandler(handler)
+            lg.propagate = prop
+    assert len(binder.binds) == 3
+
+
+def test_prewarm_callbacks_engine_is_noop():
+    from volcano_tpu.cache.synthetic import baseline_config
+    from volcano_tpu.scheduler import Scheduler
+
+    cache, _, _ = baseline_config("tiny")
+    sched = Scheduler(cache)          # default conf: callbacks allocate
+    assert sched.prewarm([(3, 1)]) == 0
+
+
+def test_resync_queue_virtual_time():
+    """RateLimitedQueue honors an injected time source: nothing is ready
+    until the VIRTUAL clock passes the backoff deadline."""
+    from volcano_tpu.cache.cache import RateLimitedQueue
+
+    clock = VirtualClock()
+    q = RateLimitedQueue(base_delay=5.0, time_fn=clock.time)
+    assert q.add_rate_limited("k", "item")
+    assert q.pop_ready() == []        # wall time is irrelevant
+    clock.sleep(4.9)
+    assert q.pop_ready() == []
+    clock.sleep(0.2)
+    assert q.pop_ready() == [("k", "item")]
+
+
+# -- acceptance scale (slow) ----------------------------------------------
+
+@pytest.mark.slow
+def test_sim_10k_jobs_500_cycles_deterministic():
+    """The acceptance-criterion replay: >= 500 virtual cycles, >= 10k
+    gangs through the full configured allocate+preempt+reclaim pipeline,
+    run twice — byte-identical decision-plane report JSON."""
+    trace = make_scenario("steady-10k", seed=SEED)
+    arrivals = sum(1 for ev in trace if ev.kind == "job_arrival")
+    assert arrivals >= 10000
+
+    r1 = SimRunner(trace, seed=SEED, scenario="steady-10k")
+    rep1 = r1.run()
+    r2 = SimRunner(trace, seed=SEED, scenario="steady-10k")
+    rep2 = r2.run()
+
+    assert rep1["cycles"] >= 500, rep1["cycles"]
+    assert rep1["jobs"]["arrived"] >= 10000
+    assert rep1["jobs"]["completed"] == rep1["jobs"]["arrived"], rep1["jobs"]
+    assert {"enqueue", "allocate", "preempt", "reclaim", "backfill"} \
+        <= set(rep1["conf_actions"])
+    assert r1.binder.sequence == r2.binder.sequence
+    assert deterministic_json(rep1) == deterministic_json(rep2), \
+        f"seed={SEED}: 10k-job replay not byte-identical"
+    # report completeness at scale
+    assert rep1["jct_s"]["p99"] > 0
+    assert rep1["wallclock"]["pipeline_e2e_ms"]["p95"] > 0
+    assert rep1["utilization"]["cpu_mean"] > 0
+    # the deterministic part really is valid standalone JSON
+    parsed = json.loads(deterministic_json(rep1))
+    assert parsed["schema"] == "volcano-tpu-sim-report/v1"

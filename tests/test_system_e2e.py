@@ -1140,16 +1140,29 @@ class TestHelmChart:
         vols = {v.get("secret", {}).get("secretName")
                 for v in dep["spec"]["template"]["spec"]["volumes"]}
         assert secret_name in vols
-        # self-registration wiring: the shim gets the service identity and
-        # the CA path, and RBAC grants the admissionregistration verbs
+        # self-registration is OFF by default (the shim's Go has never
+        # been compiled here — values.yaml): no service-identity args;
+        # the webhook front itself (cert path) is still wired, and RBAC
+        # keeps the admissionregistration verbs for the opt-in
         shim = next(c for c in dep["spec"]["template"]["spec"]["containers"]
                     if c["name"] == "shim")
-        assert any(a.startswith("--webhook-service-name=")
-                   for a in shim["args"])
+        assert not any(a.startswith("--webhook-service-name=")
+                       for a in shim["args"]), \
+            "self_register defaulted on while shim Go is uncompiled"
         assert any(a.startswith("--ca-cert-file=") for a in shim["args"])
         role = next(d for d in docs if d["kind"] == "ClusterRole")
         groups = {g for r in role["rules"] for g in r["apiGroups"]}
         assert "admissionregistration.k8s.io" in groups
+
+    def test_self_register_opt_in(self):
+        docs = self._render_all({"admission.self_register": True})
+        dep = next(d for d in docs if d["kind"] == "Deployment")
+        shim = next(c for c in dep["spec"]["template"]["spec"]["containers"]
+                    if c["name"] == "shim")
+        assert any(a.startswith("--webhook-service-name=")
+                   for a in shim["args"])
+        assert any(a.startswith("--webhook-service-namespace=")
+                   for a in shim["args"])
 
     def test_toggles(self):
         docs = self._render_all({"custom.monitoring_enable": True,

@@ -849,6 +849,11 @@ class _FusedSolution:
 
 def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
                  sharded: bool = False):
+    # KEEP IN SYNC WITH prewarm_shapes (below): it mirrors this function's
+    # kernel selection, tensor dtypes/padding and sweeps/passes budgets so
+    # startup compiles hit the same jit cache keys as live cycles — a
+    # dispatch change here that skips prewarm_shapes resurfaces the
+    # cold-bucket stall (bench.py churn's 2x-median assert catches it).
     import jax.numpy as jnp
     from ..ops.place import JobMeta, NodeState, PlacementTasks
     from ..ops.auction import BlockTasks
@@ -1193,6 +1198,143 @@ def _fused_blocks_solver():
         _SOLVER_CACHE["blocks"] = jax.jit(
             place_blocks, static_argnames=("chunk", "sweeps", "passes"))
     return _SOLVER_CACHE["blocks"]
+
+
+def prewarm_shapes(ssn, shape_configs=None, engine: str = "tpu-fused") -> int:
+    """Compile the device solver at the given cycle shapes before the
+    scheduling loop needs them (Scheduler.prewarm). Each config is a
+    ``(tasks, jobs)`` pair; dummy zero-valued tensors with the session's
+    REAL node count, resource dimensionality and score weights are
+    dispatched through the same kernel-selection logic as _solve_fused —
+    shape and dtype (not values) key the XLA compile cache, so the later
+    live solve of the same bucket is a cache hit. Returns the number of
+    shapes warmed (0 for host engines / empty clusters)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.place import JobMeta, PlacementTasks
+
+    if engine.startswith("callbacks"):
+        return 0
+    nodes = list(ssn.nodes.values())
+    if not nodes:
+        return 0
+    tasks_all = [t for j in ssn.jobs.values() for t in j.tasks.values()]
+    rnames = discover_resource_names(nodes, tasks_all)
+    node_t = NodeTensors(nodes, rnames)
+    weights = assemble_weights(ssn, rnames)
+    N, R = len(node_t.names), len(rnames)
+    if shape_configs is None:
+        T = J = 0
+        for job in ssn.jobs.values():
+            pend = [t for t in job.task_status_index.get(
+                TaskStatus.PENDING, {}).values() if not t.resreq.is_empty()]
+            if pend:
+                T += len(pend)
+                J += 1
+        shape_configs = [(T, J)] if T else []
+
+    from ..ops import pallas_place
+    use_pallas = (engine in ("tpu-fused", "tpu-pallas")
+                  and pallas_place.supported(R, N)
+                  and (engine == "tpu-pallas"
+                       or not pallas_place.use_interpret()))
+    warmed = 0
+    for T, J in shape_configs:
+        T, J = int(T), max(int(J), 1)
+        if T <= 0:
+            continue
+        # dummy task tensors: J contiguous equal job blocks over T rows
+        job_ix = np.minimum(np.arange(T) * J // T, J - 1).astype(np.int32)
+        first = np.zeros(T, bool)
+        last = np.zeros(T, bool)
+        first[0] = True
+        first[1:] = job_ix[1:] != job_ix[:-1]
+        last[:-1] = job_ix[1:] != job_ix[:-1]
+        last[-1] = True
+        req = np.zeros((T, R), np.float32)
+        min_av = np.ones(J, np.int32)
+        base_z = np.zeros(J, np.int32)
+        if use_pallas:
+            ms = pallas_place.neutral_masked_static(
+                *pallas_place.padded_shape(T, N), T, N)
+            out = pallas_place.place_pallas(
+                node_t.idle,
+                node_t.idle + node_t.releasing - node_t.pipelined,
+                node_t.used, node_t.ntasks.astype(np.float32),
+                node_t.allocatable, node_t.max_tasks.astype(np.float32),
+                req, job_ix, ms, min_av, base_z, base_z,
+                np.asarray(weights.binpack_res),
+                binpack_weight=float(weights.binpack_weight),
+                least_weight=float(weights.least_req_weight),
+                most_weight=float(weights.most_req_weight),
+                balanced_weight=float(weights.balanced_weight),
+                fetch_state=False)
+        elif engine == "tpu-blocks":
+            from ..ops.auction import BlockTasks
+            bt = BlockTasks(req=jnp.asarray(req), job_ix=jnp.asarray(job_ix),
+                            valid=jnp.ones(T, bool),
+                            feas=jnp.ones((T, N), bool),
+                            static_score=jnp.zeros((T, N), jnp.float32))
+            big = T > 12000
+            out = _fused_blocks_solver()(
+                node_t.node_state(), bt,
+                JobMeta(min_available=min_av, base_ready=base_z,
+                        base_pipelined=base_z),
+                weights, jnp.asarray(node_t.allocatable),
+                jnp.asarray(node_t.max_tasks),
+                sweeps=5 if big else 3, passes=4 if big else 3)
+        elif engine == "tpu-sharded":
+            from ..parallel.mesh import make_mesh, place_blocks_sharded
+            from ..ops.place import NodeState
+            mesh = make_mesh(jax.devices())
+            D = mesh.devices.size
+            n_pad = (-N) % D
+            idle = np.pad(node_t.idle, ((0, n_pad), (0, 0)))
+            releasing = np.pad(node_t.releasing, ((0, n_pad), (0, 0)))
+            pipelined_r = np.pad(node_t.pipelined, ((0, n_pad), (0, 0)))
+            state = NodeState(
+                idle=jnp.asarray(idle),
+                future_idle=jnp.asarray(idle + releasing - pipelined_r),
+                used=jnp.asarray(np.pad(node_t.used, ((0, n_pad), (0, 0)))),
+                ntasks=jnp.asarray(np.pad(node_t.ntasks, (0, n_pad))))
+            big = T > 12000
+            out = place_blocks_sharded(
+                mesh, state, jnp.asarray(req), jnp.ones(T, bool),
+                jnp.asarray(job_ix),
+                JobMeta(min_available=jnp.asarray(min_av),
+                        base_ready=jnp.asarray(base_z),
+                        base_pipelined=jnp.asarray(base_z)),
+                weights,
+                jnp.asarray(np.pad(node_t.allocatable, ((0, n_pad), (0, 0)))),
+                jnp.asarray(np.pad(node_t.max_tasks, (0, n_pad))),
+                masked_static=None,
+                sweeps=5 if big else 3, passes=4 if big else 3)
+        else:
+            # scan solver: the fused engine's CPU/interpret path and the
+            # strict engines' batched program (same place_scan_packed jit)
+            bucket = _bucket(T)
+            pad = bucket - T
+            # the eager jnp.pad mirrors _solve_fused exactly so even its
+            # per-shape _pad micro-compiles happen here, not in the cycle
+            pt = PlacementTasks(
+                req=jnp.asarray(np.pad(req, ((0, pad), (0, 0)))),
+                job_ix=jnp.asarray(np.pad(job_ix, (0, pad))),
+                valid=jnp.asarray(np.r_[np.ones(T, bool),
+                                        np.zeros(pad, bool)]),
+                feas=jnp.pad(jnp.ones((T, N), bool), ((0, pad), (0, 0))),
+                static_score=jnp.pad(jnp.zeros((T, N), jnp.float32),
+                                     ((0, pad), (0, 0))),
+                first_of_job=jnp.asarray(np.pad(first, (0, pad))),
+                last_of_job=jnp.asarray(np.pad(last, (0, pad))))
+            out = _job_solver()(
+                node_t.node_state(), pt,
+                JobMeta(min_available=min_av, base_ready=base_z,
+                        base_pipelined=base_z),
+                weights, jnp.asarray(node_t.allocatable),
+                jnp.asarray(node_t.max_tasks))
+        jax.block_until_ready(out)
+        warmed += 1
+    return warmed
 
 
 def _fit_error(task, node):

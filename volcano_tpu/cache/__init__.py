@@ -1,15 +1,16 @@
 from .cache import SchedulerCache
 from .executors import (Binder, Evictor, FakeBinder, FakeEvictor,
-                        FakeStatusUpdater, FakeVolumeBinder, StatusUpdater,
-                        StoreBinder, StoreEvictor, VolumeBinder)
+                        FakeStatusUpdater, FakeVolumeBinder, SequenceBinder,
+                        SequenceEvictor, StatusUpdater, StoreBinder,
+                        StoreEvictor, VolumeBinder)
 from .snapshot import (NodeTensors, assemble_feasibility, assemble_static_score,
                        assemble_weights, discover_resource_names, task_requests)
 
 __all__ = [
     "SchedulerCache",
     "Binder", "Evictor", "FakeBinder", "FakeEvictor", "FakeStatusUpdater",
-    "FakeVolumeBinder", "StatusUpdater", "StoreBinder", "StoreEvictor",
-    "VolumeBinder",
+    "FakeVolumeBinder", "SequenceBinder", "SequenceEvictor", "StatusUpdater",
+    "StoreBinder", "StoreEvictor", "VolumeBinder",
     "NodeTensors", "assemble_feasibility", "assemble_static_score",
     "assemble_weights", "discover_resource_names", "task_requests",
 ]

@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..api import (ClusterInfo, JobInfo, NamespaceCollection, NamespaceInfo,
                    NodeInfo, PodGroupPhase, QueueInfo, Resource, TaskInfo,
-                   TaskStatus)
+                   TaskStatus, allocated_status)
 from .executors import (Binder, Evictor, FakeBinder, FakeEvictor,
                         StatusUpdater, VolumeBinder)
 
@@ -37,10 +37,15 @@ class RateLimitedQueue:
     refused items (SchedulerCache.dead_letter)."""
 
     def __init__(self, base_delay: float = 0.005, max_delay: float = 10.0,
-                 max_retries: Optional[int] = None):
+                 max_retries: Optional[int] = None,
+                 time_fn=time.monotonic):
         self.base_delay = base_delay
         self.max_delay = max_delay
         self.max_retries = max_retries
+        # injectable time source: the simulator (volcano_tpu/sim) pins this
+        # to its virtual clock so retry backoff expires on deterministic
+        # virtual cycles instead of whenever the host gets there
+        self.time_fn = time_fn
         self._heap: List[Tuple[float, int, str, object]] = []
         self._failures: Dict[str, int] = {}
         self._seq = itertools.count()
@@ -59,7 +64,7 @@ class RateLimitedQueue:
             self._failures[key] = n + 1
             delay = min(self.base_delay * (2 ** n), self.max_delay)
             heapq.heappush(self._heap,
-                           (time.monotonic() + delay, next(self._seq), key,
+                           (self.time_fn() + delay, next(self._seq), key,
                             item))
             return True
 
@@ -72,7 +77,7 @@ class RateLimitedQueue:
             self._failures.pop(key, None)
 
     def pop_ready(self) -> List[Tuple[str, object]]:
-        now = time.monotonic()
+        now = self.time_fn()
         out = []
         with self._lock:
             while self._heap and self._heap[0][0] <= now:
@@ -415,13 +420,17 @@ class SchedulerCache:
         in backoff: the task was deleted, or (bind) a later scheduling
         cycle already re-placed the rolled-back task — retrying then would
         bind the pod a second time (possibly onto a different node) and
-        double-count it on two nodes' accounting."""
+        double-count it on two nodes' accounting. Any allocated status
+        counts as re-placed, not just BOUND: a re-bound task ack'd to
+        RUNNING by the watch stream between cycles is exactly as final
+        (caught by the sim's chaos replay, which acks binds the way a
+        live cluster does)."""
         with self._lock:
             job = self.jobs.get(task.job)
             cached = job.tasks.get(task.uid) if job is not None else None
             if cached is None:
                 return True
-            if op == "bind" and (cached.status == TaskStatus.BOUND
+            if op == "bind" and (allocated_status(cached.status)
                                  or (cached.node_name
                                      and cached.node_name != task.node_name)):
                 return True

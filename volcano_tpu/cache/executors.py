@@ -67,6 +67,37 @@ class FakeEvictor(Evictor):
         self.channel.put(task.key())
 
 
+class SequenceBinder(FakeBinder):
+    """FakeBinder that also records the ORDER of successful binds as
+    (task uid, node) pairs — the simulator's determinism witness
+    (volcano_tpu/sim/runner.py): two replays of the same trace+seed must
+    produce identical sequences, and the sim's post-cycle feedback walks
+    the tail of this list to ack binds into RUNNING state."""
+
+    def __init__(self):
+        super().__init__()
+        self.sequence: List[tuple] = []
+
+    def bind(self, task: TaskInfo, hostname: str) -> None:
+        super().bind(task, hostname)
+        with self._lock:
+            self.sequence.append((task.uid, hostname))
+
+
+class SequenceEvictor(FakeEvictor):
+    """FakeEvictor recording eviction order by task uid (see
+    SequenceBinder)."""
+
+    def __init__(self):
+        super().__init__()
+        self.sequence: List[str] = []
+
+    def evict(self, task: TaskInfo, reason: str) -> None:
+        super().evict(task, reason)
+        with self._lock:
+            self.sequence.append(task.uid)
+
+
 class FakeStatusUpdater(StatusUpdater):
     pass
 

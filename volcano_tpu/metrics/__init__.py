@@ -286,6 +286,25 @@ def local_counters() -> Dict[Tuple[str, ...], float]:
         return dict(_counters)
 
 
+def durations_mark() -> Dict[Tuple[str, ...], int]:
+    """Snapshot the current length of every duration series. Pair with
+    durations_since to read only the observations recorded after the mark
+    — how the simulator (volcano_tpu/sim) and bench.py attribute per-action
+    latency to one run without resetting the global recorder under other
+    consumers."""
+    with _lock:
+        return {k: len(v) for k, v in _durations.items()}
+
+
+def durations_since(mark: Dict[Tuple[str, ...], int]
+                    ) -> Dict[Tuple[str, ...], list]:
+    """Every duration series' observations recorded after ``mark``
+    (series born since the mark are returned whole). Units are as stored:
+    ms for ("e2e",)/("task",), us for ("action", name)/("plugin", ...)."""
+    with _lock:
+        return {k: list(v[mark.get(k, 0):]) for k, v in _durations.items()}
+
+
 def reset_local() -> None:
     with _lock:
         _durations.clear()

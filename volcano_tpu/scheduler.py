@@ -43,13 +43,32 @@ DEFAULT_BACKOFF_MAX = 60.0
 DEFAULT_BACKOFF_JITTER = 0.2
 
 
+class WallClock:
+    """Default time source for the shell's pacing: monotonic wall time
+    with a stop-interruptible sleep. The simulator (volcano_tpu/sim)
+    swaps in a VirtualClock whose sleep advances virtual time and returns
+    immediately — the run() loop then paces on virtual cycles with zero
+    wall sleeps while everything else (metrics perf_counter timings) still
+    measures real latency."""
+
+    def __init__(self, stop_event: threading.Event):
+        self._stop = stop_event
+
+    def time(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        self._stop.wait(seconds)
+
+
 class Scheduler:
     def __init__(self, cache, conf_text: Optional[str] = None,
                  conf_path: Optional[str] = None,
                  schedule_period: float = DEFAULT_SCHEDULE_PERIOD,
                  backoff_base: float = DEFAULT_BACKOFF_BASE,
                  backoff_max: float = DEFAULT_BACKOFF_MAX,
-                 backoff_jitter: float = DEFAULT_BACKOFF_JITTER):
+                 backoff_jitter: float = DEFAULT_BACKOFF_JITTER,
+                 clock=None):
         # actions/plugins register on import
         from . import actions as _actions  # noqa: F401
         from . import plugins as _plugins  # noqa: F401
@@ -61,6 +80,10 @@ class Scheduler:
         self.backoff_jitter = backoff_jitter
         self._conf_mtime: Optional[float] = None
         self._stop = threading.Event()
+        # time-source hook (time()/sleep()): wall clock by default, the
+        # sim's VirtualClock under trace replay — run()'s period pacing
+        # and crash-loop backoff go through it instead of time.sleep
+        self.clock = clock or WallClock(self._stop)
         self.conf: SchedulerConfiguration = None
         # pre-action hook (name, session) -> None; raising makes the action
         # count as failed. The chaos harness's ActionFaultInjector plugs in
@@ -175,14 +198,51 @@ class Scheduler:
                                    self.consecutive_failures)
                 cap = self.backoff_max if cycle_fault else \
                     max(self.schedule_period, self.backoff_base)
-                self._stop.wait(self._backoff(cap))
+                self.clock.sleep(self._backoff(cap))
                 continue
             if self.consecutive_failures:
                 self.consecutive_failures = 0
             metrics.set_health(metrics.HEALTHY, 0)
             remaining = self.schedule_period - (time.perf_counter() - cycle_start)
             if remaining > 0:
-                self._stop.wait(remaining)
+                self.clock.sleep(remaining)
+
+    def prewarm(self, configs=None) -> int:
+        """Pre-trace/compile the configured allocate solver at the shape
+        buckets the steady-state loop will hit, so cold-bucket XLA
+        compiles (a 6.5 s stall when a fresh arrival-batch bucket first
+        appears mid-churn) pay at startup instead of inside a scheduling
+        cycle.
+
+        ``configs`` is an iterable of ``(tasks, jobs)`` shape hints — the
+        pending-task count and the number of jobs owning them for each
+        cycle shape to warm (task counts snap to the engine's pow2
+        buckets, so one entry covers its whole bucket). None derives a
+        single entry from the cache's current pending set. Engines
+        resolve exactly as AllocateAction.execute does (conf
+        ``configurations`` override the action default); the callback
+        engines compile nothing and return 0. Returns the number of
+        shapes warmed."""
+        from .framework import close_session, get_action, open_session
+        engine = None
+        for name in self.conf.actions:
+            if name not in ("allocate", "allocate-tpu"):
+                continue
+            action = get_action(name)
+            engine = getattr(action, "engine", None) or "callbacks"
+            for c in self.conf.configurations:
+                if c.name in (name, "allocate"):
+                    engine = c.arguments.get("engine", engine)
+            break
+        if engine is None or engine.startswith("callbacks"):
+            return 0
+        from .actions.allocate import prewarm_shapes
+        ssn = open_session(self.cache, self.conf.tiers,
+                           self.conf.configurations)
+        try:
+            return prewarm_shapes(ssn, configs, engine)
+        finally:
+            close_session(ssn)
 
     def run_with_leader_election(self, store, name: str = "vc-scheduler",
                                  **lease_kwargs) -> None:

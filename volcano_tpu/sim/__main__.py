@@ -1,0 +1,73 @@
+"""CLI: run a trace file or a named scenario through the simulator.
+
+Examples::
+
+    python -m volcano_tpu.sim --list
+    python -m volcano_tpu.sim --scenario smoke
+    python -m volcano_tpu.sim --scenario skew --seed 3 --out report.json
+    python -m volcano_tpu.sim --scenario steady --write-trace steady.jsonl
+    python -m volcano_tpu.sim --trace steady.jsonl --conf my.conf
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .report import to_json
+from .runner import SimRunner
+from .trace import load_trace, write_trace
+from .workload import SCENARIOS, make_scenario
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m volcano_tpu.sim",
+        description="Trace-driven cluster simulation (docs/simulation.md)")
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--scenario", help="named scenario (see --list)")
+    src.add_argument("--trace", help="JSONL trace file to replay")
+    src.add_argument("--list", action="store_true",
+                     help="list named scenarios and exit")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--period", type=float, default=1.0,
+                    help="virtual schedule period per cycle (default 1.0)")
+    ap.add_argument("--conf", help="scheduler conf YAML file (default: the "
+                                   "sim pipeline conf, runner.SIM_CONF)")
+    ap.add_argument("--max-cycles", type=int, default=100000)
+    ap.add_argument("--out", help="also write the report JSON to this file")
+    ap.add_argument("--write-trace",
+                    help="write the (generated) trace to this JSONL file")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in sorted(SCENARIOS):
+            print(f"{name:14s} {SCENARIOS[name]['description']}")
+        return 0
+    if args.scenario:
+        trace = make_scenario(args.scenario, seed=args.seed)
+    elif args.trace:
+        trace = load_trace(args.trace)
+    else:
+        ap.error("one of --scenario/--trace/--list is required")
+    if args.write_trace:
+        write_trace(args.write_trace, trace)
+
+    conf_text = None
+    if args.conf:
+        with open(args.conf) as f:
+            conf_text = f.read()
+    runner = SimRunner(trace, conf_text=conf_text, period=args.period,
+                       seed=args.seed, max_cycles=args.max_cycles,
+                       scenario=args.scenario)
+    report = runner.run()
+    text = to_json(report)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,170 @@
+"""Per-run simulation reports: latency percentiles, utilization, and the
+DRF fairness gap, split into a DECISION plane (a pure function of
+trace + seed + conf — the determinism contract) and a WALL-CLOCK plane
+(``pipeline_e2e_ms``, per-action latency — properties of the host the sim
+ran on). ``deterministic_json`` strips the wall-clock plane so two runs
+of the same trace compare byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List
+
+from ..api import TaskStatus
+
+SCHEMA = "volcano-tpu-sim-report/v1"
+_ND = 6                                     # float rounding in report JSON
+
+
+def percentiles(values: Iterable[float],
+                ps: Iterable[int] = (50, 95, 99)) -> Dict[str, float]:
+    """Nearest-rank percentiles plus mean/max; {} when empty."""
+    vs = sorted(values)
+    if not vs:
+        return {}
+    out = {}
+    for p in ps:
+        ix = min(len(vs) - 1, max(0, int(round(p / 100.0 * len(vs))) - 1))
+        out[f"p{p}"] = round(vs[ix], _ND)
+    out["mean"] = round(sum(vs) / len(vs), _ND)
+    out["max"] = round(vs[-1], _ND)
+    return out
+
+
+def cpu_utilization(cache) -> float:
+    """Allocated-CPU fraction over ready nodes (0 when no node is ready)."""
+    used = total = 0.0
+    for node in cache.nodes.values():
+        if not node.ready:
+            continue
+        used += node.used.cpu
+        total += node.allocatable.cpu
+    return used / total if total else 0.0
+
+
+def mem_utilization(cache) -> float:
+    used = total = 0.0
+    for node in cache.nodes.values():
+        if not node.ready:
+            continue
+        used += node.used.memory
+        total += node.allocatable.memory
+    return used / total if total else 0.0
+
+
+def drf_fairness_gap(cache) -> float:
+    """Spread of weight-normalized dominant shares across ACTIVE queues
+    (queues holding allocations or pending demand): 0 is perfectly fair
+    by DRF-with-weights; the gap is max - min of share_q / weight_q where
+    share_q is the queue's dominant resource share of cluster capacity
+    (drf.go calculate_share semantics). Inactive queues abstain — an
+    empty queue's zero share is idleness, not unfairness."""
+    total_cpu = total_mem = 0.0
+    for node in cache.nodes.values():
+        if not node.ready:
+            continue
+        total_cpu += node.allocatable.cpu
+        total_mem += node.allocatable.memory
+    if not total_cpu:
+        return 0.0
+    alloc: Dict[str, List[float]] = {}
+    active: Dict[str, bool] = {}
+    for job in cache.jobs.values():
+        cpu = mem = 0.0
+        pending = False
+        for t in job.tasks.values():
+            if t.status in (TaskStatus.BOUND, TaskStatus.BINDING,
+                            TaskStatus.RUNNING, TaskStatus.ALLOCATED):
+                cpu += t.resreq.cpu
+                mem += t.resreq.memory
+            elif t.status == TaskStatus.PENDING:
+                pending = True
+        q = alloc.setdefault(job.queue, [0.0, 0.0])
+        q[0] += cpu
+        q[1] += mem
+        active[job.queue] = active.get(job.queue, False) or pending \
+            or cpu > 0 or mem > 0
+    shares = []
+    for quid, (cpu, mem) in alloc.items():
+        if not active.get(quid):
+            continue
+        queue = cache.queues.get(quid)
+        weight = max(getattr(queue, "weight", 1) or 1, 1)
+        dom = max(cpu / total_cpu, mem / total_mem if total_mem else 0.0)
+        shares.append(dom / weight)
+    if len(shares) < 2:
+        return 0.0
+    return max(shares) - min(shares)
+
+
+def build_report(runner, actions_ms: Dict[tuple, list],
+                 wall_s: float) -> dict:
+    """Assemble the report dict from a finished SimRunner."""
+    conf = runner.sched.conf
+    acts = {}
+    for key, vals in actions_ms.items():
+        if len(key) == 2 and key[0] == "action" and vals:
+            acts[key[1]] = percentiles(v / 1e3 for v in vals)  # us -> ms
+    report = {
+        "schema": SCHEMA,
+        "scenario": runner.scenario or "trace",
+        "seed": runner.seed,
+        "conf_actions": list(conf.actions),
+        "period_s": runner.period,
+        "cycles": runner.cycles,
+        "virtual_time_s": round(runner.clock.time(), _ND),
+        "trace_events": len(runner.trace),
+        "jobs": {
+            "arrived": runner.arrived,
+            "admitted": len(runner.gang_admission),
+            "completed": runner.completed,
+            "unfinished": len(runner.cache.jobs),
+        },
+        "binds": len(runner.binder.sequence),
+        "evicts": len(runner.evictor.sequence),
+        "requeues": runner.requeues,
+        "dead_letter": len(runner.cache.dead_letter),
+        "action_failures": len(runner.action_failures),
+        "jct_s": percentiles(runner.jct),
+        "queueing_delay_s": percentiles(runner.queueing_delay),
+        "gang_admission_s": percentiles(runner.gang_admission),
+        "utilization": {
+            "cpu_mean": round(_mean(runner.util_cpu), _ND),
+            "cpu_peak": round(max(runner.util_cpu, default=0.0), _ND),
+            "mem_mean": round(_mean(runner.util_mem), _ND),
+        },
+        "fairness": {
+            "drf_gap_mean": round(_mean(runner.drf_gap), _ND),
+            "drf_gap_max": round(max(runner.drf_gap, default=0.0), _ND),
+        },
+        # the wall-clock plane: host-dependent, excluded from the
+        # determinism contract (deterministic_json strips it)
+        "wallclock": {
+            "pipeline_e2e_ms": percentiles(runner.pipeline_e2e_ms),
+            "actions_ms": acts,
+            "total_s": round(wall_s, 3),
+        },
+    }
+    return report
+
+
+def _mean(vals: List[float]) -> float:
+    return sum(vals) / len(vals) if vals else 0.0
+
+
+def deterministic_part(report: dict) -> dict:
+    """The decision plane only: everything byte-reproducible from
+    (trace, seed, conf)."""
+    return {k: v for k, v in report.items() if k != "wallclock"}
+
+
+def to_json(report: dict) -> str:
+    return json.dumps(report, sort_keys=True, indent=1)
+
+
+def deterministic_json(report: dict) -> str:
+    """Canonical JSON of the decision plane — the byte-identity witness
+    the determinism tests (and the acceptance criterion) compare."""
+    return json.dumps(deterministic_part(report), sort_keys=True,
+                      separators=(",", ":"))

@@ -1,0 +1,333 @@
+"""SimRunner: discrete-event replay of a workload trace through the REAL
+scheduler — the actual ``Scheduler`` shell, the full configured action
+pipeline (enqueue → allocate → preempt → reclaim → backfill), the real
+cache and executors — under a virtual clock with no wall sleeps.
+
+The loop per virtual cycle:
+
+1. apply trace events due at the current virtual time (arrivals,
+   node add/drain/fail) and fire due gang completions;
+2. ``Scheduler.run_once()`` — one real cycle over the live cache
+   (wall-clock time of this call is the run's ``pipeline_e2e_ms`` sample);
+3. feed the cycle's side effects back into the cache the way a cluster
+   would: newly bound tasks flip RUNNING (the kubelet ack), evicted tasks
+   re-queue PENDING (pod delete + controller recreate), gangs that
+   reached ``min_available`` members stamp their admission and schedule a
+   completion ``duration`` later;
+4. advance the virtual clock by one schedule period.
+
+Everything the runner reports splits into two planes: the DECISION plane
+(bind/evict sequences, virtual-time JCT/queueing/admission latencies,
+utilization, fairness) is a pure function of (trace, seed, conf) — same
+inputs reproduce it byte-identically — while the WALL-CLOCK plane
+(``pipeline_e2e_ms``, per-action latency) measures this host and is
+reported separately (sim/report.py keeps the two apart so determinism
+is assertable).
+
+Chaos composes: pass ``binder_wrap``/``evictor_wrap`` (e.g.
+``lambda b: ChaosBinder(b, failure_rate=0.2, seed=7)``) and the injected
+failures flow through the cache's real rollback + resync machinery; the
+runner pins the resync queue's time source to the virtual clock, so even
+retry backoff timing is deterministic.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import metrics
+from ..api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase, QueueInfo,
+                   Resource, TaskInfo, TaskStatus)
+from ..cache import SchedulerCache
+from ..cache.executors import SequenceBinder, SequenceEvictor
+from ..scheduler import Scheduler
+from .trace import TraceEvent
+from . import report as report_mod
+
+# The sim's default pipeline: the chart conf's action chain with the
+# deterministic host engines (deploy/chart scheduler.conf swaps in the
+# TPU engines; pass conf_text to run the sim against those).
+SIM_CONF = """
+actions: "enqueue, allocate, preempt, reclaim, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+class VirtualClock:
+    """Monotonic virtual time: ``sleep`` advances it and returns
+    immediately — the scheduler-shell clock hook for simulation (a
+    thousand 1 s cycles cost zero wall seconds)."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def time(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            self._now += seconds
+
+
+class SimRunner:
+    def __init__(self, trace: List[TraceEvent],
+                 conf_text: Optional[str] = None,
+                 period: float = 1.0,
+                 seed: int = 0,
+                 max_cycles: int = 100000,
+                 stall_limit: int = 120,
+                 binder_wrap: Optional[Callable] = None,
+                 evictor_wrap: Optional[Callable] = None,
+                 scenario: Optional[str] = None):
+        self.trace = list(trace)
+        self.period = period
+        self.seed = seed
+        self.max_cycles = max_cycles
+        self.stall_limit = stall_limit
+        self.scenario = scenario
+
+        self.clock = VirtualClock()
+        self.binder = SequenceBinder()
+        self.evictor = SequenceEvictor()
+        binder = binder_wrap(self.binder) if binder_wrap else self.binder
+        evictor = evictor_wrap(self.evictor) if evictor_wrap else self.evictor
+        self.cache = SchedulerCache(binder=binder, evictor=evictor,
+                                    default_queue=None)
+        # retry backoff runs on virtual time too: a chaos-failed bind's
+        # re-attempt lands on a deterministic virtual cycle, not whenever
+        # the host happens to get there
+        self.cache.resync_queue.time_fn = self.clock.time
+        self.conf_text = conf_text if conf_text is not None else SIM_CONF
+        self.sched = Scheduler(self.cache, conf_text=self.conf_text,
+                               schedule_period=period, clock=self.clock)
+
+        # decision-plane bookkeeping
+        self.arrival_time: Dict[str, float] = {}
+        self.duration: Dict[str, float] = {}
+        self.task_job: Dict[str, str] = {}
+        self.first_bind: Dict[str, float] = {}
+        self.admitted_at: Dict[str, float] = {}
+        self._admit_epoch: Dict[str, int] = {}
+        self.jct: List[float] = []
+        self.queueing_delay: List[float] = []
+        self.gang_admission: List[float] = []
+        self.completed = 0
+        self.arrived = 0
+        self.requeues = 0
+        self.cycles = 0
+        self.action_failures: List[Tuple[int, str]] = []
+        self._binds_seen = 0
+        self._evicts_seen = 0
+        self._completions: List[tuple] = []          # (t, seq, uid, epoch)
+        self._cseq = itertools.count()
+        self._trace_ix = 0
+        # per-cycle samples (decision plane: derived from cache state)
+        self.util_cpu: List[float] = []
+        self.util_mem: List[float] = []
+        self.drf_gap: List[float] = []
+        # wall-clock plane
+        self.pipeline_e2e_ms: List[float] = []
+
+    # -- trace/event application --------------------------------------------
+
+    def _apply_trace_until(self, now: float) -> int:
+        n = 0
+        while self._trace_ix < len(self.trace) \
+                and self.trace[self._trace_ix].t <= now + 1e-9:
+            self._apply_event(self.trace[self._trace_ix])
+            self._trace_ix += 1
+            n += 1
+        return n
+
+    def _apply_event(self, ev: TraceEvent) -> None:
+        d = ev.data
+        if ev.kind == "queue_add":
+            self.cache.add_queue(QueueInfo(name=d["name"],
+                                           weight=d["weight"]))
+        elif ev.kind == "node_add":
+            scalars = {"nvidia.com/gpu": float(d["gpus"])} if d["gpus"] \
+                else None
+            alloc = Resource(d["cpu_milli"], d["mem"], scalars)
+            alloc.max_task_num = d["pods"]
+            self.cache.add_node(NodeInfo(name=d["name"], allocatable=alloc))
+        elif ev.kind == "node_drain":
+            node = self.cache.nodes.get(d["name"])
+            if node is not None:
+                node.ready = False
+        elif ev.kind == "node_restore":
+            node = self.cache.nodes.get(d["name"])
+            if node is not None:
+                node.ready = True
+        elif ev.kind == "node_fail":
+            self._fail_node(d["name"])
+        elif ev.kind == "job_arrival":
+            self._arrive(ev.t, d)
+        elif ev.kind == "job_complete":
+            if d["name"] in self.cache.jobs:
+                self._complete_job(d["name"], ev.t)
+
+    def _arrive(self, t: float, d: dict) -> None:
+        name = d["name"]
+        scalars = {"nvidia.com/gpu": float(d["gpus"])} if d["gpus"] else None
+        pg = PodGroup(name=name, queue=d["queue"],
+                      min_member=d["min_available"],
+                      phase=PodGroupPhase.PENDING)
+        job = JobInfo(uid=name, name=name, queue=d["queue"],
+                      priority=d["priority"],
+                      min_available=d["min_available"], podgroup=pg,
+                      creation_timestamp=t)
+        for i in range(d["tasks"]):
+            uid = f"{name}-{i}"
+            job.add_task_info(TaskInfo(
+                uid=uid, name=uid, job=name,
+                resreq=Resource(d["cpu_milli"], d["mem"], scalars),
+                creation_timestamp=t + i * 1e-6))
+            self.task_job[uid] = name
+        self.cache.add_job(job)
+        self.arrival_time[name] = t
+        self.duration[name] = d["duration"]
+        self.arrived += 1
+
+    def _fail_node(self, name: str) -> None:
+        """The node dies with its tasks: lost members re-queue PENDING and
+        their gang must re-admit (duration restarts — gang semantics: a
+        gang below min_available has lost its collective progress)."""
+        node = self.cache.nodes.get(name)
+        if node is None:
+            return
+        for uid in list(node.tasks):
+            self._requeue_task(uid, on_node=False)
+        self.cache.remove_node(name)
+
+    def _requeue_task(self, uid: str, on_node: bool = True) -> None:
+        job = self.cache.jobs.get(self.task_job.get(uid, ""))
+        if job is None or uid not in job.tasks:
+            return
+        cached = job.tasks[uid]
+        node = self.cache.nodes.get(cached.node_name)
+        if on_node and node is not None and uid in node.tasks:
+            node.remove_task(cached)
+        cached.node_name = ""
+        job.update_task_status(cached, TaskStatus.PENDING)
+        self.requeues += 1
+        if job.uid in self.admitted_at:
+            # the gang dropped below min_available: cancel its pending
+            # completion (epoch bump makes it stale) and let it re-admit
+            del self.admitted_at[job.uid]
+            self._admit_epoch[job.uid] = self._admit_epoch.get(job.uid, 0) + 1
+
+    def _fire_completions_until(self, now: float) -> None:
+        while self._completions and self._completions[0][0] <= now + 1e-9:
+            t, _, uid, epoch = heapq.heappop(self._completions)
+            if self._admit_epoch.get(uid, 0) != epoch \
+                    or uid not in self.admitted_at:
+                continue                       # stale: gang was broken up
+            self._complete_job(uid, t)
+
+    def _complete_job(self, uid: str, t: float) -> None:
+        job = self.cache.jobs.get(uid)
+        if job is None:
+            return
+        for task in list(job.tasks.values()):
+            self.cache.delete_task(task)
+            self.task_job.pop(task.uid, None)
+        self.cache.remove_job(uid)
+        self.admitted_at.pop(uid, None)
+        self.jct.append(t - self.arrival_time[uid])
+        self.completed += 1
+
+    # -- post-cycle feedback ------------------------------------------------
+
+    def _feedback(self, now: float) -> None:
+        """Close the loop the way a live cluster would: binds ack to
+        RUNNING, evictions delete-and-recreate PENDING, full gangs stamp
+        admission and schedule completion."""
+        touched: Dict[str, bool] = {}
+        seq = self.binder.sequence
+        while self._binds_seen < len(seq):
+            uid, _host = seq[self._binds_seen]
+            self._binds_seen += 1
+            jid = self.task_job.get(uid)
+            job = self.cache.jobs.get(jid) if jid else None
+            if job is None or uid not in job.tasks:
+                continue
+            cached = job.tasks[uid]
+            if cached.status == TaskStatus.BOUND:
+                self.cache.update_task_status(cached, TaskStatus.RUNNING)
+            if jid not in self.first_bind:
+                self.first_bind[jid] = now
+                self.queueing_delay.append(now - self.arrival_time[jid])
+            touched[jid] = True
+        eseq = self.evictor.sequence
+        while self._evicts_seen < len(eseq):
+            uid = eseq[self._evicts_seen]
+            self._evicts_seen += 1
+            self._requeue_task(uid)
+        for jid in touched:
+            job = self.cache.jobs.get(jid)
+            if job is None or jid in self.admitted_at:
+                continue
+            if job.min_available > 0 \
+                    and job.ready_task_num() >= job.min_available:
+                self.admitted_at[jid] = now
+                self.gang_admission.append(now - self.arrival_time[jid])
+                epoch = self._admit_epoch.get(jid, 0)
+                heapq.heappush(self._completions,
+                               (now + self.duration[jid], next(self._cseq),
+                                jid, epoch))
+
+    # -- the run loop -------------------------------------------------------
+
+    def _progress_signature(self) -> tuple:
+        return (self._trace_ix, self._binds_seen, self._evicts_seen,
+                self.completed, self.requeues, len(self.cache.jobs),
+                len(self.cache.resync_queue), len(self.cache.dead_letter))
+
+    def _done(self) -> bool:
+        return (self._trace_ix >= len(self.trace)
+                and not self._completions
+                and not self.cache.jobs)
+
+    def run(self) -> dict:
+        """Run the trace to completion (or stall/max_cycles); returns the
+        report dict (sim/report.py)."""
+        wall0 = time.perf_counter()
+        mark = metrics.durations_mark()
+        stall = 0
+        last_sig = None
+        while self.cycles < self.max_cycles:
+            now = self.clock.time()
+            self._apply_trace_until(now)
+            self._fire_completions_until(now)
+            t0 = time.perf_counter()
+            errors = self.sched.run_once()
+            self.pipeline_e2e_ms.append((time.perf_counter() - t0) * 1e3)
+            for name, _ in errors:
+                self.action_failures.append((self.cycles, name))
+            self._feedback(now)
+            self.util_cpu.append(report_mod.cpu_utilization(self.cache))
+            self.util_mem.append(report_mod.mem_utilization(self.cache))
+            self.drf_gap.append(report_mod.drf_fairness_gap(self.cache))
+            self.cycles += 1
+            self.clock.sleep(self.period)
+            if self._done():
+                break
+            sig = self._progress_signature()
+            stall = stall + 1 if sig == last_sig else 0
+            last_sig = sig
+            if stall >= self.stall_limit:
+                break                # wedged backlog: report what's left
+        wall_s = time.perf_counter() - wall0
+        return report_mod.build_report(
+            self, actions_ms=metrics.durations_since(mark), wall_s=wall_s)
