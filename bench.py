@@ -21,10 +21,15 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 - preempt (BASELINE config 4): 5k running + 5k pending / 1k nodes, device
   engine ms + eviction-parity vs callbacks at a tractable config.
 - gpu (BASELINE config 5): 2k nodes x 8 GPUs topology binpack, tpu-fused.
-- cycle_e2e: the whole cycle at 10k/2k — open_session (snapshot, tensor
-  assembly, OnSessionOpen) + allocate + close_session — the reference's
-  e2e_scheduling_latency_milliseconds definition (metrics.go:38-45; the
-  scheduler shell publishes the same metric per cycle).
+- cycle_e2e: the whole cycle at 10k/2k — open_session + allocate +
+  close_session — the reference's e2e_scheduling_latency_milliseconds
+  definition (metrics.go:38-45). The measured cycle opens on the
+  incremental clone-on-dirty snapshot path (docs/performance.md); the
+  COLD full-rebuild open is reported as cycle_open_ms, split into
+  snapshot_clone_ms + tensor_assembly_ms.
+- open_dirty: steady-state incremental open under real churn dirt (gangs
+  completing/arriving between cycles) — the acceptance gate for the
+  device-resident cluster state work.
 - pipeline_e2e: the FULL configured pipeline — enqueue, allocate-tpu,
   preempt, reclaim, backfill (the chart's scheduler.conf chain) — as ONE
   shell session at 10k/2k with half the gangs pre-placed running, with
@@ -108,12 +113,22 @@ def run_preempt(config: str, engine: str, seed: int = 0):
 
 
 def run_cycle_e2e(config: str, engine: str, seed: int = 0):
-    """One full cycle timed END TO END — open_session (snapshot, tensor
-    assembly, every OnSessionOpen) + action + close_session (OnSessionClose,
-    PodGroup writeback) — the reference's e2e_scheduling_latency definition
-    (metrics.go:38-45), not just action.execute. Returns
-    (e2e_s, open_s, action_s, close_s)."""
+    """One full cycle timed END TO END — open_session + action +
+    close_session, the reference's e2e_scheduling_latency definition
+    (metrics.go:38-45), not just action.execute.
+
+    Since the incremental-snapshot work (docs/performance.md) the measured
+    cycle opens on the STEADY-STATE path: an untimed absorb open first
+    pays the cold full-rebuild snapshot (reported separately as the
+    historical cycle_open_ms, split into snapshot_clone_ms +
+    tensor_assembly_ms) and warms the persistent NodeTensors, so the
+    measured cycle is what a 1 s-period scheduler actually pays per cycle
+    — clone-on-dirty open + the full 10k-pending device solve + close.
+    Returns (e2e_s, open_incr_s, action_s, close_s, cold) where ``cold``
+    is {"open_s", "clone_s", "tensor_s"}."""
     from volcano_tpu.actions import AllocateAction
+    from volcano_tpu.actions import allocate as alloc_mod
+    from volcano_tpu.cache.snapshot import discover_resource_names
     from volcano_tpu.cache.synthetic import baseline_config
     from volcano_tpu.framework import close_session, open_session, \
         parse_scheduler_conf
@@ -121,6 +136,19 @@ def run_cycle_e2e(config: str, engine: str, seed: int = 0):
 
     conf = parse_scheduler_conf(None)
     cache, binder, _ = baseline_config(config, seed=seed)
+    # cold absorb open: full-rebuild snapshot + persistent-tensor build
+    t0 = time.perf_counter()
+    ssn = open_session(cache, conf.tiers, [])
+    cold_open_s = time.perf_counter() - t0
+    cold = {"open_s": cold_open_s,
+            "clone_s": cache.last_snapshot_stats.get("clone_s", 0.0)}
+    alloc_mod.LAST_STATS.pop("tensor_s", None)
+    tasks_all = [t for j in ssn.jobs.values() for t in j.tasks.values()]
+    rnames = discover_resource_names(list(ssn.nodes.values()), tasks_all)
+    alloc_mod._node_tensors(ssn, rnames)        # cold tensor assembly
+    cold["tensor_s"] = alloc_mod.LAST_STATS.get("tensor_s", 0.0)
+    close_session(ssn)
+
     t0 = time.perf_counter()
     ssn = open_session(cache, conf.tiers, [])
     t1 = time.perf_counter()
@@ -129,7 +157,42 @@ def run_cycle_e2e(config: str, engine: str, seed: int = 0):
     close_session(ssn)
     t3 = time.perf_counter()
     _assert_no_fallback(f"engine {engine}")
-    return t3 - t0, t1 - t0, t2 - t1, t3 - t2
+    return t3 - t0, t1 - t0, t2 - t1, t3 - t2, cold
+
+
+def run_open_dirty(config: str = "10k", engine: str = "tpu-fused",
+                   seed: int = 0, churn_jobs: int = 5, rounds: int = 3):
+    """Steady-state INCREMENTAL session open: the 10k/2k world after a
+    full allocate cycle, with run_churn-style gang completions/arrivals
+    applied before each measured open — so the dirty set is the realistic
+    per-period delta (a few hundred of 10k pods), not zero and not
+    everything. Returns (best_open_s, stats_of_best) where stats is the
+    cache's last_snapshot_stats for that open."""
+    from volcano_tpu.actions import AllocateAction
+    from volcano_tpu.cache.synthetic import baseline_config
+    from volcano_tpu.framework import close_session, open_session, \
+        parse_scheduler_conf
+    import volcano_tpu.plugins  # noqa: F401
+
+    conf = parse_scheduler_conf(None)
+    cache, binder, _ = baseline_config(config, seed=seed)
+    ssn = open_session(cache, conf.tiers, [])
+    AllocateAction(engine=engine).execute(ssn)     # bind the backlog
+    close_session(ssn)
+    # absorb the all-dirty post-bind world once
+    close_session(open_session(cache, conf.tiers, []))
+    best = None
+    stats = None
+    for i in range(rounds):
+        _churn_step(cache, i, churn_jobs, seed + 2000 + i)
+        t0 = time.perf_counter()
+        ssn = open_session(cache, conf.tiers, [])
+        open_s = time.perf_counter() - t0
+        this = dict(cache.last_snapshot_stats)
+        close_session(ssn)
+        if best is None or open_s < best:
+            best, stats = open_s, this
+    return best, stats
 
 
 class _CompileCounter:
@@ -491,20 +554,41 @@ def main():
     sh10_s, sh10_admitted, _ = run_cycle("10k", "tpu-sharded")
     extras.update(tpu_sharded_10k_ms=round(sh10_s * 1e3, 2))
 
-    # the FULL cycle, end to end (VERDICT r5 #2): open_session (snapshot,
-    # tensor assembly, every OnSessionOpen) + allocate + close_session at
-    # the headline config — the reference's e2e_scheduling_latency
-    # definition (metrics.go:38-45), with the session-open breakdown
+    # the FULL cycle, end to end (VERDICT r5 #2) at the headline config —
+    # the reference's e2e_scheduling_latency definition (metrics.go:38-45).
+    # The measured cycle opens on the incremental clone-on-dirty path (an
+    # untimed absorb open pays the cold rebuild first); cycle_open_ms stays
+    # the COLD full-rebuild open, split into its snapshot_clone_ms +
+    # tensor_assembly_ms components, and cycle_open_incr_ms is the open the
+    # measured steady cycle actually paid (docs/performance.md).
     run_cycle_e2e("10k", "tpu-fused")             # warm
     e2e_best = None
     for _ in range(2):
         r = run_cycle_e2e("10k", "tpu-fused")
         if e2e_best is None or r[0] < e2e_best[0]:
             e2e_best = r
+    cold = e2e_best[4]
     extras.update(cycle_e2e_ms=round(e2e_best[0] * 1e3, 1),
-                  cycle_open_ms=round(e2e_best[1] * 1e3, 1),
+                  cycle_open_ms=round(cold["open_s"] * 1e3, 1),
+                  snapshot_clone_ms=round(cold["clone_s"] * 1e3, 1),
+                  tensor_assembly_ms=round(cold["tensor_s"] * 1e3, 1),
+                  cycle_open_incr_ms=round(e2e_best[1] * 1e3, 1),
                   cycle_action_ms=round(e2e_best[2] * 1e3, 1),
                   cycle_close_ms=round(e2e_best[3] * 1e3, 1))
+
+    # steady-state incremental open under REAL churn dirt (the acceptance
+    # gate: open_dirty_ms <= 60 at 10k/2k): gangs complete and arrive
+    # between cycles, the snapshot re-clones only the touched keys
+    od_s, od_stats = run_open_dirty("10k", "tpu-fused")
+    assert not od_stats.get("full"), (
+        "steady-state open fell back to a FULL snapshot rebuild: "
+        f"{od_stats} — clone-on-dirty is not engaging")
+    extras.update(open_dirty_ms=round(od_s * 1e3, 1),
+                  open_dirty_clone_ms=round(od_stats.get("clone_s", 0.0)
+                                            * 1e3, 1),
+                  open_dirty_nodes=od_stats.get("dirty_nodes"),
+                  open_dirty_ratio=round(od_stats.get("dirty_ratio", 0.0),
+                                         4))
 
     # compile-counter canary: the cold compile MUST register before the
     # churn gate below may claim "zero recompiles" means anything

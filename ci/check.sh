@@ -13,15 +13,42 @@ cd "$(dirname "$0")/.."
 
 run_python=true
 run_shim=true
+run_sim=true
 case "${1:-}" in
-  --shim-only) run_python=false ;;
-  --python-only) run_shim=false ;;
+  --shim-only) run_python=false; run_sim=false ;;
+  --python-only) run_shim=false; run_sim=false ;;
+  --sim-only) run_python=false; run_shim=false ;;
 esac
 
 if $run_python; then
   echo "== tier-1: pytest (not slow) =="
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
+fi
+
+if $run_sim; then
+  # sim-determinism: each fast scenario's decision plane must be
+  # byte-identical run to run, AND with incremental snapshots on vs off
+  # (docs/performance.md) — a snapshot regression that breaks replay
+  # determinism fails CI here, not just the slow-marked 10k test.
+  echo "== sim-determinism: fast scenarios, decision-plane diff =="
+  simdir=$(mktemp -d)
+  trap 'rm -rf "$simdir"' EXIT
+  for scenario in smoke skew; do
+    JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario "$scenario" \
+      --seed 3 --deterministic > "$simdir/$scenario.a.json"
+    JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario "$scenario" \
+      --seed 3 --deterministic > "$simdir/$scenario.b.json"
+    VOLCANO_TPU_INCREMENTAL_SNAPSHOT=0 JAX_PLATFORMS=cpu \
+      python -m volcano_tpu.sim --scenario "$scenario" \
+      --seed 3 --deterministic > "$simdir/$scenario.full.json"
+    diff "$simdir/$scenario.a.json" "$simdir/$scenario.b.json" \
+      || { echo "sim-determinism FAILED: $scenario not reproducible"; exit 1; }
+    diff "$simdir/$scenario.a.json" "$simdir/$scenario.full.json" \
+      || { echo "sim-determinism FAILED: $scenario decisions differ with \
+incremental snapshots off"; exit 1; }
+    echo "   $scenario: decision plane byte-identical (x2 + incremental off)"
+  done
 fi
 
 if $run_shim; then

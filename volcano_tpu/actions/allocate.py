@@ -75,6 +75,25 @@ class ReplayFault(RuntimeError):
 LAST_FALLBACK: Dict[str, str] = {}
 
 
+def _node_tensors(ssn, rnames) -> NodeTensors:
+    """Node-state tensors for a device solve: the cache's persistent,
+    incrementally scatter-updated arrays when the session can prove its
+    snapshot untouched (session.snapshot_node_tensors), else a from-scratch
+    build — the two are row-identical by the oracle test
+    (tests/test_incremental_snapshot.py). Time spent here is reported as
+    bench.py's tensor_assembly_ms."""
+    t0 = time.perf_counter()
+    get = getattr(ssn, "snapshot_node_tensors", None)
+    node_t = get(rnames) if get is not None else None
+    incremental = node_t is not None
+    if node_t is None:
+        node_t = NodeTensors(list(ssn.nodes.values()), rnames)
+    LAST_STATS["tensor_s"] = LAST_STATS.get("tensor_s", 0.0) \
+        + (time.perf_counter() - t0)
+    LAST_STATS["tensor_incremental"] = incremental
+    return node_t
+
+
 class _AggTask:
     """Lightweight task stand-in carrying a summed resreq, used to fire one
     aggregated allocate event per job during order simulation."""
@@ -101,6 +120,8 @@ class AllocateAction(Action):
                 engine = conf.arguments.get("engine", engine)
                 fallback = conf.arguments.get_bool("solver-fallback", True)
         LAST_FALLBACK.clear()
+        LAST_STATS.pop("tensor_s", None)      # accumulates within one cycle
+        LAST_STATS.pop("tensor_incremental", None)
         if engine == "callbacks":
             _execute_interleaved(ssn, _CallbackJobPlacer(ssn))
         elif engine == "callbacks-parallel":
@@ -365,10 +386,10 @@ class _DeviceJobPlacer:
         self.jnp = jnp
         tasks_all = [t for j in ssn.jobs.values() for t in j.tasks.values()]
         self.rnames = discover_resource_names(list(ssn.nodes.values()), tasks_all)
-        self.node_t = NodeTensors(list(ssn.nodes.values()), self.rnames)
+        self.node_t = _node_tensors(ssn, self.rnames)
         self.state = self.node_t.node_state()
-        self.allocatable = jnp.asarray(self.node_t.allocatable)
-        self.max_tasks = jnp.asarray(self.node_t.max_tasks)
+        self.allocatable = self.node_t.device_allocatable()
+        self.max_tasks = self.node_t.device_max_tasks()
         self.weights = assemble_weights(ssn, self.rnames)
         self._solve = _job_solver()
 
@@ -568,10 +589,10 @@ def _execute_strict_batched(ssn, batch: int = 16) -> None:
         return
     tasks_all = [t for j in ssn.jobs.values() for t in j.tasks.values()]
     rnames = discover_resource_names(list(ssn.nodes.values()), tasks_all)
-    node_t = NodeTensors(list(ssn.nodes.values()), rnames)
+    node_t = _node_tensors(ssn, rnames)
     state = node_t.node_state()
-    allocatable_d = jnp.asarray(node_t.allocatable)
-    max_tasks_d = jnp.asarray(node_t.max_tasks)
+    allocatable_d = node_t.device_allocatable()
+    max_tasks_d = node_t.device_max_tasks()
     weights = assemble_weights(ssn, rnames)
     solver = _job_solver()
     recheck = bool(ssn.stateful_predicates)
@@ -875,7 +896,7 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
         return None
 
     rnames = discover_resource_names(list(ssn.nodes.values()), tasks)
-    node_t = NodeTensors(list(ssn.nodes.values()), rnames)
+    node_t = _node_tensors(ssn, rnames)
     req = task_requests(tasks, rnames)
     feas = assemble_feasibility(ssn, tasks, node_t)
     static = assemble_static_score(ssn, tasks, node_t)
@@ -955,6 +976,10 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
             jnp.asarray(job_ix_np), jobs_meta, weights, jnp.asarray(alloc),
             jnp.asarray(maxt), masked_static=ms,
             sweeps=5 if big else 3, passes=4 if big else 3)
+        # ONE batched readback (four separate np.asarray fetches cost four
+        # tunnel RTTs on remote TPU backends)
+        assign, pipelined, ready, kept = jax.device_get(
+            (assign, pipelined, ready, kept))
         task_node = np.where(assign < N, assign, NO_NODE).astype(np.int32)
         return _FusedSolution(tasks, job_ix_np, jobs_list, node_t, task_node,
                               pipelined, ready, kept)
@@ -1007,12 +1032,12 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
         big_b = T > 12000
         assign, pipe, ready, kept, _ = _fused_blocks_solver()(
             node_t.node_state(), bt, jobs_meta, weights,
-            jnp.asarray(node_t.allocatable), jnp.asarray(node_t.max_tasks),
+            node_t.device_allocatable(), node_t.device_max_tasks(),
             sweeps=5 if big_b else 3, passes=4 if big_b else 3)
-        task_node = np.asarray(assign)
-        pipelined = np.asarray(pipe, bool)
-        job_ready = np.asarray(ready)
-        job_kept = np.asarray(kept)
+        import jax
+        task_node, pipelined, job_ready, job_kept = jax.device_get(
+            (assign, pipe, ready, kept))
+        pipelined = np.asarray(pipelined, bool)
     else:
         pt = PlacementTasks(
             req=jnp.asarray(np.pad(req, ((0, pad), (0, 0)))),
@@ -1024,8 +1049,8 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
             last_of_job=jnp.asarray(np.pad(last, (0, pad))))
         from ..ops.place import unpack_placement
         packed, _ = _job_solver()(node_t.node_state(), pt, jobs_meta, weights,
-                                  jnp.asarray(node_t.allocatable),
-                                  jnp.asarray(node_t.max_tasks))
+                                  node_t.device_allocatable(),
+                                  node_t.device_max_tasks())
         task_node, pipelined, job_ready, job_kept = unpack_placement(
             np.asarray(packed), bucket, J)
         task_node, pipelined = task_node[:T], pipelined[:T]
@@ -1089,28 +1114,45 @@ def _replay_fused_fast(ssn, sol: "_FusedSolution") -> None:
     placed = (task_node != NO_NODE) & kept_t
     pipe_m = placed & pipelined
 
-    alloc_agg: Dict[str, Resource] = {}
-    pipe_agg: Dict[str, Resource] = {}
+    # Vectorized accounting plan: every per-task decision (status, bind
+    # membership) is precomputed as index arrays so the Python loop is pure
+    # dict bookkeeping — and node identity is resolved through a row-indexed
+    # object table instead of a per-task name hash.
+    ready_j = np.asarray(sol.job_ready, bool)
+    placed_ix = np.flatnonzero(placed)
+    hosts_row = task_node[placed_ix]
+    jx_arr = job_ix[placed_ix]
+    pipe_arr = pipe_m[placed_ix]
+    bind_arr = ~pipe_arr & ready_j[jx_arr]
+
+    alloc_agg: Dict[int, Resource] = {}
+    pipe_agg: Dict[int, Resource] = {}
     job_agg: Dict[int, Resource] = {}
     job_alloc: Dict[int, Resource] = {}
-    ready_j = np.asarray(sol.job_ready, bool)
     binds: List[TaskInfo] = []
     names = sol.node_t.names
-    for i in np.flatnonzero(placed):
-        task = sol.tasks[i]
-        jx = int(job_ix[i])
-        job = sol.jobs_list[jx]
-        host = names[task_node[i]]
-        if pipe_m[i]:
-            status = TaskStatus.PIPELINED
-            pipe_agg.setdefault(host, Resource()).add(task.resreq)
+    node_objs = [ssn.nodes.get(nm) if nm else None for nm in names]
+    tasks_l = sol.tasks
+    jobs_list = sol.jobs_list
+    PIPELINED, BINDING, ALLOCATED = (TaskStatus.PIPELINED,
+                                     TaskStatus.BINDING,
+                                     TaskStatus.ALLOCATED)
+    for k in range(len(placed_ix)):
+        i = placed_ix[k]
+        task = tasks_l[i]
+        jx = int(jx_arr[k])
+        job = jobs_list[jx]
+        row = hosts_row[k]
+        if pipe_arr[k]:
+            status = PIPELINED
+            pipe_agg.setdefault(row, Resource()).add(task.resreq)
         else:
-            if ready_j[jx]:
-                status = TaskStatus.BINDING
+            if bind_arr[k]:
+                status = BINDING
                 binds.append(task)
             else:
-                status = TaskStatus.ALLOCATED
-            alloc_agg.setdefault(host, Resource()).add(task.resreq)
+                status = ALLOCATED
+            alloc_agg.setdefault(row, Resource()).add(task.resreq)
             job_alloc.setdefault(jx, Resource()).add(task.resreq)
         # inline update_task_status minus the per-task Resource math
         # (aggregated above): old status is PENDING by construction of
@@ -1118,24 +1160,29 @@ def _replay_fused_fast(ssn, sol: "_FusedSolution") -> None:
         job._del_index(task)
         task.status = status
         job._add_index(task)
-        task.node_name = host
+        task.node_name = names[row]
         ti = task.shallow_clone()
-        if status == TaskStatus.BINDING:
-            ti.status = TaskStatus.ALLOCATED
-        ssn.nodes[host].tasks[task.uid] = ti
+        if status is BINDING:
+            ti.status = ALLOCATED
+        node_objs[row].tasks[task.uid] = ti
         job_agg.setdefault(jx, Resource()).add(task.resreq)
 
     for jx, agg in job_agg.items():
-        job = sol.jobs_list[jx]
+        job = jobs_list[jx]
         if jx in job_alloc:
             job.allocated.add(job_alloc[jx])
         ssn._fire_allocate(_AggTask(job.uid, agg))
-    for host, r in alloc_agg.items():
-        node = ssn.nodes[host]
+    for row, r in alloc_agg.items():
+        node = node_objs[row]
+        node._touched = True          # direct aggregate mutation below
         node.idle.sub(r)
         node.used.add(r)
-    for host, r in pipe_agg.items():
-        ssn.nodes[host].pipelined.add(r)
+    for row, r in pipe_agg.items():
+        node = node_objs[row]
+        node._touched = True
+        node.pipelined.add(r)
+    # bind_batch records every bound task/node in the cache's dirty set, so
+    # the NEXT cycle's snapshot+tensor delta is exactly this cycle's binds
     ssn.cache.bind_batch(binds)
 
 
@@ -1220,7 +1267,10 @@ def prewarm_shapes(ssn, shape_configs=None, engine: str = "tpu-fused") -> int:
         return 0
     tasks_all = [t for j in ssn.jobs.values() for t in j.tasks.values()]
     rnames = discover_resource_names(nodes, tasks_all)
-    node_t = NodeTensors(nodes, rnames)
+    # route through the persistent tensor cache so the cold full build —
+    # AND the delta-scatter programs the steady-state cycles will dispatch
+    # — are both paid here, not inside a scheduling cycle
+    node_t = _node_tensors(ssn, rnames)
     weights = assemble_weights(ssn, rnames)
     N, R = len(node_t.names), len(rnames)
     if shape_configs is None:
@@ -1239,6 +1289,20 @@ def prewarm_shapes(ssn, shape_configs=None, engine: str = "tpu-fused") -> int:
                   and (engine == "tpu-pallas"
                        or not pallas_place.use_interpret()))
     warmed = 0
+    prewarm_delta = getattr(node_t, "prewarm_delta", None)
+    if prewarm_delta is not None and shape_configs:
+        # the per-cycle dirty-row count varies cycle to cycle, so warm the
+        # WHOLE pow2 scatter-bucket ladder up to the node count — each
+        # program is a tiny scatter, and a cold one inside the loop is
+        # exactly the recompile churn_steady_ok forbids. Not counted in the
+        # return value, which stays "solver shapes warmed". The ladder is
+        # derived through _delta_bucket so it tracks the live policy.
+        from ..cache.snapshot import _delta_bucket
+        ladder, n = [], 1
+        while n <= N:
+            ladder.append(_delta_bucket(n))
+            n = ladder[-1] + 1
+        prewarm_delta(ladder)
     for T, J in shape_configs:
         T, J = int(T), max(int(J), 1)
         if T <= 0:

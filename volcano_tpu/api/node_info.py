@@ -53,6 +53,15 @@ class NodeInfo:
         # nodes (tdm plugin)
         self.revocable_zone = self.labels.get("volcano.sh/revocable-zone", "")
         self.tasks: Dict[str, TaskInfo] = {}
+        # Mutation witness for the incremental snapshot (cache.snapshot
+        # clone-on-dirty, docs/performance.md): add_task/remove_task — the
+        # funnel every placement-accounting mutation goes through — set it,
+        # clone() starts the copy clean. The cache reuses a previous
+        # snapshot's NodeInfo clone only while BOTH the live node and that
+        # clone are untouched, so a session mutation (pipelines, discarded
+        # statements) or a direct host-side add_task can never leak into
+        # the next cycle's snapshot.
+        self._touched = False
         # (host_ip, protocol, port) -> claim count for tasks on this node
         # (k8s nodeports bookkeeping; predicates.go:321 Filter input)
         self.used_ports: Dict[tuple, int] = {}
@@ -120,6 +129,7 @@ class NodeInfo:
         if task.uid in self.tasks:
             raise ValueError(f"task {task.key()} already on node {self.name}")
 
+        self._touched = True
         ti = task.clone()
         if ti.status == TaskStatus.RELEASING:
             self._allocate_idle(ti)
@@ -143,6 +153,7 @@ class NodeInfo:
         own = self.tasks.get(task.uid)
         if own is None:
             return
+        self._touched = True
         if own.status == TaskStatus.RELEASING:
             self.releasing.sub(own.resreq)
             self.idle.add(own.resreq)
@@ -199,6 +210,7 @@ class NodeInfo:
         n.revocable_zone = self.revocable_zone
         n.used_ports = dict(self.used_ports)
         n.ready = self.ready
+        n._touched = False
         n.others = dict(self.others)
         n.numa_info = self.numa_info.deep_copy() if self.numa_info else None
         n.tasks = {}

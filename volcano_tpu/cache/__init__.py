@@ -1,16 +1,19 @@
-from .cache import SchedulerCache
+from .cache import SchedulerCache, incremental_snapshot_enabled
 from .executors import (Binder, Evictor, FakeBinder, FakeEvictor,
                         FakeStatusUpdater, FakeVolumeBinder, SequenceBinder,
                         SequenceEvictor, StatusUpdater, StoreBinder,
                         StoreEvictor, VolumeBinder)
-from .snapshot import (NodeTensors, assemble_feasibility, assemble_static_score,
-                       assemble_weights, discover_resource_names, task_requests)
+from .snapshot import (NodeTensors, PersistentNodeTensors,
+                       assemble_feasibility, assemble_static_score,
+                       assemble_weights, discover_resource_names,
+                       node_infos_for, task_requests)
 
 __all__ = [
-    "SchedulerCache",
+    "SchedulerCache", "incremental_snapshot_enabled",
     "Binder", "Evictor", "FakeBinder", "FakeEvictor", "FakeStatusUpdater",
     "FakeVolumeBinder", "SequenceBinder", "SequenceEvictor", "StatusUpdater",
     "StoreBinder", "StoreEvictor", "VolumeBinder",
-    "NodeTensors", "assemble_feasibility", "assemble_static_score",
-    "assemble_weights", "discover_resource_names", "task_requests",
+    "NodeTensors", "PersistentNodeTensors", "assemble_feasibility",
+    "assemble_static_score", "assemble_weights", "discover_resource_names",
+    "node_infos_for", "task_requests",
 ]

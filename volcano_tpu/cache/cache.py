@@ -16,15 +16,25 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import os
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..api import (ClusterInfo, JobInfo, NamespaceCollection, NamespaceInfo,
                    NodeInfo, PodGroupPhase, QueueInfo, Resource, TaskInfo,
                    TaskStatus, allocated_status)
 from .executors import (Binder, Evictor, FakeBinder, FakeEvictor,
                         StatusUpdater, VolumeBinder)
+
+
+def incremental_snapshot_enabled() -> bool:
+    """Kill-switch for the incremental snapshot + persistent tensor state
+    (docs/performance.md). Default ON; set VOLCANO_TPU_INCREMENTAL_SNAPSHOT=0
+    to force the historical full deep-clone every cycle (also how the sim's
+    A/B determinism test proves the two paths decide identically)."""
+    return os.environ.get("VOLCANO_TPU_INCREMENTAL_SNAPSHOT", "1") \
+        .lower() not in ("0", "false", "off")
 
 
 class RateLimitedQueue:
@@ -126,34 +136,87 @@ class SchedulerCache:
         # re-queues after the underlying fault is fixed.
         self.dead_letter: Dict[str, Tuple[str, TaskInfo]] = {}
         self.binding_tasks: Dict[str, str] = {}   # task uid -> node, in flight
+        # Incremental snapshot state (docs/performance.md): every mutation
+        # path records the touched node/job/queue keys; snapshot() re-clones
+        # only those and structurally shares the rest with the previous
+        # snapshot. _dirty_all forces the next snapshot to full-rebuild
+        # (initial state, external bulk mutation, kill-switch re-enable).
+        self._dirty_nodes: Set[str] = set()
+        self._dirty_jobs: Set[str] = set()
+        self._dirty_queues: Set[str] = set()
+        self._dirty_all = True
+        self._snap_nodes: Dict[str, NodeInfo] = {}
+        self._snap_jobs: Dict[str, JobInfo] = {}
+        self._snap_queues: Dict[str, QueueInfo] = {}
+        self._snap_epoch = 0
+        # node names whose snapshot row changed since the persistent tensor
+        # state last refreshed (cache/snapshot.PersistentNodeTensors)
+        self._tensor_dirty: Set[str] = set()
+        self.tensor_cache = None
+        # wall-clock + dirty-ratio breakdown of the last snapshot()
+        # (bench.py snapshot_clone_ms / open_dirty_ms extras)
+        self.last_snapshot_stats: Dict[str, object] = {}
+
+    # -- dirty-set marks (incremental snapshot) -----------------------------
+
+    def mark_node_dirty(self, name: str) -> None:
+        """Record that ``name``'s live state changed outside the cache's
+        own mutators (sim node drain/restore, direct test mutation) so the
+        next snapshot re-clones it instead of reusing the cached clone."""
+        self._dirty_nodes.add(name)
+
+    def mark_job_dirty(self, uid: str) -> None:
+        self._dirty_jobs.add(uid)
+
+    def mark_queue_dirty(self, uid: str) -> None:
+        self._dirty_queues.add(uid)
+
+    def mark_all_dirty(self) -> None:
+        """Invalidate every cached clone — the blunt instrument for bulk
+        external mutation."""
+        self._dirty_all = True
+
+    def _mark_task_dirty(self, task: TaskInfo) -> None:
+        """One task moved: its job's gang state and (when placed) its
+        node's accounting changed. Caller holds self._lock."""
+        if task.job:
+            self._dirty_jobs.add(task.job)
+        if task.node_name:
+            self._dirty_nodes.add(task.node_name)
 
     # -- ingestion (event_handlers.go analogues) ----------------------------
 
     def add_node(self, node: NodeInfo) -> None:
         with self._lock:
             self.nodes[node.name] = node
+            self._dirty_nodes.add(node.name)
 
     def remove_node(self, name: str) -> None:
         with self._lock:
             self.nodes.pop(name, None)
+            self._dirty_nodes.add(name)
 
     def add_queue(self, queue: QueueInfo) -> None:
         with self._lock:
             self.queues[queue.uid] = queue
+            self._dirty_queues.add(queue.uid)
 
     def remove_queue(self, uid: str) -> None:
         with self._lock:
             self.queues.pop(uid, None)
+            self._dirty_queues.add(uid)
 
     def add_job(self, job: JobInfo) -> None:
         with self._lock:
             if job.schedule_start_timestamp is None:
                 job.schedule_start_timestamp = time.time()
             self.jobs[job.uid] = job
+            self._dirty_jobs.add(job.uid)
 
     def remove_job(self, uid: str) -> None:
         with self._lock:
             job = self.jobs.pop(uid, None)
+            self._dirty_jobs.add(uid)
             if job is not None:
                 for task_uid in job.tasks:
                     self._drop_retry_state(task_uid)
@@ -162,6 +225,7 @@ class SchedulerCache:
         with self._lock:
             if uid not in self.jobs:
                 self.jobs[uid] = JobInfo(uid=uid, **kwargs)
+                self._dirty_jobs.add(uid)
             return self.jobs[uid]
 
     def add_task(self, task: TaskInfo) -> None:
@@ -172,6 +236,7 @@ class SchedulerCache:
             job.add_task_info(task)
             if task.node_name and task.node_name in self.nodes:
                 self.nodes[task.node_name].add_task(task)
+            self._mark_task_dirty(task)
 
     def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
         with self._lock:
@@ -181,9 +246,12 @@ class SchedulerCache:
             job.update_task_status(job.tasks[task.uid], status)
             if task.node_name and task.node_name in self.nodes:
                 self.nodes[task.node_name].update_task(job.tasks[task.uid])
+            self._mark_task_dirty(task)
 
     def delete_task(self, task: TaskInfo) -> None:
         with self._lock:
+            # mark BEFORE mutating: node.remove_task clears task.node_name
+            self._mark_task_dirty(task)
             job = self.jobs.get(task.job)
             if job is not None:
                 job.delete_task_info(task)
@@ -222,9 +290,23 @@ class SchedulerCache:
             col.delete(quota.metadata.name)
 
     def snapshot(self) -> ClusterInfo:
+        """Clone-on-dirty snapshot (docs/performance.md): nodes/jobs/queues
+        whose keys were not touched since the previous snapshot — and whose
+        previous clone the session never mutated (the ``_touched`` witness)
+        — are structurally SHARED with the previous snapshot instead of
+        deep-cloned. Sharing is exact because a reused clone is, by the
+        witness, byte-equal to what a fresh ``clone()`` would produce
+        (aggregates are invariants of the unchanged task set, and the
+        immutable fields were already shared per the Resource contract).
+        Falls back to the historical full deep-clone when
+        VOLCANO_TPU_INCREMENTAL_SNAPSHOT=0 or after mark_all_dirty()."""
+        t0 = time.perf_counter()
         with self._lock:
+            incremental = incremental_snapshot_enabled()
+            full = self._dirty_all or not incremental
             ci = ClusterInfo()
             inflight_nodes = set(self.binding_tasks.values())
+            reused_nodes = cloned_nodes = 0
             for name, node in self.nodes.items():
                 if not node.ready:
                     continue
@@ -232,20 +314,118 @@ class SchedulerCache:
                 # double-booking (cache.go:822-827)
                 if name in inflight_nodes:
                     continue
-                ci.nodes[name] = node.clone()
+                prev = None if full else self._snap_nodes.get(name)
+                if (prev is not None
+                        and name not in self._dirty_nodes
+                        and not prev._touched and not node._touched
+                        and prev.unschedulable == node.unschedulable):
+                    ci.nodes[name] = prev
+                    reused_nodes += 1
+                else:
+                    ci.nodes[name] = node.clone()
+                    node._touched = False
+                    cloned_nodes += 1
+                    self._tensor_dirty.add(name)
             for uid, q in self.queues.items():
-                ci.queues[uid] = q.clone()
+                prev = None if full else self._snap_queues.get(uid)
+                if (prev is not None and uid not in self._dirty_queues
+                        and prev.weight == q.weight
+                        and prev.state == q.state
+                        and prev.reclaimable == q.reclaimable
+                        and prev.capability is q.capability):
+                    ci.queues[uid] = prev
+                else:
+                    ci.queues[uid] = q.clone()
+            reused_jobs = 0
             for uid, job in self.jobs.items():
                 if job.podgroup is None:
                     continue
-                ci.jobs[uid] = job.clone()
+                prev = None if full else self._snap_jobs.get(uid)
+                if (prev is not None
+                        and uid not in self._dirty_jobs
+                        and not prev._touched and not job._touched
+                        and prev.podgroup is job.podgroup
+                        and prev.priority == job.priority
+                        and prev.min_available == job.min_available
+                        and prev.queue == job.queue):
+                    # per-cycle scratch a fresh clone would start without
+                    if prev.nodes_fit_errors:
+                        prev.nodes_fit_errors = {}
+                    if prev.job_fit_errors:
+                        prev.job_fit_errors = ""
+                    ci.jobs[uid] = prev
+                    reused_jobs += 1
+                else:
+                    ci.jobs[uid] = job.clone()
+                    job._touched = False
             for name, col in self.namespace_collections.items():
                 ci.namespaces[name] = col.snapshot()
             for job in ci.jobs.values():
                 ci.namespaces.setdefault(job.namespace,
                                          NamespaceInfo(job.namespace))
             ci.node_list = list(ci.nodes.values())
-            return ci
+            if incremental:
+                self._snap_nodes = dict(ci.nodes)
+                self._snap_jobs = dict(ci.jobs)
+                self._snap_queues = dict(ci.queues)
+                self._dirty_all = False
+            else:
+                # keep nothing: a later re-enable must rebuild from scratch
+                self._snap_nodes = {}
+                self._snap_jobs = {}
+                self._snap_queues = {}
+                self._dirty_all = True
+            self._dirty_nodes.clear()
+            self._dirty_jobs.clear()
+            self._dirty_queues.clear()
+            self._snap_epoch += 1
+            ci.snap_epoch = self._snap_epoch
+            n_nodes = len(ci.nodes)
+            stats = {
+                "full": full,
+                "clone_s": time.perf_counter() - t0,
+                "dirty_nodes": cloned_nodes,
+                "reused_nodes": reused_nodes,
+                "reused_jobs": reused_jobs,
+                "dirty_ratio": (cloned_nodes / n_nodes) if n_nodes else 0.0,
+            }
+            self.last_snapshot_stats = stats
+        from .. import metrics
+        metrics.update_snapshot_stats(stats["dirty_nodes"],
+                                      stats["dirty_ratio"])
+        if full:
+            metrics.register_snapshot_full_rebuild("clone")
+        return ci
+
+    def tensor_refresh(self, snapshot_nodes: Dict[str, NodeInfo], rnames,
+                       snap_epoch: Optional[int] = None):
+        """Persistent device-resident NodeTensors for the CURRENT snapshot
+        (docs/performance.md): scatter-updates only the rows the dirty set
+        named since the last refresh instead of rebuilding f32[N,R] arrays
+        from Python dicts. ``snapshot_nodes`` must be the node dict the
+        latest snapshot() returned (Session.nodes before any session
+        mutation — values identical to live state at snapshot time);
+        ``snap_epoch`` guards against a stale session refreshing over a
+        newer snapshot's delta. Returns None when the incremental path is
+        unavailable (kill-switch off, epoch mismatch) — callers build a
+        plain NodeTensors then."""
+        if not incremental_snapshot_enabled():
+            return None
+        from .snapshot import PersistentNodeTensors
+        with self._lock:
+            if snap_epoch is not None and snap_epoch != self._snap_epoch:
+                return None
+            tc = self.tensor_cache
+            if tc is None or tc.rnames.names != rnames.names:
+                tc = PersistentNodeTensors(rnames)
+                self.tensor_cache = tc
+            dirty = self._tensor_dirty
+            self._tensor_dirty = set()
+            stats = tc.refresh(snapshot_nodes, dirty)
+        if stats["full"]:
+            from .. import metrics
+            metrics.register_snapshot_full_rebuild("tensor")
+        return tc
 
     # -- side effects (cache.go:549-666) ------------------------------------
 
@@ -259,9 +439,14 @@ class SchedulerCache:
         with self._lock:
             job = self.jobs.get(task.job)
             if job is not None and task.uid in job.tasks:
+                self._dirty_jobs.add(task.job)
+                if task.node_name:
+                    self._dirty_nodes.add(task.node_name)
                 cached = job.tasks[task.uid]
                 prev_status = cached.status
                 prev_node = cached.node_name
+                if prev_node:
+                    self._dirty_nodes.add(prev_node)
                 if not prev_node:
                     newly_placed = True
                     cached.node_name = task.node_name
@@ -306,9 +491,13 @@ class SchedulerCache:
                 job = self.jobs.get(task.job)
                 if job is None or task.uid not in job.tasks:
                     continue
+                self._dirty_jobs.add(task.job)
+                if task.node_name:
+                    self._dirty_nodes.add(task.node_name)
                 cached = job.tasks[task.uid]
                 if cached.node_name:
                     # re-bind of an already-placed task: rare; full path
+                    self._dirty_nodes.add(cached.node_name)
                     job.update_task_status(cached, TaskStatus.BOUND)
                     if cached.node_name in self.nodes:
                         self.nodes[cached.node_name].update_task(cached)
@@ -372,6 +561,7 @@ class SchedulerCache:
         with self._lock:
             job = self.jobs.get(task.job)
             if job is not None and task.uid in job.tasks:
+                self._mark_task_dirty(task)
                 job.update_task_status(job.tasks[task.uid], TaskStatus.RELEASING)
                 if task.node_name in self.nodes:
                     self.nodes[task.node_name].update_task(job.tasks[task.uid])
@@ -453,6 +643,7 @@ class SchedulerCache:
                     with self._lock:
                         job = self.jobs.get(task.job)
                         if job is not None and task.uid in job.tasks:
+                            self._mark_task_dirty(task)
                             cached = job.tasks[task.uid]
                             cached.node_name = task.node_name
                             job.update_task_status(cached, TaskStatus.BOUND)
@@ -465,6 +656,7 @@ class SchedulerCache:
                     with self._lock:
                         job = self.jobs.get(task.job)
                         if job is not None and task.uid in job.tasks:
+                            self._mark_task_dirty(task)
                             job.update_task_status(job.tasks[task.uid],
                                                    TaskStatus.RELEASING)
                 self.resync_queue.forget(key)
@@ -485,14 +677,19 @@ class SchedulerCache:
             if pod is not None:
                 pod.metadata.annotations[self.FORWARD_CLUSTER_KEY] = cluster
         job.podgroup.annotations[self.FORWARD_CLUSTER_KEY] = cluster
+        self._dirty_jobs.add(job.uid)
         self.status_updater.update_pod_group(job)
 
     def update_job_status(self, job: JobInfo) -> None:
         self.status_updater.update_pod_group(job)
         with self._lock:
             cached = self.jobs.get(job.uid)
-            if cached is not None:
+            if cached is not None and cached.podgroup is not job.podgroup:
+                # the PodGroup mirror is normally ALIASED between the live
+                # job and its snapshot clones, so phase/condition writes are
+                # visible everywhere; only an actual replacement re-dirties
                 cached.podgroup = job.podgroup
+                self._dirty_jobs.add(job.uid)
 
     def update_scheduler_numa_info(self, numa_sets) -> None:
         """Commit cpuset assignments chosen by the numaaware plugin back to
@@ -506,6 +703,7 @@ class SchedulerCache:
                 node = self.nodes.get(node_name)
                 if node is None or node.numa_info is None:
                     continue
+                self._dirty_nodes.add(node_name)
                 for task_uid, res_sets in per_task.items():
                     self._release_numa(node, task_uid)
                     node.numa_info.allocate(res_sets)

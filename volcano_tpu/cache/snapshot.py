@@ -19,7 +19,9 @@ Buffers are NumPy until the final device_put so marshaling stays cheap.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+import heapq
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -61,6 +63,224 @@ class NodeTensors:
             future_idle=jnp.asarray(self.idle + self.releasing - self.pipelined),
             used=jnp.asarray(self.used),
             ntasks=jnp.asarray(self.ntasks))
+
+    def device_allocatable(self):
+        import jax.numpy as jnp
+        return jnp.asarray(self.allocatable)
+
+    def device_max_tasks(self):
+        import jax.numpy as jnp
+        return jnp.asarray(self.max_tasks)
+
+
+def _delta_bucket(n: int) -> int:
+    """Pad dirty-row scatter updates to power-of-two buckets so a churning
+    dirty count does not mint a fresh XLA scatter shape every cycle
+    (Scheduler.prewarm warms the ladder)."""
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
+class PersistentNodeTensors:
+    """NodeTensors that survive across scheduling cycles.
+
+    Host numpy mirrors stay authoritative and are updated row-wise from the
+    dirty set; device copies are updated with padded scatter writes
+    (``array.at[idx].set``) instead of re-uploading f32[N,R] from Python
+    dicts every cycle. Node identity maps to a STABLE row index: removed
+    nodes leave a neutralized hole (all-zero row, ``max_tasks`` 0 — the
+    kernels' ``ntasks < max_tasks`` predicate makes a hole unselectable,
+    the same contract the sharded engine's N-padding relies on) that a
+    lowest-index free list hands to the next added node, so row order —
+    and therefore argmax tie-breaking — survives node churn.
+
+    Falls back to a full rebuild when the dirty ratio exceeds
+    ``rebuild_ratio`` or the row count (shape bucket) changes; both are
+    observable via ``volcano_snapshot_full_rebuilds_total{layer="tensor"}``.
+
+    Duck-types ``NodeTensors`` (names/index/arrays/node_state) so every
+    consumer of the per-cycle build works unchanged."""
+
+    def __init__(self, rnames: ResourceNames, rebuild_ratio: float = 0.5):
+        self.rnames = rnames
+        self.rebuild_ratio = rebuild_ratio
+        self.names: List[str] = []
+        self.index: Dict[str, int] = {}
+        self._free: List[int] = []           # heap of hole rows
+        R = len(rnames)
+        self.idle = np.zeros((0, R), np.float32)
+        self.used = np.zeros((0, R), np.float32)
+        self.releasing = np.zeros((0, R), np.float32)
+        self.pipelined = np.zeros((0, R), np.float32)
+        self.allocatable = np.zeros((0, R), np.float32)
+        self.max_tasks = np.zeros(0, np.int32)
+        self.ntasks = np.zeros(0, np.int32)
+        self._device: Optional[dict] = None  # field -> jnp array
+        self._node_state: Optional[NodeState] = None
+        self.last_refresh: Dict[str, object] = {}
+
+    _ROW_FIELDS = ("idle", "used", "releasing", "pipelined", "allocatable",
+                   "max_tasks", "ntasks")
+
+    def _write_row(self, i: int, node: NodeInfo) -> None:
+        rn = self.rnames
+        self.idle[i] = node.idle.to_vector(rn)
+        self.used[i] = node.used.to_vector(rn)
+        self.releasing[i] = node.releasing.to_vector(rn)
+        self.pipelined[i] = node.pipelined.to_vector(rn)
+        self.allocatable[i] = node.allocatable.to_vector(rn)
+        self.max_tasks[i] = (node.max_task_num if node.max_task_num > 0
+                             else BIG_MAX_TASKS)
+        self.ntasks[i] = len(node.tasks)
+
+    def _clear_row(self, i: int) -> None:
+        for f in ("idle", "used", "releasing", "pipelined", "allocatable"):
+            getattr(self, f)[i] = 0.0
+        self.max_tasks[i] = 0                # ntasks < max_tasks never holds
+        self.ntasks[i] = 0
+
+    def full_build(self, nodes: Dict[str, NodeInfo]) -> None:
+        """Rebuild every row in snapshot order — byte-equal to a fresh
+        ``NodeTensors(list(nodes.values()), rnames)``."""
+        self.names = list(nodes)
+        self.index = {n: i for i, n in enumerate(self.names)}
+        self._free = []
+        N, R = len(self.names), len(self.rnames)
+        for f in ("idle", "used", "releasing", "pipelined", "allocatable"):
+            setattr(self, f, np.zeros((N, R), np.float32))
+        self.max_tasks = np.zeros(N, np.int32)
+        self.ntasks = np.zeros(N, np.int32)
+        for i, node in enumerate(nodes.values()):
+            self._write_row(i, node)
+        self._device = None
+        self._node_state = None
+
+    def refresh(self, nodes: Dict[str, NodeInfo],
+                changed: Set[str]) -> Dict[str, object]:
+        """Apply one snapshot delta. ``nodes`` is the snapshot's node dict
+        (ready nodes only); ``changed`` the names whose rows may differ.
+        Returns the refresh stats dict ({"full": bool, "rows": int})."""
+        t0 = time.perf_counter()
+        removed = [n for n in self.index if n not in nodes]
+        added = [n for n in nodes if n not in self.index]
+        touch = [n for n in changed if n in self.index]
+        delta = len(removed) + len(added) + len(touch)
+        base = len(self.index)
+        full = (base == 0
+                or delta / base > self.rebuild_ratio
+                or len(added) > len(removed) + len(self._free))
+        if full:
+            self.full_build(nodes)
+            self.last_refresh = {"full": True, "rows": len(self.names),
+                                 "host_s": time.perf_counter() - t0}
+            return self.last_refresh
+        rows: List[int] = []
+        for name in removed:
+            i = self.index.pop(name)
+            self.names[i] = ""
+            heapq.heappush(self._free, i)
+            self._clear_row(i)
+            rows.append(i)
+        for name in added:
+            i = heapq.heappop(self._free)
+            self.index[name] = i
+            self.names[i] = name
+            self._write_row(i, nodes[name])
+            rows.append(i)
+        for name in touch:
+            i = self.index[name]
+            self._write_row(i, nodes[name])
+            rows.append(i)
+        host_s = time.perf_counter() - t0
+        if rows:
+            self._scatter_device(np.asarray(sorted(rows), np.int32))
+        self.last_refresh = {"full": False, "rows": len(rows),
+                             "host_s": host_s}
+        return self.last_refresh
+
+    # -- device residency ---------------------------------------------------
+
+    def _scatter_device(self, rows: np.ndarray) -> None:
+        if self._device is None:
+            return                            # first node_state() uploads
+        import jax.numpy as jnp
+        # pad the row set to a pow2 bucket (repeating the last index —
+        # duplicate scatter of identical values is deterministic) so the
+        # per-cycle dirty count does not key fresh XLA scatter shapes
+        pad = _delta_bucket(len(rows)) - len(rows)
+        idx_np = np.pad(rows, (0, pad), mode="edge")
+        idx = jnp.asarray(idx_np)
+        dev = self._device
+        for f in self._ROW_FIELDS:
+            dev[f] = dev[f].at[idx].set(jnp.asarray(getattr(self, f)[idx_np]))
+        self._node_state = None
+
+    def _ensure_device(self) -> dict:
+        if self._device is None:
+            import jax.numpy as jnp
+            self._device = {f: jnp.asarray(getattr(self, f))
+                            for f in self._ROW_FIELDS}
+            self._node_state = None
+        return self._device
+
+    def node_state(self) -> NodeState:
+        if self._node_state is None:
+            from ..ops.place import make_node_state
+            dev = self._ensure_device()
+            self._node_state = make_node_state(
+                dev["idle"], dev["releasing"], dev["pipelined"],
+                dev["used"], dev["ntasks"])
+        return self._node_state
+
+    def device_allocatable(self):
+        return self._ensure_device()["allocatable"]
+
+    def device_max_tasks(self):
+        return self._ensure_device()["max_tasks"]
+
+    def prewarm_delta(self, sizes: Sequence[int]) -> int:
+        """Compile the padded scatter-update programs for the given dirty
+        counts (snapped to the pow2 bucket ladder) with no-op writes, so
+        steady-state churn cycles never pay a cold scatter compile
+        (Scheduler.prewarm calls this next to the solver shapes)."""
+        if not self.names:
+            return 0
+        self._ensure_device()
+        warmed = set()
+        for n in sizes:
+            b = _delta_bucket(max(int(n), 1))
+            if b in warmed:
+                continue
+            # b zero-indices re-writing row 0's current values: a no-op
+            # that compiles exactly the bucket-b scatter the live path uses
+            self._scatter_device(np.zeros(b, np.int32))
+            warmed.add(b)
+        return len(warmed)
+
+
+_HOLE_NODE: Optional[NodeInfo] = None
+
+
+def node_infos_for(ssn, node_t) -> List[NodeInfo]:
+    """Session NodeInfos row-aligned with ``node_t.names`` — what plugin
+    mask/score builders iterate. PersistentNodeTensors rows freed by node
+    removal are holes (name ``""``); they map to one shared inert NodeInfo
+    (unschedulable, empty) so builders stay index-aligned without
+    per-plugin hole handling. Hole columns are unselectable in-kernel
+    regardless: their row is zeroed with ``max_tasks`` 0."""
+    global _HOLE_NODE
+    nodes = ssn.nodes
+    out: List[NodeInfo] = []
+    for name in node_t.names:
+        node = nodes.get(name)
+        if node is None:
+            if _HOLE_NODE is None:
+                _HOLE_NODE = NodeInfo(name="", unschedulable=True)
+            node = _HOLE_NODE
+        out.append(node)
+    return out
 
 
 def discover_resource_names(nodes: Sequence[NodeInfo],

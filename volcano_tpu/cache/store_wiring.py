@@ -102,7 +102,10 @@ def wire_cache_to_store(store: ObjectStore,
         for job in cache.jobs.values():
             if job.podgroup is not None and \
                     job.podgroup.priority_class_name in priorities:
-                job.priority = priorities[job.podgroup.priority_class_name]
+                value = priorities[job.podgroup.priority_class_name]
+                if job.priority != value:
+                    job.priority = value
+                    cache.mark_job_dirty(job.uid)
 
     def on_pod(event: str, pod: Pod, old: Optional[Pod]) -> None:
         task = pod_to_task(pod)
@@ -146,10 +149,12 @@ def wire_cache_to_store(store: ObjectStore,
                 existing.min_available = fresh.min_available
                 existing.queue = fresh.queue
                 existing.priority = fresh.priority
+                cache.mark_job_dirty(uid)
         elif event == DELETED:
             job = cache.jobs.get(uid)
             if job is not None:
                 job.podgroup = None
+                cache.mark_job_dirty(uid)
 
     def on_queue(event: str, q: QueueCR, old) -> None:
         if event in (ADDED, UPDATED):

@@ -65,6 +65,10 @@ class Session:
         snapshot: ClusterInfo = cache.snapshot()
         self.jobs: Dict[str, JobInfo] = snapshot.jobs
         self.nodes: Dict[str, NodeInfo] = snapshot.nodes
+        # which snapshot generation this session was opened on — the
+        # persistent-tensor refresh refuses to apply a stale session's
+        # delta over a newer snapshot's (cache.tensor_refresh)
+        self.snap_epoch = getattr(snapshot, "snap_epoch", None)
         self.queues: Dict[str, QueueInfo] = snapshot.queues
         self.namespaces = snapshot.namespaces
         self.revocable_nodes = snapshot.revocable_nodes
@@ -427,3 +431,29 @@ class Session:
     def statement(self) -> "Statement":
         from .statement import Statement
         return Statement(self)
+
+    # -- persistent tensor state (docs/performance.md) ----------------------
+
+    def snapshot_node_tensors(self, rnames):
+        """Device-resident NodeTensors for this session's snapshot, kept
+        alive across cycles by the cache and scatter-updated from the dirty
+        set. Only valid while NO session mutation has touched node state —
+        the ``_touched`` witness every NodeInfo mutation sets — because the
+        persistent rows mirror snapshot-time values; after the first
+        statement replays, mid-cycle consumers (stateful re-solve rounds,
+        preempt/reclaim) must marshal from the live session objects
+        instead. Returns None whenever the incremental path cannot prove
+        itself exact; callers fall back to a from-scratch NodeTensors."""
+        refresh = getattr(self.cache, "tensor_refresh", None)
+        if refresh is None:
+            return None
+        for node in self.nodes.values():
+            if getattr(node, "_touched", True):
+                return None
+        try:
+            return refresh(self.nodes, rnames, self.snap_epoch)
+        except Exception:
+            import logging
+            logging.getLogger(__name__).exception(
+                "persistent tensor refresh failed; rebuilding from scratch")
+            return None

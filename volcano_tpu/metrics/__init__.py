@@ -71,6 +71,16 @@ if _HAVE_PROM:
     _dead_letter = Counter(f"{_SUBSYSTEM}_resync_dead_letter_total",
                            "Side effects dropped from the resync queue after "
                            "the per-item retry cap", ["op"])
+    _snap_dirty_nodes = Gauge(f"{_SUBSYSTEM}_snapshot_dirty_nodes",
+                              "Nodes re-cloned by the last incremental "
+                              "snapshot (docs/performance.md)")
+    _snap_dirty_ratio = Gauge(f"{_SUBSYSTEM}_snapshot_dirty_ratio",
+                              "Re-cloned fraction of the last snapshot's "
+                              "node set (1.0 = full rebuild)")
+    _snap_full = Counter(f"{_SUBSYSTEM}_snapshot_full_rebuilds_total",
+                         "Snapshots (layer=clone) or tensor refreshes "
+                         "(layer=tensor) that fell back to a full rebuild",
+                         ["layer"])
 
 
 def update_e2e_duration(seconds: float) -> None:
@@ -111,6 +121,29 @@ def register_solver_fallback(action: str) -> None:
         _counters[("solver_fallback", action)] += 1
     if _HAVE_PROM:
         _solver_fb.labels(action=action).inc()
+
+
+def update_snapshot_stats(dirty_nodes: int, dirty_ratio: float) -> None:
+    """Published by SchedulerCache.snapshot every cycle: how much of the
+    cluster the incremental snapshot actually re-cloned. A dirty_ratio
+    pinned at 1.0 means clone-on-dirty is not engaging (external bulk
+    mutation, kill-switch off, or a mark_all_dirty storm)."""
+    with _lock:
+        _gauges[("snapshot_dirty_nodes",)] = float(dirty_nodes)
+        _gauges[("snapshot_dirty_ratio",)] = float(dirty_ratio)
+    if _HAVE_PROM:
+        _snap_dirty_nodes.set(dirty_nodes)
+        _snap_dirty_ratio.set(dirty_ratio)
+
+
+def register_snapshot_full_rebuild(layer: str) -> None:
+    """A snapshot (layer="clone") or persistent-tensor refresh
+    (layer="tensor") fell back to a full rebuild — expected at startup and
+    after bulk mutation; a steady stream of these is a fallback storm."""
+    with _lock:
+        _counters[("snapshot_full_rebuilds", layer)] += 1
+    if _HAVE_PROM:
+        _snap_full.labels(layer=layer).inc()
 
 
 def register_dead_letter(op: str) -> None:

@@ -152,7 +152,8 @@ class NodeOrderPlugin(Plugin):
     # the common case — python loops only over tasks with affinity
     # preferences and nodes with PreferNoSchedule taints.
     def _static_matrix(self, ssn, tasks, node_t):
-        node_infos = [ssn.nodes[name] for name in node_t.names]
+        from ..cache.snapshot import node_infos_for
+        node_infos = node_infos_for(ssn, node_t)
         T, N = len(tasks), len(node_infos)
         has_pref_taints = any(
             t.get("effect") == "PreferNoSchedule"
@@ -193,10 +194,13 @@ class NodeOrderPlugin(Plugin):
                                       session_has_pod_affinity)
             if session_has_pod_affinity(ssn):
                 idx = get_pod_affinity_index(ssn)
+                cols = np.asarray([idx.node_index.get(n, -1)
+                                   for n in node_t.names])
+                hole = cols < 0             # persistent-tensor hole rows
                 for ti, task in enumerate(tasks):
                     row = idx.score_row(task)
                     if row is not None:
-                        sub = row[[idx.node_index[n] for n in node_t.names]]
+                        sub = np.where(hole, 0.0, row[cols])
                         score[ti] += self.pod_affinity_weight * \
                             normalize_scores(sub)
         return score

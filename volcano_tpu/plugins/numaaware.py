@@ -284,7 +284,8 @@ class NumaAwarePlugin(Plugin):
                 return None
             import numpy as np
             from .predicates import PredicateError
-            node_infos = [ssn_.nodes[name] for name in node_t.names]
+            from ..cache.snapshot import node_infos_for
+            node_infos = node_infos_for(ssn_, node_t)
             mask = np.ones((len(tasks), len(node_infos)), dtype=bool)
             for ti in relevant:
                 for ni, node in enumerate(node_infos):

@@ -174,7 +174,8 @@ class PredicatesPlugin(Plugin):
                 raise PredicateError(task, node, PROPORTIONAL_FAILED)
 
     def feasibility_mask(self, ssn, tasks, node_t):
-        node_infos = [ssn.nodes[name] for name in node_t.names]
+        from ..cache.snapshot import node_infos_for
+        node_infos = node_infos_for(ssn, node_t)
         T, N = len(tasks), len(node_infos)
         any_taints = any(n.taints for n in node_infos)   # O(N), once
         any_unsched = any(n.unschedulable for n in node_infos)

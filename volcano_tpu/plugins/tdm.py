@@ -117,7 +117,8 @@ class TDMPlugin(Plugin):
 
         def feasibility(ssn_, tasks, node_t):
             import numpy as np
-            node_infos = [ssn_.nodes[name] for name in node_t.names]
+            from ..cache.snapshot import node_infos_for
+            node_infos = node_infos_for(ssn_, node_t)
             if not any(n.revocable_zone for n in node_infos):
                 return None
             mask = np.ones((len(tasks), len(node_infos)), dtype=bool)

@@ -129,14 +129,20 @@ class Scheduler:
                 log.exception("resync processing failed")
                 metrics.register_action_failure("resync")
                 errors.append(("resync", exc))
+        # A cycle whose pipeline resolves to NO runnable action is a no-op:
+        # don't pay cache.snapshot() (re-cloning queues/jobs at 10k scale)
+        # plus a full open/close just to run zero actions — the state a
+        # degraded scheduler sits in when its conf names only unregistered
+        # actions (bad hot-reload) and the crash-loop guard is skipping work.
+        runnable = [(name, get_action(name)) for name in self.conf.actions]
+        runnable = [(n, a) for n, a in runnable if a is not None]
+        if not runnable:
+            return errors
         start = time.perf_counter()
         ssn = open_session(self.cache, self.conf.tiers,
                            self.conf.configurations)
         try:
-            for name in self.conf.actions:
-                action = get_action(name)
-                if action is None:
-                    continue
+            for name, action in runnable:
                 action_start = time.perf_counter()
                 try:
                     if self.action_fault_hook is not None:

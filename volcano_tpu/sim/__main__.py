@@ -14,7 +14,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .report import to_json
+from .report import deterministic_json, to_json
 from .runner import SimRunner
 from .trace import load_trace, write_trace
 from .workload import SCENARIOS, make_scenario
@@ -38,6 +38,10 @@ def main(argv=None) -> int:
     ap.add_argument("--out", help="also write the report JSON to this file")
     ap.add_argument("--write-trace",
                     help="write the (generated) trace to this JSONL file")
+    ap.add_argument("--deterministic", action="store_true",
+                    help="print ONLY the decision plane as canonical JSON "
+                         "(byte-comparable across runs — the CI "
+                         "sim-determinism step diffs this)")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -61,7 +65,8 @@ def main(argv=None) -> int:
                        seed=args.seed, max_cycles=args.max_cycles,
                        scenario=args.scenario)
     report = runner.run()
-    text = to_json(report)
+    text = deterministic_json(report) if args.deterministic \
+        else to_json(report)
     print(text)
     if args.out:
         with open(args.out, "w") as f:

@@ -165,10 +165,13 @@ class SimRunner:
             node = self.cache.nodes.get(d["name"])
             if node is not None:
                 node.ready = False
+                # direct mutation bypasses the cache's own dirty tracking
+                self.cache.mark_node_dirty(node.name)
         elif ev.kind == "node_restore":
             node = self.cache.nodes.get(d["name"])
             if node is not None:
                 node.ready = True
+                self.cache.mark_node_dirty(node.name)
         elif ev.kind == "node_fail":
             self._fail_node(d["name"])
         elif ev.kind == "job_arrival":
@@ -216,6 +219,11 @@ class SimRunner:
             return
         cached = job.tasks[uid]
         node = self.cache.nodes.get(cached.node_name)
+        if cached.node_name:
+            # mirrors job/node state directly (delete + controller
+            # recreate, collapsed): tell the incremental snapshot
+            self.cache.mark_node_dirty(cached.node_name)
+        self.cache.mark_job_dirty(job.uid)
         if on_node and node is not None and uid in node.tasks:
             node.remove_task(cached)
         cached.node_name = ""
