@@ -630,11 +630,22 @@ def main():
         f"({[round(t * 1e3, 1) for t in churn_times]} ms, median "
         f"{med * 1e3:.1f} ms): a cold shape bucket is back inside the "
         f"steady-state loop")
+    # ZERO post-prewarm compiles, EVERY cycle (the r05 hole: cycle 1 paid
+    # 6.5 s / 8 compiles because the warm-up missed a bucket the rig
+    # hits). Scheduler.prewarm covers both cycle shapes AND the pow2 job
+    # bucket (allocate._job_bucket) + the scatter-delta ladder, so any
+    # compile inside the loop is a prewarm coverage hole — fail loudly.
+    assert all(c == 0 for c in churn_compiles), (
+        f"churn cycles compiled post-prewarm: prewarm_shapes is missing "
+        f"a shape bucket the steady-state loop hits. Per-cycle compiles "
+        f"{churn_compiles}, per-cycle ms "
+        f"{[round(t * 1e3, 1) for t in churn_times]}, prewarm "
+        f"{churn_prewarm_s * 1e3:.0f}ms/{churn_prewarm_c} compiles")
     extras.update(churn_cycle_ms=[round(t * 1e3, 1) for t in churn_times],
                   churn_compiles=churn_compiles,
                   churn_prewarm_ms=round(churn_prewarm_s * 1e3, 1),
                   churn_prewarm_compiles=churn_prewarm_c,
-                  churn_steady_ok=all(c == 0 for c in churn_compiles[2:]))
+                  churn_steady_ok=all(c == 0 for c in churn_compiles))
 
     # long-axis scale (VERDICT r5 #5): 20k pods / 5k nodes, fused +
     # sharded engines (binds reported per engine — capacity is a full

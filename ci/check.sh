@@ -14,10 +14,12 @@ cd "$(dirname "$0")/.."
 run_python=true
 run_shim=true
 run_sim=true
+run_soak=true
 case "${1:-}" in
-  --shim-only) run_python=false; run_sim=false ;;
-  --python-only) run_shim=false; run_sim=false ;;
-  --sim-only) run_python=false; run_shim=false ;;
+  --shim-only) run_python=false; run_sim=false; run_soak=false ;;
+  --python-only) run_shim=false; run_sim=false; run_soak=false ;;
+  --sim-only) run_python=false; run_shim=false; run_soak=false ;;
+  --soak-only) run_python=false; run_shim=false; run_sim=false ;;
 esac
 
 if $run_python; then
@@ -33,7 +35,7 @@ if $run_sim; then
   # determinism fails CI here, not just the slow-marked 10k test.
   echo "== sim-determinism: fast scenarios, decision-plane diff =="
   simdir=$(mktemp -d)
-  trap 'rm -rf "$simdir"' EXIT
+  trap 'rm -rf "${simdir:-/nonexistent}" "${soakdir:-/nonexistent}"' EXIT
   for scenario in smoke skew; do
     JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario "$scenario" \
       --seed 3 --deterministic > "$simdir/$scenario.a.json"
@@ -48,6 +50,34 @@ if $run_sim; then
       || { echo "sim-determinism FAILED: $scenario decisions differ with \
 incremental snapshots off"; exit 1; }
     echo "   $scenario: decision plane byte-identical (x2 + incremental off)"
+  done
+fi
+
+if $run_soak; then
+  # chaos soak (docs/robustness.md): the smoke scenario with seeded kills
+  # at random cycles + 20% bind/evict faults must (a) converge to the
+  # same terminal decision-plane accounting as the unkilled run with
+  # zero double-binds (--verify-restart-equivalence runs both and
+  # compares), and (b) be byte-deterministic — the recovered run's
+  # decision plane reproduces exactly from (trace, seed, kill config).
+  echo "== chaos-soak: kill/restart + 20% faults, restart equivalence =="
+  soakdir=$(mktemp -d)
+  trap 'rm -rf "${simdir:-/nonexistent}" "${soakdir:-/nonexistent}"' EXIT
+  # skew is the scenario whose preempt/evict churn exposed the stale
+  # bind-retry corruption — keep both worlds in the soak
+  for scenario in smoke skew; do
+    JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario "$scenario" \
+      --seed 3 --chaos-rate 0.2 --kill-cycles 2,5,9,13 --kill-seed 1 \
+      --verify-restart-equivalence --deterministic \
+      > "$soakdir/$scenario.a.json" \
+      || { echo "chaos-soak FAILED: $scenario restart equivalence"; exit 1; }
+    JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario "$scenario" \
+      --seed 3 --chaos-rate 0.2 --kill-cycles 2,5,9,13 --kill-seed 1 \
+      --deterministic > "$soakdir/$scenario.b.json"
+    diff "$soakdir/$scenario.a.json" "$soakdir/$scenario.b.json" \
+      || { echo "chaos-soak FAILED: $scenario recovered run not \
+deterministic"; exit 1; }
+    echo "   $scenario: killed run converged, deterministic, zero double-binds"
   done
 fi
 

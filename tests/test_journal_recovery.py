@@ -1,0 +1,813 @@
+"""State integrity & crash recovery (docs/robustness.md).
+
+Covers the intent journal's WAL discipline at every crash phase (before/
+after the bind/evict executor, i.e. before the ack either way), startup
+reconciliation (oracle and no-oracle modes), journal durability details
+(file recovery, rotation-by-compaction, kill-switch), the drift
+self-healing shadow verifier (node/job/tensor layers), device-fault
+containment (classification, epoch bump, cool-down, re-probe), and the
+restart-under-chaos sim soak that ties it all together.
+
+Every seeded test embeds its seed in assertion messages.
+"""
+
+import os
+
+import pytest
+
+from volcano_tpu import metrics
+from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
+                             Resource, TaskInfo, TaskStatus)
+from volcano_tpu.cache import SchedulerCache, SequenceBinder, SequenceEvictor
+from volcano_tpu.cache.journal import IntentJournal, journal_enabled
+from volcano_tpu.chaos import (ChaosBinder, ChaosEvictor, DeviceFaultInjector,
+                               KillPointBinder, KillPointEvictor, SimKill)
+from volcano_tpu.device_health import (DEVICE_HEALTH, DeviceFaultError,
+                                       classify_device_fault)
+from volcano_tpu.scheduler import Scheduler
+
+GI = 1 << 30
+SEED = 20260803
+
+pytestmark = pytest.mark.chaos
+
+
+def make_world(binder, evictor=None, n_nodes=4, n_jobs=4, tasks_per_job=3,
+               **cache_kw):
+    cache = SchedulerCache(binder=binder, evictor=evictor or SequenceEvictor(),
+                           **cache_kw)
+    for i in range(n_nodes):
+        alloc = Resource(16000, 32 * GI)
+        alloc.max_task_num = 110
+        cache.add_node(NodeInfo(name=f"n{i}", allocatable=alloc))
+    for j in range(n_jobs):
+        pg = PodGroup(name=f"j{j}", queue="default",
+                      min_member=tasks_per_job, phase=PodGroupPhase.INQUEUE)
+        job = JobInfo(uid=f"j{j}", name=f"j{j}", queue="default",
+                      min_available=tasks_per_job, podgroup=pg)
+        for k in range(tasks_per_job):
+            job.add_task_info(TaskInfo(uid=f"j{j}-{k}", name=f"j{j}-{k}",
+                                       job=f"j{j}",
+                                       resreq=Resource(1000, GI)))
+        cache.add_job(job)
+    return cache
+
+
+def assert_exact_accounting(cache, ctx=""):
+    for node in cache.nodes.values():
+        expected = Resource()
+        for t in node.tasks.values():
+            if t.status not in (TaskStatus.PIPELINED, TaskStatus.RELEASING):
+                expected.add(t.resreq)
+        assert node.used == expected, \
+            f"{ctx}: node {node.name} used drifted"
+        assert node.idle == node.allocatable.clone().sub(expected), \
+            f"{ctx}: node {node.name} idle drifted"
+
+
+def drive_to_bound(cache, cycles=30):
+    sched = Scheduler(cache, schedule_period=0.0, drift_verify_every=0)
+    total = sum(len(j.tasks) for j in cache.jobs.values())
+    for _ in range(cycles):
+        sched.run_once()
+        bound = sum(1 for j in cache.jobs.values()
+                    for t in j.tasks.values()
+                    if t.status == TaskStatus.BOUND)
+        if bound == total and not len(cache.resync_queue):
+            break
+    return sched
+
+
+def oracle(binder, evictor):
+    """Cluster-truth oracle from the executors' tails — only the LAST
+    executed side effect can be the crash window's unacked one."""
+    return (dict(binder.sequence[-1:]),
+            lambda uid: bool(evictor.sequence)
+            and evictor.sequence[-1] == uid)
+
+
+def simulate_restart(cache, binder, evictor):
+    """What a process death loses + startup reconciliation, exactly as
+    SimRunner._crash_restart models it."""
+    from volcano_tpu.cache.cache import RateLimitedQueue
+    cache.binding_tasks.clear()
+    cache.dead_letter.clear()
+    cache.resync_queue = RateLimitedQueue(max_retries=12)
+    cache.mark_all_dirty()
+    cache.tensor_cache = None
+    binds, evicts = oracle(binder, evictor)
+    return cache.reconcile_journal(binds, evicts)
+
+
+# ---------------------------------------------------------------------------
+# kill-at-every-phase journal tests
+# ---------------------------------------------------------------------------
+
+
+class TestBindCrashPhases:
+    def _crash_bind(self, before: bool):
+        inner = SequenceBinder()
+        kb = KillPointBinder(inner)
+        cache = make_world(kb, journal=IntentJournal())
+        kb.arm(3, before=before)           # die at the 3rd bind of cycle 0
+        sched = Scheduler(cache, schedule_period=0.0, drift_verify_every=0)
+        with pytest.raises(SimKill):
+            sched.run_once()
+        return cache, inner, kb
+
+    def test_crash_before_bind_ack_rolls_back(self):
+        """Crash BEFORE the executor ran: the optimistic BOUND mark must
+        roll back at reconciliation — the cluster never saw the bind."""
+        cache, inner, _ = self._crash_bind(before=True)
+        open_intents = cache.journal.unacked()
+        assert len(open_intents) == 1 and open_intents[0].op == "bind"
+        victim = open_intents[0].task
+        report = simulate_restart(cache, inner, SequenceEvictor())
+        assert report.rolled_back == 1 and report.repaired_binds == 0, \
+            f"{report}"
+        job = cache.jobs[open_intents[0].job]
+        task = job.tasks[victim]
+        assert task.status == TaskStatus.PENDING and not task.node_name
+        assert not any(victim in n.tasks for n in cache.nodes.values())
+        assert_exact_accounting(cache, "after rollback")
+        # the journal settled: nothing outstanding, reconcile idempotent
+        assert len(cache.journal.unacked()) == 0
+        report2 = simulate_restart(cache, inner, SequenceEvictor())
+        assert report2.replayed == 0
+        # the new incarnation converges with ZERO double-binds
+        drive_to_bound(cache)
+        uids = [u for u, _ in inner.sequence]
+        assert sorted(uids) == sorted(set(uids)), "double-bind detected"
+        total = sum(len(j.tasks) for j in cache.jobs.values())
+        assert len(uids) == total
+        assert_exact_accounting(cache, "after recovery")
+
+    def test_crash_after_bind_ack_repairs_without_rebind(self):
+        """Crash AFTER the executor ran but before the ack: the cluster
+        HAS the bind; reconciliation re-asserts it into cache state and
+        must NOT re-issue the bind (that would be the double-bind)."""
+        cache, inner, _ = self._crash_bind(before=False)
+        open_intents = cache.journal.unacked()
+        assert len(open_intents) == 1
+        victim, node = open_intents[0].task, open_intents[0].node
+        executed_before = len(inner.sequence)
+        report = simulate_restart(cache, inner, SequenceEvictor())
+        assert report.repaired_binds == 1 and report.rolled_back == 0, \
+            f"{report}"
+        assert len(inner.sequence) == executed_before, \
+            "reconciliation re-issued an already-executed bind"
+        job = cache.jobs[open_intents[0].job]
+        task = job.tasks[victim]
+        assert task.status == TaskStatus.BOUND and task.node_name == node
+        assert victim in cache.nodes[node].tasks
+        assert_exact_accounting(cache, "after repair")
+        drive_to_bound(cache)
+        uids = [u for u, _ in inner.sequence]
+        assert sorted(uids) == sorted(set(uids)), "double-bind detected"
+        assert_exact_accounting(cache, "after recovery")
+
+
+class TestRebindCrashPhase:
+    def test_crash_before_rebind_keeps_previous_placement(self):
+        """A RE-bind intent (task already validly placed) whose executor
+        never ran must NOT be rolled back to pending: the cluster still
+        runs the task on its previous node, and stripping it would let
+        the next cycle re-place a live task — a double-bind."""
+        inner = SequenceBinder()
+        kb = KillPointBinder(inner)
+        cache = make_world(kb, journal=IntentJournal())
+        drive_to_bound(cache)
+        job = cache.jobs["j0"]
+        task = next(iter(job.tasks.values()))
+        prev_node = task.node_name
+        rebind = task.shallow_clone()
+        rebind.node_name = [n for n in cache.nodes if n != prev_node][0]
+        kb.arm(1, before=True)
+        with pytest.raises(SimKill):
+            cache.bind(rebind)
+        intent = cache.journal.unacked()[0]
+        assert intent.fresh is False and intent.node == rebind.node_name
+        report = simulate_restart(cache, inner, SequenceEvictor())
+        assert report.rolled_back == 1, f"{report}"
+        cached = job.tasks[task.uid]
+        assert cached.node_name == prev_node, \
+            "re-bind rollback stripped the still-live previous placement"
+        assert cached.uid in cache.nodes[prev_node].tasks
+        assert_exact_accounting(cache, "re-bind rollback")
+
+
+class TestEvictCrashPhases:
+    def _world_with_bound(self):
+        inner = SequenceBinder()
+        evictor = SequenceEvictor()
+        ke = KillPointEvictor(evictor)
+        cache = make_world(inner, ke, journal=IntentJournal())
+        drive_to_bound(cache)
+        return cache, inner, evictor, ke
+
+    def test_crash_before_evict_ack_leaves_decision_to_next_cycle(self):
+        cache, inner, evictor, ke = self._world_with_bound()
+        job = cache.jobs["j0"]
+        task = next(iter(job.tasks.values()))
+        ke.arm(1, before=True)
+        with pytest.raises(SimKill):
+            cache.evict(task, "test")
+        assert len(cache.journal.unacked()) == 1
+        report = simulate_restart(cache, inner, evictor)
+        assert report.rolled_back == 1, f"{report}"
+        # the evict never happened: the task still runs, accounting exact
+        assert job.tasks[task.uid].status == TaskStatus.BOUND
+        assert not evictor.sequence
+        assert_exact_accounting(cache, "evict-before")
+
+    def test_crash_after_evict_ack_repairs_releasing(self):
+        cache, inner, evictor, ke = self._world_with_bound()
+        job = cache.jobs["j0"]
+        task = next(iter(job.tasks.values()))
+        ke.arm(1, before=False)
+        with pytest.raises(SimKill):
+            cache.evict(task, "test")
+        assert evictor.sequence == [task.uid]      # cluster executed it
+        report = simulate_restart(cache, inner, evictor)
+        assert report.repaired_evicts == 1, f"{report}"
+        assert job.tasks[task.uid].status == TaskStatus.RELEASING
+        assert len(evictor.sequence) == 1, "evict re-issued"
+
+
+class TestNoOracleRedo:
+    def test_unacked_bind_redone_idempotently_onto_journaled_node(self):
+        """Without a cluster oracle the reconciler REDOES the intent —
+        always onto the journaled node, never a re-placement."""
+        inner = SequenceBinder()
+        kb = KillPointBinder(inner)
+        cache = make_world(kb, journal=IntentJournal())
+        kb.arm(2, before=True)
+        sched = Scheduler(cache, schedule_period=0.0, drift_verify_every=0)
+        with pytest.raises(SimKill):
+            sched.run_once()
+        intent = cache.journal.unacked()[0]
+        report = cache.reconcile_journal()         # no oracle
+        assert report.redone == 1, f"{report}"
+        task = cache.jobs[intent.job].tasks[intent.task]
+        assert task.status == TaskStatus.BOUND
+        assert task.node_name == intent.node, \
+            "redo must target the JOURNALED node"
+        assert_exact_accounting(cache, "no-oracle redo")
+
+    def test_stale_intent_for_deleted_task_dropped(self):
+        inner = SequenceBinder()
+        kb = KillPointBinder(inner)
+        cache = make_world(kb, journal=IntentJournal())
+        kb.arm(1, before=True)
+        sched = Scheduler(cache, schedule_period=0.0, drift_verify_every=0)
+        with pytest.raises(SimKill):
+            sched.run_once()
+        intent = cache.journal.unacked()[0]
+        for t in list(cache.jobs[intent.job].tasks.values()):
+            cache.delete_task(t)
+        cache.remove_job(intent.job)
+        report = cache.reconcile_journal()
+        assert report.stale == 1 and report.redone == 0, f"{report}"
+
+
+# ---------------------------------------------------------------------------
+# resync retry validity (the chaos-skew corruption, found by this PR's soak)
+# ---------------------------------------------------------------------------
+
+
+class TestResyncBindValidity:
+    """A queued bind retry whose placement decision was invalidated while
+    it sat in backoff (task evicted/recreated, node filled up) must be
+    DROPPED, not re-executed: re-executing raced the scheduler's own
+    re-placement (double-bind) and half-applied BOUND state when
+    node.add_task blew up on the now-full node."""
+
+    def _world_with_queued_retry(self):
+        inner = SequenceBinder()
+        # fail exactly the first bind: rate 1.0 for one call via plan
+        class FailFirst(SequenceBinder):
+            def __init__(self, inner):
+                super().__init__()
+                self.inner = inner
+                self.calls = 0
+
+            def bind(self, task, hostname):
+                self.calls += 1
+                if self.calls == 1:
+                    raise RuntimeError("transient")
+                self.inner.bind(task, hostname)
+                super().bind(task, hostname)
+        binder = FailFirst(inner)
+        cache = make_world(binder, n_nodes=1, n_jobs=1, tasks_per_job=1)
+        sched = Scheduler(cache, schedule_period=0.0, drift_verify_every=0)
+        sched.run_once()                    # bind fails -> retry queued
+        assert len(cache.resync_queue) == 1
+        return cache, inner
+
+    def test_retry_dropped_when_node_filled_up(self):
+        import time as _time
+        cache, inner = self._world_with_queued_retry()
+        # meanwhile the node fills to the brim (another scheduler
+        # decision, a bigger pod, whatever): the retry's target has no
+        # room left
+        node = cache.nodes["n0"]
+        filler = TaskInfo(uid="filler", name="filler", job="jX",
+                          resreq=node.idle.clone(),
+                          status=TaskStatus.RUNNING)
+        filler.node_name = "n0"
+        cache.add_task(filler)
+        _time.sleep(0.02)                   # let the backoff expire
+        done = cache.process_resync_tasks()
+        assert done == 0 and len(cache.resync_queue) == 0, \
+            "retry against a full node must be dropped, not executed"
+        assert not inner.sequence, "retry executed the stale bind"
+        task = next(iter(cache.jobs["j0"].tasks.values()))
+        assert task.status == TaskStatus.PENDING, \
+            "half-applied BOUND state"
+        assert_exact_accounting(cache, "full-node retry")
+
+    def test_retry_dropped_for_releasing_task(self):
+        import time as _time
+        cache, inner = self._world_with_queued_retry()
+        job = cache.jobs["j0"]
+        task = next(iter(job.tasks.values()))
+        # the task got placed+evicted through another path meanwhile:
+        # RELEASING is not a state a bind retry may stomp on
+        job.update_task_status(task, TaskStatus.RELEASING)
+        _time.sleep(0.02)
+        assert cache.process_resync_tasks() == 0
+        assert len(cache.resync_queue) == 0
+        assert not inner.sequence
+
+    def test_valid_retry_still_executes(self):
+        import time as _time
+        cache, inner = self._world_with_queued_retry()
+        _time.sleep(0.02)
+        assert cache.process_resync_tasks() == 1
+        assert [u for u, _ in inner.sequence] == ["j0-0"]
+        task = next(iter(cache.jobs["j0"].tasks.values()))
+        assert task.status == TaskStatus.BOUND
+        assert_exact_accounting(cache, "valid retry")
+
+    def test_evict_retry_updates_node_mirror(self):
+        """The evict-retry success path must update the NODE's task
+        mirror and accounting like the direct evict path does — the node
+        stores a CLONE, so a job-only status flip left a phantom RUNNING
+        task occupying idle (found by this PR's chaos-skew soak: preempt
+        selected it as a victim and drf's share math blew up)."""
+        import time as _time
+
+        class FailFirstEvictor(SequenceEvictor):
+            def __init__(self):
+                super().__init__()
+                self.calls = 0
+
+            def evict(self, task, reason):
+                self.calls += 1
+                if self.calls == 1:
+                    raise RuntimeError("transient")
+                super().evict(task, reason)
+
+        evictor = FailFirstEvictor()
+        cache = make_world(SequenceBinder(), evictor,
+                           n_jobs=1, tasks_per_job=1)
+        drive_to_bound(cache)
+        job = cache.jobs["j0"]
+        task = next(iter(job.tasks.values()))
+        node = cache.nodes[task.node_name]
+        cache.evict(task, "test")              # fails -> retry queued
+        assert task.status == TaskStatus.BOUND
+        _time.sleep(0.02)
+        assert cache.process_resync_tasks() == 1
+        assert job.tasks[task.uid].status == TaskStatus.RELEASING
+        assert node.tasks[task.uid].status == TaskStatus.RELEASING, \
+            "node mirror kept the pre-evict status"
+        assert node.releasing == task.resreq, \
+            "releasing bucket not accounted on the node"
+
+
+# ---------------------------------------------------------------------------
+# journal durability mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestJournalFile:
+    def test_file_recovery_after_process_death(self, tmp_path):
+        """A NEW IntentJournal over the old file sees exactly the unacked
+        intents — the real restart path (in-memory journals model this
+        only because the test process survives)."""
+        path = str(tmp_path / "journal.jsonl")
+        j = IntentJournal(path, fsync_batch=1)
+        t1 = TaskInfo(uid="t1", name="t1", job="j1", resreq=Resource(1, 1))
+        t2 = TaskInfo(uid="t2", name="t2", job="j1", resreq=Resource(1, 1))
+        s1 = j.record_intent("bind", t1, "n0")
+        j.ack(s1, True)
+        j.record_intent("bind", t2, "n1")          # never acked: the window
+        j.close()
+        j2 = IntentJournal(path)
+        open_intents = j2.unacked()
+        assert [(i.op, i.task, i.node) for i in open_intents] \
+            == [("bind", "t2", "n1")]
+        # seq continues past the recovered history — no seq reuse
+        s3 = j2.record_intent("evict", t1)
+        assert s3 > open_intents[0].seq
+        j2.close()
+
+    def test_rotation_compacts_acked_records(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        j = IntentJournal(path, fsync_batch=4, max_bytes=2000)
+        t = TaskInfo(uid="t1", name="t1", job="j1", resreq=Resource(1, 1))
+        keep = j.record_intent("bind", t, "n-keep")
+        for i in range(200):
+            s = j.record_intent("bind", t, f"n{i}")
+            j.ack(s, True)
+        assert j.rotations > 0, "size cap never triggered rotation"
+        assert os.path.getsize(path) < 2500, "rotation did not compact"
+        j.close()
+        j2 = IntentJournal(path)
+        assert [i.seq for i in j2.unacked()] == [keep], \
+            "compaction lost the open intent or kept acked ones"
+        j2.close()
+
+    def test_torn_tail_line_is_dropped(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        j = IntentJournal(path, fsync_batch=1)
+        t = TaskInfo(uid="t1", name="t1", job="j1", resreq=Resource(1, 1))
+        j.record_intent("bind", t, "n0")
+        j.close()
+        with open(path, "a") as f:
+            f.write('{"kind": "intent", "seq": 99, "op": "bi')   # torn
+        j2 = IntentJournal(path)
+        assert [i.task for i in j2.unacked()] == ["t1"]
+        j2.close()
+
+    def test_intent_durable_before_executor_runs(self, tmp_path):
+        """The WAL ordering the reconciler rests on: by the time the
+        binder executes, the intent must already be ON DISK (fsynced) —
+        a SIGKILL right after the executor call must leave a recoverable
+        intent even with a huge fsync batch."""
+        path = str(tmp_path / "journal.jsonl")
+
+        class DiskCheckingBinder(SequenceBinder):
+            def __init__(self):
+                super().__init__()
+                self.intent_on_disk_at_bind = []
+
+            def bind(self, task, hostname):
+                with open(path) as f:
+                    on_disk = any(f'"task":"{task.uid}"' in line
+                                  and '"kind":"intent"' in line
+                                  for line in f)
+                self.intent_on_disk_at_bind.append((task.uid, on_disk))
+                super().bind(task, hostname)
+
+        binder = DiskCheckingBinder()
+        journal = IntentJournal(path, fsync_batch=10_000)   # never batches
+        cache = make_world(binder, journal=journal)
+        drive_to_bound(cache)
+        assert binder.intent_on_disk_at_bind, "no binds executed"
+        missing = [u for u, ok in binder.intent_on_disk_at_bind if not ok]
+        assert not missing, \
+            f"binds executed before their intent was durable: {missing}"
+
+    def test_kill_switch_detaches_journal(self, monkeypatch):
+        monkeypatch.setenv("VOLCANO_TPU_JOURNAL", "0")
+        assert not journal_enabled()
+        cache = SchedulerCache(journal=IntentJournal())
+        assert cache.journal is None
+        monkeypatch.delenv("VOLCANO_TPU_JOURNAL")
+        assert journal_enabled()
+
+
+# ---------------------------------------------------------------------------
+# drift self-healing
+# ---------------------------------------------------------------------------
+
+
+def _snapshotted_world(n_jobs=2):
+    cache = make_world(SequenceBinder(), n_jobs=n_jobs)
+    drive_to_bound(cache)
+    cache.snapshot()           # absorb: dirty sets clear, clones cached
+    return cache
+
+
+class TestDriftSelfHealing:
+    def test_clean_state_verifies_clean(self):
+        cache = _snapshotted_world()
+        stats = cache.verify_state_integrity()
+        assert stats["drift_total"] == 0 and not stats["repaired"]
+
+    def test_node_drift_detected_and_repaired(self):
+        """A live-node mutation that misses every dirty mark (the exact
+        bug class clone-on-dirty can't see) is detected and repaired by
+        forcing the full-rebuild path."""
+        metrics.reset_local()
+        cache = _snapshotted_world()
+        node = cache.nodes["n0"]
+        node.idle.sub(Resource(500, GI))           # no dirty mark, no witness
+        node._touched = False
+        stats = cache.verify_state_integrity()
+        assert stats["drift"].get("node") == ["n0"], f"{stats}"
+        assert stats["repaired"] and cache._dirty_all
+        assert metrics.local_counters().get(("state_drift", "node")) == 1
+        # the repair makes the NEXT snapshot serve live truth again
+        snap = cache.snapshot()
+        assert snap.nodes["n0"].idle == node.idle
+
+    def test_job_drift_detected(self):
+        cache = _snapshotted_world()
+        job = cache.jobs["j0"]
+        task = next(iter(job.tasks.values()))
+        task.status = TaskStatus.RUNNING           # bypasses every funnel
+        job._touched = False
+        stats = cache.verify_state_integrity()
+        assert "j0" in stats["drift"].get("job", []), f"{stats}"
+
+    def test_tensor_row_drift_detected_and_repaired(self):
+        from volcano_tpu.cache.snapshot import discover_resource_names
+        metrics.reset_local()
+        cache = _snapshotted_world()
+        snap = cache.snapshot()
+        rn = discover_resource_names(
+            list(cache.nodes.values()),
+            [t for j in cache.jobs.values() for t in j.tasks.values()])
+        tc = cache.tensor_refresh(snap.nodes, rn, snap.snap_epoch)
+        assert tc is not None
+        tc.idle[0, 0] += 7.0                       # corrupt one row
+        stats = cache.verify_state_integrity()
+        assert stats["drift"].get("tensor"), f"{stats}"
+        assert cache.tensor_cache is None, \
+            "tensor drift must drop the persistent cache (full rebuild)"
+        assert metrics.local_counters().get(("state_drift", "tensor")) == 1
+
+    def test_scheduler_drives_cadence_off_cycle(self):
+        """With drift_verify_every=N the shell detects an injected
+        corruption within N cycles, after the e2e-timed window."""
+        metrics.reset_local()
+        cache = make_world(SequenceBinder())
+        sched = Scheduler(cache, schedule_period=0.0, drift_verify_every=3)
+        for _ in range(4):
+            sched.run_once()
+        node = cache.nodes["n1"]
+        node.used.add(Resource(123, GI))           # silent corruption
+        node._touched = False
+        cache._dirty_nodes.discard("n1")
+        for _ in range(3):
+            sched.run_once()
+        assert metrics.local_counters().get(("state_drift", "node"), 0) >= 1
+        # repaired: the live cache now snapshots its (corrupted-but-true)
+        # state, so a fresh verify is clean again
+        assert cache.verify_state_integrity()["drift_total"] == 0
+
+    def test_dirty_marked_changes_are_not_drift(self):
+        cache = _snapshotted_world()
+        node = cache.nodes["n0"]
+        node.idle.sub(Resource(500, GI))
+        cache.mark_node_dirty("n0")                # properly marked
+        stats = cache.verify_state_integrity()
+        assert stats["drift_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# device-fault containment
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+@pytest.fixture
+def device_rig():
+    from volcano_tpu.actions import allocate as alloc_mod
+    clock = FakeClock()
+    DEVICE_HEALTH.reset(time_fn=clock)
+    yield clock
+    alloc_mod.DEVICE_FAULT_HOOK = None
+    import time as _time
+    DEVICE_HEALTH.reset(time_fn=_time.monotonic)
+
+
+class TestDeviceFaultContainment:
+    def test_classification(self):
+        class XlaRuntimeError(RuntimeError):
+            pass
+        assert classify_device_fault(
+            XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory")) == "oom"
+        assert classify_device_fault(
+            XlaRuntimeError("DEVICE_LOST: tpu died")) == "device_lost"
+        assert classify_device_fault(
+            XlaRuntimeError("something internal")) == "xla"
+        assert classify_device_fault(ValueError("RESOURCE_EXHAUSTED")) \
+            is None, "only XlaRuntimeError/DeviceFaultError classify"
+        assert classify_device_fault(DeviceFaultError("oom")) == "oom"
+
+    def test_oom_opens_cooldown_bumps_epoch_and_degrades(self, device_rig):
+        from volcano_tpu.actions import allocate as alloc_mod
+        metrics.reset_local()
+        clock = device_rig
+        injector = DeviceFaultInjector({"oom": [1]}, seed=SEED)
+        alloc_mod.DEVICE_FAULT_HOOK = injector
+        binder = SequenceBinder()
+        cache = make_world(binder, journal=None)
+        conf = (
+            'actions: "allocate-tpu"\n'
+            "tiers:\n- plugins:\n  - name: priority\n  - name: gang\n"
+            "- plugins:\n  - name: drf\n  - name: proportion\n"
+            'configurations:\n- name: allocate-tpu\n'
+            "  arguments:\n    engine: tpu-scan\n")
+        sched = Scheduler(cache, conf_text=conf, schedule_period=0.0,
+                          drift_verify_every=0)
+        epoch_before = cache._snap_epoch
+        errs = sched.run_once()                    # cycle 1: injected OOM
+        assert not errs, f"fallback should absorb the fault: {errs}"
+        assert injector.injected == [(1, "oom")]
+        # contained: cool-down open, epoch bumped, tensors dropped,
+        # the cycle still bound through the sequential placer
+        assert not DEVICE_HEALTH.available()
+        assert cache._snap_epoch > epoch_before, "epoch not bumped"
+        assert cache.tensor_cache is None
+        assert metrics.local_counters().get(("device_faults", "oom")) == 1
+        assert len(binder.sequence) == \
+            sum(len(j.tasks) for j in cache.jobs.values())
+        # cycle 2 (inside the window): device engine skipped entirely —
+        # the injector hook is never consulted
+        attempts = injector.attempt
+        sched.run_once()
+        assert injector.attempt == attempts, \
+            "device engine dispatched during cool-down"
+        assert metrics.local_counters().get(
+            ("device_degraded_cycles",)) >= 1
+        assert alloc_mod.LAST_FALLBACK.get("error") == "device cool-down"
+        # window expires -> re-probe succeeds -> state machine closes
+        clock.now += DEVICE_HEALTH.cooldown_s + 1
+        assert DEVICE_HEALTH.available()
+        sched.run_once()
+        assert injector.attempt == attempts + 1, "re-probe did not run"
+        assert DEVICE_HEALTH.available()
+        assert DEVICE_HEALTH.consecutive_faults == 0
+        d = metrics.health_detail()
+        assert d["device"]["available"] is True
+
+    def test_tensor_refresh_device_fault_feeds_cooldown(self, device_rig):
+        """A device fault surfacing inside the persistent-tensor scatter
+        (not the allocate solve) must hit the same containment: cool-down
+        opens, epoch bumps, and the session falls back to a from-scratch
+        host build instead of silently retrying every cycle."""
+        from volcano_tpu.cache.snapshot import discover_resource_names
+        from volcano_tpu.framework import close_session, open_session
+        from volcano_tpu.framework.conf import parse_scheduler_conf
+        cache = make_world(SequenceBinder())
+        conf = parse_scheduler_conf(None)
+        epoch_before = cache._snap_epoch
+
+        def boom(nodes, rnames, epoch=None):
+            raise DeviceFaultError("device_lost")
+
+        cache.tensor_refresh = boom
+        ssn = open_session(cache, conf.tiers, [])
+        try:
+            rn = discover_resource_names(
+                list(cache.nodes.values()),
+                [t for j in cache.jobs.values() for t in j.tasks.values()])
+            assert ssn.snapshot_node_tensors(rn) is None
+        finally:
+            close_session(ssn)
+        assert not DEVICE_HEALTH.available()
+        assert cache._snap_epoch > epoch_before
+
+    def test_repeated_faults_double_the_window(self, device_rig):
+        clock = device_rig
+        w1 = DEVICE_HEALTH.record_fault("oom")
+        clock.now += w1 + 1
+        w2 = DEVICE_HEALTH.record_fault("device_lost")
+        assert w2 == 2 * w1
+        assert DEVICE_HEALTH.detail()["consecutive_faults"] == 2
+        DEVICE_HEALTH.record_ok()
+        assert DEVICE_HEALTH.detail()["consecutive_faults"] == 0
+
+
+# ---------------------------------------------------------------------------
+# dead-letter ops surface + healthz detail
+# ---------------------------------------------------------------------------
+
+
+class TestOpsSurface:
+    def test_dead_letter_gauge_tracks_set(self):
+        metrics.reset_local()
+
+        class AlwaysFails:
+            def bind(self, task, hostname):
+                raise RuntimeError("down")
+
+        cache = make_world(AlwaysFails(), n_jobs=1, tasks_per_job=1,
+                           resync_max_retries=0)
+        sched = Scheduler(cache, schedule_period=0.0, drift_verify_every=0)
+        sched.run_once()
+        assert len(cache.dead_letter) == 1
+        assert metrics.dead_letter_size() == 1
+        assert metrics.health_detail()["dead_letter_size"] == 1
+        cache.resync_queue.max_retries = 3     # "fault fixed"
+        cache.redrive_dead_letter()
+        assert metrics.dead_letter_size() == 0
+
+    def test_redrive_cli_verb(self):
+        from volcano_tpu.cli.vcctl import main as vcctl_main
+
+        class AlwaysFails:
+            def bind(self, task, hostname):
+                raise RuntimeError("down")
+
+        cache = make_world(AlwaysFails(), n_jobs=1, tasks_per_job=1,
+                           resync_max_retries=0)
+        Scheduler(cache, schedule_period=0.0,
+                  drift_verify_every=0).run_once()
+        assert len(cache.dead_letter) == 1
+        lines = []
+        rc = vcctl_main(["cache", "dead-letter"], out=lines.append,
+                        cache=cache)
+        assert rc == 0 and "1 dead-lettered" in lines[-1]
+        lines.clear()
+        # max_retries=0 means even a fresh budget is refused: redrive
+        # must RE-PARK (not silently drop) the side effect
+        rc = vcctl_main(["cache", "redrive-dead-letter"], out=lines.append,
+                        cache=cache)
+        assert rc == 0 and "redrove 0" in lines[0]
+        assert len(cache.dead_letter) == 1, "refused redrive lost the item"
+        # operator fixes the fault (grants a retry budget) -> redrive works
+        cache.resync_queue.max_retries = 3
+        lines.clear()
+        rc = vcctl_main(["cache", "redrive-dead-letter"], out=lines.append,
+                        cache=cache)
+        assert rc == 0 and "redrove 1" in lines[0]
+        assert not cache.dead_letter and len(cache.resync_queue) == 1
+        # without a cache the verb reports, not crashes
+        assert vcctl_main(["cache", "redrive-dead-letter"],
+                          out=lambda *_: None) == 1
+
+    def test_healthz_detail_endpoint(self):
+        import json
+        import urllib.request
+        metrics.reset_local()
+        server = metrics.start_metrics_server(port=0, host="127.0.0.1")
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz") as r:
+                assert r.read() == b"ok"           # plain body unchanged
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz?detail=1") as r:
+                payload = json.loads(r.read())
+            assert payload["state"] == "healthy"
+            assert "dead_letter_size" in payload
+            assert "device" in payload
+        finally:
+            server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# restart-under-chaos: the sim soak (fast tier-1 slice)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.sim
+class TestRestartUnderChaos:
+    def _run(self, kill_cycles, kill_seed):
+        from volcano_tpu.sim.runner import SimRunner
+        from volcano_tpu.sim.workload import make_scenario
+        trace = make_scenario("smoke", seed=3)
+        runner = SimRunner(
+            trace, seed=3,
+            binder_wrap=lambda b: ChaosBinder(b, failure_rate=0.2,
+                                              seed=SEED),
+            evictor_wrap=lambda e: ChaosEvictor(e, failure_rate=0.2,
+                                                seed=SEED),
+            kill_cycles=kill_cycles, kill_seed=kill_seed)
+        return runner.run()
+
+    def test_killed_run_converges_to_unkilled_accounting(self):
+        from volcano_tpu.sim.report import terminal_accounting
+        baseline = self._run([], 0)
+        assert baseline["jobs"]["completed"] == baseline["jobs"]["arrived"]
+        killed = self._run([2, 5, 9, 13], 1)
+        assert killed["restarts"] == 4, f"seed={SEED}"
+        assert terminal_accounting(killed) == terminal_accounting(baseline), \
+            f"seed={SEED}: killed={terminal_accounting(killed)} " \
+            f"unkilled={terminal_accounting(baseline)}"
+        assert killed["double_binds"] == 0
+        assert killed["jobs"]["unfinished"] == 0
+        # the crash windows actually exercised the journal (kill_seed 1
+        # lands mid-bind kills; see also the phase-exact unit tests)
+        assert killed["journal_replayed"].get("replayed", 0) >= 1, \
+            f"seed={SEED}: no journal replay — kills never landed mid-op"
+
+    def test_killed_run_is_deterministic(self):
+        from volcano_tpu.sim.report import deterministic_json
+        a = self._run([2, 5, 9], 2)
+        b = self._run([2, 5, 9], 2)
+        assert deterministic_json(a) == deterministic_json(b), \
+            f"seed={SEED}: killed-run decision plane not reproducible"

@@ -74,6 +74,18 @@ class ReplayFault(RuntimeError):
 # ran its configured engine end to end.
 LAST_FALLBACK: Dict[str, str] = {}
 
+# Pre-solve hook for device-fault injection (chaos.DeviceFaultInjector):
+# called with the engine name before every device solve attempt; raising
+# device_health.DeviceFaultError simulates an XLA OOM/device-lost at
+# exactly the point the real XlaRuntimeError would surface.
+DEVICE_FAULT_HOOK = None
+
+
+def _device_available() -> bool:
+    """Is the device-fault cool-down window closed (device_health)?"""
+    from ..device_health import DEVICE_HEALTH
+    return DEVICE_HEALTH.available()
+
 
 def _node_tensors(ssn, rnames) -> NodeTensors:
     """Node-state tensors for a device solve: the cache's persistent,
@@ -122,6 +134,28 @@ class AllocateAction(Action):
         LAST_FALLBACK.clear()
         LAST_STATS.pop("tensor_s", None)      # accumulates within one cycle
         LAST_STATS.pop("tensor_incremental", None)
+        if engine.startswith("tpu-") and not _device_available():
+            # device-fault cool-down (docs/robustness.md): a recent XLA
+            # OOM/device-lost opened a cool-down window — run this cycle
+            # on the CPU placer without touching the device; the window's
+            # expiry re-probes the device engine automatically. With
+            # ``solver-fallback: false`` (parity benches want raw
+            # errors, never a silent engine swap) the cycle raises
+            # instead, same as the original fault did.
+            from ..device_health import DEVICE_HEALTH
+            if not fallback:
+                raise RuntimeError(
+                    f"device cool-down active "
+                    f"({DEVICE_HEALTH.cooldown_remaining():.1f}s "
+                    f"remaining) and solver-fallback is disabled")
+            log.warning("device cool-down active (%.1fs remaining): "
+                        "allocate degraded to the sequential placer",
+                        DEVICE_HEALTH.cooldown_remaining())
+            from .. import metrics
+            metrics.register_device_degraded_cycle()
+            LAST_FALLBACK.update(engine=engine, error="device cool-down")
+            _execute_interleaved(ssn, _CallbackJobPlacer(ssn))
+            return
         if engine == "callbacks":
             _execute_interleaved(ssn, _CallbackJobPlacer(ssn))
         elif engine == "callbacks-parallel":
@@ -170,15 +204,37 @@ class AllocateAction(Action):
         placed. The one statement-free path (_replay_fused_fast) raises
         ReplayFault instead, which is NOT absorbed here. Disable with the
         action configuration key ``solver-fallback: false`` (parity
-        benches want the raw error)."""
+        benches want the raw error).
+
+        DEVICE faults (XLA OOM / device-lost — see device_health) are
+        additionally contained before falling back: the cool-down state
+        machine opens (subsequent cycles skip the device engine until
+        the window expires) and the cache's device-resident tensor state
+        is invalidated via the session-epoch bump, because a lost
+        device's buffers are gone and an OOM'd one must not be fed the
+        same resident arrays straight back."""
+        from ..device_health import DEVICE_HEALTH, classify_device_fault
         try:
+            if DEVICE_FAULT_HOOK is not None:
+                DEVICE_FAULT_HOOK(engine)
             run()
+            DEVICE_HEALTH.record_ok()
         except ReplayFault:
             raise            # session not provably consistent — no fallback
         except Exception as exc:
+            from .. import metrics
+            kind = classify_device_fault(exc)
+            if kind is not None:
+                window = DEVICE_HEALTH.record_fault(kind)
+                invalidate = getattr(ssn.cache, "invalidate_device_state",
+                                     None)
+                if invalidate is not None:
+                    invalidate()
+                log.error("device fault (%s) in allocate engine %s: "
+                          "cooling down for %.1fs, device tensor state "
+                          "invalidated", kind, engine, window)
             if not enabled:
                 raise
-            from .. import metrics
             log.exception("allocate engine %s failed; completing the cycle "
                           "with the sequential placer", engine)
             metrics.register_solver_fallback(self.NAME)
@@ -434,6 +490,21 @@ def _bucket(n: int) -> int:
     """Pad task counts to power-of-two buckets to bound jit recompiles."""
     b = 8
     while b < n:
+        b *= 2
+    return b
+
+
+def _job_bucket(j: int) -> int:
+    """Pad the JOB axis to power-of-two buckets too: the scan/blocks/
+    sharded solvers' jit keys include the [J] gang-meta arrays
+    (min_available/base_ready/base_pipelined), so an un-bucketed J mints
+    a fresh XLA program whenever the pending-JOB count shifts — the
+    churn warm-up hole (BENCH_r05 cycle 1: 6.5 s, 8 compiles) in its
+    remaining form. Pad gangs own no tasks and never affect state (the
+    same contract _solve_job_batch's j_pad relies on); prewarm_shapes
+    pads identically so startup compiles cover the whole bucket."""
+    b = 4
+    while b < j:
         b *= 2
     return b
 
@@ -931,10 +1002,18 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
     last[-1] = True
 
     # numpy first: the pallas path consumes these host-side, and converting
-    # jnp->np costs one ~100ms tunnel RTT per array on remote TPU backends
-    min_av_np = np.asarray([j.min_available for j in jobs_list], np.int32)
-    base_r_np = np.asarray([j.ready_task_num() for j in jobs_list], np.int32)
-    base_p_np = np.asarray([j.waiting_task_num() for j in jobs_list], np.int32)
+    # jnp->np costs one ~100ms tunnel RTT per array on remote TPU backends.
+    # The job axis pads to its pow2 bucket (_job_bucket): pad gangs with
+    # min_available 1 and no tasks are inert in-kernel, and the [J] arrays
+    # stop keying a fresh compile every time the pending-job count moves.
+    Jp = _job_bucket(J)
+    jpad = Jp - J
+    min_av_np = np.asarray([j.min_available for j in jobs_list]
+                           + [1] * jpad, np.int32)
+    base_r_np = np.asarray([j.ready_task_num() for j in jobs_list]
+                           + [0] * jpad, np.int32)
+    base_p_np = np.asarray([j.waiting_task_num() for j in jobs_list]
+                           + [0] * jpad, np.int32)
     jobs_meta = JobMeta(min_available=min_av_np, base_ready=base_r_np,
                         base_pipelined=base_p_np)
 
@@ -1052,7 +1131,7 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
                                   node_t.device_allocatable(),
                                   node_t.device_max_tasks())
         task_node, pipelined, job_ready, job_kept = unpack_placement(
-            np.asarray(packed), bucket, J)
+            np.asarray(packed), bucket, Jp)
         task_node, pipelined = task_node[:T], pipelined[:T]
 
     return _FusedSolution(tasks, job_ix_np, jobs_list, node_t, task_node,
@@ -1307,7 +1386,10 @@ def prewarm_shapes(ssn, shape_configs=None, engine: str = "tpu-fused") -> int:
         T, J = int(T), max(int(J), 1)
         if T <= 0:
             continue
-        # dummy task tensors: J contiguous equal job blocks over T rows
+        # dummy task tensors: J contiguous equal job blocks over T rows;
+        # the gang-meta arrays pad to the SAME pow2 job bucket as
+        # _solve_fused, so one warmed entry covers every live J in its
+        # bucket (shape — not values — keys the XLA compile cache)
         job_ix = np.minimum(np.arange(T) * J // T, J - 1).astype(np.int32)
         first = np.zeros(T, bool)
         last = np.zeros(T, bool)
@@ -1316,8 +1398,9 @@ def prewarm_shapes(ssn, shape_configs=None, engine: str = "tpu-fused") -> int:
         last[:-1] = job_ix[1:] != job_ix[:-1]
         last[-1] = True
         req = np.zeros((T, R), np.float32)
-        min_av = np.ones(J, np.int32)
-        base_z = np.zeros(J, np.int32)
+        Jp = _job_bucket(J)
+        min_av = np.ones(Jp, np.int32)
+        base_z = np.zeros(Jp, np.int32)
         if use_pallas:
             ms = pallas_place.neutral_masked_static(
                 *pallas_place.padded_shape(T, N), T, N)

@@ -26,6 +26,7 @@ from ..api import (ClusterInfo, JobInfo, NamespaceCollection, NamespaceInfo,
                    TaskStatus, allocated_status)
 from .executors import (Binder, Evictor, FakeBinder, FakeEvictor,
                         StatusUpdater, VolumeBinder)
+from .journal import IntentJournal, journal_enabled
 
 
 def incremental_snapshot_enabled() -> bool:
@@ -114,7 +115,8 @@ class SchedulerCache:
                  volume_binder: Optional[VolumeBinder] = None,
                  default_queue: str = "default",
                  resync_max_retries: Optional[int]
-                 = DEFAULT_RESYNC_MAX_RETRIES):
+                 = DEFAULT_RESYNC_MAX_RETRIES,
+                 journal: Optional[IntentJournal] = None):
         self._lock = threading.RLock()
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
@@ -135,6 +137,13 @@ class SchedulerCache:
         # definition of the budget); ops inspect it and redrive_dead_letter
         # re-queues after the underlying fault is fixed.
         self.dead_letter: Dict[str, Tuple[str, TaskInfo]] = {}
+        # write-ahead intent journal (cache/journal.py): bind/evict/resync
+        # funnels record intents before their executor call and acks after,
+        # so a crash window is replayable at restart (reconcile_journal).
+        # VOLCANO_TPU_JOURNAL=0 detaches a configured journal wholesale.
+        self.journal = journal if (journal is not None
+                                   and journal_enabled()) else None
+        self.last_reconcile: Optional[dict] = None
         self.binding_tasks: Dict[str, str] = {}   # task uid -> node, in flight
         # Incremental snapshot state (docs/performance.md): every mutation
         # path records the touched node/job/queue keys; snapshot() re-clones
@@ -156,6 +165,47 @@ class SchedulerCache:
         # wall-clock + dirty-ratio breakdown of the last snapshot()
         # (bench.py snapshot_clone_ms / open_dirty_ms extras)
         self.last_snapshot_stats: Dict[str, object] = {}
+        # result of the last shadow-verifier pass (verify_state_integrity)
+        self.last_verify: Dict[str, object] = {}
+
+    # -- intent journal (cache/journal.py) ----------------------------------
+
+    def attach_journal(self, journal: Optional[IntentJournal]) -> None:
+        """Swap the write-ahead journal in (or out with None); honours the
+        VOLCANO_TPU_JOURNAL kill-switch like the constructor does."""
+        self.journal = journal if (journal is not None
+                                   and journal_enabled()) else None
+
+    def _journal_intent(self, op: str, task: TaskInfo, node: str = "",
+                        via: str = "", sync: bool = True,
+                        fresh: bool = True) -> Optional[int]:
+        """Record a side-effect intent. ``sync=True`` (the default for
+        single-op funnels) makes the intent DURABLE — flushed+fsynced —
+        before the caller runs the executor, which is the WAL guarantee
+        reconciliation rests on; batch funnels journal all their intents
+        first and group-commit with one flush() instead. ``fresh`` marks
+        a NEW placement (vs a re-bind of an already-placed task), which
+        decides whether a crash-window rollback may strip the task's
+        placement (journal._rollback_bind)."""
+        if self.journal is None:
+            return None
+        seq = self.journal.record_intent(op, task, node, via, fresh)
+        if sync:
+            self.journal.flush()
+        return seq
+
+    def _journal_ack(self, seq: Optional[int], ok: bool) -> None:
+        if seq is not None and self.journal is not None:
+            self.journal.ack(seq, ok)
+
+    def reconcile_journal(self, cluster_binds=None, cluster_evicts=None):
+        """Startup reconciliation: settle the journal's crash window
+        against cache truth (journal.reconcile). Returns the
+        ReconcileReport, or None when no journal is attached."""
+        if self.journal is None:
+            return None
+        from .journal import reconcile
+        return reconcile(self, self.journal, cluster_binds, cluster_evicts)
 
     # -- dirty-set marks (incremental snapshot) -----------------------------
 
@@ -427,6 +477,181 @@ class SchedulerCache:
             metrics.register_snapshot_full_rebuild("tensor")
         return tc
 
+    def invalidate_device_state(self) -> None:
+        """Device-fault containment (docs/robustness.md): after an XLA
+        OOM/device-lost the device-resident tensor mirrors cannot be
+        trusted (device loss frees them outright). Bump the snapshot
+        epoch — any in-flight session's tensor_refresh now refuses to
+        apply its delta — and drop the persistent tensor cache so the
+        next device consumer rebuilds from host truth from scratch."""
+        with self._lock:
+            self._snap_epoch += 1
+            self.tensor_cache = None
+            self._tensor_dirty = set()
+
+    # -- drift self-healing (docs/robustness.md) ----------------------------
+
+    def verify_state_integrity(self, repair: bool = True) -> dict:
+        """Shadow verifier: re-derive what a from-scratch snapshot/tensor
+        build would produce and diff it against the incremental caches
+        that the NEXT cycle would reuse. Any mismatch is state drift — a
+        missed dirty-mark or mutation-witness hole that clone-on-dirty
+        would silently serve as a stale placement input — counted in
+        ``volcano_state_drift_total{layer}`` and repaired (``repair=True``)
+        by forcing the existing full-rebuild paths: ``mark_all_dirty()``
+        for the clone layer, dropping ``tensor_cache`` for the tensor
+        layer. Designed to run OFF-CYCLE (the scheduler shell calls it
+        after the e2e-timed window, every ``drift_verify_every`` cycles).
+
+        Entries the next snapshot would re-clone anyway (dirty-marked,
+        mutation-witnessed, or guard-field mismatches) are skipped: they
+        are not drift, they are the incremental machinery working."""
+        t0 = time.perf_counter()
+        drift = {"node": [], "job": [], "tensor": []}
+        # Phase 1 (lock): snapshot the candidate key/object pairs only.
+        with self._lock:
+            node_cand = [] if self._dirty_all else [
+                (name, prev, self.nodes.get(name))
+                for name, prev in self._snap_nodes.items()]
+            job_cand = [] if self._dirty_all else [
+                (uid, prev, self.jobs.get(uid))
+                for uid, prev in self._snap_jobs.items()]
+            tc = self.tensor_cache
+            tensor_cand = [] if tc is None else [
+                (name, prev, tc.index.get(name))
+                for name, prev in self._snap_nodes.items()]
+            checked_nodes = len(self._snap_nodes)
+            checked_jobs = len(self._snap_jobs)
+        # Phase 2 (no lock): the O(cluster) fingerprint diff — watch/
+        # controller threads keep feeding the cache meanwhile. An entry a
+        # concurrent mutator races (torn comparison raising) is skipped:
+        # that mutation dirty-marks it, so it is re-cloned anyway.
+        suspects = {"node": [], "job": [], "tensor": []}
+        for name, prev, live in node_cand:
+            try:
+                if (live is not None and live.ready
+                        and not prev._touched and not live._touched
+                        and prev.unschedulable == live.unschedulable
+                        and not self._node_matches(prev, live)):
+                    suspects["node"].append(name)
+            except Exception:
+                continue
+        for uid, prev, live in job_cand:
+            try:
+                if (live is not None and live.podgroup is not None
+                        and not prev._touched and not live._touched
+                        and prev.podgroup is live.podgroup
+                        and prev.priority == live.priority
+                        and prev.min_available == live.min_available
+                        and prev.queue == live.queue
+                        and not self._job_matches(prev, live)):
+                    suspects["job"].append(uid)
+            except Exception:
+                continue
+        rn = tc.rnames if tc is not None else None
+        for name, prev, i in tensor_cand:
+            try:
+                if i is not None \
+                        and not self._tensor_row_matches(tc, i, prev, rn):
+                    suspects["tensor"].append(name)
+            except Exception:
+                continue
+        # Phase 3 (lock): confirm each suspect against the CURRENT skip
+        # conditions (a mutation that raced phase 2 dirty-marked its key,
+        # which is not drift) and repair.
+        with self._lock:
+            if not self._dirty_all:
+                for name in suspects["node"]:
+                    live = self.nodes.get(name)
+                    prev = self._snap_nodes.get(name)
+                    if (prev is not None and live is not None and live.ready
+                            and name not in self._dirty_nodes
+                            and not prev._touched and not live._touched
+                            and prev.unschedulable == live.unschedulable
+                            and not self._node_matches(prev, live)):
+                        drift["node"].append(name)
+                for uid in suspects["job"]:
+                    live = self.jobs.get(uid)
+                    prev = self._snap_jobs.get(uid)
+                    if (prev is not None and live is not None
+                            and live.podgroup is not None
+                            and uid not in self._dirty_jobs
+                            and not prev._touched and not live._touched
+                            and prev.podgroup is live.podgroup
+                            and not self._job_matches(prev, live)):
+                        drift["job"].append(uid)
+            if self.tensor_cache is tc and tc is not None:
+                for name in suspects["tensor"]:
+                    i = tc.index.get(name)
+                    prev = self._snap_nodes.get(name)
+                    if (i is not None and prev is not None
+                            and name not in self._tensor_dirty
+                            and not self._tensor_row_matches(tc, i, prev,
+                                                             rn)):
+                        drift["tensor"].append(name)
+            repaired = False
+            if repair:
+                if drift["node"] or drift["job"]:
+                    self._dirty_all = True
+                    repaired = True
+                if drift["tensor"]:
+                    self.tensor_cache = None
+                    self._tensor_dirty = set()
+                    repaired = True
+            stats = {
+                "drift": {k: sorted(v) for k, v in drift.items() if v},
+                "drift_total": sum(len(v) for v in drift.values()),
+                "repaired": repaired,
+                "checked_nodes": checked_nodes,
+                "checked_jobs": checked_jobs,
+                "verify_s": time.perf_counter() - t0,
+            }
+            self.last_verify = stats
+        from .. import metrics
+        for layer, names in drift.items():
+            if names:
+                metrics.register_state_drift(layer, len(names))
+        metrics.set_drift_verify_stats(stats["drift_total"],
+                                       stats["verify_s"])
+        return stats
+
+    @staticmethod
+    def _node_matches(prev: NodeInfo, live: NodeInfo) -> bool:
+        """Would reusing ``prev`` equal a fresh ``live.clone()``? The
+        same fields the incremental-snapshot oracle test asserts."""
+        if (prev.allocatable is not live.allocatable
+                or prev.used_ports != live.used_ports):
+            return False
+        for field in ("idle", "used", "releasing", "pipelined"):
+            if getattr(prev, field) != getattr(live, field):
+                return False
+        return ({u: (t.status, t.node_name) for u, t in prev.tasks.items()}
+                == {u: (t.status, t.node_name)
+                    for u, t in live.tasks.items()})
+
+    @staticmethod
+    def _job_matches(prev: JobInfo, live: JobInfo) -> bool:
+        if prev.allocated != live.allocated:
+            return False
+        return ({u: t.status for u, t in prev.tasks.items()}
+                == {u: t.status for u, t in live.tasks.items()})
+
+    @staticmethod
+    def _tensor_row_matches(tc, i: int, node: NodeInfo, rnames) -> bool:
+        """Row ``i`` of the persistent tensors vs what ``_write_row``
+        would derive from the snapshot clone today."""
+        import numpy as np
+        for field in ("idle", "used", "releasing", "pipelined",
+                      "allocatable"):
+            if not np.array_equal(getattr(tc, field)[i],
+                                  getattr(node, field).to_vector(rnames)):
+                return False
+        from .snapshot import BIG_MAX_TASKS
+        want_max = node.max_task_num if node.max_task_num > 0 \
+            else BIG_MAX_TASKS
+        return (int(tc.max_tasks[i]) == want_max
+                and int(tc.ntasks[i]) == len(node.tasks))
+
     # -- side effects (cache.go:549-666) ------------------------------------
 
     def bind(self, task: TaskInfo) -> None:
@@ -457,9 +682,12 @@ class SchedulerCache:
                     job.update_task_status(cached, TaskStatus.BOUND)
                     if prev_node in self.nodes:
                         self.nodes[prev_node].update_task(cached)
+        seq = self._journal_intent("bind", task, task.node_name,
+                                   fresh=newly_placed)
         try:
             self._bind_volumes(task)
             self.binder.bind(task, task.node_name)
+            self._journal_ack(seq, True)
         except Exception:
             # roll back exactly what the optimistic phase did
             with self._lock:
@@ -476,6 +704,7 @@ class SchedulerCache:
                         if cached.node_name in self.nodes:
                             self.nodes[cached.node_name].update_task(cached)
                 self.err_tasks.append(task)
+            self._journal_ack(seq, False)
             self.resync_task(task)
 
     def bind_batch(self, tasks) -> None:
@@ -520,10 +749,19 @@ class SchedulerCache:
                 node = self.nodes[name]
                 node.idle.sub(r)
                 node.used.add(r)
-        for task, newly in placed:
+        # group commit: journal EVERY intent of the batch durably (one
+        # fsync) before the first executor call — the WAL ordering the
+        # reconciler relies on, at batch cost instead of per-bind cost
+        seqs = [self._journal_intent("bind", task, task.node_name,
+                                     sync=False, fresh=newly)
+                for task, newly in placed]
+        if self.journal is not None and placed:
+            self.journal.flush()
+        for (task, newly), seq in zip(placed, seqs):
             try:
                 self._bind_volumes(task)
                 self.binder.bind(task, task.node_name)
+                self._journal_ack(seq, True)
             except Exception:
                 with self._lock:
                     job = self.jobs.get(task.job)
@@ -536,6 +774,7 @@ class SchedulerCache:
                             job.update_task_status(cached, TaskStatus.PENDING)
                             cached.node_name = ""
                     self.err_tasks.append(task)
+                self._journal_ack(seq, False)
                 self.resync_task(task)
 
     def _bind_volumes(self, task: TaskInfo) -> None:
@@ -551,11 +790,14 @@ class SchedulerCache:
 
     def evict(self, task: TaskInfo, reason: str) -> None:
         """Execute eviction: pod condition + delete (cache.go:549-599)."""
+        seq = self._journal_intent("evict", task)
         try:
             self.evictor.evict(task, reason)
+            self._journal_ack(seq, True)
         except Exception:
             with self._lock:
                 self.err_tasks.append(task)
+            self._journal_ack(seq, False)
             self.resync_task(task, op="evict")
             return
         with self._lock:
@@ -578,20 +820,27 @@ class SchedulerCache:
             with self._lock:
                 fresh = key not in self.dead_letter
                 self.dead_letter[key] = (op, task)
+                size = len(self.dead_letter)
+            from .. import metrics
+            metrics.set_dead_letter_size(size)
             if fresh:
                 # count logical events, not cycles: a PENDING-rolled-back
                 # task re-placed every cycle keeps hitting the refused
                 # budget, but it is still ONE dead-lettered side effect
-                from .. import metrics
                 metrics.register_dead_letter(op)
 
     def _drop_retry_state(self, task_uid: str) -> None:
         """A deleted task's queued retries and dead-letter entry are moot
         — purge them so dead_letter cannot pin TaskInfo objects (and their
         job/node references) forever. Caller holds self._lock."""
+        dropped = False
         for key in (f"bind/{task_uid}", f"evict/{task_uid}"):
-            self.dead_letter.pop(key, None)
+            dropped = (self.dead_letter.pop(key, None)
+                       is not None) or dropped
             self.resync_queue.forget(key)
+        if dropped:
+            from .. import metrics
+            metrics.set_dead_letter_size(len(self.dead_letter))
 
     def redrive_dead_letter(self) -> int:
         """Re-queue every dead-lettered side effect with a fresh retry
@@ -600,10 +849,19 @@ class SchedulerCache:
         with self._lock:
             items = list(self.dead_letter.items())
             self.dead_letter.clear()
+        moved = 0
         for key, (op, task) in items:
             self.resync_queue.forget(key)
-            self.resync_queue.add_rate_limited(key, (op, task))
-        return len(items)
+            if self.resync_queue.add_rate_limited(key, (op, task)):
+                moved += 1
+            else:
+                # the queue refused even a fresh budget (max_retries 0):
+                # re-park instead of silently dropping the side effect
+                with self._lock:
+                    self.dead_letter[key] = (op, task)
+        from .. import metrics
+        metrics.set_dead_letter_size(len(self.dead_letter))
+        return moved
 
     def _resync_stale(self, op: str, task: TaskInfo) -> bool:
         """A queued retry is STALE when the cluster moved on while it sat
@@ -626,6 +884,32 @@ class SchedulerCache:
                 return True
         return False
 
+    def _resync_bind_valid(self, task: TaskInfo) -> bool:
+        """A queued bind retry is only re-executable while it is still
+        the placement decision the scheduler would stand behind: the
+        task is PENDING (a rollback state — NOT evicted/RELEASING, which
+        _resync_stale lets through) and either unplaced or still pointing
+        at the retry's own target (the re-bind rollback keeps node_name),
+        and the target node is present, ready, and can hold the task
+        RIGHT NOW on both idle and future_idle (respecting pipelined
+        reservations made against releasing capacity since the retry was
+        queued)."""
+        with self._lock:
+            job = self.jobs.get(task.job)
+            cached = job.tasks.get(task.uid) if job is not None else None
+            node = self.nodes.get(task.node_name)
+            if (cached is None or node is None or not node.ready
+                    or cached.status != TaskStatus.PENDING
+                    or cached.node_name not in ("", task.node_name)):
+                return False
+            if cached.node_name == task.node_name \
+                    and cached.uid in node.tasks:
+                # still accounted on the target (re-bind rollback kept
+                # the placement): no room check — it holds its own room
+                return True
+            return (task.init_resreq.less_equal(node.idle)
+                    and task.init_resreq.less_equal(node.future_idle()))
+
     def process_resync_tasks(self) -> int:
         """Retry side effects whose backoff expired (processResyncTask,
         cache.go:781-799) — the scheduler shell calls this every cycle.
@@ -636,6 +920,19 @@ class SchedulerCache:
             if self._resync_stale(op, task):
                 self.resync_queue.forget(key)
                 continue
+            if op == "bind" and not self._resync_bind_valid(task):
+                # the placement decision behind this retry is no longer
+                # valid — the task was evicted/recreated or the target
+                # node filled up while the retry sat in backoff. Binding
+                # anyway would race the scheduler's OWN re-placement of
+                # the task (a double-bind) and over-commit the node (the
+                # half-applied BOUND-but-not-on-node corruption the chaos
+                # skew soak exposed). Drop it: the allocate loop re-places
+                # pending tasks every cycle anyway.
+                self.resync_queue.forget(key)
+                continue
+            seq = self._journal_intent(op, task, task.node_name,
+                                       via="resync")
             try:
                 if op == "bind":
                     self._bind_volumes(task)
@@ -657,11 +954,22 @@ class SchedulerCache:
                         job = self.jobs.get(task.job)
                         if job is not None and task.uid in job.tasks:
                             self._mark_task_dirty(task)
-                            job.update_task_status(job.tasks[task.uid],
+                            cached = job.tasks[task.uid]
+                            job.update_task_status(cached,
                                                    TaskStatus.RELEASING)
+                            # the node mirror holds a CLONE: without this
+                            # update it keeps the pre-evict status and its
+                            # idle/releasing accounting (exactly what the
+                            # direct evict() path maintains) — preempt
+                            # then sees a phantom RUNNING victim
+                            if cached.node_name in self.nodes:
+                                self.nodes[cached.node_name].update_task(
+                                    cached)
+                self._journal_ack(seq, True)
                 self.resync_queue.forget(key)
                 done += 1
             except Exception:
+                self._journal_ack(seq, False)
                 self._resync_or_dead_letter(key, op, task)
         return done
 

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import random
 import time
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from .cache.executors import Binder, Evictor, StatusUpdater
 
@@ -107,6 +107,139 @@ class ChaosStatusUpdater(_ChaosWrapper, StatusUpdater):
     def update_pod_group(self, job) -> None:
         self._roll("update_pod_group")
         self.inner.update_pod_group(job)
+
+
+class DeviceFaultInjector:
+    """Simulate XLA device errors (OOM / device-lost) at the allocate
+    solve boundary — install as ``actions.allocate.DEVICE_FAULT_HOOK``.
+
+    ``plan`` maps a fault kind ("oom" | "device_lost") to the 1-based
+    SOLVE-ATTEMPT indices on which to raise (each hook call is one
+    device solve attempt); with ``failure_rate`` set, every attempt
+    instead rolls a seeded coin and picks a kind round-robin from
+    ``plan``'s keys (pass {"oom": ()}). Raises
+    ``device_health.DeviceFaultError`` — classified exactly like the
+    real XlaRuntimeError, so the cool-down state machine, epoch bump and
+    CPU degradation path are exercised end to end::
+
+        from volcano_tpu.actions import allocate
+        allocate.DEVICE_FAULT_HOOK = DeviceFaultInjector(
+            {"oom": [2]})             # second solve attempt OOMs
+    """
+
+    def __init__(self, plan: Dict[str, Iterable[int]],
+                 failure_rate: Optional[float] = None, seed: int = 0):
+        self.plan = {kind: set(attempts) for kind, attempts in plan.items()}
+        self.failure_rate = failure_rate
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self.attempt = 0
+        self.injected: List[tuple] = []    # (attempt, kind)
+
+    def __call__(self, engine: str) -> None:
+        from .device_health import DeviceFaultError
+        self.attempt += 1
+        kind = None
+        if self.failure_rate is not None:
+            if self._rng.random() < self.failure_rate:
+                kinds = sorted(self.plan) or ["oom"]
+                kind = kinds[len(self.injected) % len(kinds)]
+        else:
+            for k, attempts in self.plan.items():
+                if self.attempt in attempts:
+                    kind = k
+                    break
+        if kind is None:
+            return
+        self.injected.append((self.attempt, kind))
+        msg = ("RESOURCE_EXHAUSTED: Out of memory allocating device buffer"
+               if kind == "oom" else
+               "DEVICE_LOST: device lost (simulated)")
+        raise DeviceFaultError(kind, f"chaos: {msg} "
+                                     f"(seed={self.seed}, "
+                                     f"attempt={self.attempt})")
+
+
+class SimKill(BaseException):
+    """A simulated process death. Derives from BaseException ON PURPOSE:
+    the cache's bind/evict funnels catch ``Exception`` to roll back and
+    resync — a real crash does neither, so the kill must tunnel through
+    every except-Exception layer, leaving optimistic cache state and the
+    journal's unacked intent exactly as a SIGKILL would. The restart
+    harness (sim/runner.SimRunner) catches it at the cycle boundary."""
+
+    def __init__(self, where: str):
+        super().__init__(f"simulated crash at {where}")
+        self.where = where
+
+
+class KillPointBinder(Binder):
+    """Binder wrapper that crashes the process at a chosen bind within a
+    chosen cycle window — BEFORE the inner executor runs (the side
+    effect never reached the cluster) or AFTER it (the cluster has the
+    bind; the cache/journal never learned). Arm with ``arm(n, before)``;
+    fires once per arming. Wrap OUTERMOST (outside any ChaosBinder) so
+    kill-after still records the inner executor's side effect first."""
+
+    def __init__(self, inner: Binder):
+        self.inner = inner
+        self._armed: Optional[Tuple[int, bool]] = None
+        self._count = 0
+        self.kills: List[tuple] = []       # (bind_index, before)
+
+    def arm(self, at_bind: int, before: bool) -> None:
+        self._armed = (at_bind, before)
+        self._count = 0
+
+    def disarm(self) -> None:
+        self._armed = None
+
+    def bind(self, task, hostname: str) -> None:
+        if self._armed is not None:
+            at, before = self._armed
+            self._count += 1
+            if self._count >= at:
+                if before:
+                    self._armed = None
+                    self.kills.append((self._count, True))
+                    raise SimKill(f"bind #{self._count} (before execute)")
+                self.inner.bind(task, hostname)
+                self._armed = None
+                self.kills.append((self._count, False))
+                raise SimKill(f"bind #{self._count} (after execute)")
+        self.inner.bind(task, hostname)
+
+
+class KillPointEvictor(Evictor):
+    """Evictor twin of KillPointBinder."""
+
+    def __init__(self, inner: Evictor):
+        self.inner = inner
+        self._armed: Optional[Tuple[int, bool]] = None
+        self._count = 0
+        self.kills: List[tuple] = []
+
+    def arm(self, at_evict: int, before: bool) -> None:
+        self._armed = (at_evict, before)
+        self._count = 0
+
+    def disarm(self) -> None:
+        self._armed = None
+
+    def evict(self, task, reason: str) -> None:
+        if self._armed is not None:
+            at, before = self._armed
+            self._count += 1
+            if self._count >= at:
+                if before:
+                    self._armed = None
+                    self.kills.append((self._count, True))
+                    raise SimKill(f"evict #{self._count} (before execute)")
+                self.inner.evict(task, reason)
+                self._armed = None
+                self.kills.append((self._count, False))
+                raise SimKill(f"evict #{self._count} (after execute)")
+        self.inner.evict(task, reason)
 
 
 class ActionFaultInjector:

@@ -161,6 +161,15 @@ def build_parser() -> argparse.ArgumentParser:
     qo.add_argument("--name", required=True)
     qo.add_argument("--action", choices=["open", "close"], required=True)
 
+    cache = sub.add_parser("cache").add_subparsers(dest="verb")
+    cache.add_parser(
+        "redrive-dead-letter",
+        description="Re-queue every dead-lettered side effect with a "
+                    "fresh retry budget (after the underlying fault is "
+                    "fixed) — SchedulerCache.redrive_dead_letter")
+    cache.add_parser("dead-letter",
+                     description="List the dead-lettered side effects")
+
     sub.add_parser("version")
     return parser
 
@@ -175,10 +184,27 @@ def parse_requests(text: str) -> dict:
 
 
 def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
-         out=print) -> int:
+         out=print, cache=None) -> int:
     args = build_parser().parse_args(argv)
     if args.group == "version":
         out(f"vcctl version {__version__}")
+        return 0
+    if args.group == "cache":
+        # operator verbs against the scheduler cache (dead-letter ops,
+        # docs/robustness.md) — in-process callers pass the live
+        # SchedulerCache (VolcanoSystem.cache)
+        if cache is None:
+            out("no scheduler cache attached (in-process CLI requires "
+                "the running scheduler's cache)")
+            return 1
+        if args.verb == "redrive-dead-letter":
+            moved = cache.redrive_dead_letter()
+            out(f"redrove {moved} dead-lettered side effects")
+        elif args.verb == "dead-letter":
+            for key, (op, task) in sorted(cache.dead_letter.items()):
+                out(f"{key}\top={op}\ttask={task.uid}\t"
+                    f"node={task.node_name or '-'}")
+            out(f"{len(cache.dead_letter)} dead-lettered")
         return 0
     if store is None:
         out("no cluster store attached (in-process CLI requires a store)")

@@ -47,3 +47,12 @@ def vjobs(argv=None) -> int:
 
 def vqueues(argv=None) -> int:
     return _run(["queue", "list"], argv)
+
+
+def redrive_dead_letter(argv=None) -> int:
+    """vredrive == vcctl cache redrive-dead-letter: re-queue every
+    dead-lettered side effect with a fresh retry budget once the
+    underlying fault (bad node, apiserver outage) is fixed
+    (docs/robustness.md). In-process callers pass the running
+    scheduler's cache via vcctl.main(..., cache=...)."""
+    return _run(["cache", "redrive-dead-letter"], argv)

@@ -32,6 +32,14 @@ def scheduler_main(argv: Optional[List[str]] = None) -> int:
                         metavar="PORT",
                         help="serve /metrics and /healthz on this port "
                              "(0 = disabled)")
+    parser.add_argument("--journal", default=None, metavar="PATH",
+                        help="write-ahead intent journal file "
+                             "(docs/robustness.md): bind/evict intents "
+                             "are journaled before execution and "
+                             "reconciled at startup, so a scheduler "
+                             "killed mid-cycle restarts without "
+                             "double-binds (VOLCANO_TPU_JOURNAL=0 "
+                             "disables)")
     args = parser.parse_args(argv)
 
     if args.listen_address:
@@ -43,6 +51,9 @@ def scheduler_main(argv: Optional[List[str]] = None) -> int:
                          default_queue=args.default_queue,
                          native_store=args.native_store)
     sys_.scheduler.conf_path = args.scheduler_conf
+    if args.journal:
+        from .cache.journal import IntentJournal
+        sys_.cache.attach_journal(IntentJournal(args.journal))
     signal.signal(signal.SIGTERM, lambda *_: sys_.stop())
     try:
         if args.leader_elect:

@@ -1,0 +1,172 @@
+"""Device-fault containment: classify accelerator errors and cool down.
+
+A batched solve can fail for two very different reasons, and the right
+response differs (docs/robustness.md):
+
+- **Solver logic faults** (non-finite scores, garbage indices, shape
+  bugs — ``actions.allocate.SolverFault``): the device is fine, the
+  program is wrong. Falling back to the sequential placer and retrying
+  the device engine next cycle is correct.
+- **Device faults** (XLA ``RESOURCE_EXHAUSTED`` OOM, device-lost,
+  backend-internal errors): retrying the device engine immediately just
+  re-fails — and after a device loss the device-resident tensor mirrors
+  are gone, so any cached device state is poison.
+
+``classify_device_fault`` tells the two apart; ``DeviceHealth`` is the
+cool-down state machine the allocate action consults:
+
+    OK --fault--> COOLDOWN (allocate degrades to the CPU/callbacks
+                  engine; volcano_device_healthy=0)
+    COOLDOWN --window expires--> PROBE (the next cycle attempts the
+                  device engine once)
+    PROBE --success--> OK (counters reset)
+    PROBE --fault--> COOLDOWN, window doubled (capped)
+
+Every transition is exported (``volcano_device_faults_total{kind}``,
+``volcano_device_healthy``, /healthz?detail). The window runs on an
+injectable ``time_fn`` so the sim and tests drive it on virtual time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+DEFAULT_COOLDOWN_S = 30.0
+DEFAULT_MAX_COOLDOWN_S = 480.0
+
+# substrings that mark an XLA runtime error as a DEVICE fault rather
+# than a program bug (jaxlib surfaces both through XlaRuntimeError)
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "OOM")
+_LOST_MARKERS = ("DEVICE_LOST", "device lost", "Device lost",
+                 "DATA_LOSS", "failed to enqueue")
+
+
+class DeviceFaultError(RuntimeError):
+    """A simulated device error (chaos.DeviceFaultInjector raises these
+    with ``kind`` in {"oom", "device_lost"}); classified exactly like
+    the real XlaRuntimeError equivalents."""
+
+    def __init__(self, kind: str, message: Optional[str] = None):
+        super().__init__(message or f"simulated device fault: {kind}")
+        self.kind = kind
+
+
+def classify_device_fault(exc: BaseException) -> Optional[str]:
+    """Return the device-fault kind ("oom" | "device_lost" | "xla") when
+    ``exc`` is a device error, None for logic/solver faults. Matches on
+    the exception type name (jaxlib's XlaRuntimeError lives at different
+    import paths across releases) plus message markers."""
+    if isinstance(exc, DeviceFaultError):
+        return exc.kind
+    if type(exc).__name__ != "XlaRuntimeError":
+        return None
+    msg = str(exc)
+    if any(m in msg for m in _OOM_MARKERS):
+        return "oom"
+    if any(m in msg for m in _LOST_MARKERS):
+        return "device_lost"
+    return "xla"
+
+
+class DeviceHealth:
+    """Cool-down state machine for the device engines (module-global
+    ``DEVICE_HEALTH`` instance; allocate consults it every cycle)."""
+
+    def __init__(self, cooldown_s: float = DEFAULT_COOLDOWN_S,
+                 max_cooldown_s: float = DEFAULT_MAX_COOLDOWN_S,
+                 time_fn=time.monotonic):
+        self.cooldown_s = cooldown_s
+        self.max_cooldown_s = max_cooldown_s
+        self.time_fn = time_fn
+        self._lock = threading.Lock()
+        self.consecutive_faults = 0
+        self.total_faults = 0
+        self.last_kind: Optional[str] = None
+        self._cooldown_until: Optional[float] = None
+
+    def record_fault(self, kind: str) -> float:
+        """A device fault occurred: open (or, after an expired window's
+        failed probe, DOUBLE) the cool-down window. A fault reported
+        while the window is still open is the same outage classified
+        twice (e.g. the tensor refresh AND the solve both blow up in one
+        cycle) — it updates ``last_kind`` but neither bumps the counters
+        nor extends the window. Returns the window length in force. Also
+        publishes ``volcano_device_faults_total{kind}`` for fresh
+        faults, so call sites cannot double-count either."""
+        with self._lock:
+            now = self.time_fn()
+            if self._cooldown_until is not None \
+                    and now < self._cooldown_until:
+                self.last_kind = kind
+                return self._cooldown_until - now
+            self.consecutive_faults += 1
+            self.total_faults += 1
+            self.last_kind = kind
+            window = min(
+                self.cooldown_s * (2 ** (self.consecutive_faults - 1)),
+                self.max_cooldown_s)
+            self._cooldown_until = now + window
+        from . import metrics
+        metrics.register_device_fault(kind)
+        self._publish()
+        return window
+
+    def record_ok(self) -> None:
+        """A device solve completed: close the state machine back to OK
+        (no-op when already OK — the hot path stays branch-cheap)."""
+        with self._lock:
+            if self.consecutive_faults == 0 \
+                    and self._cooldown_until is None:
+                return
+            self.consecutive_faults = 0
+            self._cooldown_until = None
+        self._publish()
+
+    def available(self) -> bool:
+        """May allocate dispatch to the device this cycle? True in OK
+        and PROBE (window expired — one re-probe attempt is the only way
+        to learn the device recovered), False inside the window."""
+        with self._lock:
+            until = self._cooldown_until
+            return until is None or self.time_fn() >= until
+
+    def cooldown_remaining(self) -> float:
+        with self._lock:
+            if self._cooldown_until is None:
+                return 0.0
+            return max(0.0, self._cooldown_until - self.time_fn())
+
+    def detail(self) -> dict:
+        with self._lock:
+            until = self._cooldown_until
+            now = self.time_fn()
+            return {
+                "available": until is None or now >= until,
+                "consecutive_faults": self.consecutive_faults,
+                "total_faults": self.total_faults,
+                "last_kind": self.last_kind,
+                "cooldown_remaining_s": round(max(0.0, (until - now)), 3)
+                if until is not None else 0.0,
+            }
+
+    def reset(self, time_fn=None) -> None:
+        """Full reset (tests / sim restart); optionally swap the time
+        source."""
+        with self._lock:
+            self.consecutive_faults = 0
+            self.total_faults = 0
+            self.last_kind = None
+            self._cooldown_until = None
+            if time_fn is not None:
+                self.time_fn = time_fn
+        self._publish()
+
+    def _publish(self) -> None:
+        from . import metrics
+        d = self.detail()
+        metrics.set_device_health(d["available"], d)
+
+
+DEVICE_HEALTH = DeviceHealth()

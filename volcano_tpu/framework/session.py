@@ -452,8 +452,26 @@ class Session:
                 return None
         try:
             return refresh(self.nodes, rnames, self.snap_epoch)
-        except Exception:
+        except Exception as exc:
             import logging
-            logging.getLogger(__name__).exception(
-                "persistent tensor refresh failed; rebuilding from scratch")
+            # the scatter update runs eager device ops, so a real XLA
+            # OOM/device-lost can surface HERE, not just inside the
+            # allocate solve — classify it and feed the same cool-down
+            # state machine instead of silently retrying every cycle
+            # (docs/robustness.md device-fault containment)
+            from ..device_health import DEVICE_HEALTH, classify_device_fault
+            kind = classify_device_fault(exc)
+            if kind is not None:
+                DEVICE_HEALTH.record_fault(kind)
+                invalidate = getattr(self.cache, "invalidate_device_state",
+                                     None)
+                if invalidate is not None:
+                    invalidate()
+                logging.getLogger(__name__).error(
+                    "device fault (%s) during persistent tensor refresh; "
+                    "cooling down, rebuilding from host truth", kind)
+            else:
+                logging.getLogger(__name__).exception(
+                    "persistent tensor refresh failed; rebuilding from "
+                    "scratch")
             return None

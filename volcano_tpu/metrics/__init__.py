@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import collections
 import threading
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 try:
     from prometheus_client import Counter, Gauge, Histogram, start_http_server
@@ -31,6 +31,10 @@ _counters: Dict[Tuple[str, ...], float] = collections.defaultdict(float)
 HEALTHY = "healthy"
 DEGRADED = "degraded"
 _health = {"state": HEALTHY, "consecutive_failures": 0}
+# structured operational detail served by /healthz?detail (JSON):
+# components push dicts here (device cool-down state, journal reconcile
+# summary) next to the gauges/counters the payload derives
+_health_detail: Dict[str, dict] = {}
 
 if _HAVE_PROM:
     _e2e = Histogram(f"{_SUBSYSTEM}_e2e_scheduling_latency_milliseconds",
@@ -81,6 +85,27 @@ if _HAVE_PROM:
                          "Snapshots (layer=clone) or tensor refreshes "
                          "(layer=tensor) that fell back to a full rebuild",
                          ["layer"])
+    _dead_letter_size = Gauge(f"{_SUBSYSTEM}_resync_dead_letter_size",
+                              "Side effects currently parked in the "
+                              "dead-letter set (redrive to drain)")
+    _state_drift = Counter(f"{_SUBSYSTEM}_state_drift_total",
+                           "Incremental-state drift events the shadow "
+                           "verifier detected and repaired "
+                           "(layer=node|job|tensor)", ["layer"])
+    _journal_replay = Counter(f"{_SUBSYSTEM}_journal_replayed_total",
+                              "Unacked journal intents settled by startup "
+                              "reconciliation", ["result"])
+    _device_faults = Counter(f"{_SUBSYSTEM}_device_faults_total",
+                             "Device errors (XLA OOM / device-lost) "
+                             "contained by the cool-down state machine",
+                             ["kind"])
+    _device_ok = Gauge(f"{_SUBSYSTEM}_device_healthy",
+                       "1 device engines available, 0 cooling down "
+                       "(allocate degraded to the CPU engine)")
+    _device_degraded = Counter(
+        f"{_SUBSYSTEM}_device_degraded_cycles_total",
+        "Allocate cycles that ran on the CPU placer because the "
+        "device cool-down window was open")
 
 
 def update_e2e_duration(seconds: float) -> None:
@@ -104,6 +129,28 @@ def set_health(state: str, consecutive_failures: int = 0) -> None:
 def health() -> Tuple[str, int]:
     with _lock:
         return _health["state"], _health["consecutive_failures"]
+
+
+def health_detail() -> dict:
+    """The structured /healthz?detail payload: shell health plus the
+    robustness-layer state a probe or operator wants in one read —
+    dead-letter backlog, device cool-down, drift counters, journal
+    replay totals (docs/robustness.md)."""
+    with _lock:
+        drift = {k[1]: v for k, v in _counters.items()
+                 if k[0] == "state_drift"}
+        journal = {k[1]: v for k, v in _counters.items()
+                   if k[0] == "journal_replayed"}
+        return {
+            "state": _health["state"],
+            "consecutive_failures": _health["consecutive_failures"],
+            "dead_letter_size": int(
+                _gauges.get(("resync_dead_letter_size",), 0)),
+            "device": dict(_health_detail.get("device",
+                                              {"available": True})),
+            "state_drift_total": drift,
+            "journal_replayed_total": journal,
+        }
 
 
 def register_action_failure(action: str) -> None:
@@ -146,6 +193,75 @@ def register_snapshot_full_rebuild(layer: str) -> None:
         _snap_full.labels(layer=layer).inc()
 
 
+def set_dead_letter_size(size: int) -> None:
+    """Current dead-letter set size — the cache updates this on every
+    mutation of the set (park, purge, redrive); /healthz detail and the
+    redrive CLI read it."""
+    with _lock:
+        _gauges[("resync_dead_letter_size",)] = float(size)
+    if _HAVE_PROM:
+        _dead_letter_size.set(size)
+
+
+def dead_letter_size() -> int:
+    with _lock:
+        return int(_gauges.get(("resync_dead_letter_size",), 0))
+
+
+def register_state_drift(layer: str, n: int = 1) -> None:
+    """The shadow verifier found ``n`` drifted entries in ``layer``
+    (node|job|tensor) — a silent-corruption event turned into a counted,
+    repaired one (docs/robustness.md)."""
+    with _lock:
+        _counters[("state_drift", layer)] += n
+    if _HAVE_PROM:
+        _state_drift.labels(layer=layer).inc(n)
+
+
+def set_drift_verify_stats(drift_total: int, verify_s: float) -> None:
+    with _lock:
+        _gauges[("drift_last_verify_total",)] = float(drift_total)
+        _gauges[("drift_last_verify_s",)] = float(verify_s)
+
+
+def register_journal_replay(result: str, n: int = 1) -> None:
+    """Startup reconciliation settled ``n`` unacked journal intents with
+    the given outcome (repaired|rolled_back|redone|stale|failed)."""
+    with _lock:
+        _counters[("journal_replayed", result)] += n
+    if _HAVE_PROM:
+        _journal_replay.labels(result=result).inc(n)
+
+
+def register_device_degraded_cycle() -> None:
+    """An allocate cycle ran on the CPU placer because the device
+    cool-down window was open."""
+    with _lock:
+        _counters[("device_degraded_cycles",)] += 1
+    if _HAVE_PROM:
+        _device_degraded.inc()
+
+
+def register_device_fault(kind: str) -> None:
+    """A device error (oom|device_lost|xla) was classified and contained
+    by the allocate cool-down state machine."""
+    with _lock:
+        _counters[("device_faults", kind)] += 1
+    if _HAVE_PROM:
+        _device_faults.labels(kind=kind).inc()
+
+
+def set_device_health(available: bool, detail: Optional[dict] = None) -> None:
+    """Publish the device cool-down state (device_health.DeviceHealth
+    pushes on every transition); detail lands in /healthz?detail."""
+    with _lock:
+        _gauges[("device_healthy",)] = 1.0 if available else 0.0
+        _health_detail["device"] = dict(detail) if detail else {
+            "available": available}
+    if _HAVE_PROM:
+        _device_ok.set(1.0 if available else 0.0)
+
+
 def register_dead_letter(op: str) -> None:
     """A failed side effect exhausted its resync retry budget and was
     parked in the cache's dead-letter set."""
@@ -170,13 +286,20 @@ def start_metrics_server(port: int = 8080, host: str = ""):
             status = 200
             if self.path.startswith("/healthz"):
                 state, fails = health()
-                if state == HEALTHY:
-                    body = b"ok"
-                else:
+                if state != HEALTHY:
                     status = 503
-                    body = (f"degraded ({fails} consecutive failed "
-                            f"cycles)").encode()
-                ctype = "text/plain"
+                if "detail" in self.path:
+                    import json
+                    body = json.dumps(health_detail(),
+                                      sort_keys=True).encode()
+                    ctype = "application/json"
+                else:
+                    if state == HEALTHY:
+                        body = b"ok"
+                    else:
+                        body = (f"degraded ({fails} consecutive failed "
+                                f"cycles)").encode()
+                    ctype = "text/plain"
             elif self.path.startswith("/metrics"):
                 if _HAVE_PROM:
                     from prometheus_client import (CONTENT_TYPE_LATEST,
@@ -343,5 +466,6 @@ def reset_local() -> None:
         _durations.clear()
         _gauges.clear()
         _counters.clear()
+        _health_detail.clear()
         _health["state"] = HEALTHY
         _health["consecutive_failures"] = 0

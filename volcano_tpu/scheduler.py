@@ -42,6 +42,20 @@ DEFAULT_BACKOFF_BASE = 1.0
 DEFAULT_BACKOFF_MAX = 60.0
 DEFAULT_BACKOFF_JITTER = 0.2
 
+# Shadow-verifier cadence (docs/robustness.md): every N cycles the cache
+# re-derives snapshot/tensor state from scratch OFF-CYCLE (outside the
+# e2e-timed window) and repairs any drift. 0 disables; the env var
+# overrides the constructor default.
+DEFAULT_DRIFT_VERIFY_EVERY = 64
+
+
+def _drift_verify_default() -> int:
+    try:
+        return int(os.environ.get("VOLCANO_TPU_DRIFT_VERIFY_EVERY",
+                                  DEFAULT_DRIFT_VERIFY_EVERY))
+    except ValueError:
+        return DEFAULT_DRIFT_VERIFY_EVERY
+
 
 class WallClock:
     """Default time source for the shell's pacing: monotonic wall time
@@ -68,7 +82,8 @@ class Scheduler:
                  backoff_base: float = DEFAULT_BACKOFF_BASE,
                  backoff_max: float = DEFAULT_BACKOFF_MAX,
                  backoff_jitter: float = DEFAULT_BACKOFF_JITTER,
-                 clock=None):
+                 clock=None,
+                 drift_verify_every: Optional[int] = None):
         # actions/plugins register on import
         from . import actions as _actions  # noqa: F401
         from . import plugins as _plugins  # noqa: F401
@@ -92,6 +107,12 @@ class Scheduler:
         self.action_fault_hook: Optional[Callable] = None
         # crash-loop guard state, exported through metrics.set_health
         self.consecutive_failures = 0
+        # drift self-healing (docs/robustness.md): run_once counts cycles
+        # and triggers the cache's shadow verifier off-cycle every N
+        self.drift_verify_every = _drift_verify_default() \
+            if drift_verify_every is None else drift_verify_every
+        self._cycles_run = 0
+        self._reconciled = False
         self._load_conf(conf_text)
 
     def _load_conf(self, conf_text: Optional[str] = None) -> None:
@@ -137,10 +158,15 @@ class Scheduler:
         runnable = [(name, get_action(name)) for name in self.conf.actions]
         runnable = [(n, a) for n, a in runnable if a is not None]
         if not runnable:
+            # resync retries above still journaled side effects, and the
+            # drift cadence must keep counting — the short-circuit skips
+            # only the snapshot/session work
+            self._cycle_epilogue()
             return errors
         start = time.perf_counter()
         ssn = open_session(self.cache, self.conf.tiers,
                            self.conf.configurations)
+        crashed = False
         try:
             for name, action in runnable:
                 action_start = time.perf_counter()
@@ -165,10 +191,70 @@ class Scheduler:
                 finally:
                     metrics.update_action_duration(
                         name, time.perf_counter() - action_start)
+        except BaseException as exc:
+            # a non-Exception escaping here is a (simulated or real)
+            # process death — SimKill, KeyboardInterrupt. A SIGKILL'd
+            # process never runs close-time writebacks (plugin
+            # on_session_close, the job updater's PodGroup status
+            # flush), so neither may we: skip close_session and let the
+            # session's leak finalizer resume the GC window instead.
+            crashed = not isinstance(exc, Exception)
+            raise
         finally:
-            close_session(ssn)
+            if not crashed:
+                close_session(ssn)
         metrics.update_e2e_duration(time.perf_counter() - start)
+        self._cycle_epilogue()
         return errors
+
+    def _cycle_epilogue(self) -> None:
+        """Off-cycle (post-e2e-window) cycle bookkeeping, run on BOTH
+        run_once exits: flush the journal's buffered ack tail (intents
+        are made durable before their executor runs; this just bounds
+        ack-record lag to one cycle) and tick the drift-verify cadence."""
+        journal = getattr(self.cache, "journal", None)
+        if journal is not None:
+            try:
+                journal.flush()
+            except Exception:
+                log.exception("journal flush failed")
+        self._maybe_verify_drift()
+
+    def _maybe_verify_drift(self) -> None:
+        """Amortized shadow verification (docs/robustness.md): every
+        ``drift_verify_every`` cycles, AFTER the e2e-timed window closed,
+        ask the cache to re-derive snapshot/tensor state from scratch and
+        self-heal any drift. Isolated like an action — a verifier bug
+        must not cost scheduling cycles."""
+        self._cycles_run += 1
+        if not self.drift_verify_every \
+                or self._cycles_run % self.drift_verify_every:
+            return
+        verify = getattr(self.cache, "verify_state_integrity", None)
+        if verify is None:
+            return
+        try:
+            stats = verify()
+            if stats["drift_total"]:
+                log.error("state drift detected and repaired: %s",
+                          stats["drift"])
+        except Exception:
+            log.exception("shadow drift verification failed")
+            metrics.register_action_failure("drift-verify")
+
+    def startup_reconcile(self, cluster_binds=None, cluster_evicts=None):
+        """Settle the intent journal's crash window before the first
+        cycle (cache.reconcile_journal); called automatically by run(),
+        explicitly by restart harnesses. Idempotent per process."""
+        self._reconciled = True
+        reconcile = getattr(self.cache, "reconcile_journal", None)
+        if reconcile is None:
+            return None
+        report = reconcile(cluster_binds, cluster_evicts)
+        if report is not None and report.replayed:
+            log.warning("journal reconciliation replayed %d unacked "
+                        "intents: %s", report.replayed, report.as_dict())
+        return report
 
     def _backoff(self, cap: float) -> float:
         """Exponential backoff with jitter for the current consecutive
@@ -188,6 +274,12 @@ class Scheduler:
         faults (the rest of the pipeline ran fine) cap near the schedule
         period — one chronically failing action must not throttle healthy
         actions and the resync retries to crash-loop cadence."""
+        if not self._reconciled:
+            try:
+                self.startup_reconcile()
+            except Exception:
+                log.exception("startup journal reconciliation failed; "
+                              "continuing (side effects may retry)")
         while not self._stop.is_set():
             cycle_start = time.perf_counter()
             cycle_fault = False

@@ -7,6 +7,11 @@ Examples::
     python -m volcano_tpu.sim --scenario skew --seed 3 --out report.json
     python -m volcano_tpu.sim --scenario steady --write-trace steady.jsonl
     python -m volcano_tpu.sim --trace steady.jsonl --conf my.conf
+
+Crash-recovery soak (docs/robustness.md; the CI chaos step)::
+
+    python -m volcano_tpu.sim --scenario smoke --chaos-rate 0.2 \\
+        --kill-cycles 3,7,12 --verify-restart-equivalence
 """
 
 from __future__ import annotations
@@ -14,7 +19,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .report import deterministic_json, to_json
+from .report import deterministic_json, terminal_accounting, to_json
 from .runner import SimRunner
 from .trace import load_trace, write_trace
 from .workload import SCENARIOS, make_scenario
@@ -42,6 +47,22 @@ def main(argv=None) -> int:
                     help="print ONLY the decision plane as canonical JSON "
                          "(byte-comparable across runs — the CI "
                          "sim-determinism step diffs this)")
+    ap.add_argument("--chaos-rate", type=float, default=0.0,
+                    help="seeded bind/evict failure rate (volcano_tpu."
+                         "chaos wrappers; 0 = off)")
+    ap.add_argument("--chaos-seed", type=int, default=None,
+                    help="chaos RNG seed (default: --seed)")
+    ap.add_argument("--kill-cycles", default="",
+                    help="comma-separated virtual cycles on which to "
+                         "crash+restart the scheduler mid-trace "
+                         "(intent journal + startup reconciliation)")
+    ap.add_argument("--kill-seed", type=int, default=None,
+                    help="kill-point RNG seed (default: --seed)")
+    ap.add_argument("--verify-restart-equivalence", action="store_true",
+                    help="also run the SAME trace unkilled and assert the "
+                         "killed run converged to the same terminal "
+                         "decision-plane accounting with zero "
+                         "double-binds (exit 1 otherwise)")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -61,16 +82,59 @@ def main(argv=None) -> int:
     if args.conf:
         with open(args.conf) as f:
             conf_text = f.read()
-    runner = SimRunner(trace, conf_text=conf_text, period=args.period,
-                       seed=args.seed, max_cycles=args.max_cycles,
-                       scenario=args.scenario)
-    report = runner.run()
+
+    chaos_seed = args.seed if args.chaos_seed is None else args.chaos_seed
+    kill_seed = args.seed if args.kill_seed is None else args.kill_seed
+    kill_cycles = [int(c) for c in args.kill_cycles.split(",") if c.strip()]
+
+    def wraps():
+        if not args.chaos_rate:
+            return None, None
+        from ..chaos import ChaosBinder, ChaosEvictor
+        return (lambda b: ChaosBinder(b, failure_rate=args.chaos_rate,
+                                      seed=chaos_seed),
+                lambda e: ChaosEvictor(e, failure_rate=args.chaos_rate,
+                                       seed=chaos_seed))
+
+    def run(kills):
+        bw, ew = wraps()
+        runner = SimRunner(trace, conf_text=conf_text, period=args.period,
+                           seed=args.seed, max_cycles=args.max_cycles,
+                           scenario=args.scenario, binder_wrap=bw,
+                           evictor_wrap=ew, kill_cycles=kills,
+                           kill_seed=kill_seed)
+        return runner.run()
+
+    report = run(kill_cycles)
     text = deterministic_json(report) if args.deterministic \
         else to_json(report)
     print(text)
     if args.out:
         with open(args.out, "w") as f:
             f.write(text + "\n")
+    if args.verify_restart_equivalence:
+        baseline = run([])
+        got = terminal_accounting(report)
+        want = terminal_accounting(baseline)
+        problems = []
+        if got != want:
+            problems.append(f"terminal accounting diverged: "
+                            f"killed={got} unkilled={want}")
+        if got.get("double_binds"):
+            problems.append(f"double-binds in killed run: "
+                            f"{got['double_binds']}")
+        if got.get("unfinished"):
+            problems.append(f"killed run left {got['unfinished']} jobs "
+                            f"unfinished")
+        if report["jobs"]["completed"] != report["jobs"]["arrived"]:
+            problems.append("killed run did not complete every arrived job")
+        if problems:
+            for p in problems:
+                print(f"restart-equivalence FAILED: {p}", file=sys.stderr)
+            return 1
+        print(f"restart-equivalence OK: {report['restarts']} restarts, "
+              f"journal={report['journal_replayed']}, "
+              f"accounting={got}", file=sys.stderr)
     return 0
 
 

@@ -126,6 +126,11 @@ def build_report(runner, actions_ms: Dict[tuple, list],
         "requeues": runner.requeues,
         "dead_letter": len(runner.cache.dead_letter),
         "action_failures": len(runner.action_failures),
+        # crash/restart plane (zero on unkilled runs; deterministic from
+        # kill_cycles + kill_seed, so still part of the decision plane)
+        "restarts": getattr(runner, "restarts", 0),
+        "double_binds": getattr(runner, "double_binds", 0),
+        "journal_replayed": dict(getattr(runner, "_journal_replayed", {})),
         "jct_s": percentiles(runner.jct),
         "queueing_delay_s": percentiles(runner.queueing_delay),
         "gang_admission_s": percentiles(runner.gang_admission),
@@ -151,6 +156,21 @@ def build_report(runner, actions_ms: Dict[tuple, list],
 
 def _mean(vals: List[float]) -> float:
     return sum(vals) / len(vals) if vals else 0.0
+
+
+def terminal_accounting(report: dict) -> dict:
+    """The restart-equivalence contract (docs/robustness.md): the subset
+    of the decision plane a killed-and-recovered run must share with an
+    unkilled run of the same trace. Kills legitimately reshuffle the
+    bind/evict SEQUENCE and stretch latencies; what recovery must
+    preserve is the terminal accounting — every arrived gang completes,
+    nothing is left behind, and no task was ever double-bound."""
+    return {
+        "arrived": report["jobs"]["arrived"],
+        "completed": report["jobs"]["completed"],
+        "unfinished": report["jobs"]["unfinished"],
+        "double_binds": report.get("double_binds", 0),
+    }
 
 
 def deterministic_part(report: dict) -> dict:

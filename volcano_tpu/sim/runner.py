@@ -29,20 +29,35 @@ Chaos composes: pass ``binder_wrap``/``evictor_wrap`` (e.g.
 failures flow through the cache's real rollback + resync machinery; the
 runner pins the resync queue's time source to the virtual clock, so even
 retry backoff timing is deterministic.
+
+Crash/restart composes too (docs/robustness.md): ``kill_cycles`` names
+virtual cycles on which the scheduler process "dies" — at a seeded kill
+point (mid-bind/mid-evict before or after the executor ran, or between
+cycles) — and restarts: volatile state (resync queue, dead-letter set,
+in-flight markers, incremental snapshot + tensor caches) is lost, the
+intent journal survives, and startup reconciliation settles the crash
+window against the executors' recorded cluster truth before the next
+cycle. The run then must converge to the same terminal decision-plane
+accounting as an unkilled run, with zero double-binds — the acceptance
+soak the CI chaos step drives.
 """
 
 from __future__ import annotations
 
 import heapq
 import itertools
+import random
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .. import metrics
 from ..api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase, QueueInfo,
                    Resource, TaskInfo, TaskStatus)
 from ..cache import SchedulerCache
+from ..cache.cache import RateLimitedQueue
 from ..cache.executors import SequenceBinder, SequenceEvictor
+from ..cache.journal import IntentJournal
+from ..chaos import KillPointBinder, KillPointEvictor, SimKill
 from ..scheduler import Scheduler
 from .trace import TraceEvent
 from . import report as report_mod
@@ -89,7 +104,10 @@ class SimRunner:
                  stall_limit: int = 120,
                  binder_wrap: Optional[Callable] = None,
                  evictor_wrap: Optional[Callable] = None,
-                 scenario: Optional[str] = None):
+                 scenario: Optional[str] = None,
+                 kill_cycles: Optional[Sequence[int]] = None,
+                 kill_seed: int = 0,
+                 journal: Optional[IntentJournal] = None):
         self.trace = list(trace)
         self.period = period
         self.seed = seed
@@ -102,12 +120,36 @@ class SimRunner:
         self.evictor = SequenceEvictor()
         binder = binder_wrap(self.binder) if binder_wrap else self.binder
         evictor = evictor_wrap(self.evictor) if evictor_wrap else self.evictor
+        # crash/restart rig: kill wrappers sit OUTERMOST (outside chaos)
+        # so a kill-after-execute still records the inner side effect —
+        # the "cluster did it, the scheduler died before learning" window
+        self.kill_cycles = set(kill_cycles or ())
+        self.kill_seed = kill_seed
+        self._kill_rng = random.Random(kill_seed)
+        self.restarts = 0
+        self.double_binds = 0
+        self._live_bound: set = set()
+        self._journal_replayed: Dict[str, int] = {}
+        self._kill_binder: Optional[KillPointBinder] = None
+        self._kill_evictor: Optional[KillPointEvictor] = None
+        self.journal = journal
+        if self.kill_cycles:
+            self._kill_binder = binder = KillPointBinder(binder)
+            self._kill_evictor = evictor = KillPointEvictor(evictor)
+            if self.journal is None:
+                self.journal = IntentJournal()    # in-memory: survives the
+                #                                   simulated process death
         self.cache = SchedulerCache(binder=binder, evictor=evictor,
-                                    default_queue=None)
+                                    default_queue=None, journal=self.journal)
         # retry backoff runs on virtual time too: a chaos-failed bind's
         # re-attempt lands on a deterministic virtual cycle, not whenever
         # the host happens to get there
         self.cache.resync_queue.time_fn = self.clock.time
+        # ...and so does the device cool-down window, so a composed
+        # DeviceFaultInjector re-probes on a deterministic virtual cycle
+        # instead of wherever the host's wall clock lands
+        from ..device_health import DEVICE_HEALTH
+        DEVICE_HEALTH.reset(time_fn=self.clock.time)
         self.conf_text = conf_text if conf_text is not None else SIM_CONF
         self.sched = Scheduler(self.cache, conf_text=self.conf_text,
                                schedule_period=period, clock=self.clock)
@@ -228,6 +270,7 @@ class SimRunner:
             node.remove_task(cached)
         cached.node_name = ""
         job.update_task_status(cached, TaskStatus.PENDING)
+        self._live_bound.discard(uid)
         self.requeues += 1
         if job.uid in self.admitted_at:
             # the gang dropped below min_available: cancel its pending
@@ -250,6 +293,7 @@ class SimRunner:
         for task in list(job.tasks.values()):
             self.cache.delete_task(task)
             self.task_job.pop(task.uid, None)
+            self._live_bound.discard(task.uid)
         self.cache.remove_job(uid)
         self.admitted_at.pop(uid, None)
         self.jct.append(t - self.arrival_time[uid])
@@ -266,6 +310,13 @@ class SimRunner:
         while self._binds_seen < len(seq):
             uid, _host = seq[self._binds_seen]
             self._binds_seen += 1
+            # a second cluster-side bind of a task whose first bind is
+            # still live (no evict/requeue in between) is a DOUBLE-BIND —
+            # the exact corruption the journal + reconciler must prevent
+            if uid in self._live_bound:
+                self.double_binds += 1
+            else:
+                self._live_bound.add(uid)
             jid = self.task_job.get(uid)
             job = self.cache.jobs.get(jid) if jid else None
             if job is None or uid not in job.tasks:
@@ -307,6 +358,92 @@ class SimRunner:
                 and not self._completions
                 and not self.cache.jobs)
 
+    # -- crash/restart ------------------------------------------------------
+
+    _KILL_MODES = ("bind_before", "bind_after", "evict_before",
+                   "evict_after", "post_cycle")
+
+    def _arm_kill(self) -> str:
+        """Pick (seeded) where this cycle's crash lands and arm the
+        matching kill point. Returns the mode; "post_cycle" crashes
+        cleanly between run_once and the next cycle instead."""
+        mode = self._kill_rng.choice(self._KILL_MODES)
+        at = self._kill_rng.randint(1, 5)
+        if mode == "bind_before":
+            self._kill_binder.arm(at, before=True)
+        elif mode == "bind_after":
+            self._kill_binder.arm(at, before=False)
+        elif mode == "evict_before":
+            self._kill_evictor.arm(at, before=True)
+        elif mode == "evict_after":
+            self._kill_evictor.arm(at, before=False)
+        return mode
+
+    def _crash_restart(self, kill_mode: Optional[str] = None) -> None:
+        """Simulate the scheduler process dying and a fresh incarnation
+        starting against the same cluster. The CACHE's object graph
+        stands in for what a restart would rebuild from the API server
+        (the sim maintains it as cluster truth), so the restart drops
+        exactly the state a real process death loses:
+
+        - the resync queue (queued retries die with the process; their
+          tasks are PENDING in cluster truth and simply re-place),
+        - the dead-letter set and in-flight binding markers,
+        - every incremental-snapshot and device-tensor cache
+          (mark_all_dirty + tensor drop — the new process starts cold),
+
+        then runs startup reconciliation: the journal's unacked intent
+        (the crash window is at most one — side effects are synchronous)
+        is settled against the executors' recorded cluster truth, either
+        re-asserted into the cache (the cluster executed it) or rolled
+        back (it never happened). A fresh Scheduler shell replaces the
+        dead one."""
+        c = self.cache
+        if self._kill_binder is not None:
+            self._kill_binder.disarm()
+        if self._kill_evictor is not None:
+            self._kill_evictor.disarm()
+        c.binding_tasks.clear()
+        c.dead_letter.clear()
+        metrics.set_dead_letter_size(0)
+        c.err_tasks.clear()
+        c.resync_queue = RateLimitedQueue(
+            max_retries=c.resync_queue.max_retries,
+            time_fn=self.clock.time)
+        c.mark_all_dirty()
+        c.tensor_cache = None
+        c._tensor_dirty = set()
+        self.sched = Scheduler(self.cache, conf_text=self.conf_text,
+                               schedule_period=self.period,
+                               clock=self.clock)
+        # a process death also resets the device cool-down state machine
+        # (it lives in process memory) — and its clock stays virtual
+        from ..device_health import DEVICE_HEALTH
+        DEVICE_HEALTH.reset(time_fn=self.clock.time)
+        # cluster-truth oracle for the crash window: at most ONE intent
+        # is unacked (execution is synchronous) and the KILL MODE says
+        # whether its executor ran. Only an after-execute kill makes the
+        # executor tail the crash-window op; a before-execute kill means
+        # nothing executed — matching the tail there would mistake a
+        # STALE earlier bind/evict of the same (task, node) pair for the
+        # crash-window execution and "repair" a bind the cluster never
+        # saw.
+        cluster_binds = dict(self.binder.sequence[-1:]) \
+            if kill_mode == "bind_after" else {}
+        etail = self.evictor.sequence[-1:] \
+            if kill_mode == "evict_after" else []
+
+        def cluster_evicts(uid: str) -> bool:
+            return uid in etail
+
+        report = self.sched.startup_reconcile(cluster_binds, cluster_evicts)
+        if report is not None:
+            for k, v in report.as_dict().items():
+                if v:
+                    self._journal_replayed[k] = \
+                        self._journal_replayed.get(k, 0) + v
+        self.restarts += 1
+
     def run(self) -> dict:
         """Run the trace to completion (or stall/max_cycles); returns the
         report dict (sim/report.py)."""
@@ -318,8 +455,26 @@ class SimRunner:
             now = self.clock.time()
             self._apply_trace_until(now)
             self._fire_completions_until(now)
+            kill_mode = None
+            if self.cycles in self.kill_cycles:
+                kill_mode = self._arm_kill()
             t0 = time.perf_counter()
-            errors = self.sched.run_once()
+            try:
+                errors = self.sched.run_once()
+            except SimKill:
+                errors = []
+                self._crash_restart(kill_mode)
+            else:
+                if kill_mode == "post_cycle":
+                    # clean-boundary death: nothing mid-flight, but all
+                    # volatile state (queued retries!) dies with the process
+                    self._crash_restart("post_cycle")
+                elif kill_mode is not None:
+                    # the armed kill point never fired this cycle (too few
+                    # side effects) — the "crash" degenerates to a restart
+                    # at the boundary, which is still a real restart (and
+                    # the crash window is empty, so no oracle is needed)
+                    self._crash_restart("post_cycle")
             self.pipeline_e2e_ms.append((time.perf_counter() - t0) * 1e3)
             for name, _ in errors:
                 self.action_failures.append((self.cycles, name))
@@ -337,5 +492,10 @@ class SimRunner:
             if stall >= self.stall_limit:
                 break                # wedged backlog: report what's left
         wall_s = time.perf_counter() - wall0
+        # hand the (global) device-health state machine back to wall time
+        # so post-sim code in the same process isn't stuck on a frozen
+        # virtual clock
+        from ..device_health import DEVICE_HEALTH
+        DEVICE_HEALTH.reset(time_fn=time.monotonic)
         return report_mod.build_report(
             self, actions_ms=metrics.durations_since(mark), wall_s=wall_s)
