@@ -15,11 +15,13 @@ run_python=true
 run_shim=true
 run_sim=true
 run_soak=true
+run_obs=true
 case "${1:-}" in
-  --shim-only) run_python=false; run_sim=false; run_soak=false ;;
-  --python-only) run_shim=false; run_sim=false; run_soak=false ;;
-  --sim-only) run_python=false; run_shim=false; run_soak=false ;;
-  --soak-only) run_python=false; run_shim=false; run_sim=false ;;
+  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false ;;
+  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false ;;
+  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false ;;
+  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false ;;
+  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false ;;
 esac
 
 if $run_python; then
@@ -79,6 +81,33 @@ if $run_soak; then
 deterministic"; exit 1; }
     echo "   $scenario: killed run converged, deterministic, zero double-binds"
   done
+fi
+
+if $run_obs; then
+  # observability (docs/observability.md): a sim smoke with --trace-out
+  # must emit schema-valid, perfetto-loadable Chrome trace JSON (required
+  # event fields, monotonic ts, matched/nested B/E pairs, the core span
+  # names present) that is BYTE-REPRODUCIBLE under --deterministic; and
+  # /metrics must parse with the prometheus_client text parser on BOTH
+  # exposition paths (prometheus_client installed and the no-dependency
+  # fallback).
+  echo "== observability: trace schema + determinism + /metrics parse =="
+  obsdir=$(mktemp -d)
+  trap 'rm -rf "${simdir:-/nonexistent}" "${soakdir:-/nonexistent}" \
+"${obsdir:-/nonexistent}"' EXIT
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario smoke --seed 3 \
+    --deterministic --trace-out "$obsdir/smoke.a.trace.json" > /dev/null
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario smoke --seed 3 \
+    --deterministic --trace-out "$obsdir/smoke.b.trace.json" > /dev/null
+  JAX_PLATFORMS=cpu python -m volcano_tpu.obs.validate \
+    "$obsdir/smoke.a.trace.json" \
+    || { echo "observability FAILED: trace schema"; exit 1; }
+  diff "$obsdir/smoke.a.trace.json" "$obsdir/smoke.b.trace.json" \
+    || { echo "observability FAILED: deterministic trace not \
+byte-reproducible"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.obs.validate --metrics-scrape \
+    || { echo "observability FAILED: /metrics scrape/parse"; exit 1; }
+  echo "   trace schema valid, byte-reproducible; /metrics parses both paths"
 fi
 
 if $run_shim; then

@@ -38,6 +38,7 @@ import numpy as np
 
 from ..api import (FitError, FitErrors, NodeInfo, PodGroupPhase, Resource, TaskInfo,
                    TaskStatus)
+from ..obs import trace as obs_trace
 from ..cache.snapshot import (NodeTensors, assemble_feasibility,
                               assemble_static_score, assemble_weights,
                               discover_resource_names, task_requests)
@@ -93,15 +94,15 @@ def _node_tensors(ssn, rnames) -> NodeTensors:
     snapshot untouched (session.snapshot_node_tensors), else a from-scratch
     build — the two are row-identical by the oracle test
     (tests/test_incremental_snapshot.py). Time spent here is reported as
-    bench.py's tensor_assembly_ms."""
-    t0 = time.perf_counter()
-    get = getattr(ssn, "snapshot_node_tensors", None)
-    node_t = get(rnames) if get is not None else None
-    incremental = node_t is not None
-    if node_t is None:
-        node_t = NodeTensors(list(ssn.nodes.values()), rnames)
-    LAST_STATS["tensor_s"] = LAST_STATS.get("tensor_s", 0.0) \
-        + (time.perf_counter() - t0)
+    bench.py's tensor_assembly_ms and traced as the ``tensor_assembly``
+    span."""
+    with obs_trace.span("tensor_assembly") as sp:
+        get = getattr(ssn, "snapshot_node_tensors", None)
+        node_t = get(rnames) if get is not None else None
+        incremental = node_t is not None
+        if node_t is None:
+            node_t = NodeTensors(list(ssn.nodes.values()), rnames)
+    LAST_STATS["tensor_s"] = LAST_STATS.get("tensor_s", 0.0) + sp.dur_s
     LAST_STATS["tensor_incremental"] = incremental
     return node_t
 
@@ -348,6 +349,12 @@ def _pop_next(ssn, namespaces, jobs_map):
 
 
 def _execute_interleaved(ssn, placer) -> None:
+    with obs_trace.span("interleave",
+                        placer=type(placer).__name__.lstrip("_")):
+        _run_interleaved(ssn, placer)
+
+
+def _run_interleaved(ssn, placer) -> None:
     namespaces, jobs_map = _build_interleave(ssn)
     pending: Dict[str, List[TaskInfo]] = {}
 
@@ -696,12 +703,13 @@ def _execute_strict_batched(ssn, batch: int = 16) -> None:
         with_tasks = [(j, live_tasks(j)) for j in predicted]
         solvable = [(j, t) for j, t in with_tasks if t]
         if solvable:
-            packed_d, new_state, bucket, J, slices = _solve_job_batch(
-                ssn, solvable, state, node_t, rnames, weights,
-                allocatable_d, max_tasks_d, solver, j_pad=b_cur)
-            packed = np.asarray(packed_d)            # the batch's ONE fetch
-            task_node, pipelined, _, job_kept = unpack_placement(
-                packed, bucket, J)
+            with obs_trace.span("solve", batch=len(solvable)):
+                packed_d, new_state, bucket, J, slices = _solve_job_batch(
+                    ssn, solvable, state, node_t, rnames, weights,
+                    allocatable_d, max_tasks_d, solver, j_pad=b_cur)
+                packed = np.asarray(packed_d)        # the batch's ONE fetch
+                task_node, pipelined, _, job_kept = unpack_placement(
+                    packed, bucket, J)
         solved_ix = {id(j): k for k, (j, _) in enumerate(solvable)}
 
         verified_prefix: List[tuple] = []
@@ -883,18 +891,18 @@ def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4,
         assumed: Optional[set] = None
         solution = None
         for _ in range(max_order_iters):
-            t0 = time.perf_counter()
-            ordered_jobs = _fixed_job_order(ssn, assumed)
-            t_order += time.perf_counter() - t0
+            with obs_trace.span("order") as sp:
+                ordered_jobs = _fixed_job_order(ssn, assumed)
+            t_order += sp.dur_s
             if not ordered_jobs:
                 solution = None
                 break
-            t0 = time.perf_counter()
             from .. import metrics
-            with metrics.solver_trace("allocate-solve"):
-                solution = _solve_fused(ssn, ordered_jobs, blocks, kernel,
-                                        sharded)
-            t_solve += time.perf_counter() - t0
+            with obs_trace.span("solve", kernel=kernel) as sp:
+                with metrics.solver_trace("allocate-solve"):
+                    solution = _solve_fused(ssn, ordered_jobs, blocks,
+                                            kernel, sharded)
+            t_solve += sp.dur_s
             if solution is None:
                 break
             kept_uids = {solution.jobs_list[jx].uid
@@ -909,9 +917,9 @@ def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4,
             assumed = kept_uids
         if solution is None:
             break
-        t0 = time.perf_counter()
-        rejected = _replay_fused(ssn, solution)
-        t_replay += time.perf_counter() - t0
+        with obs_trace.span("replay") as sp:
+            rejected = _replay_fused(ssn, solution)
+        t_replay += sp.dur_s
         if not rejected:
             break
     LAST_STATS.update(order_s=t_order, solve_s=t_solve, replay_s=t_replay)
@@ -1260,9 +1268,14 @@ def _replay_fused_fast(ssn, sol: "_FusedSolution") -> None:
         node = node_objs[row]
         node._touched = True
         node.pipelined.add(r)
+    # the statement-free path never goes through session.dispatch, so it
+    # feeds the decision audit here (a no-op unless the audit is on)
+    for task in binds:
+        ssn._audit_event("bind", task, task.node_name)
     # bind_batch records every bound task/node in the cache's dirty set, so
     # the NEXT cycle's snapshot+tensor delta is exactly this cycle's binds
-    ssn.cache.bind_batch(binds)
+    with obs_trace.span("bind_commit", binds=len(binds)):
+        ssn.cache.bind_batch(binds)
 
 
 def _replay_fused(ssn, sol: _FusedSolution) -> int:

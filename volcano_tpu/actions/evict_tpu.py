@@ -806,7 +806,6 @@ LAST_STATS: Dict[str, float] = {}
 
 def _preempt_phase(ssn, pjobs, victims, inter_job: bool,
                    sharded: bool = False) -> None:
-    import time
     import jax.numpy as jnp
     from ..ops.evict import build_preempt_walk, build_preempt_walk_sharded
 
@@ -892,36 +891,37 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool,
         fn = build_preempt_walk(stack.kinds, stack.sizes, inter_job,
                                 allow_cheap)
     key = "p1" if inter_job else "p2"
-    t0 = time.perf_counter()
-    inputs = jax.device_put((
-        fidle0, nw, stack.padded_cand_mask(),
-        stack.device_masks(), preq, pjob_arr, pjg, first_np,
-        run_id, run_end, job_end,
-        needed, jalloc0, total))                            # one upload
-    (fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, pjg_d, first_d,
-     rid_d, rend_d, jend_d, needed_d, jalloc_d, total_d) = inputs
-    LAST_STATS[key + "_upload_s"] = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    task_node, owner_nw, job_done, iters = fn(
-        fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, pjg_d, first_d,
-        rid_d, rend_d, jend_d, score_arr, needed_d, jalloc_d, total_d)
-    N, W = tensors.vslot.shape            # UNPADDED dims for the replay
-    Np = fidle0.shape[0]                  # includes any mesh padding
-    P = len(ptasks)
-    packed = np.asarray(jnp.concatenate([
-        task_node, owner_nw.reshape(-1),
-        job_done.astype(jnp.int32), iters[None]]))          # one fetch
-    LAST_STATS[key + "_solve_s"] = time.perf_counter() - t0
+    from ..obs import trace as obs_trace
+    with obs_trace.span("upload", phase=key) as sp:
+        inputs = jax.device_put((
+            fidle0, nw, stack.padded_cand_mask(),
+            stack.device_masks(), preq, pjob_arr, pjg, first_np,
+            run_id, run_end, job_end,
+            needed, jalloc0, total))                        # one upload
+        (fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, pjg_d, first_d,
+         rid_d, rend_d, jend_d, needed_d, jalloc_d, total_d) = inputs
+    LAST_STATS[key + "_upload_s"] = sp.dur_s
+    with obs_trace.span("solve", phase=key) as sp:
+        task_node, owner_nw, job_done, iters = fn(
+            fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, pjg_d, first_d,
+            rid_d, rend_d, jend_d, score_arr, needed_d, jalloc_d, total_d)
+        N, W = tensors.vslot.shape        # UNPADDED dims for the replay
+        Np = fidle0.shape[0]              # includes any mesh padding
+        P = len(ptasks)
+        packed = np.asarray(jnp.concatenate([
+            task_node, owner_nw.reshape(-1),
+            job_done.astype(jnp.int32), iters[None]]))      # one fetch
+    LAST_STATS[key + "_solve_s"] = sp.dur_s
     task_node = packed[:P]
     owner_nw = packed[P:P + Np * W].reshape(Np, W)[:N]
     # per-group verdicts -> per kept job via its alloc-group index
     job_done = packed[P + Np * W:-1].astype(bool)[pjg_job]
     LAST_STATS[key + "_iters"] = int(packed[-1])
 
-    t0 = time.perf_counter()
-    _replay_preempt(ssn, ptasks, pjob_ix, kept_jobs, tensors,
-                    task_node, owner_nw, job_done, inter_job, stack)
-    LAST_STATS[key + "_replay_s"] = time.perf_counter() - t0
+    with obs_trace.span("replay", phase=key) as sp:
+        _replay_preempt(ssn, ptasks, pjob_ix, kept_jobs, tensors,
+                        task_node, owner_nw, job_done, inter_job, stack)
+    LAST_STATS[key + "_replay_s"] = sp.dur_s
 
 
 def _fast_evict_ok(ssn, stack: "_TierStack") -> bool:
@@ -1080,6 +1080,7 @@ def _replay_preempt_fast(ssn, ptasks, pjob_ix, kept_jobs, tensors,
     for uid, r in dealloc_agg.items():
         ssn._fire_deallocate(_AggTask(uid, r))
     for v in cache_evicts:
+        ssn._audit_event("evict", v, "preempt")
         ssn.cache.evict(v, "preempt")
 
 

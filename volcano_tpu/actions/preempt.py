@@ -10,6 +10,7 @@ from typing import Callable, List, Optional
 
 from .. import metrics
 from ..api import PodGroupPhase, Resource, TaskInfo, TaskStatus
+from ..obs import trace as obs_trace
 from ..utils import PriorityQueue
 from ..utils.scheduler_helper import (predicate_nodes, prioritize_nodes,
                                       select_best_node)
@@ -82,6 +83,20 @@ class PreemptAction(Action):
                 preemptor_tasks[job.uid] = pq
 
         # Preemption between jobs within a queue (preempt.go:83-144).
+        with obs_trace.span("preempt_inter_job"):
+            self._inter_job_pass(ssn, queues, preemptors_map,
+                                 preemptor_tasks)
+
+        # Preemption between tasks within one job — ONE pass after the
+        # per-queue loop (preempt.go:146-183 sits outside it).
+        with obs_trace.span("preempt_intra_job"):
+            self._intra_job_pass(ssn, under_request, preemptor_tasks)
+
+        with obs_trace.span("victim_tasks"):
+            self._victim_tasks(ssn)
+
+    def _inter_job_pass(self, ssn, queues, preemptors_map,
+                        preemptor_tasks) -> None:
         for queue in queues.values():
             while True:
                 preemptors = preemptors_map.get(queue.uid)
@@ -120,8 +135,7 @@ class PreemptAction(Action):
                 if assigned:
                     preemptors.push(preemptor_job)
 
-        # Preemption between tasks within one job — ONE pass after the
-        # per-queue loop (preempt.go:146-183 sits outside it).
+    def _intra_job_pass(self, ssn, under_request, preemptor_tasks) -> None:
         for job in under_request:
             pq = PriorityQueue(ssn.task_order_fn)
             for task in job.task_status_index.get(TaskStatus.PENDING,
@@ -139,8 +153,6 @@ class PreemptAction(Action):
                 stmt.commit()
                 if not assigned:
                     break
-
-        self._victim_tasks(ssn)
 
     def _preempt(self, ssn, stmt, preemptor: TaskInfo,
                  task_filter: Callable[[TaskInfo], bool]) -> bool:

@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..api import PodGroupPhase, Resource, TaskStatus
+from ..obs import trace as obs_trace
 from ..utils import PriorityQueue
 from .base import Action
 
@@ -29,7 +30,8 @@ class ReclaimAction(Action):
         if engine == "tpu":
             from .evict_tpu import execute_reclaim_tpu
             return execute_reclaim_tpu(ssn)
-        return self._execute_callbacks(ssn)
+        with obs_trace.span("reclaim_rotation", engine=engine):
+            return self._execute_callbacks(ssn)
 
     def _execute_callbacks(self, ssn, screener=None) -> None:
         """The reference rotation verbatim. ``screener`` (optional) is a

@@ -350,6 +350,11 @@ class SchedulerCache:
         immutable fields were already shared per the Resource contract).
         Falls back to the historical full deep-clone when
         VOLCANO_TPU_INCREMENTAL_SNAPSHOT=0 or after mark_all_dirty()."""
+        from ..obs import trace as obs_trace
+        with obs_trace.span("snapshot_clone"):
+            return self._snapshot_impl()
+
+    def _snapshot_impl(self) -> ClusterInfo:
         t0 = time.perf_counter()
         with self._lock:
             incremental = incremental_snapshot_enabled()

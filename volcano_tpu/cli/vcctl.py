@@ -170,6 +170,21 @@ def build_parser() -> argparse.ArgumentParser:
     cache.add_parser("dead-letter",
                      description="List the dead-lettered side effects")
 
+    trace = sub.add_parser(
+        "trace", description="Flight-recorder verbs "
+                             "(docs/observability.md); in-process like the "
+                             "cache verbs — they read the running "
+                             "scheduler's obs.TRACE/obs.AUDIT").add_subparsers(
+        dest="verb")
+    td = trace.add_parser(
+        "dump", description="Write the recorded cycle ring as Chrome "
+                            "trace-event JSON (perfetto-loadable)")
+    td.add_argument("--out", help="file to write (default: stdout)")
+    tw = trace.add_parser(
+        "why", description="The last audited decision for a job: "
+                           "admitted/denied/pipelined/preempted + reason")
+    tw.add_argument("--job", required=True)
+
     sub.add_parser("version")
     return parser
 
@@ -189,6 +204,30 @@ def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
     if args.group == "version":
         out(f"vcctl version {__version__}")
         return 0
+    if args.group == "trace":
+        # flight-recorder verbs (docs/observability.md): read the
+        # process-local recorder — in-process callers share the running
+        # scheduler's obs globals, same deployment model as the cache verbs
+        from ..obs import AUDIT, TRACE
+        if args.verb == "dump":
+            if args.out:
+                TRACE.dump(args.out)
+                out(f"wrote {TRACE.cycles_recorded()} recorded cycle(s) "
+                    f"to {args.out}")
+            else:
+                out(TRACE.dump())
+            return 0
+        if args.verb == "why":
+            rec = AUDIT.why(args.job)
+            if rec is None:
+                out(f"no decision recorded for job {args.job!r} in the "
+                    f"last {AUDIT.cycles_retained()} retained cycle(s)")
+                return 1
+            import json
+            out(json.dumps(rec, sort_keys=True))
+            return 0
+        build_parser().print_help()
+        return 1
     if args.group == "cache":
         # operator verbs against the scheduler cache (dead-letter ops,
         # docs/robustness.md) — in-process callers pass the live

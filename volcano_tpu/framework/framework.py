@@ -5,11 +5,11 @@ from __future__ import annotations
 
 import gc
 import threading
-import time
 import weakref
 from typing import List
 
 from .. import metrics
+from ..obs import trace as obs_trace
 from .conf import Configuration, Tier
 from .registry import get_plugin_builder
 from .session import Session
@@ -97,7 +97,8 @@ def open_session(cache, tiers: List[Tier],
     # automatic GC live)
     window = _gc_suspend()
     try:
-        ssn = Session(cache, tiers, list(configurations))
+        with obs_trace.span("snapshot"):
+            ssn = Session(cache, tiers, list(configurations))
         for tier in tiers:
             for opt in tier.plugins:
                 builder = get_plugin_builder(opt.name)
@@ -105,10 +106,13 @@ def open_session(cache, tiers: List[Tier],
                     continue
                 plugin = builder(opt.arguments)
                 ssn.plugins[plugin.name()] = plugin
-                start = time.perf_counter()
-                plugin.on_session_open(ssn)
-                metrics.update_plugin_duration(plugin.name(), "OnSessionOpen",
-                                               time.perf_counter() - start)
+                # the span both records the plugin callback in the cycle
+                # trace and feeds the plugin latency histogram — one timer
+                with obs_trace.span("plugin:" + plugin.name(),
+                                    event="OnSessionOpen") as sp:
+                    plugin.on_session_open(ssn)
+                metrics.update_plugin_duration(plugin.name(),
+                                               "OnSessionOpen", sp.dur_s)
     except BaseException:
         _gc_resume(window)
         raise
@@ -123,13 +127,15 @@ def open_session(cache, tiers: List[Tier],
 def close_session(ssn: Session) -> None:
     try:
         for plugin in ssn.plugins.values():
-            start = time.perf_counter()
-            plugin.on_session_close(ssn)
+            with obs_trace.span("plugin:" + plugin.name(),
+                                event="OnSessionClose") as sp:
+                plugin.on_session_close(ssn)
             metrics.update_plugin_duration(plugin.name(), "OnSessionClose",
-                                           time.perf_counter() - start)
+                                           sp.dur_s)
         # writeback of job/podgroup status (job_updater.go:95-108)
         from .job_updater import update_all
-        update_all(ssn)
+        with obs_trace.span("job_updater"):
+            update_all(ssn)
     finally:
         # idempotent per window: a double close (or the leak finalizer
         # firing later) cannot steal another live session's suspension.

@@ -20,6 +20,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..api import (ClusterInfo, JobInfo, NodeInfo, QueueInfo, TaskInfo,
                    TaskStatus)
+from ..obs.audit import AUDIT
 from .conf import Configuration, Tier
 
 # Vote values (plugins/util/util.go Permit/Abstain/Reject).
@@ -115,6 +116,10 @@ class Session:
         # proportion publishes its per-queue deserved vectors here so the
         # device reclaim engine can replay its tier in-kernel
         self.queue_deserved: Dict[str, "Resource"] = {}
+        # decision-audit feed (obs.audit): (kind, task_uid, job_uid, extra)
+        # tuples appended by dispatch/evict/statement commits, harvested by
+        # the scheduler shell after close_session
+        self.audit_events: list = []
 
     # -- registration helpers (AddXxxFn of session_plugins.go) --------------
 
@@ -387,8 +392,16 @@ class Session:
         if self.job_ready(job):
             self.dispatch(task)
 
+    def _audit_event(self, kind: str, task: TaskInfo,
+                     extra: str = "") -> None:
+        """Feed the decision audit (obs.audit) — a no-op unless the audit
+        ring is enabled."""
+        if AUDIT.enabled:
+            self.audit_events.append((kind, task.uid, task.job, extra))
+
     def dispatch(self, task: TaskInfo) -> None:
         self.jobs[task.job].update_task_status(task, TaskStatus.BINDING)
+        self._audit_event("bind", task, task.node_name)
         self.cache.bind(task)
 
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
@@ -399,6 +412,7 @@ class Session:
         node = self.nodes[reclaimee.node_name]
         node.update_task(job.tasks[reclaimee.uid])
         self._fire_deallocate(reclaimee)
+        self._audit_event("evict", reclaimee, reason)
         self.cache.evict(reclaimee, reason)
 
     def bind_pod_group(self, job: JobInfo, cluster: str) -> None:

@@ -8,6 +8,7 @@ Falls back to an in-process recorder if prometheus_client is unavailable.
 from __future__ import annotations
 
 import collections
+import os
 import threading
 from typing import Dict, Optional, Tuple
 
@@ -19,9 +20,39 @@ except Exception:                                            # pragma: no cover
 
 _SUBSYSTEM = "volcano"
 
+# Each in-process duration series is RING-BOUNDED (a long-running scheduler
+# must not grow a list forever at one observation per cycle): the deque
+# keeps the newest ``cap`` observations while ``count``/``total`` keep the
+# monotonic all-time view the mark/since API and the Prometheus-fallback
+# histogram exposition need. VOLCANO_TPU_METRICS_RING overrides the cap.
+DEFAULT_DURATION_CAP = 4096
+
+
+def _duration_cap() -> int:
+    try:
+        return max(1, int(os.environ.get("VOLCANO_TPU_METRICS_RING",
+                                         DEFAULT_DURATION_CAP)))
+    except ValueError:
+        return DEFAULT_DURATION_CAP
+
+
+class _Series:
+    __slots__ = ("data", "count", "total")
+
+    def __init__(self):
+        self.data = collections.deque(maxlen=_duration_cap())
+        self.count = 0              # all-time observations (never truncated)
+        self.total = 0.0            # all-time sum, for _count/_sum exposition
+
+    def observe(self, v: float) -> None:
+        self.data.append(v)
+        self.count += 1
+        self.total += v
+
+
 _lock = threading.Lock()
 # local mirror (always kept, powers tests and the CLI without scraping)
-_durations: Dict[Tuple[str, ...], list] = collections.defaultdict(list)
+_durations: Dict[Tuple[str, ...], _Series] = collections.defaultdict(_Series)
 _gauges: Dict[Tuple[str, ...], float] = {}
 _counters: Dict[Tuple[str, ...], float] = collections.defaultdict(float)
 
@@ -110,7 +141,7 @@ if _HAVE_PROM:
 
 def update_e2e_duration(seconds: float) -> None:
     with _lock:
-        _durations[("e2e",)].append(seconds * 1e3)
+        _durations[("e2e",)].observe(seconds * 1e3)
     if _HAVE_PROM:
         _e2e.observe(seconds * 1e3)
 
@@ -271,25 +302,147 @@ def register_dead_letter(op: str) -> None:
         _dead_letter.labels(op=op).inc()
 
 
+# In-process mirror key -> Prometheus family for the no-prometheus_client
+# /metrics fallback: first tuple element maps to (family name, label name,
+# type). Keys absent here expose as volcano_<key0> gauges with a generic
+# "key" label, so new series never silently disappear from scrapes.
+_EXPO_GAUGES = {
+    "scheduler_healthy": (f"{_SUBSYSTEM}_scheduler_healthy", None),
+    "preemption_victims": (f"{_SUBSYSTEM}_pod_preemption_victims", None),
+    "unschedule_tasks": (f"{_SUBSYSTEM}_unschedule_task_count", "job_id"),
+    "queue_allocated": (f"{_SUBSYSTEM}_queue_allocated_milli_cpu",
+                        "queue_name"),
+    "queue_share": (f"{_SUBSYSTEM}_queue_share", "queue_name"),
+    "snapshot_dirty_nodes": (f"{_SUBSYSTEM}_snapshot_dirty_nodes", None),
+    "snapshot_dirty_ratio": (f"{_SUBSYSTEM}_snapshot_dirty_ratio", None),
+    "resync_dead_letter_size": (f"{_SUBSYSTEM}_resync_dead_letter_size",
+                                None),
+    "device_healthy": (f"{_SUBSYSTEM}_device_healthy", None),
+}
+_EXPO_COUNTERS = {
+    "attempts": (f"{_SUBSYSTEM}_schedule_attempts_total", "result"),
+    "preemption_attempts": (f"{_SUBSYSTEM}_total_preemption_attempts",
+                            None),
+    "unschedule_jobs": (f"{_SUBSYSTEM}_unschedule_job_count", None),
+    "action_failures": (f"{_SUBSYSTEM}_action_failures_total", "action"),
+    "solver_fallback": (f"{_SUBSYSTEM}_solver_fallback_total", "action"),
+    "resync_dead_letter": (f"{_SUBSYSTEM}_resync_dead_letter_total", "op"),
+    "snapshot_full_rebuilds": (
+        f"{_SUBSYSTEM}_snapshot_full_rebuilds_total", "layer"),
+    "state_drift": (f"{_SUBSYSTEM}_state_drift_total", "layer"),
+    "journal_replayed": (f"{_SUBSYSTEM}_journal_replayed_total", "result"),
+    "device_faults": (f"{_SUBSYSTEM}_device_faults_total", "kind"),
+    "device_degraded_cycles": (
+        f"{_SUBSYSTEM}_device_degraded_cycles_total", None),
+}
+# duration-series key -> (family, label name, unit suffix already in name)
+_EXPO_DURATIONS = {
+    "e2e": (f"{_SUBSYSTEM}_e2e_scheduling_latency_milliseconds", None),
+    "task": (f"{_SUBSYSTEM}_task_scheduling_latency_milliseconds", None),
+    "action": (f"{_SUBSYSTEM}_action_scheduling_latency_microseconds",
+               "action"),
+    "plugin": (f"{_SUBSYSTEM}_plugin_scheduling_latency_microseconds",
+               "plugin"),
+}
+
+
+def _expo_escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def _expo_name(raw: str) -> str:
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in str(raw))
+    return out if not out[:1].isdigit() else "_" + out
+
+
+def fallback_exposition() -> bytes:
+    """Valid Prometheus text exposition (version 0.0.4) rendered from the
+    in-process mirror — what /metrics serves when prometheus_client is
+    not installed. Scrapers and the prometheus text parser read it like
+    the real thing: gauges and counters sample-per-label, duration series
+    as summary ``_count``/``_sum`` pairs (all-time, truncation-immune)."""
+    families: Dict[str, list] = {}
+
+    def add(name: str, mtype: str, label: Optional[str],
+            labelv: Optional[str], value: float,
+            suffix: str = "") -> None:
+        fam = families.setdefault(name, [mtype])
+        if label is not None and labelv is not None:
+            fam.append(f'{name}{suffix}{{{label}="{_expo_escape(labelv)}"}}'
+                       f" {float(value)}")
+        else:
+            fam.append(f"{name}{suffix} {float(value)}")
+
+    with _lock:
+        for key, value in sorted(_gauges.items(), key=str):
+            spec = _EXPO_GAUGES.get(key[0])
+            if spec is None:
+                name = f"{_SUBSYSTEM}_{_expo_name(key[0])}"
+                label, labelv = ("key", ":".join(key[1:])) \
+                    if len(key) > 1 else (None, None)
+            else:
+                name, label = spec
+                labelv = key[1] if label is not None and len(key) > 1 \
+                    else None
+            add(name, "gauge", label, labelv, value)
+        for key, value in sorted(_counters.items(), key=str):
+            spec = _EXPO_COUNTERS.get(key[0])
+            if spec is None:
+                name = f"{_SUBSYSTEM}_{_expo_name(key[0])}_total"
+                label, labelv = ("key", ":".join(key[1:])) \
+                    if len(key) > 1 else (None, None)
+            else:
+                name, label = spec
+                labelv = key[1] if label is not None and len(key) > 1 \
+                    else None
+            add(name, "counter", label, labelv, value)
+        for key, series in sorted(_durations.items(), key=str):
+            spec = _EXPO_DURATIONS.get(key[0])
+            if spec is None:
+                name = f"{_SUBSYSTEM}_{_expo_name(key[0])}_duration"
+                label = "key" if len(key) > 1 else None
+            else:
+                name, label = spec
+            labelv = ":".join(key[1:]) if label is not None and len(key) > 1 \
+                else None
+            add(name, "summary", label, labelv, series.count,
+                suffix="_count")
+            add(name, "summary", label, labelv, series.total, suffix="_sum")
+
+    lines = []
+    for name, fam in families.items():
+        lines.append(f"# HELP {name} volcano_tpu in-process mirror")
+        lines.append(f"# TYPE {name} {fam[0]}")
+        lines.extend(fam[1:])
+    return ("\n".join(lines) + "\n").encode()
+
+
 def start_metrics_server(port: int = 8080, host: str = ""):
-    """Serve /metrics (Prometheus exposition) and /healthz — the
-    --listen-address endpoint of cmd/scheduler/app (options.go:32,94).
+    """Serve /metrics (Prometheus exposition), /healthz, and the flight
+    recorder's /debug endpoints — the --listen-address endpoint of
+    cmd/scheduler/app (options.go:32,94).
+
     /healthz answers 200 "ok" while the shell is healthy and 503
     "degraded (N consecutive failed cycles)" once the crash-loop guard
     trips, so a liveness probe can distinguish slow from crash-looping.
-    Returns the http.server instance (daemon thread)."""
+
+    /debug/traces serves the recorder's Chrome trace-event JSON ring
+    (perfetto-loadable); /debug/why?job=NAME serves the last audit
+    verdict for a gang (docs/observability.md). Returns the http.server
+    instance (daemon thread)."""
     import http.server
     import threading
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
+            import json
             status = 200
             if self.path.startswith("/healthz"):
                 state, fails = health()
                 if state != HEALTHY:
                     status = 503
                 if "detail" in self.path:
-                    import json
                     body = json.dumps(health_detail(),
                                       sort_keys=True).encode()
                     ctype = "application/json"
@@ -307,11 +460,33 @@ def start_metrics_server(port: int = 8080, host: str = ""):
                     body = generate_latest()
                     ctype = CONTENT_TYPE_LATEST
                 else:
-                    with _lock:
-                        lines = [f"# {k}: {v}" for k, v in _gauges.items()]
-                        lines += [f"# {k}: {v}" for k, v in _counters.items()]
-                    body = "\n".join(lines).encode()
-                    ctype = "text/plain"
+                    body = fallback_exposition()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif self.path.startswith("/debug/traces"):
+                from ..obs import TRACE
+                body = TRACE.dump().encode()
+                ctype = "application/json"
+            elif self.path.startswith("/debug/why"):
+                from urllib.parse import parse_qs, urlparse
+                from ..obs import AUDIT
+                ctype = "application/json"
+                q = parse_qs(urlparse(self.path).query)
+                job = (q.get("job") or [None])[0]
+                if not job:
+                    status = 400
+                    body = json.dumps(
+                        {"error": "missing ?job= query parameter"}).encode()
+                else:
+                    rec = AUDIT.why(job)
+                    if rec is None:
+                        status = 404
+                        body = json.dumps(
+                            {"error": f"no decision recorded for job "
+                                      f"{job!r} in the retained window",
+                             "cycles_retained":
+                                 AUDIT.cycles_retained()}).encode()
+                    else:
+                        body = json.dumps(rec, sort_keys=True).encode()
             else:
                 self.send_response(404)
                 self.end_headers()
@@ -357,21 +532,21 @@ _trace_started = False
 
 def update_action_duration(action: str, seconds: float) -> None:
     with _lock:
-        _durations[("action", action)].append(seconds * 1e6)
+        _durations[("action", action)].observe(seconds * 1e6)
     if _HAVE_PROM:
         _action.labels(action=action).observe(seconds * 1e6)
 
 
 def update_plugin_duration(plugin: str, event: str, seconds: float) -> None:
     with _lock:
-        _durations[("plugin", plugin, event)].append(seconds * 1e6)
+        _durations[("plugin", plugin, event)].observe(seconds * 1e6)
     if _HAVE_PROM:
         _plugin.labels(plugin=plugin, OnSession=event).observe(seconds * 1e6)
 
 
 def update_task_schedule_duration(seconds: float) -> None:
     with _lock:
-        _durations[("task",)].append(seconds * 1e3)
+        _durations[("task",)].observe(seconds * 1e3)
     if _HAVE_PROM:
         _task_lat.observe(seconds * 1e3)
 
@@ -433,8 +608,10 @@ def serve(port: int = 8080) -> None:
 
 
 def local_durations() -> Dict[Tuple[str, ...], list]:
+    """The retained window of every duration series (ring-bounded: at most
+    the newest VOLCANO_TPU_METRICS_RING observations each)."""
     with _lock:
-        return {k: list(v) for k, v in _durations.items()}
+        return {k: list(v.data) for k, v in _durations.items()}
 
 
 def local_counters() -> Dict[Tuple[str, ...], float]:
@@ -443,22 +620,33 @@ def local_counters() -> Dict[Tuple[str, ...], float]:
 
 
 def durations_mark() -> Dict[Tuple[str, ...], int]:
-    """Snapshot the current length of every duration series. Pair with
-    durations_since to read only the observations recorded after the mark
-    — how the simulator (volcano_tpu/sim) and bench.py attribute per-action
-    latency to one run without resetting the global recorder under other
-    consumers."""
+    """Snapshot the ALL-TIME observation count of every duration series.
+    Pair with durations_since to read only the observations recorded after
+    the mark — how the simulator (volcano_tpu/sim) and bench.py attribute
+    per-action latency to one run without resetting the global recorder
+    under other consumers. Marks are counts, not list indices, so ring
+    truncation between mark and read cannot misattribute old samples."""
     with _lock:
-        return {k: len(v) for k, v in _durations.items()}
+        return {k: v.count for k, v in _durations.items()}
 
 
 def durations_since(mark: Dict[Tuple[str, ...], int]
                     ) -> Dict[Tuple[str, ...], list]:
     """Every duration series' observations recorded after ``mark``
     (series born since the mark are returned whole). Units are as stored:
-    ms for ("e2e",)/("task",), us for ("action", name)/("plugin", ...)."""
+    ms for ("e2e",)/("task",), us for ("action", name)/("plugin", ...).
+    If more observations arrived since the mark than the ring retains,
+    the surviving (newest) ones are returned — never pre-mark samples."""
     with _lock:
-        return {k: list(v[mark.get(k, 0):]) for k, v in _durations.items()}
+        out: Dict[Tuple[str, ...], list] = {}
+        for k, v in _durations.items():
+            new = v.count - mark.get(k, 0)
+            if new <= 0:
+                out[k] = []
+            else:
+                data = list(v.data)
+                out[k] = data[-new:] if new < len(data) else data
+        return out
 
 
 def reset_local() -> None:

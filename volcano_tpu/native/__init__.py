@@ -169,18 +169,39 @@ class NativeObjectStore:
 
     # -- native helpers -----------------------------------------------------
 
+    # two-phase sized reads retry when a concurrent writer outgrows the
+    # buffer between the sizing call and the copy; bounded so a writer
+    # hot-looping vs_put on one key cannot spin the reader forever
+    _SIZED_READ_RETRIES = 64
+
     def _read(self, kind: str, key: str):
+        # two-phase sizing is racy by construction: a concurrent vs_put can
+        # replace the value with a LONGER one between the sizing call and
+        # the copy, and vs_get copies min(buflen, cur_len) — a truncated
+        # pickle. vs_get returns the CURRENT length on every call; a copy
+        # whose returned length fits the buffer is COMPLETE (a replacement
+        # SHORTER value is copied whole), only a grown value needs a retry.
         n = self._lib.vs_get(self._h, kind.encode(), key.encode(), None, 0)
-        if n < 0:
-            return None
-        buf = ctypes.create_string_buffer(int(n))
-        self._lib.vs_get(self._h, kind.encode(), key.encode(), buf, n)
-        obj = pickle.loads(buf.raw[:n])
-        # the native side owns resourceVersions; the pickled rv is whatever
-        # the writer saw pre-put, so patch from the authoritative index
-        obj.metadata.resource_version = self._lib.vs_get_rv(
-            self._h, kind.encode(), key.encode())
-        return obj
+        for _ in range(self._SIZED_READ_RETRIES):
+            if n < 0:
+                return None                  # deleted
+            buf = ctypes.create_string_buffer(int(n) if n > 0 else 1)
+            n2 = self._lib.vs_get(self._h, kind.encode(), key.encode(),
+                                  buf, n)
+            if n2 < 0:
+                return None                  # deleted mid-read
+            if 0 < n2 <= n:
+                obj = pickle.loads(buf.raw[:n2])
+                # the native side owns resourceVersions; the pickled rv is
+                # whatever the writer saw pre-put, so patch from the
+                # authoritative index
+                obj.metadata.resource_version = self._lib.vs_get_rv(
+                    self._h, kind.encode(), key.encode())
+                return obj
+            n = n2          # grew mid-read — resize and retry
+        raise RuntimeError(
+            f"vs_get({kind}/{key}): value replaced with a longer one on "
+            f"{self._SIZED_READ_RETRIES} consecutive sized reads")
 
     def _write(self, kind: str, obj, create_only: bool) -> int:
         key = obj.metadata.key()
@@ -287,12 +308,22 @@ class NativeObjectStore:
         return self._read(kind, f"{namespace}/{name}")
 
     def _keys(self, kind: str) -> List[str]:
+        # same two-phase-sizing race as _read: a key added between the
+        # sizing call and the copy truncates the newline-joined payload
+        # mid-key — a copy that fits the buffer is complete (fewer keys
+        # than sized for still arrive whole), only growth retries
         n = self._lib.vs_list_keys(self._h, kind.encode(), None, 0)
-        if n <= 0:
-            return []
-        buf = ctypes.create_string_buffer(int(n))
-        self._lib.vs_list_keys(self._h, kind.encode(), buf, n)
-        return buf.raw[:n].decode().splitlines()
+        for _ in range(self._SIZED_READ_RETRIES):
+            if n <= 0:
+                return []
+            buf = ctypes.create_string_buffer(int(n))
+            n2 = self._lib.vs_list_keys(self._h, kind.encode(), buf, n)
+            if n2 <= n:
+                return buf.raw[:max(int(n2), 0)].decode().splitlines()
+            n = n2          # keys added mid-read — resize and retry
+        raise RuntimeError(
+            f"vs_list_keys({kind}): key set kept growing past the sized "
+            f"buffer on {self._SIZED_READ_RETRIES} consecutive reads")
 
     def list(self, kind: str, namespace: Optional[str] = None) -> List:
         objs = [self._read(kind, k) for k in self._keys(kind)]

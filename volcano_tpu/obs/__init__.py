@@ -1,0 +1,25 @@
+"""Cycle flight recorder (docs/observability.md).
+
+Three coupled layers:
+
+- ``trace``  — low-overhead hierarchical span tracing per scheduling
+  cycle (``span(name, **attrs)``), wired through the scheduler shell,
+  session open/close, every action and the solver sub-stages; spans also
+  feed the existing metrics histograms so timing is recorded once.
+- ``audit``  — per-cycle structured records of every admission / denial /
+  preemption, kept in a bounded ring buffer of the last N cycles with a
+  ``why(job)`` query API.
+- ``export`` — Chrome trace-event JSON (perfetto-loadable) dumps, served
+  by ``/debug/traces`` + ``/debug/why`` on the metrics HTTP server,
+  ``vcctl trace dump|why``, and ``python -m volcano_tpu.sim --trace-out``.
+"""
+
+from .audit import AUDIT, AuditLog
+from .export import chrome_trace, span_totals_ms, validate_chrome_trace
+from .trace import TRACE, TraceRecorder, span
+
+__all__ = [
+    "AUDIT", "AuditLog",
+    "TRACE", "TraceRecorder", "span",
+    "chrome_trace", "span_totals_ms", "validate_chrome_trace",
+]

@@ -1,0 +1,105 @@
+"""Chrome trace-event export + validation for the flight recorder.
+
+``chrome_trace(events)`` wraps a recorder's flat ``B``/``E`` event list
+into the Chrome trace-event JSON object format — loadable directly in
+Perfetto (ui.perfetto.dev) or chrome://tracing. ``validate_chrome_trace``
+is the schema check CI runs on every ``--trace-out`` artifact: required
+fields, non-decreasing timestamps, and properly nested, fully matched
+``B``/``E`` pairs per thread.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+_REQUIRED = ("ph", "name", "pid", "tid", "ts")
+
+
+def chrome_trace(events: List[dict], enabled: bool = True,
+                 logical: bool = False) -> dict:
+    """The JSON object format: {"traceEvents": [...], ...metadata}."""
+    return {
+        "traceEvents": list(events),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "volcano_tpu.obs",
+            "enabled": bool(enabled),
+            "clock": "logical" if logical else "perf_counter_us",
+        },
+    }
+
+
+def validate_chrome_trace(obj: dict) -> int:
+    """Raise ValueError on the first schema violation; return the number
+    of complete spans otherwise. Checks: traceEvents is a list, every
+    event carries the required fields with sane types, ``ts`` is
+    non-decreasing in emission order, and per (pid, tid) the ``B``/``E``
+    events nest and match exactly (every B closed by an E of the same
+    name, no stray E)."""
+    if not isinstance(obj, dict) or not isinstance(
+            obj.get("traceEvents"), list):
+        raise ValueError("not a Chrome trace object: no traceEvents list")
+    events = obj["traceEvents"]
+    last_ts = None
+    stacks: Dict[tuple, List[dict]] = {}
+    spans = 0
+    for i, ev in enumerate(events):
+        for field in _REQUIRED:
+            if field not in ev:
+                raise ValueError(f"event {i} missing field {field!r}: {ev}")
+        if ev["ph"] not in ("B", "E"):
+            raise ValueError(f"event {i} has unsupported ph {ev['ph']!r}")
+        if not isinstance(ev["ts"], (int, float)):
+            raise ValueError(f"event {i} ts is not numeric: {ev['ts']!r}")
+        if not isinstance(ev["name"], str) or not ev["name"]:
+            raise ValueError(f"event {i} has no usable name: {ev}")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            raise ValueError(f"event {i} args is not an object")
+        if last_ts is not None and ev["ts"] < last_ts:
+            raise ValueError(
+                f"event {i} ts went backwards: {ev['ts']} < {last_ts}")
+        last_ts = ev["ts"]
+        key = (ev["pid"], ev["tid"])
+        stack = stacks.setdefault(key, [])
+        if ev["ph"] == "B":
+            stack.append(ev)
+        else:
+            if not stack:
+                raise ValueError(
+                    f"event {i}: E {ev['name']!r} with no open B on "
+                    f"pid/tid {key}")
+            top = stack.pop()
+            if top["name"] != ev["name"]:
+                raise ValueError(
+                    f"event {i}: E {ev['name']!r} closes B "
+                    f"{top['name']!r} (improper nesting) on pid/tid {key}")
+            spans += 1
+    for key, stack in stacks.items():
+        if stack:
+            raise ValueError(
+                f"unclosed B events on pid/tid {key}: "
+                f"{[ev['name'] for ev in stack]}")
+    return spans
+
+
+def span_totals_ms(events: List[dict],
+                   names: Optional[List[str]] = None) -> Dict[str, float]:
+    """Total wall-clock per span name (summed across all matched B/E
+    pairs), in ms — the per-stage breakdown bench.py records into the
+    BENCH json. Meaningless for logical-clock traces (durations are event
+    counts there)."""
+    stacks: Dict[tuple, List[dict]] = {}
+    totals: Dict[str, float] = {}
+    for ev in events:
+        key = (ev.get("pid"), ev.get("tid"))
+        stack = stacks.setdefault(key, [])
+        if ev.get("ph") == "B":
+            stack.append(ev)
+        elif ev.get("ph") == "E" and stack:
+            top = stack.pop()
+            if top.get("name") == ev.get("name"):
+                name = top["name"]
+                if names is None or name in names:
+                    totals[name] = totals.get(name, 0.0) \
+                        + (ev["ts"] - top["ts"]) / 1e3
+    return {k: round(v, 3) for k, v in sorted(totals.items())}

@@ -1,0 +1,149 @@
+"""CI validators for the observability surfaces (ci/check.sh obs step).
+
+Usage::
+
+    python -m volcano_tpu.obs.validate trace.json        # schema-check a
+                                                         # --trace-out file
+    python -m volcano_tpu.obs.validate --metrics-scrape  # serve+scrape
+                                                         # /metrics (prom
+                                                         # AND fallback)
+
+The trace check enforces the Chrome trace-event contract (required
+fields, monotonic ts, matched/nested B/E pairs) via
+``export.validate_chrome_trace``. The metrics check starts the real
+``start_metrics_server`` twice — once on the prometheus_client path, once
+with the dependency masked — scrapes ``/metrics`` and parses both bodies
+with the prometheus_client text parser, so a fallback-exposition
+regression (the old ``# tuple: value`` comment format scrapers could not
+read) fails CI loudly.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+
+def check_trace(path: str) -> int:
+    from .export import validate_chrome_trace
+    with open(path) as f:
+        obj = json.load(f)
+    spans = validate_chrome_trace(obj)
+    if spans == 0:
+        print(f"{path}: no complete spans recorded", file=sys.stderr)
+        return 1
+    names = {ev["name"] for ev in obj["traceEvents"]}
+    missing = {"cycle", "schedule", "open_session"} - names
+    if missing:
+        print(f"{path}: expected span names missing: {sorted(missing)}",
+              file=sys.stderr)
+        return 1
+    print(f"{path}: OK — {spans} spans, {len(names)} distinct names, "
+          f"{len(obj['traceEvents'])} events")
+    return 0
+
+
+def _scrape(server) -> str:
+    port = server.server_address[1]
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+        body = r.read().decode()
+    server.shutdown()
+    server.server_close()
+    return body
+
+
+import re
+
+# one sample line of the text exposition: name{labels} value — the
+# no-prometheus_client grammar check (labels optional, value a float)
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [0-9eE+.\-]+(?: [0-9.]+)?$')
+
+
+def _parse_sample_count(body: str) -> int:
+    """Parse an exposition body: with prometheus_client, the real text
+    parser; without it, a strict line-grammar check (every non-comment,
+    non-blank line must be a well-formed sample). Returns the sample
+    count; raises ValueError on malformed input."""
+    try:
+        from prometheus_client.parser import text_string_to_metric_families
+    except ImportError:
+        n = 0
+        for line in body.splitlines():
+            if not line.strip() or line.startswith("#"):
+                continue
+            if not _SAMPLE_RE.match(line):
+                raise ValueError(f"malformed exposition line: {line!r}")
+            n += 1
+        return n
+    return sum(len(f.samples)
+               for f in text_string_to_metric_families(body))
+
+
+def check_metrics_scrape() -> int:
+    from .. import metrics
+
+    # seed the local mirror so the fallback has labelled series to emit
+    metrics.set_health(metrics.HEALTHY, 0)
+    metrics.register_action_failure("ci-probe")
+    metrics.update_queue_metrics("ci-q", 1000.0, 2048.0, share=0.5)
+    metrics.update_action_duration("ci-probe", 0.001)
+
+    results = {}
+    bodies = {}
+    for label, have_prom in (("prometheus_client", True), ("fallback", False)):
+        if have_prom and not metrics._HAVE_PROM:
+            print("prometheus_client unavailable; skipping the prom path",
+                  file=sys.stderr)
+            continue
+        saved = metrics._HAVE_PROM
+        metrics._HAVE_PROM = have_prom
+        try:
+            body = _scrape(metrics.start_metrics_server(0, "127.0.0.1"))
+        finally:
+            metrics._HAVE_PROM = saved
+        try:
+            n_samples = _parse_sample_count(body)
+        except ValueError as exc:
+            print(f"{label}: /metrics failed to parse: {exc}",
+                  file=sys.stderr)
+            return 1
+        if not n_samples:
+            print(f"{label}: /metrics parsed to zero samples",
+                  file=sys.stderr)
+            return 1
+        results[label] = n_samples
+        bodies[label] = body
+    # the fallback must carry the exact series the probe seeded — a broken
+    # _EXPO_* mapping that drops labelled families would otherwise still
+    # parse to "some samples" and pass
+    fb = bodies["fallback"]
+    for needle in ('volcano_action_failures_total{action="ci-probe"}',
+                   'volcano_queue_allocated_milli_cpu{queue_name="ci-q"}',
+                   "volcano_action_scheduling_latency_microseconds_count"):
+        if needle not in fb:
+            print(f"fallback: seeded series missing from /metrics: "
+                  f"{needle}", file=sys.stderr)
+            return 1
+    for label, ns in results.items():
+        print(f"{label}: /metrics OK — {ns} samples")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--metrics-scrape":
+        return check_metrics_scrape()
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    rc = 0
+    for path in argv:
+        rc = max(rc, check_trace(path))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

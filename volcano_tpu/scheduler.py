@@ -29,6 +29,8 @@ from . import metrics
 from .framework import (close_session, get_action, open_session,
                         parse_scheduler_conf)
 from .framework.conf import SchedulerConfiguration
+from .obs import audit as obs_audit
+from .obs import trace as obs_trace
 
 log = logging.getLogger(__name__)
 
@@ -137,7 +139,28 @@ class Scheduler:
         clean. A failing action is skipped — the session still closes and
         the remaining pipeline still runs; only a failure OUTSIDE the
         action loop (conf reload, snapshot/open_session, close_session)
-        propagates to the caller, where run()'s guard catches it."""
+        propagates to the caller, where run()'s guard catches it.
+
+        The cycle is bracketed by the flight recorder
+        (docs/observability.md): every run_once is one span tree
+        (cycle → resync / schedule → open_session / action:* /
+        close_session → audit / epilogue) in obs.TRACE's ring, and the
+        per-action/e2e metrics histograms are fed FROM the spans, so
+        timing is recorded once."""
+        rec = obs_trace.TRACE
+        cycle = self._cycles_run
+        began = rec.enabled
+        if began:
+            rec.begin_cycle(cycle)
+        try:
+            with rec.span("cycle", cycle=cycle):
+                return self._run_once_traced(rec, cycle)
+        finally:
+            if began:
+                rec.end_cycle()
+
+    def _run_once_traced(self, rec, cycle: int
+                         ) -> List[Tuple[str, BaseException]]:
         self._maybe_reload_conf()
         # retry failed side effects whose backoff expired (the reference's
         # errTasks worker goroutine, cache.go:777-799). Isolated like an
@@ -145,7 +168,8 @@ class Scheduler:
         errors: List[Tuple[str, BaseException]] = []
         if hasattr(self.cache, "process_resync_tasks"):
             try:
-                self.cache.process_resync_tasks()
+                with rec.span("resync"):
+                    self.cache.process_resync_tasks()
             except Exception as exc:
                 log.exception("resync processing failed")
                 metrics.register_action_failure("resync")
@@ -163,23 +187,33 @@ class Scheduler:
             # only the snapshot/session work
             self._cycle_epilogue()
             return errors
-        start = time.perf_counter()
-        ssn = open_session(self.cache, self.conf.tiers,
-                           self.conf.configurations)
+        sched_sp = rec.span("schedule")
         crashed = False
-        try:
-            for name, action in runnable:
-                action_start = time.perf_counter()
-                try:
-                    if self.action_fault_hook is not None:
-                        self.action_fault_hook(name, ssn)
-                    action.execute(ssn)
-                except Exception as exc:
-                    log.exception("action %s failed; skipping it this cycle",
-                                  name)
-                    metrics.register_action_failure(name)
-                    errors.append((name, exc))
-                    if getattr(exc, "poisons_session", False):
+        with sched_sp:
+            with rec.span("open_session"):
+                ssn = open_session(self.cache, self.conf.tiers,
+                                   self.conf.configurations)
+            try:
+                for name, action in runnable:
+                    action_sp = rec.span("action:" + name, action=name)
+                    poisoned = False
+                    try:
+                        with action_sp:
+                            try:
+                                if self.action_fault_hook is not None:
+                                    self.action_fault_hook(name, ssn)
+                                action.execute(ssn)
+                            except Exception as exc:
+                                log.exception("action %s failed; skipping "
+                                              "it this cycle", name)
+                                metrics.register_action_failure(name)
+                                errors.append((name, exc))
+                                poisoned = getattr(exc, "poisons_session",
+                                                   False)
+                    finally:
+                        metrics.update_action_duration(name,
+                                                       action_sp.dur_s)
+                    if poisoned:
                         # the action mutated session state outside any
                         # undo log (allocate.ReplayFault): later actions
                         # would schedule against phantom aggregates —
@@ -188,22 +222,29 @@ class Scheduler:
                                   "aborting the remaining actions this "
                                   "cycle", name)
                         break
-                finally:
-                    metrics.update_action_duration(
-                        name, time.perf_counter() - action_start)
-        except BaseException as exc:
-            # a non-Exception escaping here is a (simulated or real)
-            # process death — SimKill, KeyboardInterrupt. A SIGKILL'd
-            # process never runs close-time writebacks (plugin
-            # on_session_close, the job updater's PodGroup status
-            # flush), so neither may we: skip close_session and let the
-            # session's leak finalizer resume the GC window instead.
-            crashed = not isinstance(exc, Exception)
-            raise
-        finally:
-            if not crashed:
-                close_session(ssn)
-        metrics.update_e2e_duration(time.perf_counter() - start)
+            except BaseException as exc:
+                # a non-Exception escaping here is a (simulated or real)
+                # process death — SimKill, KeyboardInterrupt. A SIGKILL'd
+                # process never runs close-time writebacks (plugin
+                # on_session_close, the job updater's PodGroup status
+                # flush), so neither may we: skip close_session and let the
+                # session's leak finalizer resume the GC window instead.
+                crashed = not isinstance(exc, Exception)
+                raise
+            finally:
+                if not crashed:
+                    with rec.span("close_session"):
+                        close_session(ssn)
+        metrics.update_e2e_duration(sched_sp.dur_s)
+        # decision audit (docs/observability.md): harvested AFTER
+        # close_session so the gang plugin's job_fit_errors writeback is
+        # the denial reason, outside the e2e-timed window
+        if obs_audit.AUDIT.enabled:
+            try:
+                with rec.span("audit"):
+                    obs_audit.harvest_cycle(ssn, cycle, self.clock.time())
+            except Exception:
+                log.exception("decision-audit harvest failed")
         self._cycle_epilogue()
         return errors
 
@@ -212,13 +253,14 @@ class Scheduler:
         run_once exits: flush the journal's buffered ack tail (intents
         are made durable before their executor runs; this just bounds
         ack-record lag to one cycle) and tick the drift-verify cadence."""
-        journal = getattr(self.cache, "journal", None)
-        if journal is not None:
-            try:
-                journal.flush()
-            except Exception:
-                log.exception("journal flush failed")
-        self._maybe_verify_drift()
+        with obs_trace.TRACE.span("epilogue"):
+            journal = getattr(self.cache, "journal", None)
+            if journal is not None:
+                try:
+                    journal.flush()
+                except Exception:
+                    log.exception("journal flush failed")
+            self._maybe_verify_drift()
 
     def _maybe_verify_drift(self) -> None:
         """Amortized shadow verification (docs/robustness.md): every

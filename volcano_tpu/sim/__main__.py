@@ -47,6 +47,13 @@ def main(argv=None) -> int:
                     help="print ONLY the decision plane as canonical JSON "
                          "(byte-comparable across runs — the CI "
                          "sim-determinism step diffs this)")
+    ap.add_argument("--trace-out",
+                    help="record the flight recorder for the whole run and "
+                         "write the merged Chrome trace-event JSON "
+                         "(perfetto-loadable) to this file; with "
+                         "--deterministic the recorder uses its logical "
+                         "clock, so the artifact is byte-reproducible "
+                         "(docs/observability.md)")
     ap.add_argument("--chaos-rate", type=float, default=0.0,
                     help="seeded bind/evict failure rate (volcano_tpu."
                          "chaos wrappers; 0 = off)")
@@ -105,7 +112,18 @@ def main(argv=None) -> int:
                            kill_seed=kill_seed)
         return runner.run()
 
+    if args.trace_out:
+        from ..obs import TRACE
+        # unbounded ring for the run: --trace-out merges EVERY cycle into
+        # one artifact instead of keeping only the live tail
+        TRACE.configure(max_cycles=0, logical=args.deterministic)
+        TRACE.enable()
     report = run(kill_cycles)
+    if args.trace_out:
+        TRACE.disable()
+        TRACE.dump(args.trace_out)
+        print(f"trace: {TRACE.cycles_recorded()} cycles -> "
+              f"{args.trace_out}", file=sys.stderr)
     text = deterministic_json(report) if args.deterministic \
         else to_json(report)
     print(text)

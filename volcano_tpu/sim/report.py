@@ -99,8 +99,13 @@ def drf_fairness_gap(cache) -> float:
 
 
 def build_report(runner, actions_ms: Dict[tuple, list],
-                 wall_s: float) -> dict:
-    """Assemble the report dict from a finished SimRunner."""
+                 wall_s: float, actions_truncated=()) -> dict:
+    """Assemble the report dict from a finished SimRunner.
+
+    ``actions_truncated`` names duration series whose observations
+    outgrew the bounded in-process metrics ring during the run — their
+    percentiles below cover only the newest retained window, not every
+    cycle."""
     conf = runner.sched.conf
     acts = {}
     for key, vals in actions_ms.items():
@@ -151,6 +156,9 @@ def build_report(runner, actions_ms: Dict[tuple, list],
             "total_s": round(wall_s, 3),
         },
     }
+    if actions_truncated:
+        report["wallclock"]["actions_ms_truncated"] = \
+            list(actions_truncated)
     return report
 
 

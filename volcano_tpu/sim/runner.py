@@ -497,5 +497,14 @@ class SimRunner:
         # virtual clock
         from ..device_health import DEVICE_HEALTH
         DEVICE_HEALTH.reset(time_fn=time.monotonic)
+        # runs longer than the bounded metrics ring lose their oldest
+        # per-action samples — flag the affected series so the report's
+        # percentiles aren't read as whole-run stats
+        since = metrics.durations_since(mark)
+        end = metrics.durations_mark()
+        truncated = sorted(
+            "/".join(k) for k, vals in since.items()
+            if end.get(k, 0) - mark.get(k, 0) > len(vals))
         return report_mod.build_report(
-            self, actions_ms=metrics.durations_since(mark), wall_s=wall_s)
+            self, actions_ms=since, wall_s=wall_s,
+            actions_truncated=truncated)
