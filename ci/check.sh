@@ -7,7 +7,7 @@
 # admission.self_register default may be flipped to true
 # (deploy/chart/volcano-tpu/values.yaml).
 #
-# Usage: ci/check.sh [--shim-only|--python-only]
+# Usage: ci/check.sh [--shim-only|--python-only|--lint-only]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -16,13 +16,36 @@ run_shim=true
 run_sim=true
 run_soak=true
 run_obs=true
+run_lint=true
 case "${1:-}" in
-  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false ;;
-  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false ;;
-  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false ;;
-  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false ;;
-  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false ;;
+  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false ;;
+  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false ;;
+  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false ;;
+  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false ;;
+  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false ;;
+  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false ;;
 esac
+
+if $run_lint; then
+  # lint gate (docs/static-analysis.md): vlint — the contract-aware
+  # static analysis pass — must exit 0 (every finding fixed, suppressed
+  # with a justification, or baselined with one in vlint-baseline.json),
+  # and mypy (pinned config in pyproject.toml [tool.mypy]) must pass
+  # over the state-integrity-critical packages. vlint is stdlib-only and
+  # always runs; mypy is presence-gated like the Go shim — the dev image
+  # has no pip access, real CI installs the [lint] extra.
+  echo "== lint: vlint (contract rules) =="
+  python -m volcano_tpu.analysis volcano_tpu/ \
+    || { echo "lint FAILED: vlint findings above — fix them, or suppress/"\
+"baseline WITH a justification (docs/static-analysis.md)"; exit 1; }
+  if python -c "import mypy" >/dev/null 2>&1; then
+    echo "== lint: mypy (pyproject [tool.mypy] scope) =="
+    python -m mypy --config-file pyproject.toml \
+      || { echo "lint FAILED: mypy"; exit 1; }
+  else
+    echo "== lint: mypy SKIPPED (not installed; pip install -e .[lint]) =="
+  fi
+fi
 
 if $run_python; then
   echo "== tier-1: pytest (not slow) =="

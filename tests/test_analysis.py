@@ -1,0 +1,711 @@
+"""vlint (volcano_tpu/analysis) test suite.
+
+Four layers, per docs/static-analysis.md:
+
+1. per-rule TRIGGER/CLEAN fixture pairs — synthetic sources that fire
+   the rule and minimally-corrected twins that don't;
+2. suppression + baseline semantics (justifications required, stale
+   entries surfaced, invalid suppressions gate);
+3. the JSON reporter schema (a CI contract);
+4. "re-broken historical bug" regressions — the REAL package sources
+   with a historical fix surgically reverted must produce a finding, and
+   the unmutated sources must not. These prove the rules are not
+   vacuous: each one mechanically flags a defect this repo actually
+   shipped (witness leak, evict-retry mirror, unbucketed job axis, the
+   unjournaled funnel, unlocked shared-state writes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from volcano_tpu.analysis import analyze_sources
+from volcano_tpu.analysis.baseline import (Baseline, BaselineError,
+                                           load_baseline)
+from volcano_tpu.analysis.report import (exit_code, json_report,
+                                         split_baselined, text_report)
+from volcano_tpu.analysis.rules import ALL_RULES, rule_by_id
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def real_source(relpath: str) -> str:
+    with open(os.path.join(REPO, relpath), encoding="utf-8") as f:
+        return f.read()
+
+
+def findings_of(sources):
+    findings, invalid, _ = analyze_sources(sources)
+    return findings, invalid
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+def mutate(src: str, old: str, new: str) -> str:
+    """Exact-substring source mutation; loud failure when the anchor
+    drifted (the regression must be re-anchored, not silently skipped)."""
+    assert old in src, f"mutation anchor drifted: {old[:80]!r}"
+    out = src.replace(old, new)
+    assert out != src
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 1. per-rule trigger / clean fixture pairs
+# ---------------------------------------------------------------------------
+
+VT001_TRIGGER = '''
+class SchedulerCache:
+    def sneak_update(self, task):
+        job = self.jobs.get(task.job)
+        job.update_task_status(job.tasks[task.uid], "Releasing")
+'''
+
+VT001_CLEAN = '''
+class SchedulerCache:
+    def sneak_update(self, task):
+        job = self.jobs.get(task.job)
+        self._mark_task_dirty(task)
+        job.update_task_status(job.tasks[task.uid], "Releasing")
+        if task.node_name in self.nodes:
+            self.nodes[task.node_name].update_task(job.tasks[task.uid])
+'''
+
+
+def test_vt001_trigger_and_clean():
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": VT001_TRIGGER})
+    assert "VT001" in rule_ids(f)
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": VT001_CLEAN})
+    assert "VT001" not in rule_ids(f)
+
+
+def test_vt001_one_hop_callee_witness_excuses():
+    src = '''
+class SchedulerCache:
+    def outer(self, task):
+        self.nodes[task.node_name] = task
+        self._note(task)
+
+    def _note(self, task):
+        self._dirty_nodes.add(task.node_name)
+'''
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": src})
+    assert "VT001" not in rule_ids(f)
+
+
+def test_vt001_out_of_scope_module_ignored():
+    f, _ = findings_of({"volcano_tpu/plugins/thing.py": VT001_TRIGGER})
+    assert "VT001" not in rule_ids(f)
+
+
+VT002_TRIGGER = '''
+import time as _time
+
+def decide(job):
+    return _time.time() - job.creation_timestamp
+'''
+
+VT002_CLEAN = '''
+import time
+
+def decide(job, ssn):
+    return ssn.now() - job.creation_timestamp
+
+class Q:
+    def __init__(self, time_fn=time.monotonic):
+        self.time_fn = time_fn     # reference, not a call: the injection
+'''
+
+
+def test_vt002_trigger_and_clean():
+    f, _ = findings_of({"volcano_tpu/plugins/p.py": VT002_TRIGGER})
+    assert rule_ids(f) == ["VT002"]
+    f, _ = findings_of({"volcano_tpu/plugins/p.py": VT002_CLEAN})
+    assert f == []
+
+
+def test_vt002_datetime_and_scope():
+    src = "from datetime import datetime\n\ndef f():\n    return datetime.now()\n"
+    f, _ = findings_of({"volcano_tpu/plugins/p.py": src})
+    assert rule_ids(f) == ["VT002"]
+    # the CLI is not scheduler-path: same code out of scope is clean
+    f, _ = findings_of({"volcano_tpu/cli/p.py": src})
+    assert f == []
+
+
+def test_vt002_wallclock_owner_allowlisted():
+    src = ('import time\n\nclass WallClock:\n'
+           '    def time(self):\n        return time.monotonic()\n')
+    f, _ = findings_of({"volcano_tpu/scheduler.py": src})
+    assert f == []
+    # the same body outside the sanctioned owner is a finding
+    f, _ = findings_of({"volcano_tpu/actions/x.py": src.replace(
+        "WallClock", "NotAClock")})
+    assert rule_ids(f) == ["VT002"]
+
+
+def test_vt002_perf_counter_not_flagged():
+    src = "import time\n\ndef f():\n    return time.perf_counter()\n"
+    f, _ = findings_of({"volcano_tpu/actions/x.py": src})
+    assert f == []
+
+
+VT003_TRIGGER = '''
+import random
+import numpy as np
+
+def pick(xs):
+    if np.random.rand() > 0.5:
+        return random.choice(xs)
+'''
+
+VT003_CLEAN = '''
+import random
+
+def pick(xs, rng):
+    return rng.choice(xs)
+
+def make_rng(seed):
+    return random.Random(seed)
+'''
+
+
+def test_vt003_trigger_and_clean():
+    f, _ = findings_of({"volcano_tpu/sim/w.py": VT003_TRIGGER})
+    assert rule_ids(f) == ["VT003"]
+    assert len(f) == 2          # np.random.rand AND random.choice
+    f, _ = findings_of({"volcano_tpu/sim/w.py": VT003_CLEAN})
+    assert f == []
+
+
+def test_vt003_unseeded_default_rng_flagged_seeded_ok():
+    f, _ = findings_of({"volcano_tpu/sim/w.py":
+                        "import numpy as np\ng = np.random.default_rng()\n"})
+    assert rule_ids(f) == ["VT003"]
+    f, _ = findings_of({"volcano_tpu/sim/w.py":
+                        "import numpy as np\ng = np.random.default_rng(7)\n"})
+    assert f == []
+
+
+VT004_TRIGGER = '''
+def rogue_bind(cache, task):
+    cache.binder.bind(task, task.node_name)
+'''
+
+VT004_CLEAN = '''
+class SchedulerCache:
+    def bind(self, task):
+        seq = self._journal_intent("bind", task, task.node_name)
+        self.binder.bind(task, task.node_name)
+        self._journal_ack(seq, True)
+'''
+
+
+def test_vt004_trigger_and_clean():
+    f, _ = findings_of({"volcano_tpu/actions/a.py": VT004_TRIGGER})
+    assert rule_ids(f) == ["VT004"]
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": VT004_CLEAN})
+    assert "VT004" not in rule_ids(f)
+
+
+def test_vt004_one_hop_caller_journal_excuses():
+    src = '''
+class SchedulerCache:
+    def bind(self, task):
+        seq = self._journal_intent("bind", task, task.node_name)
+        self._do_bind(task)
+        self._journal_ack(seq, True)
+
+    def _do_bind(self, task):
+        self.binder.bind(task, task.node_name)
+'''
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": src})
+    assert "VT004" not in rule_ids(f)
+
+
+def test_vt004_executor_layer_exempt():
+    f, _ = findings_of({"volcano_tpu/chaos.py": VT004_TRIGGER})
+    assert f == []
+
+
+VT005_TRIGGER = '''
+def cycle(action):
+    try:
+        action()
+    except BaseException:
+        return None
+'''
+
+VT005_CLEAN = '''
+def cycle(action):
+    try:
+        action()
+    except BaseException:
+        raise
+    except Exception:
+        return None
+'''
+
+
+def test_vt005_trigger_and_clean():
+    f, _ = findings_of({"volcano_tpu/actions/a.py": VT005_TRIGGER})
+    assert rule_ids(f) == ["VT005"]
+    f, _ = findings_of({"volcano_tpu/actions/a.py": VT005_CLEAN})
+    assert f == []
+
+
+def test_vt005_bare_except_and_suppress():
+    src = ('import contextlib\n\ndef f(g):\n'
+           '    with contextlib.suppress(BaseException):\n        g()\n'
+           '    try:\n        g()\n    except:\n        pass\n')
+    f, _ = findings_of({"volcano_tpu/framework/x.py": src})
+    assert [x.rule for x in f] == ["VT005", "VT005"]
+
+
+def test_vt005_simkill_catch_reserved_for_harness():
+    src = ('from ..chaos import SimKill\n\ndef f(g):\n'
+           '    try:\n        g()\n    except SimKill:\n        pass\n')
+    f, _ = findings_of({"volcano_tpu/actions/a.py": src})
+    assert rule_ids(f) == ["VT005"]
+    f, _ = findings_of({"volcano_tpu/sim/runner.py": src})
+    assert f == []
+
+
+VT006_TRIGGER = '''
+import jax
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def run(xs):
+    return _solver()(xs)
+'''
+
+VT006_CLEAN = '''
+import jax
+
+def _bucket(n):
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def run(xs):
+    n = _bucket(len(xs))
+    return _solver()(xs[:n])
+'''
+
+
+def test_vt006_trigger_and_clean():
+    f, _ = findings_of({"volcano_tpu/ops/o.py": VT006_TRIGGER})
+    assert rule_ids(f) == ["VT006"]
+    f, _ = findings_of({"volcano_tpu/ops/o.py": VT006_CLEAN})
+    assert f == []
+
+
+def test_vt006_jit_var_and_attr_tracking():
+    src = '''
+import jax
+
+class Engine:
+    def __init__(self):
+        self._solve = jax.jit(lambda x: x)
+
+    def run(self, xs):
+        return self._solve(xs)
+'''
+    f, _ = findings_of({"volcano_tpu/ops/o.py": src})
+    assert rule_ids(f) == ["VT006"]
+
+
+VT007_TRIGGER = '''
+import threading
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+
+    def record(self, ev):
+        self.events.append(ev)
+'''
+
+VT007_CLEAN = '''
+import threading
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+
+    def record(self, ev):
+        with self._lock:
+            self.events.append(ev)
+'''
+
+
+def test_vt007_trigger_and_clean():
+    f, _ = findings_of({"volcano_tpu/obs/trace.py": VT007_TRIGGER})
+    assert rule_ids(f) == ["VT007"]
+    f, _ = findings_of({"volcano_tpu/obs/trace.py": VT007_CLEAN})
+    assert f == []
+
+
+def test_vt007_locked_suffix_and_caller_holds_lock():
+    src = '''
+import threading
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+
+    def record(self, ev):
+        with self._lock:
+            self._push(ev)
+
+    def _push(self, ev):
+        self.events.append(ev)
+'''
+    f, _ = findings_of({"volcano_tpu/obs/trace.py": src})
+    assert f == []
+    # same helper called once OUTSIDE the lock: flagged again
+    leaky = src + ('\n    def sneak(self, ev):\n        self._push(ev)\n')
+    f, _ = findings_of({"volcano_tpu/obs/trace.py": leaky})
+    assert rule_ids(f) == ["VT007"]
+
+
+def test_vt007_lockless_class_not_checked():
+    src = ('class Span:\n    def done(self, d):\n        self.dur_s = d\n')
+    f, _ = findings_of({"volcano_tpu/obs/trace.py": src})
+    assert f == []
+
+
+# ---------------------------------------------------------------------------
+# 2. suppression + baseline semantics
+# ---------------------------------------------------------------------------
+
+def test_suppression_same_line_with_justification():
+    src = VT002_TRIGGER.replace(
+        "return _time.time() - job.creation_timestamp",
+        "return _time.time() - job.creation_timestamp  "
+        "# vlint: disable=VT002 -- test fixture exercising suppression")
+    f, inv = findings_of({"volcano_tpu/plugins/p.py": src})
+    assert f == [] and inv == []
+
+
+def test_suppression_standalone_comment_applies_to_next_line():
+    src = ('import time as _time\n\n\ndef decide(job):\n'
+           '    # vlint: disable=VT002 -- fixture: next-line form\n'
+           '    return _time.time() - job.creation_timestamp\n')
+    f, inv = findings_of({"volcano_tpu/plugins/p.py": src})
+    assert f == [] and inv == []
+
+
+def test_suppression_without_justification_is_invalid_and_inert():
+    src = VT002_TRIGGER.replace(
+        "return _time.time() - job.creation_timestamp",
+        "return _time.time() - job.creation_timestamp  "
+        "# vlint: disable=VT002")
+    f, inv = findings_of({"volcano_tpu/plugins/p.py": src})
+    assert rule_ids(f) == ["VT002"]        # still reported
+    assert [i.rule for i in inv] == ["VT000"]
+    assert exit_code(f, inv) == 1
+
+
+def test_suppression_wrong_rule_does_not_mask():
+    src = VT002_TRIGGER.replace(
+        "return _time.time() - job.creation_timestamp",
+        "return _time.time() - job.creation_timestamp  "
+        "# vlint: disable=VT003 -- wrong rule on purpose")
+    f, _ = findings_of({"volcano_tpu/plugins/p.py": src})
+    assert rule_ids(f) == ["VT002"]
+
+
+def test_baseline_requires_justification(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"version": 1, "findings": [
+        {"rule": "VT002", "path": "volcano_tpu/plugins/p.py",
+         "symbol": "decide", "message": "m"}]}))
+    with pytest.raises(BaselineError):
+        load_baseline(str(p))
+
+
+def test_baseline_match_and_stale(tmp_path):
+    f, _ = findings_of({"volcano_tpu/plugins/p.py": VT002_TRIGGER})
+    assert len(f) == 1
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"version": 1, "findings": [
+        {"rule": f[0].rule, "path": f[0].path, "symbol": f[0].symbol,
+         "message": f[0].message, "justification": "grandfathered"},
+        {"rule": "VT003", "path": "volcano_tpu/gone.py", "symbol": "x",
+         "message": "m", "justification": "stale entry"}]}))
+    baseline = load_baseline(str(p))
+    live, grandfathered = split_baselined(f, baseline)
+    assert live == [] and len(grandfathered) == 1
+    assert exit_code(live, []) == 0
+    stale = baseline.stale_entries()
+    assert len(stale) == 1 and stale[0]["path"] == "volcano_tpu/gone.py"
+    report = text_report(live, [], grandfathered, baseline)
+    assert "stale baseline entry" in report and "clean" in report
+
+
+def test_missing_baseline_is_empty():
+    b = load_baseline(None)
+    assert isinstance(b, Baseline) and b.entries == {}
+
+
+# ---------------------------------------------------------------------------
+# 3. JSON reporter schema
+# ---------------------------------------------------------------------------
+
+def test_json_report_schema():
+    f, inv = findings_of({"volcano_tpu/plugins/p.py": VT002_TRIGGER})
+    live, grand = split_baselined(f, Baseline())
+    payload = json.loads(json_report(live, inv, grand, Baseline()))
+    assert payload["version"] == 1
+    assert set(payload) == {"version", "findings", "invalid_suppressions",
+                            "baselined", "stale_baseline", "counts",
+                            "exit_code"}
+    assert payload["counts"] == {"findings": 1, "invalid_suppressions": 0,
+                                 "baselined": 0, "stale_baseline": 0}
+    assert payload["exit_code"] == 1
+    (entry,) = payload["findings"]
+    assert set(entry) == {"rule", "path", "line", "col", "symbol",
+                          "message"}
+    assert entry["rule"] == "VT002"
+    assert entry["path"] == "volcano_tpu/plugins/p.py"
+    assert entry["line"] > 0 and entry["symbol"] == "decide"
+
+
+def test_rule_catalog_complete():
+    ids = [r.id for r in ALL_RULES]
+    assert ids == sorted(ids) and len(ids) == len(set(ids))
+    assert {"VT001", "VT002", "VT003", "VT004", "VT005", "VT006",
+            "VT007"} <= set(ids)
+    for r in ALL_RULES:
+        assert r.contract and r.name
+    assert rule_by_id("VT001") is not None
+    assert rule_by_id("VT999") is None
+
+
+# ---------------------------------------------------------------------------
+# 4. re-broken historical bugs (REAL sources, surgically reverted)
+# ---------------------------------------------------------------------------
+
+def test_package_is_clean_modulo_baseline():
+    """The acceptance bar: vlint over the real tree exits 0 with the
+    checked-in (justified) baseline."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "volcano_tpu.analysis",
+         os.path.join(REPO, "volcano_tpu")],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_json_and_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "volcano_tpu.analysis", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0
+    for rid in ("VT001", "VT007"):
+        assert rid in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "volcano_tpu.analysis",
+         os.path.join(REPO, "volcano_tpu"), "--format", "json"],
+        cwd=REPO, capture_output=True, text=True)
+    payload = json.loads(proc.stdout)
+    assert payload["exit_code"] == 0 and payload["findings"] == []
+
+
+def test_rebreak_witness_leak_vt001():
+    """PR 3's witness-leak class: deleting the dirty mark from the evict
+    funnel must produce a VT001 finding (and the real file must not)."""
+    src = real_source("volcano_tpu/cache/cache.py")
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": src})
+    assert "VT001" not in rule_ids(f)
+    broken = mutate(
+        src,
+        "                self._mark_task_dirty(task)\n"
+        "                job.update_task_status(job.tasks[task.uid], "
+        "TaskStatus.RELEASING)",
+        "                job.update_task_status(job.tasks[task.uid], "
+        "TaskStatus.RELEASING)")
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": broken})
+    assert any(x.rule == "VT001" and x.symbol == "SchedulerCache.evict"
+               for x in f)
+
+
+def test_rebreak_evict_retry_node_mirror_vt001():
+    """PR 4's evict-retry mirror bug: the retry success path updated only
+    the JOB status; reverting the node-mirror fix must be flagged."""
+    src = real_source("volcano_tpu/cache/cache.py")
+    broken = mutate(
+        src,
+        "                            if cached.node_name in self.nodes:\n"
+        "                                self.nodes[cached.node_name]"
+        ".update_task(\n"
+        "                                    cached)",
+        "                            pass")
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": broken})
+    assert any(x.rule == "VT001"
+               and x.symbol == "SchedulerCache.process_resync_tasks"
+               and "mirror" in x.message for x in f)
+
+
+def test_rebreak_unjournaled_evict_vt004():
+    """PR 4's WAL contract: an evict executing without its intent record
+    is unreconstructable after a crash."""
+    src = real_source("volcano_tpu/cache/cache.py")
+    broken = mutate(src,
+                    '        seq = self._journal_intent("evict", task)\n',
+                    "        seq = None\n")
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": broken})
+    assert any(x.rule == "VT004" and x.symbol == "SchedulerCache.evict"
+               for x in f)
+
+
+def test_rebreak_sla_wall_clock_vt002():
+    """PR 6 injected the session clock into the SLA deadline check;
+    reverting to time.time() must be flagged."""
+    src = real_source("volcano_tpu/plugins/sla.py")
+    f, _ = findings_of({"volcano_tpu/plugins/sla.py": src})
+    assert f == []
+    broken = mutate(
+        src,
+        "if ssn.now() - job.creation_timestamp < jwt:",
+        "import time\n            "
+        "if time.time() - job.creation_timestamp < jwt:")
+    f, _ = findings_of({"volcano_tpu/plugins/sla.py": broken})
+    assert rule_ids(f) == ["VT002"]
+
+
+def test_rebreak_tdm_datetime_now_vt002():
+    src = real_source("volcano_tpu/plugins/tdm.py")
+    f, _ = findings_of({"volcano_tpu/plugins/tdm.py": src})
+    assert f == []
+    broken = mutate(
+        src,
+        "return datetime.fromtimestamp(ssn.now(), tz=timezone.utc)",
+        "return datetime.now()")
+    f, _ = findings_of({"volcano_tpu/plugins/tdm.py": broken})
+    assert rule_ids(f) == ["VT002"]
+
+
+def test_rebreak_backoff_global_rng_vt003():
+    """PR 6 made crash-loop jitter injectable; the global-RNG draw it
+    replaced must be flagged."""
+    src = real_source("volcano_tpu/scheduler.py")
+    f, _ = findings_of({"volcano_tpu/scheduler.py": src})
+    assert "VT003" not in rule_ids(f)
+    broken = mutate(src, "self._rng.uniform(0.0, self.backoff_jitter)",
+                    "random.uniform(0.0, self.backoff_jitter)")
+    f, _ = findings_of({"volcano_tpu/scheduler.py": broken})
+    assert any(x.rule == "VT003" and x.symbol == "Scheduler._backoff"
+               for x in f)
+
+
+def test_rebreak_simkill_swallow_vt005():
+    """PR 4's kill tunneling: the shell's BaseException handler re-raises
+    so SimKill behaves like SIGKILL; removing the re-raise must flag."""
+    src = real_source("volcano_tpu/scheduler.py")
+    f, _ = findings_of({"volcano_tpu/scheduler.py": src})
+    assert "VT005" not in rule_ids(f)
+    broken = mutate(
+        src,
+        "                crashed = not isinstance(exc, Exception)\n"
+        "                raise",
+        "                crashed = not isinstance(exc, Exception)")
+    f, _ = findings_of({"volcano_tpu/scheduler.py": broken})
+    assert any(x.rule == "VT005" for x in f)
+
+
+def test_rebreak_unbucketed_job_axis_vt006():
+    """PR 4's churn recompile hole: stripping the pow2 bucket helpers
+    from allocate's solver paths must produce VT006 findings, and the
+    real file must be clean."""
+    src = real_source("volcano_tpu/actions/allocate.py")
+    f, _ = findings_of({"volcano_tpu/actions/allocate.py": src})
+    assert "VT006" not in rule_ids(f)
+    broken = src.replace("_bucket(", "int(")   # _bucket/_job_bucket/_delta*
+    assert broken != src
+    f, _ = findings_of({"volcano_tpu/actions/allocate.py": broken})
+    assert any(x.rule == "VT006" for x in f)
+
+
+def test_known_preempt_walk_exposure_vt006_is_baselined():
+    """The preempt walk's unbucketed (preemptor, victim-slot) axes are a
+    REAL finding (same defect class), deliberately baselined with a
+    justification — assert the rule sees it and the baseline carries a
+    justification for exactly it."""
+    # the jit producers (build_preempt_walk*) live in ops/evict.py — the
+    # cross-module producer index needs both files, like a real run has
+    f, _ = findings_of({
+        "volcano_tpu/actions/evict_tpu.py":
+            real_source("volcano_tpu/actions/evict_tpu.py"),
+        "volcano_tpu/ops/evict.py":
+            real_source("volcano_tpu/ops/evict.py")})
+    hits = [x for x in f if x.rule == "VT006"
+            and x.symbol == "_preempt_phase"]
+    assert hits, "the known preempt-walk exposure disappeared: either it "\
+                 "was fixed (remove the baseline entry) or VT006 regressed"
+    baseline = load_baseline(os.path.join(REPO, "vlint-baseline.json"))
+    assert baseline.match(hits[0])
+    entry = baseline.entries[hits[0].key()]
+    assert len(entry["justification"]) > 40
+
+
+def test_rebreak_unlocked_native_event_write_vt007():
+    """PR 6 put the native store's event-ring writes under the dispatch
+    lock; the pre-PR unguarded append must be flagged."""
+    src = real_source("volcano_tpu/native/__init__.py")
+    f, _ = findings_of({"volcano_tpu/native/__init__.py": src})
+    assert "VT007" not in rule_ids(f)
+    broken = mutate(
+        src,
+        "        with self._dispatch_lock:\n"
+        "            self._admission_hooks.append(hook)",
+        "        self._admission_hooks.append(hook)")
+    f, _ = findings_of({"volcano_tpu/native/__init__.py": broken})
+    assert any(x.rule == "VT007"
+               and "register_admission_hook" in x.symbol for x in f)
+
+
+def test_rebreak_unlocked_trace_toggle_vt007():
+    src = real_source("volcano_tpu/obs/trace.py")
+    f, _ = findings_of({"volcano_tpu/obs/trace.py": src})
+    assert "VT007" not in rule_ids(f)
+    broken = mutate(
+        src,
+        "    def enable(self) -> None:\n"
+        "        with self._lock:\n"
+        "            self._recording = True",
+        "    def enable(self) -> None:\n"
+        "        self._recording = True")
+    f, _ = findings_of({"volcano_tpu/obs/trace.py": broken})
+    assert any(x.rule == "VT007" and "enable" in x.symbol for x in f)
+
+
+def test_rebreak_session_clock_removal_vt002_gang():
+    """gang's PodGroup condition timestamps ride the session clock; a
+    revert to wall time must be flagged."""
+    src = real_source("volcano_tpu/plugins/gang.py")
+    f, _ = findings_of({"volcano_tpu/plugins/gang.py": src})
+    assert f == []
+    broken = mutate(src, '"lastTransitionTime": ssn.now(),',
+                    '"lastTransitionTime": time.time(),')
+    broken = mutate(broken, "from .. import metrics",
+                    "import time\n\nfrom .. import metrics")
+    f, _ = findings_of({"volcano_tpu/plugins/gang.py": broken})
+    assert rule_ids(f) == ["VT002"]

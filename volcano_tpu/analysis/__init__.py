@@ -1,0 +1,40 @@
+"""vlint: contract-aware static analysis for the volcano_tpu codebase.
+
+The scheduler's correctness rests on conventions that only runtime soaks
+catch when broken — the dirty-set witness (docs/performance.md), the
+journaled bind/evict funnels (docs/robustness.md), injectable clocks and
+seeded RNGs for byte-determinism (docs/simulation.md), SimKill tunneling,
+pow2 shape bucketing, and lock discipline in the shared-state modules.
+``vlint`` turns each of those conventions into a mechanical check over
+the package's ASTs (stdlib ``ast`` only, no new runtime deps):
+
+- VT001  cache-state mutation without a dirty-set/mutation-witness mark
+- VT002  raw wall clock (time.time/sleep/monotonic, datetime.now) in
+         scheduler-path code outside the sanctioned clock implementations
+- VT003  unseeded module-level RNG draws in decision paths
+- VT004  bind/evict executor invocation outside the journaled funnels
+- VT005  exception handlers that would swallow SimKill (BaseException)
+- VT006  jitted solver invocations whose shapes skip pow2 bucketing
+- VT007  shared-state writes outside a held lock in native/metrics/obs
+
+Run it: ``python -m volcano_tpu.analysis volcano_tpu/`` (or the ``vlint``
+console script). Findings are suppressible per line with
+``# vlint: disable=VTxxx -- justification`` (the justification text is
+required) and grandfathered findings live in the checked-in
+``vlint-baseline.json``, each entry carrying its own justification.
+See docs/static-analysis.md for the rule catalog and how to add a rule.
+"""
+
+from __future__ import annotations
+
+from .core import (AnalysisContext, Finding, analyze_paths, analyze_sources,
+                   iter_python_files)
+from .rules import ALL_RULES, rule_by_id
+from .baseline import Baseline, load_baseline
+from .report import json_report, text_report
+
+__all__ = [
+    "ALL_RULES", "AnalysisContext", "Baseline", "Finding", "analyze_paths",
+    "analyze_sources", "iter_python_files", "json_report", "load_baseline",
+    "rule_by_id", "text_report",
+]

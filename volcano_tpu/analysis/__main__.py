@@ -1,0 +1,124 @@
+"""``python -m volcano_tpu.analysis`` / ``vlint`` — the CLI.
+
+Usage:
+    vlint [paths...] [--format text|json] [--baseline FILE]
+          [--no-baseline] [--update-baseline] [--rule VTxxx [...]]
+          [--list-rules]
+
+Exit codes: 0 clean (suppressed/baselined findings do not gate),
+1 blocking findings or invalid suppressions, 2 usage/baseline errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .baseline import (DEFAULT_BASELINE, Baseline, BaselineError,
+                       load_baseline, write_baseline)
+from .core import analyze_paths
+from .report import exit_code, json_report, split_baselined, text_report
+from .rules import ALL_RULES, rule_by_id
+
+
+def _default_paths() -> List[str]:
+    """Default target: the volcano_tpu package next to this file (works
+    from any cwd)."""
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def _find_baseline(paths: List[str]) -> Optional[str]:
+    """The checked-in baseline lives at the repo root (the package's
+    parent); fall back to cwd."""
+    for base in paths:
+        probe = base if os.path.isdir(base) else os.path.dirname(base)
+        for candidate in (os.path.join(os.path.dirname(
+                os.path.abspath(probe)), DEFAULT_BASELINE),
+                os.path.join(probe, DEFAULT_BASELINE)):
+            if os.path.exists(candidate):
+                return candidate
+    cwd = os.path.join(os.getcwd(), DEFAULT_BASELINE)
+    return cwd if os.path.exists(cwd) else None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="vlint",
+        description="contract-aware static analysis for volcano_tpu "
+                    "(docs/static-analysis.md)")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to analyze "
+                             "(default: the volcano_tpu package)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: {DEFAULT_BASELINE} "
+                             f"at the repo root when present)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline file")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from current findings "
+                             "(preserving existing justifications)")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="VTxxx", help="run only these rules")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id}  {rule.name}: {rule.contract}")
+        return 0
+
+    rules = ALL_RULES
+    if args.rule:
+        rules = []
+        for rid in args.rule:
+            rule = rule_by_id(rid)
+            if rule is None:
+                print(f"vlint: unknown rule {rid!r} (--list-rules)",
+                      file=sys.stderr)
+                return 2
+            rules.append(rule)
+
+    paths = args.paths or _default_paths()
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"vlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    findings, invalid, _ = analyze_paths(paths, rules=rules)
+
+    baseline_path = None if args.no_baseline else (
+        args.baseline or _find_baseline(paths))
+    try:
+        baseline = load_baseline(baseline_path)
+    except BaselineError as exc:
+        print(f"vlint: {exc}", file=sys.stderr)
+        return 2
+
+    live, grandfathered = split_baselined(findings, baseline)
+
+    if args.update_baseline:
+        target = baseline_path or os.path.join(os.getcwd(),
+                                               DEFAULT_BASELINE)
+        merged = live + grandfathered
+        write_baseline(target, merged, justifications={
+            key: entry["justification"]
+            for key, entry in baseline.entries.items()
+            if entry.get("justification")})
+        print(f"vlint: wrote {len(merged)} entr"
+              f"{'y' if len(merged) == 1 else 'ies'} to {target}; "
+              f"replace any TODO justifications before committing")
+        return 0
+
+    if args.format == "json":
+        print(json_report(live, invalid, grandfathered, baseline))
+    else:
+        print(text_report(live, invalid, grandfathered, baseline))
+    return exit_code(live, invalid)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

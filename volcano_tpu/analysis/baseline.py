@@ -1,0 +1,97 @@
+"""Baseline semantics: grandfathered findings, each with its own
+justification.
+
+The baseline is NOT an escape hatch for new violations — it exists for
+findings that are deliberate (e.g. a constant-shape solver axis the
+bucketing rule cannot see) and records WHY, per finding. Entries without
+a non-empty ``justification`` are a hard error; entries that no longer
+match any finding are reported as stale so the file shrinks as debt is
+paid."""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = "vlint-baseline.json"
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (bad JSON, missing justification)."""
+
+
+@dataclass
+class Baseline:
+    path: Optional[str] = None
+    entries: Dict[Tuple[str, str, str], dict] = field(default_factory=dict)
+
+    def match(self, finding: Finding) -> bool:
+        entry = self.entries.get(finding.key())
+        if entry is None:
+            return False
+        entry["_hit"] = True
+        return True
+
+    def stale_entries(self) -> List[dict]:
+        return [dict(e, _hit=None) for e in self.entries.values()
+                if not e.get("_hit")]
+
+    @staticmethod
+    def entry_key(entry: dict) -> Tuple[str, str, str]:
+        return (entry["rule"], entry["path"], entry.get("symbol", ""))
+
+
+def load_baseline(path: Optional[str]) -> Baseline:
+    if path is None or not os.path.exists(path):
+        return Baseline(path=path)
+    with open(path, encoding="utf-8") as f:
+        try:
+            data = json.load(f)
+        except json.JSONDecodeError as exc:
+            raise BaselineError(f"{path}: not valid JSON ({exc})") from exc
+    if not isinstance(data, dict) or "findings" not in data:
+        raise BaselineError(f"{path}: expected an object with a "
+                            f"'findings' array")
+    baseline = Baseline(path=path)
+    for i, entry in enumerate(data["findings"]):
+        for req in ("rule", "path"):
+            if not entry.get(req):
+                raise BaselineError(
+                    f"{path}: findings[{i}] missing required '{req}'")
+        if not str(entry.get("justification", "")).strip():
+            raise BaselineError(
+                f"{path}: findings[{i}] ({entry['rule']} {entry['path']}) "
+                f"has no justification — every grandfathered finding must "
+                f"say why it is allowed to stay")
+        baseline.entries[Baseline.entry_key(entry)] = dict(entry)
+    return baseline
+
+
+def write_baseline(
+        path: str, findings: List[Finding],
+        justifications: Optional[Dict[Tuple[str, str, str], str]] = None,
+        ) -> None:
+    """--update-baseline: rewrite the file from the current findings.
+    ``justifications`` maps ``finding.key()`` to the justification to
+    keep (the CLI passes the prior baseline's, so re-running never
+    erases a written reason); findings without one get a placeholder
+    the loader will accept but reviewers must replace."""
+    justifications = justifications or {}
+    payload = {
+        "version": BASELINE_VERSION,
+        "findings": [
+            {"rule": f.rule, "path": f.path, "symbol": f.symbol,
+             "message": f.message,
+             "justification": justifications.get(f.key())
+             or "TODO: justify or fix"}
+            for f in findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")

@@ -1,0 +1,370 @@
+"""vlint engine: module model, suppressions, and the intra-package
+call graph rules use to see one hop of indirection.
+
+Everything here is stdlib ``ast`` — the analyzer never imports the code
+it checks, so it runs in CI without jax or a device present.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+PACKAGE = "volcano_tpu"
+
+# ``# vlint: disable=VT001,VT002 -- why this is fine`` — the justification
+# after ``--`` is REQUIRED; a disable without one is itself reported
+# (VT000) and suppresses nothing.
+_SUPPRESS_RE = re.compile(
+    r"#\s*vlint:\s*disable=(?P<rules>[A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)"
+    r"(?P<just>\s*--\s*(?P<text>.*))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str            # "VT001"
+    path: str            # repo-relative posix path
+    line: int            # 1-based
+    col: int             # 0-based
+    symbol: str          # dotted function/method ("" for module level)
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers drift, (rule, path, symbol)
+        does not."""
+        return (self.rule, self.path, self.symbol)
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "symbol": self.symbol,
+                "message": self.message}
+
+
+@dataclass
+class Suppression:
+    line: int                 # line the suppression APPLIES to
+    rules: Set[str]
+    justification: str
+    comment_line: int         # line the comment physically sits on
+    used: bool = False
+
+
+@dataclass
+class FunctionInfo:
+    """One function/method definition with the pre-computed facts rules
+    share: which simple names it calls and where it sits."""
+
+    module: "ModuleInfo"
+    qualname: str                       # "SchedulerCache.bind" / "bind"
+    node: ast.AST                       # FunctionDef | AsyncFunctionDef
+    cls: Optional[str]                  # owning class name or None
+    called_names: Set[str] = field(default_factory=set)
+    # subset of called_names eligible as CALL-GRAPH EDGES: bare calls and
+    # single-receiver method calls (``helper()``, ``self.helper()``,
+    # ``cache.evict()``). ``self.evictor.evict()`` is NOT linkable — the
+    # receiver is a nested attribute (an executor object), and linking it
+    # to a same-named local def would let a witness-carrying caller
+    # excuse a function it never actually calls.
+    linkable_calls: Set[str] = field(default_factory=set)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<fn {self.module.path}::{self.qualname}>"
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, None for anything fancier."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ModuleInfo:
+    """Parsed module + the lexical facts rules need."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        self.suppressions: List[Suppression] = []
+        self.invalid_suppressions: List[Finding] = []
+        self._parse_suppressions()
+        # import alias maps: local name -> imported module ("np" ->
+        # "numpy"), and from-imports: local name -> "module.attr"
+        self.import_aliases: Dict[str, str] = {}
+        self.from_imports: Dict[str, str] = {}
+        self._collect_imports()
+        self.functions: List[FunctionInfo] = []
+        self._collect_functions()
+
+    # -- suppressions -------------------------------------------------------
+
+    def _parse_suppressions(self) -> None:
+        try:
+            tokens = list(tokenize.generate_tokens(
+                StringIO(self.source).readline))
+        except tokenize.TokenError:  # pragma: no cover - defensive
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            comment_line = tok.start[0]
+            rules = {r.strip() for r in m.group("rules").split(",")}
+            text = (m.group("text") or "").strip()
+            # a comment alone on its line applies to the next line;
+            # a trailing comment applies to its own line
+            line_src = self.lines[comment_line - 1].strip() \
+                if comment_line <= len(self.lines) else ""
+            applies = comment_line + 1 if line_src.startswith("#") \
+                else comment_line
+            if not text:
+                self.invalid_suppressions.append(Finding(
+                    rule="VT000", path=self.path, line=comment_line, col=0,
+                    symbol="",
+                    message="vlint suppression without a justification: "
+                            "write '# vlint: disable=%s -- <why>'"
+                            % ",".join(sorted(rules))))
+                continue
+            self.suppressions.append(Suppression(
+                line=applies, rules=rules, justification=text,
+                comment_line=comment_line))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        for sup in self.suppressions:
+            if sup.line == line and rule in sup.rules:
+                sup.used = True
+                return True
+        return False
+
+    # -- imports ------------------------------------------------------------
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.import_aliases[alias.asname or
+                                        alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.from_imports[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+
+    def resolve_call(self, node: ast.Call) -> Optional[str]:
+        """Canonical dotted target of a call, with the first component
+        resolved through this module's imports: ``_time.time()`` ->
+        ``time.time``; ``datetime.now()`` (from-import) ->
+        ``datetime.datetime.now``. None when the callee is not a plain
+        name/attribute chain."""
+        dotted = dotted_name(node.func)
+        if dotted is None:
+            return None
+        parts = dotted.split(".")
+        head = parts[0]
+        if head in self.import_aliases:
+            parts[0] = self.import_aliases[head]
+        elif head in self.from_imports:
+            parts[0] = self.from_imports[head]
+        return ".".join(parts)
+
+    # -- functions ----------------------------------------------------------
+
+    def _collect_functions(self) -> None:
+        mod = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.stack: List[str] = []
+                self.cls: List[str] = []
+
+            def visit_ClassDef(self, node: ast.ClassDef) -> None:
+                self.cls.append(node.name)
+                self.stack.append(node.name)
+                self.generic_visit(node)
+                self.stack.pop()
+                self.cls.pop()
+
+            def _fn(self, node) -> None:
+                qual = ".".join(self.stack + [node.name])
+                info = FunctionInfo(
+                    module=mod, qualname=qual, node=node,
+                    cls=self.cls[-1] if self.cls else None)
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        if isinstance(sub.func, ast.Name):
+                            info.called_names.add(sub.func.id)
+                            info.linkable_calls.add(sub.func.id)
+                        elif isinstance(sub.func, ast.Attribute):
+                            info.called_names.add(sub.func.attr)
+                            if isinstance(sub.func.value, ast.Name):
+                                info.linkable_calls.add(sub.func.attr)
+                mod.functions.append(info)
+                self.stack.append(node.name)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_FunctionDef = _fn
+            visit_AsyncFunctionDef = _fn
+
+        V().visit(self.tree)
+
+    def enclosing_function(self, line: int) -> Optional[FunctionInfo]:
+        """Innermost function containing ``line``."""
+        best: Optional[FunctionInfo] = None
+        for fn in self.functions:
+            end = getattr(fn.node, "end_lineno", fn.node.lineno)
+            if fn.node.lineno <= line <= end:
+                if best is None or fn.node.lineno >= best.node.lineno:
+                    best = fn
+        return best
+
+
+class CallGraph:
+    """Lightweight intra-package call graph over SIMPLE names: good enough
+    for one hop of indirection (a funnel's helper, a helper's funnel).
+    Edges come from ``linkable_calls`` — bare calls and single-receiver
+    method calls. Rules use the graph to EXCUSE code (a callee or caller
+    carries the witness), so edge precision matters in one direction
+    only: a missing edge can cost a false positive (fixable with a
+    justified suppression), while a bogus edge would HIDE a finding —
+    which is why ``self.evictor.evict()`` does not link to a local
+    ``evict`` def (see FunctionInfo.linkable_calls)."""
+
+    def __init__(self, modules: Iterable[ModuleInfo]):
+        self.defs: Dict[str, List[FunctionInfo]] = {}
+        self.callers: Dict[str, List[FunctionInfo]] = {}
+        for mod in modules:
+            for fn in mod.functions:
+                self.defs.setdefault(fn.name, []).append(fn)
+        for mod in modules:
+            for fn in mod.functions:
+                for name in fn.linkable_calls:
+                    if name in self.defs:
+                        self.callers.setdefault(name, []).append(fn)
+
+    def callers_of(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        return [c for c in self.callers.get(fn.name, []) if c is not fn]
+
+    def callees_of(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        out: List[FunctionInfo] = []
+        for name in fn.linkable_calls:
+            for cand in self.defs.get(name, []):
+                if cand is not fn:
+                    out.append(cand)
+        return out
+
+    def one_hop(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        """Direct callers + direct callees: the neighborhood a funnel
+        witness may legitimately live in."""
+        return self.callers_of(fn) + self.callees_of(fn)
+
+
+class AnalysisContext:
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules = modules
+        self.by_path: Dict[str, ModuleInfo] = {m.path: m for m in modules}
+        self.graph = CallGraph(modules)
+
+    def witness_in_scope(self, fn: FunctionInfo, witness_names: Set[str],
+                         hop: bool = True) -> bool:
+        """Does ``fn`` call one of ``witness_names``, or (one hop) does a
+        direct caller or callee?"""
+        if fn.called_names & witness_names:
+            return True
+        if not hop:
+            return False
+        for other in self.graph.one_hop(fn):
+            if other.called_names & witness_names:
+                return True
+        return False
+
+
+def normalize_path(path: str) -> str:
+    """Repo-relative posix path starting at the package directory, so
+    findings and baselines are stable regardless of invocation cwd."""
+    posix = path.replace(os.sep, "/")
+    marker = f"{PACKAGE}/"
+    idx = posix.rfind(marker)
+    if idx >= 0:
+        return posix[idx:]
+    return posix
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[Tuple[str, str]]:
+    """(normalized_path, absolute_path) for every .py under ``paths``."""
+    for path in paths:
+        if os.path.isfile(path):
+            yield normalize_path(path), os.path.abspath(path)
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    full = os.path.join(root, name)
+                    yield normalize_path(full), os.path.abspath(full)
+
+
+def analyze_sources(sources: Dict[str, str], rules=None
+                    ) -> Tuple[List[Finding], List[Finding],
+                               AnalysisContext]:
+    """Run ``rules`` (default: all) over in-memory ``{path: source}``.
+    Returns (findings, invalid_suppressions, context); findings are
+    post-suppression, sorted by location. This is the testing entry point
+    — fixture tests and the re-broken-historical-bug regressions feed
+    mutated sources through here without touching the tree."""
+    from .rules import ALL_RULES
+    rules = ALL_RULES if rules is None else rules
+    modules: List[ModuleInfo] = []
+    errors: List[Finding] = []
+    for path, src in sorted(sources.items()):
+        norm = normalize_path(path)
+        try:
+            modules.append(ModuleInfo(norm, src))
+        except SyntaxError as exc:
+            errors.append(Finding(
+                rule="VT000", path=norm, line=exc.lineno or 0, col=0,
+                symbol="", message=f"syntax error: {exc.msg}"))
+    ctx = AnalysisContext(modules)
+    findings: List[Finding] = list(errors)
+    invalid: List[Finding] = []
+    for mod in modules:
+        invalid.extend(mod.invalid_suppressions)
+    for rule in rules:
+        for mod in modules:
+            if not rule.applies_to(mod.path):
+                continue
+            for f in rule.check(mod, ctx):
+                if not mod.suppressed(f.rule, f.line):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    invalid.sort(key=lambda f: (f.path, f.line))
+    return findings, invalid, ctx
+
+
+def analyze_paths(paths: Iterable[str], rules=None
+                  ) -> Tuple[List[Finding], List[Finding], AnalysisContext]:
+    sources: Dict[str, str] = {}
+    for norm, full in iter_python_files(paths):
+        with open(full, encoding="utf-8") as f:
+            sources[norm] = f.read()
+    return analyze_sources(sources, rules=rules)

@@ -1,0 +1,80 @@
+"""vlint reporters: text for humans, JSON for CI.
+
+The JSON schema (version 1) is a contract the tests pin:
+
+{
+  "version": 1,
+  "findings":             [{rule, path, line, col, symbol, message}],
+  "invalid_suppressions": [{rule, path, line, col, symbol, message}],
+  "baselined":            [{rule, path, line, col, symbol, message}],
+  "stale_baseline":       [{rule, path, symbol, message, justification}],
+  "counts": {"findings": N, "baselined": N, "invalid_suppressions": N,
+             "stale_baseline": N},
+  "exit_code": 0|1
+}
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from .baseline import Baseline
+from .core import Finding
+
+
+def split_baselined(findings: List[Finding], baseline: Baseline):
+    """(live, baselined) — a finding matching a justified baseline entry
+    is reported separately and does not gate."""
+    live: List[Finding] = []
+    grandfathered: List[Finding] = []
+    for f in findings:
+        (grandfathered if baseline.match(f) else live).append(f)
+    return live, grandfathered
+
+
+def exit_code(live: List[Finding], invalid: List[Finding]) -> int:
+    return 1 if (live or invalid) else 0
+
+
+def text_report(live: List[Finding], invalid: List[Finding],
+                baselined: List[Finding], baseline: Baseline) -> str:
+    lines: List[str] = []
+    for f in invalid:
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}")
+    for f in live:
+        sym = f" [{f.symbol}]" if f.symbol else ""
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule}{sym} "
+                     f"{f.message}")
+    stale = baseline.stale_entries()
+    for e in stale:
+        lines.append(f"note: stale baseline entry {e['rule']} {e['path']} "
+                     f"[{e.get('symbol', '')}] — the finding is gone; "
+                     f"remove it from {baseline.path}")
+    n = len(live) + len(invalid)
+    detail = (f"{n} blocking finding(s), {len(baselined)} baselined, "
+              f"{len(stale)} stale baseline "
+              f"entr{'y' if len(stale) == 1 else 'ies'}")
+    lines.append(f"vlint: {detail}" if n else f"vlint: clean ({detail})")
+    return "\n".join(lines)
+
+
+def json_report(live: List[Finding], invalid: List[Finding],
+                baselined: List[Finding], baseline: Baseline) -> str:
+    payload = {
+        "version": 1,
+        "findings": [f.as_dict() for f in live],
+        "invalid_suppressions": [f.as_dict() for f in invalid],
+        "baselined": [f.as_dict() for f in baselined],
+        "stale_baseline": [
+            {k: v for k, v in e.items() if not k.startswith("_")}
+            for e in baseline.stale_entries()],
+        "counts": {
+            "findings": len(live),
+            "invalid_suppressions": len(invalid),
+            "baselined": len(baselined),
+            "stale_baseline": len(baseline.stale_entries()),
+        },
+        "exit_code": exit_code(live, invalid),
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)

@@ -1,0 +1,790 @@
+"""The vlint rule catalog: one class per contract.
+
+Each rule names the PR that established its contract (docs/
+static-analysis.md carries the full catalog). Rules are deliberately
+scoped to the modules where the contract applies — a wall-clock read in
+the CLI is fine; the same read inside a plugin's decision callback breaks
+sim byte-determinism.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import (AnalysisContext, Finding, FunctionInfo, ModuleInfo,
+                   dotted_name)
+
+
+def _in_scope(path: str, prefixes: Sequence[str]) -> bool:
+    return any(path == p or (p.endswith("/") and path.startswith(p))
+               for p in prefixes)
+
+
+class Rule:
+    id: str = "VT000"
+    name: str = ""
+    contract: str = ""
+    scope: Sequence[str] = ()
+    exclude: Sequence[str] = ()
+
+    def applies_to(self, path: str) -> bool:
+        if _in_scope(path, self.exclude):
+            return False
+        if not self.scope:
+            return True
+        return _in_scope(path, self.scope)
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext
+              ) -> List[Finding]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def finding(self, mod: ModuleInfo, node: ast.AST, message: str
+                ) -> Finding:
+        fn = mod.enclosing_function(node.lineno)
+        return Finding(rule=self.id, path=mod.path, line=node.lineno,
+                       col=getattr(node, "col_offset", 0),
+                       symbol=fn.qualname if fn else "", message=message)
+
+
+# ---------------------------------------------------------------------------
+# VT001 — dirty-set witness (PR 3, docs/performance.md)
+# ---------------------------------------------------------------------------
+
+class DirtyWitnessRule(Rule):
+    """Every cluster-state mutation must mark the dirty set (or set the
+    ``_touched`` mutation witness) on the path — a missed mark makes
+    clone-on-dirty serve a stale placement input, silently. The witness
+    may live one call-graph hop away (a funnel's helper, a helper's
+    funnel)."""
+
+    id = "VT001"
+    name = "dirty-witness"
+    contract = ("cache-state mutation without a mark_*_dirty/_touched "
+                "witness on the path (PR 3 incremental snapshots)")
+    scope = ("volcano_tpu/cache/cache.py",
+             "volcano_tpu/cache/store_wiring.py",
+             "volcano_tpu/sim/runner.py")
+
+    MUTATOR_CALLS = {"add_task", "remove_task", "update_task",
+                     "add_task_info", "delete_task_info",
+                     "update_task_status"}
+    MUTATED_ATTRS = {"status", "node_name"}
+    STATE_DICTS = {"nodes", "jobs", "queues"}
+    WITNESS_CALLS = {"mark_node_dirty", "mark_job_dirty", "mark_queue_dirty",
+                     "mark_all_dirty", "_mark_task_dirty"}
+    DIRTY_SETS = {"_dirty_nodes", "_dirty_jobs", "_dirty_queues",
+                  "_tensor_dirty"}
+
+    def _has_witness(self, fn: FunctionInfo) -> bool:
+        if fn.called_names & self.WITNESS_CALLS:
+            return True
+        for node in ast.walk(fn.node):
+            # self._dirty_nodes.add(...) / _tensor_dirty.add(...)
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "add":
+                recv = dotted_name(node.func.value) or ""
+                if recv.split(".")[-1] in self.DIRTY_SETS:
+                    return True
+            # self._dirty_all = True / obj._touched = True
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and tgt.attr in ("_dirty_all", "_touched"):
+                        return True
+        return False
+
+    def _mutations(self, fn: FunctionInfo) -> List[Tuple[ast.AST, str]]:
+        out: List[Tuple[ast.AST, str]] = []
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in self.MUTATOR_CALLS:
+                recv = dotted_name(node.func.value) or "<expr>"
+                out.append((node, f"{recv}.{node.func.attr}(...)"))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and tgt.attr in self.MUTATED_ATTRS:
+                        recv = dotted_name(tgt.value) or "<expr>"
+                        if recv == "self":
+                            continue
+                        out.append((node, f"{recv}.{tgt.attr} = ..."))
+                    # self.nodes[k] = v / del-by-pop handled via calls
+                    elif isinstance(tgt, ast.Subscript) \
+                            and isinstance(tgt.value, ast.Attribute) \
+                            and tgt.value.attr in self.STATE_DICTS \
+                            and dotted_name(tgt.value.value) == "self":
+                        out.append((node,
+                                    f"self.{tgt.value.attr}[...] = ..."))
+        return out
+
+    # node-mirror ops that keep the node's task clone + accounting in
+    # step with a job-side status flip (the evict-retry mirror bug: the
+    # retry success path updated only the JOB status; the node mirror
+    # holds a CLONE, so a phantom RUNNING task kept occupying idle)
+    MIRROR_CALLS = {"add_task", "remove_task", "update_task"}
+
+    def _enclosing_block(self, fn: FunctionInfo,
+                         target: ast.AST) -> Optional[List[ast.stmt]]:
+        """Deepest statement list whose subtree contains ``target``."""
+        best: Optional[List[ast.stmt]] = None
+
+        def visit(body: List[ast.stmt]) -> None:
+            nonlocal best
+            for stmt in body:
+                found = any(sub is target for sub in ast.walk(stmt))
+                if found:
+                    best = body
+                for attr in ("body", "orelse", "finalbody"):
+                    visit(getattr(stmt, attr, []) or [])
+                for h in getattr(stmt, "handlers", []) or []:
+                    visit(h.body)
+
+        visit(fn.node.body)
+        return best
+
+    def _block_has_mirror(self, block: List[ast.stmt]) -> bool:
+        for stmt in block:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in self.MIRROR_CALLS:
+                    return True
+                # node.tasks[uid] = clone (the bind_batch agg fast path)
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Subscript) \
+                                and isinstance(tgt.value, ast.Attribute) \
+                                and tgt.value.attr == "tasks":
+                            return True
+        return False
+
+    def _mirror_findings(self, mod: ModuleInfo) -> List[Finding]:
+        """cache/cache.py only: a job-side status flip must keep the node
+        mirror in step within the same statement block."""
+        out: List[Finding] = []
+        if not mod.path.endswith("cache/cache.py"):
+            return out
+        for fn in mod.functions:
+            for node in ast.walk(fn.node):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "update_task_status"
+                        and dotted_name(node.func.value) != "self"):
+                    continue
+                block = self._enclosing_block(fn, node)
+                if block is not None and self._block_has_mirror(block):
+                    continue
+                out.append(self.finding(
+                    mod, node,
+                    f"job-side update_task_status in {fn.qualname} with no "
+                    f"node-mirror maintenance (add/remove/update_task) in "
+                    f"the same block; the node holds a CLONE — its "
+                    f"accounting drifts and preempt sees phantom tasks "
+                    f"(the PR 4 evict-retry mirror bug)"))
+        return out
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = list(self._mirror_findings(mod))
+        for fn in mod.functions:
+            muts = self._mutations(fn)
+            if not muts:
+                continue
+            if self._has_witness(fn):
+                continue
+            # one hop: a direct caller or callee carrying the witness
+            # excuses the function (e.g. _release_numa is only reached
+            # from funnels that already marked the node dirty). Defs NAMED
+            # like mutator methods are excluded from the excuse set: the
+            # graph links ``job.update_task_status(...)`` to any local def
+            # of that name, and a well-behaved mutator elsewhere must not
+            # vouch for THIS object's unmarked mutation.
+            neighborhood = [o for o in ctx.graph.one_hop(fn)
+                            if o.name not in self.MUTATOR_CALLS]
+            if any(self._has_witness(o) for o in neighborhood):
+                continue
+            node, desc = muts[0]
+            findings.append(self.finding(
+                mod, node,
+                f"cluster-state mutation ({desc}) in {fn.qualname} with no "
+                f"mark_*_dirty/_touched witness in the function or one "
+                f"call-graph hop; a reused snapshot clone will serve this "
+                f"mutation stale (docs/performance.md)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# VT002 — injectable clock (PR 2, docs/simulation.md)
+# ---------------------------------------------------------------------------
+
+class RawClockRule(Rule):
+    """Scheduler-path code must go through the injectable clock
+    (Scheduler.clock / Session.now() / a ``time_fn`` parameter) so the
+    simulator can pin virtual time. Only the sanctioned clock
+    implementations may read the wall clock. References passed as
+    defaults (``time_fn=time.monotonic``) are the injection convention
+    and are not flagged — only calls are."""
+
+    id = "VT002"
+    name = "raw-clock"
+    contract = ("raw time.time/time.sleep/time.monotonic/datetime.now "
+                "outside the WallClock/VirtualClock implementations "
+                "(PR 2 injectable clock)")
+    scope = ("volcano_tpu/scheduler.py", "volcano_tpu/leaderelection.py",
+             "volcano_tpu/framework/", "volcano_tpu/actions/",
+             "volcano_tpu/plugins/", "volcano_tpu/cache/",
+             "volcano_tpu/sim/", "volcano_tpu/utils/", "volcano_tpu/ops/",
+             "volcano_tpu/parallel/")
+
+    BANNED_TIME = {"time.time", "time.sleep", "time.monotonic"}
+    BANNED_DT_SUFFIX = ("datetime.now", "datetime.utcnow", "datetime.today",
+                        "date.today")
+    # the sanctioned clock implementations: (path, class name)
+    ALLOWED_OWNERS = {("volcano_tpu/scheduler.py", "WallClock"),
+                      ("volcano_tpu/sim/runner.py", "VirtualClock")}
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = mod.resolve_call(node)
+            if resolved is None:
+                continue
+            banned = resolved in self.BANNED_TIME or \
+                resolved.endswith(self.BANNED_DT_SUFFIX)
+            if not banned:
+                continue
+            fn = mod.enclosing_function(node.lineno)
+            if fn is not None and (mod.path, fn.cls) in self.ALLOWED_OWNERS:
+                continue
+            findings.append(self.finding(
+                mod, node,
+                f"raw clock call {resolved}() in scheduler-path code; "
+                f"inject the time source (clock/ssn.now()/time_fn param) "
+                f"so sim replay stays byte-deterministic "
+                f"(docs/simulation.md)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# VT003 — seeded RNGs (PR 2, docs/simulation.md)
+# ---------------------------------------------------------------------------
+
+class UnseededRandomRule(Rule):
+    """Decision-path randomness must come from a seeded, injectable
+    ``random.Random`` instance (or jax PRNG keys) — module-level
+    ``random.*`` / ``np.random.*`` draws share hidden global state no
+    replay can pin."""
+
+    id = "VT003"
+    name = "unseeded-random"
+    contract = ("unseeded module-level random/np.random draws in "
+                "scheduler/sim decision paths (PR 2 determinism)")
+    scope = RawClockRule.scope
+
+    RANDOM_FNS = {"random", "uniform", "choice", "choices", "randint",
+                  "randrange", "sample", "shuffle", "gauss", "betavariate",
+                  "expovariate", "triangular", "normalvariate",
+                  "vonmisesvariate", "paretovariate", "weibullvariate",
+                  "getrandbits", "seed"}
+    NP_SEEDED_OK = {"default_rng", "RandomState", "Generator",
+                    "SeedSequence"}
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = mod.resolve_call(node)
+            if resolved is None:
+                continue
+            parts = resolved.split(".")
+            msg = None
+            if parts[0] == "random" and len(parts) == 2 \
+                    and parts[1] in self.RANDOM_FNS:
+                msg = (f"module-level random.{parts[1]}() draws from the "
+                       f"hidden global RNG")
+            elif parts[0] == "numpy" and len(parts) >= 2 \
+                    and parts[1] == "random":
+                tail = parts[2] if len(parts) > 2 else ""
+                if tail in self.NP_SEEDED_OK:
+                    if node.args or node.keywords:
+                        continue        # np.random.default_rng(seed) etc.
+                    msg = (f"np.random.{tail}() without a seed is "
+                           f"entropy-seeded")
+                else:
+                    msg = (f"np.random.{tail or '<fn>'}() draws from the "
+                           f"numpy global RNG")
+            if msg is None:
+                continue
+            findings.append(self.finding(
+                mod, node,
+                f"{msg}; use an injectable seeded random.Random/"
+                f"np.random.Generator instance so decisions replay "
+                f"byte-identically (docs/simulation.md)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# VT004 — journaled bind/evict funnels (PR 4, docs/robustness.md)
+# ---------------------------------------------------------------------------
+
+class JournalFunnelRule(Rule):
+    """Bind/evict side effects may only execute through the journaled
+    funnels in cache/cache.py: the executor call must have a
+    ``_journal_intent`` record on its path (same function or one hop),
+    or a crash between the executor and the cache update is
+    unreconstructable — the double-bind class of bug the intent journal
+    closed."""
+
+    id = "VT004"
+    name = "journal-funnel"
+    contract = ("bind/evict executor invocation outside the journaled "
+                "funnels in cache/cache.py (PR 4 intent journal)")
+    # executors.py IS the executor layer; journal.py IS the journal (its
+    # reconciler replays already-journaled intents); chaos.py wraps
+    # executors to inject faults below the funnels on purpose
+    exclude = ("volcano_tpu/cache/executors.py",
+               "volcano_tpu/cache/journal.py", "volcano_tpu/chaos.py",
+               "volcano_tpu/analysis/")
+
+    EXECUTOR_ATTRS = {"binder", "evictor"}
+    EXECUTOR_METHODS = {"bind", "evict"}
+    WITNESS = {"_journal_intent"}
+
+    def _is_executor_call(self, node: ast.Call) -> Optional[str]:
+        if not isinstance(node.func, ast.Attribute) \
+                or node.func.attr not in self.EXECUTOR_METHODS:
+            return None
+        recv = dotted_name(node.func.value)
+        if recv is None:
+            return None
+        last = recv.split(".")[-1]
+        if last in self.EXECUTOR_ATTRS:
+            return f"{recv}.{node.func.attr}"
+        return None
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self._is_executor_call(node)
+            if target is None:
+                continue
+            fn = mod.enclosing_function(node.lineno)
+            if fn is not None and ctx.witness_in_scope(fn, self.WITNESS):
+                continue
+            where = fn.qualname if fn else "<module>"
+            findings.append(self.finding(
+                mod, node,
+                f"executor invocation {target}(...) in {where} without a "
+                f"_journal_intent record on the path; binds/evicts must "
+                f"flow through the journaled funnels in cache/cache.py "
+                f"(docs/robustness.md)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# VT005 — SimKill tunneling (PR 4, docs/robustness.md)
+# ---------------------------------------------------------------------------
+
+class SimKillSwallowRule(Rule):
+    """``SimKill(BaseException)`` models SIGKILL: it must tunnel through
+    every cycle-path handler. Handlers that catch BaseException (or are
+    bare) must re-raise; catching SimKill by name is reserved for the
+    sim's restart harness."""
+
+    id = "VT005"
+    name = "simkill-swallow"
+    contract = ("except-BaseException/bare-except in cycle code without "
+                "re-raise would swallow SimKill (PR 4 crash recovery)")
+    scope = ("volcano_tpu/scheduler.py", "volcano_tpu/framework/",
+             "volcano_tpu/actions/", "volcano_tpu/plugins/",
+             "volcano_tpu/cache/", "volcano_tpu/sim/",
+             "volcano_tpu/obs/")
+    # the restart harness IS the process boundary: it may catch SimKill
+    HARNESS_PATHS = ("volcano_tpu/sim/runner.py",)
+
+    BROAD = {"BaseException"}
+    KILL = {"SimKill"}
+
+    def _handler_types(self, h: ast.ExceptHandler) -> List[Optional[str]]:
+        if h.type is None:
+            return [None]
+        if isinstance(h.type, ast.Tuple):
+            return [dotted_name(e) for e in h.type.elts]
+        return [dotted_name(h.type)]
+
+    def _reraises(self, h: ast.ExceptHandler) -> bool:
+        for node in ast.walk(h):
+            if isinstance(node, ast.Raise):
+                if node.exc is None:
+                    return True
+                if isinstance(node.exc, ast.Name) and h.name \
+                        and node.exc.id == h.name:
+                    return True
+        return False
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                resolved = mod.resolve_call(node) or ""
+                if resolved.endswith("contextlib.suppress") or \
+                        resolved == "suppress":
+                    for arg in node.args:
+                        if (dotted_name(arg) or "").split(".")[-1] \
+                                in self.BROAD | self.KILL:
+                            findings.append(self.finding(
+                                mod, node,
+                                "contextlib.suppress over BaseException/"
+                                "SimKill swallows simulated process death "
+                                "(docs/robustness.md)"))
+                continue
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            types = self._handler_types(node)
+            names = {(t or "").split(".")[-1] for t in types}
+            broad = (None in types) or (names & self.BROAD)
+            kills = names & self.KILL
+            if kills and mod.path not in self.HARNESS_PATHS:
+                findings.append(self.finding(
+                    mod, node,
+                    "except SimKill outside the sim restart harness: a "
+                    "simulated SIGKILL must tunnel to the kill point "
+                    "(docs/robustness.md)"))
+                continue
+            if broad and not self._reraises(node):
+                what = "bare except:" if None in types \
+                    else "except BaseException"
+                findings.append(self.finding(
+                    mod, node,
+                    f"{what} without re-raise in cycle code would swallow "
+                    f"SimKill/KeyboardInterrupt; re-raise BaseExceptions "
+                    f"(docs/robustness.md)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# VT006 — pow2 shape bucketing (PR 3/4, docs/performance.md)
+# ---------------------------------------------------------------------------
+
+class ShapeBucketRule(Rule):
+    """Every jitted-solver invocation must route its data-dependent array
+    shapes through a pow2 bucketing/padding helper (``_bucket``,
+    ``_job_bucket``, ``_delta_bucket``, ``bucket_chunks``, ...) in the
+    function or one hop — an unbucketed axis mints a fresh XLA program
+    per distinct size, the multi-second churn recompile hole PR 4
+    closed."""
+
+    id = "VT006"
+    name = "shape-bucket"
+    contract = ("jit/shard_map entry points whose shape arguments skip "
+                "pow2 bucketing re-open the churn recompile hole (PR 4)")
+    scope = ("volcano_tpu/actions/", "volcano_tpu/ops/",
+             "volcano_tpu/parallel/", "volcano_tpu/cache/snapshot.py")
+
+    JIT_FACTORIES = {"jax.jit", "jit"}
+    BUCKET_HINT = "bucket"
+    BUCKET_EXTRA = {"padded_shape", "pow2"}
+
+    def _is_jit_factory_call(self, mod: ModuleInfo,
+                             node: ast.Call) -> bool:
+        resolved = mod.resolve_call(node)
+        return resolved in ("jax.jit",) or resolved == "jit"
+
+    def _jit_producers(self, ctx: AnalysisContext) -> Set[str]:
+        """Function names (package-wide) that return/cache a jax.jit
+        result — calling their return value launches a compiled
+        program."""
+        out: Set[str] = set()
+        for m in ctx.modules:
+            for fn in m.functions:
+                for node in ast.walk(fn.node):
+                    if isinstance(node, ast.Call) \
+                            and self._is_jit_factory_call(m, node):
+                        out.add(fn.name)
+        return out
+
+    def _has_bucket(self, fn: FunctionInfo) -> bool:
+        for name in fn.called_names:
+            if self.BUCKET_HINT in name or name in self.BUCKET_EXTRA:
+                return True
+        return False
+
+    def _module_jit_attrs(self, mod: ModuleInfo,
+                          producers: Set[str]) -> Set[str]:
+        """Attributes assigned from a jit factory/producer ANYWHERE in
+        the module (``self._solve = _job_solver()`` in __init__, invoked
+        from another method)."""
+        out: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                src = node.value
+                is_jit = self._is_jit_factory_call(mod, src) or (
+                    isinstance(src.func, ast.Name)
+                    and src.func.id in producers)
+                if not is_jit:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        out.add(tgt.attr)
+        return out
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        producers = self._jit_producers(ctx)
+        module_jit_attrs = self._module_jit_attrs(mod, producers)
+        findings: List[Finding] = []
+        for fn in mod.functions:
+            # names/attrs bound from a jit factory or producer inside fn,
+            # plus solver-valued parameters (the batched engines thread
+            # the compiled callable through helpers by argument)
+            jit_vars: Set[str] = set(module_jit_attrs)
+            for arg in ast.walk(getattr(fn.node, "args", ast.arguments(
+                    posonlyargs=[], args=[], kwonlyargs=[], kw_defaults=[],
+                    defaults=[]))):
+                if isinstance(arg, ast.arg) and "solver" in arg.arg:
+                    jit_vars.add(arg.arg)
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    src = node.value
+                    is_jit = self._is_jit_factory_call(mod, src) or (
+                        isinstance(src.func, ast.Name)
+                        and src.func.id in producers)
+                    if not is_jit:
+                        continue
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            jit_vars.add(tgt.id)
+                        elif isinstance(tgt, ast.Attribute):
+                            jit_vars.add(tgt.attr)
+            invocations: List[Tuple[ast.Call, str]] = []
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                # _job_solver()(...)  — calling a producer's return value
+                if isinstance(node.func, ast.Call) \
+                        and isinstance(node.func.func, ast.Name) \
+                        and node.func.func.id in producers:
+                    invocations.append((node, node.func.func.id + "()"))
+                # solver(...) where solver was bound from a producer/jit
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id in jit_vars:
+                    invocations.append((node, node.func.id))
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in jit_vars:
+                    invocations.append((node, node.func.attr))
+            if not invocations:
+                continue
+            if self._has_bucket(fn):
+                continue
+            if any(self._has_bucket(o) for o in ctx.graph.one_hop(fn)):
+                continue
+            node, desc = invocations[0]
+            findings.append(self.finding(
+                mod, node,
+                f"jitted solver invocation {desc}(...) in {fn.qualname} "
+                f"with no pow2 bucket/pad helper in the function or one "
+                f"hop; unbucketed shapes mint a fresh XLA compile per "
+                f"size (docs/performance.md)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# VT007 — lock discipline in shared-state modules (PR 5)
+# ---------------------------------------------------------------------------
+
+class LockDisciplineRule(Rule):
+    """native/, metrics/ and obs/trace.py are read and written from the
+    scheduler loop, watch/controller threads and the metrics HTTP server
+    at once: every write to shared state (self.* of a lock-owning class,
+    module globals of a lock-owning module) must happen under the lock,
+    in a ``*_locked`` helper, or in a function only ever called with the
+    lock held (one hop)."""
+
+    id = "VT007"
+    name = "lock-discipline"
+    contract = ("shared-state write outside a held lock in native/, "
+                "metrics/, obs/trace.py (PR 5 observability)")
+    scope = ("volcano_tpu/native/", "volcano_tpu/metrics/",
+             "volcano_tpu/obs/trace.py")
+
+    MUTATING_METHODS = {"append", "appendleft", "add", "pop", "popleft",
+                        "clear", "update", "setdefault", "remove",
+                        "extend", "discard", "insert"}
+    EXEMPT_FUNCS = {"__init__", "__new__", "__del__", "__enter__",
+                    "__exit__"}
+
+    @staticmethod
+    def _lock_names(mod: ModuleInfo) -> Tuple[Set[str], Set[str]]:
+        """(class-attr lock names, module-global lock names): anything
+        bound from threading.Lock/RLock or named *lock*."""
+        attr_locks: Set[str] = set()
+        global_locks: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            is_lock_val = isinstance(node.value, ast.Call) and \
+                (mod.resolve_call(node.value) or "").split(".")[-1] \
+                in ("Lock", "RLock")
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and (
+                        is_lock_val or "lock" in tgt.attr.lower()):
+                    # the name heuristic catches locks the value-shape
+                    # check cannot see (aliased factories, locks passed
+                    # in through a parameter) — without it their `with
+                    # self._x_lock:` guards are invisible and guarded
+                    # writes false-positive
+                    attr_locks.add(tgt.attr)
+                elif isinstance(tgt, ast.Name) and is_lock_val:
+                    global_locks.add(tgt.id)
+        return attr_locks, global_locks
+
+    def _under_lock(self, fn: FunctionInfo, node: ast.AST,
+                    locks: Set[str]) -> bool:
+        """Is ``node`` lexically inside a ``with <lock>:`` in ``fn``?"""
+        for w in ast.walk(fn.node):
+            if not isinstance(w, ast.With):
+                continue
+            held = False
+            for item in w.items:
+                d = dotted_name(item.context_expr) or ""
+                if d.split(".")[-1] in locks:
+                    held = True
+            if not held:
+                continue
+            if w.lineno <= node.lineno <= getattr(w, "end_lineno",
+                                                  w.lineno):
+                return True
+        return False
+
+    def _callers_hold_lock(self, fn: FunctionInfo, ctx: AnalysisContext,
+                           locks: Set[str]) -> bool:
+        callers = ctx.graph.callers_of(fn)
+        if not callers:
+            return False
+        for caller in callers:
+            held = False
+            for node in ast.walk(caller.node):
+                if isinstance(node, ast.Call) and (
+                        (isinstance(node.func, ast.Name)
+                         and node.func.id == fn.name)
+                        or (isinstance(node.func, ast.Attribute)
+                            and node.func.attr == fn.name)):
+                    if self._under_lock(caller, node, locks):
+                        held = True
+                    else:
+                        return False
+            if not held:
+                return False
+        return True
+
+    def _module_global_names(self, mod: ModuleInfo) -> Set[str]:
+        out: Set[str] = set()
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                out.add(node.target.id)
+        return out
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        attr_locks, global_locks = self._lock_names(mod)
+        locks = attr_locks | global_locks
+        if not locks:
+            return []
+        module_globals = self._module_global_names(mod)
+        # classes that own a lock (assign a lock attr in their methods)
+        lock_classes: Set[str] = set()
+        for fn in mod.functions:
+            if fn.cls is None:
+                continue
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and tgt.attr in attr_locks \
+                                and dotted_name(tgt.value) == "self":
+                            lock_classes.add(fn.cls)
+        findings: List[Finding] = []
+        for fn in mod.functions:
+            if fn.name in self.EXEMPT_FUNCS or fn.name.endswith("_locked"):
+                continue
+            writes: List[Tuple[ast.AST, str]] = []
+            for node in ast.walk(fn.node):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for tgt in targets:
+                        base = tgt
+                        while isinstance(base, ast.Subscript):
+                            base = base.value
+                        if isinstance(base, ast.Attribute) \
+                                and dotted_name(base.value) == "self" \
+                                and fn.cls in lock_classes \
+                                and base.attr not in attr_locks:
+                            writes.append((node, f"self.{base.attr}"))
+                        elif isinstance(base, ast.Name) \
+                                and base.id in module_globals \
+                                and global_locks \
+                                and self._declared_global(fn, base.id):
+                            writes.append((node, base.id))
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in self.MUTATING_METHODS:
+                    recv = node.func.value
+                    while isinstance(recv, ast.Subscript):
+                        recv = recv.value
+                    d = dotted_name(recv) or ""
+                    parts = d.split(".")
+                    if parts[0] == "self" and len(parts) == 2 \
+                            and fn.cls in lock_classes:
+                        writes.append((node, d))
+                    elif len(parts) == 1 and parts[0] in module_globals \
+                            and global_locks:
+                        writes.append((node, d))
+            unguarded = [(n, d) for n, d in writes
+                         if not self._under_lock(fn, n, locks)]
+            if not unguarded:
+                continue
+            if self._callers_hold_lock(fn, ctx, locks):
+                continue
+            node, desc = unguarded[0]
+            findings.append(self.finding(
+                mod, node,
+                f"write to shared state {desc} in {fn.qualname} outside a "
+                f"held lock; guard it, rename the helper *_locked, or "
+                f"call it only under the lock (docs/observability.md)"))
+        return findings
+
+    @staticmethod
+    def _declared_global(fn: FunctionInfo, name: str) -> bool:
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Global) and name in node.names:
+                return True
+        return False
+
+
+ALL_RULES: List[Rule] = [
+    DirtyWitnessRule(), RawClockRule(), UnseededRandomRule(),
+    JournalFunnelRule(), SimKillSwallowRule(), ShapeBucketRule(),
+    LockDisciplineRule(),
+]
+
+
+def rule_by_id(rule_id: str) -> Optional[Rule]:
+    for rule in ALL_RULES:
+        if rule.id == rule_id:
+            return rule
+    return None

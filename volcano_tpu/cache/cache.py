@@ -116,8 +116,14 @@ class SchedulerCache:
                  default_queue: str = "default",
                  resync_max_retries: Optional[int]
                  = DEFAULT_RESYNC_MAX_RETRIES,
-                 journal: Optional[IntentJournal] = None):
+                 journal: Optional[IntentJournal] = None,
+                 time_fn=time.time):
         self._lock = threading.RLock()
+        # injectable wall-clock source (vlint VT002): stamps
+        # schedule_start_timestamp on ingested jobs; the simulator pins
+        # it to its virtual clock (like resync_queue.time_fn) so queueing
+        # -delay metrics are deterministic under replay
+        self.time_fn = time_fn
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
         self.queues: Dict[str, QueueInfo] = {}
@@ -259,7 +265,7 @@ class SchedulerCache:
     def add_job(self, job: JobInfo) -> None:
         with self._lock:
             if job.schedule_start_timestamp is None:
-                job.schedule_start_timestamp = time.time()
+                job.schedule_start_timestamp = self.time_fn()
             self.jobs[job.uid] = job
             self._dirty_jobs.add(job.uid)
 

@@ -79,7 +79,8 @@ def _gc_resume(window: "_GCWindow" = None) -> None:
 
 
 def open_session(cache, tiers: List[Tier],
-                 configurations: List[Configuration] = ()) -> Session:
+                 configurations: List[Configuration] = (),
+                 time_fn=None) -> Session:
     # Automatic (threshold-triggered) garbage collection is suspended for
     # the lifetime of the session: a cycle at 10k pods allocates enough
     # tracked objects (Resources, task clones, statement entries) to trip
@@ -98,7 +99,8 @@ def open_session(cache, tiers: List[Tier],
     window = _gc_suspend()
     try:
         with obs_trace.span("snapshot"):
-            ssn = Session(cache, tiers, list(configurations))
+            ssn = Session(cache, tiers, list(configurations),
+                          time_fn=time_fn)
         for tier in tiers:
             for opt in tier.plugins:
                 builder = get_plugin_builder(opt.name)

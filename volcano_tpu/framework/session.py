@@ -57,11 +57,22 @@ class EventHandler:
 
 class Session:
     def __init__(self, cache, tiers: List[Tier],
-                 configurations: List[Configuration]):
+                 configurations: List[Configuration],
+                 time_fn: Optional[Callable[[], float]] = None):
         self.uid = str(uuid.uuid4())
         self.cache = cache
         self.tiers = tiers
         self.configurations = configurations
+        # Injectable session clock (vlint VT002, docs/simulation.md):
+        # plugin decision callbacks (sla deadlines, tdm zone windows, gang
+        # condition timestamps) read "now" through ssn.now() instead of
+        # the wall clock, so the scheduler shell can pin it to its clock
+        # (WallClock.now in production, the sim's VirtualClock under
+        # replay) and decisions stay byte-deterministic. The default is a
+        # wall-time reference for sessions opened outside a shell
+        # (tests, bench one-offs).
+        import time as _time
+        self._time_fn: Callable[[], float] = time_fn or _time.time
 
         snapshot: ClusterInfo = cache.snapshot()
         self.jobs: Dict[str, JobInfo] = snapshot.jobs
@@ -120,6 +131,14 @@ class Session:
         # tuples appended by dispatch/evict/statement commits, harvested by
         # the scheduler shell after close_session
         self.audit_events: list = []
+
+    def now(self) -> float:
+        """The session's time source — wall seconds in production,
+        virtual seconds under sim replay. Decision callbacks MUST read
+        time through this (vlint VT002) so replays are deterministic;
+        the timebase matches job creation_timestamps (wall via the api
+        defaults live, virtual via the trace in the sim)."""
+        return self._time_fn()
 
     # -- registration helpers (AddXxxFn of session_plugins.go) --------------
 

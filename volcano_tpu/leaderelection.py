@@ -51,7 +51,20 @@ class LeaderElector:
                  namespace: str = "volcano-system",
                  lease_duration: float = DEFAULT_LEASE_DURATION,
                  renew_deadline: float = DEFAULT_RENEW_DEADLINE,
-                 retry_period: float = DEFAULT_RETRY_PERIOD):
+                 retry_period: float = DEFAULT_RETRY_PERIOD,
+                 time_fn: Callable[[], float] = time.time,
+                 mono_fn: Callable[[], float] = time.monotonic):
+        # Injectable time sources (vlint VT002). Lease timestamps are
+        # wall-clock (``time_fn``) — they are compared ACROSS processes
+        # (native store / RPC shim replicas), where a per-process
+        # monotonic clock is meaningless. The renew-deadline watchdog is
+        # the opposite: a PER-PROCESS elapsed interval, so it reads
+        # ``mono_fn`` — measuring it on the wall clock would let an NTP
+        # step backward mask lease loss (split brain) or a step forward
+        # depose a healthy leader. A federated sim pins both to its
+        # virtual clock to elect deterministically.
+        self.time_fn = time_fn
+        self.mono_fn = mono_fn
         self.store = store
         self.name = name
         self.namespace = namespace
@@ -75,10 +88,11 @@ class LeaderElector:
         read, so two challengers racing on an expired lease cannot both
         win — the loser's update conflicts and it returns False.
 
-        Timestamps are wall-clock (``time.time()``): leases are compared
-        across processes (native store / RPC shim replicas), where a
-        per-process monotonic clock is meaningless."""
-        now = time.time() if now is None else now
+        Timestamps come from the elector's injectable ``time_fn``
+        (wall-clock by default): leases are compared across processes
+        (native store / RPC shim replicas), where a per-process
+        monotonic clock is meaningless."""
+        now = self.time_fn() if now is None else now
         from .store import ConflictError
         lease = self._lease()
         if lease is None:
@@ -145,14 +159,14 @@ class LeaderElector:
                 self.on_stopped_leading()
 
     def _renew_loop(self) -> None:
-        last_renew = time.monotonic()
+        last_renew = self.mono_fn()
         while not self._stop.is_set():
             self._stop.wait(self.retry_period)
             if self._stop.is_set():
                 return
             if self.try_acquire_or_renew():
-                last_renew = time.monotonic()
-            elif time.monotonic() - last_renew > self.renew_deadline:
+                last_renew = self.mono_fn()
+            elif self.mono_fn() - last_renew > self.renew_deadline:
                 # lost the lease: stop leading (RunOrDie klog.Fatal analogue
                 # — here we signal the component loop to stop instead)
                 self.leading = False

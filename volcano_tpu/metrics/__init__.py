@@ -519,8 +519,14 @@ def solver_trace(name: str):
     import jax
     trace_dir = os.environ.get("VOLCANO_TPU_JAX_PROFILE_DIR")
     global _trace_started
-    if trace_dir and not _trace_started:
-        _trace_started = True
+    start = False
+    with _lock:
+        # check-and-set under the module lock (vlint VT007): two threads'
+        # first annotated solves must not both start a capture
+        if trace_dir and not _trace_started:
+            _trace_started = True
+            start = True
+    if start:
         import atexit
         jax.profiler.start_trace(trace_dir)
         atexit.register(jax.profiler.stop_trace)

@@ -139,10 +139,11 @@ class NativeObjectStore:
     def record_event(self, kind: str, namespace: str, name: str,
                      etype: str, reason: str, message: str) -> None:
         import time as _time
-        self.events.append({
-            "kind": kind, "namespace": namespace, "name": name,
-            "type": etype, "reason": reason, "message": message,
-            "time": _time.time()})
+        with self._dispatch_lock:
+            self.events.append({
+                "kind": kind, "namespace": namespace, "name": name,
+                "type": etype, "reason": reason, "message": message,
+                "time": _time.time()})
 
     def events_for(self, kind: str, namespace: str, name: str):
         return [e for e in self.events
@@ -158,7 +159,8 @@ class NativeObjectStore:
     # -- admission (webhook-manager analogue) -------------------------------
 
     def register_admission_hook(self, hook: Callable) -> None:
-        self._admission_hooks.append(hook)
+        with self._dispatch_lock:
+            self._admission_hooks.append(hook)
 
     def _admit(self, operation: str, kind: str, obj, old=None):
         for hook in self._admission_hooks:

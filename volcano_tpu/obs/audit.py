@@ -154,7 +154,8 @@ class AuditLog:
             return len(self._cycles)
 
 
-def harvest_cycle(ssn, cycle: int, t: float, log: "AuditLog" = None) -> int:
+def harvest_cycle(ssn, cycle: int, t: float,
+                  log: Optional["AuditLog"] = None) -> int:
     """Build the cycle's decision records from the closed session and feed
     the ring. Called by ``Scheduler.run_once`` AFTER ``close_session`` (so
     the gang plugin's ``job_fit_errors`` writeback has run), outside the

@@ -91,10 +91,12 @@ class TraceRecorder:
         return self._recording
 
     def enable(self) -> None:
-        self._recording = True
+        with self._lock:
+            self._recording = True
 
     def disable(self) -> None:
-        self._recording = False
+        with self._lock:
+            self._recording = False
 
     def configure(self, max_cycles: Optional[int] = None,
                   logical: Optional[bool] = None, time_fn=None) -> None:

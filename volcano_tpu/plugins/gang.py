@@ -9,8 +9,6 @@ PodGroup condition writeback.
 
 from __future__ import annotations
 
-import time
-
 from .. import metrics
 from ..api import PodGroupConditionType, TaskStatus
 from ..framework.session import ABSTAIN, PERMIT, REJECT, ValidateResult
@@ -89,7 +87,7 @@ class GangPlugin(Plugin):
                     "transitionID": ssn.uid,
                     "reason": NOT_ENOUGH_RESOURCES,
                     "message": msg,
-                    "lastTransitionTime": time.time(),
+                    "lastTransitionTime": ssn.now(),
                 })
             else:
                 ssn.update_pod_group_condition(job, {
@@ -98,7 +96,7 @@ class GangPlugin(Plugin):
                     "transitionID": ssn.uid,
                     "reason": "tasks in gang are ready to be scheduled",
                     "message": "",
-                    "lastTransitionTime": time.time(),
+                    "lastTransitionTime": ssn.now(),
                 })
         for _ in range(unschedulable_jobs):
             metrics.register_unschedule_job()

@@ -7,7 +7,6 @@ Mirrors /root/reference/pkg/scheduler/plugins/sla/sla.go:60-150.
 from __future__ import annotations
 
 import re
-import time
 from typing import Optional
 
 from ..framework.session import ABSTAIN, PERMIT
@@ -72,7 +71,10 @@ class SLAPlugin(Plugin):
             jwt = self._jwt(job)
             if jwt is None:
                 return ABSTAIN
-            if time.time() - job.creation_timestamp < jwt:
+            # session clock (vlint VT002): wall time in production,
+            # virtual time under sim replay — same timebase as
+            # job.creation_timestamp in both worlds
+            if ssn.now() - job.creation_timestamp < jwt:
                 return ABSTAIN
             return PERMIT
 

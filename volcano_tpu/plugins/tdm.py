@@ -6,8 +6,8 @@ Mirrors /root/reference/pkg/scheduler/plugins/tdm/tdm.go:58-372.
 
 from __future__ import annotations
 
-import time as _time
-from datetime import datetime, timedelta
+import weakref
+from datetime import datetime, timedelta, timezone
 from typing import Dict, List, Optional
 
 from ..api import TaskStatus
@@ -19,21 +19,20 @@ EVICT_PERIOD_ARG = "tdm.evict.period"
 MAX_NODE_SCORE = 100.0
 DEFAULT_POD_EVICT_NUM = 1
 
-_last_evict_at = 0.0
-
 
 def _parse_hhmm(text: str):
     h, m = text.strip().split(":")
     return int(h), int(m)
 
 
-def parse_revocable_zone(raw: str):
-    """'10:00-21:00' -> (start, end) datetimes today (end rolls to tomorrow
-    when end <= start) (tdm.go:89-117)."""
+def parse_revocable_zone(raw: str, now: datetime):
+    """'10:00-21:00' -> (start, end) datetimes on ``now``'s day (end rolls
+    to tomorrow when end <= start) (tdm.go:89-117). ``now`` comes from the
+    session clock (vlint VT002) so zone decisions replay deterministically
+    under the sim's virtual time."""
     lo, hi = raw.strip().split("-")
     h1, m1 = _parse_hhmm(lo)
     h2, m2 = _parse_hhmm(hi)
-    now = datetime.now()
     start = now.replace(hour=h1, minute=m1, second=0, microsecond=0)
     end = now.replace(hour=h2, minute=m2, second=0, microsecond=0)
     if (h1, m1) >= (h2, m2):
@@ -54,6 +53,15 @@ def _parse_int_or_percent(text: str, total: int) -> int:
 class TDMPlugin(Plugin):
     NAME = "tdm"
 
+    # Last periodic-drain timestamp per scheduler cache, in the session
+    # clock's timebase. Plugins are REBUILT from New() on every
+    # open_session (framework.open_session), so throttle state on the
+    # instance would reset each cycle and the drain would run every
+    # cycle; keying by the cache keeps concurrent schedulers independent
+    # (the pre-PR-6 module-level global shared them) and the weakref
+    # lets a torn-down scheduler's entry collect.
+    _last_evict_at: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
     def __init__(self, arguments=None):
         super().__init__(arguments)
         self.revocable_zone: Dict[str, str] = {}
@@ -64,19 +72,29 @@ class TDMPlugin(Plugin):
         self.evict_period = parse_duration(
             self.arguments.get(EVICT_PERIOD_ARG, "")) or 60.0
 
-    def _zone_active(self, rz: str) -> Optional[str]:
-        """None if the zone is currently active, else an error string."""
+    def _zone_active(self, rz: str, now: datetime) -> Optional[str]:
+        """None if the zone is active at ``now``, else an error string.
+        ``now`` is the session clock's datetime (_session_now)."""
         raw = self.revocable_zone.get(rz)
         if raw is None:
             return f"revocable zone {rz} not support"
         try:
-            start, end = parse_revocable_zone(raw)
+            start, end = parse_revocable_zone(raw, now)
         except ValueError:
             return f"revocable zone {raw} format error"
-        now = datetime.now()
         if now < start or now > end:
             return f"current time beyond revocable zone {rz}:{raw}"
         return None
+
+    @staticmethod
+    def _session_now(ssn) -> datetime:
+        """The session clock as a UTC datetime: wall time live, virtual
+        seconds (anchored at the epoch) under sim replay — either way
+        the zone verdict is a pure function of the session's clock.
+        Zone windows ('10:00-21:00') are interpreted in UTC: a local-tz
+        conversion here would make the same trace replay to different
+        eviction decisions on hosts in different timezones."""
+        return datetime.fromtimestamp(ssn.now(), tz=timezone.utc)
 
     def _max_victims(self, job, victims: List) -> List:
         return victims[: min(self._max_evict_num(job), len(victims))]
@@ -105,7 +123,8 @@ class TDMPlugin(Plugin):
         def predicate(task, node):
             if not node.revocable_zone:
                 return
-            err = self._zone_active(node.revocable_zone)
+            err = self._zone_active(node.revocable_zone,
+                                    self._session_now(ssn))
             if err:
                 raise ValueError(f"plugin {self.NAME} predicates {err}")
             if not task.revocable_zone:
@@ -122,10 +141,11 @@ class TDMPlugin(Plugin):
             if not any(n.revocable_zone for n in node_infos):
                 return None
             mask = np.ones((len(tasks), len(node_infos)), dtype=bool)
+            now = self._session_now(ssn_)
             for ni, node in enumerate(node_infos):
                 if not node.revocable_zone:
                     continue
-                active = self._zone_active(node.revocable_zone) is None
+                active = self._zone_active(node.revocable_zone, now) is None
                 for ti, task in enumerate(tasks):
                     mask[ti, ni] = active and bool(task.revocable_zone)
             return mask
@@ -135,7 +155,8 @@ class TDMPlugin(Plugin):
         def node_order(task, node) -> float:
             if not node.revocable_zone:
                 return 0.0
-            if self._zone_active(node.revocable_zone):
+            if self._zone_active(node.revocable_zone,
+                                 self._session_now(ssn)):
                 return 0.0
             if not task.revocable_zone:
                 return 0.0
@@ -168,12 +189,13 @@ class TDMPlugin(Plugin):
         def victims_fn():
             """Periodic drain of preemptable tasks on inactive revocable
             nodes (tdm.go:232-260)."""
-            global _last_evict_at
-            if _last_evict_at + self.evict_period > _time.time():
+            last = self._last_evict_at.get(ssn.cache, 0.0)
+            if last + self.evict_period > ssn.now():
                 return None
+            now = self._session_now(ssn)
             victims = []
             for rz in self.revocable_zone:
-                if self._zone_active(rz) is None:
+                if self._zone_active(rz, now) is None:
                     continue
                 tasks_map: Dict[str, List] = {}
                 for node in ssn.nodes.values():
@@ -186,7 +208,7 @@ class TDMPlugin(Plugin):
                     job = ssn.jobs.get(job_id)
                     if job is not None:
                         victims.extend(self._max_victims(job, tasks))
-            _last_evict_at = _time.time()
+            self._last_evict_at[ssn.cache] = ssn.now()
             return victims
 
         ssn.add_victim_tasks_fn(self.NAME, victims_fn)

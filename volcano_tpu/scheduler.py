@@ -73,6 +73,14 @@ class WallClock:
     def time(self) -> float:
         return time.monotonic()
 
+    def now(self) -> float:
+        """Wall-clock seconds since the epoch — the timebase shared with
+        job creation_timestamps and cross-process lease records. time()
+        stays monotonic for pacing/interval math; now() is for
+        timestamps that are compared against externally-sourced ones.
+        The sim's VirtualClock serves both from virtual time."""
+        return time.time()
+
     def sleep(self, seconds: float) -> None:
         self._stop.wait(seconds)
 
@@ -85,7 +93,8 @@ class Scheduler:
                  backoff_max: float = DEFAULT_BACKOFF_MAX,
                  backoff_jitter: float = DEFAULT_BACKOFF_JITTER,
                  clock=None,
-                 drift_verify_every: Optional[int] = None):
+                 drift_verify_every: Optional[int] = None,
+                 rng: Optional[random.Random] = None):
         # actions/plugins register on import
         from . import actions as _actions  # noqa: F401
         from . import plugins as _plugins  # noqa: F401
@@ -101,6 +110,13 @@ class Scheduler:
         # sim's VirtualClock under trace replay — run()'s period pacing
         # and crash-loop backoff go through it instead of time.sleep
         self.clock = clock or WallClock(self._stop)
+        # Injectable RNG for crash-loop backoff jitter (vlint VT003).
+        # Production wants per-process entropy (a fleet crash-looping on
+        # the same poison input must not retry in lockstep), so the
+        # default instance is entropy-seeded; the sim passes a
+        # random.Random(seed) so failed-cycle backoff advances virtual
+        # time deterministically.
+        self._rng = rng if rng is not None else random.Random()
         self.conf: SchedulerConfiguration = None
         # pre-action hook (name, session) -> None; raising makes the action
         # count as failed. The chaos harness's ActionFaultInjector plugs in
@@ -192,7 +208,8 @@ class Scheduler:
         with sched_sp:
             with rec.span("open_session"):
                 ssn = open_session(self.cache, self.conf.tiers,
-                                   self.conf.configurations)
+                                   self.conf.configurations,
+                                   time_fn=self.clock.now)
             try:
                 for name, action in runnable:
                     action_sp = rec.span("action:" + name, action=name)
@@ -303,7 +320,7 @@ class Scheduler:
         failure count (>= 1), capped at ``cap``."""
         n = max(self.consecutive_failures, 1)
         delay = min(self.backoff_base * (2 ** (n - 1)), cap)
-        return delay * (1.0 + random.uniform(0.0, self.backoff_jitter))
+        return delay * (1.0 + self._rng.uniform(0.0, self.backoff_jitter))
 
     def run(self) -> None:
         """wait.Until(runOnce, period) (scheduler.go:81-88), with the
@@ -378,7 +395,8 @@ class Scheduler:
             return 0
         from .actions.allocate import prewarm_shapes
         ssn = open_session(self.cache, self.conf.tiers,
-                           self.conf.configurations)
+                           self.conf.configurations,
+                           time_fn=self.clock.now)
         try:
             return prewarm_shapes(ssn, configs, engine)
         finally:

@@ -90,6 +90,11 @@ class VirtualClock:
     def time(self) -> float:
         return self._now
 
+    def now(self) -> float:
+        """Session/timestamp timebase (WallClock.now counterpart): in the
+        sim both pacing and timestamps live on the one virtual axis."""
+        return self._now
+
     def sleep(self, seconds: float) -> None:
         if seconds > 0:
             self._now += seconds
@@ -145,6 +150,9 @@ class SimRunner:
         # re-attempt lands on a deterministic virtual cycle, not whenever
         # the host happens to get there
         self.cache.resync_queue.time_fn = self.clock.time
+        # job ingestion timestamps (schedule_start_timestamp) pin to
+        # virtual time with the same injection
+        self.cache.time_fn = self.clock.time
         # ...and so does the device cool-down window, so a composed
         # DeviceFaultInjector re-probes on a deterministic virtual cycle
         # instead of wherever the host's wall clock lands
@@ -152,7 +160,8 @@ class SimRunner:
         DEVICE_HEALTH.reset(time_fn=self.clock.time)
         self.conf_text = conf_text if conf_text is not None else SIM_CONF
         self.sched = Scheduler(self.cache, conf_text=self.conf_text,
-                               schedule_period=period, clock=self.clock)
+                               schedule_period=period, clock=self.clock,
+                               rng=random.Random(seed))
 
         # decision-plane bookkeeping
         self.arrival_time: Dict[str, float] = {}
@@ -415,7 +424,8 @@ class SimRunner:
         c._tensor_dirty = set()
         self.sched = Scheduler(self.cache, conf_text=self.conf_text,
                                schedule_period=self.period,
-                               clock=self.clock)
+                               clock=self.clock,
+                               rng=random.Random(self.seed))
         # a process death also resets the device cool-down state machine
         # (it lives in process memory) — and its clock stays virtual
         from ..device_health import DEVICE_HEALTH

@@ -74,13 +74,19 @@ def prioritize_nodes(task: TaskInfo, nodes: List[NodeInfo],
 
 
 def select_best_node(node_scores: Dict[float, List[NodeInfo]],
-                     deterministic: bool = True) -> Optional[NodeInfo]:
+                     deterministic: bool = True,
+                     rng: Optional[random.Random] = None
+                     ) -> Optional[NodeInfo]:
     """SelectBestNode (scheduler_helper.go:210-225). The reference picks a
     random node among the max-score group; we default to the first (lowest
-    index) for reproducibility, with the random behavior available."""
+    index) for reproducibility. The random behavior requires the caller to
+    pass its own seeded ``rng`` (vlint VT003) — without one the pick stays
+    deterministic rather than drawing from the hidden global RNG."""
     if not node_scores:
         return None
     best = node_scores[max(node_scores)]
     if not best:
         return None
-    return best[0] if deterministic else random.choice(best)
+    if deterministic or rng is None:
+        return best[0]
+    return rng.choice(best)
