@@ -17,13 +17,15 @@ run_sim=true
 run_soak=true
 run_obs=true
 run_lint=true
+run_ha=true
 case "${1:-}" in
-  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false ;;
-  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false ;;
-  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false ;;
-  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false ;;
-  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false ;;
-  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false ;;
+  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false ;;
+  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false ;;
+  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false ;;
+  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false ;;
+  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false ;;
+  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false ;;
+  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false ;;
 esac
 
 if $run_lint; then
@@ -131,6 +133,38 @@ byte-reproducible"; exit 1; }
   JAX_PLATFORMS=cpu python -m volcano_tpu.obs.validate --metrics-scrape \
     || { echo "observability FAILED: /metrics scrape/parse"; exit 1; }
   echo "   trace schema valid, byte-reproducible; /metrics parses both paths"
+fi
+
+if $run_ha; then
+  # ha-soak (docs/robustness.md HA section): 3 replica schedulers over
+  # one virtual cluster. (a) 4 seeded leader kills at adversarial points
+  # plus one mid-cycle lease loss must converge with ZERO double-binds
+  # and every job completed (--verify-ha-equivalence compares terminal
+  # accounting against the single-scheduler oracle and fails on any
+  # double-bind), (b) the killed run's decision plane must be
+  # byte-deterministic across two runs, and (c) a NON-contended --ha 3
+  # run must be byte-identical to the single-scheduler oracle's decision
+  # plane.
+  echo "== ha-soak: sim --ha 3, seeded leader kills + lease loss =="
+  hadir=$(mktemp -d)
+  trap 'rm -rf "${simdir:-/nonexistent}" "${soakdir:-/nonexistent}" \
+"${obsdir:-/nonexistent}" "${hadir:-/nonexistent}"' EXIT
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario smoke --seed 3 \
+    --ha 3 --kill-cycles 2,5,9,13 --kill-seed 2 --lease-loss-cycles 7 \
+    --verify-ha-equivalence --deterministic > "$hadir/ha.a.json" \
+    || { echo "ha-soak FAILED: killed HA run diverged or double-bound"; \
+         exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario smoke --seed 3 \
+    --ha 3 --kill-cycles 2,5,9,13 --kill-seed 2 --lease-loss-cycles 7 \
+    --deterministic > "$hadir/ha.b.json"
+  diff "$hadir/ha.a.json" "$hadir/ha.b.json" \
+    || { echo "ha-soak FAILED: killed HA run not byte-deterministic"; \
+         exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario smoke --seed 3 \
+    --ha 3 --verify-ha-equivalence --deterministic > /dev/null \
+    || { echo "ha-soak FAILED: non-contended HA decision plane differs \
+from the single-scheduler oracle"; exit 1; }
+  echo "   ha-soak: zero double-binds, byte-deterministic x2, oracle-equal"
 fi
 
 if $run_shim; then

@@ -210,7 +210,9 @@ class SchedulerCache:
 
 def test_vt004_trigger_and_clean():
     f, _ = findings_of({"volcano_tpu/actions/a.py": VT004_TRIGGER})
-    assert rule_ids(f) == ["VT004"]
+    # a bare executor call misses BOTH the journal funnel (VT004) and
+    # the fencing-epoch stamp (VT008)
+    assert rule_ids(f) == ["VT004", "VT008"]
     f, _ = findings_of({"volcano_tpu/cache/cache.py": VT004_CLEAN})
     assert "VT004" not in rule_ids(f)
 
@@ -233,6 +235,54 @@ class SchedulerCache:
 def test_vt004_executor_layer_exempt():
     f, _ = findings_of({"volcano_tpu/chaos.py": VT004_TRIGGER})
     assert f == []
+
+
+VT008_TRIGGER = '''
+class SchedulerCache:
+    def bind(self, task):
+        seq = self._journal_intent("bind", task, task.node_name)
+        self.binder.bind(task, task.node_name)
+        self._journal_ack(seq, True)
+
+    def _journal_intent(self, op, task, node):
+        return self.journal.record_intent(op, task, node)
+'''
+
+VT008_CLEAN = '''
+class SchedulerCache:
+    def fencing_epoch(self):
+        return self.fencing_epoch_fn()
+
+    def _journal_intent(self, op, task, node):
+        epoch = self.fencing_epoch()
+        return self.journal.record_intent(op, task, node, epoch=epoch)
+
+    def bind(self, task):
+        seq = self._journal_intent("bind", task, task.node_name)
+        self.binder.bind(task, task.node_name)
+        self._journal_ack(seq, True)
+'''
+
+
+def test_vt008_trigger_and_clean():
+    """A journaled funnel whose intent path never reads the fencing
+    epoch fires VT008 (and ONLY VT008 — the journal witness satisfies
+    VT004: the two rules separate cleanly); stamping through the
+    one-hop funnel is clean."""
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": VT008_TRIGGER})
+    assert rule_ids(f) == ["VT008"]
+    assert any(x.symbol == "SchedulerCache.bind" for x in f)
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": VT008_CLEAN})
+    assert "VT008" not in rule_ids(f)
+
+
+def test_vt008_exempt_layers():
+    """The executor layer, the journal reconciler and the chaos wrappers
+    invoke executors below the funnels by design — exempt, like VT004."""
+    for path in ("volcano_tpu/cache/executors.py",
+                 "volcano_tpu/cache/journal.py", "volcano_tpu/chaos.py"):
+        f, _ = findings_of({path: VT008_TRIGGER})
+        assert "VT008" not in rule_ids(f), path
 
 
 VT005_TRIGGER = '''
@@ -492,7 +542,7 @@ def test_rule_catalog_complete():
     ids = [r.id for r in ALL_RULES]
     assert ids == sorted(ids) and len(ids) == len(set(ids))
     assert {"VT001", "VT002", "VT003", "VT004", "VT005", "VT006",
-            "VT007"} <= set(ids)
+            "VT007", "VT008"} <= set(ids)
     for r in ALL_RULES:
         assert r.contract and r.name
     assert rule_by_id("VT001") is not None
@@ -562,6 +612,25 @@ def test_rebreak_evict_retry_node_mirror_vt001():
     assert any(x.rule == "VT001"
                and x.symbol == "SchedulerCache.process_resync_tasks"
                and "mirror" in x.message for x in f)
+
+
+def test_rebreak_unstamped_fencing_epoch_vt008():
+    """PR 7's fencing contract: dropping the fencing-epoch read from the
+    journal funnel leaves every executor-effecting call unordered
+    against leaderships — a deposed leader's write would be
+    indistinguishable from the live leader's. The unmutated source must
+    be clean; the reverted one must flag the funnels."""
+    src = real_source("volcano_tpu/cache/cache.py")
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": src})
+    assert "VT008" not in rule_ids(f)
+    broken = mutate(src,
+                    "        epoch = self.fencing_epoch()\n",
+                    "        epoch = 0\n")
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": broken})
+    assert any(x.rule == "VT008" and x.symbol == "SchedulerCache.bind"
+               for x in f)
+    assert any(x.rule == "VT008" and x.symbol == "SchedulerCache.evict"
+               for x in f)
 
 
 def test_rebreak_unjournaled_evict_vt004():
@@ -644,26 +713,33 @@ def test_rebreak_unbucketed_job_axis_vt006():
     assert any(x.rule == "VT006" for x in f)
 
 
-def test_known_preempt_walk_exposure_vt006_is_baselined():
-    """The preempt walk's unbucketed (preemptor, victim-slot) axes are a
-    REAL finding (same defect class), deliberately baselined with a
-    justification — assert the rule sees it and the baseline carries a
-    justification for exactly it."""
+def test_preempt_walk_bucketing_vt006_fixed_and_rebreaks():
+    """The formerly-baselined preempt-walk exposure is FIXED: the walk's
+    (preemptor, victim-slot) axes now pow2-bucket
+    (evict_tpu._ptask_bucket/_slot_bucket), the real file pair is clean,
+    the baseline no longer carries the entry — and stripping the bucket
+    helpers re-breaks it (the rule still guards the contract)."""
     # the jit producers (build_preempt_walk*) live in ops/evict.py — the
     # cross-module producer index needs both files, like a real run has
+    src = real_source("volcano_tpu/actions/evict_tpu.py")
     f, _ = findings_of({
-        "volcano_tpu/actions/evict_tpu.py":
-            real_source("volcano_tpu/actions/evict_tpu.py"),
+        "volcano_tpu/actions/evict_tpu.py": src,
         "volcano_tpu/ops/evict.py":
             real_source("volcano_tpu/ops/evict.py")})
-    hits = [x for x in f if x.rule == "VT006"
-            and x.symbol == "_preempt_phase"]
-    assert hits, "the known preempt-walk exposure disappeared: either it "\
-                 "was fixed (remove the baseline entry) or VT006 regressed"
+    assert not [x for x in f if x.rule == "VT006"
+                and x.symbol == "_preempt_phase"]
     baseline = load_baseline(os.path.join(REPO, "vlint-baseline.json"))
-    assert baseline.match(hits[0])
-    entry = baseline.entries[hits[0].key()]
-    assert len(entry["justification"]) > 40
+    assert not baseline.entries, \
+        "the preempt-walk VT006 entry was fixed; the baseline must be empty"
+    broken = src.replace("_ptask_bucket(", "int(") \
+        .replace("_slot_bucket(", "int(")
+    assert broken != src
+    f, _ = findings_of({
+        "volcano_tpu/actions/evict_tpu.py": broken,
+        "volcano_tpu/ops/evict.py":
+            real_source("volcano_tpu/ops/evict.py")})
+    assert any(x.rule == "VT006" and x.symbol == "_preempt_phase"
+               for x in f)
 
 
 def test_rebreak_unlocked_native_event_write_vt007():

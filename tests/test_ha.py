@@ -1,0 +1,477 @@
+"""HA control plane (docs/robustness.md): fenced leader failover.
+
+Covers the fencing-epoch protocol end to end — monotonic epoch minting
+at the elector, intent stamping in the journal, stale-epoch rejection at
+the executor gate (the split-brain regression the acceptance criterion
+names) — the scheduler shell's role state machine (standby never opens a
+session; a leader demoted mid-cycle abandons the open session instead of
+half-applying it; a fenced ex-leader's queued binds are rejected and
+counted), warm-standby journal replay over both transports (in-memory
+subscription and file tail), and the ``sim --ha N`` acceptance slice:
+seeded leader kills at adversarial points -> zero double-binds, bounded
+failover, byte-determinism, and decision-plane equivalence to the
+single-scheduler oracle on a non-contended trace.
+"""
+
+import gc
+import json
+
+import pytest
+
+from volcano_tpu import metrics
+from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
+                             Resource, TaskInfo, TaskStatus)
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.cache.executors import (FakeStatusUpdater, FencedBinder,
+                                         FencedError, FencedEvictor,
+                                         FencingAuthority, SequenceBinder,
+                                         SequenceEvictor)
+from volcano_tpu.cache.journal import (FileTailer, IntentJournal,
+                                       JournalFollower)
+from volcano_tpu.chaos import LeaseLossInjector
+from volcano_tpu.leaderelection import FlapGuard, LeaderElector
+from volcano_tpu.scheduler import (ROLE_FENCED, ROLE_FOLLOWER, ROLE_LEADER,
+                                   Scheduler)
+from volcano_tpu.sim.report import deterministic_json, oracle_part
+from volcano_tpu.sim.runner import SimRunner
+from volcano_tpu.sim.workload import make_scenario
+from volcano_tpu.store import ObjectStore
+
+GI = 1 << 30
+SEED = 20260803
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, d: float) -> None:
+        self.t += d
+
+
+def make_world(binder, evictor=None, n_nodes=2, n_jobs=2, tasks_per_job=2,
+               **cache_kw):
+    cache = SchedulerCache(binder=binder,
+                           evictor=evictor or SequenceEvictor(), **cache_kw)
+    for i in range(n_nodes):
+        alloc = Resource(16000, 32 * GI)
+        alloc.max_task_num = 110
+        cache.add_node(NodeInfo(name=f"n{i}", allocatable=alloc))
+    for j in range(n_jobs):
+        pg = PodGroup(name=f"j{j}", queue="default",
+                      min_member=tasks_per_job, phase=PodGroupPhase.INQUEUE)
+        job = JobInfo(uid=f"j{j}", name=f"j{j}", queue="default",
+                      min_available=tasks_per_job, podgroup=pg)
+        for k in range(tasks_per_job):
+            job.add_task_info(TaskInfo(uid=f"j{j}-{k}", name=f"j{j}-{k}",
+                                       job=f"j{j}",
+                                       resreq=Resource(1000, GI)))
+        cache.add_job(job)
+    return cache
+
+
+def make_elector(store, authority, ident, wall, mono=None, **kw):
+    kw.setdefault("lease_duration", 10.0)
+    kw.setdefault("renew_deadline", 6.0)
+    return LeaderElector(store, "vc-scheduler",
+                         on_started_leading=lambda: None,
+                         identity=ident, time_fn=wall,
+                         mono_fn=mono or wall, authority=authority, **kw)
+
+
+# ---------------------------------------------------------------------------
+# fencing: epochs, authority, the executor gate
+# ---------------------------------------------------------------------------
+
+class TestFencing:
+    def test_authority_rejects_stale_and_advances(self):
+        auth = FencingAuthority()
+        auth.check("bind", 1)                 # first leadership observed
+        auth.check("bind", 1)
+        auth.advance(3)
+        with pytest.raises(FencedError) as e:
+            auth.check("bind", 2)
+        assert e.value.epoch == 2 and e.value.current == 3
+        assert auth.rejections == 1
+        auth.check("evict", 3)                # the live leader passes
+
+    def test_fenced_binder_blocks_inner_executor(self):
+        auth = FencingAuthority()
+        inner = SequenceBinder()
+        epoch = {"v": 1}
+        gate = FencedBinder(inner, lambda: epoch["v"], auth)
+        task = TaskInfo(uid="t1", name="t1", job="j",
+                        resreq=Resource(1000, GI))
+        task.node_name = "n0"
+        gate.bind(task, "n0")
+        assert inner.sequence == [("t1", "n0")]
+        auth.advance(2)                       # a newer leader exists
+        with pytest.raises(FencedError):
+            gate.bind(task, "n1")
+        assert inner.sequence == [("t1", "n0")], \
+            "a fenced bind must never reach the cluster"
+
+    def test_fenced_ex_leader_bind_rejected_and_counted(self):
+        """THE acceptance regression: a stale-epoch bind issued by a
+        fenced ex-leader — one that lost the lease but (paused,
+        partitioned) never noticed — is rejected by the executor, the
+        optimistic cache state rolls back, and the rejection is
+        counted. Split-brain safety by construction."""
+        wall = FakeClock()
+        store = ObjectStore()
+        auth = FencingAuthority()
+        a = make_elector(store, auth, "a", wall, lease_duration=5.0,
+                         renew_deadline=3.0)
+        b = make_elector(store, auth, "b", wall, lease_duration=5.0,
+                         renew_deadline=3.0)
+        assert a.step() and a.fencing_epoch == 1
+
+        cluster = SequenceBinder()
+        cache = make_world(
+            FencedBinder(cluster, lambda: a.fencing_epoch, auth),
+            evictor=FencedEvictor(SequenceEvictor(),
+                                  lambda: a.fencing_epoch, auth),
+            journal=IntentJournal())
+        cache.fencing_epoch_fn = lambda: a.fencing_epoch
+
+        # the live leader binds fine
+        t0 = cache.jobs["j0"].tasks["j0-0"].shallow_clone()
+        t0.node_name = "n0"
+        cache.bind(t0)
+        assert cluster.sequence == [("j0-0", "n0")]
+        assert cache.jobs["j0"].tasks["j0-0"].status == TaskStatus.BOUND
+
+        # A's lease expires unnoticed; B takes over with epoch 2
+        wall.advance(6.0)
+        assert b.step() and b.fencing_epoch == 2
+        assert auth.current() == 2
+
+        before = metrics.local_counters().get(("fencing_rejections",
+                                               "bind"), 0)
+        t1 = cache.jobs["j0"].tasks["j0-1"].shallow_clone()
+        t1.node_name = "n0"
+        cache.bind(t1)                        # the funnel swallows the
+        #                                       failure into rollback+resync
+        assert cluster.sequence == [("j0-0", "n0")], \
+            "the deposed leader's bind reached the cluster (split brain)"
+        cached = cache.jobs["j0"].tasks["j0-1"]
+        assert cached.status == TaskStatus.PENDING and not cached.node_name, \
+            "optimistic state must roll back on a fenced rejection"
+        assert auth.rejections >= 1
+        assert metrics.local_counters().get(("fencing_rejections", "bind"),
+                                            0) == before + 1
+        # the queued resync retry is fenced too: process it and assert
+        # the cluster still never saw it
+        cache.resync_queue.time_fn = lambda: 1e9
+        cache.process_resync_tasks()
+        assert cluster.sequence == [("j0-0", "n0")]
+
+    def test_intent_epoch_stamped_and_durable(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        journal = IntentJournal(path=path)
+        cache = make_world(SequenceBinder(), journal=journal)
+        cache.fencing_epoch_fn = lambda: 7
+
+        class Boom(Exception):
+            pass
+
+        class FailBinder:
+            def bind(self, task, hostname):
+                raise Boom()
+
+        cache.binder = FailBinder()
+        t = cache.jobs["j0"].tasks["j0-0"].shallow_clone()
+        t.node_name = "n0"
+        cache.bind(t)                         # fails -> intent + nack
+        journal.close()
+        recs = [json.loads(line) for line in open(path)]
+        intents = [r for r in recs if r["kind"] == "intent"]
+        assert intents and all(r["epoch"] == 7 for r in intents)
+        # recovery decodes the epoch back
+        j2 = IntentJournal(path=path)
+        assert len(j2) == 0                   # nack settled it
+        j2.close()
+
+
+# ---------------------------------------------------------------------------
+# the role state machine
+# ---------------------------------------------------------------------------
+
+class TestRoleStateMachine:
+    def test_standby_never_opens_session(self, monkeypatch):
+        wall = FakeClock()
+        store = ObjectStore()
+        auth = FencingAuthority()
+        holder = make_elector(store, auth, "holder", wall)
+        assert holder.step()                  # someone else holds a live
+        #                                       lease
+        standby = make_elector(store, auth, "standby", wall)
+        cache = make_world(SequenceBinder())
+        sched = Scheduler(cache, schedule_period=0.0, drift_verify_every=0)
+        sched.attach_elector(standby)
+        import volcano_tpu.scheduler as sched_mod
+        monkeypatch.setattr(
+            sched_mod, "open_session",
+            lambda *a, **k: pytest.fail("standby opened a session"))
+        for _ in range(3):
+            assert sched.run_once() == []
+            assert sched.role == ROLE_FOLLOWER
+        assert not standby.leading
+        # ...and once the lease expires, the same shell takes over and
+        # schedules (with the real open_session back)
+        monkeypatch.undo()
+        wall.advance(standby.lease_duration + 1)
+        sched.run_once()
+        assert sched.role == ROLE_LEADER
+        assert standby.fencing_epoch == 2
+
+    def test_leader_demotes_mid_cycle_without_half_applying(self):
+        """A renewal failure mid-cycle (here: an injected revocation at
+        an action boundary) demotes the leader to FENCED: the remaining
+        actions are skipped, the open session is ABANDONED — no plugin
+        close writebacks, no podgroup status flush — and the GC window
+        resumes (the session-rollback path)."""
+        wall = FakeClock()
+        store = ObjectStore()
+        auth = FencingAuthority()
+        elector = make_elector(store, auth, "a", wall)
+
+        updates = []
+
+        class RecordingUpdater(FakeStatusUpdater):
+            def update_pod_group(self, job):
+                updates.append(job.uid)
+
+        cache = make_world(SequenceBinder(),
+                           status_updater=RecordingUpdater())
+        sched = Scheduler(cache, schedule_period=0.0, drift_verify_every=0)
+        sched.attach_elector(elector)
+
+        # control cycle: a clean leader cycle flushes podgroup status
+        sched.run_once()
+        assert sched.role == ROLE_LEADER
+        assert updates, "control cycle should write podgroup status"
+        del updates[:]
+
+        seen_actions = []
+        injector = LeaseLossInjector(lambda: elector, {1: 2})
+
+        def hook(name, ssn):
+            seen_actions.append(name)
+            injector(name, ssn)
+
+        sched.action_fault_hook = hook
+        errors = sched.run_once()
+        assert errors == []
+        assert sched.role == ROLE_FENCED
+        assert injector.injected == [(1, 2)]
+        # the revocation landed before action 2 ran its hook; the
+        # demotion check stops the pipeline at the NEXT boundary — so at
+        # most two of the five configured actions ever started
+        assert len(seen_actions) <= 2, seen_actions
+        assert updates == [], \
+            "a demoted leader must not half-apply close writebacks"
+        assert gc.isenabled(), "the abandoned session must resume GC"
+        # the ex-leader may re-contend (no flap guard here): the fresh
+        # acquisition mints a HIGHER epoch, so everything it stamped
+        # while fenced stays rejectable forever
+        assert sched.run_once() == []
+        assert sched.role == ROLE_LEADER
+        assert elector.fencing_epoch == 2
+
+    def test_flap_guard_cools_down_flapping_leadership(self):
+        """The realistic flap sequence (loss → window → re-acquire →
+        prompt loss again) must DOUBLE the window: the streak only
+        resets after leadership is held past the stability horizon, so
+        the renewal immediately after re-acquisition cannot zero it."""
+        clock = FakeClock()
+        guard = FlapGuard(cooldown_s=5.0, max_cooldown_s=20.0,
+                          time_fn=clock)
+        assert guard.may_contend()
+        assert guard.record_loss() == 5.0
+        assert not guard.may_contend()
+        clock.advance(5.1)
+        assert guard.may_contend()
+        guard.record_stable()                 # re-acquired: stamps horizon
+        clock.advance(1.0)
+        guard.record_stable()                 # renewing, horizon not past
+        assert guard.consecutive_losses == 1
+        assert guard.record_loss() == 10.0    # prompt re-loss: DOUBLES
+        clock.advance(10.1)
+        guard.record_stable()                 # re-acquired again
+        clock.advance(5.1)
+        guard.record_stable()                 # held past the horizon
+        assert guard.consecutive_losses == 0
+
+    def test_flap_guard_engages_through_the_elector(self):
+        """End to end through step(): a replica revoked right after each
+        re-acquisition must see its abstention window double."""
+        wall = FakeClock()
+        store = ObjectStore()
+        auth = FencingAuthority()
+        guard = FlapGuard(cooldown_s=4.0, max_cooldown_s=32.0,
+                          time_fn=wall)
+        a = LeaderElector(store, "vc-scheduler",
+                          on_started_leading=lambda: None, identity="a",
+                          lease_duration=2.0, renew_deadline=1.5,
+                          time_fn=wall, mono_fn=wall, authority=auth,
+                          flap_guard=guard)
+        assert a.step()
+        a.revoke()
+        assert guard.consecutive_losses == 1
+        assert not a.step()                   # abstaining
+        wall.advance(4.1)
+        assert a.step()                       # re-contends after window
+        a.revoke()                            # flaps again immediately
+        assert guard.consecutive_losses == 2, \
+            "the doubling streak must survive the re-acquisition"
+        assert not a.step()
+        wall.advance(4.1)
+        assert not a.step(), "window must have DOUBLED (8s), not reset"
+        wall.advance(4.1)
+        assert a.step()
+
+
+# ---------------------------------------------------------------------------
+# warm-standby journal replay
+# ---------------------------------------------------------------------------
+
+class TestStandbyReplay:
+    def _pair(self, journal):
+        leader = make_world(SequenceBinder(), journal=journal)
+        standby = make_world(SequenceBinder())
+        follower = JournalFollower(standby)
+        return leader, standby, follower
+
+    def test_in_memory_tail_converges_standby(self):
+        journal = IntentJournal()
+        leader, standby, follower = self._pair(journal)
+        follower.attach(journal)
+        t = leader.jobs["j0"].tasks["j0-0"].shallow_clone()
+        t.node_name = "n1"
+        leader.bind(t)
+        got = standby.jobs["j0"].tasks["j0-0"]
+        assert got.status == TaskStatus.BOUND and got.node_name == "n1"
+        assert "j0-0" in standby.nodes["n1"].tasks
+        leader.evict(leader.jobs["j0"].tasks["j0-0"], "test")
+        assert standby.jobs["j0"].tasks["j0-0"].status \
+            == TaskStatus.RELEASING
+        assert follower.applied == 2
+
+    def test_failed_bind_does_not_move_standby(self):
+        journal = IntentJournal()
+        leader, standby, follower = self._pair(journal)
+        follower.attach(journal)
+
+        class Boom(Exception):
+            pass
+
+        class FailBinder:
+            def bind(self, task, hostname):
+                raise Boom()
+
+        leader.binder = FailBinder()
+        t = leader.jobs["j0"].tasks["j0-0"].shallow_clone()
+        t.node_name = "n1"
+        leader.bind(t)                        # nack -> rollback both sides
+        got = standby.jobs["j0"].tasks["j0-0"]
+        assert got.status == TaskStatus.PENDING and not got.node_name
+
+    def test_seed_resolves_acks_for_pre_subscription_intents(self):
+        """A standby started mid-stream (or restarted after a crash)
+        still resolves acks whose intents predate its subscription — the
+        failover handoff's reconcile acks land on every replica."""
+        journal = IntentJournal()
+        leader, standby, follower = self._pair(journal)
+        seq = journal.record_intent(
+            "bind", leader.jobs["j0"].tasks["j0-0"], "n1", epoch=1)
+        follower.attach(journal)              # seeds from the open set
+        journal.ack(seq, ok=True)
+        got = standby.jobs["j0"].tasks["j0-0"]
+        assert got.status == TaskStatus.BOUND and got.node_name == "n1"
+
+    def test_file_tail_transport(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        journal = IntentJournal(path=path)
+        leader = make_world(SequenceBinder(), journal=journal)
+        standby = make_world(SequenceBinder())
+        follower = JournalFollower(standby)
+        tailer = FileTailer(path)
+        t = leader.jobs["j1"].tasks["j1-0"].shallow_clone()
+        t.node_name = "n0"
+        leader.bind(t)
+        journal.flush()
+        for rec in tailer.poll():
+            follower.apply_record(rec)
+        got = standby.jobs["j1"].tasks["j1-0"]
+        assert got.status == TaskStatus.BOUND and got.node_name == "n0"
+        # compaction shrinks the file; the tailer restarts idempotently
+        journal.compact()
+        journal.flush()
+        for rec in tailer.poll():
+            follower.apply_record(rec)
+        assert standby.jobs["j1"].tasks["j1-0"].status == TaskStatus.BOUND
+        journal.close()
+
+
+def test_vcctl_leader_status_verb():
+    from volcano_tpu.cli.vcctl import main
+    wall = FakeClock(100.0)
+    store = ObjectStore()
+    out = []
+    assert main(["leader", "status"], store=store, out=out.append) == 1
+    assert "no lease" in out[0]
+    elector = make_elector(store, FencingAuthority(), "replica-7", wall)
+    assert elector.step()
+    del out[:]
+    assert main(["leader", "status"], store=store, out=out.append) == 0
+    assert "holder=replica-7" in out[0] and "epoch=1" in out[0]
+
+
+# ---------------------------------------------------------------------------
+# sim --ha acceptance slice (fast smoke; the CI ha-soak runs the full one)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.sim
+class TestHASim:
+    KILLS = (2, 5, 9, 13)
+
+    def _run(self, **kw):
+        trace = make_scenario("smoke", seed=3)
+        return SimRunner(trace, seed=3, **kw).run()
+
+    def test_seeded_leader_kills_zero_double_binds_bounded_failover(self):
+        report = self._run(ha_replicas=3, kill_cycles=self.KILLS,
+                           kill_seed=2)
+        assert report["double_binds"] == 0, f"kill_seed=2: {report}"
+        assert report["restarts"] == len(self.KILLS)
+        assert report["jobs"]["completed"] == report["jobs"]["arrived"]
+        assert report["jobs"]["unfinished"] == 0
+        assert report["failovers"] == len(self.KILLS)
+        assert report["ha"]["failover_cycles_max"] <= 3, \
+            f"failover exceeded the bound: {report['ha']}"
+
+    def test_ha_run_byte_deterministic(self):
+        a = self._run(ha_replicas=3, kill_cycles=self.KILLS, kill_seed=2,
+                      lease_loss_cycles=(7,))
+        b = self._run(ha_replicas=3, kill_cycles=self.KILLS, kill_seed=2,
+                      lease_loss_cycles=(7,))
+        assert deterministic_json(a) == deterministic_json(b)
+
+    def test_non_contended_ha_equals_single_scheduler_oracle(self):
+        ha = self._run(ha_replicas=3)
+        single = self._run(ha_replicas=1)
+        assert json.dumps(oracle_part(ha), sort_keys=True) \
+            == json.dumps(oracle_part(single), sort_keys=True)
+        assert ha["failovers"] == 0 and ha["fenced_rejections"] == 0
+
+    def test_lease_loss_fails_over_to_warm_standby(self):
+        report = self._run(ha_replicas=3, lease_loss_cycles=(3, 8))
+        assert report["double_binds"] == 0
+        assert report["restarts"] == 0        # demotion, not death
+        assert report["failovers"] >= 1
+        assert report["jobs"]["completed"] == report["jobs"]["arrived"]
+        assert report["ha"]["failover_cycles_max"] <= 3

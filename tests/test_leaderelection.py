@@ -160,6 +160,98 @@ def test_scheduler_runs_under_election():
     assert not t.is_alive()
 
 
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, d):
+        self.t += d
+
+
+def test_fencing_epoch_monotonic_across_acquisitions():
+    """Every ACQUISITION (create/takeover/re-claim) mints epoch+1; a
+    renewal carries the epoch unchanged — the total order fencing rests
+    on."""
+    wall = _Clock()
+    store = ObjectStore()
+    a = LeaderElector(store, "x", on_started_leading=lambda: None,
+                      identity="a", lease_duration=5.0, time_fn=wall,
+                      mono_fn=wall)
+    b = LeaderElector(store, "x", on_started_leading=lambda: None,
+                      identity="b", lease_duration=5.0, time_fn=wall,
+                      mono_fn=wall)
+    assert a.step() and a.fencing_epoch == 1          # create
+    wall.advance(1.0)
+    assert a.step() and a.fencing_epoch == 1          # renewal: unchanged
+    wall.advance(6.0)                                 # a's lease expires
+    assert b.step() and b.fencing_epoch == 2          # takeover
+    b.release()
+    assert a.step() and a.fencing_epoch == 3          # re-claim after loss
+    lease = store.get("Lease", "volcano-system", "x")
+    assert lease.epoch == 3 and lease.holder == "a"
+
+
+def test_ntp_step_backward_does_not_mask_lease_loss():
+    """The NTP-step scenario the monotonic watchdog was fixed for (PR 6):
+    the wall clock steps BACKWARD while the lease is lost to a
+    challenger — the renew-deadline watchdog reads the monotonic clock,
+    so the loss is detected on time and on_lease_lost fires; a
+    wall-clock watchdog would have seen negative elapsed time and kept
+    a deposed leader scheduling (split brain)."""
+    from volcano_tpu.chaos import ClockSkewInjector
+    wall_base = _Clock()
+    wall = ClockSkewInjector(wall_base)               # steerable NTP skew
+    mono = _Clock()                                   # per-process, smooth
+    store = ObjectStore()
+    lost = []
+    a = LeaderElector(store, "x", on_started_leading=lambda: None,
+                      identity="a", lease_duration=4.0, renew_deadline=3.0,
+                      time_fn=wall, mono_fn=mono,
+                      on_lease_lost=lambda: lost.append("a"))
+    b = LeaderElector(store, "x", on_started_leading=lambda: None,
+                      identity="b", lease_duration=4.0, renew_deadline=3.0,
+                      time_fn=wall, mono_fn=mono)
+    assert a.step() and a.leading
+    # a pauses; its lease expires on the (shared) lease timebase and b
+    # takes over
+    wall_base.advance(5.0)
+    mono.advance(5.0)
+    assert b.step() and b.fencing_epoch == 2
+    # NTP now steps a's wall clock back 1000s; the monotonic clock keeps
+    # flowing. a's renewals fail (b holds a live lease) and the deadline
+    # (monotonic!) has long passed -> a must know it lost.
+    wall.step(-1000.0)
+    assert not a.step()
+    assert not a.leading and lost == ["a"]
+    assert a.fencing_epoch == 1                       # stale, rejectable
+
+
+def test_ntp_step_forward_does_not_depose_healthy_leader():
+    """The inverse skew: a large FORWARD wall step must not trip the
+    (monotonic) renew-deadline watchdog while renewals keep
+    succeeding."""
+    from volcano_tpu.chaos import ClockSkewInjector
+    wall_base = _Clock()
+    wall = ClockSkewInjector(wall_base)
+    mono = _Clock()
+    store = ObjectStore()
+    lost = []
+    a = LeaderElector(store, "x", on_started_leading=lambda: None,
+                      identity="a", lease_duration=4.0, renew_deadline=3.0,
+                      time_fn=wall, mono_fn=mono,
+                      on_lease_lost=lambda: lost.append("a"))
+    assert a.step()
+    wall.step(+1000.0)                                # NTP leaps forward
+    for _ in range(5):
+        wall_base.advance(1.0)
+        mono.advance(1.0)
+        assert a.step(), "healthy leader deposed by a forward wall step"
+    assert a.leading and not lost
+
+
 def test_verb_entry_points_parse():
     """vsub/vjobs etc. route through vcctl's parser (no store attached ->
     clean error exit, not a crash)."""

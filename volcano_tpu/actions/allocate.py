@@ -1339,7 +1339,8 @@ def _fused_blocks_solver():
     return _SOLVER_CACHE["blocks"]
 
 
-def prewarm_shapes(ssn, shape_configs=None, engine: str = "tpu-fused") -> int:
+def prewarm_shapes(ssn, shape_configs=None, engine: str = "tpu-fused",
+                   preempt_engine: Optional[str] = None) -> int:
     """Compile the device solver at the given cycle shapes before the
     scheduling loop needs them (Scheduler.prewarm). Each config is a
     ``(tasks, jobs)`` pair; dummy zero-valued tensors with the session's
@@ -1352,8 +1353,18 @@ def prewarm_shapes(ssn, shape_configs=None, engine: str = "tpu-fused") -> int:
     import jax.numpy as jnp
     from ..ops.place import JobMeta, PlacementTasks
 
+    def _warm_preempt() -> int:
+        if preempt_engine not in ("tpu", "tpu-sharded"):
+            return 0
+        # mirror of the preempt walk's pow2 (preemptor, victim-slot)
+        # bucketing (evict_tpu._ptask_bucket/_slot_bucket): compile the
+        # walk at the buckets the current session implies so steady-state
+        # preempt cycles hit the XLA cache like allocate does
+        from .evict_tpu import prewarm_preempt
+        return prewarm_preempt(ssn, sharded=preempt_engine == "tpu-sharded")
+
     if engine.startswith("callbacks"):
-        return 0
+        return _warm_preempt()
     nodes = list(ssn.nodes.values())
     if not nodes:
         return 0
@@ -1494,6 +1505,7 @@ def prewarm_shapes(ssn, shape_configs=None, engine: str = "tpu-fused") -> int:
                 jnp.asarray(node_t.max_tasks))
         jax.block_until_ready(out)
         warmed += 1
+    warmed += _warm_preempt()
     return warmed
 
 

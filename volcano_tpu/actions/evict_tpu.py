@@ -67,8 +67,33 @@ MAX_W = 64
 MAX_NWWR_ELEMS = 256 << 20
 
 
+def _slot_bucket(w: int) -> int:
+    """Pad the [N, W] victim-slot axis to a pow2 bucket: W tracks the
+    max victims on any one node, which shifts cycle to cycle under churn
+    — unbucketed it keys a fresh XLA walk compile per distinct width
+    (the VT006 exposure this closes). Pad slots carry the pad-victim
+    sentinel (valid=False), so they can never be chosen."""
+    b = 8
+    while b < max(w, 1):
+        b *= 2
+    return b
+
+
+def _ptask_bucket(p: int) -> int:
+    """Pad the preemptor-task axis to a pow2 bucket (the walk's other
+    data-dependent jit axis). Pad tasks form one trailing pad job whose
+    pipeline quota is already met, so the task cursor skips them in one
+    inactive step — they can never place or evict."""
+    b = 8
+    while b < max(p, 1):
+        b *= 2
+    return b
+
+
 def _device_shape_ok(n_nodes: int, victims, n_res: int) -> bool:
-    w = _max_per_node(victims)
+    # budget with the BUCKETED width — the padded [N, W, W] tensors are
+    # what actually allocates
+    w = _slot_bucket(_max_per_node(victims))
     return w <= MAX_W and n_nodes * w * w * max(n_res, 1) <= MAX_NWWR_ELEMS
 
 
@@ -162,7 +187,9 @@ class _EvictTensors:
         N = len(self.node_t.names)
         counts = np.bincount(self.vnode, minlength=N) if V else \
             np.zeros(N, np.int64)
-        W = max(1, int(counts.max()) if V else 1)
+        # pow2-bucketed slot width (VT006): pad columns hold the sentinel
+        # V below (valid False), decisions cannot touch them
+        W = _slot_bucket(max(1, int(counts.max()) if V else 1))
         self.W = W
         # slot table: victims grouped per node, preserving list (eviction)
         # order within each row; V is the pad sentinel. Vectorized: stable
@@ -799,13 +826,61 @@ def execute_preempt_tpu(ssn, sharded: bool = False) -> None:
     _victim_tasks_host(ssn)
 
 
+def prewarm_preempt(ssn, sharded: bool = False) -> int:
+    """Compile the preempt walk at the pow2 (preemptor, victim-slot)
+    buckets the CURRENT session implies — the prewarm mirror of the
+    bucketing in _preempt_phase/_EvictTensors, so the steady state's
+    walk compiles pay at startup like the allocate solver's
+    (allocate.prewarm_shapes calls this when the conf runs a device
+    preempt). Runs both phases end-to-end through the REAL shape
+    assembly but discards the device outputs (dry_run) — read-only on
+    session state. Returns the number of walk shapes compiled."""
+    victims = _eviction_order(ssn, _collect_victims(ssn))
+    if not victims:
+        return 0
+    names = set()
+    for n in ssn.nodes.values():
+        names.update(n.allocatable.resource_names())
+    for v in victims:
+        names.update(v.resreq.resource_names())
+    for job in ssn.jobs.values():
+        for t in job.task_status_index.get(TaskStatus.PENDING, {}).values():
+            names.update(t.resreq.resource_names())
+    if len(victims) < _device_min_victims(ssn, "preempt") \
+            or not _device_shape_ok(len(ssn.nodes), victims, len(names)):
+        return 0
+    pjobs, under_request = _starving_jobs(ssn)
+    vq_count: Dict[str, int] = {}
+    vq_own: Dict[tuple, int] = {}
+    for v in victims:
+        q = ssn.jobs[v.job].queue
+        vq_count[q] = vq_count.get(q, 0) + 1
+        vq_own[(q, v.job)] = vq_own.get((q, v.job), 0) + 1
+    pjobs = [j for j in pjobs
+             if vq_count.get(j.queue, 0)
+             - vq_own.get((j.queue, j.uid), 0) > 0]
+    warmed = 0
+    if pjobs:
+        _preempt_phase(ssn, pjobs, victims, inter_job=True,
+                       sharded=sharded, dry_run=True)
+        warmed += 1
+    pjobs2 = [j for j in under_request
+              if j.task_status_index.get(TaskStatus.PENDING)
+              and j.task_status_index.get(TaskStatus.RUNNING)]
+    if pjobs2:
+        _preempt_phase(ssn, pjobs2, victims, inter_job=False,
+                       sharded=sharded, dry_run=True)
+        warmed += 1
+    return warmed
+
+
 # Per-cycle phase timers of the last device preempt (seconds) — the
 # host/device breakdown bench.py reports, keyed per phase.
 LAST_STATS: Dict[str, float] = {}
 
 
 def _preempt_phase(ssn, pjobs, victims, inter_job: bool,
-                   sharded: bool = False) -> None:
+                   sharded: bool = False, dry_run: bool = False) -> None:
     import jax.numpy as jnp
     from ..ops.evict import build_preempt_walk, build_preempt_walk_sharded
 
@@ -853,6 +928,36 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool,
     needed = np.zeros(len(job_index) + 1, np.float32)
     needed[pjg_job] = needed_j
 
+    # pow2-bucket the preemptor-task axis (VT006, the churn-recompile
+    # contract): pad tasks form ONE trailing pad job — pjob points at a
+    # fresh all-False candidate row, pjg at the zeroed jalloc pad group
+    # whose quota (0) is already met, so the walk's first pad visit runs
+    # the job boundary (closing the last real job exactly as the
+    # unpadded after-loop close would) and then skips straight past the
+    # pad block in a single inactive step. Decisions are untouched.
+    P_live = len(ptasks)
+    Pp = _ptask_bucket(P_live)
+    cand_mask_np = stack.padded_cand_mask()
+    tier_masks_np = stack.device_masks()
+    if Pp > P_live:
+        pad = Pp - P_live
+        PJ = len(kept_jobs)
+        pad_group = len(job_index)           # the zeroed jalloc pad row
+        preq = np.pad(preq, ((0, pad), (0, 0)))
+        pjob_arr = np.pad(pjob_arr, (0, pad), constant_values=PJ)
+        pjg = np.pad(pjg, (0, pad), constant_values=pad_group)
+        first_np = np.pad(first_np, (0, pad))
+        first_np[P_live] = True
+        run_id = np.pad(run_id, (0, pad),
+                        constant_values=int(run_id[P_live - 1]))
+        run_end = np.pad(run_end, (0, pad), constant_values=Pp - 1)
+        job_end = np.pad(job_end, (0, pad), constant_values=Pp - 1)
+        cand_mask_np = np.pad(cand_mask_np, ((0, 1), (0, 0)))
+        tier_masks_np = tuple(
+            (np.pad(stk, ((0, 0), (0, 1), (0, 0))),
+             np.pad(part, ((0, 0), (0, 1))))
+            for stk, part in tier_masks_np)
+
     # intra-job preemption breaks the same-node-run shrink argument when a
     # dynamic tier is present: the victim job IS the preemptor's job, so
     # its allocation (and the victims' shares) GROWS with each placement —
@@ -894,8 +999,8 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool,
     from ..obs import trace as obs_trace
     with obs_trace.span("upload", phase=key) as sp:
         inputs = jax.device_put((
-            fidle0, nw, stack.padded_cand_mask(),
-            stack.device_masks(), preq, pjob_arr, pjg, first_np,
+            fidle0, nw, cand_mask_np,
+            tier_masks_np, preq, pjob_arr, pjg, first_np,
             run_id, run_end, job_end,
             needed, jalloc0, total))                        # one upload
         (fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, pjg_d, first_d,
@@ -905,19 +1010,20 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool,
         task_node, owner_nw, job_done, iters = fn(
             fidle_d, nw_d, cand_d, masks_d, preq_d, pjob_d, pjg_d, first_d,
             rid_d, rend_d, jend_d, score_arr, needed_d, jalloc_d, total_d)
-        N, W = tensors.vslot.shape        # UNPADDED dims for the replay
+        N, W = tensors.vslot.shape        # pre-mesh-pad dims for replay
         Np = fidle0.shape[0]              # includes any mesh padding
-        P = len(ptasks)
         packed = np.asarray(jnp.concatenate([
             task_node, owner_nw.reshape(-1),
             job_done.astype(jnp.int32), iters[None]]))      # one fetch
     LAST_STATS[key + "_solve_s"] = sp.dur_s
-    task_node = packed[:P]
-    owner_nw = packed[P:P + Np * W].reshape(Np, W)[:N]
+    task_node = packed[:P_live]           # pad-task rows are NO_NODE
+    owner_nw = packed[Pp:Pp + Np * W].reshape(Np, W)[:N]
     # per-group verdicts -> per kept job via its alloc-group index
-    job_done = packed[P + Np * W:-1].astype(bool)[pjg_job]
+    job_done = packed[Pp + Np * W:-1].astype(bool)[pjg_job]
     LAST_STATS[key + "_iters"] = int(packed[-1])
 
+    if dry_run:
+        return
     with obs_trace.span("replay", phase=key) as sp:
         _replay_preempt(ssn, ptasks, pjob_ix, kept_jobs, tensors,
                         task_node, owner_nw, job_done, inter_job, stack)

@@ -391,6 +391,66 @@ class JournalFunnelRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# VT008 — fencing-epoch stamp on executor-effecting calls (PR 7 HA)
+# ---------------------------------------------------------------------------
+
+class FencingEpochRule(Rule):
+    """Executor-effecting bind/evict calls must carry the issuing
+    leadership's fencing epoch: a ``fencing_epoch`` read must be on the
+    path (same function or one hop — the ``_journal_intent`` funnel
+    reads it for every intent it stamps). An unstamped executor call is
+    a side effect the fencing gate cannot order against leaderships —
+    a deposed leader could replay it after failover (the split-brain
+    double-bind the HA control plane closes by construction)."""
+
+    id = "VT008"
+    name = "fencing-epoch"
+    contract = ("executor-effecting bind/evict invocation without a "
+                "fencing_epoch stamp on the path (PR 7 HA fencing, "
+                "docs/robustness.md)")
+    # same exemptions as VT004: the executor layer itself, the journal's
+    # reconciler (replays already-stamped intents), the chaos wrappers
+    exclude = ("volcano_tpu/cache/executors.py",
+               "volcano_tpu/cache/journal.py", "volcano_tpu/chaos.py",
+               "volcano_tpu/analysis/")
+
+    EXECUTOR_ATTRS = {"binder", "evictor"}
+    EXECUTOR_METHODS = {"bind", "evict"}
+    WITNESS = {"fencing_epoch"}
+
+    def _is_executor_call(self, node: ast.Call) -> Optional[str]:
+        if not isinstance(node.func, ast.Attribute) \
+                or node.func.attr not in self.EXECUTOR_METHODS:
+            return None
+        recv = dotted_name(node.func.value)
+        if recv is None:
+            return None
+        if recv.split(".")[-1] in self.EXECUTOR_ATTRS:
+            return f"{recv}.{node.func.attr}"
+        return None
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self._is_executor_call(node)
+            if target is None:
+                continue
+            fn = mod.enclosing_function(node.lineno)
+            if fn is not None and ctx.witness_in_scope(fn, self.WITNESS):
+                continue
+            where = fn.qualname if fn else "<module>"
+            findings.append(self.finding(
+                mod, node,
+                f"executor invocation {target}(...) in {where} without a "
+                f"fencing_epoch stamp on the path; executor-effecting "
+                f"operations must carry the leader's epoch so a deposed "
+                f"leader's writes are rejectable (docs/robustness.md)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
 # VT005 — SimKill tunneling (PR 4, docs/robustness.md)
 # ---------------------------------------------------------------------------
 
@@ -779,7 +839,7 @@ class LockDisciplineRule(Rule):
 ALL_RULES: List[Rule] = [
     DirtyWitnessRule(), RawClockRule(), UnseededRandomRule(),
     JournalFunnelRule(), SimKillSwallowRule(), ShapeBucketRule(),
-    LockDisciplineRule(),
+    LockDisciplineRule(), FencingEpochRule(),
 ]
 
 

@@ -19,7 +19,7 @@ import itertools
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..api import (ClusterInfo, JobInfo, NamespaceCollection, NamespaceInfo,
                    NodeInfo, PodGroupPhase, QueueInfo, Resource, TaskInfo,
@@ -150,6 +150,12 @@ class SchedulerCache:
         self.journal = journal if (journal is not None
                                    and journal_enabled()) else None
         self.last_reconcile: Optional[dict] = None
+        # HA fencing (docs/robustness.md): the scheduler shell points this
+        # at its elector's fencing epoch (Scheduler.attach_elector); every
+        # journaled side-effect intent is stamped with it, and the fenced
+        # executor gates reject stale-epoch operations. Standalone
+        # schedulers stamp 0.
+        self.fencing_epoch_fn: Callable[[], int] = lambda: 0
         self.binding_tasks: Dict[str, str] = {}   # task uid -> node, in flight
         # Incremental snapshot state (docs/performance.md): every mutation
         # path records the touched node/job/queue keys; snapshot() re-clones
@@ -182,20 +188,29 @@ class SchedulerCache:
         self.journal = journal if (journal is not None
                                    and journal_enabled()) else None
 
+    def fencing_epoch(self) -> int:
+        """The issuing leadership's fencing epoch for executor-effecting
+        operations (0 standalone). Every executor-effecting funnel stamps
+        its intent with this — vlint VT008 enforces the witness."""
+        return self.fencing_epoch_fn()
+
     def _journal_intent(self, op: str, task: TaskInfo, node: str = "",
                         via: str = "", sync: bool = True,
                         fresh: bool = True) -> Optional[int]:
-        """Record a side-effect intent. ``sync=True`` (the default for
-        single-op funnels) makes the intent DURABLE — flushed+fsynced —
-        before the caller runs the executor, which is the WAL guarantee
-        reconciliation rests on; batch funnels journal all their intents
-        first and group-commit with one flush() instead. ``fresh`` marks
-        a NEW placement (vs a re-bind of an already-placed task), which
-        decides whether a crash-window rollback may strip the task's
-        placement (journal._rollback_bind)."""
+        """Record a side-effect intent, stamped with the current fencing
+        epoch. ``sync=True`` (the default for single-op funnels) makes
+        the intent DURABLE — flushed+fsynced — before the caller runs
+        the executor, which is the WAL guarantee reconciliation rests on;
+        batch funnels journal all their intents first and group-commit
+        with one flush() instead. ``fresh`` marks a NEW placement (vs a
+        re-bind of an already-placed task), which decides whether a
+        crash-window rollback may strip the task's placement
+        (journal._rollback_bind)."""
+        epoch = self.fencing_epoch()
         if self.journal is None:
             return None
-        seq = self.journal.record_intent(op, task, node, via, fresh)
+        seq = self.journal.record_intent(op, task, node, via, fresh,
+                                         epoch=epoch)
         if sync:
             self.journal.flush()
         return seq

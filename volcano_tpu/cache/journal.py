@@ -63,10 +63,11 @@ def journal_enabled() -> bool:
 class Intent:
     """One journaled side-effect intent (decoded view)."""
 
-    __slots__ = ("seq", "op", "task", "job", "node", "via", "fresh")
+    __slots__ = ("seq", "op", "task", "job", "node", "via", "fresh",
+                 "epoch")
 
     def __init__(self, seq: int, op: str, task: str, job: str, node: str,
-                 via: str = "", fresh: bool = True):
+                 via: str = "", fresh: bool = True, epoch: int = 0):
         self.seq = seq
         self.op = op                  # "bind" | "evict"
         self.task = task              # task uid
@@ -78,6 +79,11 @@ class Intent:
         # already validly placed — rolling that back must not strip the
         # still-live previous placement.
         self.fresh = fresh
+        # the leader's fencing epoch at intent time (docs/robustness.md
+        # HA section): 0 for standalone schedulers. Recorded so the
+        # journal totally orders side effects across leaderships and a
+        # replayed record names the leadership that issued it.
+        self.epoch = epoch
 
     def __repr__(self):
         return (f"Intent(seq={self.seq}, op={self.op}, task={self.task}, "
@@ -104,6 +110,11 @@ class IntentJournal:
         self.fsyncs = 0
         # seq -> intent, dropped on ack; what reconcile() replays
         self._open: Dict[int, Intent] = {}
+        # warm-standby transport (docs/robustness.md HA section): every
+        # appended record is also delivered to subscribers — in-memory
+        # mode this IS the replication stream a standby's JournalFollower
+        # tails; file mode subscribers see the same records the file gets
+        self._subscribers: List[Callable[[dict], None]] = []
         self._fh = None
         if path is not None:
             self._recover_existing(path)
@@ -136,7 +147,8 @@ class IntentJournal:
             self._open[seq] = Intent(seq, rec["op"], rec["task"],
                                      rec.get("job", ""), rec.get("node", ""),
                                      rec.get("via", ""),
-                                     bool(rec.get("fresh", True)))
+                                     bool(rec.get("fresh", True)),
+                                     int(rec.get("epoch", 0)))
         elif rec.get("kind") == "ack":
             self._open.pop(seq, None)
 
@@ -175,7 +187,8 @@ class IntentJournal:
                 f.write(json.dumps(
                     {"kind": "intent", "seq": it.seq, "op": it.op,
                      "task": it.task, "job": it.job, "node": it.node,
-                     "via": it.via, "fresh": it.fresh},
+                     "via": it.via, "fresh": it.fresh,
+                     "epoch": it.epoch},
                     separators=(",", ":")) + "\n")
             f.flush()
             os.fsync(f.fileno())
@@ -188,21 +201,44 @@ class IntentJournal:
 
     # -- the WAL surface ----------------------------------------------------
 
+    def subscribe(self, fn: Callable[[dict], None]) -> None:
+        """Register a record observer (a standby's JournalFollower).
+        Called with each appended record dict AFTER the journal's own
+        bookkeeping (and outside its lock)."""
+        with self._lock:
+            self._subscribers.append(fn)
+
+    def unsubscribe(self, fn: Callable[[dict], None]) -> None:
+        with self._lock:
+            if fn in self._subscribers:
+                self._subscribers.remove(fn)
+
+    def _publish(self, rec: dict) -> None:
+        with self._lock:
+            subs = list(self._subscribers)
+        for fn in subs:
+            fn(rec)
+
     def record_intent(self, op: str, task, node: str = "",
-                      via: str = "", fresh: bool = True) -> int:
-        """Journal a side-effect intent BEFORE the executor runs.
-        Returns the seq to ack with."""
+                      via: str = "", fresh: bool = True,
+                      epoch: int = 0) -> int:
+        """Journal a side-effect intent BEFORE the executor runs, stamped
+        with the issuing leader's fencing ``epoch``. Returns the seq to
+        ack with."""
         with self._lock:
             self._seq += 1
             seq = self._seq
             intent = Intent(seq, op, task.uid, task.job,
-                            node or task.node_name or "", via, fresh)
+                            node or task.node_name or "", via, fresh,
+                            epoch)
             self._open[seq] = intent
-            self._append({"kind": "intent", "seq": seq, "op": op,
-                          "task": intent.task, "job": intent.job,
-                          "node": intent.node, "via": via,
-                          "fresh": fresh})
-            return seq
+            rec = {"kind": "intent", "seq": seq, "op": op,
+                   "task": intent.task, "job": intent.job,
+                   "node": intent.node, "via": via, "fresh": fresh,
+                   "epoch": epoch}
+            self._append(rec)
+        self._publish(rec)
+        return seq
 
     def ack(self, seq: int, ok: bool = True) -> None:
         """Journal the executor outcome. ``ok=False`` records a failure
@@ -210,7 +246,9 @@ class IntentJournal:
         way (the resync queue owns any retry)."""
         with self._lock:
             self._open.pop(seq, None)
-            self._append({"kind": "ack", "seq": seq, "ok": bool(ok)})
+            rec = {"kind": "ack", "seq": seq, "ok": bool(ok)}
+            self._append(rec)
+        self._publish(rec)
 
     def flush(self) -> None:
         with self._lock:
@@ -243,6 +281,140 @@ class IntentJournal:
     def __len__(self) -> int:
         with self._lock:
             return len(self._open)
+
+
+class JournalFollower:
+    """Warm-standby replay (docs/robustness.md HA section): applies the
+    leader's journal record stream to a STANDBY's SchedulerCache so the
+    standby stays converged and failover is lease-acquire →
+    startup_reconcile → resume instead of a cold rebuild.
+
+    The replay contract: an intent alone changes nothing (it is exactly
+    the leader's crash window); the ACK resolves it —
+
+    - bind  + ok    → assert the bind into cache state (_assert_bound);
+    - bind  + !ok   → the leader rolled back (executor failure) or the
+                      reconciler rolled back a crash window: undo any
+                      optimistic state (_rollback_bind; a no-op on a
+                      standby that never applied the intent);
+    - evict + ok    → reflect the eviction (_repair_releasing);
+    - evict + !ok   → nothing happened cluster-side.
+
+    Transports: subscribe to an in-memory journal (``attach``), or poll a
+    journal file with ``FileTailer`` and feed ``apply_record``. ``seed``
+    preloads the journal's currently-open intents, so a follower started
+    (or restarted) mid-stream still resolves acks whose intents predate
+    its subscription."""
+
+    def __init__(self, cache):
+        self.cache = cache
+        self._pending: Dict[int, dict] = {}
+        self.applied = 0            # acks that changed cache state
+        self._journal: Optional[IntentJournal] = None
+
+    # -- transports ---------------------------------------------------------
+
+    def attach(self, journal: IntentJournal) -> None:
+        """Subscribe to an in-memory/live journal and seed from its open
+        intents (idempotent per journal)."""
+        self.seed(journal)
+        journal.subscribe(self.apply_record)
+        self._journal = journal
+
+    def detach(self) -> None:
+        if self._journal is not None:
+            self._journal.unsubscribe(self.apply_record)
+            self._journal = None
+
+    def seed(self, journal: IntentJournal) -> None:
+        for it in journal.unacked():
+            self._pending[it.seq] = {
+                "kind": "intent", "seq": it.seq, "op": it.op,
+                "task": it.task, "job": it.job, "node": it.node,
+                "via": it.via, "fresh": it.fresh, "epoch": it.epoch}
+
+    # -- the replay ---------------------------------------------------------
+
+    def apply_record(self, rec: dict) -> None:
+        kind = rec.get("kind")
+        if kind == "intent":
+            self._pending[int(rec.get("seq", 0))] = rec
+            return
+        if kind != "ack":
+            return
+        intent = self._pending.pop(int(rec.get("seq", 0)), None)
+        if intent is None:
+            return                       # pre-seed history; already settled
+        with self.cache._lock:
+            job = self.cache.jobs.get(intent.get("job", ""))
+            task = job.tasks.get(intent["task"]) if job is not None else None
+        if task is None:
+            return                       # task gone: the ack is moot
+        if intent["op"] == "bind":
+            if rec.get("ok"):
+                _assert_bound(self.cache, job, task, intent["node"])
+            else:
+                _rollback_bind(self.cache, job, task, intent["node"],
+                               bool(intent.get("fresh", True)))
+        elif rec.get("ok"):
+            _repair_releasing(self.cache, job, task)
+        else:
+            return                       # failed evict: nothing happened
+        self.applied += 1
+
+
+class FileTailer:
+    """Poll a journal FILE for new records — the standby transport for
+    real (multi-process) deployments, where the in-memory subscription
+    stream does not cross the process boundary. Tracks a byte offset and
+    restarts from 0 when the file was compacted (rotation rewrites only
+    the open intents via rename) — replaying the rewritten open intents
+    is idempotent (intents alone change nothing, and the follower's
+    apply operations are idempotent). Incomplete tail lines (a writer
+    mid-append) are left for the next poll."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0
+        self._head: Optional[bytes] = None
+
+    def poll(self) -> List[dict]:
+        if not os.path.exists(self.path):
+            return []
+        # Rotation detection must not rely on the file SHRINKING: a
+        # lagging tailer can sit mid-way through the old file while the
+        # compacted rewrite is LARGER than its offset — reading on from
+        # the stale offset would skip rewritten open intents and tear a
+        # record. The first line identifies the file generation
+        # (compaction rewrites starting at the lowest open seq), so a
+        # changed head restarts the tail; the shrink check backstops the
+        # rare head-preserving rotation.
+        with open(self.path, "rb") as fb:
+            head = fb.readline()
+        if head.endswith(b"\n") and head != self._head:
+            if self._head is not None:
+                self._offset = 0
+            self._head = head
+        size = os.path.getsize(self.path)
+        if size < self._offset:
+            self._offset = 0             # head-preserving rotation
+        if size == self._offset:
+            return []
+        out: List[dict] = []
+        with open(self.path, "r", encoding="utf-8") as f:
+            f.seek(self._offset)
+            while True:
+                line = f.readline()
+                if not line:
+                    break
+                if not line.endswith("\n"):
+                    break                # torn tail: retry next poll
+                self._offset = f.tell()
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+        return out
 
 
 class ReconcileReport:

@@ -185,6 +185,18 @@ def build_parser() -> argparse.ArgumentParser:
                            "admitted/denied/pipelined/preempted + reason")
     tw.add_argument("--job", required=True)
 
+    leader = sub.add_parser(
+        "leader", description="HA control-plane verbs "
+                              "(docs/robustness.md): inspect the "
+                              "scheduler lease / fencing epoch in the "
+                              "store").add_subparsers(dest="verb")
+    ls = leader.add_parser(
+        "status", description="Who holds the scheduler lease, its "
+                              "fencing epoch, and how stale the renew "
+                              "timestamp is")
+    ls.add_argument("--name", default="vc-scheduler")
+    ls.add_argument("--namespace", default="volcano-system")
+
     sub.add_parser("version")
     return parser
 
@@ -247,6 +259,25 @@ def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
         return 0
     if store is None:
         out("no cluster store attached (in-process CLI requires a store)")
+        return 1
+    if args.group == "leader":
+        if args.verb == "status":
+            import time as _time
+            lease = store.get("Lease", args.namespace, args.name)
+            if lease is None:
+                out(f"no lease {args.namespace}/{args.name} — no leader "
+                    f"elected (or HA not enabled)")
+                return 1
+            age = _time.time() - lease.renew_time if lease.renew_time \
+                else float("inf")
+            live = age <= lease.lease_duration
+            out(f"holder={lease.holder or '-'}\t"
+                f"epoch={int(getattr(lease, 'epoch', 0))}\t"
+                f"renew_age_s={age:.1f}\t"
+                f"lease_duration_s={lease.lease_duration}\t"
+                f"{'LIVE' if live else 'EXPIRED'}")
+            return 0
+        build_parser().print_help()
         return 1
     if args.group == "job":
         jc = JobCommands(store)

@@ -3,7 +3,7 @@
 from .arguments import Arguments
 from .conf import (DEFAULT_SCHEDULER_CONF, Configuration, PluginOption,
                    SchedulerConfiguration, Tier, parse_scheduler_conf)
-from .framework import close_session, open_session
+from .framework import abandon_session, close_session, open_session
 from .registry import (get_action, get_plugin_builder, load_custom_plugins,
                        register_action, register_plugin_builder)
 from .session import (ABSTAIN, PERMIT, REJECT, Event, EventHandler, Session,
@@ -13,7 +13,7 @@ from .statement import Statement
 __all__ = [
     "Arguments", "DEFAULT_SCHEDULER_CONF", "Configuration", "PluginOption",
     "SchedulerConfiguration", "Tier", "parse_scheduler_conf",
-    "close_session", "open_session",
+    "abandon_session", "close_session", "open_session",
     "get_action", "get_plugin_builder", "load_custom_plugins",
     "register_action", "register_plugin_builder",
     "ABSTAIN", "PERMIT", "REJECT", "Event", "EventHandler", "Session",

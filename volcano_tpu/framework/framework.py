@@ -126,6 +126,18 @@ def open_session(cache, tiers: List[Tier],
     return ssn
 
 
+def abandon_session(ssn: Session) -> None:
+    """Session ROLLBACK path (docs/robustness.md HA section): release the
+    session's GC window WITHOUT the close-time writebacks — no plugin
+    on_session_close, no podgroup status flush. Used when a leader is
+    demoted mid-cycle: the session's decision state must not be
+    half-applied by a replica that no longer owns it. Side effects already
+    executed through the cache funnels stand (they carried a then-valid
+    fencing epoch); everything session-local is simply dropped.
+    Idempotent, like close_session's window resume."""
+    _gc_resume(getattr(ssn, "_gc_window", None))
+
+
 def close_session(ssn: Session) -> None:
     try:
         for plugin in ssn.plugins.values():

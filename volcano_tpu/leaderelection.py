@@ -10,6 +10,32 @@ real API server); exactly one holds the lease and runs, the rest retry.
 A leader that misses its renew deadline loses the lease to the first
 challenger, mirroring the k8s LeaseDuration/RenewDeadline/RetryPeriod
 semantics.
+
+Fencing (docs/robustness.md HA section): every lease ACQUISITION —
+create or takeover, never a renewal — mints a monotonically increasing
+**fencing epoch**. The epoch rides the lease object, so the store's CAS
+makes it split-brain safe: two challengers cannot both mint epoch E+1.
+The holder exposes it as ``fencing_epoch``; the scheduler stamps every
+journaled bind/evict intent with it, and the executor-side fencing gate
+(cache/executors.FencedBinder/FencedEvictor) rejects any operation whose
+epoch is below the highest the cluster has observed. A paused or
+partitioned ex-leader that wakes up mid-bind therefore physically cannot
+double-bind — safety holds by construction, not by timing.
+
+Two consumption styles:
+
+- ``run()``: the threaded RunOrDie loop (real deployments) — blocks,
+  renews on a daemon thread, fires ``on_lease_lost`` when the renew
+  deadline passes without a successful renewal;
+- ``step()``: one synchronous acquire/renew attempt — the cycle-driven
+  HA mode (``sim --ha N`` and the scheduler shell's per-cycle gate)
+  calls it each cycle instead of spawning threads, which keeps elections
+  on the virtual clock and byte-deterministic.
+
+``FlapGuard`` reuses the device_health cool-down idiom for FLAPPING
+leadership: a replica that keeps losing the lease (bad clock, overloaded
+host) abstains from re-contending for a doubling window instead of
+thrashing the lease between replicas.
 """
 
 from __future__ import annotations
@@ -29,14 +55,79 @@ DEFAULT_RETRY_PERIOD = 2.0
 
 @dataclass
 class Lease:
-    """coordination.k8s.io/v1 Lease mirror."""
+    """coordination.k8s.io/v1 Lease mirror, extended with the fencing
+    epoch (the k8s analogue would be an annotation; leaseTransitions is
+    the closest stock field). ``epoch`` increments on every ACQUISITION
+    (create/takeover) and is carried unchanged across renewals, so it
+    totally orders leaderships."""
 
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     holder: str = ""
     renew_time: float = 0.0
     lease_duration: float = DEFAULT_LEASE_DURATION
+    epoch: int = 0
 
     KIND = "Lease"
+
+
+class FlapGuard:
+    """Cool-down for flapping leadership (the device_health.DeviceHealth
+    idiom applied to elections): each lease LOSS opens a doubling
+    abstention window during which ``may_contend()`` is False — the
+    replica sits out instead of thrashing the lease. The loss streak
+    resets only once a re-acquired leadership has been HELD for a full
+    base cooldown (the stability horizon) — resetting on the first
+    successful renewal would make the doubling unreachable, since a
+    loss always follows an acquisition. Runs on an injectable
+    ``time_fn`` (the sim pins virtual time)."""
+
+    def __init__(self, cooldown_s: float = 5.0, max_cooldown_s: float = 80.0,
+                 time_fn: Callable[[], float] = time.monotonic):
+        self.cooldown_s = cooldown_s
+        self.max_cooldown_s = max_cooldown_s
+        self.time_fn = time_fn
+        self.consecutive_losses = 0
+        self.total_losses = 0
+        self._until: Optional[float] = None
+        self._stable_after: Optional[float] = None
+
+    def record_loss(self) -> float:
+        """Leadership lost: open (or double) the abstention window.
+        Returns the window length in force."""
+        self.consecutive_losses += 1
+        self.total_losses += 1
+        window = min(self.cooldown_s * (2 ** (self.consecutive_losses - 1)),
+                     self.max_cooldown_s)
+        self._until = self.time_fn() + window
+        self._stable_after = None
+        return window
+
+    def record_stable(self) -> None:
+        """Called on every successful acquire/renew. The first call after
+        a loss stamps the stability horizon (now + base cooldown); the
+        streak resets only when leadership is still held past it."""
+        if self.consecutive_losses == 0:
+            return
+        now = self.time_fn()
+        if self._stable_after is None:
+            self._stable_after = now + self.cooldown_s
+            return
+        if now >= self._stable_after:
+            self.consecutive_losses = 0
+            self._until = None
+            self._stable_after = None
+
+    def may_contend(self) -> bool:
+        return self._until is None or self.time_fn() >= self._until
+
+    def detail(self) -> dict:
+        return {
+            "may_contend": self.may_contend(),
+            "consecutive_losses": self.consecutive_losses,
+            "total_losses": self.total_losses,
+            "cooldown_remaining_s": max(0.0, self._until - self.time_fn())
+            if self._until is not None else 0.0,
+        }
 
 
 class LeaderElector:
@@ -53,7 +144,10 @@ class LeaderElector:
                  renew_deadline: float = DEFAULT_RENEW_DEADLINE,
                  retry_period: float = DEFAULT_RETRY_PERIOD,
                  time_fn: Callable[[], float] = time.time,
-                 mono_fn: Callable[[], float] = time.monotonic):
+                 mono_fn: Callable[[], float] = time.monotonic,
+                 on_lease_lost: Optional[Callable[[], None]] = None,
+                 authority=None,
+                 flap_guard: Optional[FlapGuard] = None):
         # Injectable time sources (vlint VT002). Lease timestamps are
         # wall-clock (``time_fn``) — they are compared ACROSS processes
         # (native store / RPC shim replicas), where a per-process
@@ -76,6 +170,23 @@ class LeaderElector:
         self.retry_period = retry_period
         self._stop = threading.Event()
         self.leading = False
+        # fencing (docs/robustness.md): the epoch of OUR current (or most
+        # recent) leadership. Deliberately NOT reset on lease loss — a
+        # fenced ex-leader keeps stamping operations with its stale epoch,
+        # which is exactly what the executor gate rejects.
+        self.fencing_epoch = 0
+        # distinct from on_stopped_leading (which also fires on voluntary
+        # stop): fires only when the lease was LOST — renew-deadline miss
+        # or an injected revocation. The scheduler's demote path hangs off
+        # this.
+        self.on_lease_lost = on_lease_lost
+        # cluster-side epoch watermark (cache/executors.FencingAuthority):
+        # advanced on every successful acquire so a deposed predecessor's
+        # writes are rejectable the moment the new leader exists
+        self.authority = authority
+        self.flap_guard = flap_guard
+        self.takeovers = 0          # acquisitions of an expired foreign lease
+        self._last_renew_mono: Optional[float] = None
 
     # -- lock primitives ----------------------------------------------------
 
@@ -99,42 +210,125 @@ class LeaderElector:
             fresh = Lease(metadata=ObjectMeta(name=self.name,
                                               namespace=self.namespace),
                           holder=self.identity, renew_time=now,
-                          lease_duration=self.lease_duration)
+                          lease_duration=self.lease_duration, epoch=1)
             try:
                 self.store.create(fresh)
             except ValueError:
                 return False          # lost the create race; retry later
+            self._claimed(1)
             return True
         if lease.holder != self.identity \
                 and now - lease.renew_time <= lease.lease_duration:
             return False              # live lease held by someone else
-        # renew (ours) or takeover (expired): CAS on the rv we just read
+        # renew (ours, while we believe we lead) carries the epoch
+        # unchanged; any ACQUISITION — takeover of an expired foreign
+        # lease, or re-claiming our own lease after we stopped leading
+        # (a restarted incarnation, a fenced ex-leader re-contending) —
+        # mints epoch+1. The CAS makes the mint race-free: two
+        # challengers reading the same expired lease cannot both win the
+        # write, so exactly one epoch E+1 ever exists.
+        renewal = lease.holder == self.identity and self.leading
+        epoch = int(getattr(lease, "epoch", 0)) + (0 if renewal else 1)
         claimed = Lease(
             metadata=ObjectMeta(name=self.name, namespace=self.namespace),
             holder=self.identity, renew_time=now,
-            lease_duration=self.lease_duration)
+            lease_duration=self.lease_duration, epoch=epoch)
         try:
             self.store.update(
                 claimed, expect_rv=lease.metadata.resource_version)
         except ConflictError:
             return False              # another challenger won this round
+        if not renewal and lease.holder != self.identity:
+            self.takeovers += 1
+        self._claimed(epoch)
         return True
 
-    def release(self) -> None:
+    def _claimed(self, epoch: int) -> None:
+        """A lease write of ours landed: record the epoch locally, feed
+        the renew-deadline watchdog (monotonic), and advance the
+        cluster-side watermark, so a deposed predecessor's stale-epoch
+        operations are rejectable from this instant on."""
+        self.fencing_epoch = epoch
+        self._last_renew_mono = self.mono_fn()
+        if self.authority is not None:
+            self.authority.advance(epoch)
+
+    def _write_released(self) -> None:
         from .store import ConflictError
         lease = self._lease()
         if lease is not None and lease.holder == self.identity:
+            # epoch survives a release: the next acquirer must mint a
+            # HIGHER epoch than ours, or fencing would stop ordering
+            # leaderships
             released = Lease(
                 metadata=ObjectMeta(name=self.name,
                                     namespace=self.namespace),
                 holder=self.identity, renew_time=0.0,
-                lease_duration=self.lease_duration)
+                lease_duration=self.lease_duration,
+                epoch=int(getattr(lease, "epoch", 0)))
             try:
                 self.store.update(
                     released, expect_rv=lease.metadata.resource_version)
             except ConflictError:
                 pass                  # someone already took it over
+
+    def release(self) -> None:
+        self._write_released()
         self.leading = False
+
+    # -- cycle-driven (threadless) consumption ------------------------------
+
+    def step(self, now: Optional[float] = None) -> bool:
+        """One synchronous election/renew attempt; returns whether this
+        replica leads AFTER the attempt. The cycle-driven HA mode
+        (scheduler shell per cycle; ``sim --ha N`` on the virtual clock)
+        calls this instead of running the threaded loops.
+
+        k8s renew semantics: one failed renewal does not depose a live
+        leader — leadership is lost only when ``renew_deadline`` elapses
+        (on the per-process monotonic clock) without a successful
+        renewal. A non-leader honours the FlapGuard abstention window
+        before contending."""
+        if not self.leading and self.flap_guard is not None \
+                and not self.flap_guard.may_contend():
+            return False
+        ok = self.try_acquire_or_renew(now)
+        mono = self.mono_fn()
+        if ok:
+            self._last_renew_mono = mono
+            self.leading = True
+            if self.flap_guard is not None:
+                self.flap_guard.record_stable()
+            return True
+        if self.leading:
+            if self._last_renew_mono is None \
+                    or mono - self._last_renew_mono > self.renew_deadline:
+                self._lose()
+        return self.leading
+
+    def _lose(self) -> None:
+        """Leadership lost (renew deadline passed, or revoked): flip the
+        flag, open the flap cool-down, fire the loss callbacks. The
+        fencing epoch deliberately stays at its stale value — see
+        __init__."""
+        if not self.leading:
+            return
+        self.leading = False
+        if self.flap_guard is not None:
+            self.flap_guard.record_loss()
+        if self.on_lease_lost is not None:
+            self.on_lease_lost()
+        if self.on_stopped_leading is not None:
+            self.on_stopped_leading()
+
+    def revoke(self) -> None:
+        """Forcibly relinquish a held lease AND the local leading state —
+        the chaos LeaseLossInjector's entry point (models the lease
+        being administratively stolen / a partition expiring it). The
+        lease is written back expired-with-epoch so any challenger can
+        take over immediately with epoch+1."""
+        self._write_released()
+        self._lose()
 
     # -- the election loop --------------------------------------------------
 
@@ -168,11 +362,11 @@ class LeaderElector:
                 last_renew = self.mono_fn()
             elif self.mono_fn() - last_renew > self.renew_deadline:
                 # lost the lease: stop leading (RunOrDie klog.Fatal analogue
-                # — here we signal the component loop to stop instead)
-                self.leading = False
+                # — here we signal the component loop to stop instead).
+                # _lose fires on_lease_lost + on_stopped_leading and opens
+                # the flap cool-down.
                 self._stop.set()
-                if self.on_stopped_leading is not None:
-                    self.on_stopped_leading()
+                self._lose()
                 return
 
     def stop(self) -> None:

@@ -137,6 +137,16 @@ if _HAVE_PROM:
         f"{_SUBSYSTEM}_device_degraded_cycles_total",
         "Allocate cycles that ran on the CPU placer because the "
         "device cool-down window was open")
+    _leader_g = Gauge(f"{_SUBSYSTEM}_leader",
+                      "1 this replica holds the scheduler lease, 0 "
+                      "follower/fenced (docs/robustness.md HA)")
+    _fencing_rej = Counter(f"{_SUBSYSTEM}_fencing_rejections_total",
+                           "Executor operations rejected for carrying a "
+                           "stale fencing epoch (a deposed leader's "
+                           "write)", ["op"])
+    _failovers = Counter(f"{_SUBSYSTEM}_failovers_total",
+                         "Leadership takeovers (a replica acquired an "
+                         "expired foreign lease and resumed scheduling)")
 
 
 def update_e2e_duration(seconds: float) -> None:
@@ -172,6 +182,8 @@ def health_detail() -> dict:
                  if k[0] == "state_drift"}
         journal = {k[1]: v for k, v in _counters.items()
                    if k[0] == "journal_replayed"}
+        fenced = {k[1]: v for k, v in _counters.items()
+                  if k[0] == "fencing_rejections"}
         return {
             "state": _health["state"],
             "consecutive_failures": _health["consecutive_failures"],
@@ -181,6 +193,15 @@ def health_detail() -> dict:
                                               {"available": True})),
             "state_drift_total": drift,
             "journal_replayed_total": journal,
+            # HA role reporting (docs/robustness.md): which role this
+            # replica is in, its fencing epoch, and how many stale-epoch
+            # writes the fencing gate has stopped
+            "leader": dict(_health_detail.get("leader",
+                                              {"leading": False,
+                                               "role": "standalone",
+                                               "epoch": 0})),
+            "fencing_rejections_total": fenced,
+            "failovers_total": _counters.get(("failovers",), 0),
         }
 
 
@@ -293,6 +314,37 @@ def set_device_health(available: bool, detail: Optional[dict] = None) -> None:
         _device_ok.set(1.0 if available else 0.0)
 
 
+def set_leader(leading: bool, role: str = "", epoch: int = 0) -> None:
+    """Publish this replica's leadership state (the scheduler's HA gate
+    calls it on every role transition and each gated cycle); role/epoch
+    land in /healthz?detail under "leader"."""
+    with _lock:
+        _gauges[("leader",)] = 1.0 if leading else 0.0
+        _health_detail["leader"] = {"leading": bool(leading),
+                                    "role": role, "epoch": int(epoch)}
+    if _HAVE_PROM:
+        _leader_g.set(1.0 if leading else 0.0)
+
+
+def register_fencing_rejection(op: str) -> None:
+    """The fencing gate rejected a stale-epoch executor operation — a
+    deposed leader tried to mutate cluster state and was stopped
+    (docs/robustness.md HA section)."""
+    with _lock:
+        _counters[("fencing_rejections", op)] += 1
+    if _HAVE_PROM:
+        _fencing_rej.labels(op=op).inc()
+
+
+def register_failover() -> None:
+    """A replica took over an expired foreign lease and resumed
+    scheduling."""
+    with _lock:
+        _counters[("failovers",)] += 1
+    if _HAVE_PROM:
+        _failovers.inc()
+
+
 def register_dead_letter(op: str) -> None:
     """A failed side effect exhausted its resync retry budget and was
     parked in the cache's dead-letter set."""
@@ -318,6 +370,7 @@ _EXPO_GAUGES = {
     "resync_dead_letter_size": (f"{_SUBSYSTEM}_resync_dead_letter_size",
                                 None),
     "device_healthy": (f"{_SUBSYSTEM}_device_healthy", None),
+    "leader": (f"{_SUBSYSTEM}_leader", None),
 }
 _EXPO_COUNTERS = {
     "attempts": (f"{_SUBSYSTEM}_schedule_attempts_total", "result"),
@@ -334,6 +387,8 @@ _EXPO_COUNTERS = {
     "device_faults": (f"{_SUBSYSTEM}_device_faults_total", "kind"),
     "device_degraded_cycles": (
         f"{_SUBSYSTEM}_device_degraded_cycles_total", None),
+    "fencing_rejections": (f"{_SUBSYSTEM}_fencing_rejections_total", "op"),
+    "failovers": (f"{_SUBSYSTEM}_failovers_total", None),
 }
 # duration-series key -> (family, label name, unit suffix already in name)
 _EXPO_DURATIONS = {

@@ -26,8 +26,8 @@ import time
 from typing import Callable, List, Optional, Tuple
 
 from . import metrics
-from .framework import (close_session, get_action, open_session,
-                        parse_scheduler_conf)
+from .framework import (abandon_session, close_session, get_action,
+                        open_session, parse_scheduler_conf)
 from .framework.conf import SchedulerConfiguration
 from .obs import audit as obs_audit
 from .obs import trace as obs_trace
@@ -49,6 +49,18 @@ DEFAULT_BACKOFF_JITTER = 0.2
 # e2e-timed window) and repairs any drift. 0 disables; the env var
 # overrides the constructor default.
 DEFAULT_DRIFT_VERIFY_EVERY = 64
+
+# HA role state machine (docs/robustness.md HA section). STANDALONE is
+# the no-elector mode (every pre-HA deployment); with an elector attached
+# the shell moves follower -> candidate -> leader, demotes to FENCED on a
+# mid-cycle lease loss (the open session is abandoned, never
+# half-applied), and a fenced replica re-enters as follower subject to
+# the elector's flap cool-down.
+ROLE_STANDALONE = "standalone"
+ROLE_FOLLOWER = "follower"
+ROLE_CANDIDATE = "candidate"
+ROLE_LEADER = "leader"
+ROLE_FENCED = "fenced"
 
 
 def _drift_verify_default() -> int:
@@ -131,7 +143,106 @@ class Scheduler:
             if drift_verify_every is None else drift_verify_every
         self._cycles_run = 0
         self._reconciled = False
+        # HA (docs/robustness.md): no elector -> standalone, the historical
+        # single-process behavior, zero new work per cycle. attach_elector
+        # flips the shell into the role state machine.
+        self.elector = None
+        self.role = ROLE_STANDALONE
+        self.last_handoff_report = None
+        # sim hook: a restart harness points this at the cluster-truth
+        # oracle for the previous leader's crash window; consumed (once)
+        # by the handoff reconcile when this replica becomes leader.
+        self.reconcile_oracle_fn: Optional[Callable] = None
+        # sim hook mirroring action_fault_hook for the close boundary:
+        # called (with the open session) right before close_session so a
+        # seeded SimKill can land INSIDE the close — the adversarial
+        # point where binds executed but writebacks didn't.
+        self.close_fault_hook: Optional[Callable] = None
         self._load_conf(conf_text)
+
+    # -- HA role state machine (docs/robustness.md) --------------------------
+
+    def attach_elector(self, elector) -> None:
+        """Enter HA mode: this replica schedules only while ``elector``
+        holds the lease. Every journaled side effect is stamped with the
+        elector's fencing epoch (the cache funnels read it through
+        fencing_epoch_fn), so a deposed incarnation's writes are
+        rejectable at the executor gate."""
+        self.elector = elector
+        self.role = ROLE_FOLLOWER
+        # shell-level leadership edge detector: the become-leader branch
+        # of the gate (handoff reconcile, failover metric) must fire on
+        # the FIRST gated cycle of every leadership, regardless of
+        # whether the threaded elector.run() or the cycle-driven step()
+        # flipped elector.leading first
+        self._was_leading = False
+        if hasattr(self.cache, "fencing_epoch_fn"):
+            self.cache.fencing_epoch_fn = self.current_fencing_epoch
+        metrics.set_leader(False, self.role, 0)
+
+    def current_fencing_epoch(self) -> int:
+        return self.elector.fencing_epoch if self.elector is not None else 0
+
+    def _ha_gate(self, rec) -> bool:
+        """The per-cycle leadership gate: one election/renew step. Returns
+        True when this replica may run the cycle (it leads). On a fresh
+        acquisition the handoff runs startup_reconcile BEFORE the first
+        cycle — the journal's crash window (a dead predecessor's
+        unsettled intent) is settled against cluster truth, which is what
+        bounds failover to lease-acquire -> reconcile -> resume."""
+        elector = self.elector
+        led_before = self._was_leading
+        with rec.span("elect", role=self.role):
+            leading = elector.step()
+        if not leading:
+            self._was_leading = False
+            # a fenced ex-leader re-enters as an ordinary follower here:
+            # FENCED only describes the demoted remainder of the cycle
+            # the lease was lost in (contention throttling is the flap
+            # guard's job, not a role)
+            self.role = ROLE_FOLLOWER
+            metrics.set_leader(False, self.role, elector.fencing_epoch)
+            return False
+        if not led_before:
+            # epoch 1 is the first-ever leadership; any later acquisition
+            # (takeover of a foreign lease, or re-claiming after a loss)
+            # is a leadership transition — a failover
+            takeover = elector.fencing_epoch > 1
+            with rec.span("handoff", epoch=elector.fencing_epoch,
+                          takeover=takeover):
+                oracle = None
+                if self.reconcile_oracle_fn is not None:
+                    oracle = self.reconcile_oracle_fn()
+                try:
+                    if oracle is not None:
+                        self.last_handoff_report = \
+                            self.startup_reconcile(*oracle)
+                    else:
+                        self.last_handoff_report = self.startup_reconcile()
+                except Exception:
+                    log.exception("handoff journal reconciliation failed; "
+                                  "continuing (side effects may retry)")
+            if takeover:
+                metrics.register_failover()
+            log.warning("replica %s became leader (epoch %d)",
+                        elector.identity, elector.fencing_epoch)
+        self.role = ROLE_LEADER
+        self._was_leading = True
+        metrics.set_leader(True, self.role, elector.fencing_epoch)
+        return True
+
+    def _demoted_mid_cycle(self) -> bool:
+        """True when HA mode is on and leadership was lost since the
+        cycle's gate passed (the renew watchdog or a revocation flipped
+        ``elector.leading``). The action loop checks this between
+        actions; a demoted leader abandons the open session rather than
+        half-applying it."""
+        if self.elector is None or self.elector.leading:
+            return False
+        self.role = ROLE_FENCED
+        self._was_leading = False
+        metrics.set_leader(False, self.role, self.elector.fencing_epoch)
+        return True
 
     def _load_conf(self, conf_text: Optional[str] = None) -> None:
         if conf_text is None and self.conf_path and os.path.exists(self.conf_path):
@@ -170,6 +281,13 @@ class Scheduler:
             rec.begin_cycle(cycle)
         try:
             with rec.span("cycle", cycle=cycle):
+                # HA gate: a replica without the lease runs its election
+                # step and NOTHING else — no resync retries (side effects
+                # are the leader's), no snapshot, no session. run_once
+                # refusing to open a session without a live lease IS the
+                # standby contract.
+                if self.elector is not None and not self._ha_gate(rec):
+                    return []
                 return self._run_once_traced(rec, cycle)
         finally:
             if began:
@@ -205,6 +323,7 @@ class Scheduler:
             return errors
         sched_sp = rec.span("schedule")
         crashed = False
+        demoted = False
         with sched_sp:
             with rec.span("open_session"):
                 ssn = open_session(self.cache, self.conf.tiers,
@@ -212,6 +331,19 @@ class Scheduler:
                                    time_fn=self.clock.now)
             try:
                 for name, action in runnable:
+                    if self._demoted_mid_cycle():
+                        # the lease was lost while the cycle ran: stop
+                        # scheduling NOW. Already-executed side effects
+                        # carried a then-valid epoch; anything we would
+                        # issue from here on is a deposed leader's write
+                        # (the fencing gate would reject it anyway) —
+                        # and the open session must not be half-applied,
+                        # so close-time writebacks are skipped below.
+                        demoted = True
+                        log.warning("lease lost mid-cycle; demoting to "
+                                    "fenced and abandoning the open "
+                                    "session")
+                        break
                     action_sp = rec.span("action:" + name, action=name)
                     poisoned = False
                     try:
@@ -239,6 +371,8 @@ class Scheduler:
                                   "aborting the remaining actions this "
                                   "cycle", name)
                         break
+                if not demoted and self._demoted_mid_cycle():
+                    demoted = True       # lost during the last action
             except BaseException as exc:
                 # a non-Exception escaping here is a (simulated or real)
                 # process death — SimKill, KeyboardInterrupt. A SIGKILL'd
@@ -250,13 +384,22 @@ class Scheduler:
                 raise
             finally:
                 if not crashed:
-                    with rec.span("close_session"):
-                        close_session(ssn)
+                    if demoted:
+                        # session ROLLBACK path: resume the GC window but
+                        # run neither plugin on_session_close nor the
+                        # podgroup status flush — a fenced ex-leader may
+                        # not publish decision state it no longer owns
+                        abandon_session(ssn)
+                    else:
+                        with rec.span("close_session"):
+                            if self.close_fault_hook is not None:
+                                self.close_fault_hook(ssn)
+                            close_session(ssn)
         metrics.update_e2e_duration(sched_sp.dur_s)
         # decision audit (docs/observability.md): harvested AFTER
         # close_session so the gang plugin's job_fit_errors writeback is
         # the denial reason, outside the e2e-timed window
-        if obs_audit.AUDIT.enabled:
+        if not demoted and obs_audit.AUDIT.enabled:
             try:
                 with rec.span("audit"):
                     obs_audit.harvest_cycle(ssn, cycle, self.clock.time())
@@ -391,14 +534,27 @@ class Scheduler:
                 if c.name in (name, "allocate"):
                     engine = c.arguments.get("engine", engine)
             break
-        if engine is None or engine.startswith("callbacks"):
+        # the preempt walk warms too (its (preemptor, victim-slot) axes
+        # bucket pow2 — evict_tpu.prewarm_preempt mirrors the live path)
+        preempt_engine = None
+        if "preempt" in self.conf.actions:
+            action = get_action("preempt")
+            preempt_engine = getattr(action, "engine", None) or "callbacks"
+            for c in self.conf.configurations:
+                if c.name == "preempt":
+                    preempt_engine = c.arguments.get("engine",
+                                                     preempt_engine)
+        if (engine is None or engine.startswith("callbacks")) \
+                and preempt_engine not in ("tpu", "tpu-sharded"):
             return 0
         from .actions.allocate import prewarm_shapes
         ssn = open_session(self.cache, self.conf.tiers,
                            self.conf.configurations,
                            time_fn=self.clock.now)
         try:
-            return prewarm_shapes(ssn, configs, engine)
+            return prewarm_shapes(ssn, configs,
+                                  engine or "callbacks",
+                                  preempt_engine=preempt_engine)
         finally:
             close_session(ssn)
 
@@ -411,6 +567,7 @@ class Scheduler:
         self._elector = LeaderElector(
             store, name, on_started_leading=self.run,
             on_stopped_leading=self.stop, **lease_kwargs)
+        self.attach_elector(self._elector)
         self._elector.run()
 
     def start(self) -> threading.Thread:

@@ -12,6 +12,14 @@ Crash-recovery soak (docs/robustness.md; the CI chaos step)::
 
     python -m volcano_tpu.sim --scenario smoke --chaos-rate 0.2 \\
         --kill-cycles 3,7,12 --verify-restart-equivalence
+
+HA soak (docs/robustness.md HA section; the CI ha-soak step) — three
+replica schedulers, seeded LEADER kills + a mid-cycle lease loss,
+verified against the single-scheduler oracle::
+
+    python -m volcano_tpu.sim --scenario smoke --ha 3 \\
+        --kill-cycles 2,5,9,13 --lease-loss-cycles 7 \\
+        --verify-ha-equivalence
 """
 
 from __future__ import annotations
@@ -19,7 +27,8 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .report import deterministic_json, terminal_accounting, to_json
+from .report import (deterministic_json, oracle_part, terminal_accounting,
+                     to_json)
 from .runner import SimRunner
 from .trace import load_trace, write_trace
 from .workload import SCENARIOS, make_scenario
@@ -70,6 +79,24 @@ def main(argv=None) -> int:
                          "killed run converged to the same terminal "
                          "decision-plane accounting with zero "
                          "double-binds (exit 1 otherwise)")
+    ap.add_argument("--ha", type=int, default=1, metavar="N",
+                    help="run N replica schedulers over one virtual "
+                         "cluster (lease-based leadership + fencing "
+                         "epochs + warm journal-tail standbys; "
+                         "docs/robustness.md). --kill-cycles then kills "
+                         "the LEADER at seeded adversarial points")
+    ap.add_argument("--lease-loss-cycles", default="",
+                    help="comma-separated virtual cycles on which the "
+                         "leader LOSES ITS LEASE mid-cycle (no process "
+                         "death): it must abandon the open session, "
+                         "demote to fenced, and a standby takes over")
+    ap.add_argument("--verify-ha-equivalence", action="store_true",
+                    help="also run the SAME trace single-replica and "
+                         "assert equivalence: byte-identical decision "
+                         "plane when the HA run is non-contended (no "
+                         "kills/lease losses), terminal-accounting "
+                         "equivalence + zero double-binds otherwise "
+                         "(exit 1 on mismatch)")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -93,6 +120,8 @@ def main(argv=None) -> int:
     chaos_seed = args.seed if args.chaos_seed is None else args.chaos_seed
     kill_seed = args.seed if args.kill_seed is None else args.kill_seed
     kill_cycles = [int(c) for c in args.kill_cycles.split(",") if c.strip()]
+    lease_loss = [int(c) for c in args.lease_loss_cycles.split(",")
+                  if c.strip()]
 
     def wraps():
         if not args.chaos_rate:
@@ -103,13 +132,17 @@ def main(argv=None) -> int:
                 lambda e: ChaosEvictor(e, failure_rate=args.chaos_rate,
                                        seed=chaos_seed))
 
-    def run(kills):
+    def run(kills, replicas=None, losses=None):
         bw, ew = wraps()
         runner = SimRunner(trace, conf_text=conf_text, period=args.period,
                            seed=args.seed, max_cycles=args.max_cycles,
                            scenario=args.scenario, binder_wrap=bw,
                            evictor_wrap=ew, kill_cycles=kills,
-                           kill_seed=kill_seed)
+                           kill_seed=kill_seed,
+                           ha_replicas=args.ha if replicas is None
+                           else replicas,
+                           lease_loss_cycles=lease_loss if losses is None
+                           else losses)
         return runner.run()
 
     if args.trace_out:
@@ -153,6 +186,40 @@ def main(argv=None) -> int:
         print(f"restart-equivalence OK: {report['restarts']} restarts, "
               f"journal={report['journal_replayed']}, "
               f"accounting={got}", file=sys.stderr)
+    if args.verify_ha_equivalence:
+        import json as _json
+        baseline = run([], replicas=1, losses=[])
+        problems = []
+        contended = bool(kill_cycles or lease_loss)
+        if not contended:
+            got_json = _json.dumps(oracle_part(report), sort_keys=True,
+                                   separators=(",", ":"))
+            want_json = _json.dumps(oracle_part(baseline), sort_keys=True,
+                                    separators=(",", ":"))
+            if got_json != want_json:
+                problems.append("non-contended HA decision plane differs "
+                                "from the single-scheduler oracle")
+        else:
+            got = terminal_accounting(report)
+            want = terminal_accounting(baseline)
+            if got != want:
+                problems.append(f"terminal accounting diverged: "
+                                f"ha={got} oracle={want}")
+        if report.get("double_binds"):
+            problems.append(f"double-binds in HA run: "
+                            f"{report['double_binds']}")
+        if report["jobs"]["completed"] != report["jobs"]["arrived"]:
+            problems.append("HA run did not complete every arrived job")
+        if problems:
+            for p in problems:
+                print(f"ha-equivalence FAILED: {p}", file=sys.stderr)
+            return 1
+        print(f"ha-equivalence OK: replicas={args.ha}, "
+              f"failovers={report.get('failovers', 0)}, "
+              f"fenced_rejections={report.get('fenced_rejections', 0)}, "
+              f"failover_cycles_max="
+              f"{report.get('ha', {}).get('failover_cycles_max', 0)}",
+              file=sys.stderr)
     return 0
 
 

@@ -107,6 +107,8 @@ def build_report(runner, actions_ms: Dict[tuple, list],
     percentiles below cover only the newest retained window, not every
     cycle."""
     conf = runner.sched.conf
+    view = runner.view_cache() if hasattr(runner, "view_cache") \
+        else runner.cache
     acts = {}
     for key, vals in actions_ms.items():
         if len(key) == 2 and key[0] == "action" and vals:
@@ -124,18 +126,24 @@ def build_report(runner, actions_ms: Dict[tuple, list],
             "arrived": runner.arrived,
             "admitted": len(runner.gang_admission),
             "completed": runner.completed,
-            "unfinished": len(runner.cache.jobs),
+            "unfinished": len(view.jobs),
         },
         "binds": len(runner.binder.sequence),
         "evicts": len(runner.evictor.sequence),
         "requeues": runner.requeues,
-        "dead_letter": len(runner.cache.dead_letter),
+        "dead_letter": len(view.dead_letter),
         "action_failures": len(runner.action_failures),
         # crash/restart plane (zero on unkilled runs; deterministic from
         # kill_cycles + kill_seed, so still part of the decision plane)
         "restarts": getattr(runner, "restarts", 0),
         "double_binds": getattr(runner, "double_binds", 0),
         "journal_replayed": dict(getattr(runner, "_journal_replayed", {})),
+        # HA plane (docs/robustness.md): leadership transitions and the
+        # fencing gate's stale-epoch rejections — deterministic from
+        # (trace, seed, kill/lease-loss config), so decision plane
+        "failovers": getattr(runner, "failovers", 0),
+        "fenced_rejections": runner.authority.rejections
+        if getattr(runner, "authority", None) is not None else 0,
         "jct_s": percentiles(runner.jct),
         "queueing_delay_s": percentiles(runner.queueing_delay),
         "gang_admission_s": percentiles(runner.gang_admission),
@@ -159,6 +167,13 @@ def build_report(runner, actions_ms: Dict[tuple, list],
     if actions_truncated:
         report["wallclock"]["actions_ms_truncated"] = \
             list(actions_truncated)
+    if getattr(runner, "replicas", None):
+        report["ha"] = {
+            "replicas": runner.ha_replicas,
+            "failover_cycles": list(runner.failover_cycles),
+            "failover_cycles_max": max(runner.failover_cycles, default=0),
+            "lease_losses": len(getattr(runner, "lease_loss_cycles", ())),
+        }
     return report
 
 
@@ -179,6 +194,18 @@ def terminal_accounting(report: dict) -> dict:
         "unfinished": report["jobs"]["unfinished"],
         "double_binds": report.get("double_binds", 0),
     }
+
+
+def oracle_part(report: dict) -> dict:
+    """The decision plane MINUS the HA-topology-specific keys — what an
+    ``--ha N`` run of a non-contended trace must reproduce byte-for-byte
+    against the single-scheduler oracle (the acceptance criterion for
+    decision-plane equivalence). ``failovers``/``fenced_rejections`` stay
+    IN: a non-contended HA run must report both as 0, same as the
+    oracle."""
+    part = deterministic_part(report)
+    part.pop("ha", None)
+    return part
 
 
 def deterministic_part(report: dict) -> dict:

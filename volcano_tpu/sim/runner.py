@@ -55,10 +55,12 @@ from ..api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase, QueueInfo,
                    Resource, TaskInfo, TaskStatus)
 from ..cache import SchedulerCache
 from ..cache.cache import RateLimitedQueue
-from ..cache.executors import SequenceBinder, SequenceEvictor
-from ..cache.journal import IntentJournal
+from ..cache.executors import (FencedBinder, FencedEvictor,
+                               FencingAuthority, SequenceBinder,
+                               SequenceEvictor)
+from ..cache.journal import IntentJournal, JournalFollower
 from ..chaos import KillPointBinder, KillPointEvictor, SimKill
-from ..scheduler import Scheduler
+from ..scheduler import ROLE_LEADER, Scheduler
 from .trace import TraceEvent
 from . import report as report_mod
 
@@ -100,6 +102,25 @@ class VirtualClock:
             self._now += seconds
 
 
+class _Replica:
+    """One scheduler replica of the HA control plane: its own cache +
+    shell + elector + standby journal follower over the SHARED cluster
+    (executors, journal transport, lease store)."""
+
+    __slots__ = ("ix", "gen", "cache", "sched", "elector", "follower")
+
+    def __init__(self, ix: int):
+        self.ix = ix
+        self.gen = 0
+        self.cache = None
+        self.sched = None
+        self.elector = None
+        self.follower = None
+
+    def key(self) -> tuple:
+        return (self.ix, self.gen)
+
+
 class SimRunner:
     def __init__(self, trace: List[TraceEvent],
                  conf_text: Optional[str] = None,
@@ -112,7 +133,9 @@ class SimRunner:
                  scenario: Optional[str] = None,
                  kill_cycles: Optional[Sequence[int]] = None,
                  kill_seed: int = 0,
-                 journal: Optional[IntentJournal] = None):
+                 journal: Optional[IntentJournal] = None,
+                 ha_replicas: int = 1,
+                 lease_loss_cycles: Optional[Sequence[int]] = None):
         self.trace = list(trace)
         self.period = period
         self.seed = seed
@@ -138,30 +161,55 @@ class SimRunner:
         self._kill_binder: Optional[KillPointBinder] = None
         self._kill_evictor: Optional[KillPointEvictor] = None
         self.journal = journal
+        # HA mode (docs/robustness.md): N replica schedulers over ONE
+        # virtual cluster — shared executors, shared in-memory intent
+        # journal (the standby replay transport), shared lease store +
+        # fencing authority; exactly one replica holds the lease and
+        # schedules, the rest tail the journal warm.
+        self.ha_replicas = max(int(ha_replicas), 1)
+        self.lease_loss_cycles = set(lease_loss_cycles or ())
+        self._lease_rng = random.Random(kill_seed ^ 0x9E3779B9)
+        self.failovers = 0
+        self.failover_cycles: List[int] = []
+        self._vacant_since: Optional[int] = None
+        self._leader_key: Optional[tuple] = None
+        self._feedback_blocked = False
+        self._armed_action: Optional[int] = None
+        self._armed_close = False
+        self._armed_revoke: Optional[int] = None
+        self._had_leader = False
+        self._pending_crash_oracle = None
+        self.replicas: List[_Replica] = []
+        self.authority: Optional[FencingAuthority] = None
         if self.kill_cycles:
             self._kill_binder = binder = KillPointBinder(binder)
             self._kill_evictor = evictor = KillPointEvictor(evictor)
             if self.journal is None:
                 self.journal = IntentJournal()    # in-memory: survives the
                 #                                   simulated process death
-        self.cache = SchedulerCache(binder=binder, evictor=evictor,
-                                    default_queue=None, journal=self.journal)
-        # retry backoff runs on virtual time too: a chaos-failed bind's
-        # re-attempt lands on a deterministic virtual cycle, not whenever
-        # the host happens to get there
-        self.cache.resync_queue.time_fn = self.clock.time
-        # job ingestion timestamps (schedule_start_timestamp) pin to
-        # virtual time with the same injection
-        self.cache.time_fn = self.clock.time
         # ...and so does the device cool-down window, so a composed
         # DeviceFaultInjector re-probes on a deterministic virtual cycle
         # instead of wherever the host's wall clock lands
         from ..device_health import DEVICE_HEALTH
         DEVICE_HEALTH.reset(time_fn=self.clock.time)
         self.conf_text = conf_text if conf_text is not None else SIM_CONF
-        self.sched = Scheduler(self.cache, conf_text=self.conf_text,
-                               schedule_period=period, clock=self.clock,
-                               rng=random.Random(seed))
+        if self.ha_replicas > 1:
+            self._init_ha(binder, evictor)
+        else:
+            self.cache = SchedulerCache(binder=binder, evictor=evictor,
+                                        default_queue=None,
+                                        journal=self.journal)
+            # retry backoff runs on virtual time too: a chaos-failed
+            # bind's re-attempt lands on a deterministic virtual cycle,
+            # not whenever the host happens to get there
+            self.cache.resync_queue.time_fn = self.clock.time
+            # job ingestion timestamps (schedule_start_timestamp) pin to
+            # virtual time with the same injection
+            self.cache.time_fn = self.clock.time
+            self.sched = Scheduler(self.cache, conf_text=self.conf_text,
+                                   schedule_period=period, clock=self.clock,
+                                   rng=random.Random(seed))
+            self.caches = [self.cache]
 
         # decision-plane bookkeeping
         self.arrival_time: Dict[str, float] = {}
@@ -201,54 +249,78 @@ class SimRunner:
             n += 1
         return n
 
+    def _view(self) -> SchedulerCache:
+        """The cache whose state the decision-plane samples and global
+        bookkeeping read: the current (or most recent) leader's in HA
+        mode, THE cache otherwise. All replica caches converge through
+        the journal tail + shared feedback, so the choice only matters
+        transiently during failover windows — and it is deterministic."""
+        return self.caches[self._view_ix] if self.replicas else self.cache
+
+    def view_cache(self) -> SchedulerCache:
+        return self._view()
+
     def _apply_event(self, ev: TraceEvent) -> None:
+        """Apply one trace event to EVERY replica cache (the watch stream
+        every replica sees) plus the runner's global bookkeeping once."""
         d = ev.data
-        if ev.kind == "queue_add":
-            self.cache.add_queue(QueueInfo(name=d["name"],
-                                           weight=d["weight"]))
-        elif ev.kind == "node_add":
-            scalars = {"nvidia.com/gpu": float(d["gpus"])} if d["gpus"] \
-                else None
-            alloc = Resource(d["cpu_milli"], d["mem"], scalars)
-            alloc.max_task_num = d["pods"]
-            self.cache.add_node(NodeInfo(name=d["name"], allocatable=alloc))
-        elif ev.kind == "node_drain":
-            node = self.cache.nodes.get(d["name"])
-            if node is not None:
-                node.ready = False
-                # direct mutation bypasses the cache's own dirty tracking
-                self.cache.mark_node_dirty(node.name)
-        elif ev.kind == "node_restore":
-            node = self.cache.nodes.get(d["name"])
-            if node is not None:
-                node.ready = True
-                self.cache.mark_node_dirty(node.name)
-        elif ev.kind == "node_fail":
+        if ev.kind == "node_fail":
             self._fail_node(d["name"])
-        elif ev.kind == "job_arrival":
+            return
+        if ev.kind == "job_arrival":
             self._arrive(ev.t, d)
-        elif ev.kind == "job_complete":
-            if d["name"] in self.cache.jobs:
+            return
+        if ev.kind == "job_complete":
+            if d["name"] in self._view().jobs:
                 self._complete_job(d["name"], ev.t)
+            return
+        for cache in self.caches:
+            if ev.kind == "queue_add":
+                cache.add_queue(QueueInfo(name=d["name"],
+                                          weight=d["weight"]))
+            elif ev.kind == "node_add":
+                # fresh Resource/NodeInfo PER cache: allocatable is shared
+                # across clones by the immutability contract, but live
+                # caches mutate their NodeInfo accounting independently
+                scalars = {"nvidia.com/gpu": float(d["gpus"])} \
+                    if d["gpus"] else None
+                alloc = Resource(d["cpu_milli"], d["mem"], scalars)
+                alloc.max_task_num = d["pods"]
+                cache.add_node(NodeInfo(name=d["name"], allocatable=alloc))
+            elif ev.kind == "node_drain":
+                node = cache.nodes.get(d["name"])
+                if node is not None:
+                    node.ready = False
+                    # direct mutation bypasses the cache's own dirty
+                    # tracking
+                    cache.mark_node_dirty(node.name)
+            elif ev.kind == "node_restore":
+                node = cache.nodes.get(d["name"])
+                if node is not None:
+                    node.ready = True
+                    cache.mark_node_dirty(node.name)
 
     def _arrive(self, t: float, d: dict) -> None:
         name = d["name"]
-        scalars = {"nvidia.com/gpu": float(d["gpus"])} if d["gpus"] else None
-        pg = PodGroup(name=name, queue=d["queue"],
-                      min_member=d["min_available"],
-                      phase=PodGroupPhase.PENDING)
-        job = JobInfo(uid=name, name=name, queue=d["queue"],
-                      priority=d["priority"],
-                      min_available=d["min_available"], podgroup=pg,
-                      creation_timestamp=t)
+        for cache in self.caches:
+            scalars = {"nvidia.com/gpu": float(d["gpus"])} if d["gpus"] \
+                else None
+            pg = PodGroup(name=name, queue=d["queue"],
+                          min_member=d["min_available"],
+                          phase=PodGroupPhase.PENDING)
+            job = JobInfo(uid=name, name=name, queue=d["queue"],
+                          priority=d["priority"],
+                          min_available=d["min_available"], podgroup=pg,
+                          creation_timestamp=t)
+            for i in range(d["tasks"]):
+                uid = f"{name}-{i}"
+                job.add_task_info(TaskInfo(
+                    uid=uid, name=uid, job=name,
+                    resreq=Resource(d["cpu_milli"], d["mem"], scalars),
+                    creation_timestamp=t + i * 1e-6))
+            cache.add_job(job)
         for i in range(d["tasks"]):
-            uid = f"{name}-{i}"
-            job.add_task_info(TaskInfo(
-                uid=uid, name=uid, job=name,
-                resreq=Resource(d["cpu_milli"], d["mem"], scalars),
-                creation_timestamp=t + i * 1e-6))
-            self.task_job[uid] = name
-        self.cache.add_job(job)
+            self.task_job[f"{name}-{i}"] = name
         self.arrival_time[name] = t
         self.duration[name] = d["duration"]
         self.arrived += 1
@@ -257,35 +329,53 @@ class SimRunner:
         """The node dies with its tasks: lost members re-queue PENDING and
         their gang must re-admit (duration restarts — gang semantics: a
         gang below min_available has lost its collective progress)."""
-        node = self.cache.nodes.get(name)
-        if node is None:
+        uids: List[str] = []
+        seen: set = set()
+        present = False
+        for cache in self.caches:
+            node = cache.nodes.get(name)
+            if node is None:
+                continue
+            present = True
+            for uid in list(node.tasks):
+                if uid not in seen:
+                    seen.add(uid)
+                    uids.append(uid)
+        if not present:
             return
-        for uid in list(node.tasks):
+        for uid in uids:
             self._requeue_task(uid, on_node=False)
-        self.cache.remove_node(name)
+        for cache in self.caches:
+            cache.remove_node(name)
 
     def _requeue_task(self, uid: str, on_node: bool = True) -> None:
-        job = self.cache.jobs.get(self.task_job.get(uid, ""))
-        if job is None or uid not in job.tasks:
+        jid = self.task_job.get(uid, "")
+        touched_any = False
+        for cache in self.caches:
+            job = cache.jobs.get(jid)
+            if job is None or uid not in job.tasks:
+                continue
+            cached = job.tasks[uid]
+            node = cache.nodes.get(cached.node_name)
+            if cached.node_name:
+                # mirrors job/node state directly (delete + controller
+                # recreate, collapsed): tell the incremental snapshot
+                cache.mark_node_dirty(cached.node_name)
+            cache.mark_job_dirty(job.uid)
+            if on_node and node is not None and uid in node.tasks:
+                node.remove_task(cached)
+            cached.node_name = ""
+            job.update_task_status(cached, TaskStatus.PENDING)
+            touched_any = True
+        if not touched_any:
             return
-        cached = job.tasks[uid]
-        node = self.cache.nodes.get(cached.node_name)
-        if cached.node_name:
-            # mirrors job/node state directly (delete + controller
-            # recreate, collapsed): tell the incremental snapshot
-            self.cache.mark_node_dirty(cached.node_name)
-        self.cache.mark_job_dirty(job.uid)
-        if on_node and node is not None and uid in node.tasks:
-            node.remove_task(cached)
-        cached.node_name = ""
-        job.update_task_status(cached, TaskStatus.PENDING)
         self._live_bound.discard(uid)
         self.requeues += 1
-        if job.uid in self.admitted_at:
+        if jid in self.admitted_at:
             # the gang dropped below min_available: cancel its pending
             # completion (epoch bump makes it stale) and let it re-admit
-            del self.admitted_at[job.uid]
-            self._admit_epoch[job.uid] = self._admit_epoch.get(job.uid, 0) + 1
+            del self.admitted_at[jid]
+            self._admit_epoch[jid] = self._admit_epoch.get(jid, 0) + 1
 
     def _fire_completions_until(self, now: float) -> None:
         while self._completions and self._completions[0][0] <= now + 1e-9:
@@ -296,14 +386,20 @@ class SimRunner:
             self._complete_job(uid, t)
 
     def _complete_job(self, uid: str, t: float) -> None:
-        job = self.cache.jobs.get(uid)
-        if job is None:
+        vjob = self._view().jobs.get(uid)
+        if vjob is None:
             return
-        for task in list(job.tasks.values()):
-            self.cache.delete_task(task)
-            self.task_job.pop(task.uid, None)
-            self._live_bound.discard(task.uid)
-        self.cache.remove_job(uid)
+        uids = list(vjob.tasks)
+        for cache in self.caches:
+            job = cache.jobs.get(uid)
+            if job is None:
+                continue
+            for task in list(job.tasks.values()):
+                cache.delete_task(task)
+            cache.remove_job(uid)
+        for tuid in uids:
+            self.task_job.pop(tuid, None)
+            self._live_bound.discard(tuid)
         self.admitted_at.pop(uid, None)
         self.jct.append(t - self.arrival_time[uid])
         self.completed += 1
@@ -313,7 +409,8 @@ class SimRunner:
     def _feedback(self, now: float) -> None:
         """Close the loop the way a live cluster would: binds ack to
         RUNNING, evictions delete-and-recreate PENDING, full gangs stamp
-        admission and schedule completion."""
+        admission and schedule completion. Status acks apply to EVERY
+        replica cache (the watch stream is cluster-wide)."""
         touched: Dict[str, bool] = {}
         seq = self.binder.sequence
         while self._binds_seen < len(seq):
@@ -327,12 +424,19 @@ class SimRunner:
             else:
                 self._live_bound.add(uid)
             jid = self.task_job.get(uid)
-            job = self.cache.jobs.get(jid) if jid else None
-            if job is None or uid not in job.tasks:
+            if jid is None:
                 continue
-            cached = job.tasks[uid]
-            if cached.status == TaskStatus.BOUND:
-                self.cache.update_task_status(cached, TaskStatus.RUNNING)
+            placed = False
+            for cache in self.caches:
+                job = cache.jobs.get(jid)
+                if job is None or uid not in job.tasks:
+                    continue
+                cached = job.tasks[uid]
+                if cached.status == TaskStatus.BOUND:
+                    cache.update_task_status(cached, TaskStatus.RUNNING)
+                placed = True
+            if not placed:
+                continue
             if jid not in self.first_bind:
                 self.first_bind[jid] = now
                 self.queueing_delay.append(now - self.arrival_time[jid])
@@ -342,8 +446,25 @@ class SimRunner:
             uid = eseq[self._evicts_seen]
             self._evicts_seen += 1
             self._requeue_task(uid)
+        if self.replicas:
+            # HA only: a failover's handoff reconcile can re-assert a
+            # crash-window bind AFTER its kubelet ack was consumed above
+            # (the ack arrived while leadership was vacant and feedback
+            # deferred) — converge any still-BOUND task the cluster
+            # already runs. Deterministic: sorted uid order.
+            for uid in sorted(self._live_bound):
+                jid = self.task_job.get(uid)
+                if jid is None:
+                    continue
+                for cache in self.caches:
+                    job = cache.jobs.get(jid)
+                    if job is None or uid not in job.tasks:
+                        continue
+                    cached = job.tasks[uid]
+                    if cached.status == TaskStatus.BOUND:
+                        cache.update_task_status(cached, TaskStatus.RUNNING)
         for jid in touched:
-            job = self.cache.jobs.get(jid)
+            job = self._view().jobs.get(jid)
             if job is None or jid in self.admitted_at:
                 continue
             if job.min_available > 0 \
@@ -358,14 +479,272 @@ class SimRunner:
     # -- the run loop -------------------------------------------------------
 
     def _progress_signature(self) -> tuple:
+        view = self._view()
         return (self._trace_ix, self._binds_seen, self._evicts_seen,
-                self.completed, self.requeues, len(self.cache.jobs),
-                len(self.cache.resync_queue), len(self.cache.dead_letter))
+                self.completed, self.requeues, len(view.jobs),
+                len(view.resync_queue), len(view.dead_letter))
 
     def _done(self) -> bool:
         return (self._trace_ix >= len(self.trace)
                 and not self._completions
-                and not self.cache.jobs)
+                and not self._view().jobs)
+
+    # -- HA control plane (docs/robustness.md) ------------------------------
+
+    def _init_ha(self, binder, evictor) -> None:
+        """Build the N-replica control plane: shared lease store +
+        fencing authority + in-memory journal (the standby transport);
+        per-replica cache/shell/elector/follower. The shared executor
+        chain (kill/chaos wrappers over the Sequence recorders) is
+        wrapped per replica in a fencing gate reading THAT replica's
+        elector epoch — a fenced ex-leader's write is rejected before it
+        reaches the cluster."""
+        from ..store import ObjectStore
+        if self.journal is None:
+            self.journal = IntentJournal()
+        self.lease_store = ObjectStore()
+        self.authority = FencingAuthority()
+        self._pending_crash_oracle = None
+        self.caches: List[SchedulerCache] = []
+        self._view_ix = 0
+        for ix in range(self.ha_replicas):
+            rep = _Replica(ix)
+            self._build_replica_cache(rep, binder, evictor)
+            self._build_replica_shell(rep)
+            self.replicas.append(rep)
+            self.caches.append(rep.cache)
+        self.cache = self.caches[0]
+        self.sched = self.replicas[0].sched
+
+    def _build_replica_cache(self, rep: _Replica, binder, evictor) -> None:
+        cache = SchedulerCache(
+            binder=FencedBinder(binder,
+                                lambda r=rep: r.elector.fencing_epoch,
+                                self.authority),
+            evictor=FencedEvictor(evictor,
+                                  lambda r=rep: r.elector.fencing_epoch,
+                                  self.authority),
+            default_queue=None, journal=self.journal)
+        cache.resync_queue.time_fn = self.clock.time
+        cache.time_fn = self.clock.time
+        rep.cache = cache
+        rep.follower = JournalFollower(cache)
+        rep.follower.attach(self.journal)
+
+    def _build_replica_shell(self, rep: _Replica) -> None:
+        """(Re)build a replica's scheduler shell + elector — fresh on
+        construction AND after each simulated process death (the cache
+        survives; it stands in for the relist a restart rebuilds)."""
+        from ..leaderelection import FlapGuard, LeaderElector
+        ident = f"replica-{rep.ix}" if rep.gen == 0 \
+            else f"replica-{rep.ix}-g{rep.gen}"
+        rep.elector = LeaderElector(
+            self.lease_store, "vc-scheduler",
+            on_started_leading=lambda: None,
+            identity=ident,
+            lease_duration=1.6 * self.period,
+            renew_deadline=1.2 * self.period,
+            retry_period=self.period,
+            time_fn=self.clock.time, mono_fn=self.clock.time,
+            authority=self.authority,
+            flap_guard=FlapGuard(cooldown_s=4 * self.period,
+                                 max_cooldown_s=16 * self.period,
+                                 time_fn=self.clock.time))
+        sched = Scheduler(rep.cache, conf_text=self.conf_text,
+                          schedule_period=self.period, clock=self.clock,
+                          rng=random.Random(self.seed))
+        sched.attach_elector(rep.elector)
+        sched.reconcile_oracle_fn = self._take_crash_oracle
+        sched.action_fault_hook = self._mk_action_hook(rep)
+        sched.close_fault_hook = self._close_hook
+        rep.sched = sched
+
+    def _take_crash_oracle(self):
+        oracle, self._pending_crash_oracle = self._pending_crash_oracle, \
+            None
+        return oracle
+
+    def _mk_action_hook(self, rep: _Replica) -> Callable:
+        """Per-replica pre-action hook: the seeded mid-action SimKill and
+        the mid-cycle lease revocation both land at action boundaries of
+        whoever is LEADING (followers never reach the action loop)."""
+        def hook(name: str, ssn) -> None:
+            if self._armed_action is not None:
+                self._armed_action -= 1
+                if self._armed_action <= 0:
+                    self._armed_action = None
+                    raise SimKill(f"mid-action (before {name})")
+            if self._armed_revoke is not None:
+                self._armed_revoke -= 1
+                if self._armed_revoke <= 0:
+                    self._armed_revoke = None
+                    rep.elector.revoke()
+        return hook
+
+    def _close_hook(self, ssn) -> None:
+        if self._armed_close:
+            self._armed_close = False
+            raise SimKill("inside close_session")
+
+    _HA_EXTRA_KILL_MODES = ("mid_action", "in_close")
+
+    def _arm_kill_ha(self) -> str:
+        """HA kill arming: the single-replica kill points plus the two
+        adversarial HA-specific ones — mid-solve (a SimKill before a
+        seeded action) and inside close_session."""
+        mode = self._kill_rng.choice(self._KILL_MODES
+                                     + self._HA_EXTRA_KILL_MODES)
+        at = self._kill_rng.randint(1, 5)
+        if mode == "bind_before":
+            self._kill_binder.arm(at, before=True)
+        elif mode == "bind_after":
+            self._kill_binder.arm(at, before=False)
+        elif mode == "evict_before":
+            self._kill_evictor.arm(at, before=True)
+        elif mode == "evict_after":
+            self._kill_evictor.arm(at, before=False)
+        elif mode == "mid_action":
+            self._armed_action = at
+        elif mode == "in_close":
+            self._armed_close = True
+        return mode
+
+    def _disarm_kills(self) -> None:
+        if self._kill_binder is not None:
+            self._kill_binder.disarm()
+        if self._kill_evictor is not None:
+            self._kill_evictor.disarm()
+        self._armed_action = None
+        self._armed_close = False
+
+    def _crash_restart_replica(self, rep: _Replica,
+                               kill_mode: Optional[str]) -> None:
+        """A replica's scheduler process dies and restarts as a FOLLOWER:
+        volatile state is lost, the shared journal and lease store
+        survive. The crash-window oracle (kill-MODE-precise, exactly as
+        the single-replica restart) is parked for whichever replica next
+        acquires the lease — failover IS lease-acquire →
+        startup_reconcile → resume. Cluster feedback is deferred while
+        leadership is vacant (a real cluster's acks would queue in the
+        new leader's informer sync), so reconcile settles the crash
+        window before any ack is consumed — the same ordering the
+        single-replica restart preserves within one cycle."""
+        self._disarm_kills()
+        c = rep.cache
+        c.binding_tasks.clear()
+        c.dead_letter.clear()
+        metrics.set_dead_letter_size(0)
+        c.err_tasks.clear()
+        c.resync_queue = RateLimitedQueue(
+            max_retries=c.resync_queue.max_retries,
+            time_fn=self.clock.time)
+        c.mark_all_dirty()
+        c.tensor_cache = None
+        c._tensor_dirty = set()
+        from ..device_health import DEVICE_HEALTH
+        DEVICE_HEALTH.reset(time_fn=self.clock.time)
+        # fresh incarnation: new identity + elector + shell; the standby
+        # follower reseeds from the surviving journal's open-intent set so
+        # the coming reconcile acks resolve against it
+        rep.gen += 1
+        if rep.follower is not None:
+            rep.follower.detach()
+        rep.follower = JournalFollower(rep.cache)
+        rep.follower.attach(self.journal)
+        self._build_replica_shell(rep)
+        cluster_binds = dict(self.binder.sequence[-1:]) \
+            if kill_mode == "bind_after" else {}
+        etail = tuple(self.evictor.sequence[-1:]) \
+            if kill_mode == "evict_after" else ()
+
+        def cluster_evicts(uid: str, tail=etail) -> bool:
+            return uid in tail
+
+        self._pending_crash_oracle = (cluster_binds, cluster_evicts)
+        self._feedback_blocked = True
+        self.restarts += 1
+
+    def _account_leadership(self) -> None:
+        """End-of-cycle leadership bookkeeping: failover counting, the
+        failover-time-in-cycles samples, the view cache, handoff report
+        accounting, and feedback unblocking."""
+        leader = None
+        for rep in self.replicas:
+            if rep.sched.role == ROLE_LEADER and rep.elector.leading:
+                leader = rep
+                break
+        if leader is None:
+            if self._leader_key is not None:
+                self._leader_key = None
+            if self._vacant_since is None:
+                self._vacant_since = self.cycles
+            return
+        self._view_ix = leader.ix
+        key = leader.key()
+        if key != self._leader_key:
+            if self._had_leader:
+                # a failover: either across a vacancy (killed leader,
+                # lease had to expire) or a direct same-cycle handoff
+                # (revocation + immediate takeover) — gap 0 then
+                self.failovers += 1
+                gap = 0 if self._vacant_since is None \
+                    else self.cycles - self._vacant_since
+                self.failover_cycles.append(gap)
+            self._vacant_since = None
+            self._leader_key = key
+            self._had_leader = True
+            rpt = getattr(leader.sched, "last_handoff_report", None)
+            leader.sched.last_handoff_report = None
+            if rpt is not None:
+                for k, v in rpt.as_dict().items():
+                    if v:
+                        self._journal_replayed[k] = \
+                            self._journal_replayed.get(k, 0) + v
+        self._feedback_blocked = False
+
+    def _ha_cycle(self, now: float) -> None:
+        """One virtual cycle of the N-replica control plane: seeded kill/
+        lease-loss arming, every replica's run_once in replica order
+        (followers run their election step and nothing else), leadership
+        accounting, then cluster feedback unless deferred by a vacancy."""
+        kill_mode: Optional[str] = None
+        if self.cycles in self.kill_cycles:
+            kill_mode = self._arm_kill_ha()
+        if self.cycles in self.lease_loss_cycles:
+            # lease-loss injection: the leader is revoked just before a
+            # seeded action ordinal — it must abandon its open session at
+            # that boundary and demote to fenced
+            self._armed_revoke = self._lease_rng.randint(1, 5)
+        leader_ran = False
+        for rep in self.replicas:
+            t0 = time.perf_counter()
+            try:
+                errors = rep.sched.run_once()
+            except SimKill:
+                errors = []
+                self._crash_restart_replica(rep, kill_mode)
+                kill_mode = None
+            else:
+                if rep.sched.role == ROLE_LEADER:
+                    leader_ran = True
+                    self.pipeline_e2e_ms.append(
+                        (time.perf_counter() - t0) * 1e3)
+                    if kill_mode is not None:
+                        # the armed kill never fired inside the leader's
+                        # cycle (too few side effects, or post_cycle):
+                        # clean-boundary death — still a real restart
+                        self._crash_restart_replica(rep, "post_cycle")
+                        kill_mode = None
+            for name, _ in errors:
+                self.action_failures.append((self.cycles, name))
+        if kill_mode is not None and not leader_ran:
+            # a kill was scheduled for a cycle with no leader (vacancy):
+            # nothing to kill; disarm so the stale arm cannot fire later
+            self._disarm_kills()
+        self._armed_revoke = None
+        self._account_leadership()
+        if not self._feedback_blocked:
+            self._feedback(now)
 
     # -- crash/restart ------------------------------------------------------
 
@@ -465,33 +844,40 @@ class SimRunner:
             now = self.clock.time()
             self._apply_trace_until(now)
             self._fire_completions_until(now)
-            kill_mode = None
-            if self.cycles in self.kill_cycles:
-                kill_mode = self._arm_kill()
-            t0 = time.perf_counter()
-            try:
-                errors = self.sched.run_once()
-            except SimKill:
-                errors = []
-                self._crash_restart(kill_mode)
+            if self.replicas:
+                self._ha_cycle(now)
             else:
-                if kill_mode == "post_cycle":
-                    # clean-boundary death: nothing mid-flight, but all
-                    # volatile state (queued retries!) dies with the process
-                    self._crash_restart("post_cycle")
-                elif kill_mode is not None:
-                    # the armed kill point never fired this cycle (too few
-                    # side effects) — the "crash" degenerates to a restart
-                    # at the boundary, which is still a real restart (and
-                    # the crash window is empty, so no oracle is needed)
-                    self._crash_restart("post_cycle")
-            self.pipeline_e2e_ms.append((time.perf_counter() - t0) * 1e3)
-            for name, _ in errors:
-                self.action_failures.append((self.cycles, name))
-            self._feedback(now)
-            self.util_cpu.append(report_mod.cpu_utilization(self.cache))
-            self.util_mem.append(report_mod.mem_utilization(self.cache))
-            self.drf_gap.append(report_mod.drf_fairness_gap(self.cache))
+                kill_mode = None
+                if self.cycles in self.kill_cycles:
+                    kill_mode = self._arm_kill()
+                t0 = time.perf_counter()
+                try:
+                    errors = self.sched.run_once()
+                except SimKill:
+                    errors = []
+                    self._crash_restart(kill_mode)
+                else:
+                    if kill_mode == "post_cycle":
+                        # clean-boundary death: nothing mid-flight, but all
+                        # volatile state (queued retries!) dies with the
+                        # process
+                        self._crash_restart("post_cycle")
+                    elif kill_mode is not None:
+                        # the armed kill point never fired this cycle (too
+                        # few side effects) — the "crash" degenerates to a
+                        # restart at the boundary, which is still a real
+                        # restart (and the crash window is empty, so no
+                        # oracle is needed)
+                        self._crash_restart("post_cycle")
+                self.pipeline_e2e_ms.append(
+                    (time.perf_counter() - t0) * 1e3)
+                for name, _ in errors:
+                    self.action_failures.append((self.cycles, name))
+                self._feedback(now)
+            view = self._view()
+            self.util_cpu.append(report_mod.cpu_utilization(view))
+            self.util_mem.append(report_mod.mem_utilization(view))
+            self.drf_gap.append(report_mod.drf_fairness_gap(view))
             self.cycles += 1
             self.clock.sleep(self.period)
             if self._done():
