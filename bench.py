@@ -697,7 +697,15 @@ def main():
     s20s, _, nb20s = run_cycle("20k", "tpu-sharded")
     extras.update(alloc_20k_ms=round(s20 * 1e3, 1), binds_20k=nb20,
                   alloc_20k_sharded_ms=round(s20s * 1e3, 1),
-                  binds_20k_sharded=nb20s)
+                  binds_20k_sharded=nb20s,
+                  # the sharded-vs-single crossover, surfaced as a tracked
+                  # flag instead of hiding in the raw pair (ROADMAP item 1:
+                  # r5 measured 1141 ms sharded vs 723 ms single-device —
+                  # the sharded path must CROSS OVER, not regress, at the
+                  # long axis; >1.0 means the regression is still open)
+                  alloc_20k_sharded_slowdown=round(s20s / s20, 2)
+                  if s20 > 0 else 0.0,
+                  sharded_20k_crossover_ok=s20s <= s20)
 
     # config 4: preempt mix — device engine at full scale, parity at 1/10th
     p_cpu_s, p_cpu_evicts, _ = run_preempt("preempt-small", "callbacks")

@@ -18,14 +18,16 @@ run_soak=true
 run_obs=true
 run_lint=true
 run_ha=true
+run_federated=true
 case "${1:-}" in
-  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false ;;
-  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false ;;
-  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false ;;
-  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false ;;
-  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false ;;
-  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false ;;
-  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false ;;
+  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false ;;
+  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false ;;
+  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false ;;
+  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false ;;
+  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false ;;
+  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false ;;
+  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false ;;
+  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false ;;
 esac
 
 if $run_lint; then
@@ -165,6 +167,55 @@ if $run_ha; then
     || { echo "ha-soak FAILED: non-contended HA decision plane differs \
 from the single-scheduler oracle"; exit 1; }
   echo "   ha-soak: zero double-binds, byte-deterministic x2, oracle-equal"
+fi
+
+if $run_federated; then
+  # federated-soak (docs/federation.md): 4 partition schedulers over one
+  # virtual cluster. (a) smoke with 4 seeded partition kills at
+  # adversarial points must converge — zero cross-partition double-binds,
+  # every gang completed (--verify-federated-equivalence compares
+  # terminal accounting against the single-scheduler oracle), (b) the
+  # killed run's decision plane must be byte-deterministic x2, (c) a
+  # NON-contended fed-smoke run's AGGREGATE decision plane must be
+  # byte-identical to the single-scheduler oracle, and (d) the
+  # reserve-driving fed-starve world must complete through the
+  # cross-partition reserve/transfer protocol with terminal equivalence.
+  echo "== federated-soak: sim --federated 4, partition kills + reserves =="
+  feddir=$(mktemp -d)
+  trap 'rm -rf "${simdir:-/nonexistent}" "${soakdir:-/nonexistent}" \
+"${obsdir:-/nonexistent}" "${hadir:-/nonexistent}" \
+"${feddir:-/nonexistent}"' EXIT
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario smoke --seed 3 \
+    --federated 4 --kill-cycles 2,5,9,13 --kill-seed 2 \
+    --verify-federated-equivalence --deterministic > "$feddir/fed.a.json" \
+    || { echo "federated-soak FAILED: killed federated run diverged or \
+double-bound"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario smoke --seed 3 \
+    --federated 4 --kill-cycles 2,5,9,13 --kill-seed 2 \
+    --deterministic > "$feddir/fed.b.json"
+  diff "$feddir/fed.a.json" "$feddir/fed.b.json" \
+    || { echo "federated-soak FAILED: killed federated run not \
+byte-deterministic"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario fed-smoke \
+    --seed 3 --federated 4 --verify-federated-equivalence --deterministic \
+    > /dev/null \
+    || { echo "federated-soak FAILED: non-contended aggregate decision \
+plane differs from the single-scheduler oracle"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario fed-starve \
+    --seed 3 --federated 4 --verify-federated-equivalence --deterministic \
+    > "$feddir/starve.json" \
+    || { echo "federated-soak FAILED: fed-starve reserve/transfer run \
+diverged"; exit 1; }
+  python - "$feddir/starve.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+reserves = r.get("cross_partition_reserves", {})
+assert reserves.get("granted", 0) > 0, \
+    f"fed-starve exercised no cross-partition reserves: {reserves}"
+assert r["federation"]["node_transfers"] > 0
+EOF
+  echo "   federated-soak: zero double-binds, byte-deterministic x2, \
+oracle-equal, reserves exercised"
 fi
 
 if $run_shim; then

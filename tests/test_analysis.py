@@ -285,6 +285,60 @@ def test_vt008_exempt_layers():
         assert "VT008" not in rule_ids(f), path
 
 
+VT009_TRIGGER = '''
+class Rebalancer:
+    def hand_over(self, pmap, node):
+        pmap._transfer_node_raw(node, 2)
+'''
+
+VT009_CLEAN = '''
+class Rebalancer:
+    def _journal_reserve(self, kind, **fields):
+        self.journal.record_control(kind, fields)
+
+    def hand_over(self, pmap, node):
+        self._journal_reserve("reserve_grant", node=node)
+        pmap._transfer_node_raw(node, 2)
+'''
+
+VT009_ONE_HOP = '''
+class Rebalancer:
+    def _journal_reserve(self, kind, **fields):
+        self.journal.record_control(kind, fields)
+
+    def _grant(self, pmap, node):
+        self._journal_reserve("reserve_grant", node=node)
+        self.finish(pmap, node)
+
+    def finish(self, pmap, node):
+        pmap._transfer_node_raw(node, 2)
+'''
+
+VT009_RAW_DEF = '''
+class PartitionMap:
+    def _transfer_node_raw(self, node, to):
+        self.node_owner[node] = to
+        self.pinned.pop(node, None)
+'''
+
+
+def test_vt009_trigger_and_clean():
+    """A partition-ownership transfer with no _journal_reserve record on
+    the path fires VT009; journaling in the same function (or one hop —
+    the reserve funnel's shape) is clean, and the raw mutator's own
+    definition is the funnel's write primitive, not a transfer."""
+    f, _ = findings_of({"volcano_tpu/sim/runner.py": VT009_TRIGGER})
+    assert "VT009" in rule_ids(f)
+    assert any(x.symbol == "Rebalancer.hand_over" for x in f)
+    f, _ = findings_of({"volcano_tpu/sim/runner.py": VT009_CLEAN})
+    assert "VT009" not in rule_ids(f)
+    f, _ = findings_of({"volcano_tpu/federation/reserve.py": VT009_ONE_HOP})
+    assert "VT009" not in rule_ids(f)
+    f, _ = findings_of(
+        {"volcano_tpu/federation/partition.py": VT009_RAW_DEF})
+    assert "VT009" not in rule_ids(f)
+
+
 VT005_TRIGGER = '''
 def cycle(action):
     try:
@@ -542,7 +596,7 @@ def test_rule_catalog_complete():
     ids = [r.id for r in ALL_RULES]
     assert ids == sorted(ids) and len(ids) == len(set(ids))
     assert {"VT001", "VT002", "VT003", "VT004", "VT005", "VT006",
-            "VT007", "VT008"} <= set(ids)
+            "VT007", "VT008", "VT009"} <= set(ids)
     for r in ALL_RULES:
         assert r.contract and r.name
     assert rule_by_id("VT001") is not None
@@ -631,6 +685,30 @@ def test_rebreak_unstamped_fencing_epoch_vt008():
                for x in f)
     assert any(x.rule == "VT008" and x.symbol == "SchedulerCache.evict"
                for x in f)
+
+
+def test_rebreak_unjournaled_node_transfer_vt009():
+    """PR 9's federation contract: the reserve ledger's drain-and-
+    transfer step flips node ownership right next to its journaled
+    ``reserve_grant`` record. Dropping the record leaves the ownership
+    flip with no durable audit trail — a restarted partition would
+    disagree with the live map about who owns the node (the federated
+    double-bind). The unmutated source must be clean; the reverted one
+    must flag the transfer."""
+    src = real_source("volcano_tpu/federation/reserve.py")
+    f, _ = findings_of({"volcano_tpu/federation/reserve.py": src})
+    assert "VT009" not in rule_ids(f)
+    broken = mutate(src,
+                    '        self._journal_reserve("reserve_grant", '
+                    'rid=req.rid, node=req.node,\n'
+                    '                              frm=req.to, to=req.frm,\n'
+                    '                              epoch_from=req.epoch_from,'
+                    ' epoch=epoch)\n',
+                    '        pass\n')
+    f, _ = findings_of({"volcano_tpu/federation/reserve.py": broken})
+    assert any(x.rule == "VT009"
+               and x.symbol == "ReserveLedger._drain_and_transfer"
+               for x in f), rule_ids(f)
 
 
 def test_rebreak_unjournaled_evict_vt004():

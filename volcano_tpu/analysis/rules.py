@@ -238,7 +238,7 @@ class RawClockRule(Rule):
              "volcano_tpu/framework/", "volcano_tpu/actions/",
              "volcano_tpu/plugins/", "volcano_tpu/cache/",
              "volcano_tpu/sim/", "volcano_tpu/utils/", "volcano_tpu/ops/",
-             "volcano_tpu/parallel/")
+             "volcano_tpu/parallel/", "volcano_tpu/federation/")
 
     BANNED_TIME = {"time.time", "time.sleep", "time.monotonic"}
     BANNED_DT_SUFFIX = ("datetime.now", "datetime.utcnow", "datetime.today",
@@ -447,6 +447,57 @@ class FencingEpochRule(Rule):
                 f"fencing_epoch stamp on the path; executor-effecting "
                 f"operations must carry the leader's epoch so a deposed "
                 f"leader's writes are rejectable (docs/robustness.md)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# VT009 — cross-partition reserve/transfer funnel (PR 9 federation)
+# ---------------------------------------------------------------------------
+
+class CrossPartitionFunnelRule(Rule):
+    """Partition-ownership writes (moving a node or queue between
+    partitions, pinning a node for transfer, opening a queue drain) are
+    writes to cluster state another partition owns: they may only happen
+    inside the journaled reserve/transfer funnel — a ``_journal_reserve``
+    record must be on the path (same function or one hop, VT004-style).
+    A bare transfer is capacity that moved with no durable audit trail
+    and no epoch stamp: a restarted partition would disagree with the
+    live map about who owns what — the federated double-bind
+    (docs/federation.md)."""
+
+    id = "VT009"
+    name = "cross-partition-funnel"
+    contract = ("PartitionMap ownership transfer outside the journaled "
+                "reserve/transfer funnel (PR 9 federation, "
+                "docs/federation.md)")
+    exclude = ("volcano_tpu/analysis/",)
+
+    TRANSFER_METHODS = {"_transfer_node_raw", "_transfer_queue_raw",
+                        "_pin_node_raw", "_begin_drain_raw"}
+    WITNESS = {"_journal_reserve"}
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr not in self.TRANSFER_METHODS:
+                continue
+            recv = dotted_name(node.func.value) or "<expr>"
+            fn = mod.enclosing_function(node.lineno)
+            if fn is not None:
+                # the raw mutators' own defs are not transfers
+                if fn.name in self.TRANSFER_METHODS:
+                    continue
+                if ctx.witness_in_scope(fn, self.WITNESS):
+                    continue
+            where = fn.qualname if fn else "<module>"
+            findings.append(self.finding(
+                mod, node,
+                f"partition-ownership write {recv}.{node.func.attr}(...) "
+                f"in {where} without a _journal_reserve record on the "
+                f"path; cross-partition state moves only through the "
+                f"reserve/transfer funnel (docs/federation.md)"))
         return findings
 
 
@@ -839,7 +890,7 @@ class LockDisciplineRule(Rule):
 ALL_RULES: List[Rule] = [
     DirtyWitnessRule(), RawClockRule(), UnseededRandomRule(),
     JournalFunnelRule(), SimKillSwallowRule(), ShapeBucketRule(),
-    LockDisciplineRule(), FencingEpochRule(),
+    LockDisciplineRule(), FencingEpochRule(), CrossPartitionFunnelRule(),
 ]
 
 

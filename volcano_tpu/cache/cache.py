@@ -174,6 +174,12 @@ class SchedulerCache:
         # state last refreshed (cache/snapshot.PersistentNodeTensors)
         self._tensor_dirty: Set[str] = set()
         self.tensor_cache = None
+        # federation (docs/federation.md): a per-partition snapshot
+        # scope — callable ClusterInfo -> ClusterInfo (PartitionMap.scope)
+        # applied AFTER the incremental build, so the clone caches stay
+        # whole-cluster while the session only sees this partition's
+        # queues/jobs/node shard. None (default) = unscoped.
+        self.snapshot_scope: Optional[Callable] = None
         # wall-clock + dirty-ratio breakdown of the last snapshot()
         # (bench.py snapshot_clone_ms / open_dirty_ms extras)
         self.last_snapshot_stats: Dict[str, object] = {}
@@ -373,7 +379,10 @@ class SchedulerCache:
         VOLCANO_TPU_INCREMENTAL_SNAPSHOT=0 or after mark_all_dirty()."""
         from ..obs import trace as obs_trace
         with obs_trace.span("snapshot_clone"):
-            return self._snapshot_impl()
+            ci = self._snapshot_impl()
+        if self.snapshot_scope is not None:
+            ci = self.snapshot_scope(ci)
+        return ci
 
     def _snapshot_impl(self) -> ClusterInfo:
         t0 = time.perf_counter()

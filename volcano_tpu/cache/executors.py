@@ -74,6 +74,34 @@ class FencingAuthority:
         raise FencedError(op, epoch, current)
 
 
+class FencingRegistry:
+    """Per-partition fencing authorities for the federated control plane
+    (docs/federation.md): epochs are namespaced by partition id — each
+    partition's Lease mints its own monotonic epoch sequence, and each
+    partition's executor gate checks against its OWN watermark.
+    Authorities are created on demand and shared by reference, so the
+    reserve ledger and the per-partition electors see one truth."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._authorities: Dict[int, FencingAuthority] = {}
+
+    def authority(self, pid: int) -> FencingAuthority:
+        with self._lock:
+            auth = self._authorities.get(pid)
+            if auth is None:
+                auth = self._authorities[pid] = FencingAuthority()
+            return auth
+
+    def current(self, pid: int) -> int:
+        return self.authority(pid).current()
+
+    def rejections(self) -> int:
+        """Total stale-epoch rejections across every partition."""
+        with self._lock:
+            return sum(a.rejections for a in self._authorities.values())
+
+
 class Binder:
     def bind(self, task: TaskInfo, hostname: str) -> None:
         raise NotImplementedError

@@ -240,6 +240,27 @@ class IntentJournal:
         self._publish(rec)
         return seq
 
+    def record_control(self, kind: str, fields: Optional[dict] = None
+                       ) -> int:
+        """Journal a CONTROL record — a cross-partition reserve/transfer
+        protocol step (docs/federation.md) or any other coordination
+        breadcrumb that must be durable and visible to every journal
+        subscriber, but opens no bind/evict crash window. Control
+        records share the seq space (the journal totally orders them
+        against side-effect intents), are flushed+fsynced immediately
+        (a reserve must be durable before anyone acts on it), never
+        enter the open-intent set, and are dropped by compaction like
+        acked records; ``reconcile()`` ignores them. Returns the seq."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            rec = {"kind": kind, "seq": seq}
+            if fields:
+                rec.update(fields)
+            self._append(rec, sync_now=True)
+        self._publish(rec)
+        return seq
+
     def ack(self, seq: int, ok: bool = True) -> None:
         """Journal the executor outcome. ``ok=False`` records a failure
         whose cache rollback already ran — the intent is settled either

@@ -197,6 +197,23 @@ def build_parser() -> argparse.ArgumentParser:
     ls.add_argument("--name", default="vc-scheduler")
     ls.add_argument("--namespace", default="volcano-system")
 
+    fed = sub.add_parser(
+        "federation", description="Federated control-plane verbs "
+                                  "(docs/federation.md): inspect the "
+                                  "per-partition scheduler leases in "
+                                  "the store").add_subparsers(dest="verb")
+    fs = fed.add_parser(
+        "status", description="Per-partition leadership: who holds each "
+                              "partition's lease, its fencing epoch, and "
+                              "renew staleness")
+    fs.add_argument("--name", default="vc-scheduler",
+                    help="base lease name (partitions are <name>-p<i>)")
+    fs.add_argument("--namespace", default="volcano-system")
+    fs.add_argument("--partitions", type=int, default=0,
+                    help="probe exactly N partitions; 0 discovers "
+                         "contiguously from p0 until the first missing "
+                         "lease")
+
     sub.add_parser("version")
     return parser
 
@@ -259,6 +276,36 @@ def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
         return 0
     if store is None:
         out("no cluster store attached (in-process CLI requires a store)")
+        return 1
+    if args.group == "federation":
+        if args.verb == "status":
+            import time as _time
+            from ..leaderelection import partition_lease_name
+            probe = args.partitions if args.partitions > 0 else 64
+            found = 0
+            for pid in range(probe):
+                lease = store.get("Lease", args.namespace,
+                                  partition_lease_name(args.name, pid))
+                if lease is None:
+                    if args.partitions > 0:
+                        out(f"p{pid}\tholder=-\tno lease (partition idle "
+                            f"or not federated)")
+                        continue
+                    break
+                found += 1
+                age = _time.time() - lease.renew_time if lease.renew_time \
+                    else float("inf")
+                live = age <= lease.lease_duration
+                out(f"p{pid}\tholder={lease.holder or '-'}\t"
+                    f"epoch={int(getattr(lease, 'epoch', 0))}\t"
+                    f"renew_age_s={age:.1f}\t"
+                    f"{'LIVE' if live else 'EXPIRED'}")
+            if not found and args.partitions <= 0:
+                out(f"no partition leases under {args.namespace}/"
+                    f"{args.name}-p* — federation not enabled")
+                return 1
+            return 0
+        build_parser().print_help()
         return 1
     if args.group == "leader":
         if args.verb == "status":

@@ -1,0 +1,32 @@
+"""Federated control plane: partitioned schedulers with cross-partition
+reserve/reclaim (docs/federation.md).
+
+ROADMAP item 5's sharding rung above the PR-7 HA floor: N scheduler
+partitions own disjoint queue subsets and node shards of ONE cluster,
+each partition run by its own fenced leader (a per-partition Lease +
+FencingAuthority — epochs namespaced by partition id), all coordinating
+through the shared intent journal and store. Cross-partition work — a
+starved queue reclaiming capacity another partition owns — goes through
+the two-phase reserve/transfer protocol in :mod:`reserve`; everything
+else is partition-local and needs no coordination at all.
+
+- :class:`PartitionMap` — who owns which queues and node shards, plus
+  the per-partition snapshot scope the scheduler shell consumes;
+- :class:`ReserveLedger` — the journaled reserve → drain → transfer
+  protocol with timeout-based release (a killed partition can never
+  strand capacity);
+- :class:`PartitionMember` — the per-partition glue the scheduler
+  shell's cycle hooks drive (review incoming reserves at the cycle
+  boundary, detect starvation, publish health).
+
+``sim --federated N`` (volcano_tpu/sim) proves the protocol: partition
+kills mid-trace, zero cross-partition double-binds, aggregate
+decision-plane equivalence to a single-scheduler oracle on
+non-contended traces.
+"""
+
+from .member import PartitionMember
+from .partition import PartitionMap
+from .reserve import ReserveLedger
+
+__all__ = ["PartitionMap", "PartitionMember", "ReserveLedger"]

@@ -1,0 +1,180 @@
+"""PartitionMap: queue/node-shard ownership for the federated control
+plane (docs/federation.md).
+
+Each partition owns a disjoint subset of queues (and therefore the jobs
+in them — a task is only ever bound by its queue's owner, which is what
+makes cross-partition double-binds impossible by construction) and a
+disjoint shard of nodes (so partitions never race on capacity either).
+Registration is deterministic round-robin in watch-stream order: the
+same trace replays to the same map, which keeps ``sim --federated``
+byte-deterministic.
+
+Ownership TRANSFER is different from registration: moving a node or a
+queue between partitions is a write to cluster state another partition
+owns, and must flow through the reserve/transfer funnel
+(federation/reserve.py) so it is journaled, epoch-stamped and
+drain-safe. The raw mutators below (``_transfer_node_raw``,
+``_transfer_queue_raw``, ``_pin_node_raw``, ``_begin_drain_raw``) exist
+for that funnel alone — vlint rule VT009 flags any call to them without
+a ``_journal_reserve`` witness on the path (docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..api import ClusterInfo
+
+
+class PartitionMap:
+    """Thread-safe ownership map for N partitions. ``version`` bumps on
+    every ownership change so consumers (scopes, health detail) can
+    cheaply detect staleness."""
+
+    def __init__(self, n_partitions: int):
+        if n_partitions < 1:
+            raise ValueError("n_partitions must be >= 1")
+        self.n = int(n_partitions)
+        self._lock = threading.Lock()
+        self.queue_owner: Dict[str, int] = {}
+        self.node_owner: Dict[str, int] = {}
+        # queue -> destination pid while a queue move drains in-flight
+        # intents (the two-phase move: neither side schedules the queue
+        # until the flip — no orphaned intents, no double-binds)
+        self.draining: Dict[str, int] = {}
+        # node -> reserve rid while a grant drains the node before the
+        # ownership flip; the owner's scope excludes pinned nodes so it
+        # cannot refill capacity it is about to hand over
+        self.pinned: Dict[str, int] = {}
+        self.version = 0
+        self._rr_queue = 0
+        self._rr_node = 0
+
+    # -- registration (watch stream; deterministic round-robin) -------------
+
+    def register_queue(self, name: str) -> int:
+        """Assign a newly observed queue to a partition (idempotent)."""
+        with self._lock:
+            if name not in self.queue_owner:
+                self.queue_owner[name] = self._rr_queue % self.n
+                self._rr_queue += 1
+                self.version += 1
+            return self.queue_owner[name]
+
+    def register_node(self, name: str) -> int:
+        with self._lock:
+            if name not in self.node_owner:
+                self.node_owner[name] = self._rr_node % self.n
+                self._rr_node += 1
+                self.version += 1
+            return self.node_owner[name]
+
+    def forget_node(self, name: str) -> None:
+        """The node left the cluster (node_fail): drop its ownership and
+        any pending pin (the reserve ledger's expiry settles the
+        request)."""
+        with self._lock:
+            self.node_owner.pop(name, None)
+            self.pinned.pop(name, None)
+            self.version += 1
+
+    # -- lookups -------------------------------------------------------------
+
+    def owner_of_queue(self, name: str) -> Optional[int]:
+        with self._lock:
+            return self.queue_owner.get(name)
+
+    def owner_of_node(self, name: str) -> Optional[int]:
+        with self._lock:
+            return self.node_owner.get(name)
+
+    def queues_of(self, pid: int) -> List[str]:
+        with self._lock:
+            return sorted(q for q, p in self.queue_owner.items() if p == pid)
+
+    def nodes_of(self, pid: int) -> List[str]:
+        with self._lock:
+            return sorted(n for n, p in self.node_owner.items() if p == pid)
+
+    def unpinned_nodes_of(self, pid: int) -> List[str]:
+        with self._lock:
+            return sorted(n for n, p in self.node_owner.items()
+                          if p == pid and n not in self.pinned)
+
+    def pin_of(self, node: str) -> Optional[int]:
+        """The reserve rid a node is pinned for, or None — the locked
+        read for protocol code (reading ``pinned`` raw would race a
+        concurrent pin/unpin in a threaded deployment)."""
+        with self._lock:
+            return self.pinned.get(node)
+
+    def counts(self) -> Dict[int, Dict[str, int]]:
+        with self._lock:
+            out = {p: {"queues": 0, "nodes": 0} for p in range(self.n)}
+            for p in self.queue_owner.values():
+                out[p]["queues"] += 1
+            for p in self.node_owner.values():
+                out[p]["nodes"] += 1
+            return out
+
+    # -- ownership transfer: reserve/transfer funnel ONLY (vlint VT009) -----
+
+    def _transfer_node_raw(self, node: str, to: int) -> None:
+        """Flip a node's owner. Reserve/transfer funnel only — callers
+        must journal the transfer (VT009)."""
+        with self._lock:
+            self.node_owner[node] = to
+            self.pinned.pop(node, None)
+            self.version += 1
+
+    def _transfer_queue_raw(self, queue: str, to: int) -> None:
+        with self._lock:
+            self.queue_owner[queue] = to
+            self.draining.pop(queue, None)
+            self.version += 1
+
+    def _pin_node_raw(self, node: str, rid: Optional[int]) -> None:
+        """Pin (rid) or unpin (None) a node for an in-flight transfer."""
+        with self._lock:
+            if rid is None:
+                self.pinned.pop(node, None)
+            else:
+                self.pinned[node] = rid
+            self.version += 1
+
+    def _begin_drain_raw(self, queue: str, to: int) -> None:
+        with self._lock:
+            self.draining[queue] = to
+            self.version += 1
+
+    # -- the per-partition scheduler scope -----------------------------------
+
+    def scope(self, ci: ClusterInfo, pid: int) -> ClusterInfo:
+        """Filter a cluster snapshot down to what partition ``pid``
+        schedules: its owned queues (draining queues excluded — a queue
+        mid-move is scheduled by NOBODY until the flip), the jobs in
+        those queues, and its owned node shard minus nodes pinned for an
+        in-flight transfer. Values are shared, not copied — this is a
+        view, built per cycle after ``SchedulerCache.snapshot()``."""
+        with self._lock:
+            qown = self.queue_owner
+            nown = self.node_owner
+            draining = self.draining
+            pinned = self.pinned
+            out = ClusterInfo()
+            out.queues = {u: q for u, q in ci.queues.items()
+                          if qown.get(u) == pid and u not in draining}
+            out.jobs = {u: j for u, j in ci.jobs.items()
+                        if qown.get(j.queue) == pid
+                        and j.queue not in draining}
+            out.nodes = {n: node for n, node in ci.nodes.items()
+                         if nown.get(n) == pid and n not in pinned}
+            out.namespaces = ci.namespaces
+            out.revocable_nodes = {n: node
+                                   for n, node in ci.revocable_nodes.items()
+                                   if nown.get(n) == pid and n not in pinned}
+            out.node_list = list(out.nodes.values())
+            if hasattr(ci, "snap_epoch"):
+                out.snap_epoch = ci.snap_epoch
+            return out

@@ -1,0 +1,432 @@
+"""The cross-partition reserve/transfer protocol (docs/federation.md).
+
+A starved partition cannot simply take capacity another partition owns —
+that is a write to foreign cluster state, the federated analogue of the
+split-brain double-bind. Instead every cross-partition reclaim flows
+through this two-phase funnel, coordinated through the shared intent
+journal:
+
+1. **reserve** — the requester journals a ``reserve`` record naming the
+   owning partition, the capacity it needs, its own fencing epoch AND
+   the owner epoch it observed (both partitions' leaderships are named
+   in the intent), and a virtual-time deadline;
+2. **review** — the owner, at its next cycle boundary (leader-gated by
+   the scheduler shell), grants or rejects. A grant picks a donor node,
+   **pins** it (the owner's scope drops it, so the owner cannot refill
+   capacity it is handing over), drains it by evicting the owner's own
+   tasks through the owner's journaled+fenced evict funnel, and — once
+   empty — journals the ``reserve_grant`` and flips the node's
+   ownership in the PartitionMap;
+3. **timeout-based release** — a request (or a half-granted pin) whose
+   deadline passes is expired by WHICHEVER partition's cycle notices
+   first, unpinning the node. A killed partition can therefore never
+   strand capacity: its outstanding requests expire, its half-drained
+   pins release, and the journal carries the full audit trail.
+
+Queue moves (rebalancing a queue between partitions) ride the same
+funnel: ``move_queue`` journals the move and marks the queue draining —
+NEITHER partition schedules it — and ``settle_moves`` flips ownership
+only once no open journal intent references the queue's jobs (no
+orphaned intents, no double-binds across the flip).
+
+All PartitionMap ownership transfers happen HERE, next to their
+``_journal_reserve`` records — vlint rule VT009 enforces that no other
+code path calls the raw transfer mutators (docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from .partition import PartitionMap
+
+log = logging.getLogger(__name__)
+
+DEFAULT_TIMEOUT_S = 8.0
+
+REQUESTED = "requested"
+GRANTING = "granting"      # node pinned, owner draining it
+GRANTED = "granted"        # ownership transferred
+REJECTED = "rejected"
+EXPIRED = "expired"
+
+_OPEN = (REQUESTED, GRANTING)
+
+
+class ReserveRequest:
+    """One cross-partition reserve, from journal record to settlement."""
+
+    __slots__ = ("rid", "frm", "to", "cpu", "mem", "created", "deadline",
+                 "state", "epoch_from", "epoch_to_observed", "node",
+                 "epoch_granted")
+
+    def __init__(self, rid: int, frm: int, to: int, cpu: float, mem: float,
+                 created: float, deadline: float, epoch_from: int,
+                 epoch_to_observed: int):
+        self.rid = rid
+        self.frm = frm                     # requesting partition
+        self.to = to                       # owning partition
+        self.cpu = float(cpu)
+        self.mem = float(mem)
+        self.created = created
+        self.deadline = deadline
+        self.state = REQUESTED
+        self.epoch_from = epoch_from
+        self.epoch_to_observed = epoch_to_observed
+        self.node = ""                     # donor node once chosen
+        self.epoch_granted = 0
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+class ReserveLedger:
+    """The shared reserve/transfer coordinator: in-process it is this
+    object over the shared journal; a store-wired deployment would keep
+    the same records in the store (the journal stream already crosses
+    the process boundary via FileTailer). Thread-safe; all timestamps
+    come from the injectable ``time_fn`` so ``sim --federated`` replays
+    byte-deterministically."""
+
+    def __init__(self, pmap: PartitionMap, journal=None, registry=None,
+                 time_fn=time.monotonic,
+                 timeout_s: float = DEFAULT_TIMEOUT_S):
+        self.pmap = pmap
+        self.journal = journal
+        self.registry = registry           # executors.FencingRegistry
+        self.time_fn = time_fn
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._rid = itertools.count(1)
+        # OPEN requests only; settled ones move to the bounded history
+        # below (the journal is the durable record), so a persistently
+        # starved deployment filing one rejected request per cycle
+        # cannot grow this dict — or the per-cycle scans — forever
+        self.requests: Dict[int, ReserveRequest] = {}
+        self.settled: "OrderedDict[int, ReserveRequest]" = OrderedDict()
+        self.settled_keep = 64
+        self.counts: Dict[str, int] = {}
+        self.node_transfers = 0
+        self.queue_moves = 0
+        self._caches: Dict[int, object] = {}
+        # pid -> (idle_cpu, idle_mem) published at each cycle end; the
+        # requester's donor choice reads LAST cycle's published values,
+        # never another partition's live cache
+        self._idle: Dict[int, tuple] = {}
+
+    # -- wiring --------------------------------------------------------------
+
+    def attach_cache(self, pid: int, cache) -> None:
+        """Bind a partition's SchedulerCache (survives that partition's
+        process restarts in the sim — cluster truth does not die with a
+        scheduler)."""
+        self._caches[pid] = cache
+
+    def publish_idle(self, pid: int, cpu: float, mem: float) -> None:
+        with self._lock:
+            self._idle[pid] = (float(cpu), float(mem))
+
+    def _count(self, result: str, n: int = 1) -> None:
+        """Caller holds self._lock."""
+        self.counts[result] = self.counts.get(result, 0) + n
+        from .. import metrics
+        metrics.register_cross_partition_reserve(result, n)
+
+    def _settle(self, req: ReserveRequest, state: str) -> None:
+        """Caller holds self._lock: move a request from the open set to
+        the bounded settled history and count the outcome."""
+        req.state = state
+        self.requests.pop(req.rid, None)
+        self.settled[req.rid] = req
+        while len(self.settled) > self.settled_keep:
+            self.settled.popitem(last=False)
+        self._count(state)
+
+    def find(self, rid: int) -> Optional[ReserveRequest]:
+        with self._lock:
+            return self.requests.get(rid) or self.settled.get(rid)
+
+    def _journal_reserve(self, kind: str, **fields) -> None:
+        """The reserve/transfer journal funnel: every protocol step is a
+        durable control record in the SHARED intent journal, so a
+        restarted partition (or a warm standby tailing the stream) sees
+        the full cross-partition audit trail. The VT009 witness."""
+        if self.journal is not None:
+            self.journal.record_control(kind, fields)
+
+    # -- requester side ------------------------------------------------------
+
+    def outstanding(self, frm: int) -> Optional[ReserveRequest]:
+        with self._lock:
+            for req in self.requests.values():
+                if req.frm == frm and req.state in _OPEN:
+                    return req
+        return None
+
+    def pick_donor(self, frm: int) -> Optional[int]:
+        """Deterministic donor choice: the other partition with the most
+        recently PUBLISHED idle CPU (ties broken toward the lowest pid)
+        that can afford to give a node up (keeps at least one unpinned
+        node). Published values, not live reads — no partition ever
+        inspects another's cache."""
+        best: Optional[int] = None
+        best_idle = -1.0
+        for pid in range(self.pmap.n):
+            if pid == frm:
+                continue
+            if len(self.pmap.unpinned_nodes_of(pid)) <= 1:
+                continue
+            with self._lock:
+                idle = self._idle.get(pid, (0.0, 0.0))[0]
+            if idle > best_idle:
+                best, best_idle = pid, idle
+        return best
+
+    def request(self, frm: int, to: int, cpu: float, mem: float,
+                epoch_from: int) -> Optional[int]:
+        """Journal a reserve intent from partition ``frm`` to owner
+        ``to``; at most one outstanding request per requester. The
+        intent is stamped with BOTH partitions' fencing epochs — the
+        requester's own and the owner epoch it observed through the
+        fencing registry."""
+        if to == frm or not (0 <= to < self.pmap.n):
+            return None
+        if self.outstanding(frm) is not None:
+            return None
+        now = self.time_fn()
+        epoch_to = self.registry.current(to) if self.registry is not None \
+            else 0
+        with self._lock:
+            rid = next(self._rid)
+            req = ReserveRequest(rid, frm, to, cpu, mem, now,
+                                 now + self.timeout_s, epoch_from, epoch_to)
+            self.requests[rid] = req
+            self._count(REQUESTED)
+        self._journal_reserve("reserve", rid=rid, frm=frm, to=to, cpu=cpu,
+                              mem=mem, epoch_from=epoch_from,
+                              epoch_to=epoch_to, deadline=req.deadline)
+        return rid
+
+    # -- owner side (cycle boundary) -----------------------------------------
+
+    def review(self, pid: int, epoch: int) -> None:
+        """Grant or reject every open request addressed to partition
+        ``pid`` — called by the owner's leader at its cycle boundary
+        (the scheduler shell's federation hook). ``epoch`` is the
+        reviewing leadership's fencing epoch; a deposed leader (epoch
+        below the partition's watermark) may not settle anything."""
+        if self.registry is not None and epoch < self.registry.current(pid):
+            return
+        cache = self._caches.get(pid)
+        if cache is None:
+            return
+        with self._lock:
+            pending = sorted((r.rid, r) for r in self.requests.values()
+                             if r.to == pid and r.state in _OPEN)
+        for _, req in pending:
+            if req.state == REQUESTED:
+                self._start_grant(req, cache, epoch)
+            if req.state == GRANTING:
+                self._drain_and_transfer(req, cache, epoch)
+
+    def _eligible_nodes(self, pid: int, cache) -> List[str]:
+        out = []
+        for name in self.pmap.unpinned_nodes_of(pid):
+            node = cache.nodes.get(name)
+            if node is not None and node.ready:
+                out.append(name)
+        return out
+
+    def _start_grant(self, req: ReserveRequest, cache, epoch: int) -> None:
+        """Phase 2a: choose and pin a donor node, or reject. The donor
+        is the owner's least-loaded eligible node that covers the
+        request by ALLOCATABLE (capacity follows demand even when the
+        node is currently busy — draining empties it), falling back to
+        the largest node when none covers it fully. The owner always
+        keeps one unpinned node."""
+        nodes = self._eligible_nodes(req.to, cache)
+        if len(nodes) <= 1:
+            with self._lock:
+                self._settle(req, REJECTED)
+            self._journal_reserve("reserve_reject", rid=req.rid,
+                                  epoch=epoch, reason="last-node")
+            return
+        covering = [n for n in nodes
+                    if cache.nodes[n].allocatable.cpu >= req.cpu
+                    and cache.nodes[n].allocatable.memory >= req.mem]
+        if covering:
+            # fewest resident tasks first (cheapest drain), then name
+            chosen = min(covering,
+                         key=lambda n: (len(cache.nodes[n].tasks), n))
+        else:
+            # nothing covers the request: hand over the LARGEST node
+            # (maximum delivered capacity per transfer — repeated
+            # small-node grants would churn without ever fitting the gang)
+            chosen = min(nodes,
+                         key=lambda n: (-cache.nodes[n].allocatable.cpu,
+                                        len(cache.nodes[n].tasks), n))
+        with self._lock:
+            req.node = chosen
+            req.state = GRANTING
+        self.pmap._pin_node_raw(chosen, req.rid)
+        self._journal_reserve("reserve_pin", rid=req.rid, node=chosen,
+                              epoch=epoch)
+
+    def _drain_and_transfer(self, req: ReserveRequest, cache,
+                            epoch: int) -> None:
+        """Phase 2b: evict the owner's remaining tasks off the pinned
+        node through the owner's OWN journaled+fenced evict funnel, and
+        flip ownership once the node is empty. The requester never
+        touches the owner's state."""
+        from ..api import TaskStatus
+        node = cache.nodes.get(req.node)
+        if node is None or self.pmap.pin_of(req.node) != req.rid:
+            # the donor vanished (node_fail) mid-drain: back to square
+            # one; the deadline still bounds the whole exchange
+            with self._lock:
+                req.node = ""
+                req.state = REQUESTED
+            return
+        if node.tasks:
+            for uid in sorted(node.tasks):
+                clone = node.tasks[uid]
+                job = cache.jobs.get(clone.job)
+                task = job.tasks.get(uid) if job is not None else None
+                if task is None or task.status == TaskStatus.RELEASING:
+                    continue
+                try:
+                    cache.evict(task, "cross-partition-reserve")
+                except Exception:
+                    log.exception("reserve drain evict %s failed; the "
+                                  "resync queue owns the retry", uid)
+            if node.tasks:
+                return                 # not empty yet: next cycle
+        self.pmap._transfer_node_raw(req.node, req.frm)
+        with self._lock:
+            req.epoch_granted = epoch
+            self.node_transfers += 1
+            self._settle(req, GRANTED)
+        self._journal_reserve("reserve_grant", rid=req.rid, node=req.node,
+                              frm=req.to, to=req.frm,
+                              epoch_from=req.epoch_from, epoch=epoch)
+
+    # -- timeout-based release (any partition's cycle) -----------------------
+
+    def expire(self, now: Optional[float] = None) -> int:
+        """Settle every open request whose deadline passed — run by
+        WHICHEVER partition's cycle gets there first, so a killed
+        requester or owner can never strand a request (or a pinned,
+        half-drained node) forever."""
+        now = self.time_fn() if now is None else now
+        expired = []
+        with self._lock:
+            for req in list(self.requests.values()):
+                if req.state in _OPEN and now > req.deadline:
+                    expired.append(req)
+                    self._settle(req, EXPIRED)
+        for req in expired:
+            if req.node:
+                self.pmap._pin_node_raw(req.node, None)
+            self._journal_reserve("reserve_expire", rid=req.rid,
+                                  node=req.node)
+        return len(expired)
+
+    # -- queue rebalancing (the same funnel) ---------------------------------
+
+    def move_queue(self, queue: str, to: int, epoch: int) -> bool:
+        """Begin rebalancing ``queue`` to partition ``to``: journal the
+        move and mark the queue draining. Ownership flips only in
+        ``settle_moves`` once the queue's in-flight intents drained."""
+        frm = self.pmap.owner_of_queue(queue)
+        if frm is None or frm == to or queue in self.pmap.draining:
+            return False
+        if self.registry is not None \
+                and epoch < self.registry.current(frm):
+            return False             # a deposed leader may not move queues
+        self._journal_reserve("queue_move", queue=queue, frm=frm, to=to,
+                              epoch=epoch)
+        self.pmap._begin_drain_raw(queue, to)
+        return True
+
+    def _queue_has_open_intents(self, queue: str, cache) -> bool:
+        if self.journal is None:
+            return False
+        for intent in self.journal.unacked():
+            job = cache.jobs.get(intent.job)
+            if job is not None and job.queue == queue:
+                return True
+        return False
+
+    def settle_moves(self, pid: int, epoch: int) -> int:
+        """Complete every draining queue move whose source is ``pid``:
+        once no open journal intent references the queue's jobs, move
+        the jobs (and their node mirrors) to the destination partition's
+        cache and flip ownership. Returns the number of flips."""
+        if self.registry is not None and epoch < self.registry.current(pid):
+            return 0                 # deposed-epoch reviewers may not flip
+        cache = self._caches.get(pid)
+        if cache is None:
+            return 0
+        moves = [(q, dest) for q, dest in sorted(self.pmap.draining.items())
+                 if self.pmap.owner_of_queue(q) == pid]
+        flipped = 0
+        for queue, dest in moves:
+            if self._queue_has_open_intents(queue, cache):
+                continue
+            dest_cache = self._caches.get(dest)
+            if dest_cache is None:
+                continue
+            self._move_queue_jobs(queue, cache, dest_cache)
+            self.pmap._transfer_queue_raw(queue, dest)
+            with self._lock:
+                self.queue_moves += 1
+            self._journal_reserve("queue_move_done", queue=queue, frm=pid,
+                                  to=dest, epoch=epoch)
+            flipped += 1
+        return flipped
+
+    @staticmethod
+    def _move_queue_jobs(queue: str, frm_cache, to_cache) -> None:
+        """Surgically move a drained queue's jobs between partition
+        caches: the job objects (and their placed tasks' node-mirror
+        accounting) leave the source cache — remove_job also purges any
+        queued retry/dead-letter state, so no orphaned side effects —
+        and land in the destination, dirty-marked on both sides."""
+        moved = [j for j in list(frm_cache.jobs.values())
+                 if j.queue == queue]
+        for job in moved:
+            frm_cache.remove_job(job.uid)
+            for task in job.tasks.values():
+                node_name = task.node_name
+                if node_name and node_name in frm_cache.nodes:
+                    frm_cache.mark_node_dirty(node_name)
+                    frm_cache.nodes[node_name].remove_task(task)
+                    # remove_task clears node_name, but the task is
+                    # still PLACED cluster-side — only its cache home
+                    # moves; restore it for the destination mirror
+                    task.node_name = node_name
+            to_cache.add_job(job)
+            for task in job.tasks.values():
+                node = to_cache.nodes.get(task.node_name) \
+                    if task.node_name else None
+                if node is not None and task.uid not in node.tasks:
+                    to_cache.mark_node_dirty(node.name)
+                    node.add_task(task)
+
+    # -- introspection -------------------------------------------------------
+
+    def detail(self) -> dict:
+        with self._lock:
+            open_reqs = [r.as_dict() for r in self.requests.values()
+                         if r.state in _OPEN]
+            return {
+                "counts": dict(self.counts),
+                "node_transfers": self.node_transfers,
+                "queue_moves": self.queue_moves,
+                "open": sorted(open_reqs, key=lambda d: d["rid"]),
+            }

@@ -53,6 +53,16 @@ DEFAULT_RENEW_DEADLINE = 10.0
 DEFAULT_RETRY_PERIOD = 2.0
 
 
+def partition_lease_name(base: str, pid: int) -> str:
+    """The per-partition Lease name of a federated control plane
+    (docs/federation.md): each partition elects its own fenced leader
+    under ``<base>-p<pid>``, so fencing epochs are namespaced by
+    partition id — one partition's failover can never fence another's
+    leader. The sim runner and ``vcctl federation status`` share this
+    naming."""
+    return f"{base}-p{int(pid)}"
+
+
 @dataclass
 class Lease:
     """coordination.k8s.io/v1 Lease mirror, extended with the fencing

@@ -147,6 +147,18 @@ if _HAVE_PROM:
     _failovers = Counter(f"{_SUBSYSTEM}_failovers_total",
                          "Leadership takeovers (a replica acquired an "
                          "expired foreign lease and resumed scheduling)")
+    _partition_leader = Gauge(f"{_SUBSYSTEM}_partition_leader",
+                              "1 this replica leads the labelled "
+                              "federation partition (docs/federation.md)",
+                              ["partition"])
+    _xp_reserves = Counter(
+        f"{_SUBSYSTEM}_cross_partition_reserves_total",
+        "Cross-partition reserve/transfer protocol steps by result "
+        "(requested|granted|rejected|expired)", ["result"])
+    _admission_batch = Histogram(
+        f"{_SUBSYSTEM}_admission_batch_size",
+        "Jobs per batched admission submit (docs/federation.md)",
+        buckets=(1, 4, 16, 64, 256, 1024, 4096))
 
 
 def update_e2e_duration(seconds: float) -> None:
@@ -202,6 +214,14 @@ def health_detail() -> dict:
                                                "epoch": 0})),
             "fencing_rejections_total": fenced,
             "failovers_total": _counters.get(("failovers",), 0),
+            # federation (docs/federation.md): per-partition leadership/
+            # ownership entries published by PartitionMember, plus the
+            # cross-partition reserve counters
+            "federation": dict(_health_detail.get("federation",
+                                                  {"enabled": False})),
+            "cross_partition_reserves_total": {
+                k[1]: v for k, v in _counters.items()
+                if k[0] == "cross_partition_reserves"},
         }
 
 
@@ -345,6 +365,46 @@ def register_failover() -> None:
         _failovers.inc()
 
 
+def set_partition_leader(partition: int, leading: bool, epoch: int = 0,
+                         detail: Optional[dict] = None) -> None:
+    """Publish a federation partition's leadership state
+    (docs/federation.md): the labelled gauge plus the per-partition
+    entry of /healthz?detail's "federation" section. Each partition
+    member publishes its own entry; entries merge by partition id."""
+    with _lock:
+        _gauges[("partition_leader", str(partition))] = \
+            1.0 if leading else 0.0
+        fed = _health_detail.setdefault("federation", {"enabled": True})
+        fed["enabled"] = True
+        entry = {"leading": bool(leading), "epoch": int(epoch)}
+        if detail:
+            entry.update(detail)
+        fed[str(partition)] = entry
+    if _HAVE_PROM:
+        _partition_leader.labels(partition=str(partition)).set(
+            1.0 if leading else 0.0)
+
+
+def register_cross_partition_reserve(result: str, n: int = 1) -> None:
+    """A cross-partition reserve/transfer protocol step settled with the
+    given result (requested|granted|rejected|expired) — the federated
+    reclaim funnel's audit counter (docs/federation.md)."""
+    with _lock:
+        _counters[("cross_partition_reserves", result)] += n
+    if _HAVE_PROM:
+        _xp_reserves.labels(result=result).inc(n)
+
+
+def observe_admission_batch(size: int) -> None:
+    """One batched admission submit of ``size`` jobs went through the
+    amortized validate-then-single-store-write path
+    (webhooks/admission.submit_job_batch)."""
+    with _lock:
+        _durations[("admission_batch",)].observe(float(size))
+    if _HAVE_PROM:
+        _admission_batch.observe(size)
+
+
 def register_dead_letter(op: str) -> None:
     """A failed side effect exhausted its resync retry budget and was
     parked in the cache's dead-letter set."""
@@ -371,6 +431,7 @@ _EXPO_GAUGES = {
                                 None),
     "device_healthy": (f"{_SUBSYSTEM}_device_healthy", None),
     "leader": (f"{_SUBSYSTEM}_leader", None),
+    "partition_leader": (f"{_SUBSYSTEM}_partition_leader", "partition"),
 }
 _EXPO_COUNTERS = {
     "attempts": (f"{_SUBSYSTEM}_schedule_attempts_total", "result"),
@@ -389,6 +450,8 @@ _EXPO_COUNTERS = {
         f"{_SUBSYSTEM}_device_degraded_cycles_total", None),
     "fencing_rejections": (f"{_SUBSYSTEM}_fencing_rejections_total", "op"),
     "failovers": (f"{_SUBSYSTEM}_failovers_total", None),
+    "cross_partition_reserves": (
+        f"{_SUBSYSTEM}_cross_partition_reserves_total", "result"),
 }
 # duration-series key -> (family, label name, unit suffix already in name)
 _EXPO_DURATIONS = {
@@ -398,6 +461,7 @@ _EXPO_DURATIONS = {
                "action"),
     "plugin": (f"{_SUBSYSTEM}_plugin_scheduling_latency_microseconds",
                "plugin"),
+    "admission_batch": (f"{_SUBSYSTEM}_admission_batch_size", None),
 }
 
 

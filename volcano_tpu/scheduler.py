@@ -158,6 +158,13 @@ class Scheduler:
         # seeded SimKill can land INSIDE the close — the adversarial
         # point where binds executed but writebacks didn't.
         self.close_fault_hook: Optional[Callable] = None
+        # federation (docs/federation.md): a PartitionMember when this
+        # scheduler runs one partition of a federated control plane.
+        # Driven at the cycle boundaries — on_cycle_start BEFORE the
+        # snapshot (incoming reserves granted against pre-cycle state),
+        # on_cycle_end in the epilogue — and only while this replica
+        # leads its partition (the hooks sit behind the HA gate).
+        self.federation = None
         self._load_conf(conf_text)
 
     # -- HA role state machine (docs/robustness.md) --------------------------
@@ -202,6 +209,11 @@ class Scheduler:
             # guard's job, not a role)
             self.role = ROLE_FOLLOWER
             metrics.set_leader(False, self.role, elector.fencing_epoch)
+            if self.federation is not None:
+                # keep the per-partition leadership gauge honest: the
+                # leader-gated cycle hooks never run here, so the
+                # follower state must be published from the gate itself
+                self.federation.publish_follower()
             return False
         if not led_before:
             # epoch 1 is the first-ever leadership; any later acquisition
@@ -308,6 +320,21 @@ class Scheduler:
                 log.exception("resync processing failed")
                 metrics.register_action_failure("resync")
                 errors.append(("resync", exc))
+        # federated cycle boundary (docs/federation.md): expire timed-out
+        # reserves, settle drained queue moves, review incoming reserve
+        # requests — BEFORE the snapshot, so grants (evictions, node
+        # transfers) shape the state this cycle schedules against.
+        # Isolated like an action; a SimKill inside a drain eviction
+        # tunnels (it is not an Exception), exactly like the funnels it
+        # rides through.
+        if self.federation is not None:
+            try:
+                with rec.span("federation"):
+                    self.federation.on_cycle_start()
+            except Exception as exc:
+                log.exception("federation cycle-start hook failed")
+                metrics.register_action_failure("federation")
+                errors.append(("federation", exc))
         # A cycle whose pipeline resolves to NO runnable action is a no-op:
         # don't pay cache.snapshot() (re-cloning queues/jobs at 10k scale)
         # plus a full open/close just to run zero actions — the state a
@@ -420,6 +447,12 @@ class Scheduler:
                     journal.flush()
                 except Exception:
                     log.exception("journal flush failed")
+            if self.federation is not None:
+                try:
+                    self.federation.on_cycle_end()
+                except Exception:
+                    log.exception("federation cycle-end hook failed")
+                    metrics.register_action_failure("federation")
             self._maybe_verify_drift()
 
     def _maybe_verify_drift(self) -> None:

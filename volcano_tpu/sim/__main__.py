@@ -85,6 +85,21 @@ def main(argv=None) -> int:
                          "epochs + warm journal-tail standbys; "
                          "docs/robustness.md). --kill-cycles then kills "
                          "the LEADER at seeded adversarial points")
+    ap.add_argument("--federated", type=int, default=0, metavar="N",
+                    help="run N PARTITION schedulers over one virtual "
+                         "cluster (disjoint queue subsets + node shards, "
+                         "per-partition fenced leaders, cross-partition "
+                         "reserve/transfer through the shared journal; "
+                         "docs/federation.md). --kill-cycles then kills "
+                         "a partition's leader at seeded adversarial "
+                         "points")
+    ap.add_argument("--verify-federated-equivalence", action="store_true",
+                    help="also run the SAME trace single-scheduler and "
+                         "assert equivalence: byte-identical aggregate "
+                         "decision plane when the federated run is "
+                         "non-contended (no kills), terminal-accounting "
+                         "equivalence + zero cross-partition double-binds "
+                         "otherwise (exit 1 on mismatch)")
     ap.add_argument("--lease-loss-cycles", default="",
                     help="comma-separated virtual cycles on which the "
                          "leader LOSES ITS LEASE mid-cycle (no process "
@@ -132,7 +147,7 @@ def main(argv=None) -> int:
                 lambda e: ChaosEvictor(e, failure_rate=args.chaos_rate,
                                        seed=chaos_seed))
 
-    def run(kills, replicas=None, losses=None):
+    def run(kills, replicas=None, losses=None, federated=None):
         bw, ew = wraps()
         runner = SimRunner(trace, conf_text=conf_text, period=args.period,
                            seed=args.seed, max_cycles=args.max_cycles,
@@ -142,7 +157,9 @@ def main(argv=None) -> int:
                            ha_replicas=args.ha if replicas is None
                            else replicas,
                            lease_loss_cycles=lease_loss if losses is None
-                           else losses)
+                           else losses,
+                           federated_partitions=args.federated
+                           if federated is None else federated)
         return runner.run()
 
     if args.trace_out:
@@ -186,6 +203,48 @@ def main(argv=None) -> int:
         print(f"restart-equivalence OK: {report['restarts']} restarts, "
               f"journal={report['journal_replayed']}, "
               f"accounting={got}", file=sys.stderr)
+    if args.verify_federated_equivalence:
+        import json as _json
+        baseline = run([], replicas=1, losses=[], federated=0)
+        problems = []
+        # contended = anything that can legitimately diverge the
+        # aggregate plane from the oracle: seeded kills/lease losses, OR
+        # the run itself exercising cross-partition reserves (capacity
+        # moved between partitions — timing shifts are the feature)
+        contended = bool(kill_cycles or lease_loss
+                         or report.get("cross_partition_reserves"))
+        if not contended:
+            got_json = _json.dumps(oracle_part(report), sort_keys=True,
+                                   separators=(",", ":"))
+            want_json = _json.dumps(oracle_part(baseline), sort_keys=True,
+                                    separators=(",", ":"))
+            if got_json != want_json:
+                problems.append("non-contended federated aggregate "
+                                "decision plane differs from the "
+                                "single-scheduler oracle")
+        else:
+            got = terminal_accounting(report)
+            want = terminal_accounting(baseline)
+            if got != want:
+                problems.append(f"terminal accounting diverged: "
+                                f"federated={got} oracle={want}")
+        if report.get("double_binds"):
+            problems.append(f"cross-partition double-binds in federated "
+                            f"run: {report['double_binds']}")
+        if report["jobs"]["completed"] != report["jobs"]["arrived"]:
+            problems.append("federated run did not complete every "
+                            "arrived job")
+        if problems:
+            for p in problems:
+                print(f"federated-equivalence FAILED: {p}", file=sys.stderr)
+            return 1
+        fed = report.get("federation", {})
+        print(f"federated-equivalence OK: partitions={args.federated}, "
+              f"restarts={report.get('restarts', 0)}, "
+              f"failovers={report.get('failovers', 0)}, "
+              f"reserves={report.get('cross_partition_reserves', {})}, "
+              f"node_transfers={fed.get('node_transfers', 0)}",
+              file=sys.stderr)
     if args.verify_ha_equivalence:
         import json as _json
         baseline = run([], replicas=1, losses=[])

@@ -34,62 +34,93 @@ def percentiles(values: Iterable[float],
 
 def cpu_utilization(cache) -> float:
     """Allocated-CPU fraction over ready nodes (0 when no node is ready)."""
-    used = total = 0.0
-    for node in cache.nodes.values():
-        if not node.ready:
-            continue
-        used += node.used.cpu
-        total += node.allocatable.cpu
-    return used / total if total else 0.0
+    return cpu_utilization_all([cache])
 
 
 def mem_utilization(cache) -> float:
+    return mem_utilization_all([cache])
+
+
+def _utilization_all(caches, field: str) -> float:
+    """Aggregate utilization over one or more caches holding DISJOINT
+    slices of the same cluster (federated partitions: every cache
+    mirrors every node, but each accounts only its own partition's
+    tasks). Capacity counts each node once (from the first cache that
+    has it); usage sums across all caches. A single-cache list degrades
+    to the classic per-cache reading."""
     used = total = 0.0
-    for node in cache.nodes.values():
-        if not node.ready:
-            continue
-        used += node.used.memory
-        total += node.allocatable.memory
+    seen = set()
+    for cache in caches:
+        for name, node in cache.nodes.items():
+            if not node.ready:
+                continue
+            used += getattr(node.used, field)
+            if name not in seen:
+                seen.add(name)
+                total += getattr(node.allocatable, field)
     return used / total if total else 0.0
 
 
+def cpu_utilization_all(caches) -> float:
+    return _utilization_all(caches, "cpu")
+
+
+def mem_utilization_all(caches) -> float:
+    return _utilization_all(caches, "memory")
+
+
 def drf_fairness_gap(cache) -> float:
+    return drf_fairness_gap_all([cache])
+
+
+def drf_fairness_gap_all(caches) -> float:
     """Spread of weight-normalized dominant shares across ACTIVE queues
     (queues holding allocations or pending demand): 0 is perfectly fair
     by DRF-with-weights; the gap is max - min of share_q / weight_q where
     share_q is the queue's dominant resource share of cluster capacity
     (drf.go calculate_share semantics). Inactive queues abstain — an
-    empty queue's zero share is idleness, not unfairness."""
+    empty queue's zero share is idleness, not unfairness. Accepts the
+    disjoint partition caches of a federated run (jobs are homed in
+    exactly one cache; capacity counts each node once), degrading to the
+    classic single-cache reading for a one-element list."""
     total_cpu = total_mem = 0.0
-    for node in cache.nodes.values():
-        if not node.ready:
-            continue
-        total_cpu += node.allocatable.cpu
-        total_mem += node.allocatable.memory
+    seen = set()
+    for cache in caches:
+        for name, node in cache.nodes.items():
+            if not node.ready or name in seen:
+                continue
+            seen.add(name)
+            total_cpu += node.allocatable.cpu
+            total_mem += node.allocatable.memory
     if not total_cpu:
         return 0.0
     alloc: Dict[str, List[float]] = {}
     active: Dict[str, bool] = {}
-    for job in cache.jobs.values():
-        cpu = mem = 0.0
-        pending = False
-        for t in job.tasks.values():
-            if t.status in (TaskStatus.BOUND, TaskStatus.BINDING,
-                            TaskStatus.RUNNING, TaskStatus.ALLOCATED):
-                cpu += t.resreq.cpu
-                mem += t.resreq.memory
-            elif t.status == TaskStatus.PENDING:
-                pending = True
-        q = alloc.setdefault(job.queue, [0.0, 0.0])
-        q[0] += cpu
-        q[1] += mem
-        active[job.queue] = active.get(job.queue, False) or pending \
-            or cpu > 0 or mem > 0
+    for cache in caches:
+        for job in cache.jobs.values():
+            cpu = mem = 0.0
+            pending = False
+            for t in job.tasks.values():
+                if t.status in (TaskStatus.BOUND, TaskStatus.BINDING,
+                                TaskStatus.RUNNING, TaskStatus.ALLOCATED):
+                    cpu += t.resreq.cpu
+                    mem += t.resreq.memory
+                elif t.status == TaskStatus.PENDING:
+                    pending = True
+            q = alloc.setdefault(job.queue, [0.0, 0.0])
+            q[0] += cpu
+            q[1] += mem
+            active[job.queue] = active.get(job.queue, False) or pending \
+                or cpu > 0 or mem > 0
     shares = []
     for quid, (cpu, mem) in alloc.items():
         if not active.get(quid):
             continue
-        queue = cache.queues.get(quid)
+        queue = None
+        for cache in caches:
+            queue = cache.queues.get(quid)
+            if queue is not None:
+                break
         weight = max(getattr(queue, "weight", 1) or 1, 1)
         dom = max(cpu / total_cpu, mem / total_mem if total_mem else 0.0)
         shares.append(dom / weight)
@@ -107,8 +138,6 @@ def build_report(runner, actions_ms: Dict[tuple, list],
     percentiles below cover only the newest retained window, not every
     cycle."""
     conf = runner.sched.conf
-    view = runner.view_cache() if hasattr(runner, "view_cache") \
-        else runner.cache
     acts = {}
     for key, vals in actions_ms.items():
         if len(key) == 2 and key[0] == "action" and vals:
@@ -126,12 +155,12 @@ def build_report(runner, actions_ms: Dict[tuple, list],
             "arrived": runner.arrived,
             "admitted": len(runner.gang_admission),
             "completed": runner.completed,
-            "unfinished": len(view.jobs),
+            "unfinished": runner.unfinished_jobs(),
         },
         "binds": len(runner.binder.sequence),
         "evicts": len(runner.evictor.sequence),
         "requeues": runner.requeues,
-        "dead_letter": len(view.dead_letter),
+        "dead_letter": runner.dead_letter_total(),
         "action_failures": len(runner.action_failures),
         # crash/restart plane (zero on unkilled runs; deterministic from
         # kill_cycles + kill_seed, so still part of the decision plane)
@@ -142,8 +171,16 @@ def build_report(runner, actions_ms: Dict[tuple, list],
         # fencing gate's stale-epoch rejections — deterministic from
         # (trace, seed, kill/lease-loss config), so decision plane
         "failovers": getattr(runner, "failovers", 0),
-        "fenced_rejections": runner.authority.rejections
-        if getattr(runner, "authority", None) is not None else 0,
+        "fenced_rejections": runner.fencing_rejections()
+        if hasattr(runner, "fencing_rejections")
+        else (runner.authority.rejections
+              if getattr(runner, "authority", None) is not None else 0),
+        # cross-partition reserve/transfer counters (docs/federation.md):
+        # part of EVERY report — a non-federated (or non-contended
+        # federated) run must report {} here, which is exactly what the
+        # federated-equivalence oracle diff checks
+        "cross_partition_reserves": dict(runner.ledger.counts)
+        if getattr(runner, "ledger", None) is not None else {},
         "jct_s": percentiles(runner.jct),
         "queueing_delay_s": percentiles(runner.queueing_delay),
         "gang_admission_s": percentiles(runner.gang_admission),
@@ -167,7 +204,19 @@ def build_report(runner, actions_ms: Dict[tuple, list],
     if actions_truncated:
         report["wallclock"]["actions_ms_truncated"] = \
             list(actions_truncated)
-    if getattr(runner, "replicas", None):
+    if getattr(runner, "federated", 0):
+        ledger = runner.ledger
+        report["federation"] = {
+            "partitions": runner.federated,
+            "map": runner.pmap.counts(),
+            "map_version": runner.pmap.version,
+            "reserves": dict(ledger.counts),
+            "node_transfers": ledger.node_transfers,
+            "queue_moves": ledger.queue_moves,
+            "failover_cycles": list(runner.failover_cycles),
+            "failover_cycles_max": max(runner.failover_cycles, default=0),
+        }
+    elif getattr(runner, "replicas", None):
         report["ha"] = {
             "replicas": runner.ha_replicas,
             "failover_cycles": list(runner.failover_cycles),
@@ -197,14 +246,16 @@ def terminal_accounting(report: dict) -> dict:
 
 
 def oracle_part(report: dict) -> dict:
-    """The decision plane MINUS the HA-topology-specific keys — what an
-    ``--ha N`` run of a non-contended trace must reproduce byte-for-byte
-    against the single-scheduler oracle (the acceptance criterion for
-    decision-plane equivalence). ``failovers``/``fenced_rejections`` stay
-    IN: a non-contended HA run must report both as 0, same as the
-    oracle."""
+    """The decision plane MINUS the topology-specific sections — what an
+    ``--ha N`` (or ``--federated N``) run of a non-contended trace must
+    reproduce byte-for-byte against the single-scheduler oracle (the
+    acceptance criterion for decision-plane equivalence).
+    ``failovers``/``fenced_rejections``/``cross_partition_reserves``
+    stay IN: a non-contended run must report 0 / {} for all three, same
+    as the oracle."""
     part = deterministic_part(report)
     part.pop("ha", None)
+    part.pop("federation", None)
     return part
 
 

@@ -135,7 +135,8 @@ class SimRunner:
                  kill_seed: int = 0,
                  journal: Optional[IntentJournal] = None,
                  ha_replicas: int = 1,
-                 lease_loss_cycles: Optional[Sequence[int]] = None):
+                 lease_loss_cycles: Optional[Sequence[int]] = None,
+                 federated_partitions: int = 0):
         self.trace = list(trace)
         self.period = period
         self.seed = seed
@@ -167,6 +168,21 @@ class SimRunner:
         # fencing authority; exactly one replica holds the lease and
         # schedules, the rest tail the journal warm.
         self.ha_replicas = max(int(ha_replicas), 1)
+        # federated mode (docs/federation.md): N PARTITION schedulers —
+        # disjoint queue subsets and node shards of one virtual cluster,
+        # each partition its own fenced leader (per-partition lease +
+        # authority), coordinating only through the shared journal's
+        # reserve/transfer protocol. Mutually exclusive with --ha (the
+        # two topologies answer different questions).
+        self.federated = max(int(federated_partitions or 0), 0)
+        if self.federated == 1:
+            self.federated = 0              # one partition == standalone
+        if self.federated and self.ha_replicas > 1:
+            raise ValueError("ha_replicas and federated_partitions are "
+                             "mutually exclusive")
+        self.pmap = None
+        self.ledger = None
+        self.registry = None
         self.lease_loss_cycles = set(lease_loss_cycles or ())
         self._lease_rng = random.Random(kill_seed ^ 0x9E3779B9)
         self.failovers = 0
@@ -193,7 +209,9 @@ class SimRunner:
         from ..device_health import DEVICE_HEALTH
         DEVICE_HEALTH.reset(time_fn=self.clock.time)
         self.conf_text = conf_text if conf_text is not None else SIM_CONF
-        if self.ha_replicas > 1:
+        if self.federated:
+            self._init_federated(binder, evictor)
+        elif self.ha_replicas > 1:
             self._init_ha(binder, evictor)
         else:
             self.cache = SchedulerCache(binder=binder, evictor=evictor,
@@ -264,6 +282,15 @@ class SimRunner:
         """Apply one trace event to EVERY replica cache (the watch stream
         every replica sees) plus the runner's global bookkeeping once."""
         d = ev.data
+        if self.pmap is not None:
+            # federated: the watch stream also feeds the partition map
+            # (deterministic round-robin in stream order)
+            if ev.kind == "queue_add":
+                self.pmap.register_queue(d["name"])
+            elif ev.kind == "node_add":
+                self.pmap.register_node(d["name"])
+            elif ev.kind == "node_fail":
+                self.pmap.forget_node(d["name"])
         if ev.kind == "node_fail":
             self._fail_node(d["name"])
             return
@@ -271,7 +298,7 @@ class SimRunner:
             self._arrive(ev.t, d)
             return
         if ev.kind == "job_complete":
-            if d["name"] in self._view().jobs:
+            if self._job(d["name"]) is not None:
                 self._complete_job(d["name"], ev.t)
             return
         for cache in self.caches:
@@ -300,9 +327,43 @@ class SimRunner:
                     node.ready = True
                     cache.mark_node_dirty(node.name)
 
+    def _job(self, uid: str):
+        """The live JobInfo for ``uid`` wherever it is homed: the view
+        cache in single/HA mode (replicas converge), the owning
+        partition's cache in federated mode (ingestion is partitioned —
+        a job exists only in its queue's owner)."""
+        for cache in self.caches:
+            job = cache.jobs.get(uid)
+            if job is not None:
+                return job
+        return None
+
+    def unfinished_jobs(self) -> int:
+        if self.federated:
+            return sum(len(c.jobs) for c in self.caches)
+        return len(self._view().jobs)
+
+    def dead_letter_total(self) -> int:
+        if self.federated:
+            return sum(len(c.dead_letter) for c in self.caches)
+        return len(self._view().dead_letter)
+
+    def fencing_rejections(self) -> int:
+        if self.registry is not None:
+            return self.registry.rejections()
+        return self.authority.rejections if self.authority is not None \
+            else 0
+
     def _arrive(self, t: float, d: dict) -> None:
         name = d["name"]
-        for cache in self.caches:
+        caches = self.caches
+        if self.federated:
+            # partitioned ingestion: the job materializes only in its
+            # queue's owning partition (a server-side filtered watch) —
+            # which is also what keeps the 1M-job scenario affordable
+            pid = self.pmap.owner_of_queue(d["queue"])
+            caches = [self.caches[pid if pid is not None else 0]]
+        for cache in caches:
             scalars = {"nvidia.com/gpu": float(d["gpus"])} if d["gpus"] \
                 else None
             pg = PodGroup(name=name, queue=d["queue"],
@@ -386,7 +447,7 @@ class SimRunner:
             self._complete_job(uid, t)
 
     def _complete_job(self, uid: str, t: float) -> None:
-        vjob = self._view().jobs.get(uid)
+        vjob = self._job(uid)
         if vjob is None:
             return
         uids = list(vjob.tasks)
@@ -464,7 +525,7 @@ class SimRunner:
                     if cached.status == TaskStatus.BOUND:
                         cache.update_task_status(cached, TaskStatus.RUNNING)
         for jid in touched:
-            job = self._view().jobs.get(jid)
+            job = self._job(jid)
             if job is None or jid in self.admitted_at:
                 continue
             if job.min_available > 0 \
@@ -479,15 +540,15 @@ class SimRunner:
     # -- the run loop -------------------------------------------------------
 
     def _progress_signature(self) -> tuple:
-        view = self._view()
         return (self._trace_ix, self._binds_seen, self._evicts_seen,
-                self.completed, self.requeues, len(view.jobs),
-                len(view.resync_queue), len(view.dead_letter))
+                self.completed, self.requeues, self.unfinished_jobs(),
+                sum(len(c.resync_queue) for c in self.caches),
+                sum(len(c.dead_letter) for c in self.caches))
 
     def _done(self) -> bool:
         return (self._trace_ix >= len(self.trace)
                 and not self._completions
-                and not self._view().jobs)
+                and not self.unfinished_jobs())
 
     # -- HA control plane (docs/robustness.md) ------------------------------
 
@@ -746,6 +807,207 @@ class SimRunner:
         if not self._feedback_blocked:
             self._feedback(now)
 
+    # -- federated control plane (docs/federation.md) ------------------------
+
+    def _init_federated(self, binder, evictor) -> None:
+        """Build the N-partition control plane: a shared PartitionMap +
+        reserve ledger + in-memory journal + lease store, and per
+        partition a cache (scoped snapshot over its queue subset + node
+        shard), a fenced executor gate against its OWN authority (epochs
+        namespaced by partition id), a cycle-driven elector on its OWN
+        lease, and a PartitionMember riding the scheduler shell's
+        federation hooks."""
+        from ..cache.executors import FencingRegistry
+        from ..federation import PartitionMap, ReserveLedger
+        from ..store import ObjectStore
+        if self.journal is None:
+            self.journal = IntentJournal()
+        self.lease_store = ObjectStore()
+        self.registry = FencingRegistry()
+        self.pmap = PartitionMap(self.federated)
+        self.ledger = ReserveLedger(self.pmap, journal=self.journal,
+                                    registry=self.registry,
+                                    time_fn=self.clock.time,
+                                    timeout_s=8 * self.period)
+        self.caches: List[SchedulerCache] = []
+        self._view_ix = 0
+        self._fed_oracles: Dict[int, tuple] = {}
+        self._p_leader_key: Dict[int, Optional[tuple]] = {}
+        self._p_vacant: Dict[int, Optional[int]] = {}
+        self._p_had: Dict[int, bool] = {}
+        for pid in range(self.federated):
+            rep = _Replica(pid)
+            cache = SchedulerCache(
+                binder=FencedBinder(binder,
+                                    lambda r=rep: r.elector.fencing_epoch,
+                                    self.registry.authority(pid)),
+                evictor=FencedEvictor(evictor,
+                                      lambda r=rep: r.elector.fencing_epoch,
+                                      self.registry.authority(pid)),
+                default_queue=None, journal=self.journal)
+            cache.resync_queue.time_fn = self.clock.time
+            cache.time_fn = self.clock.time
+            cache.snapshot_scope = \
+                lambda ci, p=pid: self.pmap.scope(ci, p)
+            rep.cache = cache
+            self._build_partition_shell(rep)
+            self.replicas.append(rep)
+            self.caches.append(cache)
+            self._p_leader_key[pid] = None
+            self._p_vacant[pid] = None
+            self._p_had[pid] = False
+        self.cache = self.caches[0]
+        self.sched = self.replicas[0].sched
+
+    def _build_partition_shell(self, rep: _Replica) -> None:
+        """(Re)build one partition's scheduler shell + elector + member
+        — fresh on construction AND after a simulated partition death
+        (the cache and the shared map/ledger/journal survive)."""
+        from ..federation import PartitionMember
+        from ..leaderelection import (FlapGuard, LeaderElector,
+                                      partition_lease_name)
+        pid = rep.ix
+        ident = f"fed-p{pid}" if rep.gen == 0 else f"fed-p{pid}-g{rep.gen}"
+        rep.elector = LeaderElector(
+            self.lease_store, partition_lease_name("vc-scheduler", pid),
+            on_started_leading=lambda: None,
+            identity=ident,
+            lease_duration=1.6 * self.period,
+            renew_deadline=1.2 * self.period,
+            retry_period=self.period,
+            time_fn=self.clock.time, mono_fn=self.clock.time,
+            authority=self.registry.authority(pid),
+            flap_guard=FlapGuard(cooldown_s=4 * self.period,
+                                 max_cooldown_s=16 * self.period,
+                                 time_fn=self.clock.time))
+        sched = Scheduler(rep.cache, conf_text=self.conf_text,
+                          schedule_period=self.period, clock=self.clock,
+                          rng=random.Random(self.seed))
+        sched.attach_elector(rep.elector)
+        sched.reconcile_oracle_fn = \
+            lambda p=pid: self._fed_oracles.pop(p, None)
+        sched.action_fault_hook = self._mk_action_hook(rep)
+        sched.close_fault_hook = self._close_hook
+        sched.federation = PartitionMember(
+            pid, self.pmap, self.ledger, rep.cache,
+            epoch_fn=lambda r=rep: r.elector.fencing_epoch,
+            time_fn=self.clock.time,
+            starve_after_s=4 * self.period)
+        rep.sched = sched
+
+    def _crash_restart_partition(self, rep: _Replica,
+                                 kill_mode: Optional[str]) -> None:
+        """One partition's scheduler process dies and restarts: volatile
+        state is lost, the shared journal/map/ledger/lease store (and
+        the cache, standing in for the relist) survive. The kill-MODE-
+        precise crash oracle is parked for THIS partition's next leader
+        — the other partitions keep scheduling their own subsets, and
+        cluster feedback defers until every partition has a leader again
+        (the killed partition's handoff reconcile settles its crash
+        window before any ack is consumed)."""
+        self._disarm_kills()
+        c = rep.cache
+        c.binding_tasks.clear()
+        c.dead_letter.clear()
+        metrics.set_dead_letter_size(0)
+        c.err_tasks.clear()
+        c.resync_queue = RateLimitedQueue(
+            max_retries=c.resync_queue.max_retries,
+            time_fn=self.clock.time)
+        c.mark_all_dirty()
+        c.tensor_cache = None
+        c._tensor_dirty = set()
+        from ..device_health import DEVICE_HEALTH
+        DEVICE_HEALTH.reset(time_fn=self.clock.time)
+        rep.gen += 1
+        self._build_partition_shell(rep)
+        cluster_binds = dict(self.binder.sequence[-1:]) \
+            if kill_mode == "bind_after" else {}
+        etail = tuple(self.evictor.sequence[-1:]) \
+            if kill_mode == "evict_after" else ()
+
+        def cluster_evicts(uid: str, tail=etail) -> bool:
+            return uid in tail
+
+        self._fed_oracles[rep.ix] = (cluster_binds, cluster_evicts)
+        self._feedback_blocked = True
+        self.restarts += 1
+
+    def _account_partitions(self) -> None:
+        """End-of-cycle leadership bookkeeping, per partition: failover
+        counting and vacancy gaps (reusing the HA report fields), the
+        handoff-report harvest, and feedback unblocking once EVERY
+        partition has a live leader."""
+        all_lead = True
+        for rep in self.replicas:
+            pid = rep.ix
+            leads = rep.sched.role == ROLE_LEADER and rep.elector.leading
+            if not leads:
+                all_lead = False
+                self._p_leader_key[pid] = None
+                if self._p_vacant[pid] is None:
+                    self._p_vacant[pid] = self.cycles
+                continue
+            key = rep.key()
+            if key != self._p_leader_key[pid]:
+                if self._p_had[pid]:
+                    self.failovers += 1
+                    gap = 0 if self._p_vacant[pid] is None \
+                        else self.cycles - self._p_vacant[pid]
+                    self.failover_cycles.append(gap)
+                self._p_vacant[pid] = None
+                self._p_leader_key[pid] = key
+                self._p_had[pid] = True
+                rpt = getattr(rep.sched, "last_handoff_report", None)
+                rep.sched.last_handoff_report = None
+                if rpt is not None:
+                    for k, v in rpt.as_dict().items():
+                        if v:
+                            self._journal_replayed[k] = \
+                                self._journal_replayed.get(k, 0) + v
+        if all_lead:
+            self._feedback_blocked = False
+
+    def _federated_cycle(self, now: float) -> None:
+        """One virtual cycle of the N-partition control plane: seeded
+        kill arming (the kill fires inside whichever partition's cycle
+        trips the armed point; a never-fired arm degenerates to a
+        clean-boundary death of a seeded partition), every partition's
+        run_once in pid order, leadership accounting, then cluster
+        feedback unless a partition vacancy defers it."""
+        kill_mode: Optional[str] = None
+        boundary_pid = 0
+        if self.cycles in self.kill_cycles:
+            kill_mode = self._arm_kill_ha()
+            boundary_pid = self._kill_rng.randint(0, self.federated - 1)
+        if self.cycles in self.lease_loss_cycles:
+            self._armed_revoke = self._lease_rng.randint(1, 5)
+        fired = False
+        for rep in self.replicas:
+            t0 = time.perf_counter()
+            try:
+                errors = rep.sched.run_once()
+            except SimKill:
+                errors = []
+                self._crash_restart_partition(rep, kill_mode)
+                kill_mode = None
+                fired = True
+            else:
+                if rep.sched.role == ROLE_LEADER:
+                    self.pipeline_e2e_ms.append(
+                        (time.perf_counter() - t0) * 1e3)
+            for name, _ in errors:
+                self.action_failures.append((self.cycles, name))
+        if kill_mode is not None and not fired:
+            # the armed kill never fired (too few side effects, or
+            # post_cycle): clean-boundary death of the seeded partition
+            self._crash_restart_partition(self.replicas[boundary_pid],
+                                          "post_cycle")
+        self._armed_revoke = None
+        self._account_partitions()
+        if not self._feedback_blocked:
+            self._feedback(now)
+
     # -- crash/restart ------------------------------------------------------
 
     _KILL_MODES = ("bind_before", "bind_after", "evict_before",
@@ -844,7 +1106,9 @@ class SimRunner:
             now = self.clock.time()
             self._apply_trace_until(now)
             self._fire_completions_until(now)
-            if self.replicas:
+            if self.federated:
+                self._federated_cycle(now)
+            elif self.replicas:
                 self._ha_cycle(now)
             else:
                 kill_mode = None
@@ -874,10 +1138,13 @@ class SimRunner:
                 for name, _ in errors:
                     self.action_failures.append((self.cycles, name))
                 self._feedback(now)
-            view = self._view()
-            self.util_cpu.append(report_mod.cpu_utilization(view))
-            self.util_mem.append(report_mod.mem_utilization(view))
-            self.drf_gap.append(report_mod.drf_fairness_gap(view))
+            # decision-plane samples: in federated mode the planes live
+            # in DISJOINT partition caches, so utilization/fairness
+            # aggregate across them; single/HA read the (converged) view
+            sample = self.caches if self.federated else [self._view()]
+            self.util_cpu.append(report_mod.cpu_utilization_all(sample))
+            self.util_mem.append(report_mod.mem_utilization_all(sample))
+            self.drf_gap.append(report_mod.drf_fairness_gap_all(sample))
             self.cycles += 1
             self.clock.sleep(self.period)
             if self._done():

@@ -266,6 +266,51 @@ SCENARIOS: Dict[str, dict] = {
                                      restore_at=80.0, fail=(30, 31),
                                      fail_at=60.0)),
     ),
+    "fed-smoke": dict(
+        description="60 gangs over 4 equal queues on 16 nodes, light "
+                    "load — the federated non-contended oracle world: "
+                    "sharded 4 ways every partition places its gangs the "
+                    "cycle they arrive, so the aggregate decision plane "
+                    "must be byte-identical to the single scheduler's",
+        factory=lambda seed: synthetic_trace(
+            60, 16, seed=seed, arrival_rate=2.0, duration_mean=4.0,
+            duration_cap=12.0,
+            gang_sizes=((1, 0.5), (2, 0.35), (4, 0.15)),
+            queues=(("q1", 1), ("q2", 1), ("q3", 1), ("q4", 1)),
+            cpu_choices=(500, 1000), mem_choices=(GI,),
+            priority_choices=(0,)),
+    ),
+    "fed-starve": dict(
+        description="4 queues / 8 nodes sharded 4 ways with demand "
+                    "pinned to one queue — its 2-node shard saturates "
+                    "while the other shards idle, driving the "
+                    "cross-partition reserve/transfer protocol "
+                    "(docs/federation.md)",
+        factory=lambda seed: synthetic_trace(
+            80, 8, seed=seed, arrival_rate=3.0, duration_mean=12.0,
+            duration_cap=30.0, gang_sizes=((2, 0.6), (4, 0.4)),
+            queues=(("q1", 1), ("q2", 1), ("q3", 1), ("q4", 1)),
+            queue_demand=(40, 1, 1, 1),
+            cpu_choices=(4000, 8000), mem_choices=(GI,),
+            priority_choices=(0,)),
+    ),
+    "federated-1m": dict(
+        description="1,000,000 single-task jobs at 2000 jobs/s over 4 "
+                    "queues on 16 fat nodes — the sustained "
+                    "millions-of-users intake world for `sim "
+                    "--federated 4` (slow; ~500 virtual seconds, jobs "
+                    "complete within ~2 s so the live set stays small "
+                    "while the cumulative count reaches 1M)",
+        factory=lambda seed: synthetic_trace(
+            1_000_000, 16, seed=seed, arrival_rate=2000.0,
+            duration_mean=1.0, duration_cap=2.0,
+            gang_sizes=((1, 1.0),),
+            queues=(("q1", 1), ("q2", 1), ("q3", 1), ("q4", 1)),
+            cpu_choices=(500,), mem_choices=(GI // 4,),
+            priority_choices=(0,),
+            node_cpu_milli=1_024_000, node_mem=4096 * GI,
+            node_pods=70_000),
+    ),
     "baseline-tiny": dict(
         description="BASELINE config 1 (1 gang of 3, 10 nodes) as the "
                     "degenerate all-at-t0 trace",

@@ -106,6 +106,38 @@ class ObjectStore:
         self._notify(kind, ADDED, obj)
         return obj
 
+    def create_batch(self, objs: Iterable, admit: bool = True) -> List:
+        """Create a batch of objects through ONE store write: a single
+        lock window covers the existence checks and inserts (an
+        apiserver transaction analogue), and watchers are notified once
+        per object only after the whole batch committed. All-or-nothing:
+        any duplicate key aborts the batch before anything is inserted.
+
+        ``admit=False`` skips the admission-hook chain — for callers
+        that already validated the batch through the amortized batch
+        validator (webhooks/admission.submit_job_batch), where a
+        per-object hook walk would re-pay exactly the per-job store
+        reads the batch path exists to avoid."""
+        objs = list(objs)
+        if admit:
+            objs = [self._admit("CREATE", obj.KIND, obj) for obj in objs]
+        with self._lock:
+            seen = set()
+            for obj in objs:
+                key = (obj.KIND, obj.metadata.key())
+                if key in seen or obj.metadata.key() \
+                        in self._objects[obj.KIND]:
+                    raise ValueError(
+                        f"{obj.KIND} {obj.metadata.key()} already exists")
+                seen.add(key)
+            for obj in objs:
+                self._rv += 1
+                obj.metadata.resource_version = self._rv
+                self._objects[obj.KIND][obj.metadata.key()] = obj
+        for obj in objs:
+            self._notify(obj.KIND, ADDED, obj)
+        return objs
+
     def update(self, obj, expect_rv=None) -> object:
         """Update; with ``expect_rv`` set, an optimistic-concurrency write
         that fails with :class:`ConflictError` unless the stored object's
