@@ -1,9 +1,10 @@
 """vlint (volcano_tpu/analysis) test suite.
 
-Four layers, per docs/static-analysis.md:
+Layers, per docs/static-analysis.md:
 
 1. per-rule TRIGGER/CLEAN fixture pairs — synthetic sources that fire
-   the rule and minimally-corrected twins that don't;
+   the rule and minimally-corrected twins that don't (incl. the PR 11
+   dataflow rules VT010-VT014 and the transitive VT006 witness);
 2. suppression + baseline semantics (justifications required, stale
    entries surfaced, invalid suppressions gate);
 3. the JSON reporter schema (a CI contract);
@@ -12,7 +13,15 @@ Four layers, per docs/static-analysis.md:
    the unmutated sources must not. These prove the rules are not
    vacuous: each one mechanically flags a defect this repo actually
    shipped (witness leak, evict-retry mirror, unbucketed job axis, the
-   unjournaled funnel, unlocked shared-state writes).
+   unjournaled funnel, unlocked shared-state writes — and, since PR 11,
+   the sharded score-pad host sync and the device-mirror attr aliasing
+   that PR fixed);
+5. taint-propagation unit tests for the dataflow lattice (assignment
+   chains, element-wise tuple unpacking, call summaries, parameter
+   propagation, comprehensions, attribute chains, rebind-kills-taint,
+   traced-context suppression);
+6. CLI surfaces: --rules/--dataflow/--explain/--sync-inventory,
+   SARIF 2.1.0 shape, and --diff BASE against a scratch git repo.
 """
 
 from __future__ import annotations
@@ -415,6 +424,70 @@ def test_vt006_trigger_and_clean():
     assert rule_ids(f) == ["VT006"]
     f, _ = findings_of({"volcano_tpu/ops/o.py": VT006_CLEAN})
     assert f == []
+
+
+VT006_TWO_HOPS = '''
+import jax
+
+def _bucket(n):
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+def pad_tasks(xs):
+    return xs[:_bucket(len(xs))]
+
+def prepare(xs):
+    return pad_tasks(xs)
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def run(xs):
+    return _solver()(prepare(xs))
+'''
+
+
+def test_vt006_transitive_witness_excuses():
+    """The re-pointed engine: a bucket helper TWO call-graph hops away
+    (run -> prepare -> pad_tasks -> _bucket) excuses the invocation —
+    the old one-hop heuristic would have flagged this exact shape."""
+    f, _ = findings_of({"volcano_tpu/ops/o.py": VT006_TWO_HOPS})
+    assert "VT006" not in rule_ids(f)
+    # severing the chain re-exposes the invocation: prepare no longer
+    # reaches pad_tasks, so no bucket is on run's reachable path
+    broken = VT006_TWO_HOPS.replace("    return pad_tasks(xs)",
+                                    "    return list(xs)")
+    f, _ = findings_of({"volcano_tpu/ops/o.py": broken})
+    assert "VT006" in rule_ids(f)
+
+
+def test_vt006_transitive_caller_witness_excuses():
+    """A caller that bucketed the shapes before threading the solver
+    down two levels of helpers excuses the leaf invocation."""
+    src = '''
+import jax
+
+def _bucket(n):
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+def top(xs):
+    xs = xs[:_bucket(len(xs))]
+    return middle(xs)
+
+def middle(xs):
+    return leaf(xs)
+
+def leaf(xs):
+    solver = jax.jit(lambda x: x)
+    return solver(xs)
+'''
+    f, _ = findings_of({"volcano_tpu/ops/o.py": src})
+    assert "VT006" not in rule_ids(f)
 
 
 def test_vt006_jit_var_and_attr_tracking():
@@ -863,3 +936,713 @@ def test_rebreak_session_clock_removal_vt002_gang():
                     "import time\n\nfrom .. import metrics")
     f, _ = findings_of({"volcano_tpu/plugins/gang.py": broken})
     assert rule_ids(f) == ["VT002"]
+
+
+# ---------------------------------------------------------------------------
+# 5. dataflow rules VT010-VT014 (PR 11): trigger/clean fixtures
+# ---------------------------------------------------------------------------
+
+VT010_TRIGGER = '''
+import jax
+import numpy as np
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def decide(xs):
+    packed = _solver()(xs)
+    return np.asarray(packed)      # implicit fetch outside any span
+'''
+
+VT010_CLEAN_SPAN = '''
+import jax
+import numpy as np
+from ..obs import trace as obs_trace
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def decide(xs):
+    with obs_trace.span("solve"):
+        packed = _solver()(xs)
+        out = np.asarray(packed)   # the sanctioned one-fetch readback
+    return out
+'''
+
+
+def test_vt010_trigger_and_clean_span():
+    f, _ = findings_of({"volcano_tpu/actions/a.py": VT010_TRIGGER})
+    assert "VT010" in rule_ids(f)
+    (x,) = [x for x in f if x.rule == "VT010"]
+    # the finding names BOTH the sync site and the producing expression
+    assert "np.asarray" in x.message and "_solver" in x.message
+    f, _ = findings_of({"volcano_tpu/actions/a.py": VT010_CLEAN_SPAN})
+    assert "VT010" not in rule_ids(f)
+
+
+def test_vt010_span_context_inherited_through_call_graph():
+    """A helper only ever invoked under span("replay") is excused even
+    though the span is lexically in its caller."""
+    src = '''
+import jax
+import numpy as np
+from ..obs import trace as obs_trace
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def cycle(xs):
+    packed = None
+    with obs_trace.span("solve"):
+        packed = _solver()(xs)
+    with obs_trace.span("replay"):
+        apply_replay(packed)
+
+def apply_replay(packed):
+    rows = np.asarray(packed)      # inherits the replay span context
+    return rows
+'''
+    f, _ = findings_of({"volcano_tpu/actions/a.py": src})
+    assert "VT010" not in rule_ids(f)
+
+
+def test_vt010_sync_kinds_iteration_branch_cast():
+    src = '''
+import jax
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def walk(xs):
+    packed = _solver()(xs)
+    for row in packed:             # iteration fetches
+        pass
+    if packed[0] > 0:              # branch test fetches
+        return float(packed[1])    # cast fetches
+'''
+    f, _ = findings_of({"volcano_tpu/actions/a.py": src})
+    kinds = [x.message for x in f if x.rule == "VT010"]
+    assert len(kinds) == 3
+    assert any("iteration" in m for m in kinds)
+    assert any("branch-test" in m for m in kinds)
+    assert any("float()" in m for m in kinds)
+
+
+def test_vt010_identity_test_and_shape_not_syncs():
+    src = '''
+import jax
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def walk(xs):
+    packed = _solver()(xs)
+    if packed is None:             # identity: no fetch
+        return 0
+    return packed.shape[0]         # static metadata: no fetch
+'''
+    f, _ = findings_of({"volcano_tpu/actions/a.py": src})
+    assert "VT010" not in rule_ids(f)
+
+
+def test_vt010_device_get_rebind_clears_taint():
+    """x = jax.device_get(x) is THE sync (reported if bare) and the
+    rebound name is host afterwards — downstream np use is clean."""
+    src = '''
+import jax
+import numpy as np
+from ..obs import trace as obs_trace
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def walk(xs):
+    packed = _solver()(xs)
+    with obs_trace.span("solve"):
+        packed = jax.device_get(packed)
+    return np.asarray(packed)      # host already: not a second sync
+'''
+    f, _ = findings_of({"volcano_tpu/actions/a.py": src})
+    assert "VT010" not in rule_ids(f)
+
+
+def test_vt010_allowlist_matches_kind():
+    """The structured readback allowlist matches (path, symbol, kind):
+    the prewarm entry covers its block_until_ready but NOT a different
+    sync appearing in the same function."""
+    blocked = '''
+import jax
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def prewarm_shapes(xs):
+    out = _solver()(xs)
+    jax.block_until_ready(out)     # allowlisted kind
+'''
+    f, _ = findings_of({"volcano_tpu/actions/allocate.py": blocked})
+    assert "VT010" not in rule_ids(f)
+    other = blocked.replace("jax.block_until_ready(out)",
+                            "import numpy as np\n    np.asarray(out)")
+    f, _ = findings_of({"volcano_tpu/actions/allocate.py": other})
+    assert "VT010" in rule_ids(f)
+
+
+VT011_TRIGGER = '''
+import jax
+
+def kernel(x):
+    if x > 0:                      # traced value in a Python branch
+        return x
+    return -x
+
+solve = jax.jit(kernel)
+'''
+
+VT011_CLEAN = '''
+import jax
+import jax.numpy as jnp
+
+def kernel(x, debug=None):
+    if debug is None:              # identity test: static
+        debug = 0
+    return jnp.where(x > 0, x, -x)
+
+solve = jax.jit(kernel)
+'''
+
+
+def test_vt011_trigger_and_clean():
+    f, _ = findings_of({"volcano_tpu/ops/k.py": VT011_TRIGGER})
+    assert "VT011" in rule_ids(f)
+    f, _ = findings_of({"volcano_tpu/ops/k.py": VT011_CLEAN})
+    assert "VT011" not in rule_ids(f)
+
+
+def test_vt011_static_argnames_exempt():
+    src = '''
+import jax
+
+def kernel(x, mode):
+    if mode == "fast":             # static under static_argnames
+        return x
+    return -x
+
+solve = jax.jit(kernel, static_argnames=("mode",))
+'''
+    f, _ = findings_of({"volcano_tpu/ops/k.py": src})
+    assert "VT011" not in rule_ids(f)
+
+
+def test_vt011_decorated_jit_entry():
+    src = '''
+from functools import partial
+import jax
+
+@partial(jax.jit, static_argnames=("n",))
+def kernel(x, n):
+    while x.sum() > n:             # traced test in a while
+        x = x - 1
+    return x
+'''
+    f, _ = findings_of({"volcano_tpu/ops/k.py": src})
+    assert "VT011" in rule_ids(f)
+
+
+VT012_TRIGGER = '''
+import jax
+
+def make():
+    return jax.jit(lambda x: x)
+
+def run(f, xs):
+    return f(xs)                   # f is not named *solver*: VT006-blind
+
+def cycle(xs):
+    return run(make(), xs)
+'''
+
+
+def test_vt012_dataflow_detected_jit_call():
+    """A compiled callable threaded through an arbitrarily-named
+    parameter is invisible to VT006's name heuristics; the taint lattice
+    still sees the invocation and requires the bucket witness."""
+    f, _ = findings_of({"volcano_tpu/ops/o.py": VT012_TRIGGER})
+    assert "VT012" in rule_ids(f)
+    assert "VT006" not in rule_ids(f)
+    # the same flow with a bucket helper on the path is clean
+    clean = VT012_TRIGGER.replace(
+        "def cycle(xs):\n    return run(make(), xs)",
+        "def _bucket(n):\n    b = 8\n    while b < n:\n        b *= 2\n"
+        "    return b\n\n"
+        "def cycle(xs):\n    return run(make(), xs[:_bucket(len(xs))])")
+    f, _ = findings_of({"volcano_tpu/ops/o.py": clean})
+    assert "VT012" not in rule_ids(f)
+
+
+def test_vt012_does_not_double_report_vt006_sites():
+    f, _ = findings_of({"volcano_tpu/ops/o.py": VT006_TRIGGER})
+    assert rule_ids(f) == ["VT006"]        # one rule per site
+
+
+VT013_TRIGGER = '''
+import jax
+import numpy as np
+
+def _solver():
+    return jax.jit(lambda s, i: (s, i))
+
+def run(state, n):
+    idx = np.arange(n)             # no dtype: weak int
+    return _solver()(state, idx)
+'''
+
+
+def test_vt013_weak_dtype_and_literal_operands():
+    f, _ = findings_of({"volcano_tpu/ops/o.py": VT013_TRIGGER})
+    assert "VT013" in rule_ids(f)
+    (x,) = [x for x in f if x.rule == "VT013"]
+    assert "np.arange" in x.message
+    # explicit dtype is clean
+    clean = VT013_TRIGGER.replace("np.arange(n)",
+                                  "np.arange(n, dtype=np.int32)")
+    f, _ = findings_of({"volcano_tpu/ops/o.py": clean})
+    assert "VT013" not in rule_ids(f)
+
+
+def test_vt013_bare_positional_literal_flagged_keyword_exempt():
+    src = '''
+import jax
+
+def _solver():
+    return jax.jit(lambda s, k: (s, k))
+
+def run(state):
+    return _solver()(state, 3)     # bare positional literal
+'''
+    f, _ = findings_of({"volcano_tpu/ops/o.py": src})
+    assert "VT013" in rule_ids(f)
+    kw = src.replace("_solver()(state, 3)", "_solver()(state, k=3)")
+    f, _ = findings_of({"volcano_tpu/ops/o.py": kw})
+    assert "VT013" not in rule_ids(f)
+
+
+VT014_GLOBAL_TRIGGER = '''
+LAST = {}
+
+def record(ssn):
+    LAST["jobs"] = ssn.jobs        # outlives close_session
+'''
+
+VT014_SELF_TRIGGER = '''
+class SchedulerCache:
+    def remember(self, ssn):
+        self._last_nodes = ssn.nodes
+'''
+
+VT014_SESSION_SCOPED_CLEAN = '''
+class Placer:
+    def __init__(self, ssn):
+        self.nodes = ssn.nodes     # Placer is itself session-scoped
+'''
+
+VT014_PLUGIN_CLEAN = '''
+class MyPlugin:
+    def on_session_open(self, ssn):
+        self._ssn = ssn            # plugins are rebuilt per session
+'''
+
+
+def test_vt014_trigger_and_clean():
+    f, _ = findings_of({"volcano_tpu/actions/a.py": VT014_GLOBAL_TRIGGER})
+    assert "VT014" in rule_ids(f)
+    f, _ = findings_of({"volcano_tpu/cache/c.py": VT014_SELF_TRIGGER})
+    assert "VT014" in rule_ids(f)
+    f, _ = findings_of({"volcano_tpu/cache/c.py": VT014_SESSION_SCOPED_CLEAN})
+    assert "VT014" not in rule_ids(f)
+    f, _ = findings_of({"volcano_tpu/plugins/p.py": VT014_PLUGIN_CLEAN})
+    assert "VT014" not in rule_ids(f)
+
+
+def test_vt014_self_store_only_checked_in_long_lived_modules():
+    """Per-cycle helper objects in actions/ die with the session by
+    construction — a self-store there is not an escape; the same store
+    in the cache layer is."""
+    f, _ = findings_of({"volcano_tpu/actions/a.py": VT014_SELF_TRIGGER})
+    assert "VT014" not in rule_ids(f)
+
+
+def test_vt014_closure_escape():
+    src = '''
+_HOOKS = {}
+
+def install(ssn):
+    def hook():
+        return ssn.nodes           # closes over the session
+    _HOOKS["snapshot"] = hook
+'''
+    f, _ = findings_of({"volcano_tpu/actions/a.py": src})
+    assert "VT014" in rule_ids(f)
+
+
+# ---------------------------------------------------------------------------
+# 6. taint-propagation unit tests (the lattice itself)
+# ---------------------------------------------------------------------------
+
+def _sync_count(src, path="volcano_tpu/actions/a.py"):
+    f, _ = findings_of({path: src})
+    return len([x for x in f if x.rule == "VT010"])
+
+
+def test_taint_assignment_chain():
+    src = '''
+import jax
+import numpy as np
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def run(xs):
+    a = _solver()(xs)
+    b = a
+    c = b
+    return np.asarray(c)
+'''
+    assert _sync_count(src) == 1
+
+
+def test_taint_tuple_unpack_is_element_wise():
+    """helper() returns (device, host_int): the int element must NOT
+    carry device taint into np.pad."""
+    src = '''
+import jax
+import numpy as np
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def helper(xs):
+    packed = _solver()(xs)
+    return packed, len(xs)
+
+def run(xs, req):
+    packed, bucket = helper(xs)
+    padded = np.pad(req, (0, bucket))     # bucket is host: clean
+    return packed, padded
+'''
+    assert _sync_count(src) == 0
+
+
+def test_taint_through_call_return_summary():
+    src = '''
+import jax
+import numpy as np
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def produce(xs):
+    return _solver()(xs)
+
+def consume(xs):
+    return np.asarray(produce(xs))
+'''
+    assert _sync_count(src) == 1
+
+
+def test_taint_through_param_propagation():
+    src = '''
+import jax
+import numpy as np
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def helper(arr):
+    return np.asarray(arr)
+
+def run(xs):
+    return helper(_solver()(xs))
+'''
+    assert _sync_count(src) == 1
+
+
+def test_taint_through_comprehension():
+    src = '''
+import jax
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def run(xs):
+    packed = _solver()(xs)
+    return [int(v) for v in packed]       # iteration + int(): 2 syncs
+'''
+    assert _sync_count(src) == 2
+
+
+def test_taint_through_attribute_chain():
+    src = '''
+import jax
+import numpy as np
+
+class Solution:
+    def __init__(self, packed):
+        self.packed = packed
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def solve(xs):
+    return Solution(_solver()(xs))
+
+def replay(xs):
+    sol = solve(xs)
+    return np.asarray(sol.packed)
+'''
+    assert _sync_count(src) == 1
+
+
+def test_taint_container_iteration_not_a_sync():
+    src = '''
+import jax
+import jax.numpy as jnp
+
+def _solver():
+    return jax.jit(lambda x: x)
+
+def run(xs, ys):
+    a = _solver()(xs)
+    b = _solver()(ys)
+    return [jnp.maximum(x, y) for x, y in zip(a, b)]
+'''
+    assert _sync_count(src) == 0
+
+
+def test_traced_context_suppresses_device_syncs():
+    """Inside a jit-entry function jnp values are tracers — host-looking
+    ops there are traced by XLA, not syncs."""
+    src = '''
+import jax
+import jax.numpy as jnp
+
+def kernel(x):
+    mask = jnp.zeros(8, bool)
+    total = mask.sum() + x.sum()
+    return total
+
+solve = jax.jit(kernel)
+'''
+    assert _sync_count(src, "volcano_tpu/ops/k.py") == 0
+
+
+# ---------------------------------------------------------------------------
+# 7. re-broken hot-path regressions (THIS PR's real fixes)
+# ---------------------------------------------------------------------------
+
+def _hot_sources():
+    return {
+        "volcano_tpu/actions/allocate.py":
+            real_source("volcano_tpu/actions/allocate.py"),
+        "volcano_tpu/actions/evict_tpu.py":
+            real_source("volcano_tpu/actions/evict_tpu.py"),
+        "volcano_tpu/ops/evict.py": real_source("volcano_tpu/ops/evict.py"),
+        "volcano_tpu/cache/snapshot.py":
+            real_source("volcano_tpu/cache/snapshot.py"),
+    }
+
+
+def test_hot_path_sources_clean_under_dataflow_rules():
+    f, _ = findings_of(_hot_sources())
+    assert f == [], [(x.rule, x.path, x.line) for x in f]
+
+
+def test_rebreak_sharded_score_pad_host_sync_vt010():
+    """THIS PR's fix: the sharded preempt path pads the device-resident
+    score matrix with jnp.pad. Reverting to np.pad re-introduces the
+    hidden device->host fetch mid-solve and must fire VT010."""
+    srcs = _hot_sources()
+    srcs["volcano_tpu/actions/evict_tpu.py"] = mutate(
+        srcs["volcano_tpu/actions/evict_tpu.py"],
+        "score_arr = jnp.pad(score_g, ((0, 0), (0, n_pad)),\n"
+        "                                constant_values=-1e30)",
+        "score_arr = np.pad(score_g, ((0, 0), (0, n_pad)),\n"
+        "                               constant_values=-1e30)")
+    f, _ = findings_of(srcs)
+    assert any(x.rule == "VT010" and x.symbol == "_preempt_phase"
+               and "np.pad" in x.message for x in f), rule_ids(f)
+
+
+def test_rebreak_device_mirror_rename_vt010():
+    """THIS PR's fix: _DeviceJobPlacer's device-resident mirrors carry a
+    _d suffix so they cannot alias NodeTensors' HOST arrays. Reverting
+    the rename makes every node_t.allocatable/max_tasks read look
+    device-resident — prewarm's np.pads over them become (apparent)
+    syncs and must fire VT010."""
+    srcs = _hot_sources()
+    broken = srcs["volcano_tpu/actions/allocate.py"] \
+        .replace("allocatable_d", "allocatable") \
+        .replace("max_tasks_d", "max_tasks")
+    assert broken != srcs["volcano_tpu/actions/allocate.py"]
+    srcs["volcano_tpu/actions/allocate.py"] = broken
+    f, _ = findings_of(srcs)
+    assert any(x.rule == "VT010" and x.symbol == "prewarm_shapes"
+               for x in f), rule_ids(f)
+
+
+# ---------------------------------------------------------------------------
+# 8. CLI: --rules/--explain/--dataflow/--sync-inventory/--format sarif/--diff
+# ---------------------------------------------------------------------------
+
+def _vlint(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "volcano_tpu.analysis", *args],
+        cwd=REPO, capture_output=True, text=True)
+
+
+def test_cli_rules_comma_selection_and_dataflow():
+    proc = _vlint(os.path.join(REPO, "volcano_tpu"),
+                  "--rules", "VT010,VT014", "--format", "json")
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    proc = _vlint(os.path.join(REPO, "volcano_tpu"), "--dataflow")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = _vlint("--rules", "VT999")
+    assert proc.returncode == 2
+
+
+def test_cli_explain_prints_contract_and_example():
+    proc = _vlint("--explain", "VT010")
+    assert proc.returncode == 0
+    assert "host-sync" in proc.stdout
+    assert "minimal trigger" in proc.stdout
+    assert "span" in proc.stdout
+    proc = _vlint("--explain", "VT999")
+    assert proc.returncode == 2
+
+
+def test_cli_sync_inventory_lists_every_site():
+    proc = _vlint(os.path.join(REPO, "volcano_tpu"), "--sync-inventory")
+    assert proc.returncode == 0, proc.stderr
+    # the deliberate one-fetch sites appear WITH their excuse status
+    assert "_execute_strict_batched" in proc.stdout
+    assert "span:solve" in proc.stdout
+    assert "allowlist" in proc.stdout
+    assert "0 outside allowlisted spans" in proc.stdout
+
+
+def test_cli_sarif_output_valid():
+    proc = _vlint(os.path.join(REPO, "volcano_tpu"), "--format", "sarif")
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["version"] == "2.1.0"
+    assert payload["$schema"].endswith("sarif-schema-2.1.0.json")
+    (run,) = payload["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "vlint"
+    rules = {r["id"]: r for r in driver["rules"]}
+    for rid in ("VT001", "VT010", "VT014"):
+        assert rid in rules
+        assert rules[rid]["helpUri"].startswith("docs/static-analysis.md#")
+        assert rules[rid]["shortDescription"]["text"]
+    assert run["results"] == []
+
+
+def test_cli_sarif_findings_have_locations(tmp_path):
+    bad = tmp_path / "volcano_tpu" / "plugins"
+    bad.mkdir(parents=True)
+    (bad / "p.py").write_text(VT002_TRIGGER)
+    proc = _vlint(str(bad / "p.py"), "--no-baseline", "--format", "sarif")
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    (res,) = payload["runs"][0]["results"]
+    assert res["ruleId"] == "VT002" and res["level"] == "error"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "volcano_tpu/plugins/p.py"
+    assert loc["region"]["startLine"] > 0
+
+
+def test_cli_diff_mode_restricts_to_changed_functions(tmp_path):
+    """--diff BASE via a scratch git repo: only findings in functions
+    whose bodies changed vs the ref survive."""
+    repo = tmp_path / "r"
+    pkg = repo / "volcano_tpu" / "plugins"
+    pkg.mkdir(parents=True)
+    clean_two = (
+        "import time\n\n"
+        "def a(job, ssn):\n    return ssn.now() - job.t\n\n"
+        "def b(job, ssn):\n    return ssn.now() - job.t\n")
+    (pkg / "p.py").write_text(clean_two)
+    env = dict(os.environ,
+               GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+    for cmd in (["git", "init", "-q"], ["git", "add", "."],
+                ["git", "commit", "-qm", "base"]):
+        subprocess.run(cmd, cwd=repo, env=env, check=True,
+                       capture_output=True)
+    # break BOTH functions, but only b's body counts as changed when we
+    # diff against a base where a was already broken
+    broken_a = clean_two.replace(
+        "def a(job, ssn):\n    return ssn.now() - job.t",
+        "def a(job, ssn):\n    return time.time() - job.t")
+    (pkg / "p.py").write_text(broken_a)
+    subprocess.run(["git", "commit", "-aqm", "break a"], cwd=repo,
+                   env=env, check=True, capture_output=True)
+    broken_both = broken_a.replace(
+        "def b(job, ssn):\n    return ssn.now() - job.t",
+        "def b(job, ssn):\n    return time.time() - job.t")
+    (pkg / "p.py").write_text(broken_both)
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "volcano_tpu.analysis", str(pkg),
+         "--no-baseline", "--diff", "HEAD", "--format", "json"],
+        cwd=repo, capture_output=True, text=True,
+        env=dict(env, PYTHONPATH=REPO))
+    assert proc.returncode == 1, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert [x["symbol"] for x in payload["findings"]] == ["b"]
+    # without --diff both fire
+    proc = subprocess.run(
+        [sys.executable, "-m", "volcano_tpu.analysis", str(pkg),
+         "--no-baseline", "--format", "json"],
+        cwd=repo, capture_output=True, text=True,
+        env=dict(env, PYTHONPATH=REPO))
+    payload = json.loads(proc.stdout)
+    assert sorted(x["symbol"] for x in payload["findings"]) == ["a", "b"]
+
+
+def test_span_context_does_not_propagate_through_ambiguous_names():
+    """core.CallGraph.span_context: a shared simple name must not smear
+    span context (the excusing direction) across unrelated defs."""
+    from volcano_tpu.analysis.core import analyze_sources as _an
+    src_a = '''
+from ..obs import trace as obs_trace
+
+def caller_one(x):
+    with obs_trace.span("solve"):
+        shared(x)
+
+def shared(x):
+    return x
+'''
+    src_b = '''
+def shared(y):
+    return y
+'''
+    _, _, ctx = _an({"volcano_tpu/actions/a.py": src_a,
+                     "volcano_tpu/actions/b.py": src_b})
+    for m in ctx.modules:
+        for fn in m.functions:
+            if fn.name == "shared":
+                assert ctx.graph.span_context(fn) == set(), m.path
+
+
+def test_dataflow_fixpoint_converges_on_tree():
+    """The engine's round cap is a safety net, not a truncation: the
+    real tree must reach a true fixpoint (otherwise facts could be
+    missing taint and findings silently disappear)."""
+    from volcano_tpu.analysis import analyze_paths
+    from volcano_tpu.analysis.dataflow import get_dataflow
+    _, _, ctx = analyze_paths([os.path.join(REPO, "volcano_tpu")])
+    assert get_dataflow(ctx).converged

@@ -451,8 +451,13 @@ class _DeviceJobPlacer:
         self.rnames = discover_resource_names(list(ssn.nodes.values()), tasks_all)
         self.node_t = _node_tensors(ssn, self.rnames)
         self.state = self.node_t.node_state()
-        self.allocatable = self.node_t.device_allocatable()
-        self.max_tasks = self.node_t.device_max_tasks()
+        # _d suffix: device-resident mirrors. NodeTensors exposes HOST
+        # arrays under .allocatable/.max_tasks — reusing those names here
+        # would alias a device value into every node_t.<field> read in
+        # this module (the vlint dataflow engine tracks attribute taint
+        # per module by name, and readers deserve the same clarity)
+        self.allocatable_d = self.node_t.device_allocatable()
+        self.max_tasks_d = self.node_t.device_max_tasks()
         self.weights = assemble_weights(ssn, self.rnames)
         self._solve = _job_solver()
 
@@ -465,7 +470,7 @@ class _DeviceJobPlacer:
         T = len(tasks)
         packed, new_state, bucket, J, _ = _solve_job_batch(
             self.ssn, [(job, tasks)], self.state, self.node_t, self.rnames,
-            self.weights, self.allocatable, self.max_tasks, self._solve,
+            self.weights, self.allocatable_d, self.max_tasks_d, self._solve,
             j_pad=1)
         task_node, pipelined, _, job_kept = unpack_placement(
             np.asarray(packed), bucket, J)

@@ -988,8 +988,13 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool,
                               constant_values=jalloc0.shape[0] - 1),
                 rank=np.pad(nw.rank, ((0, n_pad), (0, 0)),
                             constant_values=BIG))
-            score_arr = np.pad(score_g, ((0, 0), (0, n_pad)),
-                               constant_values=-1e30)
+            # jnp.pad, NOT np.pad: score_g is device-resident (the
+            # combined-score path computes it in-kernel), and np.pad
+            # would force a hidden device->host fetch plus re-upload —
+            # an implicit sync in the middle of the solve hot path
+            # (VT010); jnp.pad dispatches the pad on device
+            score_arr = jnp.pad(score_g, ((0, 0), (0, n_pad)),
+                                constant_values=-1e30)
         fn = build_preempt_walk_sharded(mesh, stack.kinds, stack.sizes,
                                         inter_job, allow_cheap)
     else:

@@ -15,10 +15,29 @@ the package's ASTs (stdlib ``ast`` only, no new runtime deps):
 - VT004  bind/evict executor invocation outside the journaled funnels
 - VT005  exception handlers that would swallow SimKill (BaseException)
 - VT006  jitted solver invocations whose shapes skip pow2 bucketing
+         (transitive-reach witness since PR 11)
 - VT007  shared-state writes outside a held lock in native/metrics/obs
+- VT008  executor-effecting calls without a fencing-epoch stamp (HA)
+- VT009  partition-ownership writes outside the reserve/transfer funnel
+
+Since PR 11 the analyzer is also a DATAFLOW engine (``dataflow.py``): an
+interprocedural taint lattice tracks device arrays, tracers and
+session-scoped values through assignments, calls, returns and
+comprehensions, powering five more rules:
+
+- VT010  implicit host sync on a device value outside an allowlisted
+         replay/readback span (the async-overlap worklist; also
+         ``vlint --sync-inventory``)
+- VT011  Python if/while/assert on a traced value inside a jitted fn
+- VT012  dataflow-detected jit invocations missing the bucket witness
+- VT013  weak-dtype / bare-literal operands feeding jitted solvers
+- VT014  session-scoped values stored past close_session's lifetime
 
 Run it: ``python -m volcano_tpu.analysis volcano_tpu/`` (or the ``vlint``
-console script). Findings are suppressible per line with
+console script); ``--dataflow`` runs just the taint rules, ``--diff
+BASE`` restricts to changed functions, ``--format sarif`` emits SARIF
+2.1.0, ``--explain VTxxx`` prints a rule's contract + minimal trigger.
+Findings are suppressible per line with
 ``# vlint: disable=VTxxx -- justification`` (the justification text is
 required) and grandfathered findings live in the checked-in
 ``vlint-baseline.json``, each entry carrying its own justification.
@@ -31,10 +50,10 @@ from .core import (AnalysisContext, Finding, analyze_paths, analyze_sources,
                    iter_python_files)
 from .rules import ALL_RULES, rule_by_id
 from .baseline import Baseline, load_baseline
-from .report import json_report, text_report
+from .report import json_report, sarif_report, text_report
 
 __all__ = [
     "ALL_RULES", "AnalysisContext", "Baseline", "Finding", "analyze_paths",
     "analyze_sources", "iter_python_files", "json_report", "load_baseline",
-    "rule_by_id", "text_report",
+    "rule_by_id", "sarif_report", "text_report",
 ]

@@ -1,12 +1,15 @@
 """``python -m volcano_tpu.analysis`` / ``vlint`` — the CLI.
 
 Usage:
-    vlint [paths...] [--format text|json] [--baseline FILE]
-          [--no-baseline] [--update-baseline] [--rule VTxxx [...]]
+    vlint [paths...] [--format text|json|sarif] [--baseline FILE]
+          [--no-baseline] [--update-baseline]
+          [--rule VTxxx [...]] [--rules VTxxx,VTyyy] [--dataflow]
+          [--diff BASE] [--explain VTxxx] [--sync-inventory]
           [--list-rules]
 
 Exit codes: 0 clean (suppressed/baselined findings do not gate),
-1 blocking findings or invalid suppressions, 2 usage/baseline errors.
+1 blocking findings or invalid suppressions, 2 usage/baseline/diff
+errors.
 """
 
 from __future__ import annotations
@@ -19,8 +22,9 @@ from typing import List, Optional
 from .baseline import (DEFAULT_BASELINE, Baseline, BaselineError,
                        load_baseline, write_baseline)
 from .core import analyze_paths
-from .report import exit_code, json_report, split_baselined, text_report
-from .rules import ALL_RULES, rule_by_id
+from .report import (exit_code, json_report, sarif_report, split_baselined,
+                     text_report)
+from .rules import ALL_RULES, DATAFLOW_RULE_IDS, HostSyncRule, rule_by_id
 
 
 def _default_paths() -> List[str]:
@@ -43,6 +47,66 @@ def _find_baseline(paths: List[str]) -> Optional[str]:
     return cwd if os.path.exists(cwd) else None
 
 
+def _explain(rule_id: str) -> int:
+    rule = rule_by_id(rule_id)
+    if rule is None:
+        print(f"vlint: unknown rule {rule_id!r} (--list-rules)",
+              file=sys.stderr)
+        return 2
+    print(f"{rule.id}  {rule.name}")
+    print(f"contract: {rule.contract}")
+    if rule.scope:
+        print(f"scope:    {', '.join(rule.scope)}")
+    if rule.exclude:
+        print(f"exempt:   {', '.join(rule.exclude)}")
+    doc = (rule.__doc__ or "").strip()
+    if doc:
+        print()
+        print(doc)
+    if rule.example:
+        print()
+        print("minimal trigger:")
+        for line in rule.example.splitlines():
+            print(f"    {line}")
+    return 0
+
+
+def _sync_inventory(paths: List[str]) -> int:
+    """Print EVERY host-sync site the dataflow engine sees — excused or
+    not — with its producer and why it is (or is not) allowlisted. This
+    is the async-overlap worklist of ROADMAP item 2: the non-excused
+    rows block solve/commit overlap today; the span-excused rows are the
+    sanctioned fetch points the overlap redesign must double-buffer."""
+    from .dataflow import get_dataflow
+    # rules=[] — the inventory needs only the context + taint engine,
+    # not 14 rule passes whose findings would be discarded
+    _, _, ctx = analyze_paths(paths, rules=[])
+    df = get_dataflow(ctx)
+    rule = HostSyncRule()
+    rows = []
+    for mod in ctx.modules:
+        for fn in mod.functions:
+            for site in df.facts(fn).sync_sites:
+                line = getattr(site.node, "lineno", fn.node.lineno)
+                # the SAME excusal ladder CI gates on (HostSyncRule
+                # .classify) — the inventory cannot drift from the rule
+                status, detail = rule.classify(mod, fn, site, ctx)
+                if status == "span":
+                    status = f"span:{detail}"
+                elif status == "blocking":
+                    status = "BLOCKING"
+                rows.append((mod.path, line, fn.qualname, site.kind,
+                             status, site.producer))
+    rows.sort()
+    for path, line, sym, kind, status, producer in rows:
+        print(f"{path}:{line}: [{sym}] {kind:<22} {status:<18} "
+              f"<- {producer}")
+    blocking = sum(1 for r in rows if r[4] == "BLOCKING")
+    print(f"vlint --sync-inventory: {len(rows)} host-sync site(s), "
+          f"{blocking} outside allowlisted spans")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="vlint",
@@ -51,7 +115,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("paths", nargs="*",
                         help="files/directories to analyze "
                              "(default: the volcano_tpu package)")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text")
     parser.add_argument("--baseline", default=None,
                         help=f"baseline file (default: {DEFAULT_BASELINE} "
@@ -63,6 +127,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(preserving existing justifications)")
     parser.add_argument("--rule", action="append", default=None,
                         metavar="VTxxx", help="run only these rules")
+    parser.add_argument("--rules", action="append", default=None,
+                        metavar="VTxxx,VTyyy",
+                        help="comma-separated rule selection "
+                             "(combines with --rule)")
+    parser.add_argument("--dataflow", action="store_true",
+                        help="run only the dataflow-engine rules "
+                             f"({', '.join(DATAFLOW_RULE_IDS)})")
+    parser.add_argument("--diff", default=None, metavar="BASE",
+                        help="restrict findings to functions whose bodies "
+                             "changed vs this git ref (pure git diff "
+                             "line ranges; full-tree runs stay the CI "
+                             "gate)")
+    parser.add_argument("--explain", default=None, metavar="VTxxx",
+                        help="print the rule's contract and a minimal "
+                             "trigger example, then exit")
+    parser.add_argument("--sarif-out", default=None, metavar="FILE",
+                        help="additionally write a SARIF 2.1.0 report to "
+                             "FILE (the gating run can feed PR diff "
+                             "annotation without a second analysis)")
+    parser.add_argument("--sync-inventory", action="store_true",
+                        help="print every VT010 host-sync site (excused "
+                             "or not) with producer and span context — "
+                             "the async-overlap worklist")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -71,16 +158,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{rule.id}  {rule.name}: {rule.contract}")
         return 0
 
-    rules = ALL_RULES
-    if args.rule:
-        rules = []
-        for rid in args.rule:
-            rule = rule_by_id(rid)
-            if rule is None:
-                print(f"vlint: unknown rule {rid!r} (--list-rules)",
-                      file=sys.stderr)
-                return 2
-            rules.append(rule)
+    if args.explain:
+        return _explain(args.explain)
 
     paths = args.paths or _default_paths()
     for p in paths:
@@ -88,7 +167,50 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"vlint: no such path: {p}", file=sys.stderr)
             return 2
 
-    findings, invalid, _ = analyze_paths(paths, rules=rules)
+    if args.diff is not None and args.update_baseline:
+        # a diff-restricted finding set would silently TRUNCATE the
+        # baseline to the changed functions; the full-tree set is the
+        # only valid input for a baseline rewrite. Checked BEFORE any
+        # analysis runs — a usage error must not cost a full pass.
+        print("vlint: --update-baseline cannot be combined with --diff "
+              "(the baseline must be rewritten from the full-tree "
+              "finding set)", file=sys.stderr)
+        return 2
+
+    if args.sync_inventory:
+        return _sync_inventory(paths)
+
+    selected: List[str] = list(args.rule or [])
+    for chunk in args.rules or []:
+        selected.extend(r.strip() for r in chunk.split(",") if r.strip())
+    if args.dataflow:
+        selected.extend(DATAFLOW_RULE_IDS)
+
+    rules = ALL_RULES
+    if selected:
+        rules = []
+        for rid in dict.fromkeys(selected):          # dedupe, keep order
+            rule = rule_by_id(rid)
+            if rule is None:
+                print(f"vlint: unknown rule {rid!r} (--list-rules)",
+                      file=sys.stderr)
+                return 2
+            rules.append(rule)
+
+    findings, invalid, ctx = analyze_paths(paths, rules=rules)
+
+    dropped = 0
+    if args.diff is not None:
+        from .diff import (DiffError, changed_ranges, repo_root_for,
+                           restrict_findings)
+        try:
+            ranges = changed_ranges(args.diff, cwd=repo_root_for(paths))
+        except DiffError as exc:
+            print(f"vlint: {exc}", file=sys.stderr)
+            return 2
+        findings, d1 = restrict_findings(findings, ctx, ranges)
+        invalid, d2 = restrict_findings(invalid, ctx, ranges)
+        dropped = d1 + d2
 
     baseline_path = None if args.no_baseline else (
         args.baseline or _find_baseline(paths))
@@ -113,10 +235,21 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"replace any TODO justifications before committing")
         return 0
 
+    if args.sarif_out:
+        with open(args.sarif_out, "w", encoding="utf-8") as fh:
+            fh.write(sarif_report(live, invalid, grandfathered))
+            fh.write("\n")
+
     if args.format == "json":
         print(json_report(live, invalid, grandfathered, baseline))
+    elif args.format == "sarif":
+        print(sarif_report(live, invalid, grandfathered))
     else:
         print(text_report(live, invalid, grandfathered, baseline))
+        if args.diff is not None:
+            print(f"vlint: --diff {args.diff}: {dropped} finding(s) in "
+                  f"unchanged functions not shown (full-tree pass "
+                  f"remains the CI gate)")
     return exit_code(live, invalid)
 
 

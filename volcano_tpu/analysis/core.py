@@ -1,5 +1,12 @@
 """vlint engine: module model, suppressions, and the intra-package
-call graph rules use to see one hop of indirection.
+call graph.
+
+The graph serves two precision tiers: ``one_hop`` (the original funnel
+rules — a witness may live in a direct caller/callee) and the cached
+TRANSITIVE closures ``reach``/``transitive_callers``/``transitive_callees``
+that the dataflow rules (VT010-VT014, and the re-pointed VT006) use to
+ask "is a witness anywhere on the reachable path" and "which
+obs_trace.span contexts can this function run under".
 
 Everything here is stdlib ``ast`` — the analyzer never imports the code
 it checks, so it runs in CI without jax or a device present.
@@ -73,6 +80,12 @@ class FunctionInfo:
     # to a same-named local def would let a witness-carrying caller
     # excuse a function it never actually calls.
     linkable_calls: Set[str] = field(default_factory=set)
+    # callee simple name -> union of obs_trace.span("...") names lexically
+    # enclosing a call site of that callee in THIS function (the edge
+    # annotation span-context propagation rides; see CallGraph.span_context)
+    call_spans: Dict[str, Set[str]] = field(default_factory=dict)
+    # span names this function opens anywhere in its body
+    spans_opened: Set[str] = field(default_factory=set)
 
     @property
     def name(self) -> str:
@@ -92,6 +105,40 @@ def dotted_name(node: ast.AST) -> Optional[str]:
         parts.append(node.id)
         return ".".join(reversed(parts))
     return None
+
+
+def span_call_name(node: ast.AST) -> Optional[str]:
+    """The literal name of an ``obs_trace.span("X", ...)`` / ``span("X")``
+    call (the flight-recorder context manager, PR 5), else None. Only
+    string-constant names count — a computed span name cannot anchor an
+    allowlist."""
+    if not isinstance(node, ast.Call) or not node.args:
+        return None
+    dotted = dotted_name(node.func)
+    if dotted is None or not (dotted == "span" or dotted.endswith(".span")):
+        return None
+    first = node.args[0]
+    if isinstance(first, ast.Constant) and isinstance(first.value, str):
+        return first.value
+    return None
+
+
+def enclosing_span_names(fn: "FunctionInfo", line: int) -> Set[str]:
+    """Span names of every ``with ...span("X")`` block in ``fn`` whose
+    lexical extent covers ``line`` — the direct (same-function) half of
+    the span-context question; CallGraph.span_context answers the
+    inherited half."""
+    out: Set[str] = set()
+    for w in ast.walk(fn.node):
+        if not isinstance(w, ast.With):
+            continue
+        if not (w.lineno <= line <= getattr(w, "end_lineno", w.lineno)):
+            continue
+        for item in w.items:
+            name = span_call_name(item.context_expr)
+            if name is not None:
+                out.add(name)
+    return out
 
 
 class ModuleInfo:
@@ -208,15 +255,46 @@ class ModuleInfo:
                 info = FunctionInfo(
                     module=mod, qualname=qual, node=node,
                     cls=self.cls[-1] if self.cls else None)
-                for sub in ast.walk(node):
-                    if isinstance(sub, ast.Call):
-                        if isinstance(sub.func, ast.Name):
-                            info.called_names.add(sub.func.id)
-                            info.linkable_calls.add(sub.func.id)
-                        elif isinstance(sub.func, ast.Attribute):
-                            info.called_names.add(sub.func.attr)
-                            if isinstance(sub.func.value, ast.Name):
-                                info.linkable_calls.add(sub.func.attr)
+
+                def collect(n: ast.AST, spans: Tuple[str, ...]) -> None:
+                    # recursive walk carrying the enclosing-span stack so
+                    # call edges are annotated with the span context they
+                    # fire under (ast.walk would lose the nesting)
+                    if isinstance(n, ast.Call):
+                        name = None
+                        if isinstance(n.func, ast.Name):
+                            name = n.func.id
+                            info.called_names.add(name)
+                            info.linkable_calls.add(name)
+                        elif isinstance(n.func, ast.Attribute):
+                            name = n.func.attr
+                            info.called_names.add(name)
+                            if isinstance(n.func.value, ast.Name):
+                                info.linkable_calls.add(name)
+                        if name is not None:
+                            info.call_spans.setdefault(
+                                name, set()).update(spans)
+                    if isinstance(n, ast.With):
+                        opened = [s for item in n.items
+                                  if (s := span_call_name(
+                                      item.context_expr)) is not None]
+                        info.spans_opened.update(opened)
+                        inner = spans + tuple(opened)
+                        for item in n.items:
+                            collect(item.context_expr, spans)
+                        for stmt in n.body:
+                            collect(stmt, inner)
+                        return
+                    for child in ast.iter_child_nodes(n):
+                        collect(child, spans)
+
+                for dec in node.decorator_list:
+                    collect(dec, ())
+                for default in (list(node.args.defaults)
+                                + [d for d in node.args.kw_defaults if d]):
+                    collect(default, ())
+                for stmt in node.body:
+                    collect(stmt, ())
                 mod.functions.append(info)
                 self.stack.append(node.name)
                 self.generic_visit(node)
@@ -277,6 +355,92 @@ class CallGraph:
         witness may legitimately live in."""
         return self.callers_of(fn) + self.callees_of(fn)
 
+    # -- transitive closures (the dataflow rules' reach) --------------------
+
+    def _closure(self, fn: FunctionInfo, step, cache: Dict[int, list]
+                 ) -> List[FunctionInfo]:
+        key = id(fn)
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+        seen: Dict[int, FunctionInfo] = {id(fn): fn}
+        frontier = [fn]
+        while frontier:
+            nxt: List[FunctionInfo] = []
+            for f in frontier:
+                for other in step(f):
+                    if id(other) not in seen:
+                        seen[id(other)] = other
+                        nxt.append(other)
+            frontier = nxt
+        out = [f for k, f in seen.items() if k != id(fn)]
+        cache[key] = out
+        return out
+
+    def transitive_callees(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        """Everything reachable BY CALLING from ``fn`` (fn excluded),
+        cached."""
+        if not hasattr(self, "_tc_callees"):
+            self._tc_callees: Dict[int, list] = {}
+        return self._closure(fn, self.callees_of, self._tc_callees)
+
+    def transitive_callers(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        """Everything that can REACH ``fn`` by calling (fn excluded),
+        cached."""
+        if not hasattr(self, "_tc_callers"):
+            self._tc_callers: Dict[int, list] = {}
+        return self._closure(fn, self.callers_of, self._tc_callers)
+
+    def reach(self, fn: FunctionInfo) -> List[FunctionInfo]:
+        """Transitive callers + transitive callees: every function on some
+        call path THROUGH ``fn``. This is where the dataflow rules look
+        for a witness ("the shapes are bucketed somewhere on the reachable
+        path") — the transitive generalization of ``one_hop``."""
+        out = {id(f): f for f in self.transitive_callers(fn)}
+        for f in self.transitive_callees(fn):
+            out.setdefault(id(f), f)
+        out.pop(id(fn), None)
+        return list(out.values())
+
+    def span_context(self, fn: FunctionInfo) -> Set[str]:
+        """Union of obs_trace.span names ``fn`` can run under: spans
+        lexically wrapping some call site on a path to ``fn``, propagated
+        down the call graph to a fixpoint. MAY-analysis by design — a
+        function invoked both under ``span("replay")`` and bare reports
+        {"replay"}; rules that use contexts to EXCUSE findings (VT010's
+        readback-span allowlist) accept that bias and say so in their
+        docs. Context only propagates through UNAMBIGUOUS simple names
+        (exactly one def in the package): a shared name like ``execute``
+        would smear one action's span context over every action and
+        EXCUSE real findings — the direction this graph must not err in.
+        The whole map is computed once and cached."""
+        ctx_map = getattr(self, "_span_ctx", None)
+        if ctx_map is None:
+            ctx_map = {id(f): set() for fns in self.defs.values()
+                       for f in fns}
+            changed = True
+            while changed:
+                changed = False
+                for fns in self.defs.values():
+                    for g in fns:
+                        base = ctx_map[id(g)]
+                        for name in g.linkable_calls:
+                            targets = self.defs.get(name)
+                            if not targets or len(targets) > 1:
+                                continue
+                            contrib = base | g.call_spans.get(name, set())
+                            if not contrib:
+                                continue
+                            for callee in targets:
+                                if callee is g:
+                                    continue
+                                cur = ctx_map[id(callee)]
+                                if not contrib <= cur:
+                                    cur.update(contrib)
+                                    changed = True
+            self._span_ctx = ctx_map
+        return ctx_map.get(id(fn), set())
+
 
 class AnalysisContext:
     def __init__(self, modules: List[ModuleInfo]):
@@ -293,6 +457,20 @@ class AnalysisContext:
         if not hop:
             return False
         for other in self.graph.one_hop(fn):
+            if other.called_names & witness_names:
+                return True
+        return False
+
+    def witness_in_reach(self, fn: FunctionInfo,
+                         witness_names: Set[str]) -> bool:
+        """Transitive version of ``witness_in_scope``: does ``fn``, any
+        transitive caller, or any transitive callee call one of
+        ``witness_names``? The dataflow rules' reach semantics — "the
+        shapes are routed through a bucket helper SOMEWHERE on the
+        reachable path"."""
+        if fn.called_names & witness_names:
+            return True
+        for other in self.graph.reach(fn):
             if other.called_names & witness_names:
                 return True
         return False

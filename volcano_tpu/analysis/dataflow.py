@@ -1,0 +1,1072 @@
+"""vlint's interprocedural dataflow engine (stdlib ``ast`` only).
+
+A lightweight abstract-value lattice tracks four taints through
+assignments (incl. element-wise tuple unpacking), calls (parameter and
+return summaries, iterated to a package-wide fixpoint), returns,
+comprehensions and attribute chains:
+
+- ``device``   the value is (or contains) a jax device array: produced by
+               ``jnp.*`` / ``jax.device_put``, by invoking a jitted
+               callable, or by ``Session.snapshot_node_tensors`` and the
+               NodeTensors device getters. Feeding one into host-only
+               code (``np.*``, ``float``/``int``/``bool``/``len``,
+               ``.item()``, iteration, a branch test) forces a host↔device
+               synchronization — the overlap blockers VT010 inventories.
+- ``traced``   the value is a tracer: a parameter of a jit-entry function
+               (minus ``static_argnames``). A Python ``if``/``while``/
+               ``assert`` on one concretizes silently or retraces (VT011).
+- ``session``  the value derives from an open scheduling Session (an
+               ``ssn`` parameter, ``open_session``, a snapshot). Storing
+               one where it outlives ``close_session`` is VT014's escape.
+- ``jitfn``    the value is a compiled callable (``jax.jit`` result or a
+               producer's return). CALLING it is a jit invocation — the
+               site set VT006/VT012/VT013 police for shape bucketing and
+               dtype discipline.
+- ``weak``     the value is an ambient-dtype array (``np.arange`` /
+               ``np.zeros``-family without an explicit dtype): weak-typed
+               operands re-key jit compiles and truncate under disabled
+               x64 when they reach a solver (VT013).
+
+Design bias (same as the CallGraph's): the lattice is a MAY-analysis and
+deliberately cheap — no aliasing, attribute taint is tracked by attribute
+NAME package-wide, call summaries merge all same-named defs. A missing
+edge costs a false positive (suppressible with a justification); the
+approximations are chosen so they can only ADD taint, never hide it —
+except where a rule uses context to EXCUSE a finding (VT010's
+readback-span allowlist), which accepts the union bias and documents it.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple, Union
+
+from .core import (AnalysisContext, FunctionInfo, ModuleInfo, dotted_name)
+
+DEVICE = "device"
+TRACED = "traced"
+SESSION = "session"
+JITFN = "jitfn"
+WEAK = "weak"
+
+# taints that flow through attribute READS by attribute name (tracked
+# PER MODULE: a device array stored on self.X is a device array when read
+# as obj.X anywhere in the same module — the _FusedSolution/_EvictTensors
+# pattern; cross-module attr flow would alias unrelated names like
+# ``.state`` into false positives). session flows through the BASE value
+# instead (ssn.nodes is session because ssn is), and traced never enters
+# object graphs in this codebase's kernels.
+_ATTR_TAINTS = (DEVICE, JITFN, WEAK)
+
+# value-taint dict: taint kind -> origin string ("where it came from")
+TV = Dict[str, str]
+# a return summary is either one TV or an element-wise tuple of TVs
+RetVal = Union[TV, List[TV]]
+
+_SESSION_PARAM_NAMES = {"ssn", "session", "sess"}
+
+# numpy constructors whose dtype defaults to the ambient (weak) type;
+# value = index of the positional argument that, when present, supplies
+# the dtype explicitly
+_WEAK_CTORS = {"zeros": 1, "ones": 1, "empty": 1, "arange": 3, "full": 2}
+
+# host builtins that force a device->host fetch when handed a device array
+_HOST_CASTS = {"float", "int", "bool", "len", "list", "tuple", "sorted",
+               "sum", "min", "max", "any", "all"}
+
+# metadata attributes that are host/static even on device arrays/tracers
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "at"}
+
+# methods that stay on device when called on a device array
+_SYNC_METHODS = {"item", "tolist", "tobytes"}
+
+
+def _merge(dst: TV, src: TV) -> bool:
+    changed = False
+    for k, v in src.items():
+        if k not in dst:
+            dst[k] = v
+            changed = True
+    return changed
+
+
+def _union(*tvs: TV) -> TV:
+    out: TV = {}
+    for tv in tvs:
+        _merge(out, tv)
+    return out
+
+
+def _strip(tv: TV, *kinds: str) -> TV:
+    return {k: v for k, v in tv.items() if k not in kinds}
+
+
+def _flat(val: Union[TV, List[TV], None]) -> TV:
+    if val is None:
+        return {}
+    if isinstance(val, list):
+        return _union(*val) if val else {}
+    return val
+
+
+@dataclass
+class SyncSite:
+    """One host↔device synchronization point: ``kind`` is the syncing
+    operation, ``producer`` the expression the device taint came from —
+    both go into the VT010 finding so the report doubles as the
+    async-overlap worklist (docs/static-analysis.md)."""
+
+    node: ast.AST
+    kind: str
+    producer: str
+
+
+@dataclass
+class JitCall:
+    node: ast.Call
+    desc: str                    # callee descriptor ("solver", "_job_solver()")
+    # VT013 inputs: (arg node, arg descriptor, producer) for every operand
+    # that is a bare numeric literal or carries the ``weak`` taint
+    weak_args: List[Tuple[ast.AST, str, str]] = field(default_factory=list)
+
+
+@dataclass
+class FunctionFacts:
+    sync_sites: List[SyncSite] = field(default_factory=list)
+    jit_calls: List[JitCall] = field(default_factory=list)
+    # (test node, producer) for traced-value branches in jit-entry code
+    traced_tests: List[Tuple[ast.AST, str]] = field(default_factory=list)
+    # (node, target descriptor, producer) for session-scoped values stored
+    # where they outlive the session
+    session_escapes: List[Tuple[ast.AST, str, str]] = \
+        field(default_factory=list)
+
+
+@dataclass
+class _Summary:
+    ret: Optional[RetVal] = None
+    params: Dict[str, TV] = field(default_factory=dict)
+
+
+class DataflowEngine:
+    """Package-wide taint fixpoint + per-function fact extraction.
+
+    Built once per analysis run (``get_dataflow``); rules read
+    ``facts(fn)``. Rounds re-evaluate every function until parameter and
+    return summaries stop growing (bounded), then one final collecting
+    pass records the sites."""
+
+    # safety cap only — the lattice is monotone and finite (taints and
+    # summaries only grow), so the loop terminates by convergence; the
+    # cap guards against an engine bug, not expected depth. If it were
+    # ever hit, ``converged`` would read False and facts could be
+    # missing taint — tests/test_analysis.py pins converged=True on the
+    # real tree so CI notices before findings silently disappear.
+    MAX_ROUNDS = 50
+
+    def __init__(self, ctx: AnalysisContext):
+        self.ctx = ctx
+        # per-FunctionInfo summaries. Interprocedural propagation only
+        # fires through UNAMBIGUOUS simple names (exactly one def in the
+        # package, or a unique class name for __init__): a shared name
+        # like ``get``/``add``/``step`` would alias every same-named
+        # method's arguments into one summary and flood the lattice.
+        self.summaries: Dict[int, _Summary] = {}
+        # (module path, attribute name) -> taints (device/jitfn/weak only)
+        self.attr_taints: Dict[Tuple[str, str], TV] = {}
+        # simple names of functions whose bodies run traced (passed to
+        # jax.jit / @jax.jit-decorated), with their static_argnames
+        self.jit_entries: Set[str] = set()
+        self.static_params: Dict[str, Set[str]] = {}
+        # class simple name -> its __init__ FunctionInfo (None sentinel on
+        # package-wide class-name collision)
+        self.class_inits: Dict[str, Optional[FunctionInfo]] = {}
+        # per-module: class names whose instances are session-scoped —
+        # __init__ takes a session parameter, or the class is a plugin
+        # (has on_session_open: the framework REBUILDS plugins every
+        # open_session, docs/static-analysis.md) — storing session state
+        # on them is not an escape
+        self.session_classes: Dict[str, Set[str]] = {}
+        self._module_globals: Dict[str, Set[str]] = {}
+        # (module path, module-global name) -> taints stored into it
+        # (via NAME[k] = v or global NAME = v): the _SOLVER_CACHE pattern
+        self.global_taints: Dict[Tuple[str, str], TV] = {}
+        self._facts: Dict[int, FunctionFacts] = {}
+        self.converged = False
+        self._prescan()
+        self._traced_ctx = self._traced_contexts()
+        for _ in range(self.MAX_ROUNDS):
+            if not self._run_round(collect=False):
+                self.converged = True
+                break
+        self._run_round(collect=True)
+
+    def facts(self, fn: FunctionInfo) -> FunctionFacts:
+        return self._facts.get(id(fn), FunctionFacts())
+
+    # -- prescan ------------------------------------------------------------
+
+    def _is_jit_factory(self, mod: ModuleInfo, node: ast.Call) -> bool:
+        resolved = mod.resolve_call(node)
+        return resolved in ("jax.jit", "jit")
+
+    def _prescan(self) -> None:
+        for mod in self.ctx.modules:
+            # session-scoped classes: __init__ has an ssn/session param,
+            # or the class is a per-session-rebuilt plugin
+            scoped: Set[str] = set()
+            for fn in mod.functions:
+                if fn.cls is None:
+                    continue
+                if fn.name == "on_session_open":
+                    scoped.add(fn.cls)
+                if fn.name == "__init__":
+                    args = {a.arg for a in fn.node.args.args}
+                    args |= {a.arg for a in fn.node.args.kwonlyargs}
+                    if args & _SESSION_PARAM_NAMES:
+                        scoped.add(fn.cls)
+                    if fn.cls in self.class_inits:
+                        self.class_inits[fn.cls] = None   # ambiguous
+                    else:
+                        self.class_inits[fn.cls] = fn
+            self.session_classes[mod.path] = scoped
+            self._module_globals[mod.path] = _module_global_names(mod)
+            for node in ast.walk(mod.tree):
+                # jax.jit(f, static_argnames=...) / jax.jit(lambda..)
+                if isinstance(node, ast.Call) \
+                        and self._is_jit_factory(mod, node):
+                    statics = _static_argnames(node)
+                    for arg in node.args[:1]:
+                        if isinstance(arg, ast.Name):
+                            self.jit_entries.add(arg.id)
+                            self.static_params.setdefault(
+                                arg.id, set()).update(statics)
+                # @jax.jit / @partial(jax.jit, ...) decorators
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        target = dec.func if isinstance(dec, ast.Call) \
+                            else dec
+                        dn = dotted_name(target) or ""
+                        if dn.split(".")[-1] == "jit":
+                            self.jit_entries.add(node.name)
+                            if isinstance(dec, ast.Call):
+                                self.static_params.setdefault(
+                                    node.name, set()).update(
+                                    _static_argnames(dec))
+                        elif dn.split(".")[-1] == "partial" \
+                                and isinstance(dec, ast.Call) and dec.args:
+                            inner = dotted_name(dec.args[0]) or ""
+                            if inner.split(".")[-1] == "jit":
+                                self.jit_entries.add(node.name)
+                                self.static_params.setdefault(
+                                    node.name, set()).update(
+                                    _static_argnames(dec))
+
+    # -- fixpoint rounds ----------------------------------------------------
+
+    def _run_round(self, collect: bool) -> bool:
+        changed = False
+        for mod in self.ctx.modules:
+            for fn in mod.functions:
+                ev = _FunctionEval(self, mod, fn, collect=collect)
+                changed |= ev.run()
+                if collect:
+                    self._facts[id(fn)] = ev.facts
+        return changed
+
+    # -- traced contexts ----------------------------------------------------
+
+    def _traced_contexts(self) -> Set[int]:
+        """Functions whose bodies execute under a jax trace: jit-entry
+        defs, everything lexically nested inside one, and helpers whose
+        every caller is itself a traced context (kernel utilities like
+        ops/place._select). Inside a traced context ``jnp.*`` values are
+        tracers, not device arrays — a host-looking op there is traced by
+        XLA, not a sync, so VT010 collection is suppressed."""
+        out: Set[int] = set()
+        all_fns = [fn for m in self.ctx.modules for fn in m.functions]
+        by_qual: Dict[Tuple[str, str], FunctionInfo] = {
+            (fn.module.path, fn.qualname): fn for fn in all_fns}
+        for fn in all_fns:
+            parts = set(fn.qualname.split("."))
+            if fn.name in self.jit_entries or parts & self.jit_entries:
+                out.add(id(fn))
+        changed = True
+        while changed:
+            changed = False
+            for fn in all_fns:
+                if id(fn) in out:
+                    continue
+                # lexically nested inside a traced-context function
+                parts = fn.qualname.split(".")
+                for i in range(1, len(parts)):
+                    anc = by_qual.get((fn.module.path,
+                                       ".".join(parts[:i])))
+                    if anc is not None and id(anc) in out:
+                        out.add(id(fn))
+                        changed = True
+                        break
+                if id(fn) in out:
+                    continue
+                # every caller runs traced (kernel helpers like _select)
+                callers = self.ctx.graph.callers_of(fn)
+                if callers and all(id(c) in out for c in callers):
+                    out.add(id(fn))
+                    changed = True
+        return out
+
+    # -- shared summary plumbing --------------------------------------------
+
+    def resolve_callee(self, name: str,
+                       method: bool) -> Optional[FunctionInfo]:
+        """The unambiguous local def a call by simple ``name`` reaches:
+        exactly one def in the package, or a unique class's __init__ for
+        constructor calls. None blocks interprocedural propagation (the
+        safe direction: a missed summary can only lose taint the fixture
+        tests don't rely on, never invent it)."""
+        defs = self.ctx.graph.defs.get(name)
+        if defs is not None and len(defs) == 1:
+            return defs[0]
+        if not method and name in self.class_inits:
+            return self.class_inits[name]
+        return None
+
+    def summary(self, fn: FunctionInfo) -> _Summary:
+        s = self.summaries.get(id(fn))
+        if s is None:
+            s = self.summaries[id(fn)] = _Summary()
+        return s
+
+    def note_return(self, fn: FunctionInfo, val: RetVal) -> bool:
+        s = self.summary(fn)
+        if isinstance(val, list) and isinstance(s.ret, list) \
+                and len(val) == len(s.ret):
+            changed = False
+            for dst, src in zip(s.ret, val):
+                changed |= _merge(dst, src)
+            return changed
+        if s.ret is None:
+            s.ret = [dict(tv) for tv in val] if isinstance(val, list) \
+                else dict(val)
+            return bool(_flat(s.ret))
+        # shape mismatch across return statements: collapse to one TV
+        merged = _union(_flat(s.ret), _flat(val))
+        if merged != _flat(s.ret) or isinstance(s.ret, list):
+            s.ret = merged
+            return True
+        return False
+
+    def note_param(self, fn: FunctionInfo, param: str, tv: TV) -> bool:
+        if not tv:
+            return False
+        s = self.summary(fn)
+        dst = s.params.setdefault(param, {})
+        return _merge(dst, tv)
+
+    def note_global(self, mod_path: str, name: str, tv: TV) -> bool:
+        kept = _strip(tv, TRACED)
+        if not kept:
+            return False
+        dst = self.global_taints.setdefault((mod_path, name), {})
+        return _merge(dst, kept)
+
+    def note_attr(self, mod_path: str, attr: str, tv: TV) -> bool:
+        kept = {k: v for k, v in tv.items() if k in _ATTR_TAINTS}
+        if not kept:
+            return False
+        dst = self.attr_taints.setdefault((mod_path, attr), {})
+        return _merge(dst, kept)
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            out: Set[str] = set()
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) \
+                        and isinstance(el.value, str):
+                    out.add(el.value)
+            return out
+    return set()
+
+
+def _module_global_names(mod: ModuleInfo) -> Set[str]:
+    out: Set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            out.add(node.target.id)
+    return out
+
+
+class _FunctionEval:
+    """Abstract interpretation of one function body.
+
+    Two internal passes per round (so loop-carried taints reach first-use
+    sites) with facts collected only on the engine's final collecting
+    round — no duplicate findings, stable environments."""
+
+    def __init__(self, engine: DataflowEngine, mod: ModuleInfo,
+                 fn: FunctionInfo, collect: bool):
+        self.eng = engine
+        self.mod = mod
+        self.fn = fn
+        self.collect = collect
+        self.facts = FunctionFacts()
+        self.env: Dict[str, TV] = {}
+        self.globals_decl: Set[str] = set()
+        self.changed = False
+        self._recording = False
+        self.ret_val: Optional[RetVal] = None
+
+    # -- entry --------------------------------------------------------------
+
+    def run(self) -> bool:
+        self._seed_params()
+        for final in (False, True):
+            self._recording = self.collect and final
+            for stmt in self.fn.node.body:
+                self.stmt(stmt)
+        if self.ret_val is not None:
+            self.changed |= self.eng.note_return(self.fn, self.ret_val)
+        return self.changed
+
+    def _loc(self, node: ast.AST) -> str:
+        return f"{self.mod.path}:{getattr(node, 'lineno', 0)}"
+
+    def _seed_params(self) -> None:
+        args = self.fn.node.args
+        names = [a.arg for a in args.posonlyargs + args.args
+                 + args.kwonlyargs]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        summary = self.eng.summaries.get(id(self.fn))
+        is_jit_entry = self.fn.name in self.eng.jit_entries
+        statics = self.eng.static_params.get(self.fn.name, set())
+        for name in names:
+            tv: TV = {}
+            if name in _SESSION_PARAM_NAMES:
+                tv[SESSION] = f"parameter {name!r}"
+            if "solver" in name:
+                tv[JITFN] = f"solver-valued parameter {name!r}"
+            if is_jit_entry and name not in statics and name != "self":
+                tv[TRACED] = (f"traced parameter {name!r} of jit-entry "
+                              f"{self.fn.name}")
+            if summary is not None and name in summary.params:
+                tv = _union(tv, summary.params[name])
+            if tv:
+                self.env[name] = tv
+
+    # -- fact recording -----------------------------------------------------
+
+    def _sync(self, node: ast.AST, kind: str, tv: TV) -> None:
+        if id(self.fn) in self.eng._traced_ctx:
+            return          # tracer ops inside a jit trace are not syncs
+        if self._recording:
+            self.facts.sync_sites.append(SyncSite(
+                node=node, kind=kind, producer=tv.get(DEVICE, "?")))
+
+    def _traced_test(self, node: ast.AST, tv: TV) -> None:
+        if self._recording:
+            self.facts.traced_tests.append((node, tv.get(TRACED, "?")))
+
+    def _escape(self, node: ast.AST, target: str, tv: TV) -> None:
+        if self._recording:
+            self.facts.session_escapes.append(
+                (node, target, tv.get(SESSION, "?")))
+
+    # -- statements ---------------------------------------------------------
+
+    def stmt(self, node: ast.stmt) -> None:
+        m = getattr(self, "stmt_" + type(node).__name__, None)
+        if m is not None:
+            m(node)
+            return
+        # default: evaluate embedded expressions, walk nested bodies
+        for fname in ("body", "orelse", "finalbody"):
+            for sub in getattr(node, fname, []) or []:
+                self.stmt(sub)
+        for h in getattr(node, "handlers", []) or []:
+            for sub in h.body:
+                self.stmt(sub)
+
+    def stmt_Global(self, node: ast.Global) -> None:
+        self.globals_decl.update(node.names)
+
+    def stmt_Expr(self, node: ast.Expr) -> None:
+        self.ev(node.value)
+
+    def stmt_Return(self, node: ast.Return) -> None:
+        if node.value is None:
+            return
+        if isinstance(node.value, ast.Tuple):
+            val: RetVal = [self.ev(el) for el in node.value.elts]
+        else:
+            v = self.ev(node.value)
+            val = v if not isinstance(v, list) else v
+        if self.ret_val is None:
+            self.ret_val = val
+        elif isinstance(self.ret_val, list) and isinstance(val, list) \
+                and len(val) == len(self.ret_val):
+            for dst, src in zip(self.ret_val, val):
+                _merge(dst, src)
+        else:
+            self.ret_val = _union(_flat(self.ret_val), _flat(val))
+
+    def _assign_name(self, node: ast.AST, name: str, tv: TV) -> None:
+        if name in self.globals_decl:
+            self.changed |= self.eng.note_global(self.mod.path, name, tv)
+            if SESSION in tv:
+                self._escape(node, f"module global {name!r}", tv)
+        if tv:
+            # OVERWRITE, do not union: a rebind kills the old taint —
+            # ``x = jax.device_get(x)`` must leave x host. Loop-carried
+            # taints are handled by the two-pass body evaluation, not by
+            # making the environment sticky.
+            self.env[name] = dict(tv)
+        elif name in self.env:
+            del self.env[name]
+
+    def _assign_target(self, stmt: ast.AST, tgt: ast.expr,
+                       val: Union[TV, List[TV]]) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            if isinstance(val, list) and len(val) == len(tgt.elts) \
+                    and not any(isinstance(e, ast.Starred)
+                                for e in tgt.elts):
+                for el, v in zip(tgt.elts, val):
+                    self._assign_target(stmt, el, v)
+            else:
+                flat = _flat(val)
+                for el in tgt.elts:
+                    self._assign_target(
+                        stmt, el.value if isinstance(el, ast.Starred)
+                        else el, flat)
+            return
+        tv = _flat(val)
+        if isinstance(tgt, ast.Name):
+            self._assign_name(stmt, tgt.id, tv)
+            return
+        if isinstance(tgt, ast.Attribute):
+            self.changed |= self.eng.note_attr(self.mod.path, tgt.attr, tv)
+            base = dotted_name(tgt.value)
+            if SESSION in tv and base == "self" \
+                    and self.fn.cls is not None \
+                    and self.fn.cls not in self.eng.session_classes.get(
+                        self.mod.path, set()):
+                self._escape(stmt, f"self.{tgt.attr} "
+                             f"(class {self.fn.cls} is not "
+                             f"session-scoped)", tv)
+            return
+        if isinstance(tgt, ast.Subscript):
+            # store into a module-global container: an escape for session
+            # values (the container outlives the cycle)
+            base = tgt.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            self.ev(tgt.slice)
+            if isinstance(base, ast.Name) \
+                    and base.id in self.eng._module_globals.get(
+                        self.mod.path, set()) \
+                    and base.id not in self.env:
+                self.changed |= self.eng.note_global(
+                    self.mod.path, base.id, tv)
+                if SESSION in tv:
+                    self._escape(stmt, f"module-global container "
+                                 f"{base.id!r}", tv)
+
+    def stmt_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Tuple):
+            val: Union[TV, List[TV]] = [self.ev(el)
+                                        for el in node.value.elts]
+        else:
+            val = self.ev_maybe_tuple(node.value)
+        for tgt in node.targets:
+            self._assign_target(node, tgt, val)
+
+    def stmt_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is None:
+            return
+        self._assign_target(node, node.target,
+                            self.ev_maybe_tuple(node.value))
+
+    def stmt_AugAssign(self, node: ast.AugAssign) -> None:
+        tv = _union(self.ev(node.value),
+                    self.ev(ast.copy_location(
+                        ast.Name(id=node.target.id, ctx=ast.Load()),
+                        node.target))
+                    if isinstance(node.target, ast.Name) else {})
+        self._assign_target(node, node.target, tv)
+
+    def stmt_For(self, node: ast.For) -> None:
+        it = self.ev(node.iter)
+        if DEVICE in it and not _container_iter(node.iter):
+            self._sync(node.iter, "iteration", it)
+        elt = _strip(it, JITFN)
+        self._assign_target(node, node.target, elt)
+        for sub in node.body:
+            self.stmt(sub)
+        for sub in node.orelse:
+            self.stmt(sub)
+
+    def _test(self, node: ast.expr) -> None:
+        tv = self.ev(node)
+        if DEVICE in tv and not _static_test(node):
+            self._sync(node, "branch-test", tv)
+        if TRACED in tv and self.fn.name in self.eng.jit_entries \
+                and not _static_test(node):
+            self._traced_test(node, tv)
+
+    def stmt_If(self, node: ast.If) -> None:
+        self._test(node.test)
+        for sub in node.body:
+            self.stmt(sub)
+        for sub in node.orelse:
+            self.stmt(sub)
+
+    def stmt_While(self, node: ast.While) -> None:
+        self._test(node.test)
+        for sub in node.body:
+            self.stmt(sub)
+        for sub in node.orelse:
+            self.stmt(sub)
+
+    def stmt_Assert(self, node: ast.Assert) -> None:
+        self._test(node.test)
+        if node.msg is not None:
+            self.ev(node.msg)
+
+    def stmt_With(self, node: ast.With) -> None:
+        for item in node.items:
+            tv = self.ev(item.context_expr)
+            if item.optional_vars is not None:
+                self._assign_target(node, item.optional_vars, tv)
+        for sub in node.body:
+            self.stmt(sub)
+
+    stmt_AsyncWith = stmt_With
+
+    def stmt_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # a nested def closing over tainted locals is a value carrying
+        # those taints (the closure half of VT014): bind its name to the
+        # union of the tainted free names it references
+        tv: TV = {}
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in self.env:
+                _merge(tv, _strip(self.env[sub.id], TRACED))
+        if tv:
+            self.env[node.name] = tv
+
+    stmt_AsyncFunctionDef = stmt_FunctionDef
+
+    def stmt_Raise(self, node: ast.Raise) -> None:
+        if node.exc is not None:
+            self.ev(node.exc)
+
+    def stmt_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                self.env.pop(tgt.id, None)
+
+    # -- expressions --------------------------------------------------------
+
+    def ev_maybe_tuple(self, node: ast.expr) -> Union[TV, List[TV]]:
+        """Like ``ev`` but preserves element-wise taints for calls whose
+        summaries are tuples — so ``a, b = helper()`` distributes."""
+        if isinstance(node, ast.Call):
+            tv = self.ev(node, want_tuple=True)
+            return tv
+        if isinstance(node, ast.Tuple):
+            return [self.ev(el) for el in node.elts]
+        return self.ev(node)
+
+    def ev(self, node: ast.expr,
+           want_tuple: bool = False) -> Union[TV, List[TV]]:
+        out = self._ev(node, want_tuple)
+        return out
+
+    def _ev(self, node: ast.expr, want_tuple: bool = False):
+        if isinstance(node, ast.Name):
+            tv = self.env.get(node.id)
+            if tv is not None:
+                return dict(tv)
+            gtv = self.eng.global_taints.get((self.mod.path, node.id))
+            return dict(gtv) if gtv else {}
+        if isinstance(node, ast.Constant):
+            return {}
+        if isinstance(node, ast.Attribute):
+            base = self.ev(node.value)
+            if node.attr in _STATIC_ATTRS:
+                return {}
+            out = {k: v for k, v in base.items()
+                   if k in (SESSION, TRACED)}
+            attr_tv = self.eng.attr_taints.get((self.mod.path, node.attr))
+            if attr_tv:
+                _merge(out, dict(attr_tv))
+            return out
+        if isinstance(node, ast.Call):
+            return self._ev_call(node, want_tuple)
+        if isinstance(node, ast.Subscript):
+            base = self.ev(node.value)
+            self.ev(node.slice)
+            if isinstance(base, list):
+                base = _flat(base)
+            return base                 # element reads keep jitfn: caches
+        if isinstance(node, (ast.BinOp,)):
+            return _union(self.ev(node.left), self.ev(node.right))
+        if isinstance(node, ast.UnaryOp):
+            return self.ev(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return _union(*[self.ev(v) for v in node.values])
+        if isinstance(node, ast.Compare):
+            tv = _union(self.ev(node.left),
+                        *[self.ev(c) for c in node.comparators])
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in node.ops):
+                return {}                    # identity/membership: host bool
+            return tv
+        if isinstance(node, ast.IfExp):
+            self._test(node.test)
+            return _union(self.ev(node.body), self.ev(node.orelse))
+        if isinstance(node, ast.Tuple):
+            if want_tuple:
+                return [self.ev(el) for el in node.elts]
+            return _union(*[self.ev(el) for el in node.elts])
+        if isinstance(node, (ast.List, ast.Set)):
+            return _union(*[self.ev(el) for el in node.elts])
+        if isinstance(node, ast.Dict):
+            vals = [self.ev(v) for v in node.values if v is not None]
+            for k in node.keys:
+                if k is not None:
+                    self.ev(k)
+            return _union(*vals) if vals else {}
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            return self._ev_comp(node)
+        if isinstance(node, ast.Starred):
+            return self.ev(node.value)
+        if isinstance(node, ast.Lambda):
+            tv: TV = {}
+            for sub in ast.walk(node.body):
+                if isinstance(sub, ast.Name) and sub.id in self.env:
+                    _merge(tv, _strip(self.env[sub.id], TRACED))
+            return tv
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    self.ev(v.value)
+            return {}
+        if isinstance(node, ast.FormattedValue):
+            self.ev(node.value)
+            return {}
+        if isinstance(node, ast.Await):
+            return self.ev(node.value)
+        if isinstance(node, ast.NamedExpr):
+            tv = self.ev(node.value)
+            self._assign_name(node, node.target.id, _flat(tv))
+            return tv
+        if isinstance(node, ast.Slice):
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    self.ev(part)
+            return {}
+        return {}
+
+    def _ev_comp(self, node) -> TV:
+        saved: Dict[str, Optional[TV]] = {}
+        for gen in node.generators:
+            it = self.ev(gen.iter)
+            if DEVICE in it and not _container_iter(gen.iter):
+                self._sync(gen.iter, "iteration", it)
+            elt = _strip(it, JITFN)
+            for name in _target_names(gen.target):
+                saved.setdefault(name, self.env.get(name))
+                if elt:
+                    self.env[name] = _union(self.env.get(name, {}), elt)
+            for cond in gen.ifs:
+                self._test(cond)
+        if isinstance(node, ast.DictComp):
+            out = _union(self.ev(node.key), self.ev(node.value))
+        else:
+            out = self.ev(node.elt)
+        for name, old in saved.items():
+            if old is None:
+                self.env.pop(name, None)
+            else:
+                self.env[name] = old
+        return out
+
+    # -- calls --------------------------------------------------------------
+
+    def _ev_call(self, node: ast.Call, want_tuple: bool = False):
+        arg_tvs = [_flat(self.ev(a)) for a in node.args]
+        # positional list FIRST (two **expansions share arg=None — a dict
+        # would collapse them and misalign taint attribution), dict view
+        # for named-parameter threading
+        kw_tv_list = [_flat(self.ev(kw.value)) for kw in node.keywords]
+        kw_tvs = {kw.arg: tv for kw, tv in zip(node.keywords, kw_tv_list)
+                  if kw.arg}
+        resolved = self.mod.resolve_call(node) or ""
+        parts = resolved.split(".")
+        head = parts[0]
+        func = node.func
+        callee_desc = dotted_name(func) or "<expr>"
+        all_args = list(arg_tvs) + list(kw_tvs.values())
+
+        # jax.jit(...) minting a compiled callable
+        if resolved in ("jax.jit", "jit"):
+            return {JITFN: f"jax.jit(...) at {self._loc(node)}"}
+
+        # jax.numpy.* — device-array producers (and traced/session carry)
+        if head == "jax" and len(parts) >= 2 and parts[1] == "numpy":
+            out = _union(*all_args) if all_args else {}
+            out = _strip(out, JITFN, WEAK)
+            out[DEVICE] = f"{_short(resolved)}(...) at {self._loc(node)}"
+            if parts[-1] in _WEAK_CTORS and not _has_dtype(
+                    node, _WEAK_CTORS[parts[-1]]):
+                out[WEAK] = (f"{_short(resolved)}(...) without dtype at "
+                             f"{self._loc(node)}")
+            return out
+
+        if resolved == "jax.device_put":
+            out = _union(*all_args) if all_args else {}
+            out = _strip(out, JITFN)
+            out[DEVICE] = f"jax.device_put(...) at {self._loc(node)}"
+            return out
+
+        if resolved in ("jax.device_get", "jax.block_until_ready"):
+            merged = _union(*all_args) if all_args else {}
+            if DEVICE in merged:
+                self._sync(node, _short(resolved), merged)
+            return _strip(merged, DEVICE, JITFN)
+
+        # numpy.* on a device operand is an implicit device_get
+        if head == "numpy":
+            merged = _union(*all_args) if all_args else {}
+            if DEVICE in merged:
+                self._sync(node, _short(resolved), merged)
+            out = _strip(merged, DEVICE, JITFN)
+            tail = parts[-1] if len(parts) > 1 else ""
+            if tail in _WEAK_CTORS and not _has_dtype(
+                    node, _WEAK_CTORS[tail]):
+                out[WEAK] = (f"np.{tail}(...) without dtype at "
+                             f"{self._loc(node)}")
+            elif "dtype" in kw_tvs or tail in ("asarray", "astype"):
+                out = _strip(out, WEAK) if _has_dtype(node, 1) else out
+            return out
+
+        # host builtins force the fetch
+        if isinstance(func, ast.Name) and func.id in _HOST_CASTS \
+                and func.id not in self.env:
+            merged = _union(*all_args) if all_args else {}
+            if DEVICE in merged:
+                self._sync(node, f"{func.id}()", merged)
+            return _strip(merged, DEVICE, JITFN, SESSION, TRACED) \
+                if func.id in ("float", "int", "bool", "len") \
+                else _strip(merged, DEVICE, JITFN)
+
+        # method calls ------------------------------------------------------
+        if isinstance(func, ast.Attribute):
+            recv = _flat(self.ev(func.value))
+            merged_args = _union(*all_args) if all_args else {}
+            if func.attr == "snapshot_node_tensors":
+                # the NodeTensors OBJECT is session-scoped host state; its
+                # device residency is behind node_state()/device_* below
+                return {SESSION: f"snapshot_node_tensors() at "
+                                 f"{self._loc(node)}"}
+            if func.attr in ("node_state", "device_allocatable",
+                             "device_max_tasks"):
+                return _union(_strip(recv, DEVICE),
+                              {DEVICE: f"{callee_desc}() at "
+                                       f"{self._loc(node)}"})
+            if func.attr in _SYNC_METHODS and DEVICE in recv:
+                self._sync(node, f".{func.attr}()", recv)
+                return _strip(recv, DEVICE, JITFN)
+            if func.attr == "astype":
+                return _strip(_union(recv, merged_args), WEAK, JITFN)
+            # invoking a jit-valued attribute (self._solve(...)); a jitfn
+            # merely HELD by the receiver (a cache dict) is not invoked by
+            # calling one of the receiver's own methods
+            if func.attr in self._module_jit_attrs():
+                return self._jit_invoke(node, callee_desc, arg_tvs, kw_tv_list)
+            # local def reachable as a method: thread param taints.
+            # _AMBIENT_METHODS never consult a summary either — `reshape`
+            # having one def somewhere in the package must not wipe a
+            # device receiver's taint (the MAY invariant: approximations
+            # may ADD taint, never hide it)
+            self._note_callsite(func.attr, node, arg_tvs, kw_tvs,
+                                method=True)
+            if func.attr not in self._AMBIENT_METHODS:
+                out = self._summary_ret(func.attr, want_tuple, method=True)
+                if out is not None:
+                    return out
+            # unknown method: device receivers stay device (x.min(),
+            # x.reshape()); session receivers derive session values
+            out = _strip(_union(recv, merged_args), JITFN, WEAK)
+            return out
+
+        # plain-name calls --------------------------------------------------
+        if isinstance(func, ast.Name):
+            name = func.id
+            bound = self.env.get(name)
+            if bound and JITFN in bound:
+                return self._jit_invoke(node, name, arg_tvs, kw_tv_list)
+            carried = _strip(bound or {}, JITFN, DEVICE, WEAK)
+            if name == "open_session":
+                return {SESSION: f"open_session() at {self._loc(node)}"}
+            self._note_callsite(name, node, arg_tvs, kw_tvs, method=False)
+            out = self._summary_ret(name, want_tuple)
+            if out is not None:
+                if carried:
+                    out = _union(_flat(out), carried) \
+                        if not isinstance(out, list) else out
+                return out
+            merged = _union(*all_args) if all_args else {}
+            return _union(_strip(merged, JITFN), carried)
+
+        # calling the result of a call: producer()(args) — a jit
+        # invocation when the inner call yields a compiled callable
+        if isinstance(func, ast.Call):
+            inner = _flat(self.ev(func))
+            if JITFN in inner:
+                return self._jit_invoke(
+                    node, (dotted_name(func.func) or "<expr>") + "()",
+                    arg_tvs, kw_tv_list)
+            merged = _union(*all_args) if all_args else {}
+            return _strip(merged, JITFN)
+
+        merged = _union(*all_args) if all_args else {}
+        return _strip(merged, JITFN)
+
+    def _module_jit_attrs(self) -> Set[str]:
+        return {a for (p, a), tv in self.eng.attr_taints.items()
+                if p == self.mod.path and JITFN in tv}
+
+    def _jit_invoke(self, node: ast.Call, desc: str,
+                    arg_tvs: List[TV], kw_tv_list: List[TV]) -> TV:
+        if self._recording:
+            jc = JitCall(node=node, desc=desc)
+            for arg, tv in zip(node.args, arg_tvs):
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, (int, float)) \
+                        and not isinstance(arg.value, bool):
+                    jc.weak_args.append(
+                        (arg, repr(arg.value), "bare Python literal"))
+                elif WEAK in tv:
+                    jc.weak_args.append(
+                        (arg, ast.unparse(arg)[:60] if hasattr(
+                            ast, "unparse") else "<arg>", tv[WEAK]))
+            for kw, tv in zip(node.keywords, kw_tv_list):
+                if WEAK in tv:
+                    jc.weak_args.append(
+                        (kw.value, f"{kw.arg or '**'}=...", tv[WEAK]))
+            self.facts.jit_calls.append(jc)
+        return {DEVICE: f"jitted call {desc}(...) at {self._loc(node)}"}
+
+    # method names jax arrays / stdlib containers also expose: a
+    # ``dev.at[i].set(x)`` must not thread taints into Resource.set just
+    # because ``set`` happens to have one def in the package
+    _AMBIENT_METHODS = {"set", "get", "add", "sub", "update", "pop",
+                        "clear", "copy", "keys", "values", "items",
+                        "append", "extend", "remove", "sort", "min",
+                        "max", "sum", "all", "any", "reshape", "astype"}
+
+    def _note_callsite(self, name: str, node: ast.Call,
+                       arg_tvs: List[TV], kw_tvs: Dict[str, TV],
+                       method: bool) -> None:
+        """Thread argument taints into a local def's parameter summary
+        (the interprocedural half of the lattice)."""
+        if method and name in self._AMBIENT_METHODS:
+            return
+        callee = self.eng.resolve_callee(name, method=method)
+        if callee is None:
+            return
+        args = callee.node.args
+        params = [a.arg for a in args.posonlyargs + args.args]
+        if params and params[0] == "self":
+            params = params[1:]
+        for i, tv in enumerate(arg_tvs):
+            if i < len(params):
+                self.changed |= self.eng.note_param(callee, params[i], tv)
+        kwonly = {a.arg for a in args.kwonlyargs}
+        for kwname, tv in kw_tvs.items():
+            if kwname and (kwname in params or kwname in kwonly):
+                self.changed |= self.eng.note_param(callee, kwname, tv)
+
+    def _summary_ret(self, name: str, want_tuple: bool,
+                     method: bool = False):
+        callee = self.eng.resolve_callee(name, method=method)
+        if callee is None:
+            return None if name not in self.eng.ctx.graph.defs else {}
+        s = self.eng.summaries.get(id(callee))
+        if s is None or s.ret is None:
+            return {}
+        if isinstance(s.ret, list):
+            if want_tuple:
+                return [dict(tv) for tv in s.ret]
+            return _flat(s.ret)
+        return dict(s.ret)
+
+
+_CONTAINER_FNS = {"zip", "enumerate", "reversed", "map", "filter",
+                  "range", "sorted"}
+
+
+def _container_iter(node: ast.expr) -> bool:
+    """Iterating zip()/enumerate()/... over device arrays walks a host
+    container whose ELEMENTS are device arrays — structural, no fetch;
+    only iterating a device array itself syncs."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in _CONTAINER_FNS)
+
+
+def _target_names(tgt: ast.expr) -> List[str]:
+    if isinstance(tgt, ast.Name):
+        return [tgt.id]
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in tgt.elts:
+            out.extend(_target_names(el))
+        return out
+    return []
+
+
+def _static_test(node: ast.expr) -> bool:
+    """Tests that are safe on tracers: identity against None and
+    isinstance checks concretize nothing."""
+    if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+        return True
+    if isinstance(node, ast.Call):
+        dn = dotted_name(node.func) or ""
+        if dn.split(".")[-1] in ("isinstance", "hasattr", "callable"):
+            return True
+    return False
+
+
+def _has_dtype(node: ast.Call, dtype_pos: int) -> bool:
+    if any(kw.arg == "dtype" for kw in node.keywords):
+        return True
+    return len(node.args) > dtype_pos
+
+
+def _short(resolved: str) -> str:
+    return resolved.replace("jax.numpy.", "jnp.").replace("numpy.", "np.")
+
+
+def get_dataflow(ctx: AnalysisContext) -> DataflowEngine:
+    """The per-run engine, built lazily and cached on the context so the
+    five dataflow rules share one fixpoint."""
+    eng = getattr(ctx, "_dataflow", None)
+    if eng is None:
+        eng = DataflowEngine(ctx)
+        ctx._dataflow = eng                      # type: ignore[attr-defined]
+    return eng

@@ -1,0 +1,106 @@
+"""``vlint --diff BASE``: restrict findings to functions whose bodies
+changed vs a git ref.
+
+Pure stdlib: ``git diff --unified=0 BASE -- '*.py'`` is parsed for
+post-image hunk ranges, and a finding survives when its ENCLOSING
+FUNCTION's lexical span intersects a changed range (module-level
+findings match on their own line). The full-tree pass stays the CI hard
+gate; --diff keeps the edit-compile-lint loop fast as the tree grows —
+it can only ever REMOVE findings, never add them, so a clean --diff run
+is necessary but not sufficient.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+from typing import Dict, Iterable, List, Tuple
+
+from .core import AnalysisContext, Finding, normalize_path
+
+# ``@@ -12,3 +14,6 @@`` — we only need the post-image (+) side
+_HUNK_RE = re.compile(r"^@@ -\d+(?:,\d+)? \+(?P<start>\d+)(?:,(?P<count>\d+))? @@")
+
+
+class DiffError(RuntimeError):
+    """git unavailable / bad ref — the CLI reports and exits 2."""
+
+
+def changed_ranges(base: str, cwd: str = ".") -> Dict[str, List[Tuple[int, int]]]:
+    """normalized path -> [(start, end)] 1-based inclusive line ranges
+    that differ from ``base`` (post-image side; pure deletions collapse
+    to a zero-length range at the deletion point so a finding ON the
+    surrounding function still matches via its span)."""
+    try:
+        proc = subprocess.run(
+            ["git", "diff", "--unified=0", "--no-color", base, "--",
+             "*.py"],
+            cwd=cwd, capture_output=True, text=True)
+    except OSError as exc:  # pragma: no cover - no git binary
+        raise DiffError(f"git not available: {exc}") from exc
+    if proc.returncode not in (0, 1):
+        raise DiffError(f"git diff {base!r} failed: "
+                        f"{proc.stderr.strip() or proc.stdout.strip()}")
+    ranges: Dict[str, List[Tuple[int, int]]] = {}
+    current: str = ""
+    for line in proc.stdout.splitlines():
+        if line.startswith("+++ "):
+            target = line[4:].strip()
+            if target == "/dev/null":
+                current = ""
+                continue
+            if target.startswith("b/"):
+                target = target[2:]
+            current = normalize_path(target)
+            continue
+        m = _HUNK_RE.match(line)
+        if m and current:
+            start = int(m.group("start"))
+            count = int(m.group("count") or "1")
+            end = start + max(count - 1, 0)
+            ranges.setdefault(current, []).append((start, end))
+    return ranges
+
+
+def _overlaps(a_start: int, a_end: int, b_start: int, b_end: int) -> bool:
+    return a_start <= b_end and b_start <= a_end
+
+
+def restrict_findings(findings: Iterable[Finding], ctx: AnalysisContext,
+                      ranges: Dict[str, List[Tuple[int, int]]]
+                      ) -> Tuple[List[Finding], int]:
+    """(kept, dropped_count): a finding is kept when its enclosing
+    function's span — or, module-level, its own line — intersects a
+    changed range of its file."""
+    kept: List[Finding] = []
+    dropped = 0
+    for f in findings:
+        file_ranges = ranges.get(f.path)
+        if not file_ranges:
+            dropped += 1
+            continue
+        mod = ctx.by_path.get(f.path)
+        fn = mod.enclosing_function(f.line) if mod is not None else None
+        if fn is not None:
+            span = (fn.node.lineno,
+                    getattr(fn.node, "end_lineno", fn.node.lineno))
+        else:
+            span = (f.line, f.line)
+        if any(_overlaps(span[0], span[1], lo, hi)
+               for lo, hi in file_ranges):
+            kept.append(f)
+        else:
+            dropped += 1
+    return kept, dropped
+
+
+def repo_root_for(paths: List[str]) -> str:
+    """cwd for the git invocation: the first existing path's directory
+    (git resolves the repo root upward from there)."""
+    for p in paths:
+        if os.path.isdir(p):
+            return p
+        if os.path.exists(p):
+            return os.path.dirname(os.path.abspath(p)) or "."
+    return "."

@@ -59,6 +59,84 @@ def text_report(live: List[Finding], invalid: List[Finding],
     return "\n".join(lines)
 
 
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+# rule help anchors: docs/static-analysis.md carries an explicit
+# <a id="vtxxx"></a> per rule so the URI survives heading rewording
+DOC_URI = "docs/static-analysis.md"
+
+
+def sarif_report(live: List[Finding], invalid: List[Finding],
+                 baselined: List[Finding]) -> str:
+    """SARIF 2.1.0 (``--format sarif``): one run, the full rule catalog
+    with help URIs into docs/static-analysis.md, one result per finding.
+    Live findings and invalid suppressions are ``error``; baselined
+    findings are emitted as suppressed ``note`` results so diff
+    annotation shows the debt without failing the check."""
+    from .rules import ALL_RULES
+    rule_ids = [r.id for r in ALL_RULES] + ["VT000"]
+    rules_meta = [
+        {
+            "id": r.id,
+            "name": r.name or r.id,
+            "shortDescription": {"text": r.contract or r.id},
+            "fullDescription": {
+                "text": (r.__doc__ or r.contract or r.id).strip()},
+            "helpUri": f"{DOC_URI}#{r.id.lower()}",
+            "defaultConfiguration": {"level": "error"},
+        }
+        for r in ALL_RULES
+    ] + [{
+        "id": "VT000",
+        "name": "analyzer-error",
+        "shortDescription": {"text": "vlint analyzer error / invalid "
+                                     "suppression"},
+        "helpUri": f"{DOC_URI}#vt000",
+        "defaultConfiguration": {"level": "error"},
+    }]
+    index = {rid: i for i, rid in enumerate(rule_ids)}
+
+    def result(f: Finding, level: str, suppressed: bool) -> dict:
+        out = {
+            "ruleId": f.rule,
+            "ruleIndex": index.get(f.rule, len(rule_ids) - 1),
+            "level": level,
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "%SRCROOT%"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                },
+            }],
+        }
+        if suppressed:
+            out["suppressions"] = [{
+                "kind": "external",
+                "justification": "grandfathered in vlint-baseline.json "
+                                 "(entry carries its own justification)",
+            }]
+        return out
+
+    payload = {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "vlint",
+                "informationUri": DOC_URI,
+                "rules": rules_meta,
+            }},
+            "results": (
+                [result(f, "error", False) for f in invalid]
+                + [result(f, "error", False) for f in live]
+                + [result(f, "note", True) for f in baselined]),
+        }],
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)
+
+
 def json_report(live: List[Finding], invalid: List[Finding],
                 baselined: List[Finding], baseline: Baseline) -> str:
     payload = {

@@ -10,10 +10,10 @@ sim byte-determinism.
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Optional, Sequence, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .core import (AnalysisContext, Finding, FunctionInfo, ModuleInfo,
-                   dotted_name)
+                   dotted_name, enclosing_span_names)
 
 
 def _in_scope(path: str, prefixes: Sequence[str]) -> bool:
@@ -27,6 +27,7 @@ class Rule:
     contract: str = ""
     scope: Sequence[str] = ()
     exclude: Sequence[str] = ()
+    example: str = ""          # minimal trigger snippet (vlint --explain)
 
     def applies_to(self, path: str) -> bool:
         if _in_scope(path, self.exclude):
@@ -589,15 +590,20 @@ class SimKillSwallowRule(Rule):
 class ShapeBucketRule(Rule):
     """Every jitted-solver invocation must route its data-dependent array
     shapes through a pow2 bucketing/padding helper (``_bucket``,
-    ``_job_bucket``, ``_delta_bucket``, ``bucket_chunks``, ...) in the
-    function or one hop — an unbucketed axis mints a fresh XLA program
-    per distinct size, the multi-second churn recompile hole PR 4
-    closed."""
+    ``_job_bucket``, ``_delta_bucket``, ``bucket_chunks``, ...) somewhere
+    on the REACHABLE PATH — the function itself, a transitive caller, or
+    a transitive callee (this PR re-pointed the rule from one-hop to the
+    transitive CallGraph closures; the id stays VT006 for baseline
+    continuity). An unbucketed axis mints a fresh XLA program per
+    distinct size, the multi-second churn recompile hole PR 4 closed.
+    VT012 runs the SAME witness over the invocation sites only the
+    dataflow lattice can see."""
 
     id = "VT006"
     name = "shape-bucket"
     contract = ("jit/shard_map entry points whose shape arguments skip "
-                "pow2 bucketing re-open the churn recompile hole (PR 4)")
+                "pow2 bucketing re-open the churn recompile hole (PR 4; "
+                "transitive-reach engine since PR 11)")
     scope = ("volcano_tpu/actions/", "volcano_tpu/ops/",
              "volcano_tpu/parallel/", "volcano_tpu/cache/snapshot.py")
 
@@ -613,7 +619,11 @@ class ShapeBucketRule(Rule):
     def _jit_producers(self, ctx: AnalysisContext) -> Set[str]:
         """Function names (package-wide) that return/cache a jax.jit
         result — calling their return value launches a compiled
-        program."""
+        program. Cached on the context: this is a full-package AST walk
+        and both VT006 and VT012 consult it per module."""
+        cached = getattr(ctx, "_jit_producers", None)
+        if cached is not None:
+            return cached
         out: Set[str] = set()
         for m in ctx.modules:
             for fn in m.functions:
@@ -621,6 +631,7 @@ class ShapeBucketRule(Rule):
                     if isinstance(node, ast.Call) \
                             and self._is_jit_factory_call(m, node):
                         out.add(fn.name)
+        ctx._jit_producers = out               # type: ignore[attr-defined]
         return out
 
     def _has_bucket(self, fn: FunctionInfo) -> bool:
@@ -649,63 +660,97 @@ class ShapeBucketRule(Rule):
                         out.add(tgt.attr)
         return out
 
-    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+    def bucket_on_path(self, fn: FunctionInfo,
+                       ctx: AnalysisContext) -> bool:
+        """Transitive witness: a bucket/pad helper call in the function
+        or anywhere on the reachable path (callers* ∪ callees*)."""
+        if self._has_bucket(fn):
+            return True
+        return any(self._has_bucket(o) for o in ctx.graph.reach(fn))
+
+    def syntactic_sites(self, mod: ModuleInfo, ctx: AnalysisContext
+                        ) -> Dict[int, List[Tuple[ast.Call, str]]]:
+        """id(fn) -> jit invocation sites found by the NAME heuristics
+        (producer-bound names/attrs, solver-valued parameters). VT012
+        subtracts these lines so the two rules never double-report;
+        per-module results are cached on the context so the two rules
+        share one computation."""
+        cache = getattr(ctx, "_vt006_sites", None)
+        if cache is None:
+            cache = {}
+            ctx._vt006_sites = cache           # type: ignore[attr-defined]
+        hit = cache.get(mod.path)
+        if hit is not None:
+            return hit
         producers = self._jit_producers(ctx)
         module_jit_attrs = self._module_jit_attrs(mod, producers)
-        findings: List[Finding] = []
+        out: Dict[int, List[Tuple[ast.Call, str]]] = {}
         for fn in mod.functions:
-            # names/attrs bound from a jit factory or producer inside fn,
-            # plus solver-valued parameters (the batched engines thread
-            # the compiled callable through helpers by argument)
-            jit_vars: Set[str] = set(module_jit_attrs)
-            for arg in ast.walk(getattr(fn.node, "args", ast.arguments(
-                    posonlyargs=[], args=[], kwonlyargs=[], kw_defaults=[],
-                    defaults=[]))):
-                if isinstance(arg, ast.arg) and "solver" in arg.arg:
-                    jit_vars.add(arg.arg)
-            for node in ast.walk(fn.node):
-                if isinstance(node, ast.Assign) \
-                        and isinstance(node.value, ast.Call):
-                    src = node.value
-                    is_jit = self._is_jit_factory_call(mod, src) or (
-                        isinstance(src.func, ast.Name)
-                        and src.func.id in producers)
-                    if not is_jit:
-                        continue
-                    for tgt in node.targets:
-                        if isinstance(tgt, ast.Name):
-                            jit_vars.add(tgt.id)
-                        elif isinstance(tgt, ast.Attribute):
-                            jit_vars.add(tgt.attr)
-            invocations: List[Tuple[ast.Call, str]] = []
-            for node in ast.walk(fn.node):
-                if not isinstance(node, ast.Call):
+            sites = self._fn_sites(fn, producers, module_jit_attrs)
+            if sites:
+                out[id(fn)] = sites
+        cache[mod.path] = out
+        return out
+
+    def _fn_sites(self, fn: FunctionInfo, producers: Set[str],
+                  module_jit_attrs: Set[str]
+                  ) -> List[Tuple[ast.Call, str]]:
+        mod = fn.module
+        # names/attrs bound from a jit factory or producer inside fn,
+        # plus solver-valued parameters (the batched engines thread
+        # the compiled callable through helpers by argument)
+        jit_vars: Set[str] = set(module_jit_attrs)
+        for arg in ast.walk(getattr(fn.node, "args", ast.arguments(
+                posonlyargs=[], args=[], kwonlyargs=[], kw_defaults=[],
+                defaults=[]))):
+            if isinstance(arg, ast.arg) and "solver" in arg.arg:
+                jit_vars.add(arg.arg)
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                src = node.value
+                is_jit = self._is_jit_factory_call(mod, src) or (
+                    isinstance(src.func, ast.Name)
+                    and src.func.id in producers)
+                if not is_jit:
                     continue
-                # _job_solver()(...)  — calling a producer's return value
-                if isinstance(node.func, ast.Call) \
-                        and isinstance(node.func.func, ast.Name) \
-                        and node.func.func.id in producers:
-                    invocations.append((node, node.func.func.id + "()"))
-                # solver(...) where solver was bound from a producer/jit
-                elif isinstance(node.func, ast.Name) \
-                        and node.func.id in jit_vars:
-                    invocations.append((node, node.func.id))
-                elif isinstance(node.func, ast.Attribute) \
-                        and node.func.attr in jit_vars:
-                    invocations.append((node, node.func.attr))
-            if not invocations:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        jit_vars.add(tgt.id)
+                    elif isinstance(tgt, ast.Attribute):
+                        jit_vars.add(tgt.attr)
+        invocations: List[Tuple[ast.Call, str]] = []
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
                 continue
-            if self._has_bucket(fn):
-                continue
-            if any(self._has_bucket(o) for o in ctx.graph.one_hop(fn)):
+            # _job_solver()(...)  — calling a producer's return value
+            if isinstance(node.func, ast.Call) \
+                    and isinstance(node.func.func, ast.Name) \
+                    and node.func.func.id in producers:
+                invocations.append((node, node.func.func.id + "()"))
+            # solver(...) where solver was bound from a producer/jit
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in jit_vars:
+                invocations.append((node, node.func.id))
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in jit_vars:
+                invocations.append((node, node.func.attr))
+        return invocations
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn_id, invocations in self.syntactic_sites(mod, ctx).items():
+            fn = next(f for f in mod.functions if id(f) == fn_id)
+            if self.bucket_on_path(fn, ctx):
                 continue
             node, desc = invocations[0]
             findings.append(self.finding(
                 mod, node,
                 f"jitted solver invocation {desc}(...) in {fn.qualname} "
-                f"with no pow2 bucket/pad helper in the function or one "
-                f"hop; unbucketed shapes mint a fresh XLA compile per "
-                f"size (docs/performance.md)"))
+                f"with no pow2 bucket/pad helper anywhere on the "
+                f"reachable path (transitive callers/callees); unbucketed "
+                f"shapes mint a fresh XLA compile per size "
+                f"(docs/performance.md)"))
         return findings
 
 
@@ -887,11 +932,321 @@ class LockDisciplineRule(Rule):
         return False
 
 
+# ---------------------------------------------------------------------------
+# VT010–VT014 — the dataflow rules (PR 11, analysis/dataflow.py)
+# ---------------------------------------------------------------------------
+
+class HostSyncRule(Rule):
+    """Implicit host↔device synchronization on a device-tainted value —
+    ``np.*``, ``float``/``int``/``bool``/``len``, ``.item()``, iteration,
+    a branch test, ``jax.device_get``/``block_until_ready`` — outside an
+    allowlisted replay/readback span. Every such site serializes the
+    device stream against the host and is therefore a blocker for
+    overlapping cycle N+1's solve with cycle N's commit (ROADMAP item 2):
+    the findings ARE the async-overlap worklist, each reporting the sync
+    operation AND the producing expression.
+
+    Excusals (both MAY-biased, see dataflow.py's design note):
+    - the site runs under one of the sanctioned readback/commit spans
+      (lexically, or inherited through CallGraph.span_context) — those
+      phases exist to fetch;
+    - a structured READBACK_ALLOWLIST entry matches (path, symbol): the
+      deliberate one-fetch sites, each carrying its reason."""
+
+    id = "VT010"
+    name = "host-sync"
+    contract = ("implicit host sync on a device-tainted value outside an "
+                "allowlisted replay/readback span (PR 11 dataflow; the "
+                "async-overlap worklist of ROADMAP item 2)")
+    scope = ("volcano_tpu/actions/", "volcano_tpu/ops/",
+             "volcano_tpu/parallel/", "volcano_tpu/cache/",
+             "volcano_tpu/framework/")
+
+    # the sanctioned fetch/commit phases of the cycle trace (PR 5 spans):
+    # a sync under one of these is the scheduled readback, not a leak
+    ALLOWED_SPANS = {"solve", "replay", "upload", "bind_commit"}
+
+    # deliberate one-fetch / blocking sites outside any span, each with
+    # its reason — the structured allowlist the tentpole issue specifies.
+    # Match is on (path, enclosing symbol, sync kind) — the kind keeps an
+    # entry from silently covering a DIFFERENT sync that later appears in
+    # the same function. Keep entries FEW and justified.
+    READBACK_ALLOWLIST = (
+        {"path": "volcano_tpu/actions/allocate.py",
+         "symbol": "prewarm_shapes",
+         "kind": "jax.block_until_ready",
+         "reason": "startup prewarm must block until every warmed shape "
+                   "finishes compiling; it runs from Scheduler.prewarm, "
+                   "never inside a scheduling cycle"},
+        {"path": "volcano_tpu/actions/allocate.py",
+         "symbol": "_DeviceJobPlacer.place",
+         "kind": "np.asarray",
+         "reason": "tpu-strict-perjob IS the one-RTT-per-job decision-"
+                   "parity engine (r3): each job's placement must be "
+                   "fetched before the next pop. The batched tpu-strict "
+                   "engine supersedes it for throughput; the overlap "
+                   "work of ROADMAP item 2 targets the fused/strict "
+                   "engines, not this oracle"},
+    )
+
+    def classify(self, mod: ModuleInfo, fn: FunctionInfo, site,
+                 ctx: AnalysisContext) -> Tuple[str, str]:
+        """The ONE excusal ladder, shared by check() and the CLI's
+        --sync-inventory so the printed worklist can never drift from
+        what CI gates. Returns (status, detail):
+        ("span", names) | ("allowlist", reason) |
+        ("out-of-scope", "") | ("blocking", "")."""
+        line = getattr(site.node, "lineno", fn.node.lineno)
+        spans = enclosing_span_names(fn, line) | ctx.graph.span_context(fn)
+        excused = sorted(spans & self.ALLOWED_SPANS)
+        if excused:
+            return ("span", ",".join(excused))
+        for e in self.READBACK_ALLOWLIST:
+            if (e["path"], e["symbol"], e["kind"]) == \
+                    (mod.path, fn.qualname, site.kind):
+                return ("allowlist", e["reason"])
+        if not self.applies_to(mod.path):
+            return ("out-of-scope", "")
+        return ("blocking", "")
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        from .dataflow import get_dataflow
+        df = get_dataflow(ctx)
+        findings: List[Finding] = []
+        for fn in mod.functions:
+            for site in df.facts(fn).sync_sites:
+                status, _ = self.classify(mod, fn, site, ctx)
+                if status != "blocking":
+                    continue
+                findings.append(self.finding(
+                    mod, site.node,
+                    f"implicit host sync ({site.kind}) on a device value "
+                    f"produced by {site.producer} in {fn.qualname}, "
+                    f"outside an allowlisted replay/readback span "
+                    f"{sorted(self.ALLOWED_SPANS)}; this blocks "
+                    f"solve/commit overlap — move it into the fetch "
+                    f"phase, keep the value on device, or add a "
+                    f"justified READBACK_ALLOWLIST entry "
+                    f"(docs/static-analysis.md)"))
+        return findings
+
+
+class TracedBranchRule(Rule):
+    """Python ``if``/``while``/``assert`` on a traced value inside a
+    jit-entry function: under ``jax.jit`` the test either concretizes the
+    tracer (TracerBoolConversionError at best) or silently burns the
+    branch into the compiled program and retraces per value. Control flow
+    on traced data belongs in ``lax.cond``/``lax.while_loop``/
+    ``jnp.where``. ``is None``/``isinstance`` tests are static and
+    exempt, as are ``static_argnames`` parameters."""
+
+    id = "VT011"
+    name = "traced-branch"
+    contract = ("Python if/while/assert on a traced value inside a "
+                "jit-entry function — silent retrace/concretization "
+                "hazard (PR 11 dataflow)")
+    scope = ("volcano_tpu/actions/", "volcano_tpu/ops/",
+             "volcano_tpu/parallel/", "volcano_tpu/cache/")
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        from .dataflow import get_dataflow
+        df = get_dataflow(ctx)
+        findings: List[Finding] = []
+        for fn in mod.functions:
+            for node, producer in df.facts(fn).traced_tests:
+                findings.append(self.finding(
+                    mod, node,
+                    f"Python branch on a traced value ({producer}) inside "
+                    f"jit-entry {fn.qualname}; use lax.cond/lax.while_loop/"
+                    f"jnp.where — a concrete branch silently retraces per "
+                    f"value (docs/static-analysis.md)"))
+        return findings
+
+
+class DataflowShapeBucketRule(Rule):
+    """The dataflow half of the shape-bucketing contract: jit invocation
+    sites only the taint lattice can see — a compiled callable threaded
+    through an arbitrarily-named parameter, a cache dict, a return
+    value — still need a pow2 bucket/pad helper on the reachable path.
+    Sites VT006's name heuristics already report are skipped, so the two
+    rules partition the invocation set (VT006 keeps its id for baseline
+    continuity; both use the same transitive witness)."""
+
+    id = "VT012"
+    name = "shape-bucket-dataflow"
+    contract = ("dataflow-detected jit invocation with no pow2 bucket/pad "
+                "helper on the reachable path (PR 11; supersedes VT006's "
+                "one-hop heuristic)")
+    scope = ShapeBucketRule.scope
+
+    def __init__(self) -> None:
+        self._syntactic = ShapeBucketRule()
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        from .dataflow import get_dataflow
+        df = get_dataflow(ctx)
+        syntactic = self._syntactic.syntactic_sites(mod, ctx)
+        findings: List[Finding] = []
+        for fn in mod.functions:
+            known = {n.lineno for n, _ in syntactic.get(id(fn), [])}
+            for jc in df.facts(fn).jit_calls:
+                if jc.node.lineno in known:
+                    continue            # VT006's site; one rule reports
+                if self._syntactic.bucket_on_path(fn, ctx):
+                    continue
+                findings.append(self.finding(
+                    mod, jc.node,
+                    f"jitted callable {jc.desc}(...) invoked in "
+                    f"{fn.qualname} (dataflow-traced) with no pow2 "
+                    f"bucket/pad helper anywhere on the reachable path; "
+                    f"unbucketed shapes mint a fresh XLA compile per "
+                    f"size (docs/performance.md)"))
+        return findings
+
+
+class DtypeDisciplineRule(Rule):
+    """Weak-dtype operands feeding jitted solvers: a bare Python numeric
+    literal passed positionally, or an ``np.arange``/``np.zeros``-family
+    array built WITHOUT an explicit dtype, reaching a jit invocation.
+    Weak-typed operands re-key the compile cache when promotion changes
+    (a recompile per literal pattern) and silently truncate under the
+    x64-disabled default (int64→int32, float64→float32) — the solver
+    sees different numbers than the host accounting. Keyword literals
+    are exempt: they are the ``static_argnames`` convention."""
+
+    id = "VT013"
+    name = "dtype-discipline"
+    contract = ("bare literal / dtype-less np.arange/np.zeros-family "
+                "operand flowing into a jitted solver — weak-type "
+                "recompile and x64-truncation hazard (PR 11 dataflow)")
+    scope = ShapeBucketRule.scope
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        from .dataflow import get_dataflow
+        df = get_dataflow(ctx)
+        findings: List[Finding] = []
+        for fn in mod.functions:
+            for jc in df.facts(fn).jit_calls:
+                for arg_node, desc, producer in jc.weak_args:
+                    findings.append(self.finding(
+                        mod, arg_node,
+                        f"weak-dtype operand {desc} ({producer}) feeds "
+                        f"jitted call {jc.desc}(...) in {fn.qualname}; "
+                        f"pass an explicit dtype so the compile key and "
+                        f"the x64-disabled value range are pinned "
+                        f"(docs/static-analysis.md)"))
+        return findings
+
+
+class SessionEscapeRule(Rule):
+    """Session-lifetime escape: a session-scoped value (derived from an
+    open Session/snapshot) stored where it outlives ``close_session``/
+    ``abandon_session`` — a module global, a module-global container, or
+    an attribute of a long-lived class. Exactly the bug class PR 3's
+    ``_touched`` mutation witness catches dynamically (session pipeline
+    state leaking through reused snapshot clones), now caught statically.
+    Self-stores are only checked in the long-lived infrastructure
+    modules (scheduler/cache/controllers/...): per-cycle helper objects
+    in actions/ die with the session by construction, and classes whose
+    ``__init__`` takes the session (or that are per-session-rebuilt
+    plugins) are session-scoped themselves."""
+
+    id = "VT014"
+    name = "session-escape"
+    contract = ("session-scoped value stored on a module global or a "
+                "long-lived object — outlives close_session/"
+                "abandon_session (PR 11 dataflow; the PR 3 witness bug "
+                "class, statically)")
+    scope = ("volcano_tpu/scheduler.py", "volcano_tpu/actions/",
+             "volcano_tpu/cache/", "volcano_tpu/framework/",
+             "volcano_tpu/plugins/", "volcano_tpu/sim/",
+             "volcano_tpu/federation/", "volcano_tpu/controllers/")
+
+    # modules whose classes outlive scheduling sessions: a session-tainted
+    # self-store here escapes the session lifetime
+    LONG_LIVED = ("volcano_tpu/scheduler.py", "volcano_tpu/cache/",
+                  "volcano_tpu/controllers/", "volcano_tpu/federation/",
+                  "volcano_tpu/sim/")
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        from .dataflow import get_dataflow
+        df = get_dataflow(ctx)
+        long_lived = _in_scope(mod.path, self.LONG_LIVED)
+        findings: List[Finding] = []
+        for fn in mod.functions:
+            for node, target, producer in df.facts(fn).session_escapes:
+                if target.startswith("self.") and not long_lived:
+                    continue
+                findings.append(self.finding(
+                    mod, node,
+                    f"session-scoped value ({producer}) stored in "
+                    f"{target} by {fn.qualname}; it outlives "
+                    f"close_session/abandon_session — derive it per "
+                    f"cycle, or justify why the holder may keep it "
+                    f"(docs/static-analysis.md)"))
+        return findings
+
+
 ALL_RULES: List[Rule] = [
     DirtyWitnessRule(), RawClockRule(), UnseededRandomRule(),
     JournalFunnelRule(), SimKillSwallowRule(), ShapeBucketRule(),
     LockDisciplineRule(), FencingEpochRule(), CrossPartitionFunnelRule(),
+    HostSyncRule(), TracedBranchRule(), DataflowShapeBucketRule(),
+    DtypeDisciplineRule(), SessionEscapeRule(),
 ]
+
+# the rules that run on the shared dataflow engine (vlint --dataflow)
+DATAFLOW_RULE_IDS = ("VT006", "VT010", "VT011", "VT012", "VT013", "VT014")
+
+# minimal trigger snippets, printed by ``vlint --explain VTxxx`` next to
+# the rule's contract while burning down findings
+_EXAMPLES = {
+    "VT001": '''class SchedulerCache:
+    def sneak(self, task):                 # no mark_*_dirty / _touched
+        job = self.jobs[task.job]
+        job.update_task_status(job.tasks[task.uid], "Releasing")''',
+    "VT002": '''import time
+def decide(job):
+    return time.time() - job.creation_timestamp   # inject ssn.now()''',
+    "VT003": '''import random
+def pick(nodes):
+    return random.choice(nodes)            # inject random.Random(seed)''',
+    "VT004": '''def rogue(cache, task):
+    cache.binder.bind(task, task.node_name)   # no _journal_intent''',
+    "VT005": '''try:
+    action()
+except BaseException:                      # swallows SimKill
+    pass''',
+    "VT006": '''solver = _job_solver()
+solver(state, tasks)                       # no _bucket()/pad on the path''',
+    "VT007": '''class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+    def record(self, ev):
+        self.events.append(ev)             # write outside self._lock''',
+    "VT008": '''def bind(self, task):
+    seq = self._journal_intent("bind", task)   # intent never reads
+    self.binder.bind(task, task.node_name)     # fencing_epoch()''',
+    "VT009": '''def hand_over(pmap, node):
+    pmap._transfer_node_raw(node, 2)       # no _journal_reserve record''',
+    "VT010": '''packed = solver(state, tasks)          # device value
+n = int(packed[0])                     # implicit fetch OUTSIDE any
+                                       # solve/replay/upload span''',
+    "VT011": '''def kernel(x):                         # jax.jit(kernel)
+    if x > 0:                          # traced value in a Python branch
+        return x''',
+    "VT012": '''def run(f, xs):                        # f not named *solver*
+    return f(xs)                       # ...but dataflow sees jax.jit
+run(jax.jit(lambda x: x), xs)          # flows in; no bucket on path''',
+    "VT013": '''idx = np.arange(n)                     # no dtype: weak int
+solver(state, idx)                     # truncates under x64-disabled''',
+    "VT014": '''class SchedulerCache:
+    def remember(self, ssn):
+        self._last_nodes = ssn.nodes   # outlives close_session''',
+}
+for _rule in ALL_RULES:
+    _rule.example = _EXAMPLES.get(_rule.id, "")
 
 
 def rule_by_id(rule_id: str) -> Optional[Rule]:

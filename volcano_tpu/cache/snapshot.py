@@ -36,8 +36,15 @@ class NodeTensors:
     """Dense node-state arrays, index-aligned with ``names`` order."""
 
     def __init__(self, nodes: Sequence[NodeInfo], rnames: ResourceNames):
+        # NodeTensors is built per solve from the open session and dropped
+        # with it; only PersistentNodeTensors (below) outlives cycles, and
+        # it stores value copies guarded by the session epoch + _touched
+        # witness — hence the VT014 waivers:
+        # vlint: disable=VT014 -- per-solve object, dies with the session
         self.rnames = rnames
+        # vlint: disable=VT014 -- per-solve object, dies with the session
         self.names: List[str] = [n.name for n in nodes]
+        # vlint: disable=VT014 -- per-solve object, dies with the session
         self.index: Dict[str, int] = {n: i for i, n in enumerate(self.names)}
         N, R = len(nodes), len(rnames)
         self.idle = np.zeros((N, R), np.float32)
