@@ -342,6 +342,162 @@ def _churn_step(cache, cyc: int, churn_jobs: int, arrival_seed: int) -> None:
         cache.add_job(j)
 
 
+def run_pipelined_churn(n_cycles: int = 8, churn_jobs: int = 5,
+                        seed: int = 0, period: float = 1.0):
+    """Pipelined steady-state churn (docs/performance.md pipelining): the
+    10k/2k world carries a STANDING 10k-task backlog (a second wave the
+    packed cluster cannot place), so every cycle has pending work to
+    speculate over; ``churn_jobs`` fresh gangs arrive between cycles (the
+    partial-hit path — arrivals are what a speculation cannot know). The
+    shell runs with ``pipelined=True`` and the loop paces like
+    ``Scheduler.run``: each cycle's in-cycle time is measured, then the
+    period's remainder is slept so the dispatched speculative solve
+    finishes in the idle window exactly as production overlap would.
+
+    Returns a dict: cycle_ms (per measured cycle), p50/p99, the absorb
+    cycle's time (cycle 0 binds the first 10k serially), speculation
+    outcome deltas, and a fast-admit time-to-first-bind demonstration
+    (ttfb_p99_cycles) measured OUTSIDE the steady loop — a fast-admit
+    bind dirties the cache and would conflict the in-flight speculation,
+    so the two fast paths are benchmarked separately on purpose."""
+    from volcano_tpu import metrics as vmetrics
+    from volcano_tpu.api import NodeInfo, Resource, TaskStatus
+    from volcano_tpu.cache.synthetic import make_jobs
+    from volcano_tpu.scheduler import Scheduler
+    import volcano_tpu.plugins  # noqa: F401
+    import volcano_tpu.actions  # noqa: F401
+
+    conf_text = (
+        'actions: "allocate-tpu"\n'
+        "tiers:\n"
+        "- plugins:\n"
+        "  - name: priority\n"
+        "  - name: gang\n"
+        "- plugins:\n"
+        "  - name: drf\n"
+        "  - name: predicates\n"
+        "  - name: proportion\n"
+        "  - name: nodeorder\n"
+        'configurations:\n'
+        "- name: allocate-tpu\n"
+        "  arguments:\n"
+        "    engine: tpu-fused\n")
+
+    from volcano_tpu.api import QueueInfo
+    from volcano_tpu.cache import FakeBinder, SchedulerCache
+    from volcano_tpu.cache.synthetic import make_cluster
+
+    # a 900-node cluster under a 20k-task wave: ~13k tasks pack it, the
+    # rest is the STANDING backlog every steady cycle speculates over —
+    # saturation is the pipeline's home turf (an unsaturated cluster
+    # drains its queue within the cycle and leaves nothing to overlap)
+    binder = FakeBinder()
+    cache = SchedulerCache(binder=binder)
+    for q in (QueueInfo(name="q1", weight=3),
+              QueueInfo(name="q2", weight=2),
+              QueueInfo(name="q3", weight=1)):
+        cache.add_queue(q)
+    for n in make_cluster(900, seed=seed):
+        cache.add_node(n)
+    for j in make_jobs(20000, 400, ["q1", "q2", "q3"], seed=seed):
+        cache.add_job(j)
+    sched = Scheduler(cache, conf_text=conf_text, pipelined=True,
+                      fast_admit=False)
+
+    def shape():
+        pend = jobs = 0
+        for j in cache.jobs.values():
+            n = sum(1 for t in j.task_status_index.get(TaskStatus.PENDING,
+                                                       {}).values()
+                    if not t.resreq.is_empty())
+            if n:
+                pend += n
+                jobs += 1
+        return pend, jobs
+
+    pend_all, jobs_all = shape()
+    # shapes of this rig: the 20k absorb cycle, the standing-backlog
+    # buckets on either side of the arrival growth (8192 and 16384), the
+    # suffix solve of one arrival batch — plus the epoch pair
+    # (Scheduler.prewarm warms it when pipelined: the
+    # first-pipelined-cycle outlier fix)
+    # steady-state J sits far below jobs_all (only backlog gangs stay
+    # pending) and drifts up as arrivals join the backlog: warm BOTH
+    # job-axis buckets (128 and 256) on both task buckets the loop
+    # straddles (8192 and 16384)
+    sched.prewarm([(pend_all, jobs_all), (8000, 100), (8000, 200),
+                   (10000, 100), (10000, 200),
+                   (churn_jobs * 50, churn_jobs)])
+    spec_before = dict(vmetrics.speculation_counts())
+    t0 = time.perf_counter()
+    errs = sched.run_once()               # absorb: the first 10k bind
+    absorb_s = time.perf_counter() - t0
+    assert not errs, f"pipelined absorb cycle had faults: {errs}"
+    times = []
+    outcomes = []
+    last_s = absorb_s
+    for cyc in range(n_cycles):
+        # inter-cycle arrivals joining the backlog (the speculation's
+        # suffix), then the pacing sleep the dispatched solve overlaps
+        fresh = make_jobs(churn_jobs * 50, churn_jobs,
+                          ["q1", "q2", "q3"], seed=seed + 3000 + cyc,
+                          name_prefix=f"pchurn{cyc}-")
+        for j in fresh:
+            cache.add_job(j)
+        time.sleep(max(period - last_s, 0.0))
+        t0 = time.perf_counter()
+        errs = sched.run_once()
+        last_s = time.perf_counter() - t0
+        times.append(last_s)
+        outcomes.append(sched.last_speculation.get("outcome"))
+        assert not errs, f"pipelined churn cycle {cyc} had faults: {errs}"
+        _assert_no_fallback(f"pipelined churn cycle {cyc}")
+    spec_after = vmetrics.speculation_counts()
+    spec = {k: int(spec_after.get(k, 0) - spec_before.get(k, 0))
+            for k in set(spec_after) | set(spec_before)}
+    committed = spec.get("hit", 0) + spec.get("partial", 0)
+    total = committed + spec.get("conflict", 0)
+
+    # fast-admit ttfb demonstration: a dedicated spare node + small gangs
+    # arriving between cycles; fast_admit binds them through the
+    # journaled funnel in a fraction of the period
+    spare_alloc = Resource(256000, 1024 * (1 << 30))
+    spare_alloc.max_task_num = 500
+    cache.add_node(NodeInfo(name="fa-spare", allocatable=spare_alloc))
+    sched.fast_admit_enabled = True
+    cache.fast_admit_feed = True
+    fa_before = dict(vmetrics.fast_admit_counts())
+    ttfb = []
+    for k in range(16):
+        gang = make_jobs(2, 1, ["q1"], cpu_range=(500, 600),
+                         mem_range=(1 << 30, (1 << 30) + 1),
+                         seed=seed + 9000 + k, name_prefix=f"fa{k}-")
+        t_arr = time.perf_counter()
+        for j in gang:
+            cache.add_job(j)
+        bound = sched.fast_admit()
+        assert bound == sum(len(j.tasks) for j in gang), (
+            f"fast-admit failed to bind the trivially-fitting gang "
+            f"({bound} tasks bound)")
+        ttfb.append((time.perf_counter() - t_arr) / period)
+    fa_after = vmetrics.fast_admit_counts()
+    ttfb.sort()
+    return {
+        "cycle_ms": [round(t * 1e3, 1) for t in times],
+        "cycle_p50_ms": round(sorted(times)[len(times) // 2] * 1e3, 1),
+        "cycle_p99_ms": round(sorted(times)[-1] * 1e3, 1),
+        "absorb_ms": round(absorb_s * 1e3, 1),
+        "outcomes": outcomes,
+        "speculation": spec,
+        "speculation_hit_rate": round(committed / total, 4) if total
+        else 0.0,
+        "ttfb_p99_cycles": round(ttfb[-1], 4),
+        "fast_admit": {k: int(fa_after.get(k, 0) - fa_before.get(k, 0))
+                       for k in ("gangs", "binds")},
+        "binds": len(binder.binds),
+    }
+
+
 PIPELINE_CONF = (
     'actions: "enqueue, allocate-tpu, preempt, reclaim, backfill"\n'
     "tiers:\n"
@@ -687,6 +843,38 @@ def main():
                   churn_prewarm_ms=round(churn_prewarm_s * 1e3, 1),
                   churn_prewarm_compiles=churn_prewarm_c,
                   churn_steady_ok=all(c == 0 for c in churn_compiles))
+
+    # pipelined scheduling cycle (docs/performance.md, ROADMAP item 2):
+    # a saturated 20k-wave/900-node world with a standing backlog and
+    # arrival churn, run through the PIPELINED shell — the speculative
+    # solve is dispatched at cycle N's tail and awaited at N+1's commit,
+    # so the steady cycle pays conflict-check + fetch + replay + suffix
+    # instead of the full solve. The serial headline cycle_e2e_ms is the
+    # comparison column; the canary asserts the pipelined steady p50
+    # BEATS it (the whole point of the refactor).
+    pc = run_pipelined_churn(8, 5)
+    assert pc["cycle_p50_ms"] < extras["cycle_e2e_ms"], (
+        f"pipelined steady cycle p50 {pc['cycle_p50_ms']}ms did not beat "
+        f"the serial cycle_e2e_ms {extras['cycle_e2e_ms']}ms — the "
+        f"solve/commit overlap is not engaging "
+        f"(outcomes {pc['outcomes']}, speculation {pc['speculation']})")
+    assert pc["speculation_hit_rate"] > 0.5, (
+        f"pipelined churn speculation hit rate {pc['speculation_hit_rate']}"
+        f" — speculation is being discarded in the steady state: "
+        f"{pc['speculation']}")
+    assert pc["ttfb_p99_cycles"] < 1.0, (
+        f"fast-admit ttfb p99 {pc['ttfb_p99_cycles']} cycles — the "
+        f"event-driven path is not binding between cycles")
+    extras.update(pipelined_cycle_ms=pc["cycle_ms"],
+                  pipelined_cycle_p50_ms=pc["cycle_p50_ms"],
+                  pipelined_cycle_p99_ms=pc["cycle_p99_ms"],
+                  pipelined_absorb_ms=pc["absorb_ms"],
+                  speculation=pc["speculation"],
+                  speculation_hit_rate=pc["speculation_hit_rate"],
+                  ttfb_p99_cycles=pc["ttfb_p99_cycles"],
+                  fast_admit=pc["fast_admit"],
+                  pipelined_beats_serial_ok=pc["cycle_p50_ms"]
+                  < extras["cycle_e2e_ms"])
 
     # long-axis scale (VERDICT r5 #5): 20k pods / 5k nodes, fused +
     # sharded engines (binds reported per engine — capacity is a full

@@ -19,15 +19,17 @@ run_obs=true
 run_lint=true
 run_ha=true
 run_federated=true
+run_pipelined=true
 case "${1:-}" in
-  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false ;;
-  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false ;;
-  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false ;;
-  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false ;;
-  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false ;;
-  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false ;;
-  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false ;;
-  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false ;;
+  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ;;
+  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ;;
+  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ;;
+  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ;;
+  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ;;
+  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false; run_pipelined=false ;;
+  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false; run_pipelined=false ;;
+  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_pipelined=false ;;
+  --pipelined-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false ;;
 esac
 
 if $run_lint; then
@@ -72,11 +74,23 @@ if $run_lint; then
   # membership: if a future change dropped a dataflow rule from the
   # default set, the full-tree gate above would pass silently and THIS
   # step would still enforce it (cheap post-memoization: ~4s)
-  echo "== lint: vlint --dataflow (VT006/VT010-VT014 hard gate) =="
+  echo "== lint: vlint --dataflow (VT006/VT010-VT015 hard gate) =="
   python -m volcano_tpu.analysis volcano_tpu/ --dataflow \
     || { echo "lint FAILED: dataflow findings above — every host-sync/"\
-"traced-branch/bucket/dtype/session-escape finding must be fixed or "\
-"carry a written justification (docs/static-analysis.md)"; exit 1; }
+"traced-branch/bucket/dtype/session-escape/speculation-isolation "\
+"finding must be fixed or carry a written justification "\
+"(docs/static-analysis.md)"; exit 1; }
+  # the async-overlap burn-down ratchet (ROADMAP item 2, PR 12): the
+  # host-sync inventory shrank to 7 sites (allowlist 2 -> 1; the
+  # _DeviceJobPlacer fetch moved under the solve span, the serial and
+  # speculative fused fetches share ONE _fetch_packed site). A new sync
+  # site must raise this budget with a written justification, not slide
+  # in silently.
+  echo "== lint: vlint --sync-inventory --sync-budget 7 =="
+  python -m volcano_tpu.analysis volcano_tpu/ --sync-inventory \
+    --sync-budget 7 \
+    || { echo "lint FAILED: host-sync inventory grew past the budget"; \
+         exit 1; }
   echo "== lint: SARIF 2.1.0 validity =="
   python - "$lintdir/vlint.sarif" <<'EOF'
 import json, sys
@@ -271,6 +285,62 @@ assert r["federation"]["node_transfers"] > 0
 EOF
   echo "   federated-soak: zero double-binds, byte-deterministic x2, \
 oracle-equal, reserves exercised"
+fi
+
+if $run_pipelined; then
+  # pipelined-soak (docs/performance.md pipelining): the pipelined shell
+  # over the two pipelined scenarios. (a) pipelined-steady must be
+  # decision-plane BYTE-IDENTICAL to the serial oracle
+  # (--verify-pipelined-equivalence runs both and diffs the oracle
+  # part), (b) the conflict-heavy world must stay terminal-equivalent
+  # with zero double-binds — including with fast-admit binding gangs
+  # between cycles and seeded kills landing mid-speculation (the
+  # "speculate" kill mode: a crash between dispatch and commit must lose
+  # only speculative state), and (c) both pipelined runs must be
+  # byte-deterministic x2.
+  echo "== pipelined-soak: sim --pipelined, speculation + fast-admit =="
+  pipedir=$(mktemp -d)
+  trap 'rm -rf "${simdir:-/nonexistent}" "${soakdir:-/nonexistent}" \
+"${obsdir:-/nonexistent}" "${hadir:-/nonexistent}" \
+"${feddir:-/nonexistent}" "${pipedir:-/nonexistent}"' EXIT
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario pipelined-steady \
+    --seed 3 --pipelined --verify-pipelined-equivalence --deterministic \
+    > "$pipedir/steady.a.json" \
+    || { echo "pipelined-soak FAILED: pipelined-steady not equivalent to \
+the serial oracle"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario pipelined-steady \
+    --seed 3 --pipelined --deterministic > "$pipedir/steady.b.json"
+  diff "$pipedir/steady.a.json" "$pipedir/steady.b.json" \
+    || { echo "pipelined-soak FAILED: pipelined-steady not \
+byte-deterministic"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim \
+    --scenario pipelined-conflict --seed 3 --pipelined --fast-admit \
+    --kill-cycles 2,5,9,13 --kill-seed 1 --verify-pipelined-equivalence \
+    --deterministic > "$pipedir/conflict.a.json" \
+    || { echo "pipelined-soak FAILED: conflict-heavy killed run diverged \
+or double-bound"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim \
+    --scenario pipelined-conflict --seed 3 --pipelined --fast-admit \
+    --kill-cycles 2,5,9,13 --kill-seed 1 --deterministic \
+    > "$pipedir/conflict.b.json"
+  diff "$pipedir/conflict.a.json" "$pipedir/conflict.b.json" \
+    || { echo "pipelined-soak FAILED: conflict-heavy killed run not \
+byte-deterministic"; exit 1; }
+  python - "$pipedir/steady.a.json" "$pipedir/conflict.a.json" <<'EOF'
+import json, sys
+steady = json.load(open(sys.argv[1]))
+conflict = json.load(open(sys.argv[2]))
+s = steady["speculation"]
+assert s["hits"] + s["partial"] > 0, f"steady run never speculated: {s}"
+assert steady["double_binds"] == 0 and conflict["double_binds"] == 0
+assert conflict["fast_admit"]["gangs"] > 0, \
+    f"conflict run fast-admitted nothing: {conflict['fast_admit']}"
+assert conflict["restarts"] > 0, "kills armed but nothing restarted"
+print("   pipelined-soak: speculation %s, fast_admit %s, restarts %d, "
+      "zero double-binds" % (s, conflict["fast_admit"],
+                             conflict["restarts"]))
+EOF
+  echo "   pipelined-soak: oracle-equal, byte-deterministic x2"
 fi
 
 if $run_shim; then

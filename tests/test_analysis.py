@@ -1646,3 +1646,94 @@ def test_dataflow_fixpoint_converges_on_tree():
     from volcano_tpu.analysis.dataflow import get_dataflow
     _, _, ctx = analyze_paths([os.path.join(REPO, "volcano_tpu")])
     assert get_dataflow(ctx).converged
+
+
+# ---------------------------------------------------------------------------
+# 9. VT015 speculation-isolation (PR 12)
+# ---------------------------------------------------------------------------
+
+VT015_TRIGGER = '''
+def _dispatch_speculation(self, rec, runnable):
+    sssn = open_session(self.cache, speculative=True)
+    self.cache.bind_batch([])          # journaled write BEFORE the commit
+    return sssn
+'''
+
+VT015_CLEAN = '''
+def _dispatch_speculation(self, rec, runnable):
+    sssn = open_session(self.cache, speculative=True)
+    pending = order_and_dispatch(sssn)
+    return pending
+
+def _commit_speculation(self, ssn, plan):
+    # the sanctioned commit funnel: runs AFTER the conflict check
+    ssn.cache.bind_batch(plan.binds)
+'''
+
+
+def test_vt015_trigger_and_clean():
+    f, _ = findings_of({"volcano_tpu/scheduler.py": VT015_TRIGGER})
+    assert "VT015" in rule_ids(f)
+    (x,) = [x for x in f if x.rule == "VT015"]
+    assert "bind_batch" in x.message and "speculative" in x.message
+    f, _ = findings_of({"volcano_tpu/scheduler.py": VT015_CLEAN})
+    assert "VT015" not in rule_ids(f)
+
+
+def test_vt015_reaches_through_unambiguous_callees():
+    src = '''
+def dispatch_speculative_solve(ssn):
+    helper(ssn)
+
+def helper(ssn):
+    ssn.dispatch(task)                 # side effect on the spec path
+'''
+    f, _ = findings_of({"volcano_tpu/actions/allocate.py": src})
+    assert "VT015" in rule_ids(f)
+    # ambiguous names do not smear: two defs of `helper` -> no edge
+    f, _ = findings_of({"volcano_tpu/actions/allocate.py": src,
+                        "volcano_tpu/actions/other.py":
+                            "def helper(x):\n    return x\n"})
+    assert "VT015" not in rule_ids(f)
+
+
+def test_vt015_rebroken_commit_gate_drop():
+    """Re-broken regression: the REAL shell with the commit gate dropped
+    — a journaled side effect issued straight from the speculative
+    dispatch path — must produce a VT015 finding; the unmutated sources
+    must not."""
+    paths = ("volcano_tpu/scheduler.py",
+             "volcano_tpu/actions/allocate.py",
+             "volcano_tpu/framework/framework.py",
+             "volcano_tpu/cache/cache.py")
+    srcs = {p: real_source(p) for p in paths}
+    f, _ = findings_of(srcs)
+    assert "VT015" not in rule_ids(f)
+    broken = dict(srcs)
+    broken["volcano_tpu/scheduler.py"] = mutate(
+        srcs["volcano_tpu/scheduler.py"],
+        "self._spec = _Speculation(sssn, pending, engine)",
+        "self.cache.bind_batch([])\n"
+        "                self._spec = _Speculation(sssn, pending, engine)")
+    f, _ = findings_of(broken)
+    assert "VT015" in rule_ids(f)
+
+
+def test_cli_sync_budget_ratchet():
+    proc = _vlint(os.path.join(REPO, "volcano_tpu"),
+                  "--sync-inventory", "--sync-budget", "99")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = _vlint(os.path.join(REPO, "volcano_tpu"),
+                  "--sync-inventory", "--sync-budget", "0")
+    assert proc.returncode == 1
+    assert "exceed the --sync-budget" in proc.stdout
+
+
+def test_readback_allowlist_burned_down_to_prewarm_only():
+    """PR 12's burn-down contract: the structured VT010 allowlist holds
+    exactly the startup-prewarm block (the one legitimately-blocking
+    fetch left); everything else must live under sanctioned spans."""
+    from volcano_tpu.analysis.rules import HostSyncRule
+    entries = HostSyncRule.READBACK_ALLOWLIST
+    assert len(entries) == 1
+    assert entries[0]["symbol"] == "prewarm_shapes"

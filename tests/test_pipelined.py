@@ -1,0 +1,351 @@
+"""Pipelined scheduling cycle (docs/performance.md pipelining): the
+epoch-pair protocol, the staged speculative snapshot, the conflict check
+at the commit boundary, decision-plane equivalence with the serial shell,
+the event-driven fast-admit path, and the crash window between
+speculative dispatch and commit (nothing journaled, zero double-binds).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from volcano_tpu import metrics
+from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase,
+                             QueueInfo, Resource, ResourceNames, TaskInfo,
+                             TaskStatus)
+from volcano_tpu.cache.cache import SchedulerCache
+from volcano_tpu.cache.journal import IntentJournal
+from volcano_tpu.cache.snapshot import PersistentNodeTensors
+from volcano_tpu.chaos import SimKill
+from volcano_tpu.scheduler import Scheduler
+
+GI = 1 << 30
+
+CONF = """
+actions: "enqueue, allocate-tpu, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def mkjob(uid: str, ts: float, cpu: int = 1000, tasks: int = 2,
+          queue: str = "q1", **task_kw) -> JobInfo:
+    pg = PodGroup(name=uid, queue=queue, min_member=tasks,
+                  phase=PodGroupPhase.PENDING)
+    job = JobInfo(uid=uid, name=uid, queue=queue, min_available=tasks,
+                  podgroup=pg, creation_timestamp=ts)
+    for t in range(tasks):
+        job.add_task_info(TaskInfo(
+            uid=f"{uid}-{t}", name=f"{uid}-{t}", job=uid,
+            resreq=Resource(cpu, GI), creation_timestamp=ts + t * 1e-6,
+            **task_kw))
+    return job
+
+
+def build_cache(n_nodes: int = 4, node_cpu: int = 2000, n_jobs: int = 30,
+                cpu: int = 1000, journal: IntentJournal = None
+                ) -> SchedulerCache:
+    cache = SchedulerCache(default_queue=None, journal=journal)
+    cache.add_queue(QueueInfo(name="q1", weight=1))
+    for i in range(n_nodes):
+        alloc = Resource(node_cpu, 64 * GI)
+        alloc.max_task_num = 100
+        cache.add_node(NodeInfo(name=f"n{i}", allocatable=alloc))
+    for j in range(n_jobs):
+        cache.add_job(mkjob(f"j{j}", float(j), cpu))
+    return cache
+
+
+def state_plane(cache) -> list:
+    """The per-cycle decision plane the serial/pipelined comparison
+    diffs: every task's (uid, node, status)."""
+    return sorted((t.uid, t.node_name, str(t.status))
+                  for j in cache.jobs.values() for t in j.tasks.values())
+
+
+def drive(pipelined: bool, mutate=None, cycles: int = 10, **build_kw):
+    cache = build_cache(**build_kw)
+    sched = Scheduler(cache, conf_text=CONF, pipelined=pipelined)
+    planes, outcomes = [], []
+    for cyc in range(cycles):
+        errs = sched.run_once()
+        assert not errs, errs
+        outcomes.append(sched.last_speculation.get("outcome"))
+        planes.append(state_plane(cache))
+        if mutate is not None:
+            mutate(cache, cyc)
+    return planes, outcomes
+
+
+# ---------------------------------------------------------------------------
+# epoch pair (PersistentNodeTensors pin/retire)
+# ---------------------------------------------------------------------------
+
+def test_epoch_pair_pin_survives_scatter():
+    rnames = ResourceNames(["cpu", "memory"])
+    alloc = Resource(4000, 8 * GI)
+    alloc.max_task_num = 10
+    nodes = {f"n{i}": NodeInfo(name=f"n{i}", allocatable=alloc.clone()
+                               if i else alloc)
+             for i in range(3)}
+    tc = PersistentNodeTensors(rnames)
+    tc.full_build(nodes)
+    view = tc.pin_epoch()
+    assert tc.live_pins == 1
+    pinned_idle = np.asarray(view._device["idle"]).copy()
+    # mutate one node and scatter: the PUBLISH must leave the pinned
+    # epoch's arrays untouched (functional update = the B buffer)
+    nodes["n1"].idle.sub(Resource(1000, GI))
+    epoch_before = tc.epoch
+    tc.refresh(nodes, {"n1"})
+    assert tc.epoch > epoch_before
+    assert np.array_equal(np.asarray(view._device["idle"]), pinned_idle)
+    assert not np.array_equal(np.asarray(tc._device["idle"]), pinned_idle)
+    # host copies in the view are value-frozen too
+    assert view.idle[tc.index["n1"]][0] == pinned_idle[tc.index["n1"]][0]
+    tc.retire_epoch(view)
+    tc.retire_epoch(view)                      # idempotent
+    assert tc.live_pins == 0
+
+
+def test_epoch_pair_prewarm_is_cheap_noop_when_empty():
+    tc = PersistentNodeTensors(ResourceNames(["cpu", "memory"]))
+    tc.prewarm_epoch_pair()                    # no nodes: no-op, no raise
+    assert tc.live_pins == 0
+
+
+# ---------------------------------------------------------------------------
+# staged speculative snapshot
+# ---------------------------------------------------------------------------
+
+def test_speculative_snapshot_stages_without_consuming():
+    cache = build_cache(n_jobs=3)
+    cache.snapshot()                           # settle the initial build
+    cache.add_job(mkjob("late", 99.0))
+    dirty_before = set(cache._dirty_jobs)
+    epoch_before = cache._snap_epoch
+    ci, staged = cache.speculative_snapshot()
+    # nothing consumed: epoch unchanged, the dirt MOVED into the basis
+    assert cache._snap_epoch == epoch_before
+    assert staged["dirty_jobs"] == frozenset(dirty_before)
+    assert not cache._dirty_jobs
+    assert "late" in ci.jobs
+    # clean window -> adopt succeeds and installs the staged bookkeeping
+    assert cache.adopt_speculative_snapshot(staged)
+    assert cache._snap_epoch == epoch_before + 1
+    assert ci.snap_epoch == cache._snap_epoch
+    assert cache._snap_jobs["late"] is ci.jobs["late"]
+
+
+def test_speculation_delta_sees_remutation_of_stage_dirty_key():
+    """The churn hole the move-semantics exists for: a key that was
+    ALREADY dirty at stage time mutates again post-stage — the delta
+    must see it (a plain set-difference would not)."""
+    cache = build_cache(n_jobs=3)
+    cache.snapshot()
+    cache.add_job(mkjob("late", 99.0))         # dirty at stage time
+    ci, staged = cache.speculative_snapshot()
+    cache.mark_job_dirty("late")               # re-mutated post-stage
+    delta = cache.speculation_delta(staged)
+    assert "late" in delta["jobs"]
+    assert not cache.adopt_speculative_snapshot(staged)
+    # discard restores the moved dirt so the next real snapshot re-clones
+    cache.discard_speculative_snapshot(staged)
+    assert "late" in cache._dirty_jobs
+
+
+def test_real_snapshot_reabsorbs_orphaned_speculation_dirt():
+    """A real snapshot taken while a speculation is in flight (or after a
+    crash dropped it) must merge the moved dirt back before building —
+    never reuse a stale clone."""
+    cache = build_cache(n_jobs=2)
+    cache.snapshot()
+    cache.add_job(mkjob("late", 99.0))
+    _, staged = cache.speculative_snapshot()
+    ci = cache.snapshot()                      # reabsorbs; sees "late"
+    assert "late" in ci.jobs
+    assert cache._spec_dirt is None
+    # the orphaned basis can no longer adopt or restore anything
+    assert not cache.adopt_speculative_snapshot(staged)
+    cache.discard_speculative_snapshot(staged)  # no-op, no corruption
+    assert not cache._dirty_jobs
+
+
+# ---------------------------------------------------------------------------
+# pipelined shell: equivalence with the serial decision plane
+# ---------------------------------------------------------------------------
+
+def test_pipelined_hits_match_serial_on_standing_backlog():
+    sp, _ = drive(False)
+    pp, outcomes = drive(True)
+    assert sp == pp
+    # a saturated standing backlog is the pure-hit world
+    assert outcomes[1:] == ["hit"] * (len(outcomes) - 1)
+
+
+def test_pipelined_partial_matches_serial_under_acks_and_arrivals():
+    def mut(cache, cyc):
+        for job in cache.jobs.values():
+            for t in list(job.tasks.values()):
+                if t.status == TaskStatus.BOUND:
+                    cache.update_task_status(t, TaskStatus.RUNNING)
+        cache.add_job(mkjob(f"late{cyc}", 1000.0 + cyc, cpu=500))
+
+    sp, _ = drive(False, mutate=mut)
+    pp, outcomes = drive(True, mutate=mut)
+    assert sp == pp
+    assert "partial" in outcomes
+    assert "conflict" not in outcomes
+
+
+def test_pipelined_conflicts_match_serial_under_completions():
+    def mut(cache, cyc):
+        done = [j for j in cache.jobs.values()
+                if j.ready_task_num() >= j.min_available][:2]
+        for job in done:
+            for task in list(job.tasks.values()):
+                cache.delete_task(task)
+            cache.remove_job(job.uid)
+
+    sp, _ = drive(False, mutate=mut)
+    pp, outcomes = drive(True, mutate=mut)
+    assert sp == pp
+    # completions free capacity: the speculation must NOT survive them
+    assert "conflict" in outcomes
+    assert "hit" not in outcomes[1:]
+
+
+def test_speculation_counters_move():
+    before = dict(metrics.speculation_counts())
+    drive(True, cycles=4)
+    after = metrics.speculation_counts()
+    assert after.get("hit", 0) > before.get("hit", 0)
+
+
+# ---------------------------------------------------------------------------
+# fast admit
+# ---------------------------------------------------------------------------
+
+def test_fast_admit_binds_through_the_journaled_funnel():
+    journal = IntentJournal()
+    cache = build_cache(n_nodes=2, node_cpu=4000, n_jobs=0,
+                        journal=journal)
+    sched = Scheduler(cache, conf_text=CONF, fast_admit=True)
+    records = []
+    journal.subscribe(records.append)
+    cache.add_job(mkjob("fa0", 0.0, cpu=500))
+    n = sched.fast_admit()
+    assert n == 2
+    job = cache.jobs["fa0"]
+    assert all(t.status == TaskStatus.BOUND for t in job.tasks.values())
+    # the unconditional enqueue path ran (min_resources is None)
+    assert job.podgroup.phase == PodGroupPhase.INQUEUE
+    binds = [r for r in records if r.get("kind") == "intent"
+             and r.get("op") == "bind"]
+    assert len(binds) == 2                     # journaled, then acked
+    assert not journal.unacked()
+    # the next full cycle must not double-place the fast-admitted gang
+    errs = sched.run_once()
+    assert not errs
+    assert sum(1 for t in job.tasks.values()
+               if t.status == TaskStatus.BOUND) == 2
+
+
+def test_fast_admit_declines_anything_not_provably_trivial():
+    cache = build_cache(n_nodes=1, node_cpu=4000, n_jobs=0)
+    sched = Scheduler(cache, conf_text=CONF, fast_admit=True)
+    # placement constraint -> not trivial
+    cache.add_job(mkjob("sel", 0.0, cpu=500,
+                        node_selector={"zone": "a"}))
+    # does not fit the node -> not trivial
+    cache.add_job(mkjob("big", 1.0, cpu=3000))
+    assert sched.fast_admit() == 0
+    assert all(t.status == TaskStatus.PENDING
+               for j in cache.jobs.values() for t in j.tasks.values())
+
+
+def test_fast_admit_respects_pipelined_reservations():
+    """future_idle gates the fast path: capacity already pipelined to a
+    waiting gang must not be given away."""
+    cache = build_cache(n_nodes=1, node_cpu=2000, n_jobs=0)
+    sched = Scheduler(cache, conf_text=CONF, fast_admit=True)
+    node = cache.nodes["n0"]
+    node.pipelined.add(Resource(1500, GI))
+    node._touched = True
+    cache.mark_node_dirty("n0")
+    cache.add_job(mkjob("fa0", 0.0, cpu=500))  # fits idle, NOT future
+    assert sched.fast_admit() == 0
+
+
+# ---------------------------------------------------------------------------
+# crash window: SimKill between dispatch and commit
+# ---------------------------------------------------------------------------
+
+def test_simkill_mid_speculation_loses_only_speculative_state():
+    journal = IntentJournal()
+    cache = build_cache(journal=journal)
+    sched = Scheduler(cache, conf_text=CONF, pipelined=True)
+    errs = sched.run_once()                    # cycle 0 binds + dispatches
+    assert not errs
+    assert sched._spec is not None
+    journal_len_before = len(journal)
+
+    def boom(spec):
+        raise SimKill("between dispatch and commit")
+
+    sched.spec_fault_hook = boom
+    with pytest.raises(SimKill):
+        sched.run_once()
+    # the dispatch journaled NOTHING: the crash window holds no
+    # speculative intent to reconcile
+    assert len(journal) == journal_len_before
+    assert not journal.unacked()
+    plane_at_death = state_plane(cache)
+
+    # a fresh incarnation (the sim's restart semantics) converges to the
+    # serial plane with zero double-binds by construction
+    cache.mark_all_dirty()
+    cache.tensor_cache = None
+    cache._tensor_dirty = set()
+    sched2 = Scheduler(cache, conf_text=CONF, pipelined=True)
+    sched2.startup_reconcile()
+    assert state_plane(cache) == plane_at_death
+    for _ in range(3):
+        assert not sched2.run_once()
+
+    serial = build_cache()
+    s = Scheduler(serial, conf_text=CONF, pipelined=False)
+    for _ in range(5):                         # 0..1 + kill + 3 recovery
+        assert not s.run_once()
+    assert state_plane(serial) == state_plane(cache)
+
+
+def test_pipelined_requires_standalone_topology():
+    """With an elector attached the shell must fall back to serial
+    cycles: a speculation never crosses a leadership boundary."""
+    cache = build_cache(n_jobs=4)
+    sched = Scheduler(cache, conf_text=CONF, pipelined=True)
+
+    class AlwaysLeader:
+        leading = True
+        fencing_epoch = 1
+        identity = "r1"
+
+        def step(self):
+            return True
+
+    sched.attach_elector(AlwaysLeader())
+    before = dict(metrics.speculation_counts())
+    for _ in range(3):
+        assert not sched.run_once()
+    after = metrics.speculation_counts()
+    assert after == before                     # never dispatched
+    assert sched._spec is None

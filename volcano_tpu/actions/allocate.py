@@ -468,12 +468,16 @@ class _DeviceJobPlacer:
         from ..ops.place import unpack_placement
 
         T = len(tasks)
-        packed, new_state, bucket, J, _ = _solve_job_batch(
-            self.ssn, [(job, tasks)], self.state, self.node_t, self.rnames,
-            self.weights, self.allocatable_d, self.max_tasks_d, self._solve,
-            j_pad=1)
-        task_node, pipelined, _, job_kept = unpack_placement(
-            np.asarray(packed), bucket, J)
+        # the per-job fetch is this engine's contract (one RTT per job,
+        # decision parity) — run it under the sanctioned solve span so
+        # VT010 sees the scheduled readback, not a stray sync
+        with obs_trace.span("solve", batch=1):
+            packed, new_state, bucket, J, _ = _solve_job_batch(
+                self.ssn, [(job, tasks)], self.state, self.node_t,
+                self.rnames, self.weights, self.allocatable_d,
+                self.max_tasks_d, self._solve, j_pad=1)
+            task_node, pipelined, _, job_kept = unpack_placement(
+                np.asarray(packed), bucket, J)
         task_node, pipelined = task_node[:T], pipelined[:T]
         if bool(job_kept[0]):
             self.state = new_state
@@ -797,7 +801,8 @@ def _job_solver():
 # fused engine: one device program per cycle
 # ---------------------------------------------------------------------------
 
-def _fixed_job_order(ssn, assumed_admitted: Optional[set] = None) -> List:
+def _fixed_job_order(ssn, assumed_admitted: Optional[set] = None,
+                     only_jobs: Optional[set] = None) -> List:
     """Precompute the namespace→queue→job interleave for the fused solve.
 
     Runs the reference's popping loop (allocate.go:123-180) with one
@@ -807,10 +812,15 @@ def _fixed_job_order(ssn, assumed_admitted: Optional[set] = None) -> List:
     queues exactly as the live loop would; all events are undone before
     returning. The fused executor iterates this to a fixed point on the
     actually-admitted set, so gang failures feed back into the ordering.
+    ``only_jobs`` restricts the interleave to that uid set — the
+    pipelined commit's SUFFIX solve uses it to order exactly the jobs a
+    committed speculation did not cover.
     """
     namespaces = PriorityQueue(ssn.namespace_order_fn)
     jobs_map: Dict[str, Dict[str, PriorityQueue]] = {}
     for job in _eligible_jobs(ssn):
+        if only_jobs is not None and job.uid not in only_jobs:
+            continue
         ns = job.namespace
         if ns not in jobs_map:
             namespaces.push(ns)
@@ -877,7 +887,11 @@ LAST_STATS: Dict[str, float] = {}
 
 
 def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4,
-                   kernel: str = "auto", sharded: bool = False) -> None:
+                   kernel: str = "auto", sharded: bool = False,
+                   first_solution: Optional["_FusedSolution"] = None,
+                   first_ordered: Optional[List] = None,
+                   first_assumed: Optional[set] = None,
+                   only_jobs: Optional[set] = None) -> None:
     """Fused executor: iterate (order simulation → one device solve) until
     the admitted-job set stabilizes, then replay the final solve through
     Statements. Convergence is usually immediate; gang rollbacks trigger one
@@ -889,25 +903,50 @@ def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4,
     placement changed the state the mask was computed from. Those tasks
     stay pending; extra rounds re-solve them against the fresh session
     state — the batched analogue of the callback engine's per-task
-    re-evaluation."""
+    re-evaluation.
+
+    ``first_solution``/``first_ordered`` seed the fixpoint with an
+    already-solved first iteration — how the pipelined shell commits a
+    speculative solve: the speculation IS iteration 1 (same snapshot
+    values, same order, same kernel as the serial path would have run),
+    and when its admitted set does not match its premise the loop
+    continues with the normal assumed=kept re-solve, exactly as the
+    serial cycle would. ``first_assumed`` is the seeded iteration's
+    premise: None for the all-admitted start, or the EMPTY set when the
+    speculation warm-started at the serial fixpoint's converged point (a
+    saturated backlog whose fixpoint is ∅→∅ — solving there directly
+    reproduces the serial trajectory's FINAL solution, skipping its
+    in-cycle re-solve). ``only_jobs`` restricts the whole execution to
+    that uid set (the pipelined suffix solve for jobs the speculation
+    did not cover)."""
     t_order = t_solve = t_replay = 0.0
     max_rounds = 3 if ssn.stateful_predicates else 1
+    seeded = first_solution
+    kept_uids: Optional[set] = None
     for _ in range(max_rounds):
         assumed: Optional[set] = None
         solution = None
         for _ in range(max_order_iters):
-            with obs_trace.span("order") as sp:
-                ordered_jobs = _fixed_job_order(ssn, assumed)
-            t_order += sp.dur_s
-            if not ordered_jobs:
-                solution = None
-                break
-            from .. import metrics
-            with obs_trace.span("solve", kernel=kernel) as sp:
-                with metrics.solver_trace("allocate-solve"):
-                    solution = _solve_fused(ssn, ordered_jobs, blocks,
-                                            kernel, sharded)
-            t_solve += sp.dur_s
+            if seeded is not None:
+                # iteration 1 happened in the speculate window; its
+                # order/solve time was paid there (span "speculate")
+                ordered_jobs, solution = first_ordered, seeded
+                assumed = first_assumed
+                seeded = None
+            else:
+                with obs_trace.span("order") as sp:
+                    ordered_jobs = _fixed_job_order(ssn, assumed,
+                                                    only_jobs=only_jobs)
+                t_order += sp.dur_s
+                if not ordered_jobs:
+                    solution = None
+                    break
+                from .. import metrics
+                with obs_trace.span("solve", kernel=kernel) as sp:
+                    with metrics.solver_trace("allocate-solve"):
+                        solution = _solve_fused(ssn, ordered_jobs, blocks,
+                                                kernel, sharded)
+                t_solve += sp.dur_s
             if solution is None:
                 break
             kept_uids = {solution.jobs_list[jx].uid
@@ -928,6 +967,34 @@ def _execute_fused(ssn, blocks: bool = False, max_order_iters: int = 4,
         if not rejected:
             break
     LAST_STATS.update(order_s=t_order, solve_s=t_solve, replay_s=t_replay)
+    # warm-start witness for the pipelined dispatch: True iff the fixpoint
+    # CONVERGED at the empty admitted set (a saturated backlog) — the one
+    # case where next cycle's speculation may start at assumed=∅ and still
+    # reproduce the serial trajectory's final solution byte-for-byte
+    LAST_STATS["final_kept_empty"] = bool(solution is not None
+                                          and kept_uids is not None
+                                          and not kept_uids)
+
+
+def _collect_pending_ordered(ssn, ordered_jobs):
+    """Flatten the ordered jobs' pending tasks into the solver's task
+    axis: (tasks, per-task job index, jobs_list). Shared by the serial
+    solve and the speculative dispatch so the two assemble bit-identical
+    inputs."""
+    tasks: List[TaskInfo] = []
+    job_ix: List[int] = []
+    job_index: Dict[str, int] = {}
+    jobs_list: List = []
+    for job in ordered_jobs:
+        jtasks = _pending_tasks(ssn, job)
+        if not jtasks:
+            continue
+        if job.uid not in job_index:
+            job_index[job.uid] = len(jobs_list)
+            jobs_list.append(job)
+        tasks.extend(jtasks)
+        job_ix.extend([job_index[job.uid]] * len(jtasks))
+    return tasks, job_ix, jobs_list
 
 
 class _FusedSolution:
@@ -963,19 +1030,7 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
     from ..ops.place import JobMeta, NodeState, PlacementTasks
     from ..ops.auction import BlockTasks
 
-    tasks: List[TaskInfo] = []
-    job_ix: List[int] = []
-    job_index: Dict[str, int] = {}
-    jobs_list: List = []
-    for job in ordered_jobs:
-        jtasks = _pending_tasks(ssn, job)
-        if not jtasks:
-            continue
-        if job.uid not in job_index:
-            job_index[job.uid] = len(jobs_list)
-            jobs_list.append(job)
-        tasks.extend(jtasks)
-        job_ix.extend([job_index[job.uid]] * len(jtasks))
+    tasks, job_ix, jobs_list = _collect_pending_ordered(ssn, ordered_jobs)
     if not tasks or not ssn.nodes:
         return None
 
@@ -1003,32 +1058,10 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
 
     T = len(tasks)
     N = len(node_t.names)
-    J = len(jobs_list)
-    bucket = _bucket(T)
-    pad = bucket - T
     job_ix_np = np.asarray(job_ix, np.int32)
-    first = np.zeros(T, bool)
-    last = np.zeros(T, bool)
-    first[0] = True
-    first[1:] = job_ix_np[1:] != job_ix_np[:-1]
-    last[:-1] = job_ix_np[1:] != job_ix_np[:-1]
-    last[-1] = True
-
     # numpy first: the pallas path consumes these host-side, and converting
     # jnp->np costs one ~100ms tunnel RTT per array on remote TPU backends.
-    # The job axis pads to its pow2 bucket (_job_bucket): pad gangs with
-    # min_available 1 and no tasks are inert in-kernel, and the [J] arrays
-    # stop keying a fresh compile every time the pending-job count moves.
-    Jp = _job_bucket(J)
-    jpad = Jp - J
-    min_av_np = np.asarray([j.min_available for j in jobs_list]
-                           + [1] * jpad, np.int32)
-    base_r_np = np.asarray([j.ready_task_num() for j in jobs_list]
-                           + [0] * jpad, np.int32)
-    base_p_np = np.asarray([j.waiting_task_num() for j in jobs_list]
-                           + [0] * jpad, np.int32)
-    jobs_meta = JobMeta(min_available=min_av_np, base_ready=base_r_np,
-                        base_pipelined=base_p_np)
+    jobs_meta, min_av_np, base_r_np, base_p_np, Jp = _gang_meta(jobs_list)
 
     if sharded:
         # multi-chip engine: node axis sharded over the device mesh (VERDICT
@@ -1112,13 +1145,14 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
                               res.task_node, res.task_pipelined,
                               res.job_ready, res.job_kept)
 
-    feas_b = (jnp.ones((T, N), bool) if feas is None else jnp.asarray(feas))
-    static_b = (jnp.zeros((T, N), jnp.float32) if static is None
-                else jnp.asarray(static))
+    feas_np = np.ones((T, N), bool) if feas is None else np.asarray(feas)
+    static_np = (np.zeros((T, N), np.float32) if static is None
+                 else np.asarray(static, np.float32))
     if blocks:
         bt = BlockTasks(req=jnp.asarray(req), job_ix=jnp.asarray(job_ix_np),
-                        valid=jnp.ones(T, bool), feas=feas_b,
-                        static_score=static_b)
+                        valid=jnp.ones(T, bool),
+                        feas=jnp.asarray(feas_np),
+                        static_score=jnp.asarray(static_np))
         # same size-scaled sweep budget as the sharded engine above, so
         # the two block-auction paths keep identical admissions at any T
         big_b = T > 12000
@@ -1131,24 +1165,78 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
             (assign, pipe, ready, kept))
         pipelined = np.asarray(pipelined, bool)
     else:
-        pt = PlacementTasks(
-            req=jnp.asarray(np.pad(req, ((0, pad), (0, 0)))),
-            job_ix=jnp.asarray(np.pad(job_ix_np, (0, pad))),
-            valid=jnp.asarray(np.r_[np.ones(T, bool), np.zeros(pad, bool)]),
-            feas=jnp.pad(feas_b, ((0, pad), (0, 0))),
-            static_score=jnp.pad(static_b, ((0, pad), (0, 0))),
-            first_of_job=jnp.asarray(np.pad(first, (0, pad))),
-            last_of_job=jnp.asarray(np.pad(last, (0, pad))))
-        from ..ops.place import unpack_placement
+        pt, bucket = _scan_placement_tasks(req, job_ix_np, feas_np,
+                                           static_np)
         packed, _ = _job_solver()(node_t.node_state(), pt, jobs_meta, weights,
                                   node_t.device_allocatable(),
                                   node_t.device_max_tasks())
-        task_node, pipelined, job_ready, job_kept = unpack_placement(
-            np.asarray(packed), bucket, Jp)
-        task_node, pipelined = task_node[:T], pipelined[:T]
+        task_node, pipelined, job_ready, job_kept = _fetch_packed(
+            packed, bucket, Jp, T)
 
     return _FusedSolution(tasks, job_ix_np, jobs_list, node_t, task_node,
                           pipelined, job_ready, job_kept)
+
+
+def _gang_meta(jobs_list):
+    """Pow2-padded gang-meta arrays for the fused solvers. The job axis
+    pads to its pow2 bucket (_job_bucket): pad gangs with min_available 1
+    and no tasks are inert in-kernel, and the [J] arrays stop keying a
+    fresh compile every time the pending-job count moves. ONE definition,
+    shared by the serial solve and the speculative dispatch — their
+    byte-for-byte agreement is what the pipelined equivalence rests on.
+    Returns (JobMeta, min_av, base_ready, base_pipelined, Jp)."""
+    from ..ops.place import JobMeta
+    J = len(jobs_list)
+    Jp = _job_bucket(J)
+    jpad = Jp - J
+    min_av = np.asarray([j.min_available for j in jobs_list]
+                        + [1] * jpad, np.int32)
+    base_r = np.asarray([j.ready_task_num() for j in jobs_list]
+                        + [0] * jpad, np.int32)
+    base_p = np.asarray([j.waiting_task_num() for j in jobs_list]
+                        + [0] * jpad, np.int32)
+    return (JobMeta(min_available=min_av, base_ready=base_r,
+                    base_pipelined=base_p), min_av, base_r, base_p, Jp)
+
+
+def _scan_placement_tasks(req, job_ix_np, feas_np, static_np):
+    """The scan solver's padded PlacementTasks — ONE definition of the
+    bucket/pad/dtype/boundary rules, shared by the serial solve, the
+    speculative dispatch and prewarm (byte-for-byte agreement again).
+    Masks are padded in NUMPY: an eager jnp.ones/jnp.pad would key a
+    fresh XLA micro-program on the RAW task count T, which (unlike the
+    pow2 bucket) changes every cycle under churn. Returns (pt, bucket)."""
+    import jax.numpy as jnp
+    from ..ops.place import PlacementTasks
+    T = len(job_ix_np)
+    bucket = _bucket(T)
+    pad = bucket - T
+    first = np.zeros(T, bool)
+    last = np.zeros(T, bool)
+    first[0] = True
+    first[1:] = job_ix_np[1:] != job_ix_np[:-1]
+    last[:-1] = job_ix_np[1:] != job_ix_np[:-1]
+    last[-1] = True
+    pt = PlacementTasks(
+        req=jnp.asarray(np.pad(req, ((0, pad), (0, 0)))),
+        job_ix=jnp.asarray(np.pad(job_ix_np, (0, pad))),
+        valid=jnp.asarray(np.r_[np.ones(T, bool), np.zeros(pad, bool)]),
+        feas=jnp.asarray(np.pad(feas_np, ((0, pad), (0, 0)))),
+        static_score=jnp.asarray(np.pad(static_np, ((0, pad), (0, 0)))),
+        first_of_job=jnp.asarray(np.pad(first, (0, pad))),
+        last_of_job=jnp.asarray(np.pad(last, (0, pad))))
+    return pt, bucket
+
+
+def _fetch_packed(packed_d, bucket: int, jp: int, T: int):
+    """The scan solver's ONE device→host fetch + unpack, shared by the
+    serial solve and the speculative finalize so the inventory carries a
+    single readback site. Callers run it under the sanctioned ``solve``
+    span (VT010)."""
+    from ..ops.place import unpack_placement
+    task_node, pipelined, job_ready, job_kept = unpack_placement(
+        np.asarray(packed_d), bucket, jp)
+    return task_node[:T], pipelined[:T], job_ready, job_kept
 
 
 def _stateful_recheck(ssn, task, node) -> bool:
@@ -1335,6 +1423,170 @@ def _replay_fused(ssn, sol: _FusedSolution) -> int:
     return rejected
 
 
+# ---------------------------------------------------------------------------
+# speculative dispatch/await split (docs/performance.md pipelining)
+# ---------------------------------------------------------------------------
+
+class PendingFusedSolution:
+    """A dispatched-but-unfetched fused solve: the device-resident packed
+    result plus everything needed to finalize and replay it at the
+    pipelined commit boundary. Holding this object IS the overlap — jax
+    async dispatch means the device computes while the host runs cycle
+    N's replay/bind/close and the inter-cycle wait."""
+
+    __slots__ = ("ordered_jobs", "tasks", "job_ix", "jobs_list", "node_t",
+                 "packed_d", "bucket", "jp", "eligible_uids",
+                 "assumed_hint")
+
+    def __init__(self, ordered_jobs, tasks, job_ix, jobs_list, node_t,
+                 packed_d, bucket, jp, eligible_uids, assumed_hint=None):
+        self.ordered_jobs = ordered_jobs
+        self.tasks = tasks
+        self.job_ix = job_ix
+        self.jobs_list = jobs_list
+        self.node_t = node_t
+        self.packed_d = packed_d
+        self.bucket = bucket
+        self.jp = jp
+        # every job eligible at speculation time (covered or not — the
+        # ordering's overused gating may have excluded some): the commit
+        # suffix-solves exactly the jobs eligible at commit time that are
+        # NOT in this set, which is what the speculation could not know
+        self.eligible_uids = eligible_uids
+        # None: all-admitted premise (the serial trajectory's iteration
+        # 1). set(): warm-started at the ∅ fixpoint — the commit must
+        # verify kept==∅ and otherwise discard (conflict), never continue
+        self.assumed_hint = assumed_hint
+
+
+def dispatch_speculative_solve(ssn, engine: str = "tpu-fused",
+                               assumed_hint: Optional[set] = None
+                               ) -> Optional[PendingFusedSolution]:
+    """Order + assemble + DISPATCH one fused scan solve with no
+    host↔device synchronization: the call returns as soon as XLA enqueues
+    the program, so the device solves cycle N+1's speculative placement
+    while the host is still committing cycle N.
+    ``finalize_speculative_dispatch`` performs the batch's one fetch at
+    the commit boundary.
+
+    The assembly IS ``_solve_fused``'s scan-branch input (the shared
+    ``_collect_pending_ordered``/``_gang_meta``/``_scan_placement_tasks``
+    helpers — one definition of collection, padding, dtypes and the jit
+    cache key), which is what makes a committed speculation
+    byte-equivalent to the serial cycle.
+    Returns None whenever speculation cannot run this cycle: nothing
+    pending, stateful predicates (the mask would go stale mid-replay),
+    device cool-down, a pallas-eligible shape under ``tpu-fused`` auto
+    mode (that kernel is not dispatch/await split), or non-finite
+    inputs (the serial path's SolverFault degradation owns those)."""
+    if ssn.stateful_predicates or not ssn.nodes:
+        return None
+    if engine not in ("tpu-fused", "tpu-scan"):
+        return None
+    if not _device_available():
+        return None
+    with obs_trace.span("order", speculative=True) as sp:
+        # assumed_hint=set() warm-starts the order at the ∅ fixpoint (the
+        # previous cycle's converged admitted set on a saturated
+        # backlog); None is the serial trajectory's all-admitted start
+        ordered_jobs = _fixed_job_order(ssn, assumed_hint)
+    if not ordered_jobs:
+        return None
+    tasks, job_ix, jobs_list = _collect_pending_ordered(ssn, ordered_jobs)
+    if not tasks:
+        return None
+    rnames = discover_resource_names(list(ssn.nodes.values()), tasks)
+    node_t = _node_tensors(ssn, rnames)
+    N = len(node_t.names)
+    if engine == "tpu-fused":
+        from ..ops import pallas_place
+        if pallas_place.supported(len(rnames), N) \
+                and not pallas_place.use_interpret():
+            return None
+    req = task_requests(tasks, rnames)
+    feas = assemble_feasibility(ssn, tasks, node_t)
+    static = assemble_static_score(ssn, tasks, node_t)
+    weights = assemble_weights(ssn, rnames)
+    if not np.isfinite(req).all() or (
+            static is not None
+            and not np.isfinite(np.asarray(static)).all()):
+        return None
+    if not (np.isfinite(weights.binpack_res).all()
+            and all(np.isfinite(w) for w in (
+                weights.binpack_weight, weights.least_req_weight,
+                weights.most_req_weight, weights.balanced_weight))):
+        return None
+
+    T = len(tasks)
+    job_ix_np = np.asarray(job_ix, np.int32)
+    jobs_meta, _, _, _, Jp = _gang_meta(jobs_list)
+    feas_np = np.ones((T, N), bool) if feas is None else np.asarray(feas)
+    static_np = (np.zeros((T, N), np.float32) if static is None
+                 else np.asarray(static, np.float32))
+    pt, bucket = _scan_placement_tasks(req, job_ix_np, feas_np, static_np)
+    packed, _ = _job_solver()(node_t.node_state(), pt, jobs_meta, weights,
+                              node_t.device_allocatable(),
+                              node_t.device_max_tasks())
+    LAST_STATS["speculate_order_s"] = sp.dur_s
+    return PendingFusedSolution(ordered_jobs, tasks, job_ix_np, jobs_list,
+                                node_t, packed, bucket, Jp,
+                                {j.uid for j in _eligible_jobs(ssn)},
+                                assumed_hint=assumed_hint)
+
+
+def finalize_speculative_dispatch(pending: PendingFusedSolution
+                                  ) -> _FusedSolution:
+    """The dispatched solve's ONE fetch, under the sanctioned solve span
+    (VT010): at the commit boundary the device finished during cycle N's
+    host commit, so this await costs transfer time, not solve time.
+    Raises SolverFault on garbage output (the ``_FusedSolution`` guard);
+    the pipelined shell counts that as a conflict and re-solves."""
+    with obs_trace.span("solve", speculative=True):
+        task_node, pipelined, job_ready, job_kept = _fetch_packed(
+            pending.packed_d, pending.bucket, pending.jp,
+            len(pending.tasks))
+    return _FusedSolution(pending.tasks, pending.job_ix, pending.jobs_list,
+                          pending.node_t, task_node, pipelined,
+                          job_ready, job_kept)
+
+
+def remap_speculative_solution(sol: _FusedSolution, ordered_jobs, ssn):
+    """Re-anchor a speculative solution onto the COMMIT session's objects
+    by uid — sound because the shell's conflict check already proved the
+    covered jobs' and placed-on nodes' decision inputs unchanged since
+    the speculative snapshot. On the promote path the session is the
+    speculative session itself and this is the identity map. Returns
+    ``(solution, ordered)`` or ``(None, None)`` when any covered object
+    vanished (the shell counts a conflict)."""
+    jobs_list = []
+    for job in sol.jobs_list:
+        live = ssn.jobs.get(job.uid)
+        if live is None:
+            return None, None
+        jobs_list.append(live)
+    ordered = []
+    for job in ordered_jobs:
+        live = ssn.jobs.get(job.uid)
+        if live is None:
+            return None, None
+        ordered.append(live)
+    tasks = []
+    for t in sol.tasks:
+        job = ssn.jobs.get(t.job)
+        live = job.tasks.get(t.uid) if job is not None else None
+        if live is None or live.status != TaskStatus.PENDING:
+            return None, None
+        tasks.append(live)
+    tn = np.asarray(sol.task_node)
+    for n in np.unique(tn[tn != NO_NODE]):
+        if sol.node_t.names[int(n)] not in ssn.nodes:
+            return None, None
+    mapped = _FusedSolution(tasks, sol.job_ix, jobs_list, sol.node_t,
+                            sol.task_node, sol.pipelined, sol.job_ready,
+                            sol.job_kept)
+    return mapped, ordered
+
+
 def _fused_blocks_solver():
     import jax
     if "blocks" not in _SOLVER_CACHE:
@@ -1420,12 +1672,6 @@ def prewarm_shapes(ssn, shape_configs=None, engine: str = "tpu-fused",
         # _solve_fused, so one warmed entry covers every live J in its
         # bucket (shape — not values — keys the XLA compile cache)
         job_ix = np.minimum(np.arange(T) * J // T, J - 1).astype(np.int32)
-        first = np.zeros(T, bool)
-        last = np.zeros(T, bool)
-        first[0] = True
-        first[1:] = job_ix[1:] != job_ix[:-1]
-        last[:-1] = job_ix[1:] != job_ix[:-1]
-        last[-1] = True
         req = np.zeros((T, R), np.float32)
         Jp = _job_bucket(J)
         min_av = np.ones(Jp, np.int32)
@@ -1487,21 +1733,12 @@ def prewarm_shapes(ssn, shape_configs=None, engine: str = "tpu-fused",
                 sweeps=5 if big else 3, passes=4 if big else 3)
         else:
             # scan solver: the fused engine's CPU/interpret path and the
-            # strict engines' batched program (same place_scan_packed jit)
-            bucket = _bucket(T)
-            pad = bucket - T
-            # the eager jnp.pad mirrors _solve_fused exactly so even its
-            # per-shape _pad micro-compiles happen here, not in the cycle
-            pt = PlacementTasks(
-                req=jnp.asarray(np.pad(req, ((0, pad), (0, 0)))),
-                job_ix=jnp.asarray(np.pad(job_ix, (0, pad))),
-                valid=jnp.asarray(np.r_[np.ones(T, bool),
-                                        np.zeros(pad, bool)]),
-                feas=jnp.pad(jnp.ones((T, N), bool), ((0, pad), (0, 0))),
-                static_score=jnp.pad(jnp.zeros((T, N), jnp.float32),
-                                     ((0, pad), (0, 0))),
-                first_of_job=jnp.asarray(np.pad(first, (0, pad))),
-                last_of_job=jnp.asarray(np.pad(last, (0, pad))))
+            # strict engines' batched program (same place_scan_packed
+            # jit), assembled through the SAME helper as the live paths
+            # so prewarm compiles exactly the cache keys they will hit
+            pt, _ = _scan_placement_tasks(
+                req, job_ix, np.ones((T, N), bool),
+                np.zeros((T, N), np.float32))
             out = _job_solver()(
                 node_t.node_state(), pt,
                 JobMeta(min_available=min_av, base_ready=base_z,

@@ -4,8 +4,8 @@ Usage:
     vlint [paths...] [--format text|json|sarif] [--baseline FILE]
           [--no-baseline] [--update-baseline]
           [--rule VTxxx [...]] [--rules VTxxx,VTyyy] [--dataflow]
-          [--diff BASE] [--explain VTxxx] [--sync-inventory]
-          [--list-rules]
+          [--diff BASE] [--explain VTxxx]
+          [--sync-inventory [--sync-budget N]] [--list-rules]
 
 Exit codes: 0 clean (suppressed/baselined findings do not gate),
 1 blocking findings or invalid suppressions, 2 usage/baseline/diff
@@ -71,7 +71,8 @@ def _explain(rule_id: str) -> int:
     return 0
 
 
-def _sync_inventory(paths: List[str]) -> int:
+def _sync_inventory(paths: List[str],
+                    budget: Optional[int] = None) -> int:
     """Print EVERY host-sync site the dataflow engine sees — excused or
     not — with its producer and why it is (or is not) allowlisted. This
     is the async-overlap worklist of ROADMAP item 2: the non-excused
@@ -104,6 +105,16 @@ def _sync_inventory(paths: List[str]) -> int:
     blocking = sum(1 for r in rows if r[4] == "BLOCKING")
     print(f"vlint --sync-inventory: {len(rows)} host-sync site(s), "
           f"{blocking} outside allowlisted spans")
+    if budget is not None and len(rows) > budget:
+        # the CI ratchet of the async-overlap burn-down (ROADMAP item 2):
+        # the pipelined refactor shrank this inventory — a NEW sync site
+        # (even span-excused) must justify itself by raising the budget
+        # in ci/check.sh, not slide in silently
+        print(f"vlint --sync-inventory: FAILED — {len(rows)} site(s) "
+              f"exceed the --sync-budget of {budget}; remove the new "
+              f"host sync or raise the budget with a written "
+              f"justification (docs/static-analysis.md)")
+        return 1
     return 0
 
 
@@ -150,6 +161,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="print every VT010 host-sync site (excused "
                              "or not) with producer and span context — "
                              "the async-overlap worklist")
+    parser.add_argument("--sync-budget", type=int, default=None,
+                        metavar="N",
+                        help="with --sync-inventory: exit 1 if the total "
+                             "site count exceeds N (the CI ratchet that "
+                             "keeps the inventory from growing)")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -178,7 +194,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     if args.sync_inventory:
-        return _sync_inventory(paths)
+        return _sync_inventory(paths, budget=args.sync_budget)
 
     selected: List[str] = list(args.rule or [])
     for chunk in args.rules or []:

@@ -971,6 +971,12 @@ class HostSyncRule(Rule):
     # Match is on (path, enclosing symbol, sync kind) — the kind keeps an
     # entry from silently covering a DIFFERENT sync that later appears in
     # the same function. Keep entries FEW and justified.
+    # Burn-down history (ROADMAP item 2): the _DeviceJobPlacer.place
+    # entry was retired by PR 12 — its per-job fetch now runs under the
+    # sanctioned ``solve`` span, and the pipelined dispatch/await split
+    # (dispatch_speculative_solve / finalize_speculative_dispatch) means
+    # replay readbacks await the PREVIOUS cycle's transfer instead of
+    # blocking their own. Only the startup-prewarm block remains.
     READBACK_ALLOWLIST = (
         {"path": "volcano_tpu/actions/allocate.py",
          "symbol": "prewarm_shapes",
@@ -978,15 +984,6 @@ class HostSyncRule(Rule):
          "reason": "startup prewarm must block until every warmed shape "
                    "finishes compiling; it runs from Scheduler.prewarm, "
                    "never inside a scheduling cycle"},
-        {"path": "volcano_tpu/actions/allocate.py",
-         "symbol": "_DeviceJobPlacer.place",
-         "kind": "np.asarray",
-         "reason": "tpu-strict-perjob IS the one-RTT-per-job decision-"
-                   "parity engine (r3): each job's placement must be "
-                   "fetched before the next pop. The batched tpu-strict "
-                   "engine supersedes it for throughput; the overlap "
-                   "work of ROADMAP item 2 targets the fused/strict "
-                   "engines, not this oracle"},
     )
 
     def classify(self, mod: ModuleInfo, fn: FunctionInfo, site,
@@ -1187,16 +1184,131 @@ class SessionEscapeRule(Rule):
         return findings
 
 
+class SpeculationIsolationRule(Rule):
+    """Speculation isolation (PR 12, docs/performance.md pipelining): the
+    speculative-open path — staging the snapshot, opening the speculative
+    session, dispatching the solve — must be READ-ONLY with respect to
+    the scheduler's durable and decision state. Any side-effect write
+    reachable from a speculative root that lands on the SchedulerCache
+    funnels, the intent journal, or an executor OUTSIDE the commit funnel
+    is a finding: a crash between dispatch and commit must lose only
+    speculative state (nothing journaled, zero double-binds — the
+    pipelined chaos soak's contract).
+
+    Mechanics: BFS over the call graph from ``SPECULATIVE_ROOTS``,
+    following only UNAMBIGUOUS simple-name edges (exactly one def in the
+    package — the same precision rule as CallGraph.span_context, biased
+    against smearing), never entering the ``COMMIT_GATE`` functions (the
+    sanctioned commit boundary, which runs after the conflict check on
+    the cycle's real session). Every function in the closure is scanned
+    for sink calls (``<cache|binder|evictor|journal|status_updater|ssn>
+    .<bind|bind_batch|evict|allocate|pipeline|dispatch|record_intent|
+    _journal_intent|ack|resync_task|redrive_dead_letter>``) and for
+    assignments into the cache's object indexes."""
+
+    id = "VT015"
+    name = "speculation-isolation"
+    contract = ("write reachable from the speculative-open path landing "
+                "on SchedulerCache/journal/executors outside the commit "
+                "funnel (PR 12; docs/performance.md pipelining)")
+    scope = ("volcano_tpu/scheduler.py", "volcano_tpu/actions/",
+             "volcano_tpu/framework/", "volcano_tpu/cache/")
+
+    SPECULATIVE_ROOTS = ("_dispatch_speculation",
+                         "dispatch_speculative_solve",
+                         "speculative_snapshot",
+                         "tensor_refresh_speculative")
+    COMMIT_GATE = ("_commit_speculation", "_check_speculation",
+                   "finalize_speculative_dispatch")
+    SINK_ATTRS = {"bind", "bind_batch", "evict", "allocate", "pipeline",
+                  "dispatch", "record_intent", "_journal_intent", "ack",
+                  "resync_task", "redrive_dead_letter"}
+    SINK_RECEIVERS = {"cache", "binder", "evictor", "journal",
+                      "status_updater", "ssn", "session", "sssn"}
+    INDEX_ATTRS = {"jobs", "nodes", "queues", "dead_letter",
+                   "binding_tasks"}
+
+    def _closure(self, ctx: AnalysisContext) -> List[FunctionInfo]:
+        graph = ctx.graph
+        frontier = [fn for name in self.SPECULATIVE_ROOTS
+                    for fn in graph.defs.get(name, [])]
+        seen = {id(fn): fn for fn in frontier}
+        while frontier:
+            nxt: List[FunctionInfo] = []
+            for fn in frontier:
+                for name in fn.linkable_calls:
+                    targets = graph.defs.get(name)
+                    if not targets or len(targets) > 1:
+                        continue        # ambiguous: do not smear
+                    (callee,) = targets
+                    if callee.name in self.COMMIT_GATE:
+                        continue        # the sanctioned commit boundary
+                    if id(callee) not in seen:
+                        seen[id(callee)] = callee
+                        nxt.append(callee)
+            frontier = nxt
+        return list(seen.values())
+
+    def _sinks_in(self, fn: FunctionInfo):
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                dotted = dotted_name(node.func)
+                if dotted is None:
+                    continue
+                parts = dotted.split(".")
+                if len(parts) >= 2 and parts[-1] in self.SINK_ATTRS \
+                        and set(parts[:-1]) & self.SINK_RECEIVERS:
+                    yield node, f"call {dotted}(...)"
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    base = tgt
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    dotted = dotted_name(base)
+                    if dotted is None:
+                        continue
+                    parts = dotted.split(".")
+                    if parts[-1] in self.INDEX_ATTRS \
+                            and set(parts[:-1]) & self.SINK_RECEIVERS:
+                        yield node, f"write to {dotted}"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        closure = getattr(ctx, "_vt015_closure", None)
+        if closure is None:
+            closure = self._closure(ctx)
+            ctx._vt015_closure = closure
+        findings: List[Finding] = []
+        for fn in closure:
+            if fn.module is not mod:
+                continue
+            for node, desc in self._sinks_in(fn):
+                findings.append(self.finding(
+                    mod, node,
+                    f"{desc} in {fn.qualname}, reachable from the "
+                    f"speculative-open path "
+                    f"({'/'.join(self.SPECULATIVE_ROOTS[:2])}...): "
+                    f"speculation must journal/execute NOTHING before "
+                    f"the commit funnel — route the write through the "
+                    f"commit boundary or off the speculative path "
+                    f"(docs/static-analysis.md)"))
+        return findings
+
+
 ALL_RULES: List[Rule] = [
     DirtyWitnessRule(), RawClockRule(), UnseededRandomRule(),
     JournalFunnelRule(), SimKillSwallowRule(), ShapeBucketRule(),
     LockDisciplineRule(), FencingEpochRule(), CrossPartitionFunnelRule(),
     HostSyncRule(), TracedBranchRule(), DataflowShapeBucketRule(),
     DtypeDisciplineRule(), SessionEscapeRule(),
+    SpeculationIsolationRule(),
 ]
 
-# the rules that run on the shared dataflow engine (vlint --dataflow)
-DATAFLOW_RULE_IDS = ("VT006", "VT010", "VT011", "VT012", "VT013", "VT014")
+# the rules that run on the shared dataflow/callgraph engine
+# (vlint --dataflow): VT015 rides the same interprocedural closure
+DATAFLOW_RULE_IDS = ("VT006", "VT010", "VT011", "VT012", "VT013", "VT014",
+                     "VT015")
 
 # minimal trigger snippets, printed by ``vlint --explain VTxxx`` next to
 # the rule's contract while burning down findings
@@ -1244,6 +1356,10 @@ solver(state, idx)                     # truncates under x64-disabled''',
     "VT014": '''class SchedulerCache:
     def remember(self, ssn):
         self._last_nodes = ssn.nodes   # outlives close_session''',
+    "VT015": '''def _dispatch_speculation(self, rec, runnable):
+    sssn = open_session(self.cache, speculative=True)
+    ssn.cache.bind_batch(gang)         # journaled side effect BEFORE
+                                       # the commit funnel''',
 }
 for _rule in ALL_RULES:
     _rule.example = _EXAMPLES.get(_rule.id, "")

@@ -183,6 +183,21 @@ class SchedulerCache:
         # wall-clock + dirty-ratio breakdown of the last snapshot()
         # (bench.py snapshot_clone_ms / open_dirty_ms extras)
         self.last_snapshot_stats: Dict[str, object] = {}
+        # outstanding speculative-snapshot dirt (docs/performance.md
+        # pipelining): speculative_snapshot MOVES the dirty sets into the
+        # staged basis (so post-stage mutations land in empty sets and
+        # the commit-boundary delta is exact, including re-mutation of
+        # keys that were already dirty); the moved keys live here until
+        # adopt consumes them, discard restores them, or a real
+        # _snapshot_impl reabsorbs them first.
+        self._spec_dirt: Optional[dict] = None
+        # event-driven fast-admit feed (docs/performance.md): when a
+        # scheduler enables it, add_job records arrivals here so
+        # Scheduler.fast_admit scans only what arrived since the last
+        # drain instead of every job. Off by default — an unconsumed
+        # feed must not grow without bound.
+        self.fast_admit_feed = False
+        self._new_job_uids: Set[str] = set()
         # result of the last shadow-verifier pass (verify_state_integrity)
         self.last_verify: Dict[str, object] = {}
 
@@ -289,6 +304,16 @@ class SchedulerCache:
                 job.schedule_start_timestamp = self.time_fn()
             self.jobs[job.uid] = job
             self._dirty_jobs.add(job.uid)
+            if self.fast_admit_feed:
+                self._new_job_uids.add(job.uid)
+
+    def drain_new_jobs(self) -> List[str]:
+        """Consume the fast-admit arrival feed (sorted for determinism);
+        empty unless ``fast_admit_feed`` is on."""
+        with self._lock:
+            uids = sorted(self._new_job_uids)
+            self._new_job_uids.clear()
+        return uids
 
     def remove_job(self, uid: str) -> None:
         with self._lock:
@@ -384,9 +409,21 @@ class SchedulerCache:
             ci = self.snapshot_scope(ci)
         return ci
 
-    def _snapshot_impl(self) -> ClusterInfo:
+    def _snapshot_impl(self, stage: bool = False):
+        """Build one clone-on-dirty ClusterInfo. ``stage=False`` (the
+        historical path) also CONSUMES the incremental bookkeeping:
+        stores the clone maps, clears the dirty sets, bumps the epoch.
+        ``stage=True`` (speculative_snapshot) leaves every piece of
+        cache bookkeeping untouched and instead returns ``(ci, staged)``
+        where ``staged`` carries what adopt_speculative_snapshot would
+        need to install later — the read-only open the pipelined shell's
+        speculation rides (docs/performance.md)."""
         t0 = time.perf_counter()
+        touched_nodes: List[str] = []
+        touched_jobs: List[str] = []
+        tensor_rows: Set[str] = set()
         with self._lock:
+            self._reabsorb_spec_dirt_locked()
             incremental = incremental_snapshot_enabled()
             full = self._dirty_all or not incremental
             ci = ClusterInfo()
@@ -408,9 +445,16 @@ class SchedulerCache:
                     reused_nodes += 1
                 else:
                     ci.nodes[name] = node.clone()
-                    node._touched = False
+                    if stage:
+                        # defer the witness reset to adopt time: a
+                        # discarded speculation must leave the real
+                        # snapshot's re-clone decision exactly as it was
+                        touched_nodes.append(name)
+                    else:
+                        node._touched = False
+                        self._tensor_dirty.add(name)
                     cloned_nodes += 1
-                    self._tensor_dirty.add(name)
+                    tensor_rows.add(name)
             for uid, q in self.queues.items():
                 prev = None if full else self._snap_queues.get(uid)
                 if (prev is not None and uid not in self._dirty_queues
@@ -442,13 +486,54 @@ class SchedulerCache:
                     reused_jobs += 1
                 else:
                     ci.jobs[uid] = job.clone()
-                    job._touched = False
+                    if stage:
+                        touched_jobs.append(uid)
+                    else:
+                        job._touched = False
             for name, col in self.namespace_collections.items():
                 ci.namespaces[name] = col.snapshot()
             for job in ci.jobs.values():
                 ci.namespaces.setdefault(job.namespace,
                                          NamespaceInfo(job.namespace))
             ci.node_list = list(ci.nodes.values())
+            n_nodes = len(ci.nodes)
+            stats = {
+                "full": full,
+                "clone_s": time.perf_counter() - t0,
+                "dirty_nodes": cloned_nodes,
+                "reused_nodes": reused_nodes,
+                "reused_jobs": reused_jobs,
+                "dirty_ratio": (cloned_nodes / n_nodes) if n_nodes else 0.0,
+            }
+            if stage:
+                # clone maps and epoch untouched: stamp the epoch the
+                # snapshot WILL get if adopted, and hand back everything
+                # adopt needs. The dirty sets MOVE into the staged basis
+                # (_spec_dirt): post-stage mutations then accumulate in
+                # empty sets, so the commit boundary's delta is exact —
+                # including a re-mutation of a key that was already dirty
+                # at stage time (the cycle's own bind set).
+                ci.snap_epoch = self._snap_epoch + 1
+                staged = {
+                    "epoch": self._snap_epoch,
+                    "dirty_all": self._dirty_all,
+                    "incremental": incremental,
+                    "nodes": dict(ci.nodes),
+                    "jobs": dict(ci.jobs),
+                    "queues": dict(ci.queues),
+                    "dirty_nodes": frozenset(self._dirty_nodes),
+                    "dirty_jobs": frozenset(self._dirty_jobs),
+                    "dirty_queues": frozenset(self._dirty_queues),
+                    "touched_nodes": touched_nodes,
+                    "touched_jobs": touched_jobs,
+                    "tensor_rows": tensor_rows,
+                    "stats": stats,
+                }
+                self._spec_dirt = staged
+                self._dirty_nodes.clear()
+                self._dirty_jobs.clear()
+                self._dirty_queues.clear()
+                return ci, staged
             if incremental:
                 self._snap_nodes = dict(ci.nodes)
                 self._snap_jobs = dict(ci.jobs)
@@ -465,15 +550,6 @@ class SchedulerCache:
             self._dirty_queues.clear()
             self._snap_epoch += 1
             ci.snap_epoch = self._snap_epoch
-            n_nodes = len(ci.nodes)
-            stats = {
-                "full": full,
-                "clone_s": time.perf_counter() - t0,
-                "dirty_nodes": cloned_nodes,
-                "reused_nodes": reused_nodes,
-                "reused_jobs": reused_jobs,
-                "dirty_ratio": (cloned_nodes / n_nodes) if n_nodes else 0.0,
-            }
             self.last_snapshot_stats = stats
         from .. import metrics
         metrics.update_snapshot_stats(stats["dirty_nodes"],
@@ -481,6 +557,120 @@ class SchedulerCache:
         if full:
             metrics.register_snapshot_full_rebuild("clone")
         return ci
+
+    # -- speculative snapshot (docs/performance.md pipelining) --------------
+
+    def speculative_snapshot(self):
+        """Read-only clone-on-dirty snapshot for the pipelined shell's
+        speculative open: builds the same ClusterInfo ``snapshot()``
+        would, but consumes NOTHING — dirty sets, clone maps, epoch and
+        mutation witnesses all stay as they were, so the next real
+        ``snapshot()`` is unaffected whether the speculation commits or
+        is discarded. Returns ``(ci, staged)``;
+        ``adopt_speculative_snapshot(staged)`` promotes the staged
+        bookkeeping iff nothing mutated in between."""
+        from ..obs import trace as obs_trace
+        with obs_trace.span("snapshot_clone", speculative=True):
+            ci, staged = self._snapshot_impl(stage=True)
+        if self.snapshot_scope is not None:
+            ci = self.snapshot_scope(ci)
+        return ci, staged
+
+    def _reabsorb_spec_dirt_locked(self) -> None:
+        """Merge an outstanding speculative basis's moved dirty keys back
+        into the live dirty sets (caller holds the lock). Every real
+        snapshot build runs this first, so a snapshot taken while a
+        speculation is in flight — or after one was discarded without an
+        explicit restore — can never reuse a stale clone."""
+        sd = self._spec_dirt
+        if sd is None:
+            return
+        self._spec_dirt = None
+        self._dirty_nodes.update(sd["dirty_nodes"])
+        self._dirty_jobs.update(sd["dirty_jobs"])
+        self._dirty_queues.update(sd["dirty_queues"])
+
+    def discard_speculative_snapshot(self, staged) -> None:
+        """Give the staged basis's moved dirty keys back (conflict path /
+        abandoned speculation). No-op if a real snapshot already
+        reabsorbed them, or if a newer speculation staged since."""
+        with self._lock:
+            if self._spec_dirt is staged:
+                self._reabsorb_spec_dirt_locked()
+
+    def speculation_delta(self, staged) -> Dict[str, object]:
+        """What mutated since the speculative snapshot was staged — the
+        dirty keys accumulated since the stage moved the sets (exact:
+        re-mutations of stage-time-dirty keys show up too), plus whether
+        the snapshot epoch moved (another snapshot ran, or
+        invalidate_device_state fired). The conflict check at the
+        pipelined commit boundary is a pure function of this delta."""
+        with self._lock:
+            # a post-stage mark_all_dirty (drift repair, bulk external
+            # mutation) invalidates the staged clones wholesale without
+            # touching the key sets — treat it like an epoch move
+            stale = (self._spec_dirt is not staged
+                     or self._dirty_all != staged["dirty_all"])
+            return {
+                "epoch_moved": stale
+                or self._snap_epoch != staged["epoch"],
+                "nodes": set(self._dirty_nodes),
+                "jobs": set(self._dirty_jobs),
+                "queues": set(self._dirty_queues),
+            }
+
+    def adopt_speculative_snapshot(self, staged) -> bool:
+        """Promote a staged speculative snapshot to THE snapshot —
+        exactly what ``snapshot()`` would have produced had it run now,
+        because the precondition is that nothing mutated since staging
+        (epoch unchanged, zero dirty keys since the stage moved the
+        sets). Installs the clone maps, clears the witnesses the staged
+        build deferred, consumes the moved dirt and bumps the epoch.
+        Returns False (adopting nothing) on any mutation since staging —
+        the caller re-snapshots."""
+        with self._lock:
+            if self._spec_dirt is not staged \
+                    or self._snap_epoch != staged["epoch"] \
+                    or self._dirty_all != staged["dirty_all"] \
+                    or self._dirty_nodes or self._dirty_jobs \
+                    or self._dirty_queues:
+                return False
+            self._spec_dirt = None      # consumed: the clones embody it
+            if staged["incremental"]:
+                self._snap_nodes = dict(staged["nodes"])
+                self._snap_jobs = dict(staged["jobs"])
+                self._snap_queues = dict(staged["queues"])
+                self._dirty_all = False
+            else:
+                self._snap_nodes = {}
+                self._snap_jobs = {}
+                self._snap_queues = {}
+                self._dirty_all = True
+            # deferred witness resets: the same ``_touched = False`` the
+            # real snapshot performs at clone time. Sound here because
+            # every cache mutator dirty-marks (VT001), and new dirt
+            # refused adoption above.
+            for name in staged["touched_nodes"]:
+                node = self.nodes.get(name)
+                if node is not None:
+                    node._touched = False
+            for uid in staged["touched_jobs"]:
+                job = self.jobs.get(uid)
+                if job is not None:
+                    job._touched = False
+            self._tensor_dirty.update(staged["tensor_rows"])
+            self._dirty_nodes.clear()
+            self._dirty_jobs.clear()
+            self._dirty_queues.clear()
+            self._snap_epoch += 1
+            stats = staged["stats"]
+            self.last_snapshot_stats = stats
+        from .. import metrics
+        metrics.update_snapshot_stats(stats["dirty_nodes"],
+                                      stats["dirty_ratio"])
+        if stats["full"]:
+            metrics.register_snapshot_full_rebuild("clone")
+        return True
 
     def tensor_refresh(self, snapshot_nodes: Dict[str, NodeInfo], rnames,
                        snap_epoch: Optional[int] = None):
@@ -511,6 +701,35 @@ class SchedulerCache:
             from .. import metrics
             metrics.register_snapshot_full_rebuild("tensor")
         return tc
+
+    def tensor_refresh_speculative(self, snapshot_nodes: Dict[str, NodeInfo],
+                                   rnames, staged):
+        """Device tensors for a SPECULATIVE snapshot (docs/performance.md
+        pipelining): scatter the union of the pending tensor-dirty rows
+        and the staged clone rows onto the persistent mirrors — a
+        value-idempotent write; the next REAL refresh re-applies the same
+        rows because ``_tensor_dirty`` is deliberately NOT consumed here
+        — then pin the resulting epoch so the in-flight solve keeps a
+        stable A while cycle N's binds publish B. Returns the pinned
+        ``TensorEpochView`` (caller must ``retire_epoch`` it), or None
+        when the incremental path is unavailable."""
+        if not incremental_snapshot_enabled():
+            return None
+        from .snapshot import PersistentNodeTensors
+        with self._lock:
+            if staged["epoch"] != self._snap_epoch:
+                return None
+            tc = self.tensor_cache
+            if tc is None or tc.rnames.names != rnames.names:
+                tc = PersistentNodeTensors(rnames)
+                self.tensor_cache = tc
+            dirty = set(self._tensor_dirty) | set(staged["tensor_rows"])
+            stats = tc.refresh(snapshot_nodes, dirty)
+            view = tc.pin_epoch()
+        if stats["full"]:
+            from .. import metrics
+            metrics.register_snapshot_full_rebuild("tensor")
+        return view
 
     def invalidate_device_state(self) -> None:
         """Device-fault containment (docs/robustness.md): after an XLA

@@ -90,6 +90,51 @@ def _delta_bucket(n: int) -> int:
     return b
 
 
+class TensorEpochView:
+    """One PINNED epoch of the double-buffered device pair
+    (docs/performance.md, pipelining). JAX arrays are immutable, so the
+    A/B pair falls out of functional updates: ``pin_epoch`` freezes
+    references to the CURRENT device arrays (epoch A) and every later
+    scatter/rebuild publishes NEW arrays into the owner (epoch B) without
+    disturbing A. The view also freezes the row maps and value-copies
+    of the host mirrors, so an in-flight speculative solve keeps reading
+    a stable snapshot while cycle N's binds scatter-update the live
+    epoch. Duck-types the ``NodeTensors`` surface the solve consumes
+    (names/index/host arrays/node_state/device_allocatable/
+    device_max_tasks). Retire through ``PersistentNodeTensors
+    .retire_epoch`` on commit or discard — the live-pin gauge
+    (``volcano_tensor_epochs_live``) is how a leak shows up."""
+
+    def __init__(self, owner: "PersistentNodeTensors", epoch: int,
+                 device: dict, names: List[str], index: Dict[str, int],
+                 rnames: ResourceNames, host: Dict[str, np.ndarray]):
+        self._owner = owner
+        self.epoch = epoch
+        self._device = device
+        self.names = names
+        self.index = index
+        self.rnames = rnames
+        for f, arr in host.items():
+            setattr(self, f, arr)
+        self._node_state: Optional[NodeState] = None
+        self.retired = False
+
+    def node_state(self) -> NodeState:
+        if self._node_state is None:
+            from ..ops.place import make_node_state
+            dev = self._device
+            self._node_state = make_node_state(
+                dev["idle"], dev["releasing"], dev["pipelined"],
+                dev["used"], dev["ntasks"])
+        return self._node_state
+
+    def device_allocatable(self):
+        return self._device["allocatable"]
+
+    def device_max_tasks(self):
+        return self._device["max_tasks"]
+
+
 class PersistentNodeTensors:
     """NodeTensors that survive across scheduling cycles.
 
@@ -108,7 +153,15 @@ class PersistentNodeTensors:
     observable via ``volcano_snapshot_full_rebuilds_total{layer="tensor"}``.
 
     Duck-types ``NodeTensors`` (names/index/arrays/node_state) so every
-    consumer of the per-cycle build works unchanged."""
+    consumer of the per-cycle build works unchanged.
+
+    Epoch pair (docs/performance.md pipelining): ``epoch`` counts device
+    publishes (every scatter or full rebuild); ``pin_epoch`` hands an
+    in-flight speculative solve a frozen ``TensorEpochView`` of the
+    current epoch, and subsequent publishes leave the pinned arrays
+    untouched (functional ``.at[].set`` allocates fresh buffers). The
+    pin/retire protocol exists so epoch lifetime is explicit and
+    observable, not implied by GC."""
 
     def __init__(self, rnames: ResourceNames, rebuild_ratio: float = 0.5):
         self.rnames = rnames
@@ -127,6 +180,9 @@ class PersistentNodeTensors:
         self._device: Optional[dict] = None  # field -> jnp array
         self._node_state: Optional[NodeState] = None
         self.last_refresh: Dict[str, object] = {}
+        # epoch-pair bookkeeping (publish/retire protocol)
+        self.epoch = 0
+        self.live_pins = 0
 
     _ROW_FIELDS = ("idle", "used", "releasing", "pipelined", "allocatable",
                    "max_tasks", "ntasks")
@@ -163,6 +219,7 @@ class PersistentNodeTensors:
             self._write_row(i, node)
         self._device = None
         self._node_state = None
+        self.epoch += 1                      # publish: next upload is B
 
     def refresh(self, nodes: Dict[str, NodeInfo],
                 changed: Set[str]) -> Dict[str, object]:
@@ -223,6 +280,9 @@ class PersistentNodeTensors:
         for f in self._ROW_FIELDS:
             dev[f] = dev[f].at[idx].set(jnp.asarray(getattr(self, f)[idx_np]))
         self._node_state = None
+        # publish: ``.at[].set`` allocated FRESH device arrays, so any
+        # pinned TensorEpochView keeps reading the pre-scatter epoch
+        self.epoch += 1
 
     def _ensure_device(self) -> dict:
         if self._device is None:
@@ -246,6 +306,52 @@ class PersistentNodeTensors:
 
     def device_max_tasks(self):
         return self._ensure_device()["max_tasks"]
+
+    # -- epoch pair (docs/performance.md pipelining) ------------------------
+
+    _HOST_FIELDS = ("idle", "used", "releasing", "pipelined", "allocatable",
+                    "max_tasks", "ntasks")
+
+    def pin_epoch(self) -> TensorEpochView:
+        """Freeze the CURRENT epoch for an in-flight speculative solve:
+        device array references (immutable — later scatters publish new
+        arrays), copies of the host mirrors, and the row maps. The caller
+        MUST pair this with ``retire_epoch`` on commit or discard."""
+        dev = dict(self._ensure_device())
+        view = TensorEpochView(
+            self, self.epoch, dev, list(self.names), dict(self.index),
+            self.rnames,
+            {f: getattr(self, f).copy() for f in self._HOST_FIELDS})
+        self.live_pins += 1
+        from .. import metrics
+        metrics.set_tensor_epochs_live(self.live_pins)
+        return view
+
+    def retire_epoch(self, view: Optional[TensorEpochView]) -> None:
+        """Release one pinned epoch (idempotent per view): drops the
+        bookkeeping so the live-pin gauge stays honest; the arrays free
+        whenever the last holder lets go."""
+        if view is None or view.retired:
+            return
+        view.retired = True
+        self.live_pins = max(self.live_pins - 1, 0)
+        from .. import metrics
+        metrics.set_tensor_epochs_live(self.live_pins)
+
+    def prewarm_epoch_pair(self) -> None:
+        """Pay the cold epoch-pair costs at startup instead of inside the
+        first pipelined cycle (the 708ms-vs-470ms first-churn-cycle
+        outlier): the initial device upload, the pinned view's host-mirror
+        copies, and the pinned ``node_state`` future-idle program all
+        allocate here, so ``pin_epoch`` on the live path is pure
+        bookkeeping."""
+        if not self.names:
+            return
+        view = self.pin_epoch()
+        try:
+            view.node_state()
+        finally:
+            self.retire_epoch(view)
 
     def prewarm_delta(self, sizes: Sequence[int]) -> int:
         """Compile the padded scatter-update programs for the given dirty

@@ -80,7 +80,7 @@ def _gc_resume(window: "_GCWindow" = None) -> None:
 
 def open_session(cache, tiers: List[Tier],
                  configurations: List[Configuration] = (),
-                 time_fn=None) -> Session:
+                 time_fn=None, speculative: bool = False) -> Session:
     # Automatic (threshold-triggered) garbage collection is suspended for
     # the lifetime of the session: a cycle at 10k pods allocates enough
     # tracked objects (Resources, task clones, statement entries) to trip
@@ -96,11 +96,25 @@ def open_session(cache, tiers: List[Tier],
     # burst, and a gen-2 collection tripping mid-clone was half the
     # cold-open jitter (measured: 116ms -> 380ms snapshot swings with
     # automatic GC live)
+    # SPECULATIVE open (docs/performance.md pipelining): same plugin
+    # lifecycle and its OWN nested GC window — it must consume neither
+    # the real session's window nor its plugin callbacks — but the
+    # snapshot is the cache's read-only STAGED build, so dirty sets,
+    # clone maps and epoch stay untouched until the pipelined shell
+    # either adopts (promotion) or discards the speculation.
     window = _gc_suspend()
     try:
-        with obs_trace.span("snapshot"):
-            ssn = Session(cache, tiers, list(configurations),
-                          time_fn=time_fn)
+        if speculative:
+            with obs_trace.span("snapshot", speculative=True):
+                ci, basis = cache.speculative_snapshot()
+                ssn = Session(cache, tiers, list(configurations),
+                              time_fn=time_fn, snapshot=ci)
+                ssn.speculative = True
+                ssn.spec_basis = basis
+        else:
+            with obs_trace.span("snapshot"):
+                ssn = Session(cache, tiers, list(configurations),
+                              time_fn=time_fn)
         for tier in tiers:
             for opt in tier.plugins:
                 builder = get_plugin_builder(opt.name)
@@ -126,15 +140,31 @@ def open_session(cache, tiers: List[Tier],
     return ssn
 
 
+def _retire_session_pin(ssn: Session) -> None:
+    """Release the session's pinned tensor epoch, if any (speculative
+    sessions pin one for the in-flight solve). Idempotent."""
+    view = getattr(ssn, "_pinned_epoch", None)
+    if view is None:
+        return
+    ssn._pinned_epoch = None
+    try:
+        view._owner.retire_epoch(view)
+    except Exception:  # pragma: no cover - defensive
+        pass
+
+
 def abandon_session(ssn: Session) -> None:
     """Session ROLLBACK path (docs/robustness.md HA section): release the
     session's GC window WITHOUT the close-time writebacks — no plugin
     on_session_close, no podgroup status flush. Used when a leader is
-    demoted mid-cycle: the session's decision state must not be
-    half-applied by a replica that no longer owns it. Side effects already
-    executed through the cache funnels stand (they carried a then-valid
-    fencing epoch); everything session-local is simply dropped.
+    demoted mid-cycle (the session's decision state must not be
+    half-applied by a replica that no longer owns it) and when the
+    pipelined shell discards a conflicted speculation. Side effects
+    already executed through the cache funnels stand (they carried a
+    then-valid fencing epoch); everything session-local is simply
+    dropped, including any pinned tensor epoch.
     Idempotent, like close_session's window resume."""
+    _retire_session_pin(ssn)
     _gc_resume(getattr(ssn, "_gc_window", None))
 
 
@@ -154,5 +184,7 @@ def close_session(ssn: Session) -> None:
         # idempotent per window: a double close (or the leak finalizer
         # firing later) cannot steal another live session's suspension.
         # Sessions not built by open_session carry no window — legacy
-        # most-recent-window resume.
+        # most-recent-window resume. A promoted speculative session's
+        # pin is normally retired at commit; this is the leak backstop.
+        _retire_session_pin(ssn)
         _gc_resume(getattr(ssn, "_gc_window", None))

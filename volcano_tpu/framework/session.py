@@ -58,11 +58,22 @@ class EventHandler:
 class Session:
     def __init__(self, cache, tiers: List[Tier],
                  configurations: List[Configuration],
-                 time_fn: Optional[Callable[[], float]] = None):
+                 time_fn: Optional[Callable[[], float]] = None,
+                 snapshot: Optional[ClusterInfo] = None):
         self.uid = str(uuid.uuid4())
         self.cache = cache
         self.tiers = tiers
         self.configurations = configurations
+        # speculative sessions (docs/performance.md pipelining) are
+        # opened on a read-only staged snapshot
+        # (cache.speculative_snapshot); open_session flips these. A
+        # speculative session either PROMOTES (the pipelined shell's
+        # conflict check passed — speculative cleared, the staged
+        # snapshot adopted, the session becomes the cycle's real one) or
+        # is abandoned without close-time writebacks.
+        self.speculative = False
+        self.spec_basis = None          # staged-snapshot bookkeeping
+        self._pinned_epoch = None       # TensorEpochView held for a solve
         # Injectable session clock (vlint VT002, docs/simulation.md):
         # plugin decision callbacks (sla deadlines, tdm zone windows, gang
         # condition timestamps) read "now" through ssn.now() instead of
@@ -74,7 +85,8 @@ class Session:
         import time as _time
         self._time_fn: Callable[[], float] = time_fn or _time.time
 
-        snapshot: ClusterInfo = cache.snapshot()
+        if snapshot is None:
+            snapshot = cache.snapshot()
         self.jobs: Dict[str, JobInfo] = snapshot.jobs
         self.nodes: Dict[str, NodeInfo] = snapshot.nodes
         # which snapshot generation this session was opened on — the
@@ -476,14 +488,32 @@ class Session:
         statement replays, mid-cycle consumers (stateful re-solve rounds,
         preempt/reclaim) must marshal from the live session objects
         instead. Returns None whenever the incremental path cannot prove
-        itself exact; callers fall back to a from-scratch NodeTensors."""
-        refresh = getattr(self.cache, "tensor_refresh", None)
-        if refresh is None:
-            return None
+        itself exact; callers fall back to a from-scratch NodeTensors.
+
+        SPECULATIVE sessions route through the cache's staged refresh
+        (``tensor_refresh_speculative``): the scatter is value-idempotent
+        and nothing is consumed, and the returned ``TensorEpochView`` is
+        the PINNED epoch the in-flight solve reads while later binds
+        publish the other half of the pair — held on the session for the
+        shell to retire at commit/discard."""
+        if self.speculative:
+            refresh = getattr(self.cache, "tensor_refresh_speculative",
+                              None)
+            if refresh is None or self.spec_basis is None:
+                return None
+        else:
+            refresh = getattr(self.cache, "tensor_refresh", None)
+            if refresh is None:
+                return None
         for node in self.nodes.values():
             if getattr(node, "_touched", True):
                 return None
         try:
+            if self.speculative:
+                view = refresh(self.nodes, rnames, self.spec_basis)
+                if view is not None:
+                    self._pinned_epoch = view
+                return view
             return refresh(self.nodes, rnames, self.snap_epoch)
         except Exception as exc:
             import logging

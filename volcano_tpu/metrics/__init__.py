@@ -159,6 +159,21 @@ if _HAVE_PROM:
         f"{_SUBSYSTEM}_admission_batch_size",
         "Jobs per batched admission submit (docs/federation.md)",
         buckets=(1, 4, 16, 64, 256, 1024, 4096))
+    _speculation = Counter(
+        f"{_SUBSYSTEM}_speculation_total",
+        "Pipelined-cycle speculation outcomes at the commit boundary "
+        "(hit|partial|conflict; docs/performance.md)", ["outcome"])
+    _fast_admit_g = Counter(
+        f"{_SUBSYSTEM}_fast_admit_gangs_total",
+        "Gangs bound by the event-driven fast-admit path between full "
+        "cycles (docs/performance.md)")
+    _fast_admit_b = Counter(
+        f"{_SUBSYSTEM}_fast_admit_binds_total",
+        "Tasks bound by the event-driven fast-admit path")
+    _tensor_epochs = Gauge(
+        f"{_SUBSYSTEM}_tensor_epochs_live",
+        "Pinned PersistentNodeTensors epochs currently live (the A side "
+        "of the double-buffered pair; >1 sustained is a retire leak)")
 
 
 def update_e2e_duration(seconds: float) -> None:
@@ -223,6 +238,49 @@ def health_detail() -> dict:
                 k[1]: v for k, v in _counters.items()
                 if k[0] == "cross_partition_reserves"},
         }
+
+
+def register_speculation(outcome: str) -> None:
+    """One pipelined-cycle conflict-check verdict: ``hit`` (the
+    speculative solve committed, snapshot promoted), ``partial`` (the
+    solve replayed onto a fresh snapshot, suffix re-solved), or
+    ``conflict`` (speculation discarded, cycle re-solved serially).
+    The issue-named series volcano_speculation_{hits,conflicts}_total
+    are the outcome="hit"/"conflict" samples of this counter."""
+    with _lock:
+        _counters[("speculation", outcome)] += 1
+    if _HAVE_PROM:
+        _speculation.labels(outcome=outcome).inc()
+
+
+def speculation_counts() -> Dict[str, float]:
+    """Current speculation outcome counts {outcome: n} (bench/sim read
+    these; take a before/after delta for per-run rates)."""
+    with _lock:
+        return {k[1]: v for k, v in _counters.items()
+                if k[0] == "speculation"}
+
+
+def register_fast_admit(gangs: int, binds: int) -> None:
+    with _lock:
+        _counters[("fast_admit_gangs",)] += gangs
+        _counters[("fast_admit_binds",)] += binds
+    if _HAVE_PROM:
+        _fast_admit_g.inc(gangs)
+        _fast_admit_b.inc(binds)
+
+
+def fast_admit_counts() -> Dict[str, float]:
+    with _lock:
+        return {"gangs": _counters.get(("fast_admit_gangs",), 0.0),
+                "binds": _counters.get(("fast_admit_binds",), 0.0)}
+
+
+def set_tensor_epochs_live(n: int) -> None:
+    with _lock:
+        _gauges[("tensor_epochs_live",)] = float(n)
+    if _HAVE_PROM:
+        _tensor_epochs.set(n)
 
 
 def register_action_failure(action: str) -> None:
@@ -432,6 +490,7 @@ _EXPO_GAUGES = {
     "device_healthy": (f"{_SUBSYSTEM}_device_healthy", None),
     "leader": (f"{_SUBSYSTEM}_leader", None),
     "partition_leader": (f"{_SUBSYSTEM}_partition_leader", "partition"),
+    "tensor_epochs_live": (f"{_SUBSYSTEM}_tensor_epochs_live", None),
 }
 _EXPO_COUNTERS = {
     "attempts": (f"{_SUBSYSTEM}_schedule_attempts_total", "result"),
@@ -452,6 +511,9 @@ _EXPO_COUNTERS = {
     "failovers": (f"{_SUBSYSTEM}_failovers_total", None),
     "cross_partition_reserves": (
         f"{_SUBSYSTEM}_cross_partition_reserves_total", "result"),
+    "speculation": (f"{_SUBSYSTEM}_speculation_total", "outcome"),
+    "fast_admit_gangs": (f"{_SUBSYSTEM}_fast_admit_gangs_total", None),
+    "fast_admit_binds": (f"{_SUBSYSTEM}_fast_admit_binds_total", None),
 }
 # duration-series key -> (family, label name, unit suffix already in name)
 _EXPO_DURATIONS = {

@@ -36,6 +36,42 @@ log = logging.getLogger(__name__)
 
 DEFAULT_SCHEDULE_PERIOD = 1.0
 
+
+def _env_flag(name: str) -> bool:
+    return os.environ.get(name, "").lower() in ("1", "true", "on", "yes")
+
+
+class _Speculation:
+    """One in-flight speculation: the speculative session (read-only
+    staged snapshot, own GC window) and the dispatched-but-unfetched
+    solve. Purely in-memory — nothing is journaled until the commit
+    boundary, so a crash between dispatch and commit loses exactly this
+    object and nothing else (the zero-double-binds contract of the
+    pipelined chaos soak)."""
+
+    __slots__ = ("ssn", "pending", "engine")
+
+    def __init__(self, ssn, pending, engine: str):
+        self.ssn = ssn
+        self.pending = pending
+        self.engine = engine
+
+
+class _SpecCommitPlan:
+    """A conflict-check verdict that lets the speculation commit: carried
+    into the cycle's allocate slot, where _commit_speculation awaits the
+    solve and replays it. ``promoted`` means the speculative session
+    itself became the cycle's session (full hit)."""
+
+    __slots__ = ("pending", "engine", "outcome", "spec_ssn", "promoted")
+
+    def __init__(self, spec: _Speculation, outcome: str, promoted: bool):
+        self.pending = spec.pending
+        self.engine = spec.engine
+        self.outcome = outcome
+        self.spec_ssn = spec.ssn
+        self.promoted = promoted
+
 # crash-loop guard defaults: first failed cycle waits backoff_base, each
 # consecutive failure doubles it up to backoff_max, each wait is stretched
 # by up to backoff_jitter (uniform) so a fleet of replicas crash-looping on
@@ -106,7 +142,9 @@ class Scheduler:
                  backoff_jitter: float = DEFAULT_BACKOFF_JITTER,
                  clock=None,
                  drift_verify_every: Optional[int] = None,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 pipelined: Optional[bool] = None,
+                 fast_admit: Optional[bool] = None):
         # actions/plugins register on import
         from . import actions as _actions  # noqa: F401
         from . import plugins as _plugins  # noqa: F401
@@ -165,6 +203,38 @@ class Scheduler:
         # on_cycle_end in the epilogue — and only while this replica
         # leads its partition (the hooks sit behind the HA gate).
         self.federation = None
+        # pipelined scheduling (docs/performance.md): overlap cycle N+1's
+        # device solve with cycle N's host commit via a speculative
+        # session + conflict check at the commit boundary. Standalone
+        # single-scheduler mode only — with an elector or federation
+        # attached the shell silently runs serial cycles (leadership and
+        # partition boundaries change between cycles; a speculation
+        # cannot carry across them).
+        self.pipelined = _env_flag("VOLCANO_TPU_PIPELINED") \
+            if pipelined is None else bool(pipelined)
+        self._spec: Optional[_Speculation] = None
+        # sim hook (docs/simulation.md): called with the in-flight
+        # _Speculation right after dispatch, so a seeded SimKill can land
+        # BETWEEN speculative dispatch and commit — the adversarial point
+        # where only speculative state may be lost.
+        self.spec_fault_hook: Optional[Callable] = None
+        # introspection for bench/tests: outcome of the last pipelined
+        # commit ({"outcome": hit|partial|conflict|none, ...})
+        self.last_speculation: dict = {}
+        # event-driven fast-admit (docs/performance.md): bind
+        # trivially-fitting gangs between full cycles through the
+        # journaled+fenced bind funnel
+        self.fast_admit_enabled = _env_flag("VOLCANO_TPU_FAST_ADMIT") \
+            if fast_admit is None else bool(fast_admit)
+        if self.fast_admit_enabled \
+                and hasattr(self.cache, "fast_admit_feed"):
+            self.cache.fast_admit_feed = True
+        self._fast_admit_audit: list = []
+        # warm-start witness (docs/performance.md): did the LAST cycle's
+        # allocate fixpoint converge at the empty admitted set? Tracked
+        # here per cycle — the module-global LAST_STATS is overwritten by
+        # the commit path's suffix run, so it cannot serve as the witness
+        self._warmstart_empty = False
         self._load_conf(conf_text)
 
     # -- HA role state machine (docs/robustness.md) --------------------------
@@ -346,16 +416,43 @@ class Scheduler:
             # resync retries above still journaled side effects, and the
             # drift cadence must keep counting — the short-circuit skips
             # only the snapshot/session work
+            self._discard_speculation("conflict")
             self._cycle_epilogue()
             return errors
+        # pipelined commit boundary (docs/performance.md): decide what the
+        # in-flight speculation is worth BEFORE opening anything — a full
+        # hit promotes the speculative session (the staged snapshot is
+        # adopted and no real open runs at all); a tolerable delta opens a
+        # fresh session and replays the speculative solve onto it; any
+        # real divergence discards the speculation and the cycle re-solves
+        # serially.
+        pipelined = (self.pipelined and self.elector is None
+                     and self.federation is None)
+        ssn = None
+        commit = None
+        if self._spec is not None:
+            spec, self._spec = self._spec, None
+            if pipelined and any(n in ("allocate", "allocate-tpu")
+                                 for n, _ in runnable):
+                with rec.span("conflict_check"):
+                    ssn, commit = self._check_speculation(rec, spec)
+            else:
+                self._abandon_speculation(spec, "conflict")
         sched_sp = rec.span("schedule")
         crashed = False
         demoted = False
         with sched_sp:
-            with rec.span("open_session"):
-                ssn = open_session(self.cache, self.conf.tiers,
-                                   self.conf.configurations,
-                                   time_fn=self.clock.now)
+            if ssn is None:
+                with rec.span("open_session"):
+                    ssn = open_session(self.cache, self.conf.tiers,
+                                       self.conf.configurations,
+                                       time_fn=self.clock.now)
+            if self._fast_admit_audit and obs_audit.AUDIT.enabled:
+                # fast-admit binds since the last cycle ride this cycle's
+                # audit harvest (their jobs read "admitted" with the bind
+                # count they earned between cycles)
+                ssn.audit_events.extend(self._fast_admit_audit)
+                self._fast_admit_audit.clear()
             try:
                 for name, action in runnable:
                     if self._demoted_mid_cycle():
@@ -378,7 +475,18 @@ class Scheduler:
                             try:
                                 if self.action_fault_hook is not None:
                                     self.action_fault_hook(name, ssn)
-                                action.execute(ssn)
+                                if commit is not None and name in (
+                                        "allocate", "allocate-tpu"):
+                                    plan, commit = commit, None
+                                    self._commit_speculation(ssn, plan,
+                                                             action)
+                                elif pipelined and name in (
+                                        "allocate", "allocate-tpu"):
+                                    action.execute(ssn)
+                                    self._warmstart_empty = bool(
+                                        self._allocate_kept_empty())
+                                else:
+                                    action.execute(ssn)
                             except Exception as exc:
                                 log.exception("action %s failed; skipping "
                                               "it this cycle", name)
@@ -432,6 +540,11 @@ class Scheduler:
                     obs_audit.harvest_cycle(ssn, cycle, self.clock.time())
             except Exception:
                 log.exception("decision-audit harvest failed")
+        # stage 2 of the pipeline: dispatch cycle N+1's speculative solve
+        # while this cycle's tail (epilogue, pacing sleep, fast-admit) and
+        # the device transfer overlap. Outside the e2e-timed window.
+        if pipelined and not demoted:
+            self._dispatch_speculation(rec, runnable)
         self._cycle_epilogue()
         return errors
 
@@ -454,6 +567,392 @@ class Scheduler:
                     log.exception("federation cycle-end hook failed")
                     metrics.register_action_failure("federation")
             self._maybe_verify_drift()
+
+    # -- pipelined speculation (docs/performance.md) -------------------------
+
+    def _check_speculation(self, rec, spec: _Speculation):
+        """The commit-boundary conflict check: diff what actually mutated
+        since the speculative snapshot was staged against what the
+        speculation assumed. Returns ``(session_or_None, plan_or_None)``:
+
+        - CLEAN (no mutation at all): the staged snapshot is adopted and
+          the speculative session PROMOTES to this cycle's real session —
+          no open_session runs.
+        - TOLERABLE delta (only decision-neutral changes — bind acks,
+          plus brand-new jobs the suffix solve will cover): a fresh
+          session opens and the speculative solve replays onto it by uid.
+        - anything else: the speculation is discarded (conflict) and the
+          cycle re-solves serially.
+        """
+        delta = self.cache.speculation_delta(spec.ssn.spec_basis)
+        clean = not (delta["epoch_moved"] or delta["nodes"]
+                     or delta["jobs"] or delta["queues"])
+        if clean and self.cache.adopt_speculative_snapshot(
+                spec.ssn.spec_basis):
+            ssn = spec.ssn
+            ssn.speculative = False     # promoted: the cycle's real session
+            return ssn, _SpecCommitPlan(spec, "hit", promoted=True)
+        if clean or delta["epoch_moved"] or delta["queues"]:
+            # clean-but-adopt-refused is a stage/adopt race; epoch or
+            # queue movement is never tolerable (ordering/overuse inputs)
+            self._abandon_speculation(spec, "conflict")
+            return None, None
+        with rec.span("open_session"):
+            ssn = open_session(self.cache, self.conf.tiers,
+                               self.conf.configurations,
+                               time_fn=self.clock.now)
+        if not self._delta_tolerable(spec, ssn, delta):
+            self._abandon_speculation(spec, "conflict")
+            return ssn, None
+        plan = _SpecCommitPlan(spec, "partial", promoted=False)
+        # the solution objects live on through the plan's pending; the
+        # speculative session itself (GC window, pinned epoch) releases
+        # now — nothing journaled, nothing half-applied
+        abandon_session(spec.ssn)
+        return ssn, plan
+
+    def _delta_tolerable(self, spec: _Speculation, ssn, delta) -> bool:
+        """May the speculative solve still commit onto ``ssn`` despite the
+        delta? True iff every changed node/known job is DECISION-EQUAL
+        between the speculative and the fresh snapshot (bind acks —
+        BOUND→RUNNING — are the canonical tolerable delta: resource
+        accounting, pending sets and gang counters all unchanged), and
+        every other changed job is NEW (unknown at speculation time; the
+        commit's suffix solve owns those)."""
+        sspec = spec.ssn
+        for name in delta["nodes"]:
+            a = sspec.nodes.get(name)
+            b = ssn.nodes.get(name)
+            if a is None and b is None:
+                continue
+            if a is None or b is None \
+                    or not self._node_decision_equal(a, b):
+                return False
+        for uid in delta["jobs"]:
+            a = sspec.jobs.get(uid)
+            if a is None:
+                continue                    # new job: suffix solve covers it
+            b = ssn.jobs.get(uid)
+            if b is None or not self._job_decision_equal(a, b):
+                return False
+        return True
+
+    @staticmethod
+    def _node_decision_equal(a, b) -> bool:
+        """Do two snapshot clones of one node feed the solve identical
+        inputs? Compares exactly what reaches the kernels and the mask
+        builders (accounting vectors, capacity, schedulability, task
+        population) — NOT task statuses, which is what makes bind acks
+        tolerable."""
+        if (a.allocatable is not b.allocatable
+                or a.unschedulable != b.unschedulable
+                or a.ready != b.ready
+                or a.max_task_num != b.max_task_num
+                or len(a.tasks) != len(b.tasks)
+                or set(a.tasks) != set(b.tasks)
+                or a.used_ports != b.used_ports):
+            return False
+        for f in ("idle", "used", "releasing", "pipelined"):
+            if getattr(a, f) != getattr(b, f):
+                return False
+        return True
+
+    @staticmethod
+    def _job_decision_equal(a, b) -> bool:
+        from .api import TaskStatus
+        if (a.queue != b.queue or a.priority != b.priority
+                or a.min_available != b.min_available
+                or a.podgroup is None or b.podgroup is None
+                or a.podgroup.phase != b.podgroup.phase
+                or a.ready_task_num() != b.ready_task_num()
+                or a.waiting_task_num() != b.waiting_task_num()):
+            return False
+        return set(a.task_status_index.get(TaskStatus.PENDING, {})) \
+            == set(b.task_status_index.get(TaskStatus.PENDING, {}))
+
+    def _commit_speculation(self, ssn, plan: "_SpecCommitPlan",
+                            action) -> None:
+        """The allocate slot of a pipelined cycle whose conflict check
+        passed: await the speculative solve (its one sanctioned
+        readback), re-anchor it onto the session by uid, continue the
+        serial fixpoint from it (gang rollbacks re-solve exactly as the
+        serial cycle would), then suffix-solve the jobs the speculation
+        could not know about. Every placement replays through the same
+        Statement/bind funnels as a serial cycle — speculation changes
+        WHEN the solve ran, never how its decisions commit. Any failure
+        inside the speculative machinery downgrades to the configured
+        serial action within the same cycle."""
+        from .actions import allocate as alloc
+        alloc.LAST_FALLBACK.clear()
+        mapped = ordered = None
+        try:
+            sol = alloc.finalize_speculative_dispatch(plan.pending)
+            mapped, ordered = alloc.remap_speculative_solution(
+                sol, plan.pending.ordered_jobs, ssn)
+        except Exception:
+            log.exception("speculative solve unusable; re-solving the "
+                          "cycle serially")
+        if mapped is None:
+            self._finish_speculation(plan, "conflict")
+            action.execute(ssn)
+            self._warmstart_empty = self._allocate_kept_empty()
+            return
+        hint = plan.pending.assumed_hint
+        if hint is not None:
+            # warm-started speculation: sound ONLY if the fixpoint stayed
+            # where the warm-start assumed (kept == hint, i.e. the
+            # saturated ∅ fixpoint). Anything else re-solves serially —
+            # continuing from a foreign premise could diverge from the
+            # serial trajectory on an otherwise-clean cycle.
+            kept = {mapped.jobs_list[jx].uid
+                    for jx in range(len(mapped.jobs_list))
+                    if mapped.job_kept[jx]}
+            if kept != hint:
+                self._finish_speculation(plan, "conflict")
+                action.execute(ssn)
+                self._warmstart_empty = self._allocate_kept_empty()
+                return
+        kernel = "scan" if plan.engine == "tpu-scan" else "auto"
+        with obs_trace.TRACE.span("speculate_commit",
+                                  outcome=plan.outcome):
+            alloc._execute_fused(ssn, kernel=kernel, first_solution=mapped,
+                                 first_ordered=ordered, first_assumed=hint)
+            # the warm-start witness must be the MAIN fixpoint's verdict;
+            # read it before the suffix run overwrites LAST_STATS
+            main_empty = bool(alloc.LAST_STATS.get("final_kept_empty"))
+            suffix = ({j.uid for j in alloc._eligible_jobs(ssn)}
+                      - plan.pending.eligible_uids)
+            if suffix:
+                alloc._execute_fused(ssn, kernel=kernel, only_jobs=suffix)
+                # a suffix that ADMITTED jobs moved the fixpoint: the ∅
+                # warm-start would only be discarded at the next commit
+                main_empty = main_empty and bool(
+                    alloc.LAST_STATS.get("final_kept_empty"))
+            self._warmstart_empty = main_empty
+        self._finish_speculation(plan, plan.outcome)
+
+    def _finish_speculation(self, plan: "_SpecCommitPlan",
+                            outcome: str) -> None:
+        from .framework.framework import _retire_session_pin
+        _retire_session_pin(plan.spec_ssn)
+        metrics.register_speculation(outcome)
+        self.last_speculation = {"outcome": outcome,
+                                 "promoted": plan.promoted}
+
+    def _abandon_speculation(self, spec: _Speculation,
+                             outcome: str) -> None:
+        basis = spec.ssn.spec_basis
+        abandon_session(spec.ssn)       # retires the pinned epoch too
+        if basis is not None:
+            # give the moved dirty keys back (no-op if a real snapshot
+            # already reabsorbed them)
+            discard = getattr(self.cache, "discard_speculative_snapshot",
+                              None)
+            if discard is not None:
+                discard(basis)
+        metrics.register_speculation(outcome)
+        self.last_speculation = {"outcome": outcome, "promoted": False}
+
+    def _discard_speculation(self, outcome: str) -> None:
+        if self._spec is not None:
+            spec, self._spec = self._spec, None
+            self._abandon_speculation(spec, outcome)
+
+    @staticmethod
+    def _allocate_kept_empty() -> bool:
+        from .actions.allocate import LAST_STATS
+        return bool(LAST_STATS.get("final_kept_empty"))
+
+    def _allocate_engine(self, runnable) -> Optional[str]:
+        """The engine the allocate slot will run, when it is one the
+        dispatch/await split supports (the scan-kernel fused paths)."""
+        for name, action in runnable:
+            if name not in ("allocate", "allocate-tpu"):
+                continue
+            engine = getattr(action, "engine", None) or "callbacks"
+            for c in self.conf.configurations:
+                if c.name in (name, "allocate"):
+                    engine = c.arguments.get("engine", engine)
+            return engine if engine in ("tpu-fused", "tpu-scan") else None
+        return None
+
+    def _dispatch_speculation(self, rec, runnable) -> None:
+        """Stage 2 of the pipeline: open a speculative session on the
+        post-commit state and DISPATCH cycle N+1's solve. jax async
+        dispatch returns immediately, so the device crunches while the
+        host finishes the epilogue and sleeps out the period. Nothing
+        here touches the journal or the executors (vlint VT015): a crash
+        between this dispatch and the next commit loses only the
+        speculation."""
+        engine = self._allocate_engine(runnable)
+        if engine is None:
+            return
+        with rec.span("speculate", engine=engine):
+            sssn = None
+            try:
+                sssn = open_session(self.cache, self.conf.tiers,
+                                    self.conf.configurations,
+                                    time_fn=self.clock.now,
+                                    speculative=True)
+                from .actions.allocate import dispatch_speculative_solve
+                # warm-start at the ∅ fixpoint iff this cycle's fused
+                # fixpoint CONVERGED empty (saturated backlog): the next
+                # serial cycle would converge there again, so solving at
+                # the fixpoint directly skips its in-cycle re-solve. The
+                # witness is shell-tracked (_warmstart_empty) — set from
+                # the MAIN fixpoint at commit, not from whatever
+                # _execute_fused ran last.
+                hint = set() if self._warmstart_empty else None
+                pending = dispatch_speculative_solve(sssn, engine,
+                                                     assumed_hint=hint)
+                if pending is None:
+                    abandon_session(sssn)
+                    return
+                self._spec = _Speculation(sssn, pending, engine)
+            except Exception:
+                # a broken speculation must never cost the cycle that
+                # already committed — log, drop, run serial next cycle
+                log.exception("speculative dispatch failed; next cycle "
+                              "runs serial")
+                if sssn is not None:
+                    abandon_session(sssn)
+                self._spec = None
+                return
+            except BaseException:
+                # SimKill / process death mid-speculation: only the
+                # in-memory speculative state is lost — nothing was
+                # journaled, so recovery cannot double-bind
+                self._spec = None
+                raise
+            if self.spec_fault_hook is not None:
+                # sim hook: lands a seeded SimKill BETWEEN dispatch and
+                # commit — the speculation exists, nothing is journaled
+                self.spec_fault_hook(self._spec)
+
+    # -- event-driven fast admit (docs/performance.md) -----------------------
+
+    def fast_admit(self, max_gangs: int = 64) -> int:
+        """Bind trivially-fitting gangs BETWEEN full cycles, so p99
+        time-to-first-bind drops below one cycle period. Trivial means
+        provably interaction-free: the whole gang fits one node's idle
+        AND future_idle (pipelined reservations respected), no placement
+        constraints (selectors/affinity/tolerations/topology), no
+        gpu-card or NUMA packing, no preempt/reclaim involvement, and —
+        for PENDING podgroups — the unconditional enqueue path
+        (``min_resources is None``, exactly EnqueueAction's gate). Binds
+        ride the journaled+fenced ``bind_batch`` funnel and are fed into
+        the next cycle's audit harvest; anything not provably trivial
+        waits for the full cycle. Returns the number of tasks bound.
+
+        Any bind here dirties the cache, so an in-flight speculation
+        over the pre-admit state conflicts at its commit boundary — the
+        two fast paths compose without a special case."""
+        if not self.fast_admit_enabled:
+            return 0
+        if self.elector is not None and not self.elector.leading:
+            return 0
+        cache = self.cache
+        drain = getattr(cache, "drain_new_jobs", None)
+        if drain is None:
+            return 0
+        if self.federation is not None:
+            # partitioned control plane: ownership is enforced at session
+            # scope (cache.snapshot_scope), and this path reads the
+            # whole-cluster indexes directly — binding here could claim
+            # another partition's job. Standalone/HA-leader only; drain
+            # the feed so it cannot grow unconsumed.
+            drain()
+            return 0
+        uids = drain()
+        if not uids:
+            return 0
+        from .api import PodGroupPhase
+        gangs = tasks_bound = 0
+        with obs_trace.TRACE.span("fast_admit", jobs=len(uids)):
+            for uid in uids:
+                if gangs >= max_gangs:
+                    # cap the between-cycles work; the full cycle owns
+                    # the rest (they stay in cache.jobs regardless)
+                    break
+                job = cache.jobs.get(uid)
+                fit = self._trivial_fit(job)
+                if fit is None:
+                    continue
+                node, gang = fit
+                if job.podgroup.phase == PodGroupPhase.PENDING:
+                    # the unconditional branch of EnqueueAction's gate
+                    job.podgroup.phase = PodGroupPhase.INQUEUE
+                    cache.mark_job_dirty(uid)
+                    cache.update_job_status(job)
+                # the funnel convention (session.dispatch does the same):
+                # the ARGUMENT task carries the placement, the cached
+                # object must still be unplaced — that is what routes
+                # bind_batch onto its fresh-placement path (journal
+                # intent fresh=True, full rollback on binder failure).
+                # Mutating the live task first would misclassify every
+                # fast-admit bind as a re-bind.
+                placed = []
+                for task in gang:
+                    ti = task.shallow_clone()
+                    ti.node_name = node.name
+                    placed.append(ti)
+                cache.bind_batch(placed)
+                gangs += 1
+                tasks_bound += len(gang)
+                if obs_audit.AUDIT.enabled:
+                    for task in gang:
+                        self._fast_admit_audit.append(
+                            ("bind", task.uid, task.job, "fast-admit"))
+        if gangs:
+            metrics.register_fast_admit(gangs, tasks_bound)
+        return tasks_bound
+
+    def _trivial_fit(self, job):
+        """(node, gang_tasks) when the WHOLE gang provably fits one node
+        under the CPU placer's resource rule with zero interactions, else
+        None. First fitting node in cache order — deterministic."""
+        from .api import PodGroupPhase, Resource, TaskStatus
+        cache = self.cache
+        if job is None or job.podgroup is None:
+            return None
+        if job.podgroup.phase not in (PodGroupPhase.PENDING,
+                                      PodGroupPhase.INQUEUE):
+            return None
+        if job.podgroup.phase == PodGroupPhase.PENDING \
+                and job.podgroup.min_resources is not None:
+            return None                 # enqueue's vote path: not trivial
+        if job.queue not in cache.queues:
+            return None
+        gang = [t for t in job.tasks.values()
+                if t.status == TaskStatus.PENDING
+                and not t.resreq.is_empty()]
+        if not gang or len(gang) != len(job.tasks):
+            return None                 # partially-placed gang: full cycle
+        if not (0 < job.min_available <= len(gang)):
+            return None
+        total = Resource()
+        for task in gang:
+            if (task.node_selector or task.affinity or task.tolerations
+                    or task.topology_policy or task.revocable_zone
+                    or getattr(task, "_has_pod_affinity", False)):
+                return None             # placement constraints: full cycle
+            total.add(task.init_resreq)
+        inflight = set(cache.binding_tasks.values())
+        for node in cache.nodes.values():
+            if (not node.ready or node.unschedulable
+                    or node.name in inflight
+                    or node.gpu_devices or node.numa_info is not None):
+                continue
+            if any(t.get("effect") in ("NoSchedule", "NoExecute")
+                   for t in node.taints):
+                continue
+            if node.max_task_num > 0 \
+                    and len(node.tasks) + len(gang) > node.max_task_num:
+                continue
+            if total.less_equal(node.idle) \
+                    and total.less_equal(node.future_idle()):
+                return node, gang
+        return None
 
     def _maybe_verify_drift(self) -> None:
         """Amortized shadow verification (docs/robustness.md): every
@@ -516,6 +1015,15 @@ class Scheduler:
                 log.exception("startup journal reconciliation failed; "
                               "continuing (side effects may retry)")
         while not self._stop.is_set():
+            if self.fast_admit_enabled:
+                # between-cycles fast path: arrivals that accumulated
+                # during the pacing sleep bind now instead of waiting out
+                # the rest of the period
+                try:
+                    self.fast_admit()
+                except Exception:
+                    log.exception("fast-admit pass failed; the full "
+                                  "cycle will pick the jobs up")
             cycle_start = time.perf_counter()
             cycle_fault = False
             try:
@@ -585,9 +1093,18 @@ class Scheduler:
                            self.conf.configurations,
                            time_fn=self.clock.now)
         try:
-            return prewarm_shapes(ssn, configs,
-                                  engine or "callbacks",
-                                  preempt_engine=preempt_engine)
+            warmed = prewarm_shapes(ssn, configs,
+                                    engine or "callbacks",
+                                    preempt_engine=preempt_engine)
+            if self.pipelined:
+                # the cold epoch-pair allocation (device upload + pinned
+                # host copies + future-idle program) belongs here, not in
+                # the first pipelined cycle — the 708ms first-churn-cycle
+                # outlier was exactly this cost landing in-cycle
+                tc = getattr(self.cache, "tensor_cache", None)
+                if tc is not None and hasattr(tc, "prewarm_epoch_pair"):
+                    tc.prewarm_epoch_pair()
+            return warmed
         finally:
             close_session(ssn)
 

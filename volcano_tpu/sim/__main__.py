@@ -112,6 +112,22 @@ def main(argv=None) -> int:
                          "kills/lease losses), terminal-accounting "
                          "equivalence + zero double-binds otherwise "
                          "(exit 1 on mismatch)")
+    ap.add_argument("--pipelined", action="store_true",
+                    help="run the pipelined scheduler shell "
+                         "(speculative solve overlapped with host "
+                         "commit; docs/performance.md). Single-"
+                         "scheduler only")
+    ap.add_argument("--fast-admit", action="store_true",
+                    help="enable the event-driven fast-admit path: "
+                         "trivially-fitting gangs bind between full "
+                         "cycles through the journaled funnel")
+    ap.add_argument("--verify-pipelined-equivalence", action="store_true",
+                    help="also run the SERIAL single-scheduler oracle "
+                         "and assert equivalence: byte-identical "
+                         "decision plane when the pipelined run never "
+                         "conflicted (and fast-admit is off), terminal-"
+                         "accounting equivalence + zero double-binds "
+                         "otherwise (exit 1 on mismatch)")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -131,6 +147,12 @@ def main(argv=None) -> int:
     if args.conf:
         with open(args.conf) as f:
             conf_text = f.read()
+    elif args.pipelined or args.fast_admit:
+        # pin the pipelined conf EXPLICITLY so the serial oracle of
+        # --verify-pipelined-equivalence schedules with the identical
+        # action pipeline/engine — the diff isolates the pipeline itself
+        from .runner import PIPELINED_SIM_CONF
+        conf_text = PIPELINED_SIM_CONF
 
     chaos_seed = args.seed if args.chaos_seed is None else args.chaos_seed
     kill_seed = args.seed if args.kill_seed is None else args.kill_seed
@@ -147,7 +169,8 @@ def main(argv=None) -> int:
                 lambda e: ChaosEvictor(e, failure_rate=args.chaos_rate,
                                        seed=chaos_seed))
 
-    def run(kills, replicas=None, losses=None, federated=None):
+    def run(kills, replicas=None, losses=None, federated=None,
+            pipelined=None, fast_admit=None):
         bw, ew = wraps()
         runner = SimRunner(trace, conf_text=conf_text, period=args.period,
                            seed=args.seed, max_cycles=args.max_cycles,
@@ -159,7 +182,11 @@ def main(argv=None) -> int:
                            lease_loss_cycles=lease_loss if losses is None
                            else losses,
                            federated_partitions=args.federated
-                           if federated is None else federated)
+                           if federated is None else federated,
+                           pipelined=args.pipelined if pipelined is None
+                           else pipelined,
+                           fast_admit=args.fast_admit if fast_admit is None
+                           else fast_admit)
         return runner.run()
 
     if args.trace_out:
@@ -245,6 +272,52 @@ def main(argv=None) -> int:
               f"reserves={report.get('cross_partition_reserves', {})}, "
               f"node_transfers={fed.get('node_transfers', 0)}",
               file=sys.stderr)
+    if args.verify_pipelined_equivalence:
+        import json as _json
+        from .report import pipelined_oracle_part
+        baseline = run([], pipelined=False, fast_admit=False)
+        problems = []
+        spec = report.get("speculation", {})
+        mode = "byte-identical"
+        # strongest claim first: the full decision plane byte-identical
+        # to the serial oracle. Conflicts are byte-SAFE by construction
+        # (a discarded speculation re-solves serially on the true
+        # snapshot), so this usually holds even on conflict-heavy runs;
+        # it is REQUIRED whenever nothing could legitimately diverge —
+        # no kills, no fast-admit, and zero conflicted/partial commits
+        # (the issue's "speculation never conflicts" contract).
+        got_json = _json.dumps(pipelined_oracle_part(report),
+                               sort_keys=True, separators=(",", ":"))
+        want_json = _json.dumps(pipelined_oracle_part(baseline),
+                                sort_keys=True, separators=(",", ":"))
+        if got_json != want_json:
+            diverger = bool(kill_cycles or args.fast_admit
+                            or spec.get("conflicts", 0)
+                            or spec.get("partial", 0))
+            if not diverger:
+                problems.append("conflict-free pipelined decision plane "
+                                "differs from the serial oracle")
+            mode = "terminal"
+            got = terminal_accounting(report)
+            want = terminal_accounting(baseline)
+            if got != want:
+                problems.append(f"terminal accounting diverged: "
+                                f"pipelined={got} serial={want}")
+        if report.get("double_binds"):
+            problems.append(f"double-binds in pipelined run: "
+                            f"{report['double_binds']}")
+        if report["jobs"]["completed"] != report["jobs"]["arrived"]:
+            problems.append("pipelined run did not complete every "
+                            "arrived job")
+        if problems:
+            for p in problems:
+                print(f"pipelined-equivalence FAILED: {p}", file=sys.stderr)
+            return 1
+        print(f"pipelined-equivalence OK: speculation={spec}, "
+              f"fast_admit={report.get('fast_admit', {})}, "
+              f"restarts={report.get('restarts', 0)}, "
+              f"ttfb_p99_cycles={report.get('ttfb_p99_cycles')}, "
+              f"mode={mode}", file=sys.stderr)
     if args.verify_ha_equivalence:
         import json as _json
         baseline = run([], replicas=1, losses=[])

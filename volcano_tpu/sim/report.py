@@ -183,6 +183,11 @@ def build_report(runner, actions_ms: Dict[tuple, list],
         if getattr(runner, "ledger", None) is not None else {},
         "jct_s": percentiles(runner.jct),
         "queueing_delay_s": percentiles(runner.queueing_delay),
+        # time-to-first-bind in CYCLE PERIODS (the fast-admit acceptance
+        # metric: < 1.0 means gangs bound between full cycles)
+        "ttfb_p99_cycles": round(
+            percentiles(runner.queueing_delay).get("p99", 0.0)
+            / runner.period, _ND) if runner.period else 0.0,
         "gang_admission_s": percentiles(runner.gang_admission),
         "utilization": {
             "cpu_mean": round(_mean(runner.util_cpu), _ND),
@@ -204,6 +209,12 @@ def build_report(runner, actions_ms: Dict[tuple, list],
     if actions_truncated:
         report["wallclock"]["actions_ms_truncated"] = \
             list(actions_truncated)
+    if getattr(runner, "pipelined_mode", False):
+        # deterministic (cycle-logic-driven) but MECHANISM, not decisions:
+        # pipelined_oracle_part strips it for the serial-oracle diff
+        report["speculation"] = runner.speculation_stats()
+    if getattr(runner, "fast_admit_mode", False):
+        report["fast_admit"] = runner.fast_admit_stats()
     if getattr(runner, "federated", 0):
         ledger = runner.ledger
         report["federation"] = {
@@ -256,6 +267,18 @@ def oracle_part(report: dict) -> dict:
     part = deterministic_part(report)
     part.pop("ha", None)
     part.pop("federation", None)
+    return part
+
+
+def pipelined_oracle_part(report: dict) -> dict:
+    """The decision plane a ``--pipelined`` run of a conflict-free trace
+    must reproduce byte-for-byte against the serial oracle: everything
+    except the speculation/fast-admit mechanism counters (the oracle has
+    none) — the DECISIONS (binds, evicts, admissions, fairness,
+    utilization, latencies on the virtual clock) must be identical."""
+    part = oracle_part(report)
+    part.pop("speculation", None)
+    part.pop("fast_admit", None)
     return part
 
 

@@ -80,6 +80,24 @@ tiers:
   - name: nodeorder
 """
 
+# conf for ``--pipelined`` runs (docs/performance.md): the speculative
+# dispatch/await split exists for the fused device engine, so the
+# allocate slot runs allocate-tpu (the scan kernel on CPU jax). The
+# serial oracle of --verify-pipelined-equivalence runs this SAME conf —
+# the comparison isolates the pipeline, not the engine.
+PIPELINED_SIM_CONF = """
+actions: "enqueue, allocate-tpu, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
 
 class VirtualClock:
     """Monotonic virtual time: ``sleep`` advances it and returns
@@ -136,7 +154,9 @@ class SimRunner:
                  journal: Optional[IntentJournal] = None,
                  ha_replicas: int = 1,
                  lease_loss_cycles: Optional[Sequence[int]] = None,
-                 federated_partitions: int = 0):
+                 federated_partitions: int = 0,
+                 pipelined: bool = False,
+                 fast_admit: bool = False):
         self.trace = list(trace)
         self.period = period
         self.seed = seed
@@ -180,6 +200,17 @@ class SimRunner:
         if self.federated and self.ha_replicas > 1:
             raise ValueError("ha_replicas and federated_partitions are "
                              "mutually exclusive")
+        # pipelined shell + event-driven fast admit (docs/performance.md):
+        # single-scheduler topologies only — the pipeline does not carry
+        # speculations across leadership or partition boundaries
+        self.pipelined_mode = bool(pipelined)
+        self.fast_admit_mode = bool(fast_admit)
+        if (self.pipelined_mode or self.fast_admit_mode) \
+                and (federated_partitions or ha_replicas > 1):
+            raise ValueError("pipelined/fast_admit are single-scheduler "
+                             "modes (not --ha / --federated)")
+        self._spec_mark: Dict[str, float] = {}
+        self._fa_mark: Dict[str, float] = {}
         self.pmap = None
         self.ledger = None
         self.registry = None
@@ -208,7 +239,12 @@ class SimRunner:
         # instead of wherever the host's wall clock lands
         from ..device_health import DEVICE_HEALTH
         DEVICE_HEALTH.reset(time_fn=self.clock.time)
-        self.conf_text = conf_text if conf_text is not None else SIM_CONF
+        if conf_text is not None:
+            self.conf_text = conf_text
+        elif self.pipelined_mode or self.fast_admit_mode:
+            self.conf_text = PIPELINED_SIM_CONF
+        else:
+            self.conf_text = SIM_CONF
         if self.federated:
             self._init_federated(binder, evictor)
         elif self.ha_replicas > 1:
@@ -226,8 +262,12 @@ class SimRunner:
             self.cache.time_fn = self.clock.time
             self.sched = Scheduler(self.cache, conf_text=self.conf_text,
                                    schedule_period=period, clock=self.clock,
-                                   rng=random.Random(seed))
+                                   rng=random.Random(seed),
+                                   pipelined=self.pipelined_mode,
+                                   fast_admit=self.fast_admit_mode)
             self.caches = [self.cache]
+            self._spec_mark = dict(metrics.speculation_counts())
+            self._fa_mark = dict(metrics.fast_admit_counts())
 
         # decision-plane bookkeeping
         self.arrival_time: Dict[str, float] = {}
@@ -1016,8 +1056,13 @@ class SimRunner:
     def _arm_kill(self) -> str:
         """Pick (seeded) where this cycle's crash lands and arm the
         matching kill point. Returns the mode; "post_cycle" crashes
-        cleanly between run_once and the next cycle instead."""
-        mode = self._kill_rng.choice(self._KILL_MODES)
+        cleanly between run_once and the next cycle instead. Pipelined
+        runs add the "speculate" mode — the process dies BETWEEN
+        speculative dispatch and commit, the window where the pipeline
+        must have journaled nothing."""
+        modes = self._KILL_MODES + ("speculate",) if self.pipelined_mode \
+            else self._KILL_MODES
+        mode = self._kill_rng.choice(modes)
         at = self._kill_rng.randint(1, 5)
         if mode == "bind_before":
             self._kill_binder.arm(at, before=True)
@@ -1027,6 +1072,11 @@ class SimRunner:
             self._kill_evictor.arm(at, before=True)
         elif mode == "evict_after":
             self._kill_evictor.arm(at, before=False)
+        elif mode == "speculate":
+            def _hook(spec, _sched=self.sched):
+                _sched.spec_fault_hook = None
+                raise SimKill("between speculative dispatch and commit")
+            self.sched.spec_fault_hook = _hook
         return mode
 
     def _crash_restart(self, kill_mode: Optional[str] = None) -> None:
@@ -1066,7 +1116,9 @@ class SimRunner:
         self.sched = Scheduler(self.cache, conf_text=self.conf_text,
                                schedule_period=self.period,
                                clock=self.clock,
-                               rng=random.Random(self.seed))
+                               rng=random.Random(self.seed),
+                               pipelined=self.pipelined_mode,
+                               fast_admit=self.fast_admit_mode)
         # a process death also resets the device cool-down state machine
         # (it lives in process memory) — and its clock stays virtual
         from ..device_health import DEVICE_HEALTH
@@ -1095,6 +1147,26 @@ class SimRunner:
                         self._journal_replayed.get(k, 0) + v
         self.restarts += 1
 
+    def speculation_stats(self) -> Dict[str, object]:
+        """This run's speculation outcome deltas (the process-global
+        counters are marked at construction). hit_rate counts committed
+        speculations (full hits + partial replays) over all outcomes."""
+        now = metrics.speculation_counts()
+        d = {k: int(now.get(k, 0) - self._spec_mark.get(k, 0))
+             for k in set(now) | set(self._spec_mark)}
+        hits = d.get("hit", 0)
+        partial = d.get("partial", 0)
+        conflicts = d.get("conflict", 0)
+        total = hits + partial + conflicts
+        return {"hits": hits, "partial": partial, "conflicts": conflicts,
+                "hit_rate": round((hits + partial) / total, 4)
+                if total else 0.0}
+
+    def fast_admit_stats(self) -> Dict[str, int]:
+        now = metrics.fast_admit_counts()
+        return {k: int(now.get(k, 0) - self._fa_mark.get(k, 0))
+                for k in ("gangs", "binds")}
+
     def run(self) -> dict:
         """Run the trace to completion (or stall/max_cycles); returns the
         report dict (sim/report.py)."""
@@ -1106,6 +1178,14 @@ class SimRunner:
             now = self.clock.time()
             self._apply_trace_until(now)
             self._fire_completions_until(now)
+            if self.fast_admit_mode and not self.federated \
+                    and not self.replicas:
+                # event-driven fast path: arrivals just applied bind NOW
+                # (sub-cycle time-to-first-bind) through the journaled
+                # funnel; the feedback pass stamps their first_bind at
+                # the CURRENT virtual time, before the full cycle runs
+                if self.sched.fast_admit():
+                    self._feedback(now)
             if self.federated:
                 self._federated_cycle(now)
             elif self.replicas:

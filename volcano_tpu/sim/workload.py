@@ -311,6 +311,36 @@ SCENARIOS: Dict[str, dict] = {
             node_cpu_milli=1_024_000, node_mem=4096 * GI,
             node_pods=70_000),
     ),
+    "pipelined-steady": dict(
+        description="48 gangs land at ~t0 on 6 small nodes and drain "
+                    "over many cycles with durations long enough that "
+                    "nothing completes mid-drain — the pipelined shell's "
+                    "no-conflict world: every speculation commits and "
+                    "--verify-pipelined-equivalence proves the decision "
+                    "plane byte-identical to the serial oracle",
+        factory=lambda seed: synthetic_trace(
+            48, 6, seed=seed, arrival_rate=1000.0, duration_mean=30.0,
+            duration_cap=45.0, tail_alpha=4.0,
+            gang_sizes=((1, 0.5), (2, 0.35), (4, 0.15)),
+            queues=(("q1", 1),), cpu_choices=(1000, 2000),
+            mem_choices=(GI,), priority_choices=(0,),
+            node_cpu_milli=4000, node_mem=64 * GI, node_pods=50),
+    ),
+    "pipelined-conflict": dict(
+        description="continuous churn on a 3-node sliver — arrivals and "
+                    "completions land between almost every pair of "
+                    "cycles, so speculation misses often: the "
+                    "conflict-heavy world where the pipelined shell must "
+                    "stay terminal-equivalent to the serial oracle with "
+                    "zero double-binds",
+        factory=lambda seed: synthetic_trace(
+            120, 3, seed=seed, arrival_rate=4.0, duration_mean=3.0,
+            duration_cap=8.0,
+            gang_sizes=((1, 0.6), (2, 0.3), (4, 0.1)),
+            queues=(("q1", 2), ("q2", 1)), cpu_choices=(1000, 2000),
+            mem_choices=(GI,), priority_choices=(0,),
+            node_cpu_milli=6000, node_mem=64 * GI, node_pods=40),
+    ),
     "baseline-tiny": dict(
         description="BASELINE config 1 (1 gang of 3, 10 nodes) as the "
                     "degenerate all-at-t0 trace",
