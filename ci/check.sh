@@ -20,16 +20,18 @@ run_lint=true
 run_ha=true
 run_federated=true
 run_pipelined=true
+run_store=true
 case "${1:-}" in
-  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ;;
-  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ;;
-  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ;;
-  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ;;
-  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ;;
-  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false; run_pipelined=false ;;
-  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false; run_pipelined=false ;;
-  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_pipelined=false ;;
-  --pipelined-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false ;;
+  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ;;
+  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ;;
+  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ;;
+  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ;;
+  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ;;
+  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ;;
+  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false; run_pipelined=false; run_store=false ;;
+  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_pipelined=false; run_store=false ;;
+  --pipelined-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_store=false ;;
+  --store-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ;;
 esac
 
 if $run_lint; then
@@ -341,6 +343,63 @@ print("   pipelined-soak: speculation %s, fast_admit %s, restarts %d, "
                              conflict["restarts"]))
 EOF
   echo "   pipelined-soak: oracle-equal, byte-deterministic x2"
+fi
+
+if $run_store; then
+  # store-chaos soak (docs/robustness.md store failure model): the
+  # scheduler behind the hostile store boundary — 20% seeded per-verb
+  # faults (latency/transient/409), 2 torn watch streams, 4 seeded
+  # kills. (a) the faulted smoke must converge to the SAME terminal
+  # accounting as a no-fault store-wired run with zero double-binds
+  # (--verify-store-equivalence runs both), (b) the chaotic run's
+  # decision plane must be byte-deterministic x2, and (c) the
+  # --federated 4 variant (store-backed PartitionState CR transport)
+  # must pass the same bar.
+  echo "== store-chaos: faulted verbs + torn watches + kills =="
+  storedir=$(mktemp -d)
+  trap 'rm -rf "${simdir:-/nonexistent}" "${soakdir:-/nonexistent}" \
+"${obsdir:-/nonexistent}" "${hadir:-/nonexistent}" \
+"${feddir:-/nonexistent}" "${pipedir:-/nonexistent}" \
+"${storedir:-/nonexistent}"' EXIT
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario smoke --seed 3 \
+    --store-chaos --kill-cycles 2,5,9,13 --kill-seed 1 \
+    --verify-store-equivalence --deterministic > "$storedir/st.a.json" \
+    || { echo "store-chaos FAILED: faulted run diverged or double-bound"; \
+         exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario smoke --seed 3 \
+    --store-chaos --kill-cycles 2,5,9,13 --kill-seed 1 \
+    --deterministic > "$storedir/st.b.json"
+  diff "$storedir/st.a.json" "$storedir/st.b.json" \
+    || { echo "store-chaos FAILED: faulted run not byte-deterministic"; \
+         exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario smoke --seed 3 \
+    --store-chaos --federated 4 --kill-cycles 2,5,9,13 --kill-seed 2 \
+    --verify-store-equivalence --deterministic > "$storedir/fed.a.json" \
+    || { echo "store-chaos FAILED: store-backed federated run diverged \
+or double-bound"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario smoke --seed 3 \
+    --store-chaos --federated 4 --kill-cycles 2,5,9,13 --kill-seed 2 \
+    --deterministic > "$storedir/fed.b.json"
+  diff "$storedir/fed.a.json" "$storedir/fed.b.json" \
+    || { echo "store-chaos FAILED: store-backed federated run not \
+byte-deterministic"; exit 1; }
+  python - "$storedir/st.a.json" "$storedir/fed.a.json" <<'EOF'
+import json, sys
+single = json.load(open(sys.argv[1]))
+fed = json.load(open(sys.argv[2]))
+for name, r in (("single", single), ("federated", fed)):
+    st = r["store"]
+    assert st["faults"].get("transient", 0) > 0, f"{name}: no transients"
+    assert st["retry_funnel"]["retries"] > 0, f"{name}: funnel never retried"
+    assert st["torn_watch_events"] == 2, f"{name}: torn drill miscounted"
+    assert st["watch_resumes"] + st["watch_relists"] >= 2, \
+        f"{name}: torn streams never recovered"
+    assert r["double_binds"] == 0 and r["restarts"] > 0
+assert fed["federation"]["store_backed"] is True
+print("   store-chaos: faults absorbed, streams recovered, zero "
+      "double-binds (single + federated)")
+EOF
+  echo "   store-chaos: terminal-equivalent, byte-deterministic x2"
 fi
 
 if $run_shim; then

@@ -1737,3 +1737,95 @@ def test_readback_allowlist_burned_down_to_prewarm_only():
     entries = HostSyncRule.READBACK_ALLOWLIST
     assert len(entries) == 1
     assert entries[0]["symbol"] == "prewarm_shapes"
+
+
+# ---------------------------------------------------------------------------
+# 10. VT016 store-verb funnel (store failure model)
+# ---------------------------------------------------------------------------
+
+VT016_TRIGGER = '''
+def flush_podgroup(self, pg):
+    self.store.update_status(pg)       # bare store verb in scheduler scope
+'''
+
+VT016_CLEAN = '''
+def flush_podgroup(self, pg):
+    # verbs only through the handed-in transport composition: the
+    # executor funnels live in cache/executors.py (excluded), and this
+    # module merely threads the transport object around
+    self.transport_writer(pg)
+'''
+
+
+def test_vt016_trigger_and_clean():
+    f, _ = findings_of({"volcano_tpu/actions/custom.py": VT016_TRIGGER})
+    assert "VT016" in rule_ids(f)
+    (x,) = [x for x in f if x.rule == "VT016"]
+    assert "update_status" in x.message and "retrying" in x.message
+    f, _ = findings_of({"volcano_tpu/actions/custom.py": VT016_CLEAN})
+    assert "VT016" not in rule_ids(f)
+
+
+def test_vt016_distinct_verbs_fire_on_any_receiver():
+    src = '''
+def rogue(client, task):
+    client.bind_pod(task.namespace, task.name, task.node_name)
+'''
+    f, _ = findings_of({"volcano_tpu/federation/helper.py": src})
+    assert "VT016" in rule_ids(f)
+
+
+def test_vt016_generic_verbs_need_a_store_receiver():
+    # dict.update / set.add-style generic calls must NOT fire
+    src = '''
+def harmless(d, extra):
+    d.update(extra)
+    labels = {}
+    labels.update({"a": 1})
+'''
+    f, _ = findings_of({"volcano_tpu/actions/custom.py": src})
+    assert "VT016" not in rule_ids(f)
+    src = '''
+def rogue(self, obj):
+    self.store.update(obj)             # store-named receiver: fires
+'''
+    f, _ = findings_of({"volcano_tpu/actions/custom.py": src})
+    assert "VT016" in rule_ids(f)
+
+
+def test_vt016_funnel_modules_are_exempt():
+    src = '''
+class StoreBinder:
+    def bind(self, task, hostname):
+        self.store.bind_pod(task.namespace, task.name, hostname)
+'''
+    f, _ = findings_of({"volcano_tpu/cache/executors.py": src})
+    assert "VT016" not in rule_ids(f)
+    f, _ = findings_of({"volcano_tpu/federation/store_backed.py": src})
+    assert "VT016" not in rule_ids(f)
+    # CLI / controllers are out of scope (not scheduler-side)
+    f, _ = findings_of({"volcano_tpu/cli/vcctl.py": src})
+    assert "VT016" not in rule_ids(f)
+
+
+def test_vt016_rebroken_funnel_bypass():
+    """Re-broken regression: the REAL executor funnel relocated outside
+    its sanctioned module — StoreBinder's store.bind_pod call pasted
+    into scheduler scope — must fire; the unmutated sources must not."""
+    paths = ("volcano_tpu/scheduler.py", "volcano_tpu/cache/cache.py",
+             "volcano_tpu/cache/store_wiring.py",
+             "volcano_tpu/federation/reserve.py")
+    srcs = {p: real_source(p) for p in paths}
+    f, _ = findings_of(srcs)
+    assert "VT016" not in rule_ids(f)
+    broken = dict(srcs)
+    broken["volcano_tpu/cache/cache.py"] = mutate(
+        srcs["volcano_tpu/cache/cache.py"],
+        "        seq = self._journal_intent(\"bind\", task, task.node_name,\n"
+        "                                   fresh=newly_placed)",
+        "        seq = self._journal_intent(\"bind\", task, task.node_name,\n"
+        "                                   fresh=newly_placed)\n"
+        "        self.store.bind_pod(task.namespace, task.name,\n"
+        "                            task.node_name)")
+    f, _ = findings_of(broken)
+    assert "VT016" in rule_ids(f)

@@ -591,3 +591,178 @@ class TestFederatedSim:
         hot = report["federation"]["map"]
         total = sum(p["nodes"] for p in hot.values())
         assert total == 8 and max(p["nodes"] for p in hot.values()) > 2
+
+
+# ---------------------------------------------------------------------------
+# store-backed transport: PartitionState CR over the CAS/watch path
+# (docs/federation.md store-backed transport; ROADMAP item 5 closure)
+# ---------------------------------------------------------------------------
+
+def make_store_backed_federation(clock, n=2, nodes_each=2, journal=None,
+                                 store=None):
+    """Per-partition map/ledger MIRRORS over one shared store — the
+    multi-process topology (each partition only ever touches its own
+    mirror; convergence flows through the PartitionState CR)."""
+    from volcano_tpu.federation import (StoreBackedPartitionMap,
+                                        StoreBackedReserveLedger,
+                                        StorePartitionBackend)
+    store = store or ObjectStore()
+    reg = FencingRegistry()
+    backends, maps, ledgers, caches = [], [], [], []
+    for pid in range(n):
+        backend = StorePartitionBackend(store, n)
+        pm = StoreBackedPartitionMap(backend)
+        ledger = StoreBackedReserveLedger(pm, backend, journal=journal,
+                                          registry=reg, time_fn=clock,
+                                          timeout_s=8.0)
+        cache = make_cache(n_nodes=0, journal=journal)
+        ledger.attach_cache(pid, cache)
+        backends.append(backend)
+        maps.append(pm)
+        ledgers.append(ledger)
+        caches.append(cache)
+    for i in range(n * nodes_each):
+        name = f"n{i}"
+        maps[0].register_node(name)
+        for cache in caches:
+            alloc = Resource(16000, 32 * GI)
+            alloc.max_task_num = 110
+            cache.add_node(NodeInfo(name=name, allocatable=alloc))
+    return store, reg, backends, maps, ledgers, caches
+
+
+class TestStoreBackedFederation:
+    def test_mirrors_converge_and_match_in_process_round_robin(self):
+        clock = FakeClock()
+        store, reg, backends, maps, ledgers, caches = \
+            make_store_backed_federation(clock, n=3, nodes_each=0)
+        oracle = PartitionMap(3)
+        for q in ("q1", "q2", "q3", "q4"):
+            maps[0].register_queue(q)
+            oracle.register_queue(q)
+        for nd in ("n0", "n1", "n2"):
+            maps[1].register_node(nd)
+            oracle.register_node(nd)
+        for pm in maps:
+            assert pm.queue_owner == oracle.queue_owner
+            assert pm.node_owner == oracle.node_owner
+        # idempotent re-registration writes nothing (version stable)
+        v = maps[0].version
+        assert maps[2].register_queue("q2") == oracle.queue_owner["q2"]
+        assert maps[0].version == v
+        # the state survives a fresh mirror wiring up late (a restarted
+        # partition rebuilding from the store)
+        from volcano_tpu.federation import (StoreBackedPartitionMap,
+                                            StorePartitionBackend)
+        late = StoreBackedPartitionMap(StorePartitionBackend(store, 3))
+        assert late.queue_owner == oracle.queue_owner
+        assert late.node_owner == oracle.node_owner
+
+    def test_reserve_protocol_end_to_end_over_the_store(self):
+        clock = FakeClock()
+        journal = IntentJournal()
+        store, reg, backends, maps, ledgers, caches = \
+            make_store_backed_federation(clock, journal=journal)
+        reg.authority(0).advance(3)
+        reg.authority(1).advance(5)
+        # the REQUESTER files through ITS ledger...
+        rid = ledgers[0].request(frm=0, to=1, cpu=4000, mem=GI,
+                                 epoch_from=3)
+        assert rid is not None
+        # ...and the OWNER's mirror sees it through the CR watch
+        assert rid in ledgers[1].requests
+        assert ledgers[1].requests[rid].state == "requested"
+        ledgers[1].review(pid=1, epoch=5)
+        req = ledgers[1].find(rid)
+        assert req.state == "granted"
+        # ownership converged on EVERY mirror, pin released everywhere
+        for pm in maps:
+            assert pm.owner_of_node(req.node) == 0
+            assert req.node not in pm.pinned
+        # the settled request left the CR, so the requester's open set
+        # drained too (no re-count: the owner counted the grant once)
+        assert rid not in ledgers[0].requests
+        assert metrics.local_counters()[
+            ("cross_partition_reserves", "granted")] >= 1
+
+    def test_published_idle_flows_through_the_cr(self):
+        clock = FakeClock()
+        store, reg, backends, maps, ledgers, caches = \
+            make_store_backed_federation(clock, n=3)
+        ledgers[1].publish_idle(1, 5000.0, GI)
+        ledgers[2].publish_idle(2, 9000.0, GI)
+        # partition 0 picks its donor from the CR-synced idle map
+        assert ledgers[0].pick_donor(0) == 2
+
+    def test_cas_conflicts_retry_and_converge(self):
+        import random
+        from volcano_tpu.chaos import StoreFaultInjector
+        from volcano_tpu.federation import (StoreBackedPartitionMap,
+                                            StorePartitionBackend)
+        from volcano_tpu.store_transport import (FaultyStoreTransport,
+                                                 RetryingStoreTransport)
+        store = ObjectStore()
+        inj = StoreFaultInjector(failure_rate=0.4, seed=9,
+                                 conflict_share=1.0, latency_share=0.0)
+        transport = RetryingStoreTransport(
+            FaultyStoreTransport(store, inj), sleep_fn=lambda s: None,
+            rng=random.Random(0))
+        backend = StorePartitionBackend(transport, 2)
+        pm = StoreBackedPartitionMap(backend)
+        for i in range(20):
+            pm.register_node(f"n{i}")
+        oracle = PartitionMap(2)
+        for i in range(20):
+            oracle.register_node(f"n{i}")
+        assert pm.node_owner == oracle.node_owner
+        assert backend.cas_conflicts > 0
+
+    def test_failed_flip_leaves_pin_and_expiry_releases(self):
+        """The atomicity contract under store chaos: an ownership flip
+        whose CAS cannot land does NOT half-apply — the pin stays (on
+        the CR and every mirror), and deadline expiry releases it, so
+        capacity is never stranded."""
+        clock = FakeClock()
+        journal = IntentJournal()
+        store, reg, backends, maps, ledgers, caches = \
+            make_store_backed_federation(clock, journal=journal)
+        ledgers[0].request(frm=0, to=1, cpu=4000, mem=GI, epoch_from=1)
+        # break ONLY the transfer CAS: the flip transition itself raises
+        owner_map = maps[1]
+        real = owner_map.backend.mutate
+        def broken(fn, _real=real):
+            raise RuntimeError("store down at flip time")
+        owner_map.backend.mutate = broken
+        try:
+            with pytest.raises(RuntimeError):
+                ledgers[1].review(pid=1, epoch=1)
+        finally:
+            owner_map.backend.mutate = real
+        # nothing half-applied: owner unchanged on every mirror...
+        (rid, req), = ledgers[1].requests.items()
+        assert req.state == "granting" and req.node
+        for pm in maps:
+            assert pm.owner_of_node(req.node) == 1
+        # ...except the pin, which the CR carries and expiry releases
+        clock.advance(9.0)
+        assert ledgers[0].expire() == 1          # ANY partition's cycle
+        for pm in maps:
+            assert req.node not in pm.pinned
+            assert pm.owner_of_node(req.node) == 1
+
+    def test_torn_partition_state_stream_heals_on_sync(self):
+        clock = FakeClock()
+        store, reg, backends, maps, ledgers, caches = \
+            make_store_backed_federation(clock)
+        backends[1]._watch.tear()
+        rid = ledgers[0].request(frm=0, to=1, cpu=4000, mem=GI,
+                                 epoch_from=1)
+        # the owner's mirror is stale: it reviews nothing this cycle
+        assert rid not in ledgers[1].requests
+        ledgers[1].review(pid=1, epoch=1)
+        assert ledgers[0].requests[rid].state == "requested"
+        # the cycle-start sync (PartitionMember.on_cycle_start) heals it
+        maps[1].sync()
+        assert rid in ledgers[1].requests
+        ledgers[1].review(pid=1, epoch=1)
+        assert ledgers[1].find(rid).state == "granted"

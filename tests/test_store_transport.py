@@ -1,0 +1,582 @@
+"""The hostile store boundary (docs/robustness.md store failure model):
+watch resume/relist semantics, the fault-injected transport, the
+retrying write funnel, and the store-level fixes this PR shipped
+(structured 409 payload, exactly-once registration, rv monotonicity)."""
+
+import threading
+
+import pytest
+
+from volcano_tpu import metrics
+from volcano_tpu.api import PodGroupPhase, Resource
+from volcano_tpu.apis.objects import (ObjectMeta, Pod, PodGroupCR,
+                                      PodGroupSpec, PodTemplate, QueueCR)
+from volcano_tpu.cache.watches import ResumableWatch, WatchManager
+from volcano_tpu.chaos import StoreFaultInjector
+from volcano_tpu.store import (ADDED, DELETED, UPDATED, ConflictError,
+                               GoneError, ObjectStore)
+from volcano_tpu.store_transport import (FaultyStoreTransport,
+                                         RetryingStoreTransport,
+                                         TransientStoreError)
+
+
+def make_pod(name, group="g1", ns="default", cpu=100):
+    return Pod(metadata=ObjectMeta(
+        name=name, namespace=ns, uid=name,
+        annotations={"scheduling.k8s.io/group-name": group}),
+        template=PodTemplate(resources=Resource(cpu, 1 << 20)))
+
+
+def make_pg(name, ns="default", min_member=1,
+            phase=PodGroupPhase.INQUEUE):
+    pg = PodGroupCR(metadata=ObjectMeta(name=name, namespace=ns),
+                    spec=PodGroupSpec(min_member=min_member))
+    pg.status.phase = phase
+    return pg
+
+
+class Recorder:
+    """rv-aware watch handler recording (event, key, rv)."""
+
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, event, obj, old, rv=None):
+        key = obj.metadata.key() if obj is not None else None
+        self.events.append((event, key, rv))
+
+    def of(self, etype):
+        return [e for e in self.events if e[0] == etype]
+
+
+# ---------------------------------------------------------------------------
+# store-level semantics (satellite: store bugfix sweep + watch contract)
+# ---------------------------------------------------------------------------
+
+class TestStoreWatchV2:
+    def test_conflict_error_names_observed_and_expected(self):
+        store = ObjectStore()
+        q = store.create(QueueCR(metadata=ObjectMeta(name="q")))
+        rv = q.metadata.resource_version
+        store.update(q, expect_rv=rv)            # moves rv
+        with pytest.raises(ConflictError) as ei:
+            store.update(q, expect_rv=rv)
+        err = ei.value
+        assert err.expected == rv
+        assert err.observed == store.get(
+            "Queue", "default", "q").metadata.resource_version
+        assert str(err.observed) in str(err) and str(rv) in str(err)
+
+    def test_rv_monotonic_across_create_batch(self):
+        store = ObjectStore()
+        rec = Recorder()
+        store.watch("Pod", rec, with_rv=True)
+        store.create_batch([make_pod(f"p{i}") for i in range(5)])
+        rvs = [rv for _, _, rv in rec.of(ADDED)]
+        assert rvs == sorted(rvs) and len(set(rvs)) == 5
+        # stored objects carry the same versions the events announced
+        stored = sorted(p.metadata.resource_version
+                        for p in store.list("Pod"))
+        assert stored == rvs
+
+    def test_delete_consumes_a_resource_version(self):
+        store = ObjectStore()
+        store.create(make_pod("p1"))
+        rv_before = store.current_rv()
+        rec = Recorder()
+        store.watch("Pod", rec, with_rv=True)
+        store.delete("Pod", "default", "p1")
+        (ev,) = rec.of(DELETED)
+        assert ev[2] == rv_before + 1 == store.current_rv()
+
+    def test_registration_during_inflight_notify_exactly_once(self):
+        """A watch wired from WITHIN another handler's delivery (the
+        late-wired cache) observes the notifying object exactly once —
+        the registration replay covers it and the in-flight notify is
+        deduplicated by the registration horizon."""
+        store = ObjectStore()
+        late = Recorder()
+        registered = []
+
+        def early(event, obj, old):
+            if not registered:
+                registered.append(store.watch("Pod", late, with_rv=True))
+
+        store.watch("Pod", early)
+        store.create(make_pod("p1"))
+        assert [(e, k) for e, k, _ in late.events] \
+            == [(ADDED, "default/p1")]
+        # and the late watcher keeps receiving subsequent events normally
+        store.create(make_pod("p2"))
+        assert [(e, k) for e, k, _ in late.events] \
+            == [(ADDED, "default/p1"), (ADDED, "default/p2")]
+
+    def test_concurrent_writer_registration_exactly_once(self):
+        """Threaded version: watchers registered while a writer storm is
+        in flight see every pod exactly once (replay + horizon dedup)."""
+        store = ObjectStore()
+        recs = []
+        stop = threading.Event()
+
+        def writer():
+            for i in range(200):
+                store.create(make_pod(f"w{i}"))
+            stop.set()
+
+        t = threading.Thread(target=writer)
+        t.start()
+        while not stop.is_set():
+            rec = Recorder()
+            store.watch("Pod", rec, with_rv=True)
+            recs.append(rec)
+        t.join()
+        for rec in recs:
+            keys = [k for e, k, _ in rec.events if e == ADDED]
+            assert len(keys) == len(set(keys)), "duplicate ADD observed"
+
+    def test_resume_replays_missed_events(self):
+        store = ObjectStore()
+        rec = Recorder()
+        w = store.watch("Pod", rec, with_rv=True)
+        store.create(make_pod("p1"))
+        last_rv = rec.events[-1][2]
+        store.unwatch("Pod", w)                 # the stream dies
+        store.create(make_pod("p2"))
+        store.delete("Pod", "default", "p1")
+        store.watch("Pod", rec, since_rv=last_rv, with_rv=True)
+        assert [(e, k) for e, k, _ in rec.events] == [
+            (ADDED, "default/p1"), (ADDED, "default/p2"),
+            (DELETED, "default/p1")]
+
+    def test_resume_past_backlog_raises_gone(self):
+        store = ObjectStore(watch_backlog=4)
+        store.create(make_pod("p0"))
+        rv = store.current_rv()
+        for i in range(1, 9):
+            store.create(make_pod(f"p{i}"))
+        with pytest.raises(GoneError):
+            store.watch("Pod", Recorder(), since_rv=rv, with_rv=True)
+
+    def test_list_with_rv_is_consistent(self):
+        store = ObjectStore()
+        store.create(make_pod("p1"))
+        objs, rv = store.list_with_rv("Pod")
+        assert len(objs) == 1 and rv == store.current_rv()
+
+
+# ---------------------------------------------------------------------------
+# ResumableWatch: the informer contract (satellite: relist/resume tests)
+# ---------------------------------------------------------------------------
+
+class TestResumableWatch:
+    def test_mid_stream_registration_sees_consistent_snapshot(self):
+        store = ObjectStore()
+        store.create(make_pod("p1"))
+        store.create(make_pod("p2"))
+        store.delete("Pod", "default", "p1")
+        rec = Recorder()
+        ResumableWatch(store, "Pod", lambda e, o, old: rec(e, o, old))
+        assert [(e, k) for e, k, _ in rec.events] == [(ADDED, "default/p2")]
+
+    def test_torn_stream_resumes_from_backlog(self):
+        store = ObjectStore()
+        rec = Recorder()
+        w = ResumableWatch(store, "Pod",
+                           lambda e, o, old: rec(e, o, old))
+        store.create(make_pod("p1"))
+        w.tear()
+        store.create(make_pod("p2"))
+        store.delete("Pod", "default", "p1")
+        assert w.torn
+        assert w.resume() == "resume"
+        assert [(e, k) for e, k, _ in rec.events] == [
+            (ADDED, "default/p1"), (ADDED, "default/p2"),
+            (DELETED, "default/p1")]
+
+    def test_gone_relists_without_double_add_or_lost_delete(self):
+        """410-Gone relist: pods that survived are NOT re-ADDed (known
+        keys diff as updates/skips), a pod deleted while the stream was
+        torn IS delivered as DELETED, and pods created meanwhile ADD."""
+        store = ObjectStore(watch_backlog=4)
+        rec = Recorder()
+        w = ResumableWatch(store, "Pod",
+                           lambda e, o, old: rec(e, o, old))
+        store.create(make_pod("keeper"))
+        store.create(make_pod("victim"))
+        w.tear()
+        store.delete("Pod", "default", "victim")     # the raced delete
+        for i in range(8):                           # trim the backlog
+            store.create(make_pod(f"new{i}"))
+        assert w.resume() == "relist"
+        events = [(e, k) for e, k, _ in rec.events]
+        assert events.count((ADDED, "default/keeper")) == 1
+        assert (DELETED, "default/victim") in events
+        adds = [k for e, k in events if e == ADDED]
+        assert len(adds) == len(set(adds)), "relist double-added"
+        assert {f"default/new{i}" for i in range(8)} <= set(adds)
+
+    def test_relist_delivers_changed_objects_as_updates(self):
+        store = ObjectStore(watch_backlog=2)
+        store.create(make_pg("g1", phase=PodGroupPhase.PENDING))
+        events = []
+
+        def handler(e, o, old):
+            events.append((e, o.status.phase, old))
+
+        w = ResumableWatch(store, "PodGroup", handler)
+        w.tear()
+        pg = store.get("PodGroup", "default", "g1")
+        pg.status.phase = PodGroupPhase.INQUEUE
+        store.update_status(pg)
+        for i in range(4):          # age the PODGROUP backlog (per-kind)
+            store.create(make_pg(f"x{i}"))
+            store.delete("PodGroup", "default", f"x{i}")
+        assert w.resume() == "relist"
+        assert events[0][0] == ADDED
+        assert events[-1][0] == UPDATED \
+            and events[-1][1] == PodGroupPhase.INQUEUE
+
+    def test_bookmarks_keep_resume_point_fresh(self):
+        """Churn on OTHER kinds ages the global rv; bookmarks let an
+        idle stream resume instead of relisting."""
+        store = ObjectStore(watch_backlog=1000)
+        rec = Recorder()
+        w = ResumableWatch(store, "PodGroup",
+                           lambda e, o, old: rec(e, o, old))
+        for i in range(10):
+            store.create(make_pod(f"p{i}"))
+        store.emit_bookmarks()
+        assert w.last_rv == store.current_rv()
+
+    def test_manager_step_resumes_and_publishes(self):
+        store = ObjectStore()
+        manager = WatchManager(store)
+        rec = Recorder()
+        w = manager.add("Pod", lambda e, o, old: rec(e, o, old))
+        store.create(make_pod("p1"))
+        w.tear()
+        store.create(make_pod("p2"))
+        assert manager.staleness() > 0 or w.torn
+        assert manager.step() == 1
+        assert not w.torn
+        assert [(e, k) for e, k, _ in rec.events] == [
+            (ADDED, "default/p1"), (ADDED, "default/p2")]
+        detail = metrics.health_detail()["store"]
+        assert detail["wired"] and detail["streams"][0]["kind"] == "Pod"
+
+
+# ---------------------------------------------------------------------------
+# the fault-injected + retrying transports (tentpole)
+# ---------------------------------------------------------------------------
+
+class TestFaultyTransport:
+    def test_seeded_faults_reproduce(self):
+        mk = lambda: FaultyStoreTransport(  # noqa: E731
+            ObjectStore(), StoreFaultInjector(failure_rate=0.5, seed=7,
+                                              latency_s=0.0))
+        def drive(t):
+            out = []
+            for i in range(30):
+                try:
+                    t.create(make_pod(f"p{i}"))
+                    out.append("ok")
+                except TransientStoreError:
+                    out.append("transient")
+                except ConflictError:
+                    out.append("conflict")
+            return out
+        assert drive(mk()) == drive(mk())
+        counts = mk().injector
+        assert counts.attempts == 0
+
+    def test_conflict_carries_observed_rv(self):
+        store = ObjectStore()
+        inj = StoreFaultInjector(failure_rate=1.0, seed=1,
+                                 conflict_share=1.0, latency_share=0.0)
+        t = FaultyStoreTransport(store, inj)
+        with pytest.raises(ConflictError) as ei:
+            t.update(make_pod("p1"))
+        assert ei.value.observed == store.current_rv()
+
+    def test_torn_stream_stops_delivering(self):
+        store = ObjectStore()
+        inj = StoreFaultInjector(failure_rate=0.0, seed=3, tear_rate=1.0)
+        t = FaultyStoreTransport(store, inj)
+        rec = Recorder()
+        h = t.watch("Pod", rec, with_rv=True)
+        store.create(make_pod("p1"))
+        assert h.torn and rec.events == []
+        store.create(make_pod("p2"))
+        assert rec.events == []
+
+    def test_tear_streams_is_seeded(self):
+        store = ObjectStore()
+        inj = StoreFaultInjector(failure_rate=0.0, seed=3)
+        t = FaultyStoreTransport(store, inj)
+        for kind in ("Pod", "PodGroup", "Queue"):
+            t.watch(kind, Recorder(), with_rv=True)
+        import random
+        torn = t.tear_streams(2, random.Random(5))
+        assert len(torn) == 2
+        assert len([s for s in t.streams if s.torn]) == 2
+
+
+class TestRetryingTransport:
+    def test_absorbs_transients_within_budget(self):
+        store = ObjectStore()
+        inj = StoreFaultInjector(failure_rate=0.4, seed=11,
+                                 conflict_share=0.0, latency_share=0.0)
+        sleeps = []
+        import random
+        t = RetryingStoreTransport(FaultyStoreTransport(store, inj),
+                                   sleep_fn=sleeps.append,
+                                   rng=random.Random(0))
+        for i in range(40):
+            t.create(make_pod(f"p{i}"))
+        assert len(store.list("Pod")) == 40
+        assert t.retries > 0 and sleeps
+        # backoff grows and carries jitter
+        assert max(sleeps) > min(sleeps)
+
+    def test_exhaustion_reraises_for_the_resync_machinery(self):
+        store = ObjectStore()
+        inj = StoreFaultInjector(failure_rate=1.0, seed=2,
+                                 conflict_share=0.0, latency_share=0.0)
+        import random
+        t = RetryingStoreTransport(FaultyStoreTransport(store, inj),
+                                   max_attempts=3, sleep_fn=lambda s: None,
+                                   rng=random.Random(0))
+        with pytest.raises(TransientStoreError):
+            t.create(make_pod("p1"))
+        assert t.exhausted == 1
+        assert store.list("Pod") == []
+
+    def test_cycle_budget_caps_retry_time(self):
+        store = ObjectStore()
+        inj = StoreFaultInjector(failure_rate=1.0, seed=2,
+                                 conflict_share=0.0, latency_share=0.0)
+        import random
+        t = RetryingStoreTransport(FaultyStoreTransport(store, inj),
+                                   max_attempts=50, base_delay=0.1,
+                                   max_delay=0.1, cycle_budget_s=0.35,
+                                   sleep_fn=lambda s: None,
+                                   rng=random.Random(0))
+        with pytest.raises(TransientStoreError):
+            t.create(make_pod("p1"))
+        assert t.retries <= 4            # ~3 sleeps fit the 0.35s budget
+        t.new_cycle()
+        with pytest.raises(TransientStoreError):
+            t.create(make_pod("p2"))     # fresh budget, same degradation
+
+    def test_conflicts_pass_through_untouched(self):
+        store = ObjectStore()
+        q = store.create(QueueCR(metadata=ObjectMeta(name="q")))
+        t = RetryingStoreTransport(store, sleep_fn=lambda s: None)
+        store.update(q)                  # move the rv
+        with pytest.raises(ConflictError):
+            t.update(q, expect_rv=1)
+        assert t.retries == 0
+
+    def test_metrics_series_flow(self):
+        metrics.reset_local()
+        store = ObjectStore()
+        inj = StoreFaultInjector(failure_rate=0.5, seed=4,
+                                 conflict_share=0.0, latency_share=0.0)
+        import random
+        t = RetryingStoreTransport(FaultyStoreTransport(store, inj),
+                                   sleep_fn=lambda s: None,
+                                   rng=random.Random(0))
+        for i in range(20):
+            t.create(make_pod(f"p{i}"))
+        counts = metrics.store_counts()
+        assert counts["retries"].get("create/ok", 0) == 20
+        assert counts["retries"].get("create/retry", 0) > 0
+        assert counts["faults"].get("create/transient", 0) > 0
+        # the fallback exposition renders the two-label series validly
+        text = metrics.fallback_exposition().decode()
+        assert 'volcano_store_retries_total{verb="create",result="ok"}' \
+            in text
+        assert "volcano_store_faults_total" in text
+
+
+# ---------------------------------------------------------------------------
+# the wired stack: cache informers over the hostile boundary
+# ---------------------------------------------------------------------------
+
+class TestWiredCacheOverFaults:
+    def _wired(self, fault_rate=0.0, seed=5, tear_rate=0.0):
+        import random
+        from volcano_tpu.cache.store_wiring import wire_cache_to_store
+        store = ObjectStore()
+        inj = StoreFaultInjector(failure_rate=fault_rate, seed=seed,
+                                 latency_s=0.0, tear_rate=tear_rate)
+        faulty = FaultyStoreTransport(store, inj)
+        transport = RetryingStoreTransport(faulty,
+                                           sleep_fn=lambda s: None,
+                                           rng=random.Random(seed))
+        cache = wire_cache_to_store(transport)
+        return store, faulty, transport, cache
+
+    def test_wiring_attaches_watch_manager(self):
+        store, _, transport, cache = self._wired()
+        assert cache.watch_manager is not None
+        transport.create(make_pg("g1"))
+        transport.create(make_pod("m1", group="g1"))
+        assert "default/g1" in cache.jobs
+        assert "m1" in cache.jobs["default/g1"].tasks
+
+    def test_torn_pod_stream_heals_without_double_accounting(self):
+        """A pod bound while the Pod stream is torn: the cache misses
+        the Running ack until step() resumes the stream, then converges
+        WITHOUT double-adding the placed task to its node."""
+        from volcano_tpu.api import NodeInfo, TaskStatus
+        store, faulty, transport, cache = self._wired()
+        alloc = Resource(4000, 8 << 30)
+        alloc.max_task_num = 10
+        cache.add_node(NodeInfo(name="n1", allocatable=alloc))
+        transport.create(make_pg("g1"))
+        transport.create(make_pod("m1", group="g1"))
+        task = cache.jobs["default/g1"].tasks["m1"]
+        pod_stream = [w for w in cache.watch_manager.watches
+                      if w.kind == "Pod"][0]
+        pod_stream.tear()
+        clone = task.shallow_clone()
+        clone.node_name = "n1"
+        cache.bind(clone)                       # executes through the store
+        assert store.get("Pod", "default", "m1").status.phase == "Running"
+        assert task.status == TaskStatus.BOUND  # ack missed: stream torn
+        cache.watch_manager.step()
+        assert task.status == TaskStatus.RUNNING
+        node = cache.nodes["n1"]
+        assert list(node.tasks) == ["m1"]
+        assert node.used.cpu == task.resreq.cpu  # accounted exactly once
+
+    def test_store_chaos_convergence_under_faults(self):
+        """20% verb faults on every store verb: the retry funnel + watch
+        upkeep still converge a create/bind/evict/delete storm to exact
+        terminal state."""
+        store, faulty, transport, cache = self._wired(fault_rate=0.2)
+        ok_pods = []
+        for i in range(30):
+            name = f"p{i}"
+            try:
+                transport.create(make_pg(f"grp{i}"))
+                transport.create(make_pod(name, group=f"grp{i}"))
+                ok_pods.append(name)
+            except Exception:
+                pass                      # a client submit that gave up
+        cache.watch_manager.step()
+        assert {f"default/grp{i}" for i in range(30)
+                if f"p{i}" in ok_pods} <= set(cache.jobs)
+        for name in ok_pods:
+            for attempt in range(10):
+                try:
+                    transport.delete("Pod", "default", name)
+                    break
+                except Exception:
+                    continue
+        cache.watch_manager.step()
+        live = [p.metadata.name for p in store.list("Pod")]
+        cached = {u for j in cache.jobs.values() for u in j.tasks}
+        assert cached == set(live)
+
+
+# ---------------------------------------------------------------------------
+# the store-chaos sim acceptance slice (docs/simulation.md --store-wired)
+# ---------------------------------------------------------------------------
+
+class TestStoreWiredSim:
+    def _run(self, scenario="smoke", **kw):
+        from volcano_tpu.sim.runner import SimRunner
+        from volcano_tpu.sim.workload import make_scenario
+        trace = make_scenario(scenario, seed=3)
+        runner = SimRunner(trace, seed=3, store_wired=True,
+                           scenario=scenario, **kw)
+        return runner.run()
+
+    def test_store_wired_smoke_completes_exactly(self):
+        report = self._run()
+        assert report["jobs"]["completed"] == report["jobs"]["arrived"] > 0
+        assert report["jobs"]["unfinished"] == 0
+        assert report["double_binds"] == 0
+        assert report["store"]["retry_funnel"]["exhausted"] == 0
+
+    def test_store_chaos_converges_and_is_deterministic(self):
+        """20% verb faults + 2 torn watch streams + seeded kills: exact
+        terminal accounting, zero double-binds, byte-deterministic x2 —
+        the acceptance contract of the store-chaos soak."""
+        from volcano_tpu.sim.report import (deterministic_json,
+                                            terminal_accounting)
+        kw = dict(store_fault_rate=0.2, torn_watches=2,
+                  kill_cycles=(2, 5), kill_seed=1)
+        a = self._run(**kw)
+        b = self._run(**kw)
+        assert deterministic_json(a) == deterministic_json(b)
+        clean = self._run()
+        assert terminal_accounting(a) == terminal_accounting(clean)
+        assert a["double_binds"] == 0 and a["restarts"] == 2
+        assert a["store"]["faults"].get("transient", 0) > 0
+        assert a["store"]["retry_funnel"]["retries"] > 0
+        assert a["store"]["torn_watch_events"] == 2
+        assert a["store"]["watch_resumes"] \
+            + a["store"]["watch_relists"] >= 2
+
+    def test_federated_store_backed_smoke(self):
+        """--federated 4 over the store: partitioned informer-fed caches
+        (server-side filtered watch) + the PartitionState CR transport;
+        faults on every partition's connection."""
+        report = self._run(federated_partitions=4, store_fault_rate=0.2,
+                           torn_watches=2)
+        assert report["jobs"]["completed"] == report["jobs"]["arrived"] > 0
+        assert report["double_binds"] == 0
+        assert report["federation"]["store_backed"] is True
+
+    def test_federated_store_backed_reserves_flow_through_cr(self):
+        report = self._run(scenario="fed-starve", federated_partitions=4)
+        assert report["cross_partition_reserves"].get("granted", 0) > 0
+        assert report["federation"]["node_transfers"] > 0
+        assert report["jobs"]["completed"] == report["jobs"]["arrived"]
+        assert report["double_binds"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ops surfaces: vcctl store status + /healthz?detail store section
+# ---------------------------------------------------------------------------
+
+def test_vcctl_store_status_verb():
+    import random
+    from volcano_tpu.cache.store_wiring import wire_cache_to_store
+    from volcano_tpu.cli.vcctl import main
+    metrics.reset_local()
+    store = ObjectStore()
+    inj = StoreFaultInjector(failure_rate=0.5, seed=4, latency_s=0.0,
+                             conflict_share=0.0)
+    transport = RetryingStoreTransport(FaultyStoreTransport(store, inj),
+                                       sleep_fn=lambda s: None,
+                                       rng=random.Random(0))
+    cache = wire_cache_to_store(transport)
+    for i in range(5):
+        transport.create(make_pg(f"g{i}"))
+    cache.watch_manager.step()
+    lines = []
+    rc = main(["store", "status"], store=transport, out=lines.append)
+    assert rc == 0
+    text = "\n".join(lines)
+    assert "resourceVersion=" in text
+    assert "PodGroup\t5" in text
+    assert "retries/create/ok\t5" in text
+    assert "watch/PodGroup" in text and "watch_staleness=0" in text
+
+
+def test_healthz_detail_store_section():
+    metrics.reset_local()
+    from volcano_tpu.cache.store_wiring import wire_cache_to_store
+    store = ObjectStore()
+    cache = wire_cache_to_store(store)
+    cache.watch_manager.step()
+    detail = metrics.health_detail()
+    assert detail["store"]["wired"] is True
+    assert {w["kind"] for w in detail["store"]["streams"]} == {
+        "ResourceQuota", "PriorityClass", "Pod", "PodGroup", "Queue"}
+    assert "store_faults_total" in detail
+    assert "store_retries_total" in detail

@@ -503,6 +503,80 @@ class CrossPartitionFunnelRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# VT016 — store verbs ride the retrying-transport funnel (store boundary)
+# ---------------------------------------------------------------------------
+
+class StoreVerbFunnelRule(Rule):
+    """Scheduler-side store writes must flow through the retrying-
+    transport funnel (store_transport.RetryingStoreTransport — bounded
+    retry, backoff+jitter, per-cycle budget, resync degradation;
+    docs/robustness.md store failure model). The funnel is a runtime
+    composition, so statically the contract is scoping: the only code
+    allowed to invoke store verbs directly is the executor funnel layer
+    (cache/executors.py Store*), the transports themselves, and the
+    federation CAS funnel (store_backed.py, whose fresh-read-and-reapply
+    retry the generic transport cannot provide). A bare verb call
+    anywhere else in scheduler scope is a write that crashes the cycle
+    on the first transient apiserver error.
+
+    Matched verbs: the distinctive store surface (``bind_pod``,
+    ``evict_pod``, ``update_status``, ``create_batch``) on any receiver,
+    plus the generic CRUD verbs (``create``/``update``/``delete``) when
+    the receiver names a store (``self.store.update(...)``,
+    ``store.create(...)`` — ``dict.update`` and friends stay out)."""
+
+    id = "VT016"
+    name = "store-verb-funnel"
+    contract = ("scheduler-side store verb call outside the retrying-"
+                "transport funnel (store failure model, "
+                "docs/robustness.md)")
+    scope = ("volcano_tpu/scheduler.py", "volcano_tpu/actions/",
+             "volcano_tpu/framework/", "volcano_tpu/cache/",
+             "volcano_tpu/plugins/", "volcano_tpu/federation/")
+    # executors.py IS the funnel layer the transports compose under;
+    # store_backed.py is the federation CAS funnel (per-transition
+    # conflict retry with fresh reads)
+    exclude = ("volcano_tpu/cache/executors.py",
+               "volcano_tpu/federation/store_backed.py",
+               "volcano_tpu/analysis/")
+
+    DISTINCT_VERBS = {"bind_pod", "evict_pod", "update_status",
+                      "create_batch"}
+    GENERIC_VERBS = {"create", "update", "delete"}
+
+    def _is_store_verb(self, node: ast.Call) -> Optional[str]:
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        verb = node.func.attr
+        recv = dotted_name(node.func.value)
+        if verb in self.DISTINCT_VERBS:
+            return f"{recv or '<expr>'}.{verb}"
+        if verb in self.GENERIC_VERBS and recv is not None \
+                and "store" in recv.split(".")[-1].lower():
+            return f"{recv}.{verb}"
+        return None
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self._is_store_verb(node)
+            if target is None:
+                continue
+            fn = mod.enclosing_function(node.lineno)
+            where = fn.qualname if fn else "<module>"
+            findings.append(self.finding(
+                mod, node,
+                f"store verb {target}(...) in {where} outside the "
+                f"retrying-transport funnel; scheduler-side store writes "
+                f"ride store_transport.RetryingStoreTransport so a "
+                f"transient apiserver error degrades to resync instead "
+                f"of crashing the cycle (docs/robustness.md)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
 # VT005 — SimKill tunneling (PR 4, docs/robustness.md)
 # ---------------------------------------------------------------------------
 
@@ -1302,7 +1376,7 @@ ALL_RULES: List[Rule] = [
     LockDisciplineRule(), FencingEpochRule(), CrossPartitionFunnelRule(),
     HostSyncRule(), TracedBranchRule(), DataflowShapeBucketRule(),
     DtypeDisciplineRule(), SessionEscapeRule(),
-    SpeculationIsolationRule(),
+    SpeculationIsolationRule(), StoreVerbFunnelRule(),
 ]
 
 # the rules that run on the shared dataflow/callgraph engine
@@ -1360,6 +1434,11 @@ solver(state, idx)                     # truncates under x64-disabled''',
     sssn = open_session(self.cache, speculative=True)
     ssn.cache.bind_batch(gang)         # journaled side effect BEFORE
                                        # the commit funnel''',
+    "VT016": '''def flush(self, store, pg):
+    store.update_status(pg)            # bare verb: first transient
+                                       # apiserver error crashes the
+                                       # cycle — ride the retrying
+                                       # transport funnel''',
 }
 for _rule in ALL_RULES:
     _rule.example = _EXAMPLES.get(_rule.id, "")

@@ -242,6 +242,24 @@ class PriorityClass:
 
 
 @dataclass
+class PartitionStateCR:
+    """Federated control-plane state as a store object
+    (docs/federation.md, store-backed transport): the PartitionMap's
+    queue/node ownership + pin/drain markers and the ReserveLedger's
+    open request set, flowing through the same CAS/watch path as every
+    other CR. ``spec`` is one plain dict (queue_owner, node_owner,
+    pinned, draining, rr_queue, rr_node, idle, requests, next_rid,
+    version) so the CAS funnel can deep-copy/replace it wholesale —
+    partial writes cannot exist, which is what makes an ownership flip
+    atomic at the store."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: Dict = field(default_factory=dict)
+
+    KIND = "PartitionState"
+
+
+@dataclass
 class Command:
     """bus/v1alpha1 Command: async RPC from CLI to controllers."""
 

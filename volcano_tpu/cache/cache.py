@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import logging
 import os
 import threading
 import time
@@ -27,6 +28,8 @@ from ..api import (ClusterInfo, JobInfo, NamespaceCollection, NamespaceInfo,
 from .executors import (Binder, Evictor, FakeBinder, FakeEvictor,
                         StatusUpdater, VolumeBinder)
 from .journal import IntentJournal, journal_enabled
+
+log = logging.getLogger(__name__)
 
 
 def incremental_snapshot_enabled() -> bool:
@@ -200,6 +203,12 @@ class SchedulerCache:
         self._new_job_uids: Set[str] = set()
         # result of the last shadow-verifier pass (verify_state_integrity)
         self.last_verify: Dict[str, object] = {}
+        # store-wired caches carry their resumable watch streams here
+        # (cache/watches.WatchManager, attached by wire_cache_to_store);
+        # the scheduler epilogue drives step() — torn-stream resume,
+        # bookmarks, retry-budget reset (docs/robustness.md store
+        # failure model). None for direct-fed caches (tests, sim default)
+        self.watch_manager = None
 
     # -- intent journal (cache/journal.py) ----------------------------------
 
@@ -322,6 +331,12 @@ class SchedulerCache:
             if job is not None:
                 for task_uid in job.tasks:
                     self._drop_retry_state(task_uid)
+                # a parked podgroup-status flush for a removed job is moot
+                key = f"pg_status/{uid}"
+                if self.dead_letter.pop(key, None) is not None:
+                    from .. import metrics
+                    metrics.set_dead_letter_size(len(self.dead_letter))
+                self.resync_queue.forget(key)
 
     def get_or_create_job(self, uid: str, **kwargs) -> JobInfo:
         with self._lock:
@@ -1171,6 +1186,23 @@ class SchedulerCache:
         _resync_stale) are dropped, not retried."""
         done = 0
         for key, (op, task) in self.resync_queue.pop_ready():
+            if op == "pg_status":
+                # a parked podgroup status flush (the item is the
+                # JobInfo): re-flush the job's LATEST status — the
+                # queued snapshot is stale by definition; dropping the
+                # retry when the job is gone
+                with self._lock:
+                    live = self.jobs.get(task.uid)
+                if live is None or live.podgroup is None:
+                    self.resync_queue.forget(key)
+                    continue
+                try:
+                    self.status_updater.update_pod_group(live)
+                    self.resync_queue.forget(key)
+                    done += 1
+                except Exception:
+                    self._resync_or_dead_letter(key, op, live)
+                continue
             if self._resync_stale(op, task):
                 self.resync_queue.forget(key)
                 continue
@@ -1243,7 +1275,20 @@ class SchedulerCache:
         self.status_updater.update_pod_group(job)
 
     def update_job_status(self, job: JobInfo) -> None:
-        self.status_updater.update_pod_group(job)
+        try:
+            self.status_updater.update_pod_group(job)
+        except Exception:
+            # a store write that failed past the retrying transport's
+            # budget (docs/robustness.md store failure model): the cycle
+            # must not crash, and the STORE must not be left disagreeing
+            # about the phase forever — the store's bind gate refuses
+            # pods whose PodGroup it still sees Pending. Park a
+            # pg_status retry; process_resync_tasks re-flushes the
+            # job's LATEST status once the backoff expires.
+            log.exception("podgroup status write for %s failed; queued "
+                          "for resync", job.uid)
+            self._resync_or_dead_letter(f"pg_status/{job.uid}",
+                                        "pg_status", job)
         with self._lock:
             cached = self.jobs.get(job.uid)
             if cached is not None and cached.podgroup is not job.podgroup:

@@ -9,7 +9,7 @@ the watch source. Pods carry their gang membership in the
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 from ..api import (JobInfo, NodeInfo, PodGroup, PodGroupPhase, QueueInfo,
                    Resource, TaskInfo, TaskStatus)
@@ -81,9 +81,30 @@ def podgroup_to_job(pg: PodGroupCR) -> JobInfo:
 
 def wire_cache_to_store(store: ObjectStore,
                         cache: Optional[SchedulerCache] = None,
+                        resumable: Optional[bool] = None,
+                        event_filter: Optional[Callable] = None,
                         ) -> SchedulerCache:
     """Subscribe a SchedulerCache to the store; side effects write back via
-    StoreBinder/StoreEvictor (the REST-out half of the bus)."""
+    StoreBinder/StoreEvictor (the REST-out half of the bus).
+
+    ``store`` may be the raw ObjectStore or the production transport
+    composition (store_transport.RetryingStoreTransport over it) — the
+    executors write through whatever is handed in, which is how every
+    scheduler-side store write rides the retry funnel (vlint VT016).
+
+    ``resumable`` wraps each watch in a cache/watches.ResumableWatch
+    (resourceVersion tracking, torn-stream resume, 410-Gone relist — the
+    informer contract) and attaches the WatchManager as
+    ``cache.watch_manager`` so the scheduler epilogue can drive stream
+    upkeep. Default: on whenever the store supports consistent lists
+    (list_with_rv); pass False to force the legacy direct wiring.
+
+    ``event_filter(kind, obj) -> bool`` scopes Pod/PodGroup ingestion —
+    the server-side filtered watch of a federated deployment (each
+    partition's cache holds only its queue subset's jobs,
+    docs/federation.md). The filter must be STABLE per object (queue
+    ownership does not move outside the drain funnel, which schedules
+    the queue on NO partition until the flip)."""
     if cache is None:
         cache = SchedulerCache(binder=StoreBinder(store),
                                evictor=StoreEvictor(store),
@@ -135,6 +156,12 @@ def wire_cache_to_store(store: ObjectStore,
             job = cache.jobs.get(task.job)
             if job is not None and task.uid in job.tasks:
                 cache.delete_task(job.tasks[task.uid])
+                if not job.tasks and job.podgroup is None:
+                    # the PodGroup went first and this was the last pod:
+                    # drop the empty shell so a long-running store-wired
+                    # cache (and the sim's drain check) doesn't hold one
+                    # JobInfo per completed job forever
+                    cache.remove_job(task.job)
 
     def on_podgroup(event: str, pg: PodGroupCR, old) -> None:
         uid = f"{pg.metadata.namespace}/{pg.metadata.name}"
@@ -155,6 +182,9 @@ def wire_cache_to_store(store: ObjectStore,
             if job is not None:
                 job.podgroup = None
                 cache.mark_job_dirty(uid)
+                if not job.tasks:
+                    # no pods left either: the job is fully gone
+                    cache.remove_job(uid)
 
     def on_queue(event: str, q: QueueCR, old) -> None:
         if event in (ADDED, UPDATED):
@@ -174,11 +204,33 @@ def wire_cache_to_store(store: ObjectStore,
         else:
             cache.add_resource_quota(quota)
 
-    store.watch("ResourceQuota", on_resource_quota)
-    store.watch("PriorityClass", on_priority_class)
-    store.watch("Pod", on_pod)
-    store.watch("PodGroup", on_podgroup)
-    store.watch("Queue", on_queue)
+    if event_filter is not None:
+        def _filtered(kind, handler):
+            def wrapped(event, obj, old):
+                if not event_filter(kind, obj):
+                    return
+                handler(event, obj, old)
+            return wrapped
+        on_pod = _filtered("Pod", on_pod)
+        on_podgroup = _filtered("PodGroup", on_podgroup)
+
+    handlers = [("ResourceQuota", on_resource_quota),
+                ("PriorityClass", on_priority_class),
+                ("Pod", on_pod),
+                ("PodGroup", on_podgroup),
+                ("Queue", on_queue)]
+    if resumable is None:
+        resumable = hasattr(store, "list_with_rv") \
+            and hasattr(store, "current_rv")
+    if resumable:
+        from .watches import WatchManager
+        manager = WatchManager(store)
+        for kind, handler in handlers:
+            manager.add(kind, handler)
+        cache.watch_manager = manager
+    else:
+        for kind, handler in handlers:
+            store.watch(kind, handler)
     return cache
 
 

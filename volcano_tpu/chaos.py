@@ -109,6 +109,78 @@ class ChaosStatusUpdater(_ChaosWrapper, StatusUpdater):
         self.inner.update_pod_group(job)
 
 
+class StoreFaultInjector:
+    """Seeded per-verb fault plan for the API-server boundary — drives
+    :class:`volcano_tpu.store_transport.FaultyStoreTransport`. Every
+    store verb call rolls ONE seeded coin; a hit picks a fault kind by
+    seeded weighted choice among the kinds legal for that verb:
+
+    - ``transient``  — TransientStoreError (500/etcd-timeout analogue;
+      the retrying transport absorbs it with backoff),
+    - ``conflict``   — ConflictError on WRITE verbs (409; CAS loops
+      re-read, non-CAS writers surface it like any error),
+    - ``latency``    — a slow verb: ``sleep_fn(latency_s)`` then success
+      (virtual seconds under the sim's clock — deterministic).
+
+    Watch streams tear separately: ``roll_tear()`` is consulted per
+    delivered watch event, and the sim additionally schedules whole-
+    stream tears at seeded cycles. All RNG is one ``random.Random(seed)``
+    per injector — a failing soak reproduces from its printed seed."""
+
+    READ_VERBS = ("get", "list")
+    WRITE_VERBS = ("create", "create_batch", "update", "update_status",
+                   "delete", "bind_pod", "evict_pod")
+
+    def __init__(self, failure_rate: float = 0.2, seed: int = 0,
+                 conflict_share: float = 0.25, latency_share: float = 0.25,
+                 latency_s: float = 0.05, tear_rate: float = 0.0,
+                 sleep_fn=None):
+        if not 0.0 <= failure_rate <= 1.0:
+            raise ValueError(f"failure_rate {failure_rate} not in [0, 1]")
+        self.failure_rate = failure_rate
+        self.conflict_share = conflict_share
+        self.latency_share = latency_share
+        self.latency_s = latency_s
+        self.tear_rate = tear_rate
+        self.seed = seed
+        self.sleep_fn = sleep_fn or time.sleep
+        self._rng = random.Random(seed)
+        self.attempts = 0
+        self.injected: Dict[str, int] = {}     # kind -> count
+
+    def _pick_kind(self, verb: str) -> str:
+        r = self._rng.random()
+        if verb not in self.READ_VERBS and r < self.conflict_share:
+            return "conflict"
+        if r < self.conflict_share + self.latency_share:
+            return "latency"
+        return "transient"
+
+    def roll(self, verb: str) -> Optional[str]:
+        """One verb attempt: returns the injected fault kind ("latency"
+        is applied here — the sleep — and reported for counting), or
+        None for a clean call."""
+        self.attempts += 1
+        if self._rng.random() >= self.failure_rate:
+            return None
+        kind = self._pick_kind(verb)
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+        if kind == "latency":
+            if self.latency_s:
+                self.sleep_fn(self.latency_s)
+        return kind
+
+    def roll_tear(self) -> bool:
+        """Per-delivered-watch-event tear roll (a torn stream stops
+        receiving until its owner resumes it)."""
+        if not self.tear_rate:
+            return False
+        if self._rng.random() >= self.tear_rate:
+            return False
+        self.injected["torn_watch"] = self.injected.get("torn_watch", 0) + 1
+        return True
+
+
 class DeviceFaultInjector:
     """Simulate XLA device errors (OOM / device-lost) at the allocate
     solve boundary — install as ``actions.allocate.DEVICE_FAULT_HOOK``.

@@ -214,6 +214,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "contiguously from p0 until the first missing "
                          "lease")
 
+    st = sub.add_parser(
+        "store", description="Store-boundary verbs (docs/robustness.md "
+                             "store failure model): object counts, "
+                             "fault/retry funnel totals, watch stream "
+                             "staleness").add_subparsers(dest="verb")
+    st.add_parser(
+        "status", description="Current resourceVersion, per-kind object "
+                              "counts, volcano_store_faults/retries "
+                              "totals and per-stream watch state")
+
     sub.add_parser("version")
     return parser
 
@@ -276,6 +286,30 @@ def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
         return 0
     if store is None:
         out("no cluster store attached (in-process CLI requires a store)")
+        return 1
+    if args.group == "store":
+        if args.verb == "status":
+            from .. import metrics
+            if hasattr(store, "current_rv"):
+                out(f"resourceVersion={store.current_rv()}")
+            for kind in getattr(store, "KINDS", ()):
+                n = len(store.list(kind))
+                if n:
+                    out(f"{kind}\t{n}")
+            counts = metrics.store_counts()
+            for family in ("faults", "retries", "watch_resumes"):
+                for key, v in sorted(counts[family].items()):
+                    out(f"{family}/{key}\t{int(v)}")
+            detail = metrics.health_detail().get("store", {})
+            for stream in detail.get("streams", []):
+                out(f"watch/{stream['kind']}\tlast_rv={stream['last_rv']}"
+                    f"\ttorn={stream['torn']}"
+                    f"\tresumes={stream['resumes']}"
+                    f"\trelists={stream['relists']}")
+            if "staleness" in detail:
+                out(f"watch_staleness={detail['staleness']}")
+            return 0
+        build_parser().print_help()
         return 1
     if args.group == "federation":
         if args.verb == "status":

@@ -28,5 +28,10 @@ non-contended traces.
 from .member import PartitionMember
 from .partition import PartitionMap
 from .reserve import ReserveLedger
+from .store_backed import (StoreBackedPartitionMap,
+                           StoreBackedReserveLedger,
+                           StorePartitionBackend)
 
-__all__ = ["PartitionMap", "PartitionMember", "ReserveLedger"]
+__all__ = ["PartitionMap", "PartitionMember", "ReserveLedger",
+           "StoreBackedPartitionMap", "StoreBackedReserveLedger",
+           "StorePartitionBackend"]

@@ -52,6 +52,12 @@ class PartitionMember:
     # -- cycle hooks (leader-gated by the scheduler shell) -------------------
 
     def on_cycle_start(self) -> None:
+        # store-backed maps (federation/store_backed.py) first heal a
+        # torn PartitionState stream so this cycle reviews against the
+        # freshest ownership/request state reachable
+        sync = getattr(self.pmap, "sync", None)
+        if sync is not None:
+            sync()
         epoch = self.epoch_fn()
         self.ledger.expire(self.time_fn())
         self.ledger.settle_moves(self.pid, epoch)

@@ -101,7 +101,11 @@ class ReserveLedger:
         self.registry = registry           # executors.FencingRegistry
         self.time_fn = time_fn
         self.timeout_s = timeout_s
-        self._lock = threading.Lock()
+        # reentrant: the store-backed subclass persists transitions
+        # through a CAS funnel whose watch echo applies remote state
+        # back onto this ledger's mirror — possibly on the same thread
+        # that holds the lock mid-_settle
+        self._lock = threading.RLock()
         self._rid = itertools.count(1)
         # OPEN requests only; settled ones move to the bounded history
         # below (the journal is the durable record), so a persistently
@@ -146,6 +150,23 @@ class ReserveLedger:
         while len(self.settled) > self.settled_keep:
             self.settled.popitem(last=False)
         self._count(state)
+        self._drop_request(req)
+
+    # -- persistence hooks (federation/store_backed.py) ----------------------
+    #
+    # The in-process ledger IS the shared state, so these are no-ops. The
+    # store-backed subclass persists every request transition to the
+    # PartitionState CR through the CAS funnel, and allocates rids from
+    # the CR — one protocol implementation, two transports.
+
+    def _alloc_rid(self) -> int:
+        return next(self._rid)
+
+    def _persist_request(self, req: ReserveRequest) -> None:
+        pass
+
+    def _drop_request(self, req: ReserveRequest) -> None:
+        pass
 
     def find(self, rid: int) -> Optional[ReserveRequest]:
         with self._lock:
@@ -201,12 +222,13 @@ class ReserveLedger:
         now = self.time_fn()
         epoch_to = self.registry.current(to) if self.registry is not None \
             else 0
+        rid = self._alloc_rid()
         with self._lock:
-            rid = next(self._rid)
             req = ReserveRequest(rid, frm, to, cpu, mem, now,
                                  now + self.timeout_s, epoch_from, epoch_to)
             self.requests[rid] = req
             self._count(REQUESTED)
+        self._persist_request(req)
         self._journal_reserve("reserve", rid=rid, frm=frm, to=to, cpu=cpu,
                               mem=mem, epoch_from=epoch_from,
                               epoch_to=epoch_to, deadline=req.deadline)
@@ -273,6 +295,11 @@ class ReserveLedger:
         with self._lock:
             req.node = chosen
             req.state = GRANTING
+        # persist the request transition BEFORE the pin: the pin write's
+        # watch echo re-applies the CR's request record onto local
+        # mirrors, so the record must already say GRANTING (store-backed
+        # transport ordering, federation/store_backed.py)
+        self._persist_request(req)
         self.pmap._pin_node_raw(chosen, req.rid)
         self._journal_reserve("reserve_pin", rid=req.rid, node=chosen,
                               epoch=epoch)
@@ -291,6 +318,7 @@ class ReserveLedger:
             with self._lock:
                 req.node = ""
                 req.state = REQUESTED
+            self._persist_request(req)
             return
         if node.tasks:
             for uid in sorted(node.tasks):

@@ -174,6 +174,24 @@ if _HAVE_PROM:
         f"{_SUBSYSTEM}_tensor_epochs_live",
         "Pinned PersistentNodeTensors epochs currently live (the A side "
         "of the double-buffered pair; >1 sustained is a retire leak)")
+    _store_retries = Counter(
+        f"{_SUBSYSTEM}_store_retries_total",
+        "Store verb attempts through the retrying transport funnel "
+        "(result=ok|retry|exhausted; docs/robustness.md store failure "
+        "model)", ["verb", "result"])
+    _store_faults = Counter(
+        f"{_SUBSYSTEM}_store_faults_total",
+        "Faults injected/observed at the store boundary "
+        "(kind=transient|conflict|latency|torn)", ["verb", "kind"])
+    _watch_resumes = Counter(
+        f"{_SUBSYSTEM}_store_watch_resumes_total",
+        "Torn watch streams recovered (outcome=resume: backlog replay "
+        "from the last resourceVersion; outcome=relist: 410 Gone, "
+        "reconciled against a fresh list)", ["outcome"])
+    _watch_stale = Gauge(
+        f"{_SUBSYSTEM}_store_watch_staleness",
+        "Max resourceVersion lag across live watch streams (torn "
+        "streams fall behind until resumed)")
 
 
 def update_e2e_duration(seconds: float) -> None:
@@ -237,6 +255,76 @@ def health_detail() -> dict:
             "cross_partition_reserves_total": {
                 k[1]: v for k, v in _counters.items()
                 if k[0] == "cross_partition_reserves"},
+            # the store boundary (docs/robustness.md store failure
+            # model): retry-funnel + fault + watch-stream state pushed by
+            # the transports/watch manager, plus the counter totals
+            "store": dict(_health_detail.get("store",
+                                             {"wired": False})),
+            "store_faults_total": {
+                "/".join(k[1:]): v for k, v in _counters.items()
+                if k[0] == "store_faults"},
+            "store_retries_total": {
+                "/".join(k[1:]): v for k, v in _counters.items()
+                if k[0] == "store_retries"},
+        }
+
+
+def register_store_retry(verb: str, result: str) -> None:
+    """One store verb attempt through the retrying transport funnel
+    settled with ``result`` (ok|retry|exhausted) — the
+    volcano_store_retries_total{verb,result} series
+    (docs/robustness.md store failure model)."""
+    with _lock:
+        _counters[("store_retries", verb, result)] += 1
+    if _HAVE_PROM:
+        _store_retries.labels(verb=verb, result=result).inc()
+
+
+def register_store_fault(verb: str, kind: str) -> None:
+    """A fault (transient|conflict|latency|torn) was injected or
+    observed at the store boundary on ``verb``."""
+    with _lock:
+        _counters[("store_faults", verb, kind)] += 1
+    if _HAVE_PROM:
+        _store_faults.labels(verb=verb, kind=kind).inc()
+
+
+def register_watch_resume(outcome: str) -> None:
+    """A torn watch stream recovered: ``resume`` (backlog replay from
+    its last resourceVersion) or ``relist`` (410 Gone; reconciled
+    against a fresh consistent list)."""
+    with _lock:
+        _counters[("store_watch_resumes", outcome)] += 1
+    if _HAVE_PROM:
+        _watch_resumes.labels(outcome=outcome).inc()
+
+
+def set_store_watch_staleness(lag: int) -> None:
+    with _lock:
+        _gauges[("store_watch_staleness",)] = float(lag)
+    if _HAVE_PROM:
+        _watch_stale.set(float(lag))
+
+
+def set_store_detail(detail: dict) -> None:
+    """Publish the store-boundary operational fragment of
+    /healthz?detail (retry funnel totals, watch stream states)."""
+    with _lock:
+        _health_detail["store"] = dict(detail)
+
+
+def store_counts() -> Dict[str, Dict[str, float]]:
+    """Current store-boundary counters, grouped — the sim report and
+    vcctl `store status` read these (take before/after deltas for
+    per-run rates)."""
+    with _lock:
+        return {
+            "retries": {"/".join(k[1:]): v for k, v in _counters.items()
+                        if k[0] == "store_retries"},
+            "faults": {"/".join(k[1:]): v for k, v in _counters.items()
+                       if k[0] == "store_faults"},
+            "watch_resumes": {k[1]: v for k, v in _counters.items()
+                              if k[0] == "store_watch_resumes"},
         }
 
 
@@ -491,6 +579,7 @@ _EXPO_GAUGES = {
     "leader": (f"{_SUBSYSTEM}_leader", None),
     "partition_leader": (f"{_SUBSYSTEM}_partition_leader", "partition"),
     "tensor_epochs_live": (f"{_SUBSYSTEM}_tensor_epochs_live", None),
+    "store_watch_staleness": (f"{_SUBSYSTEM}_store_watch_staleness", None),
 }
 _EXPO_COUNTERS = {
     "attempts": (f"{_SUBSYSTEM}_schedule_attempts_total", "result"),
@@ -514,6 +603,13 @@ _EXPO_COUNTERS = {
     "speculation": (f"{_SUBSYSTEM}_speculation_total", "outcome"),
     "fast_admit_gangs": (f"{_SUBSYSTEM}_fast_admit_gangs_total", None),
     "fast_admit_binds": (f"{_SUBSYSTEM}_fast_admit_binds_total", None),
+    # tuple label specs render one label per key component (the
+    # two-label store series of docs/robustness.md's store failure model)
+    "store_retries": (f"{_SUBSYSTEM}_store_retries_total",
+                      ("verb", "result")),
+    "store_faults": (f"{_SUBSYSTEM}_store_faults_total", ("verb", "kind")),
+    "store_watch_resumes": (f"{_SUBSYSTEM}_store_watch_resumes_total",
+                            "outcome"),
 }
 # duration-series key -> (family, label name, unit suffix already in name)
 _EXPO_DURATIONS = {
@@ -545,11 +641,18 @@ def fallback_exposition() -> bytes:
     as summary ``_count``/``_sum`` pairs (all-time, truncation-immune)."""
     families: Dict[str, list] = {}
 
-    def add(name: str, mtype: str, label: Optional[str],
-            labelv: Optional[str], value: float,
+    def add(name: str, mtype: str, label,
+            labelv, value: float,
             suffix: str = "") -> None:
         fam = families.setdefault(name, [mtype])
-        if label is not None and labelv is not None:
+        if isinstance(label, tuple) and labelv is not None:
+            # multi-label series (e.g. store_retries{verb,result}): one
+            # label per key component, padded with "" when short
+            vals = list(labelv) + [""] * (len(label) - len(labelv))
+            pairs = ",".join(f'{ln}="{_expo_escape(lv)}"'
+                             for ln, lv in zip(label, vals))
+            fam.append(f"{name}{suffix}{{{pairs}}} {float(value)}")
+        elif label is not None and labelv is not None:
             fam.append(f'{name}{suffix}{{{label}="{_expo_escape(labelv)}"}}'
                        f" {float(value)}")
         else:
@@ -573,6 +676,9 @@ def fallback_exposition() -> bytes:
                 name = f"{_SUBSYSTEM}_{_expo_name(key[0])}_total"
                 label, labelv = ("key", ":".join(key[1:])) \
                     if len(key) > 1 else (None, None)
+            elif isinstance(spec[1], tuple):
+                name, label = spec
+                labelv = tuple(key[1:]) if len(key) > 1 else None
             else:
                 name, label = spec
                 labelv = key[1] if label is not None and len(key) > 1 \

@@ -289,9 +289,13 @@ class NativeObjectStore:
                                       data, len(data), int(expect_rv))
             if rv == -2:
                 from ..store import ConflictError
-                raise ConflictError(
-                    f"{kind} {key}: resourceVersion conflict "
-                    f"(expected {expect_rv})")
+                # report the OBSERVED version alongside the expected one:
+                # a retry loop re-reads precisely instead of guessing, and
+                # a log line alone shows how far the writer was behind
+                cur = self._read(kind, key)
+                observed = cur.metadata.resource_version \
+                    if cur is not None else 0
+                raise ConflictError(kind, key, observed, int(expect_rv))
             obj.metadata.resource_version = rv
         self._drain_events()
         return obj

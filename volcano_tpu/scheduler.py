@@ -61,16 +61,23 @@ class _SpecCommitPlan:
     """A conflict-check verdict that lets the speculation commit: carried
     into the cycle's allocate slot, where _commit_speculation awaits the
     solve and replays it. ``promoted`` means the speculative session
-    itself became the cycle's session (full hit)."""
+    itself became the cycle's session (full hit). ``avoid_nodes`` names
+    completion-shrunk nodes the tolerable-delta widening admitted on the
+    PROMISE that the speculative solve placed nothing there — checked
+    against the actual solution once it is fetched; a broken promise
+    downgrades to the serial re-solve."""
 
-    __slots__ = ("pending", "engine", "outcome", "spec_ssn", "promoted")
+    __slots__ = ("pending", "engine", "outcome", "spec_ssn", "promoted",
+                 "avoid_nodes")
 
-    def __init__(self, spec: _Speculation, outcome: str, promoted: bool):
+    def __init__(self, spec: _Speculation, outcome: str, promoted: bool,
+                 avoid_nodes=frozenset()):
         self.pending = spec.pending
         self.engine = spec.engine
         self.outcome = outcome
         self.spec_ssn = spec.ssn
         self.promoted = promoted
+        self.avoid_nodes = frozenset(avoid_nodes)
 
 # crash-loop guard defaults: first failed cycle waits backoff_base, each
 # consecutive failure doubles it up to backoff_max, each wait is stretched
@@ -560,6 +567,18 @@ class Scheduler:
                     journal.flush()
                 except Exception:
                     log.exception("journal flush failed")
+            # store-wired caches: resume torn watch streams, tick
+            # bookmarks, reset the retry funnel's per-cycle budget
+            # (cache/watches.WatchManager; docs/robustness.md store
+            # failure model). Isolated — stream upkeep failing must not
+            # cost the cycle; the next epilogue retries.
+            manager = getattr(self.cache, "watch_manager", None)
+            if manager is not None:
+                try:
+                    with obs_trace.TRACE.span("watch_upkeep"):
+                        manager.step()
+                except Exception:
+                    log.exception("watch-stream upkeep failed")
             if self.federation is not None:
                 try:
                     self.federation.on_cycle_end()
@@ -601,41 +620,67 @@ class Scheduler:
             ssn = open_session(self.cache, self.conf.tiers,
                                self.conf.configurations,
                                time_fn=self.clock.now)
-        if not self._delta_tolerable(spec, ssn, delta):
+        avoid = self._delta_tolerable(spec, ssn, delta)
+        if avoid is None:
             self._abandon_speculation(spec, "conflict")
             return ssn, None
-        plan = _SpecCommitPlan(spec, "partial", promoted=False)
+        plan = _SpecCommitPlan(spec, "partial", promoted=False,
+                               avoid_nodes=avoid)
         # the solution objects live on through the plan's pending; the
         # speculative session itself (GC window, pinned epoch) releases
         # now — nothing journaled, nothing half-applied
         abandon_session(spec.ssn)
         return ssn, plan
 
-    def _delta_tolerable(self, spec: _Speculation, ssn, delta) -> bool:
-        """May the speculative solve still commit onto ``ssn`` despite the
-        delta? True iff every changed node/known job is DECISION-EQUAL
-        between the speculative and the fresh snapshot (bind acks —
-        BOUND→RUNNING — are the canonical tolerable delta: resource
-        accounting, pending sets and gang counters all unchanged), and
-        every other changed job is NEW (unknown at speculation time; the
-        commit's suffix solve owns those)."""
+    def _delta_tolerable(self, spec: _Speculation, ssn, delta):
+        """May the speculative solve still commit onto ``ssn`` despite
+        the delta? Returns the set of COMPLETION-SHRUNK node names the
+        commit must verify the solution avoided (possibly empty), or
+        None when the delta is intolerable.
+
+        Tolerable classes (docs/performance.md, ROADMAP item 2):
+
+        - a changed node/known job that is DECISION-EQUAL between the
+          speculative and the fresh snapshot (bind acks — BOUND→RUNNING
+          — the canonical case: accounting, pending sets and gang
+          counters all unchanged);
+        - a changed job that is NEW (unknown at speculation time; the
+          commit's suffix solve owns those);
+        - a job that VANISHED (its gang completed / was deleted): if the
+          solve covered it anyway, the uid remap fails and the commit
+          downgrades to serial — nothing can half-apply;
+        - a node that only SHED tasks (a completion freed capacity,
+          nothing else changed): tolerable iff the speculation placed
+          nothing there, which only the fetched solution can prove —
+          hence the returned avoid set, enforced in _commit_speculation.
+          Extra capacity the speculation did not use cannot invalidate
+          its placements; jobs it rejected stay pending and the next
+          cycle's solve sees the freed node."""
         sspec = spec.ssn
+        avoid = set()
         for name in delta["nodes"]:
             a = sspec.nodes.get(name)
             b = ssn.nodes.get(name)
             if a is None and b is None:
                 continue
-            if a is None or b is None \
-                    or not self._node_decision_equal(a, b):
-                return False
+            if a is None or b is None:
+                return None             # node appeared/left: re-solve
+            if self._node_decision_equal(a, b):
+                continue
+            if self._node_completion_shrunk(a, b):
+                avoid.add(name)
+                continue
+            return None
         for uid in delta["jobs"]:
             a = sspec.jobs.get(uid)
             if a is None:
-                continue                    # new job: suffix solve covers it
+                continue                # new job: suffix solve covers it
             b = ssn.jobs.get(uid)
-            if b is None or not self._job_decision_equal(a, b):
-                return False
-        return True
+            if b is None:
+                continue                # vanished: remap guard owns it
+            if not self._job_decision_equal(a, b):
+                return None
+        return avoid
 
     @staticmethod
     def _node_decision_equal(a, b) -> bool:
@@ -656,6 +701,37 @@ class Scheduler:
             if getattr(a, f) != getattr(b, f):
                 return False
         return True
+
+    @staticmethod
+    def _solution_touches(mapped, avoid_nodes) -> bool:
+        """Did the (remapped) speculative solution place any task on one
+        of ``avoid_nodes``? The commit-time enforcement of the
+        completion-shrunk tolerable-delta class."""
+        import numpy as np
+        from .actions.allocate import NO_NODE
+        tn = np.asarray(mapped.task_node)
+        placed = {mapped.node_t.names[int(n)]
+                  for n in np.unique(tn[tn != NO_NODE])}
+        return bool(placed & set(avoid_nodes))
+
+    @staticmethod
+    def _node_completion_shrunk(a, b) -> bool:
+        """Did node ``b`` (fresh) differ from ``a`` (speculative) ONLY
+        by tasks leaving — a completion delta? Identity/capacity fields
+        unchanged, the fresh task set a strict subset of the speculative
+        one, and every surviving task unchanged. Freed capacity cannot
+        invalidate placements made elsewhere; whether anything was
+        placed HERE is the commit-time avoid-set check."""
+        if (a.allocatable is not b.allocatable
+                or a.unschedulable != b.unschedulable
+                or a.ready != b.ready
+                or a.max_task_num != b.max_task_num):
+            return False
+        if not set(b.tasks) < set(a.tasks):
+            return False
+        return all(b.tasks[u].status == a.tasks[u].status
+                   and b.tasks[u].node_name == a.tasks[u].node_name
+                   for u in b.tasks)
 
     @staticmethod
     def _job_decision_equal(a, b) -> bool:
@@ -692,6 +768,14 @@ class Scheduler:
         except Exception:
             log.exception("speculative solve unusable; re-solving the "
                           "cycle serially")
+        if mapped is not None and plan.avoid_nodes \
+                and self._solution_touches(mapped, plan.avoid_nodes):
+            # the completion-shrunk widening's promise check: the delta
+            # was tolerable only if the speculation placed nothing on
+            # the nodes that shed tasks — the fetched solution is the
+            # proof. A placement there means the solve reasoned about
+            # pre-completion capacity: discard and re-solve serially.
+            mapped = None
         if mapped is None:
             self._finish_speculation(plan, "conflict")
             action.execute(ssn)

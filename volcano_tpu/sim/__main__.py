@@ -112,6 +112,36 @@ def main(argv=None) -> int:
                          "kills/lease losses), terminal-accounting "
                          "equivalence + zero double-binds otherwise "
                          "(exit 1 on mismatch)")
+    ap.add_argument("--store-wired", action="store_true",
+                    help="cluster truth lives in a real ObjectStore "
+                         "behind the hostile transport "
+                         "(store_transport.py): informer-fed caches "
+                         "with resumable watches, every scheduler "
+                         "write through the retry funnel "
+                         "(docs/simulation.md). Composes with "
+                         "--federated N (store-backed PartitionState "
+                         "CR)")
+    ap.add_argument("--store-chaos", action="store_true",
+                    help="the store-chaos soak preset: --store-wired "
+                         "with 20%% seeded verb faults and 2 torn "
+                         "watch streams (docs/robustness.md store "
+                         "failure model); individual --store-* flags "
+                         "override")
+    ap.add_argument("--store-fault-rate", type=float, default=None,
+                    help="seeded per-verb store fault rate (latency/"
+                         "transient/409; implies --store-wired)")
+    ap.add_argument("--store-fault-seed", type=int, default=None,
+                    help="store fault RNG seed (default: --seed)")
+    ap.add_argument("--torn-watches", type=int, default=None,
+                    help="tear N watch streams at seeded cycles; the "
+                         "resumable informers must recover by backlog "
+                         "replay or 410-relist (implies --store-wired)")
+    ap.add_argument("--verify-store-equivalence", action="store_true",
+                    help="also run the SAME trace store-wired with "
+                         "ZERO faults/tears/kills and assert the "
+                         "chaotic run converged to the same terminal "
+                         "accounting with zero double-binds (exit 1 "
+                         "otherwise)")
     ap.add_argument("--pipelined", action="store_true",
                     help="run the pipelined scheduler shell "
                          "(speculative solve overlapped with host "
@@ -159,6 +189,24 @@ def main(argv=None) -> int:
     kill_cycles = [int(c) for c in args.kill_cycles.split(",") if c.strip()]
     lease_loss = [int(c) for c in args.lease_loss_cycles.split(",")
                   if c.strip()]
+    # the store-chaos preset (docs/robustness.md store failure model):
+    # 20% verb faults + 2 torn watch streams over the store-wired world
+    store_fault_rate = args.store_fault_rate
+    torn_watches = args.torn_watches
+    if args.store_chaos:
+        if store_fault_rate is None:
+            store_fault_rate = 0.2
+        if torn_watches is None:
+            torn_watches = 2
+    # asking for the store-equivalence verdict implies the store-wired
+    # world — otherwise the "baseline" would be a second identical
+    # direct-mode run and the OK verdict vacuous
+    store_wired = (args.store_wired or args.store_chaos
+                   or args.verify_store_equivalence
+                   or store_fault_rate is not None
+                   or torn_watches is not None)
+    store_fault_rate = store_fault_rate or 0.0
+    torn_watches = torn_watches or 0
 
     def wraps():
         if not args.chaos_rate:
@@ -170,7 +218,7 @@ def main(argv=None) -> int:
                                        seed=chaos_seed))
 
     def run(kills, replicas=None, losses=None, federated=None,
-            pipelined=None, fast_admit=None):
+            pipelined=None, fast_admit=None, fault_rate=None, torn=None):
         bw, ew = wraps()
         runner = SimRunner(trace, conf_text=conf_text, period=args.period,
                            seed=args.seed, max_cycles=args.max_cycles,
@@ -186,7 +234,13 @@ def main(argv=None) -> int:
                            pipelined=args.pipelined if pipelined is None
                            else pipelined,
                            fast_admit=args.fast_admit if fast_admit is None
-                           else fast_admit)
+                           else fast_admit,
+                           store_wired=store_wired,
+                           store_fault_rate=store_fault_rate
+                           if fault_rate is None else fault_rate,
+                           store_fault_seed=args.store_fault_seed,
+                           torn_watches=torn_watches if torn is None
+                           else torn)
         return runner.run()
 
     if args.trace_out:
@@ -229,6 +283,32 @@ def main(argv=None) -> int:
             return 1
         print(f"restart-equivalence OK: {report['restarts']} restarts, "
               f"journal={report['journal_replayed']}, "
+              f"accounting={got}", file=sys.stderr)
+    if args.verify_store_equivalence:
+        baseline = run([], fault_rate=0.0, torn=0, losses=[])
+        got = terminal_accounting(report)
+        want = terminal_accounting(baseline)
+        problems = []
+        if got != want:
+            problems.append(f"terminal accounting diverged: "
+                            f"chaotic={got} clean={want}")
+        if got.get("double_binds"):
+            problems.append(f"double-binds under store chaos: "
+                            f"{got['double_binds']}")
+        if report["jobs"]["completed"] != report["jobs"]["arrived"]:
+            problems.append("store-chaos run did not complete every "
+                            "arrived job")
+        if problems:
+            for p in problems:
+                print(f"store-equivalence FAILED: {p}", file=sys.stderr)
+            return 1
+        st = report.get("store", {})
+        print(f"store-equivalence OK: faults={st.get('faults', {})}, "
+              f"retry_funnel={st.get('retry_funnel', {})}, "
+              f"torn={st.get('torn_watch_events', 0)}, "
+              f"resumes={st.get('watch_resumes', 0)}, "
+              f"relists={st.get('watch_relists', 0)}, "
+              f"restarts={report.get('restarts', 0)}, "
               f"accounting={got}", file=sys.stderr)
     if args.verify_federated_equivalence:
         import json as _json

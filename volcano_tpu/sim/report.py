@@ -179,8 +179,10 @@ def build_report(runner, actions_ms: Dict[tuple, list],
         # part of EVERY report — a non-federated (or non-contended
         # federated) run must report {} here, which is exactly what the
         # federated-equivalence oracle diff checks
-        "cross_partition_reserves": dict(runner.ledger.counts)
-        if getattr(runner, "ledger", None) is not None else {},
+        "cross_partition_reserves": runner.reserve_counts()
+        if hasattr(runner, "reserve_counts")
+        else (dict(runner.ledger.counts)
+              if getattr(runner, "ledger", None) is not None else {}),
         "jct_s": percentiles(runner.jct),
         "queueing_delay_s": percentiles(runner.queueing_delay),
         # time-to-first-bind in CYCLE PERIODS (the fast-admit acceptance
@@ -209,6 +211,12 @@ def build_report(runner, actions_ms: Dict[tuple, list],
     if actions_truncated:
         report["wallclock"]["actions_ms_truncated"] = \
             list(actions_truncated)
+    if getattr(runner, "store_wired", False):
+        # the hostile-store plane (docs/robustness.md store failure
+        # model): all seeded — faults injected, retry-funnel absorption,
+        # torn-stream recoveries — so this is decision-plane material
+        # and byte-reproducible
+        report["store"] = runner.store_detail()
     if getattr(runner, "pipelined_mode", False):
         # deterministic (cycle-logic-driven) but MECHANISM, not decisions:
         # pipelined_oracle_part strips it for the serial-oracle diff
@@ -216,17 +224,22 @@ def build_report(runner, actions_ms: Dict[tuple, list],
     if getattr(runner, "fast_admit_mode", False):
         report["fast_admit"] = runner.fast_admit_stats()
     if getattr(runner, "federated", 0):
-        ledger = runner.ledger
+        totals = runner.federation_totals() \
+            if hasattr(runner, "federation_totals") else {
+                "node_transfers": runner.ledger.node_transfers,
+                "queue_moves": runner.ledger.queue_moves}
         report["federation"] = {
             "partitions": runner.federated,
             "map": runner.pmap.counts(),
             "map_version": runner.pmap.version,
-            "reserves": dict(ledger.counts),
-            "node_transfers": ledger.node_transfers,
-            "queue_moves": ledger.queue_moves,
+            "reserves": report["cross_partition_reserves"],
+            "node_transfers": totals["node_transfers"],
+            "queue_moves": totals["queue_moves"],
             "failover_cycles": list(runner.failover_cycles),
             "failover_cycles_max": max(runner.failover_cycles, default=0),
         }
+        if getattr(runner, "store_wired", False):
+            report["federation"]["store_backed"] = True
     elif getattr(runner, "replicas", None):
         report["ha"] = {
             "replicas": runner.ha_replicas,

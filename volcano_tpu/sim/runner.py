@@ -156,7 +156,12 @@ class SimRunner:
                  lease_loss_cycles: Optional[Sequence[int]] = None,
                  federated_partitions: int = 0,
                  pipelined: bool = False,
-                 fast_admit: bool = False):
+                 fast_admit: bool = False,
+                 store_wired: bool = False,
+                 store_fault_rate: float = 0.0,
+                 store_fault_seed: Optional[int] = None,
+                 store_latency_s: float = 0.05,
+                 torn_watches: int = 0):
         self.trace = list(trace)
         self.period = period
         self.seed = seed
@@ -211,6 +216,30 @@ class SimRunner:
                              "modes (not --ha / --federated)")
         self._spec_mark: Dict[str, float] = {}
         self._fa_mark: Dict[str, float] = {}
+        # store-wired mode (docs/simulation.md --store-wired): cluster
+        # truth in a real ObjectStore behind the hostile transport of
+        # store_transport.py — per-verb seeded faults, torn watch
+        # streams, and (with --federated) the store-backed PartitionState
+        # CR. Single-scheduler and federated topologies.
+        self.store_wired = bool(store_wired)
+        self.store_fault_rate = float(store_fault_rate)
+        self.store_fault_seed = seed if store_fault_seed is None \
+            else store_fault_seed
+        self.store_latency_s = float(store_latency_s)
+        self.torn_watches = int(torn_watches)
+        self.world = None
+        self._store_pending: List[Callable] = []
+        self._tear_rng = random.Random(self.store_fault_seed ^ 0x51F7)
+        self._tear_cycles: List[int] = sorted(
+            self._tear_rng.randint(2, 12) for _ in range(self.torn_watches))
+        self.torn_watch_events = 0
+        self.ledgers: List = []
+        if self.store_wired and ha_replicas > 1:
+            raise ValueError("store_wired supports single-scheduler and "
+                             "federated topologies (not --ha)")
+        if self.store_wired and (pipelined or fast_admit):
+            raise ValueError("store_wired and pipelined/fast_admit are "
+                             "separate modes")
         self.pmap = None
         self.ledger = None
         self.registry = None
@@ -229,11 +258,15 @@ class SimRunner:
         self.replicas: List[_Replica] = []
         self.authority: Optional[FencingAuthority] = None
         if self.kill_cycles:
-            self._kill_binder = binder = KillPointBinder(binder)
-            self._kill_evictor = evictor = KillPointEvictor(evictor)
             if self.journal is None:
                 self.journal = IntentJournal()    # in-memory: survives the
                 #                                   simulated process death
+            if not self.store_wired:
+                # store mode builds its executor chains per scheduler
+                # (StoreWorld.build_cache) and interposes kill wrappers
+                # there, between the fencing gate and the store chain
+                self._kill_binder = binder = KillPointBinder(binder)
+                self._kill_evictor = evictor = KillPointEvictor(evictor)
         # ...and so does the device cool-down window, so a composed
         # DeviceFaultInjector re-probes on a deterministic virtual cycle
         # instead of wherever the host's wall clock lands
@@ -245,7 +278,24 @@ class SimRunner:
             self.conf_text = PIPELINED_SIM_CONF
         else:
             self.conf_text = SIM_CONF
-        if self.federated:
+        if self.store_wired:
+            from .store_world import StoreWorld
+            self.world = StoreWorld(
+                self.clock, fault_rate=self.store_fault_rate,
+                fault_seed=self.store_fault_seed,
+                latency_s=self.store_latency_s,
+                n_schedulers=self.federated or 1,
+                retry_rng_seed=seed, period=period)
+            # the determinism witnesses: executions that REACHED the
+            # store, recorded by the shared wrapper inside every
+            # scheduler's executor chain (duck-typed .sequence)
+            self.binder = self.world.bind_witness
+            self.evictor = self.world.evict_witness
+            if self.federated:
+                self._init_federated_store(binder_wrap, evictor_wrap)
+            else:
+                self._init_store_single(binder_wrap, evictor_wrap)
+        elif self.federated:
             self._init_federated(binder, evictor)
         elif self.ha_replicas > 1:
             self._init_ha(binder, evictor)
@@ -338,8 +388,19 @@ class SimRunner:
             self._arrive(ev.t, d)
             return
         if ev.kind == "job_complete":
-            if self._job(d["name"]) is not None:
-                self._complete_job(d["name"], ev.t)
+            jid = self._jid(d["name"])
+            if self._job(jid) is not None:
+                self._complete_job(jid, ev.t)
+            return
+        if self.store_wired and ev.kind == "queue_add":
+            # store mode: the queue is a CR; caches learn it through
+            # their watches. Submission rides the faulted transport and
+            # re-queues on failure like any client POST.
+            thunk = self.world.submit_queue(0, d)
+            try:
+                thunk()
+            except Exception:
+                self._store_pending.append(thunk)
             return
         for cache in self.caches:
             if ev.kind == "queue_add":
@@ -396,6 +457,24 @@ class SimRunner:
 
     def _arrive(self, t: float, d: dict) -> None:
         name = d["name"]
+        if self.store_wired:
+            # informer-path ingestion: the job materializes as
+            # PodGroup + pod CRs through the (faulted) transport; the
+            # caches learn it from their watch streams. Bookkeeping is
+            # stamped at the front door (arrival is when the client
+            # tried); a failed submit retries next cycle.
+            jid = self._jid(name)
+            for i in range(d["tasks"]):
+                self.task_job[f"{name}-{i}"] = jid
+            self.arrival_time[jid] = t
+            self.duration[jid] = d["duration"]
+            self.arrived += 1
+            thunk = self.world.submit_job(0, t, d)
+            try:
+                thunk()
+            except Exception:
+                self._store_pending.append(thunk)
+            return
         caches = self.caches
         if self.federated:
             # partitioned ingestion: the job materializes only in its
@@ -430,6 +509,17 @@ class SimRunner:
         """The node dies with its tasks: lost members re-queue PENDING and
         their gang must re-admit (duration restarts — gang semantics: a
         gang below min_available has lost its collective progress)."""
+        if self.store_wired:
+            if not any(name in c.nodes for c in self.caches):
+                return
+            # the kubelet dies with its pods: delete + controller
+            # recreate against cluster truth; caches follow by watch
+            for uid in self.world.pods_on_node(name):
+                self.world.delete_pod(uid)
+                self._requeue_task(uid, on_node=False)
+            for cache in self.caches:
+                cache.remove_node(name)
+            return
         uids: List[str] = []
         seen: set = set()
         present = False
@@ -451,6 +541,20 @@ class SimRunner:
 
     def _requeue_task(self, uid: str, on_node: bool = True) -> None:
         jid = self.task_job.get(uid, "")
+        if self.store_wired:
+            # the evicted/killed pod was already deleted cluster-side;
+            # the controller recreates it (same logical member) and the
+            # caches converge via their watches. recreate_pod refusing
+            # (no blueprint: the gang completed; pod present: already
+            # recreated) means there is nothing to requeue.
+            if not self.world.recreate_pod(uid):
+                return
+            self._live_bound.discard(uid)
+            self.requeues += 1
+            if jid in self.admitted_at:
+                del self.admitted_at[jid]
+                self._admit_epoch[jid] = self._admit_epoch.get(jid, 0) + 1
+            return
         touched_any = False
         for cache in self.caches:
             job = cache.jobs.get(jid)
@@ -487,6 +591,22 @@ class SimRunner:
             self._complete_job(uid, t)
 
     def _complete_job(self, uid: str, t: float) -> None:
+        if self.store_wired:
+            # cluster-truth completion: pods + PodGroup leave the store;
+            # caches drain through their watches (possibly a resumed
+            # stream later — staleness, not loss)
+            task_uids = sorted(u for u, j in self.task_job.items()
+                               if j == uid)
+            if not task_uids:
+                return
+            self.world.complete_job(uid, task_uids)
+            for tuid in task_uids:
+                self.task_job.pop(tuid, None)
+                self._live_bound.discard(tuid)
+            self.admitted_at.pop(uid, None)
+            self.jct.append(t - self.arrival_time[uid])
+            self.completed += 1
+            return
         vjob = self._job(uid)
         if vjob is None:
             return
@@ -533,7 +653,12 @@ class SimRunner:
                 if job is None or uid not in job.tasks:
                     continue
                 cached = job.tasks[uid]
-                if cached.status == TaskStatus.BOUND:
+                if cached.status == TaskStatus.BOUND \
+                        and not self.store_wired:
+                    # store mode: the Running ack arrives through the
+                    # watch stream (possibly after a torn-stream resume)
+                    # — acking here would mask exactly the staleness the
+                    # store-chaos soak exists to exercise
                     cache.update_task_status(cached, TaskStatus.RUNNING)
                 placed = True
             if not placed:
@@ -547,7 +672,15 @@ class SimRunner:
             uid = eseq[self._evicts_seen]
             self._evicts_seen += 1
             self._requeue_task(uid)
-        if self.replicas:
+        if self.store_wired:
+            # torn watch streams can delay the Running acks past the
+            # cycle that bound the gang: keep re-checking gangs with
+            # binds until they admit, so admission lands on the first
+            # cycle the (resumed) cache shows the gang ready
+            for jid in self.first_bind:
+                if jid not in self.admitted_at:
+                    touched.setdefault(jid, True)
+        if self.replicas and not self.store_wired:
             # HA only: a failover's handoff reconcile can re-assert a
             # crash-window bind AFTER its kubelet ack was consumed above
             # (the ack arrived while leadership was vacant and feedback
@@ -711,6 +844,9 @@ class SimRunner:
         return mode
 
     def _disarm_kills(self) -> None:
+        for kb, ke in getattr(self, "_store_kill_wrappers", {}).values():
+            kb.disarm()
+            ke.disarm()
         if self._kill_binder is not None:
             self._kill_binder.disarm()
         if self._kill_evictor is not None:
@@ -928,8 +1064,12 @@ class SimRunner:
             lambda p=pid: self._fed_oracles.pop(p, None)
         sched.action_fault_hook = self._mk_action_hook(rep)
         sched.close_fault_hook = self._close_hook
+        # store-backed mode gives each partition its OWN map/ledger
+        # mirror (federation/store_backed.py); in-process mode shares one
+        pmap = getattr(self, "_p_maps", {}).get(pid, self.pmap)
+        ledger = getattr(self, "_p_ledgers", {}).get(pid, self.ledger)
         sched.federation = PartitionMember(
-            pid, self.pmap, self.ledger, rep.cache,
+            pid, pmap, ledger, rep.cache,
             epoch_fn=lambda r=rep: r.elector.fencing_epoch,
             time_fn=self.clock.time,
             starve_after_s=4 * self.period)
@@ -1018,8 +1158,19 @@ class SimRunner:
         kill_mode: Optional[str] = None
         boundary_pid = 0
         if self.cycles in self.kill_cycles:
-            kill_mode = self._arm_kill_ha()
-            boundary_pid = self._kill_rng.randint(0, self.federated - 1)
+            if self.store_wired:
+                # store mode builds kill wrappers PER partition (each
+                # partition has its own store chain): seed the boundary
+                # partition first and arm that partition's wrappers
+                boundary_pid = self._kill_rng.randint(
+                    0, self.federated - 1)
+                self._kill_binder, self._kill_evictor = \
+                    self._store_kill_wrappers[boundary_pid]
+                kill_mode = self._arm_kill_ha()
+            else:
+                kill_mode = self._arm_kill_ha()
+                boundary_pid = self._kill_rng.randint(
+                    0, self.federated - 1)
         if self.cycles in self.lease_loss_cycles:
             self._armed_revoke = self._lease_rng.randint(1, 5)
         fired = False
@@ -1047,6 +1198,181 @@ class SimRunner:
         self._account_partitions()
         if not self._feedback_blocked:
             self._feedback(now)
+
+    # -- store-wired control planes (docs/simulation.md --store-wired) ------
+
+    def _jid(self, name: str) -> str:
+        """The job uid a trace job name maps to: store mode ingests jobs
+        through the informer path, whose uid is namespace-qualified."""
+        return f"default/{name}" if self.store_wired else name
+
+    def _init_store_single(self, binder_wrap, evictor_wrap) -> None:
+        """Single scheduler over the hostile store boundary: the cache
+        is informer-fed (resumable watches) and every executor write
+        rides retry funnel → faulty transport → store."""
+        cache, b, e = self.world.build_cache(
+            0, binder_wrap, evictor_wrap, journal=self.journal)
+        if self.kill_cycles:
+            self._kill_binder = KillPointBinder(b)
+            self._kill_evictor = KillPointEvictor(e)
+            cache.binder = self._kill_binder
+            cache.evictor = self._kill_evictor
+        self.cache = cache
+        self.sched = Scheduler(self.cache, conf_text=self.conf_text,
+                               schedule_period=self.period,
+                               clock=self.clock,
+                               rng=random.Random(self.seed))
+        self.caches = [self.cache]
+
+    def _fed_event_filter(self, pid: int):
+        """The server-side filtered watch of a federated deployment:
+        Pod/PodGroup events reach only their queue's owning partition.
+        Ownership is read from the REGISTRAR map (raw-store
+        PartitionState — the server's own view, never torn), so the
+        filter stays stable even while a partition's faulted streams
+        lag."""
+        from ..cache.store_wiring import GROUP_NAME_ANNOTATION
+
+        def filt(kind: str, obj) -> bool:
+            if kind == "PodGroup":
+                queue = obj.spec.queue
+            else:
+                group = obj.metadata.annotations.get(
+                    GROUP_NAME_ANNOTATION, "")
+                pg = self.world.store.get("PodGroup",
+                                          obj.metadata.namespace, group)
+                queue = pg.spec.queue if pg is not None else None
+            if queue is None:
+                return pid == 0
+            owner = self.pmap.owner_of_queue(queue)
+            return (owner if owner is not None else 0) == pid
+
+        return filt
+
+    def _init_federated_store(self, binder_wrap, evictor_wrap) -> None:
+        """N partitions over the hostile store boundary, with the
+        PartitionMap/ReserveLedger on the PartitionState CR
+        (federation/store_backed.py): per partition its OWN hostile
+        transport, its own map/ledger mirror over that transport, an
+        informer-fed cache filtered to its queue subset, and a fenced
+        executor gate — coordinating only through the store and the
+        shared journal, exactly the multi-process deployment shape."""
+        from ..cache.executors import FencingRegistry
+        from ..federation import (StoreBackedPartitionMap,
+                                  StoreBackedReserveLedger,
+                                  StorePartitionBackend)
+        from ..store import ObjectStore
+        if self.journal is None:
+            self.journal = IntentJournal()
+        self.lease_store = ObjectStore()
+        self.registry = FencingRegistry()
+        # the registrar mirror over the RAW store: trace-stream
+        # registration + the server-side ingestion filter + report map
+        self._registrar_backend = StorePartitionBackend(self.world.store,
+                                                        self.federated)
+        self.pmap = StoreBackedPartitionMap(self._registrar_backend)
+        self.caches = []
+        self._view_ix = 0
+        self._fed_oracles = {}
+        self._p_leader_key = {}
+        self._p_vacant = {}
+        self._p_had = {}
+        self._p_maps = {}
+        self._p_ledgers = {}
+        self._store_kill_wrappers = {}
+        for pid in range(self.federated):
+            rep = _Replica(pid)
+            backend = StorePartitionBackend(self.world.transports[pid],
+                                            self.federated)
+            pmap_p = StoreBackedPartitionMap(backend)
+            ledger = StoreBackedReserveLedger(
+                pmap_p, backend, journal=self.journal,
+                registry=self.registry, time_fn=self.clock.time,
+                timeout_s=8 * self.period)
+            cache, b, e = self.world.build_cache(
+                pid, binder_wrap, evictor_wrap, journal=self.journal,
+                event_filter=self._fed_event_filter(pid))
+            if self.kill_cycles:
+                kb, ke = KillPointBinder(b), KillPointEvictor(e)
+                self._store_kill_wrappers[pid] = (kb, ke)
+                b, e = kb, ke
+            cache.binder = FencedBinder(
+                b, lambda r=rep: r.elector.fencing_epoch,
+                self.registry.authority(pid))
+            cache.evictor = FencedEvictor(
+                e, lambda r=rep: r.elector.fencing_epoch,
+                self.registry.authority(pid))
+            cache.snapshot_scope = \
+                lambda ci, m=pmap_p, p=pid: m.scope(ci, p)
+            rep.cache = cache
+            ledger.attach_cache(pid, cache)
+            self._p_maps[pid] = pmap_p
+            self._p_ledgers[pid] = ledger
+            self.ledgers.append(ledger)
+            self._build_partition_shell(rep)
+            self.replicas.append(rep)
+            self.caches.append(cache)
+            self._p_leader_key[pid] = None
+            self._p_vacant[pid] = None
+            self._p_had[pid] = False
+        self.cache = self.caches[0]
+        self.sched = self.replicas[0].sched
+        self.ledger = self.ledgers[0]
+
+    def _drain_store_pending(self) -> None:
+        """Re-run client submissions that failed at the store boundary
+        (the client retrying its POSTs next cycle); thunks are
+        idempotent — only what is still missing is created."""
+        pending, self._store_pending = self._store_pending, []
+        for thunk in pending:
+            try:
+                thunk()
+            except Exception:
+                self._store_pending.append(thunk)
+
+    def reserve_counts(self) -> Dict[str, int]:
+        """Cross-partition reserve counters, aggregated across ledger
+        mirrors in store-backed mode (each settlement is counted once,
+        by the partition that performed it)."""
+        if self.ledgers:
+            out: Dict[str, int] = {}
+            for lg in self.ledgers:
+                for k, v in lg.counts.items():
+                    out[k] = out.get(k, 0) + v
+            return out
+        return dict(self.ledger.counts) if self.ledger is not None else {}
+
+    def federation_totals(self) -> Dict[str, int]:
+        ledgers = self.ledgers or ([self.ledger]
+                                   if self.ledger is not None else [])
+        return {
+            "node_transfers": sum(lg.node_transfers for lg in ledgers),
+            "queue_moves": sum(lg.queue_moves for lg in ledgers),
+        }
+
+    def store_detail(self) -> Dict[str, object]:
+        """The report's deterministic store-boundary section."""
+        resumes = relists = 0
+        for cache in self.caches:
+            mgr = getattr(cache, "watch_manager", None)
+            if mgr is not None:
+                for w in mgr.watches:
+                    resumes += w.resumes
+                    relists += w.relists
+        for lg in self.ledgers:
+            w = lg.backend._watch
+            if w is not None:
+                resumes += w.resumes
+                relists += w.relists
+        return {
+            "fault_rate": self.store_fault_rate,
+            "faults": self.world.faults_detail(),
+            "retry_funnel": self.world.retry_detail(),
+            "torn_watch_events": self.torn_watch_events,
+            "watch_resumes": resumes,
+            "watch_relists": relists,
+            "pending_submissions": len(self._store_pending),
+        }
 
     # -- crash/restart ------------------------------------------------------
 
@@ -1178,6 +1504,17 @@ class SimRunner:
             now = self.clock.time()
             self._apply_trace_until(now)
             self._fire_completions_until(now)
+            if self.store_wired:
+                # client submissions that failed at the store boundary
+                # retry here; the seeded torn-watch drill fires at its
+                # scheduled cycles (the schedulers' epilogue upkeep must
+                # then resume/relist the streams)
+                self._drain_store_pending()
+                while self._tear_cycles \
+                        and self._tear_cycles[0] <= self.cycles:
+                    self._tear_cycles.pop(0)
+                    self.torn_watch_events += len(
+                        self.world.tear_streams(1, self._tear_rng))
             if self.fast_admit_mode and not self.federated \
                     and not self.replicas:
                 # event-driven fast path: arrivals just applied bind NOW

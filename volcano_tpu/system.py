@@ -41,7 +41,15 @@ class VolcanoSystem:
             self.store.create(QueueCR(
                 metadata=ObjectMeta(name=default_queue, namespace="default"),
                 spec=QueueSpecCR(weight=1)))
-        self.cache = wire_cache_to_store(self.store)
+        # the scheduler's connection to the store rides the retrying
+        # transport funnel (docs/robustness.md store failure model):
+        # every scheduler-side verb gets bounded retry with backoff +
+        # jitter under a per-cycle budget, degrading to resync past it.
+        # Controllers/webhooks/CLI keep the raw store — they are other
+        # components with their own (store-side) semantics.
+        from .store_transport import RetryingStoreTransport
+        self.scheduler_transport = RetryingStoreTransport(self.store)
+        self.cache = wire_cache_to_store(self.scheduler_transport)
         self.scheduler = Scheduler(self.cache, conf_text=conf_text,
                                    schedule_period=schedule_period)
         self.jobs = JobCommands(self.store)
