@@ -21,17 +21,19 @@ run_ha=true
 run_federated=true
 run_pipelined=true
 run_store=true
+run_ack=true
 case "${1:-}" in
-  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ;;
-  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ;;
-  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ;;
-  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ;;
-  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ;;
-  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ;;
-  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false; run_pipelined=false; run_store=false ;;
-  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_pipelined=false; run_store=false ;;
-  --pipelined-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_store=false ;;
-  --store-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ;;
+  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false ;;
+  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false ;;
+  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false ;;
+  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false ;;
+  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false ;;
+  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false ;;
+  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false ;;
+  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_pipelined=false; run_store=false ; run_ack=false ;;
+  --pipelined-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_store=false ; run_ack=false ;;
+  --store-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ; run_ack=false ;;
+  --ack-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ;;
 esac
 
 if $run_lint; then
@@ -237,6 +239,26 @@ if $run_ha; then
     --ha 3 --verify-ha-equivalence --deterministic > /dev/null \
     || { echo "ha-soak FAILED: non-contended HA decision plane differs \
 from the single-scheduler oracle"; exit 1; }
+  # lease-verb faults (ROADMAP item 5 remainder): the Lease CAS path
+  # behind the retrying transport + seeded store faults — failover must
+  # stay BOUNDED (vacancy <= 3 cycles) and split-brain impossible
+  # (zero double-binds; fencing still counts every stale write).
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario smoke --seed 3 \
+    --ha 3 --lease-fault-rate 0.6 --verify-ha-equivalence \
+    --deterministic > "$hadir/lease.json" \
+    || { echo "ha-soak FAILED: lease-faulted run diverged or \
+double-bound"; exit 1; }
+  python - "$hadir/lease.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["failovers"] > 0, "lease faults never caused a failover"
+assert r["ha"]["failover_cycles_max"] <= 3, \
+    f"unbounded failover under lease faults: {r['ha']['failover_cycles']}"
+assert r["double_binds"] == 0
+print("   lease faults: %d bounded failovers (max gap %d cycles), "
+      "zero double-binds" % (r["failovers"],
+                             r["ha"]["failover_cycles_max"]))
+EOF
   echo "   ha-soak: zero double-binds, byte-deterministic x2, oracle-equal"
 fi
 
@@ -400,6 +422,69 @@ print("   store-chaos: faults absorbed, streams recovered, zero "
       "double-binds (single + federated)")
 EOF
   echo "   store-chaos: terminal-equivalent, byte-deterministic x2"
+fi
+
+if $run_ack; then
+  # ack-chaos soak (docs/robustness.md feedback failure model): the
+  # hostile feedback plane — 30% seeded kubelet/status ack faults
+  # (delay/drop/duplicate/reorder/stale on the virtual clock) over the
+  # reclaim-churning ack-chaos world with node flaps and 4 seeded
+  # kills. (a) the chaotic run must converge to the no-fault terminal
+  # accounting with zero double-binds and ZERO stuck in-flight entries
+  # (--verify-ack-equivalence runs both and checks all of it), (b) the
+  # in-flight watchdog must actually have fired (dropped acks are only
+  # recoverable through it), (c) byte-deterministic x2, and (d) the
+  # --federated 4 variant must pass the same bar.
+  echo "== ack-chaos: hostile feedback plane, single + federated =="
+  ackdir=$(mktemp -d)
+  trap 'rm -rf "${simdir:-/nonexistent}" "${soakdir:-/nonexistent}" \
+"${obsdir:-/nonexistent}" "${hadir:-/nonexistent}" \
+"${feddir:-/nonexistent}" "${pipedir:-/nonexistent}" \
+"${storedir:-/nonexistent}" "${ackdir:-/nonexistent}"' EXIT
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario ack-chaos \
+    --seed 3 --ack-chaos --kill-cycles 2,5,9,13 --kill-seed 1 \
+    --verify-ack-equivalence --deterministic > "$ackdir/ack.a.json" \
+    || { echo "ack-chaos FAILED: chaotic run diverged, double-bound or \
+left in-flight state stuck"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario ack-chaos \
+    --seed 3 --ack-chaos --kill-cycles 2,5,9,13 --kill-seed 1 \
+    --deterministic > "$ackdir/ack.b.json"
+  diff "$ackdir/ack.a.json" "$ackdir/ack.b.json" \
+    || { echo "ack-chaos FAILED: chaotic run not byte-deterministic"; \
+         exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario ack-chaos \
+    --seed 3 --ack-chaos --federated 4 --kill-cycles 2,5,9,13 \
+    --kill-seed 2 --verify-ack-equivalence --deterministic \
+    > "$ackdir/fed.a.json" \
+    || { echo "ack-chaos FAILED: federated chaotic run diverged or \
+double-bound"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario ack-chaos \
+    --seed 3 --ack-chaos --federated 4 --kill-cycles 2,5,9,13 \
+    --kill-seed 2 --deterministic > "$ackdir/fed.b.json"
+  diff "$ackdir/fed.a.json" "$ackdir/fed.b.json" \
+    || { echo "ack-chaos FAILED: federated chaotic run not \
+byte-deterministic"; exit 1; }
+  python - "$ackdir/ack.a.json" "$ackdir/fed.a.json" <<'EOF'
+import json, sys
+single = json.load(open(sys.argv[1]))
+fed = json.load(open(sys.argv[2]))
+for name, r in (("single", single), ("federated", fed)):
+    fb = r["feedback"]
+    assert sum(fb["faults"].values()) > 0, f"{name}: no ack faults"
+    assert fb["faults"].get("drop", 0) > 0, f"{name}: no dropped acks"
+    assert fb["watchdog_fired"] > 0, \
+        f"{name}: the in-flight watchdog never fired"
+    assert fb["inflight_open"] == 0 and fb["wire_pending"] == 0, \
+        f"{name}: stuck feedback state: {fb}"
+    assert fb["acks"].get("evicted/applied", 0) > 0, \
+        f"{name}: no evict acks exercised"
+    assert r["double_binds"] == 0 and r["restarts"] > 0
+print("   ack-chaos: faults absorbed, watchdog fired (%d/%d), zero "
+      "double-binds, nothing stuck (single + federated)"
+      % (single["feedback"]["watchdog_fired"],
+         fed["feedback"]["watchdog_fired"]))
+EOF
+  echo "   ack-chaos: terminal-equivalent, byte-deterministic x2"
 fi
 
 if $run_shim; then

@@ -219,9 +219,9 @@ class SchedulerCache:
 
 def test_vt004_trigger_and_clean():
     f, _ = findings_of({"volcano_tpu/actions/a.py": VT004_TRIGGER})
-    # a bare executor call misses BOTH the journal funnel (VT004) and
-    # the fencing-epoch stamp (VT008)
-    assert rule_ids(f) == ["VT004", "VT008"]
+    # a bare executor call misses the journal funnel (VT004), the
+    # fencing-epoch stamp (VT008) AND the in-flight ledger (VT017)
+    assert rule_ids(f) == ["VT004", "VT008", "VT017"]
     f, _ = findings_of({"volcano_tpu/cache/cache.py": VT004_CLEAN})
     assert "VT004" not in rule_ids(f)
 
@@ -279,7 +279,8 @@ def test_vt008_trigger_and_clean():
     VT004: the two rules separate cleanly); stamping through the
     one-hop funnel is clean."""
     f, _ = findings_of({"volcano_tpu/cache/cache.py": VT008_TRIGGER})
-    assert rule_ids(f) == ["VT008"]
+    # the journal witness satisfies VT004 but not the ledger (VT017)
+    assert rule_ids(f) == ["VT008", "VT017"]
     assert any(x.symbol == "SchedulerCache.bind" for x in f)
     f, _ = findings_of({"volcano_tpu/cache/cache.py": VT008_CLEAN})
     assert "VT008" not in rule_ids(f)
@@ -1829,3 +1830,113 @@ def test_vt016_rebroken_funnel_bypass():
         "                            task.node_name)")
     f, _ = findings_of(broken)
     assert "VT016" in rule_ids(f)
+
+
+# ---------------------------------------------------------------------------
+# 11. VT017 in-flight ledger + FeedbackChannel funnel (feedback plane)
+# ---------------------------------------------------------------------------
+
+VT017_LEDGER_TRIGGER = '''
+def rogue(self, task):
+    seq = self._journal_intent("bind", task, task.node_name)
+    self.binder.bind(task, task.node_name)     # no _register_inflight
+'''
+
+VT017_LEDGER_CLEAN = '''
+def funnel(self, task):
+    seq = self._journal_intent("bind", task, task.node_name)
+    self._register_inflight("bind", task, task.node_name, seq)
+    self.binder.bind(task, task.node_name)
+'''
+
+
+def test_vt017_ledger_trigger_and_clean():
+    f, _ = findings_of({"volcano_tpu/cache/custom.py": VT017_LEDGER_TRIGGER})
+    assert "VT017" in rule_ids(f)
+    (x,) = [x for x in f if x.rule == "VT017"]
+    assert "_register_inflight" in x.message
+    f, _ = findings_of({"volcano_tpu/cache/custom.py": VT017_LEDGER_CLEAN})
+    assert "VT017" not in rule_ids(f)
+
+
+def test_vt017_ledger_one_hop_witness():
+    src = VT017_LEDGER_TRIGGER + '''
+def outer(self, task):
+    self._register_inflight("bind", task, task.node_name, None)
+    rogue(self, task)
+'''
+    # the witness sits in a direct CALLER: one-hop semantics admit it
+    f, _ = findings_of({"volcano_tpu/cache/custom.py": src})
+    assert "VT017" not in rule_ids(f)
+
+
+VT017_ACK_TRIGGER = '''
+def feedback(self, cache, cached, status):
+    cache.update_task_status(cached, status)   # raw ack consumption
+'''
+
+VT017_ACK_CLEAN = '''
+def feedback(self, cache, cached, status):
+    cache.feedback.pod_status_event(cached, status)
+'''
+
+
+def test_vt017_ack_consumption_trigger_and_clean():
+    f, _ = findings_of({"volcano_tpu/sim/custom.py": VT017_ACK_TRIGGER})
+    assert "VT017" in rule_ids(f)
+    (x,) = [x for x in f if x.rule == "VT017"]
+    assert "FeedbackChannel" in x.message
+    f, _ = findings_of({"volcano_tpu/sim/custom.py": VT017_ACK_CLEAN})
+    assert "VT017" not in rule_ids(f)
+
+
+def test_vt017_ack_scope_and_receiver_heuristic():
+    # outside the ack-consuming scopes the same call is fine (the cache
+    # funnels and plugins legitimately update statuses)
+    f, _ = findings_of({"volcano_tpu/plugins/custom.py": VT017_ACK_TRIGGER})
+    assert "VT017" not in rule_ids(f)
+    # JobInfo.update_task_status (non-cache receiver) is not an ack
+    src = '''
+def harmless(self, job, task, status):
+    job.update_task_status(task, status)
+'''
+    f, _ = findings_of({"volcano_tpu/sim/custom.py": src})
+    assert "VT017" not in rule_ids(f)
+
+
+def test_vt017_funnel_modules_are_exempt():
+    src = '''
+class Replayer:
+    def redo(self, cache, task):
+        cache.binder.bind(task, task.node_name)
+'''
+    for path in ("volcano_tpu/cache/journal.py",
+                 "volcano_tpu/cache/feedback.py",
+                 "volcano_tpu/cache/executors.py", "volcano_tpu/chaos.py"):
+        f, _ = findings_of({path: src})
+        assert "VT017" not in rule_ids(f), path
+
+
+def test_vt017_rebroken_bind_batch_registration_strip():
+    """Re-broken regression: the REAL cache with the in-flight
+    registration stripped from bind_batch must fire VT017; the unmutated
+    sources must not."""
+    paths = ("volcano_tpu/cache/cache.py", "volcano_tpu/cache/feedback.py",
+             "volcano_tpu/cache/inflight.py", "volcano_tpu/scheduler.py",
+             "volcano_tpu/sim/runner.py",
+             "volcano_tpu/cache/store_wiring.py")
+    srcs = {p: real_source(p) for p in paths}
+    f, _ = findings_of(srcs)
+    assert "VT017" not in rule_ids(f)
+    broken = dict(srcs)
+    broken["volcano_tpu/cache/cache.py"] = mutate(
+        srcs["volcano_tpu/cache/cache.py"],
+        "        for (task, newly), seq in zip(placed, seqs):\n"
+        "            self._register_inflight(\"bind\", task, "
+        "task.node_name, seq)\n"
+        "        for (task, newly), seq in zip(placed, seqs):",
+        "        for (task, newly), seq in zip(placed, seqs):")
+    f, _ = findings_of(broken)
+    vt17 = [x for x in f if x.rule == "VT017"]
+    assert vt17, "stripping bind_batch's ledger registration went unseen"
+    assert any(x.symbol.endswith("bind_batch") for x in vt17)

@@ -475,3 +475,67 @@ class TestHASim:
         assert report["failovers"] >= 1
         assert report["jobs"]["completed"] == report["jobs"]["arrived"]
         assert report["ha"]["failover_cycles_max"] <= 3
+
+    def test_lease_verb_faults_bounded_failover_no_split_brain(self):
+        """ROADMAP item 5 remainder: the Lease CAS path rides the SAME
+        hostile-transport composition as every other store write (retry
+        funnel -> seeded faulty transport). A failed acquire/renew
+        attempt is a lost ROUND, never a crash: failover stays bounded
+        (vacancy <= 3 cycles) and split-brain impossible (zero
+        double-binds; every stale write still fenced)."""
+        report = self._run(ha_replicas=3, lease_fault_rate=0.6,
+                           lease_fault_seed=3)
+        assert report["failovers"] > 0,             "lease_fault_seed=3: faults never caused a failover — the "             "drill exercised nothing"
+        assert report["ha"]["failover_cycles_max"] <= 3,             f"unbounded failover under lease faults: {report['ha']}"
+        assert report["double_binds"] == 0
+        assert report["jobs"]["completed"] == report["jobs"]["arrived"]
+        assert report["restarts"] == 0        # deposition, not death
+
+    def test_lease_verb_faults_byte_deterministic(self):
+        a = self._run(ha_replicas=3, lease_fault_rate=0.6,
+                      lease_fault_seed=3)
+        b = self._run(ha_replicas=3, lease_fault_rate=0.6,
+                      lease_fault_seed=3)
+        assert deterministic_json(a) == deterministic_json(b)
+
+    def test_lease_transient_does_not_depose_within_deadline(self):
+        """One failed renewal must not depose a live leader (k8s renew
+        semantics): a single TransientStoreError surfaced from the lease
+        transport loses the attempt, and leadership holds until the
+        renew deadline passes on the monotonic clock."""
+        from volcano_tpu.leaderelection import LeaderElector
+        from volcano_tpu.store import ObjectStore
+        from volcano_tpu.store_transport import TransientStoreError
+        clock = FakeClock()
+        store = ObjectStore()
+
+        class Flaky:
+            def __init__(self, inner):
+                self.inner = inner
+                self.fail_next = 0
+
+            def __getattr__(self, name):
+                if name in ("get", "update", "create"):
+                    def verb(*a, **kw):
+                        if self.fail_next:
+                            self.fail_next -= 1
+                            raise TransientStoreError(name, 0, 0)
+                        return getattr(self.inner, name)(*a, **kw)
+                    return verb
+                return getattr(self.inner, name)
+
+        flaky = Flaky(store)
+        el = LeaderElector(flaky, "vc-scheduler",
+                           on_started_leading=lambda: None,
+                           identity="r0", lease_duration=1.6,
+                           renew_deadline=1.2, retry_period=1.0,
+                           time_fn=clock, mono_fn=clock)
+        assert el.step() is True
+        flaky.fail_next = 1
+        clock.advance(1.0)
+        assert el.step() is True,             "one failed renewal deposed a live leader"
+        flaky.fail_next = 99
+        clock.advance(1.0)
+        el.step()
+        clock.advance(1.0)
+        assert el.step() is False,             "leadership survived past the renew deadline with every "             "lease write failing"

@@ -577,6 +577,113 @@ class StoreVerbFunnelRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# VT017 — in-flight ledger + FeedbackChannel funnel (feedback failure model)
+# ---------------------------------------------------------------------------
+
+class InflightLedgerRule(Rule):
+    """The feedback plane's two funnels (docs/robustness.md feedback
+    failure model), statically pinned:
+
+    1. Every executor-effecting bind/evict invocation must have a
+       ``_register_inflight`` call on the path (same function or one
+       hop) — an executor-accepted side effect with no armed ack
+       deadline is exactly the state a lost kubelet ack wedges forever
+       (the watchdog can only re-validate what the ledger knows about).
+
+    2. Ack consumption — a ``cache.update_task_status(...)`` call in the
+       ack-consuming scopes (the sim's cluster feedback, the store
+       wiring's pod watch handlers) — must route through the
+       FeedbackChannel normalizer (``ack_running`` / ``ack_evicted`` /
+       ``pod_status_event`` on the path): a raw status flip would let a
+       duplicate RUNNING ack resurrect a dead placement or a reordered
+       evict/bind ack pair settle to the EARLIER intent.
+
+    The executor layer, the journal's reconciler, the chaos wrappers,
+    and the feedback/ledger modules themselves are exempt by design."""
+
+    id = "VT017"
+    name = "inflight-ledger"
+    contract = ("executor-effecting bind/evict outside the in-flight "
+                "ledger registration funnel, or ack consumption outside "
+                "the FeedbackChannel normalizer (feedback failure "
+                "model, docs/robustness.md)")
+    exclude = ("volcano_tpu/cache/executors.py",
+               "volcano_tpu/cache/journal.py", "volcano_tpu/chaos.py",
+               "volcano_tpu/cache/feedback.py",
+               "volcano_tpu/cache/inflight.py",
+               "volcano_tpu/analysis/")
+
+    EXECUTOR_ATTRS = {"binder", "evictor"}
+    EXECUTOR_METHODS = {"bind", "evict"}
+    LEDGER_WITNESS = {"_register_inflight"}
+    ACK_SCOPE = ("volcano_tpu/sim/", "volcano_tpu/cache/store_wiring.py")
+    ACK_WITNESS = {"ack_running", "ack_evicted", "pod_status_event"}
+
+    def _is_executor_call(self, node: ast.Call) -> Optional[str]:
+        if not isinstance(node.func, ast.Attribute) \
+                or node.func.attr not in self.EXECUTOR_METHODS:
+            return None
+        recv = dotted_name(node.func.value)
+        if recv is None:
+            return None
+        if recv.split(".")[-1] in self.EXECUTOR_ATTRS:
+            return f"{recv}.{node.func.attr}"
+        return None
+
+    def _is_ack_consumption(self, node: ast.Call) -> Optional[str]:
+        if not isinstance(node.func, ast.Attribute) \
+                or node.func.attr != "update_task_status":
+            return None
+        recv = dotted_name(node.func.value)
+        # JobInfo carries an update_task_status too; only the CACHE-level
+        # call is an ack consumption (the receiver heuristic VT016 uses)
+        if recv is None or "cache" not in recv.split(".")[-1].lower():
+            return None
+        return f"{recv}.{node.func.attr}"
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        in_ack_scope = _in_scope(mod.path, self.ACK_SCOPE)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self._is_executor_call(node)
+            if target is not None:
+                fn = mod.enclosing_function(node.lineno)
+                if fn is not None and ctx.witness_in_scope(
+                        fn, self.LEDGER_WITNESS):
+                    continue
+                where = fn.qualname if fn else "<module>"
+                findings.append(self.finding(
+                    mod, node,
+                    f"executor invocation {target}(...) in {where} "
+                    f"without a _register_inflight record on the path; "
+                    f"an executor-accepted side effect with no armed ack "
+                    f"deadline wedges forever when its cluster ack is "
+                    f"lost (docs/robustness.md feedback failure model)"))
+                continue
+            if not in_ack_scope:
+                continue
+            target = self._is_ack_consumption(node)
+            if target is None:
+                continue
+            fn = mod.enclosing_function(node.lineno)
+            if fn is not None and ctx.witness_in_scope(fn,
+                                                      self.ACK_WITNESS):
+                continue
+            where = fn.qualname if fn else "<module>"
+            findings.append(self.finding(
+                mod, node,
+                f"ack consumption {target}(...) in {where} outside the "
+                f"FeedbackChannel normalizer; kubelet/status acks enter "
+                f"the cache through ack_running/ack_evicted/"
+                f"pod_status_event so duplicates, reorders and stale "
+                f"replays cannot resurrect dead placements "
+                f"(docs/robustness.md feedback failure model)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
 # VT005 — SimKill tunneling (PR 4, docs/robustness.md)
 # ---------------------------------------------------------------------------
 
@@ -1377,6 +1484,7 @@ ALL_RULES: List[Rule] = [
     HostSyncRule(), TracedBranchRule(), DataflowShapeBucketRule(),
     DtypeDisciplineRule(), SessionEscapeRule(),
     SpeculationIsolationRule(), StoreVerbFunnelRule(),
+    InflightLedgerRule(),
 ]
 
 # the rules that run on the shared dataflow/callgraph engine
@@ -1439,6 +1547,11 @@ solver(state, idx)                     # truncates under x64-disabled''',
                                        # apiserver error crashes the
                                        # cycle — ride the retrying
                                        # transport funnel''',
+    "VT017": '''def rogue(self, task):
+    seq = self._journal_intent("bind", task)
+    self.binder.bind(task, task.node_name)   # no _register_inflight:
+                                             # a lost kubelet ack wedges
+                                             # this bind forever''',
 }
 for _rule in ALL_RULES:
     _rule.example = _EXAMPLES.get(_rule.id, "")

@@ -1,4 +1,6 @@
 from .cache import SchedulerCache, incremental_snapshot_enabled
+from .feedback import FeedbackChannel
+from .inflight import InflightLedger
 from .executors import (Binder, Evictor, FakeBinder, FakeEvictor,
                         FakeStatusUpdater, FakeVolumeBinder, SequenceBinder,
                         SequenceEvictor, StatusUpdater, StoreBinder,
@@ -10,6 +12,7 @@ from .snapshot import (NodeTensors, PersistentNodeTensors,
 
 __all__ = [
     "SchedulerCache", "incremental_snapshot_enabled",
+    "FeedbackChannel", "InflightLedger",
     "Binder", "Evictor", "FakeBinder", "FakeEvictor", "FakeStatusUpdater",
     "FakeVolumeBinder", "SequenceBinder", "SequenceEvictor", "StatusUpdater",
     "StoreBinder", "StoreEvictor", "VolumeBinder",

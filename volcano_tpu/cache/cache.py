@@ -27,6 +27,8 @@ from ..api import (ClusterInfo, JobInfo, NamespaceCollection, NamespaceInfo,
                    TaskStatus, allocated_status)
 from .executors import (Binder, Evictor, FakeBinder, FakeEvictor,
                         StatusUpdater, VolumeBinder)
+from .feedback import FeedbackChannel
+from .inflight import InflightLedger
 from .journal import IntentJournal, journal_enabled
 
 log = logging.getLogger(__name__)
@@ -209,6 +211,21 @@ class SchedulerCache:
         # bookmarks, retry-budget reset (docs/robustness.md store
         # failure model). None for direct-fed caches (tests, sim default)
         self.watch_manager = None
+        # the feedback plane (docs/robustness.md feedback failure
+        # model): every executor-accepted bind/evict arms an ack
+        # deadline in the in-flight ledger; the FeedbackChannel is the
+        # ONE funnel cluster acks enter the cache through (vlint VT017),
+        # and the scheduler epilogue's watchdog
+        # (process_expired_inflight) re-validates expired entries so a
+        # lost ack can never wedge in-flight state forever.
+        self.inflight = InflightLedger()
+        self.feedback = FeedbackChannel(self)
+        # cluster-truth probe for the watchdog: entry -> True (the side
+        # effect is live cluster-side), False (it is not), None
+        # (unknown). None (the default probe-less state) presumes
+        # executed — the executor DID ack the call — so expiry recovers
+        # the lost ack instead of inventing a rollback.
+        self.inflight_oracle_fn: Optional[Callable] = None
 
     # -- intent journal (cache/journal.py) ----------------------------------
 
@@ -248,6 +265,16 @@ class SchedulerCache:
     def _journal_ack(self, seq: Optional[int], ok: bool) -> None:
         if seq is not None and self.journal is not None:
             self.journal.ack(seq, ok)
+
+    def _register_inflight(self, op: str, task: TaskInfo, node: str = "",
+                           seq: Optional[int] = None) -> None:
+        """Arm the in-flight ledger's ack deadline for an intent about to
+        execute (cache/inflight.py) — every executor-effecting funnel
+        calls this next to its ``_journal_intent`` (vlint VT017). An
+        executor failure aborts the entry in the rollback path; the
+        cluster's feedback ack (or the watchdog) resolves it otherwise."""
+        self.inflight.register(op, task.uid, task.job,
+                               node or task.node_name or "", seq)
 
     def reconcile_journal(self, cluster_binds=None, cluster_evicts=None):
         """Startup reconciliation: settle the journal's crash window
@@ -331,6 +358,8 @@ class SchedulerCache:
             if job is not None:
                 for task_uid in job.tasks:
                     self._drop_retry_state(task_uid)
+                    self.inflight.task_deleted(task_uid)
+                    self.binding_tasks.pop(task_uid, None)
                 # a parked podgroup-status flush for a removed job is moot
                 key = f"pg_status/{uid}"
                 if self.dead_letter.pop(key, None) is not None:
@@ -364,6 +393,11 @@ class SchedulerCache:
             if task.node_name and task.node_name in self.nodes:
                 self.nodes[task.node_name].update_task(job.tasks[task.uid])
             self._mark_task_dirty(task)
+        if status == TaskStatus.RUNNING:
+            # belt-and-braces: however the RUNNING confirmation reached
+            # the cache (the FeedbackChannel is the sanctioned route),
+            # the bind is no longer in flight
+            self.inflight.resolve("bind", task.uid)
 
     def delete_task(self, task: TaskInfo) -> None:
         with self._lock:
@@ -377,6 +411,10 @@ class SchedulerCache:
                 node.remove_task(task)
                 self._release_numa(node, task.uid)
             self._drop_retry_state(task.uid)
+            self.binding_tasks.pop(task.uid, None)
+        # the pod left the cluster: a pending evict entry is thereby
+        # CONFIRMED, a pending bind entry is moot (cache/inflight.py)
+        self.inflight.task_deleted(task.uid)
 
     @staticmethod
     def _release_numa(node, task_uid: str) -> None:
@@ -953,6 +991,7 @@ class SchedulerCache:
                         self.nodes[prev_node].update_task(cached)
         seq = self._journal_intent("bind", task, task.node_name,
                                    fresh=newly_placed)
+        self._register_inflight("bind", task, task.node_name, seq)
         try:
             self._bind_volumes(task)
             self.binder.bind(task, task.node_name)
@@ -974,6 +1013,7 @@ class SchedulerCache:
                             self.nodes[cached.node_name].update_task(cached)
                 self.err_tasks.append(task)
             self._journal_ack(seq, False)
+            self.inflight.abort("bind", task.uid)
             self.resync_task(task)
 
     def bind_batch(self, tasks) -> None:
@@ -1027,6 +1067,8 @@ class SchedulerCache:
         if self.journal is not None and placed:
             self.journal.flush()
         for (task, newly), seq in zip(placed, seqs):
+            self._register_inflight("bind", task, task.node_name, seq)
+        for (task, newly), seq in zip(placed, seqs):
             try:
                 self._bind_volumes(task)
                 self.binder.bind(task, task.node_name)
@@ -1044,6 +1086,7 @@ class SchedulerCache:
                             cached.node_name = ""
                     self.err_tasks.append(task)
                 self._journal_ack(seq, False)
+                self.inflight.abort("bind", task.uid)
                 self.resync_task(task)
 
     def _bind_volumes(self, task: TaskInfo) -> None:
@@ -1060,6 +1103,7 @@ class SchedulerCache:
     def evict(self, task: TaskInfo, reason: str) -> None:
         """Execute eviction: pod condition + delete (cache.go:549-599)."""
         seq = self._journal_intent("evict", task)
+        self._register_inflight("evict", task, seq=seq)
         try:
             self.evictor.evict(task, reason)
             self._journal_ack(seq, True)
@@ -1067,6 +1111,7 @@ class SchedulerCache:
             with self._lock:
                 self.err_tasks.append(task)
             self._journal_ack(seq, False)
+            self.inflight.abort("evict", task.uid)
             self.resync_task(task, op="evict")
             return
         with self._lock:
@@ -1076,6 +1121,152 @@ class SchedulerCache:
                 job.update_task_status(job.tasks[task.uid], TaskStatus.RELEASING)
                 if task.node_name in self.nodes:
                     self.nodes[task.node_name].update_task(job.tasks[task.uid])
+
+    def requeue_lost_member(self, jid: str, uid: str,
+                            lost_node: Optional[str] = None,
+                            detach: bool = True) -> bool:
+        """Validate-then-requeue for a gang member the cluster lost (a
+        node died with its pods; the delete+controller-recreate is
+        implicit). The validation is what makes a node death racing an
+        unacked bind safe: only a member the cache still places on
+        ``lost_node`` (or an unplaced mid-rollback one) requeues — a
+        member a newer intent re-placed elsewhere is that intent's
+        business. Any open in-flight entry and ``binding_tasks`` marker
+        for the member resolves here: a dead node's ack never comes, so
+        leaving either armed would strand them until the watchdog
+        (docs/robustness.md feedback failure model). ``detach=False``
+        skips the node-mirror detach when the node itself is about to
+        leave the cache wholesale. Returns whether the member was
+        requeued."""
+        with self._lock:
+            job = self.jobs.get(jid)
+            cached = job.tasks.get(uid) if job is not None else None
+            if cached is None:
+                return False
+            if lost_node is not None and cached.node_name \
+                    and cached.node_name != lost_node:
+                return False
+            if cached.node_name:
+                self._dirty_nodes.add(cached.node_name)
+            self._dirty_jobs.add(jid)
+            node = self.nodes.get(cached.node_name)
+            if detach and node is not None and uid in node.tasks:
+                node.remove_task(cached)
+            cached.node_name = ""
+            job.update_task_status(cached, TaskStatus.PENDING)
+            self.binding_tasks.pop(uid, None)
+        self.inflight.resolve(None, uid, "lost")
+        return True
+
+    def rearm_inflight_from_state(self) -> int:
+        """Rebuild the (volatile) in-flight ledger from cache truth — a
+        fresh incarnation's ledger is empty while the relisted state
+        still shows tasks whose cluster ack is outstanding: BOUND means
+        a bind awaiting its RUNNING ack, RELEASING an eviction awaiting
+        its delete confirmation. Run by ``Scheduler.startup_reconcile``
+        AFTER the journal's crash window settles, so an ack lost around
+        a process death still meets the watchdog instead of wedging the
+        task forever (the kill + dropped-evict-ack compose the ack-chaos
+        soak exposed). Returns the number of entries armed."""
+        pending: List[Tuple[str, str, str, str]] = []
+        with self._lock:
+            for jid, job in self.jobs.items():
+                for uid, task in job.tasks.items():
+                    if task.status == TaskStatus.BOUND and task.node_name:
+                        pending.append(("bind", uid, jid, task.node_name))
+                    elif task.status == TaskStatus.RELEASING:
+                        pending.append(("evict", uid, jid,
+                                        task.node_name or ""))
+        for op, uid, jid, node in pending:
+            self.inflight.register(op, uid, jid, node)
+        return len(pending)
+
+    # -- in-flight watchdog (docs/robustness.md feedback failure model) -----
+
+    def process_expired_inflight(self) -> Dict[str, int]:
+        """The ack watchdog, driven from the scheduler epilogue: drain
+        any delayed watch-path acks, then re-validate every in-flight
+        entry whose ack deadline passed against cluster truth
+        (``inflight_oracle_fn``) and resolve it through the existing
+        repair machinery — the FeedbackChannel normalizer for recovered
+        acks, ``journal._rollback_bind`` for binds the cluster lacks,
+        the resync ladder for evicts that never landed. Never a raw
+        mutation. Returns {resolution: count} for the entries settled
+        this pass."""
+        from .. import metrics
+        self.feedback.deliver_due()
+        ledger = self.inflight
+        now = ledger.time_fn()
+        out: Dict[str, int] = {}
+        for entry in ledger.expired(now):
+            try:
+                resolution = self._resolve_expired_inflight(entry)
+            except Exception:
+                log.exception("resolving expired in-flight entry %r "
+                              "failed; it stays armed", entry)
+                continue
+            if resolution:
+                out[resolution] = out.get(resolution, 0) + 1
+                metrics.register_inflight_expired(entry.op, resolution)
+        metrics.set_inflight_stats(ledger.open_count(),
+                                   ledger.oldest_age(now),
+                                   ledger.detail(now))
+        return out
+
+    def _resolve_expired_inflight(self, entry) -> Optional[str]:
+        """Settle ONE expired entry; returns its resolution label."""
+        from .journal import _rollback_bind
+        ledger = self.inflight
+        with self._lock:
+            job = self.jobs.get(entry.job)
+            cached = job.tasks.get(entry.uid) if job is not None else None
+            if cached is None:
+                intended = False
+            elif entry.op == "bind":
+                intended = (cached.status == TaskStatus.BOUND
+                            and cached.node_name == entry.node)
+            else:
+                intended = cached.status == TaskStatus.RELEASING
+        if cached is None:
+            ledger.resolve(entry.op, entry.uid, "gone")
+            return "gone"
+        if not intended:
+            # the cache moved on (re-placement, completion ack raced the
+            # deadline): the entry no longer describes live intent
+            ledger.resolve(entry.op, entry.uid, "superseded")
+            return "superseded"
+        truth = None
+        if self.inflight_oracle_fn is not None:
+            truth = self.inflight_oracle_fn(entry)
+        if entry.op == "bind":
+            if truth is False:
+                # the cluster does not run this placement (the pod died
+                # or was deleted under us): undo the optimistic state
+                # with the reconciler's own rollback helper — the task
+                # re-enters the pending pool and the next cycle's
+                # journaled+fenced allocate re-places it
+                _rollback_bind(self, job, cached, entry.node, fresh=True)
+                with self._lock:
+                    self.binding_tasks.pop(entry.uid, None)
+                ledger.resolve("bind", entry.uid, "rolled_back")
+                return "rolled_back"
+            # executed (True) or unknown (the executor DID accept the
+            # bind): only the feedback was lost — recover the ack
+            # through the normalizer, exactly as the wire would deliver
+            self.feedback.ack_running(entry.job, entry.uid, entry.node,
+                                      source="watchdog")
+            ledger.resolve("bind", entry.uid, "repaired")
+            return "repaired"
+        if truth is False:
+            # the evict never took cluster-side effect: re-issue it
+            # through the resync ladder (journaled+fenced retry with a
+            # budget; dead-letters on exhaustion)
+            ledger.resolve("evict", entry.uid, "reissued")
+            self.resync_task(cached.shallow_clone(), op="evict")
+            return "reissued"
+        self.feedback.ack_evicted(entry.job, entry.uid, source="watchdog")
+        ledger.resolve("evict", entry.uid, "repaired")
+        return "repaired"
 
     def resync_task(self, task: TaskInfo, op: str = "bind") -> None:
         """Queue a failed side effect for rate-limited retry
@@ -1219,6 +1410,7 @@ class SchedulerCache:
                 continue
             seq = self._journal_intent(op, task, task.node_name,
                                        via="resync")
+            self._register_inflight(op, task, task.node_name, seq)
             try:
                 if op == "bind":
                     self._bind_volumes(task)
@@ -1256,6 +1448,7 @@ class SchedulerCache:
                 done += 1
             except Exception:
                 self._journal_ack(seq, False)
+                self.inflight.abort(op, task.uid)
                 self._resync_or_dead_letter(key, op, task)
         return done
 

@@ -148,7 +148,13 @@ def wire_cache_to_store(store: ObjectStore,
                         cache.delete_task(cached)
                         cache.add_task(task)
                     elif prev_status != new_status:
-                        cache.update_task_status(cached, new_status)
+                        # status flips enter through the FeedbackChannel
+                        # normalizer (vlint VT017): the RUNNING flip is
+                        # the kubelet ack — stale/duplicate replays off
+                        # a resumed stream must not resurrect a dead
+                        # placement (docs/robustness.md feedback
+                        # failure model)
+                        cache.feedback.pod_status_event(cached, new_status)
                     return
             _ensure_job(cache, task.job, pod.metadata.namespace)
             cache.add_task(task)

@@ -181,6 +181,67 @@ class StoreFaultInjector:
         return True
 
 
+class AckFaultInjector:
+    """Seeded fault plan for the cluster→scheduler FEEDBACK plane (the
+    kubelet/status ack wire; docs/robustness.md feedback failure model).
+    Every offered ack rolls ONE seeded coin; a hit picks a kind by
+    seeded weighted choice:
+
+    - ``delay``     — the ack arrives ``delay_s`` late (virtual seconds
+      under the sim's clock — deterministic);
+    - ``drop``      — the ack never arrives; only the in-flight
+      watchdog's re-validation can settle the side effect;
+    - ``duplicate`` — the ack arrives twice (the replay ``delay_s``
+      later); the FeedbackChannel normalizer must make the second a
+      no-op;
+    - ``reorder``   — the ack is delivered AFTER the next ack offered
+      (adjacent swap), the evict-ack/bind-ack inversion drill;
+    - ``stale``     — the ack arrives, then is REPLAYED ``stale_delay_s``
+      later — long enough that the placement it confirms is usually
+      dead (evicted/completed); the replay must not resurrect it.
+
+    One ``random.Random(seed)`` per injector — a failing soak reproduces
+    from its printed seed. Counted in volcano_ack_faults_total{kind}."""
+
+    KINDS = ("delay", "drop", "duplicate", "reorder", "stale")
+    DEFAULT_SHARES = (("delay", 0.35), ("drop", 0.2), ("duplicate", 0.15),
+                      ("reorder", 0.15), ("stale", 0.15))
+
+    def __init__(self, failure_rate: float = 0.3, seed: int = 0,
+                 delay_s: float = 2.5, stale_delay_s: float = 6.5,
+                 shares=None):
+        if not 0.0 <= failure_rate <= 1.0:
+            raise ValueError(f"failure_rate {failure_rate} not in [0, 1]")
+        self.failure_rate = failure_rate
+        self.seed = seed
+        self.delay_s = delay_s
+        self.stale_delay_s = stale_delay_s
+        self.shares = tuple(shares) if shares is not None \
+            else self.DEFAULT_SHARES
+        self._rng = random.Random(seed)
+        self.attempts = 0
+        self.injected: Dict[str, int] = {}     # kind -> count
+
+    def roll(self, ack_kind: str) -> Optional[str]:
+        """One offered ack: returns the injected fault kind, or None for
+        a clean delivery."""
+        self.attempts += 1
+        if self._rng.random() >= self.failure_rate:
+            return None
+        total = sum(w for _, w in self.shares)
+        r = self._rng.random() * total
+        kind = self.shares[-1][0]
+        for name, w in self.shares:
+            if r < w:
+                kind = name
+                break
+            r -= w
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+        from . import metrics
+        metrics.register_ack_fault(kind)
+        return kind
+
+
 class DeviceFaultInjector:
     """Simulate XLA device errors (OOM / device-lost) at the allocate
     solve boundary — install as ``actions.allocate.DEVICE_FAULT_HOOK``.

@@ -169,6 +169,12 @@ def build_parser() -> argparse.ArgumentParser:
                     "fixed) — SchedulerCache.redrive_dead_letter")
     cache.add_parser("dead-letter",
                      description="List the dead-lettered side effects")
+    cache.add_parser(
+        "inflight",
+        description="List the in-flight ledger: executor-accepted "
+                    "bind/evicts still awaiting their cluster ack, with "
+                    "age and deadline, plus the watchdog's resolution "
+                    "totals (docs/robustness.md feedback failure model)")
 
     trace = sub.add_parser(
         "trace", description="Flight-recorder verbs "
@@ -283,6 +289,23 @@ def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
                 out(f"{key}\top={op}\ttask={task.uid}\t"
                     f"node={task.node_name or '-'}")
             out(f"{len(cache.dead_letter)} dead-lettered")
+        elif args.verb == "inflight":
+            ledger = getattr(cache, "inflight", None)
+            if ledger is None:
+                out("no in-flight ledger attached")
+                return 1
+            now = ledger.time_fn()
+            for e in sorted(ledger.entries(),
+                            key=lambda e: (e.registered_at, e.uid)):
+                out(f"{e.op}/{e.uid}\tnode={e.node or '-'}\t"
+                    f"age={now - e.registered_at:.1f}s\t"
+                    f"deadline_in={e.deadline - now:.1f}s")
+            detail = ledger.detail(now)
+            res = " ".join(f"{k}={v}" for k, v in
+                           detail["resolved"].items())
+            out(f"{detail['open']} in flight; "
+                f"oldest {detail['oldest_age_s']:.1f}s; "
+                f"resolved: {res or '-'}")
         return 0
     if store is None:
         out("no cluster store attached (in-process CLI requires a store)")

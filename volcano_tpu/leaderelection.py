@@ -47,6 +47,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from .apis.objects import ObjectMeta
+from .store_transport import TransientStoreError
 
 DEFAULT_LEASE_DURATION = 15.0
 DEFAULT_RENEW_DEADLINE = 10.0
@@ -215,7 +216,17 @@ class LeaderElector:
         monotonic clock is meaningless."""
         now = self.time_fn() if now is None else now
         from .store import ConflictError
-        lease = self._lease()
+        # The lease path rides the SAME store boundary as every other
+        # scheduler write (docs/robustness.md store failure model): in a
+        # hostile deployment the store here is a RetryingStoreTransport
+        # composition, and a verb that fails PAST the retry budget
+        # surfaces as TransientStoreError. That loses THIS attempt, not
+        # the leadership — k8s renew semantics: only the renew deadline
+        # (monotonic) deposes, and step() owns that watchdog.
+        try:
+            lease = self._lease()
+        except TransientStoreError:
+            return False
         if lease is None:
             fresh = Lease(metadata=ObjectMeta(name=self.name,
                                               namespace=self.namespace),
@@ -223,8 +234,10 @@ class LeaderElector:
                           lease_duration=self.lease_duration, epoch=1)
             try:
                 self.store.create(fresh)
-            except ValueError:
+            except (ValueError, ConflictError):
                 return False          # lost the create race; retry later
+            except TransientStoreError:
+                return False
             self._claimed(1)
             return True
         if lease.holder != self.identity \
@@ -248,6 +261,8 @@ class LeaderElector:
                 claimed, expect_rv=lease.metadata.resource_version)
         except ConflictError:
             return False              # another challenger won this round
+        except TransientStoreError:
+            return False              # write lost past the retry budget
         if not renewal and lease.holder != self.identity:
             self.takeovers += 1
         self._claimed(epoch)
@@ -265,7 +280,10 @@ class LeaderElector:
 
     def _write_released(self) -> None:
         from .store import ConflictError
-        lease = self._lease()
+        try:
+            lease = self._lease()
+        except TransientStoreError:
+            return                    # best effort: expiry releases it
         if lease is not None and lease.holder == self.identity:
             # epoch survives a release: the next acquirer must mint a
             # HIGHER epoch than ours, or fencing would stop ordering
@@ -279,7 +297,7 @@ class LeaderElector:
             try:
                 self.store.update(
                     released, expect_rv=lease.metadata.resource_version)
-            except ConflictError:
+            except (ConflictError, TransientStoreError):
                 pass                  # someone already took it over
 
     def release(self) -> None:

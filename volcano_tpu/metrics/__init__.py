@@ -192,6 +192,29 @@ if _HAVE_PROM:
         f"{_SUBSYSTEM}_store_watch_staleness",
         "Max resourceVersion lag across live watch streams (torn "
         "streams fall behind until resumed)")
+    _inflight_expired = Counter(
+        f"{_SUBSYSTEM}_inflight_expired_total",
+        "In-flight bind/evict entries whose cluster ack deadline passed, "
+        "re-validated and resolved by the watchdog "
+        "(docs/robustness.md feedback failure model)",
+        ["op", "resolution"])
+    _inflight_oldest = Gauge(
+        f"{_SUBSYSTEM}_inflight_oldest_seconds",
+        "Age of the oldest executor-accepted side effect still awaiting "
+        "its cluster ack (0 when nothing is in flight)")
+    _inflight_open = Gauge(
+        f"{_SUBSYSTEM}_inflight_open",
+        "Executor-accepted side effects currently awaiting their "
+        "cluster ack")
+    _ack_faults = Counter(
+        f"{_SUBSYSTEM}_ack_faults_total",
+        "Feedback-plane faults injected by the seeded ack chaos harness "
+        "(kind=delay|drop|duplicate|reorder|stale)", ["kind"])
+    _feedback_acks = Counter(
+        f"{_SUBSYSTEM}_feedback_acks_total",
+        "Cluster acks consumed through the FeedbackChannel normalizer "
+        "by verdict (docs/robustness.md feedback failure model)",
+        ["kind", "verdict"])
 
 
 def update_e2e_duration(seconds: float) -> None:
@@ -266,6 +289,14 @@ def health_detail() -> dict:
             "store_retries_total": {
                 "/".join(k[1:]): v for k, v in _counters.items()
                 if k[0] == "store_retries"},
+            # the feedback plane (docs/robustness.md feedback failure
+            # model): the in-flight ledger's open set + watchdog
+            # resolutions pushed by process_expired_inflight, plus the
+            # expiry counter rollup
+            "inflight": dict(_health_detail.get("inflight", {"open": 0})),
+            "inflight_expired_total": {
+                "/".join(k[1:]): v for k, v in _counters.items()
+                if k[0] == "inflight_expired"},
         }
 
 
@@ -326,6 +357,49 @@ def store_counts() -> Dict[str, Dict[str, float]]:
             "watch_resumes": {k[1]: v for k, v in _counters.items()
                               if k[0] == "store_watch_resumes"},
         }
+
+
+def register_inflight_expired(op: str, resolution: str) -> None:
+    """One in-flight entry expired past its ack deadline and the
+    watchdog resolved it (repaired|rolled_back|reissued|superseded|gone)
+    — volcano_inflight_expired_total{op,resolution}."""
+    with _lock:
+        _counters[("inflight_expired", op, resolution)] += 1
+    if _HAVE_PROM:
+        _inflight_expired.labels(op=op, resolution=resolution).inc()
+
+
+def set_inflight_stats(open_count: int, oldest_s: float,
+                       detail: Optional[dict] = None) -> None:
+    """Published by the watchdog step each epilogue: how much is in
+    flight and for how long (the liveness gauges of the feedback
+    failure model)."""
+    with _lock:
+        _gauges[("inflight_open",)] = float(open_count)
+        _gauges[("inflight_oldest_seconds",)] = float(oldest_s)
+        if detail is not None:
+            _health_detail["inflight"] = dict(detail)
+    if _HAVE_PROM:
+        _inflight_open.set(open_count)
+        _inflight_oldest.set(oldest_s)
+
+
+def register_ack_fault(kind: str) -> None:
+    """The seeded ack chaos harness injected one feedback-plane fault
+    (delay|drop|duplicate|reorder|stale)."""
+    with _lock:
+        _counters[("ack_faults", kind)] += 1
+    if _HAVE_PROM:
+        _ack_faults.labels(kind=kind).inc()
+
+
+def register_feedback_ack(kind: str, verdict: str) -> None:
+    """One cluster ack consumed through the FeedbackChannel normalizer
+    settled with ``verdict`` (applied|duplicate|stale|unknown)."""
+    with _lock:
+        _counters[("feedback_acks", kind, verdict)] += 1
+    if _HAVE_PROM:
+        _feedback_acks.labels(kind=kind, verdict=verdict).inc()
 
 
 def register_speculation(outcome: str) -> None:
@@ -580,6 +654,9 @@ _EXPO_GAUGES = {
     "partition_leader": (f"{_SUBSYSTEM}_partition_leader", "partition"),
     "tensor_epochs_live": (f"{_SUBSYSTEM}_tensor_epochs_live", None),
     "store_watch_staleness": (f"{_SUBSYSTEM}_store_watch_staleness", None),
+    "inflight_open": (f"{_SUBSYSTEM}_inflight_open", None),
+    "inflight_oldest_seconds": (f"{_SUBSYSTEM}_inflight_oldest_seconds",
+                                None),
 }
 _EXPO_COUNTERS = {
     "attempts": (f"{_SUBSYSTEM}_schedule_attempts_total", "result"),
@@ -610,6 +687,11 @@ _EXPO_COUNTERS = {
     "store_faults": (f"{_SUBSYSTEM}_store_faults_total", ("verb", "kind")),
     "store_watch_resumes": (f"{_SUBSYSTEM}_store_watch_resumes_total",
                             "outcome"),
+    "inflight_expired": (f"{_SUBSYSTEM}_inflight_expired_total",
+                         ("op", "resolution")),
+    "ack_faults": (f"{_SUBSYSTEM}_ack_faults_total", "kind"),
+    "feedback_acks": (f"{_SUBSYSTEM}_feedback_acks_total",
+                      ("kind", "verdict")),
 }
 # duration-series key -> (family, label name, unit suffix already in name)
 _EXPO_DURATIONS = {

@@ -579,6 +579,17 @@ class Scheduler:
                         manager.step()
                 except Exception:
                     log.exception("watch-stream upkeep failed")
+            # ack watchdog (docs/robustness.md feedback failure model):
+            # drain delayed watch-path acks and re-validate in-flight
+            # entries whose cluster ack is overdue — the liveness
+            # guarantee that nothing stays in flight forever. Isolated:
+            # a watchdog fault costs this pass, not the cycle.
+            if hasattr(self.cache, "process_expired_inflight"):
+                try:
+                    with obs_trace.TRACE.span("inflight_watchdog"):
+                        self.cache.process_expired_inflight()
+                except Exception:
+                    log.exception("in-flight ack watchdog failed")
             if self.federation is not None:
                 try:
                     self.federation.on_cycle_end()
@@ -1072,6 +1083,17 @@ class Scheduler:
         if report is not None and report.replayed:
             log.warning("journal reconciliation replayed %d unacked "
                         "intents: %s", report.replayed, report.as_dict())
+        # the in-flight ledger died with the old process while the
+        # settled state still shows BOUND/RELEASING tasks whose cluster
+        # ack is outstanding: re-arm their deadlines so an ack lost
+        # around the crash meets the watchdog (docs/robustness.md
+        # feedback failure model)
+        rearm = getattr(self.cache, "rearm_inflight_from_state", None)
+        if rearm is not None:
+            try:
+                rearm()
+            except Exception:
+                log.exception("re-arming the in-flight ledger failed")
         return report
 
     def _backoff(self, cap: float) -> float:

@@ -142,6 +142,32 @@ def main(argv=None) -> int:
                          "chaotic run converged to the same terminal "
                          "accounting with zero double-binds (exit 1 "
                          "otherwise)")
+    ap.add_argument("--ack-chaos", action="store_true",
+                    help="the feedback-plane soak preset: seeded "
+                         "kubelet/status ack faults at rate 0.3 "
+                         "(delay/drop/duplicate/reorder/stale; "
+                         "docs/robustness.md feedback failure model). "
+                         "Direct modes fault the ack wire; with "
+                         "--store-wired the watch-path RUNNING acks "
+                         "are faulted instead")
+    ap.add_argument("--ack-fault-rate", type=float, default=None,
+                    help="seeded per-ack fault rate (overrides the "
+                         "--ack-chaos preset)")
+    ap.add_argument("--ack-fault-seed", type=int, default=None,
+                    help="ack fault RNG seed (default: --seed)")
+    ap.add_argument("--verify-ack-equivalence", action="store_true",
+                    help="also run the SAME trace with a clean feedback "
+                         "plane (no ack faults, no kills) and assert "
+                         "the chaotic run converged to the same "
+                         "terminal accounting with zero double-binds "
+                         "and zero stuck in-flight entries (exit 1 "
+                         "otherwise)")
+    ap.add_argument("--lease-fault-rate", type=float, default=None,
+                    help="seeded store-fault rate on the HA lease CAS "
+                         "path (acquire/renew ride the retrying "
+                         "transport; --ha/--federated only)")
+    ap.add_argument("--lease-fault-seed", type=int, default=None,
+                    help="lease fault RNG seed (default: --seed)")
     ap.add_argument("--pipelined", action="store_true",
                     help="run the pipelined scheduler shell "
                          "(speculative solve overlapped with host "
@@ -207,6 +233,18 @@ def main(argv=None) -> int:
                    or torn_watches is not None)
     store_fault_rate = store_fault_rate or 0.0
     torn_watches = torn_watches or 0
+    # the feedback-plane preset (docs/robustness.md feedback failure
+    # model): 30% seeded ack faults over the chosen topology
+    ack_fault_rate = args.ack_fault_rate
+    if args.ack_chaos and ack_fault_rate is None:
+        ack_fault_rate = 0.3
+    ack_fault_rate = ack_fault_rate or 0.0
+    lease_fault_rate = args.lease_fault_rate or 0.0
+    if args.verify_ack_equivalence and not ack_fault_rate:
+        # without faults the report has no feedback section and every
+        # stuck-state assertion would pass vacuously
+        ap.error("--verify-ack-equivalence requires ack faults "
+                 "(--ack-chaos, or --ack-fault-rate > 0)")
 
     def wraps():
         if not args.chaos_rate:
@@ -218,7 +256,8 @@ def main(argv=None) -> int:
                                        seed=chaos_seed))
 
     def run(kills, replicas=None, losses=None, federated=None,
-            pipelined=None, fast_admit=None, fault_rate=None, torn=None):
+            pipelined=None, fast_admit=None, fault_rate=None, torn=None,
+            ack_rate=None, lease_rate=None):
         bw, ew = wraps()
         runner = SimRunner(trace, conf_text=conf_text, period=args.period,
                            seed=args.seed, max_cycles=args.max_cycles,
@@ -240,7 +279,13 @@ def main(argv=None) -> int:
                            if fault_rate is None else fault_rate,
                            store_fault_seed=args.store_fault_seed,
                            torn_watches=torn_watches if torn is None
-                           else torn)
+                           else torn,
+                           ack_fault_rate=ack_fault_rate
+                           if ack_rate is None else ack_rate,
+                           ack_fault_seed=args.ack_fault_seed,
+                           lease_fault_rate=lease_fault_rate
+                           if lease_rate is None else lease_rate,
+                           lease_fault_seed=args.lease_fault_seed)
         return runner.run()
 
     if args.trace_out:
@@ -310,15 +355,46 @@ def main(argv=None) -> int:
               f"relists={st.get('watch_relists', 0)}, "
               f"restarts={report.get('restarts', 0)}, "
               f"accounting={got}", file=sys.stderr)
+    if args.verify_ack_equivalence:
+        baseline = run([], losses=[], ack_rate=0.0, lease_rate=0.0)
+        got = terminal_accounting(report)
+        want = terminal_accounting(baseline)
+        fb = report.get("feedback", {})
+        problems = []
+        if got != want:
+            problems.append(f"terminal accounting diverged: "
+                            f"ack-chaotic={got} clean={want}")
+        if got.get("double_binds"):
+            problems.append(f"double-binds under ack chaos: "
+                            f"{got['double_binds']}")
+        if report["jobs"]["completed"] != report["jobs"]["arrived"]:
+            problems.append("ack-chaos run did not complete every "
+                            "arrived job")
+        if fb.get("inflight_open") or fb.get("wire_pending"):
+            problems.append(
+                f"stuck feedback state at run end: "
+                f"inflight_open={fb.get('inflight_open')} "
+                f"wire_pending={fb.get('wire_pending')}")
+        if problems:
+            for p in problems:
+                print(f"ack-equivalence FAILED: {p}", file=sys.stderr)
+            return 1
+        print(f"ack-equivalence OK: faults={fb.get('faults', {})}, "
+              f"acks={fb.get('acks', {})}, "
+              f"watchdog_fired={fb.get('watchdog_fired', 0)}, "
+              f"restarts={report.get('restarts', 0)}, "
+              f"accounting={got}", file=sys.stderr)
     if args.verify_federated_equivalence:
         import json as _json
         baseline = run([], replicas=1, losses=[], federated=0)
         problems = []
         # contended = anything that can legitimately diverge the
-        # aggregate plane from the oracle: seeded kills/lease losses, OR
-        # the run itself exercising cross-partition reserves (capacity
-        # moved between partitions — timing shifts are the feature)
+        # aggregate plane from the oracle: seeded kills/lease losses,
+        # ack/lease chaos, OR the run itself exercising cross-partition
+        # reserves (capacity moved between partitions — timing shifts
+        # are the feature)
         contended = bool(kill_cycles or lease_loss
+                         or ack_fault_rate or lease_fault_rate
                          or report.get("cross_partition_reserves"))
         if not contended:
             got_json = _json.dumps(oracle_part(report), sort_keys=True,
@@ -400,9 +476,9 @@ def main(argv=None) -> int:
               f"mode={mode}", file=sys.stderr)
     if args.verify_ha_equivalence:
         import json as _json
-        baseline = run([], replicas=1, losses=[])
+        baseline = run([], replicas=1, losses=[], lease_rate=0.0)
         problems = []
-        contended = bool(kill_cycles or lease_loss)
+        contended = bool(kill_cycles or lease_loss or lease_fault_rate)
         if not contended:
             got_json = _json.dumps(oracle_part(report), sort_keys=True,
                                    separators=(",", ":"))

@@ -217,6 +217,13 @@ def build_report(runner, actions_ms: Dict[tuple, list],
         # torn-stream recoveries — so this is decision-plane material
         # and byte-reproducible
         report["store"] = runner.store_detail()
+    if getattr(runner, "ack_chaos", False):
+        # the hostile feedback plane (docs/robustness.md feedback
+        # failure model): all seeded + virtual-clock timed, so
+        # decision-plane material and byte-reproducible. Only emitted
+        # for ack-chaos runs — fault-free reports stay byte-identical
+        # to the pre-feedback-plane decision plane.
+        report["feedback"] = runner.feedback_stats()
     if getattr(runner, "pipelined_mode", False):
         # deterministic (cycle-logic-driven) but MECHANISM, not decisions:
         # pipelined_oracle_part strips it for the serial-oracle diff

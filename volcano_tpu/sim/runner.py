@@ -59,7 +59,8 @@ from ..cache.executors import (FencedBinder, FencedEvictor,
                                FencingAuthority, SequenceBinder,
                                SequenceEvictor)
 from ..cache.journal import IntentJournal, JournalFollower
-from ..chaos import KillPointBinder, KillPointEvictor, SimKill
+from ..chaos import (AckFaultInjector, KillPointBinder, KillPointEvictor,
+                     SimKill)
 from ..scheduler import ROLE_LEADER, Scheduler
 from .trace import TraceEvent
 from . import report as report_mod
@@ -120,6 +121,72 @@ class VirtualClock:
             self._now += seconds
 
 
+class _AckWire:
+    """The cluster→scheduler feedback wire of the direct-mode sim: every
+    kubelet/status ack (RUNNING flip, eviction confirmation) the cluster
+    owes the scheduler rides this queue, and a seeded
+    ``chaos.AckFaultInjector`` reshapes deliveries — latency on the
+    virtual clock, drops, duplicates, adjacent-swap reorders, and stale
+    replays that land after the placement they confirm is dead. With no
+    injector every ack delivers immediately in offer order — byte-
+    identical to the pre-feedback-plane sim. The wire is CLUSTER state:
+    it survives scheduler kills (the in-flight ledger does not)."""
+
+    __slots__ = ("clock", "injector", "delay_s", "stale_delay_s", "_heap",
+                 "_seq", "delivered")
+
+    def __init__(self, clock, injector=None, delay_s: float = 2.5,
+                 stale_delay_s: float = 6.5):
+        self.clock = clock
+        self.injector = injector
+        self.delay_s = delay_s
+        self.stale_delay_s = stale_delay_s
+        # (due, seq, kind, uid, node); seq is a float so a reordered
+        # ack can slot between the next two offers (adjacent swap)
+        self._heap: List[Tuple[float, float, str, str, str]] = []
+        self._seq = 0.0
+        self.delivered = 0
+
+    def _next(self) -> float:
+        self._seq += 1.0
+        return self._seq
+
+    def offer(self, kind: str, uid: str, node: str = "") -> None:
+        now = self.clock.time()
+        fault = self.injector.roll(kind) \
+            if self.injector is not None else None
+        seq = self._next()
+        if fault == "drop":
+            return
+        if fault == "delay":
+            heapq.heappush(self._heap,
+                           (now + self.delay_s, seq, kind, uid, node))
+            return
+        if fault == "reorder":
+            # sorts after the NEXT offered ack (seq n+1) but before the
+            # one after it: the adjacent-pair swap
+            heapq.heappush(self._heap, (now, seq + 1.5, kind, uid, node))
+            return
+        heapq.heappush(self._heap, (now, seq, kind, uid, node))
+        if fault == "duplicate":
+            heapq.heappush(self._heap, (now + self.delay_s, self._next(),
+                                        kind, uid, node))
+        elif fault == "stale":
+            heapq.heappush(self._heap, (now + self.stale_delay_s,
+                                        self._next(), kind, uid, node))
+
+    def due(self, now: float) -> List[Tuple[str, str, str]]:
+        out = []
+        while self._heap and self._heap[0][0] <= now + 1e-9:
+            _, _, kind, uid, node = heapq.heappop(self._heap)
+            out.append((kind, uid, node))
+        self.delivered += len(out)
+        return out
+
+    def pending(self) -> int:
+        return len(self._heap)
+
+
 class _Replica:
     """One scheduler replica of the HA control plane: its own cache +
     shell + elector + standby journal follower over the SHARED cluster
@@ -161,7 +228,11 @@ class SimRunner:
                  store_fault_rate: float = 0.0,
                  store_fault_seed: Optional[int] = None,
                  store_latency_s: float = 0.05,
-                 torn_watches: int = 0):
+                 torn_watches: int = 0,
+                 ack_fault_rate: float = 0.0,
+                 ack_fault_seed: Optional[int] = None,
+                 lease_fault_rate: float = 0.0,
+                 lease_fault_seed: Optional[int] = None):
         self.trace = list(trace)
         self.period = period
         self.seed = seed
@@ -183,6 +254,13 @@ class SimRunner:
         self.restarts = 0
         self.double_binds = 0
         self._live_bound: set = set()
+        # cluster-side requeues that undid a bind BEFORE its harvest ran
+        # (feedback defers during leadership vacancies; a node death in
+        # that window kills a bind the witness has not been read for
+        # yet). The late harvest must consume the debt instead of
+        # counting the already-dead bind as live — otherwise the next
+        # legitimate re-placement reads as a double-bind.
+        self._requeue_debt: Dict[str, int] = {}
         self._journal_replayed: Dict[str, int] = {}
         self._kill_binder: Optional[KillPointBinder] = None
         self._kill_evictor: Optional[KillPointEvictor] = None
@@ -234,6 +312,35 @@ class SimRunner:
             self._tear_rng.randint(2, 12) for _ in range(self.torn_watches))
         self.torn_watch_events = 0
         self.ledgers: List = []
+        # hostile feedback plane (docs/robustness.md feedback failure
+        # model): seeded ack faults on the kubelet/status wire. Direct
+        # modes fault the runner-level _AckWire; the store-wired variant
+        # faults the watch-path RUNNING acks inside each cache's
+        # FeedbackChannel instead (they are watch events there, already
+        # subject to the torn streams).
+        self.ack_fault_rate = float(ack_fault_rate)
+        self.ack_fault_seed = seed if ack_fault_seed is None \
+            else ack_fault_seed
+        if self.ack_fault_rate and ha_replicas > 1:
+            raise ValueError("ack chaos supports single-scheduler and "
+                             "federated topologies (not --ha: the "
+                             "convergence sweep would mask delays)")
+        self._ack_injector = AckFaultInjector(
+            failure_rate=self.ack_fault_rate, seed=self.ack_fault_seed,
+            delay_s=2.5 * period, stale_delay_s=6.5 * period) \
+            if self.ack_fault_rate else None
+        self._ack_wire = _AckWire(
+            self.clock,
+            None if store_wired else self._ack_injector,
+            delay_s=2.5 * period, stale_delay_s=6.5 * period)
+        self._store_ack_injectors: List[AckFaultInjector] = []
+        # HA lease path behind the faulted transport (ROADMAP item 5
+        # remainder): per-replica Lease CAS traffic rides retry funnel →
+        # faulty transport → lease store when a rate is set
+        self.lease_fault_rate = float(lease_fault_rate)
+        self.lease_fault_seed = seed if lease_fault_seed is None \
+            else lease_fault_seed
+        self._lease_transports: Dict[int, object] = {}
         if self.store_wired and ha_replicas > 1:
             raise ValueError("store_wired supports single-scheduler and "
                              "federated topologies (not --ha)")
@@ -310,6 +417,7 @@ class SimRunner:
             # job ingestion timestamps (schedule_start_timestamp) pin to
             # virtual time with the same injection
             self.cache.time_fn = self.clock.time
+            self._pin_feedback(self.cache)
             self.sched = Scheduler(self.cache, conf_text=self.conf_text,
                                    schedule_period=period, clock=self.clock,
                                    rng=random.Random(seed),
@@ -345,6 +453,49 @@ class SimRunner:
         self.drf_gap: List[float] = []
         # wall-clock plane
         self.pipeline_e2e_ms: List[float] = []
+
+    def _pin_feedback(self, cache: SchedulerCache) -> None:
+        """Pin a cache's feedback-plane machinery to the sim: in-flight
+        ack deadlines expire on the virtual clock after a few periods
+        (so soaks exercise the watchdog), and a watchdog-recovered evict
+        ack hands the controller-recreate to the harness."""
+        cache.inflight.time_fn = self.clock.time
+        cache.inflight.ack_timeout_s = 3.0 * self.period
+        cache.feedback.on_watchdog_evict = \
+            lambda jid, uid, c=cache: self._watchdog_requeued(c, jid, uid)
+
+    def _watchdog_requeued(self, cache: SchedulerCache, jid: str,
+                           uid: str) -> None:
+        """A cache's watchdog recovered a LOST eviction ack and requeued
+        the member cache-locally: perform the cluster/controller half —
+        fan the requeue out to the other replica caches and keep the
+        runner's gang bookkeeping consistent (one logical requeue)."""
+        if self.store_wired:
+            # the controller-recreate path owns both the idempotency
+            # guard (recreate_pod refuses when the harvest already
+            # recreated the pod — a delete event merely delayed by a
+            # torn stream) and the requeue bookkeeping
+            self._requeue_task(uid)
+            return
+        for other in self.caches:
+            if other is not cache:
+                other.requeue_lost_member(jid, uid, detach=True)
+        self._note_requeue(uid)
+        self.requeues += 1
+        if jid in self.admitted_at:
+            del self.admitted_at[jid]
+            self._admit_epoch[jid] = self._admit_epoch.get(jid, 0) + 1
+
+    def _note_requeue(self, uid: str) -> None:
+        """A cluster-side requeue retired ``uid``'s live placement: drop
+        it from the live-bound witness — or, when the undone bind sits
+        UNHARVESTED in the executor witness (feedback deferred during a
+        leadership vacancy), record debt the late harvest consumes."""
+        if uid in self._live_bound:
+            self._live_bound.discard(uid)
+        elif any(uid == u for u, _ in
+                 self.binder.sequence[self._binds_seen:]):
+            self._requeue_debt[uid] = self._requeue_debt.get(uid, 0) + 1
 
     # -- trace/event application --------------------------------------------
 
@@ -535,11 +686,19 @@ class SimRunner:
         if not present:
             return
         for uid in uids:
-            self._requeue_task(uid, on_node=False)
+            # the lost members ride the same validate-then-requeue
+            # resolution the watchdog uses (cache.requeue_lost_member):
+            # a member mid-bind on the dying node has its in-flight
+            # entry and binding marker resolved WITH the requeue, so the
+            # unacked bind cannot strand them — and the stale RUNNING
+            # ack still on the wire classifies stale when it lands
+            self._requeue_task(uid, on_node=False, lost_node=name)
         for cache in self.caches:
             cache.remove_node(name)
 
-    def _requeue_task(self, uid: str, on_node: bool = True) -> None:
+    def _requeue_task(self, uid: str, on_node: bool = True,
+                      via_ack: bool = False,
+                      lost_node: Optional[str] = None) -> None:
         jid = self.task_job.get(uid, "")
         if self.store_wired:
             # the evicted/killed pod was already deleted cluster-side;
@@ -549,7 +708,7 @@ class SimRunner:
             # recreated) means there is nothing to requeue.
             if not self.world.recreate_pod(uid):
                 return
-            self._live_bound.discard(uid)
+            self._note_requeue(uid)
             self.requeues += 1
             if jid in self.admitted_at:
                 del self.admitted_at[jid]
@@ -557,24 +716,21 @@ class SimRunner:
             return
         touched_any = False
         for cache in self.caches:
-            job = cache.jobs.get(jid)
-            if job is None or uid not in job.tasks:
-                continue
-            cached = job.tasks[uid]
-            node = cache.nodes.get(cached.node_name)
-            if cached.node_name:
-                # mirrors job/node state directly (delete + controller
-                # recreate, collapsed): tell the incremental snapshot
-                cache.mark_node_dirty(cached.node_name)
-            cache.mark_job_dirty(job.uid)
-            if on_node and node is not None and uid in node.tasks:
-                node.remove_task(cached)
-            cached.node_name = ""
-            job.update_task_status(cached, TaskStatus.PENDING)
-            touched_any = True
+            if via_ack:
+                # an eviction confirmation off the ack wire: consumed
+                # through the cache's FeedbackChannel normalizer, which
+                # drops acks a NEWER bind intent superseded
+                touched = cache.feedback.ack_evicted(jid, uid) == "applied"
+            else:
+                # cluster-initiated loss (node death): validate-then-
+                # requeue, resolving in-flight state with the member
+                touched = cache.requeue_lost_member(jid, uid,
+                                                    lost_node=lost_node,
+                                                    detach=on_node)
+            touched_any = touched or touched_any
         if not touched_any:
             return
-        self._live_bound.discard(uid)
+        self._note_requeue(uid)
         self.requeues += 1
         if jid in self.admitted_at:
             # the gang dropped below min_available: cancel its pending
@@ -630,39 +786,45 @@ class SimRunner:
     def _feedback(self, now: float) -> None:
         """Close the loop the way a live cluster would: binds ack to
         RUNNING, evictions delete-and-recreate PENDING, full gangs stamp
-        admission and schedule completion. Status acks apply to EVERY
-        replica cache (the watch stream is cluster-wide)."""
+        admission and schedule completion. The HARVEST half (reading the
+        executor witnesses) is cluster truth and stamps the runner's
+        bookkeeping immediately; the ACKS then ride the _AckWire — where
+        seeded chaos delays/drops/duplicates/reorders them — and are
+        consumed by each cache's FeedbackChannel normalizer (the watch
+        stream is cluster-wide, so deliveries fan out to every replica
+        cache)."""
         touched: Dict[str, bool] = {}
         seq = self.binder.sequence
         while self._binds_seen < len(seq):
-            uid, _host = seq[self._binds_seen]
+            uid, host = seq[self._binds_seen]
             self._binds_seen += 1
             # a second cluster-side bind of a task whose first bind is
             # still live (no evict/requeue in between) is a DOUBLE-BIND —
             # the exact corruption the journal + reconciler must prevent
             if uid in self._live_bound:
                 self.double_binds += 1
+            elif self._requeue_debt.get(uid):
+                # this bind was already undone by a cluster event (node
+                # death) while feedback was deferred: it is not live
+                self._requeue_debt[uid] -= 1
+                if not self._requeue_debt[uid]:
+                    del self._requeue_debt[uid]
             else:
                 self._live_bound.add(uid)
             jid = self.task_job.get(uid)
             if jid is None:
                 continue
-            placed = False
-            for cache in self.caches:
-                job = cache.jobs.get(jid)
-                if job is None or uid not in job.tasks:
-                    continue
-                cached = job.tasks[uid]
-                if cached.status == TaskStatus.BOUND \
-                        and not self.store_wired:
-                    # store mode: the Running ack arrives through the
-                    # watch stream (possibly after a torn-stream resume)
-                    # — acking here would mask exactly the staleness the
-                    # store-chaos soak exists to exercise
-                    cache.update_task_status(cached, TaskStatus.RUNNING)
-                placed = True
+            placed = any(jid in cache.jobs
+                         and uid in cache.jobs[jid].tasks
+                         for cache in self.caches)
             if not placed:
                 continue
+            if not self.store_wired:
+                # store mode: the Running ack arrives through the watch
+                # stream (possibly after a torn-stream resume) — a wire
+                # ack here would mask exactly the staleness the
+                # store-chaos soak exists to exercise
+                self._ack_wire.offer("running", uid, host)
             if jid not in self.first_bind:
                 self.first_bind[jid] = now
                 self.queueing_delay.append(now - self.arrival_time[jid])
@@ -671,7 +833,20 @@ class SimRunner:
         while self._evicts_seen < len(eseq):
             uid = eseq[self._evicts_seen]
             self._evicts_seen += 1
-            self._requeue_task(uid)
+            if self.store_wired:
+                self._requeue_task(uid)
+            else:
+                self._ack_wire.offer("evicted", uid)
+        if not self.store_wired:
+            for kind, uid, node in self._ack_wire.due(now):
+                jid = self.task_job.get(uid)
+                if jid is None:
+                    continue           # gang completed while the ack flew
+                if kind == "running":
+                    for cache in self.caches:
+                        cache.feedback.ack_running(jid, uid, node)
+                else:
+                    self._requeue_task(uid, via_ack=True)
         if self.store_wired:
             # torn watch streams can delay the Running acks past the
             # cycle that bound the gang: keep re-checking gangs with
@@ -685,18 +860,15 @@ class SimRunner:
             # crash-window bind AFTER its kubelet ack was consumed above
             # (the ack arrived while leadership was vacant and feedback
             # deferred) — converge any still-BOUND task the cluster
-            # already runs. Deterministic: sorted uid order.
+            # already runs through the normalizer. Deterministic: sorted
+            # uid order.
             for uid in sorted(self._live_bound):
                 jid = self.task_job.get(uid)
                 if jid is None:
                     continue
                 for cache in self.caches:
-                    job = cache.jobs.get(jid)
-                    if job is None or uid not in job.tasks:
-                        continue
-                    cached = job.tasks[uid]
-                    if cached.status == TaskStatus.BOUND:
-                        cache.update_task_status(cached, TaskStatus.RUNNING)
+                    cache.feedback.ack_running(jid, uid, node=None,
+                                               source="converge")
         for jid in touched:
             job = self._job(jid)
             if job is None or jid in self.admitted_at:
@@ -715,15 +887,47 @@ class SimRunner:
     def _progress_signature(self) -> tuple:
         return (self._trace_ix, self._binds_seen, self._evicts_seen,
                 self.completed, self.requeues, self.unfinished_jobs(),
+                self._ack_wire.delivered, self._ack_wire.pending(),
                 sum(len(c.resync_queue) for c in self.caches),
                 sum(len(c.dead_letter) for c in self.caches))
 
     def _done(self) -> bool:
         return (self._trace_ix >= len(self.trace)
                 and not self._completions
-                and not self.unfinished_jobs())
+                and not self.unfinished_jobs()
+                # drain the ack wire: a delayed/stale replay still in
+                # flight must meet the normalizer, not die with the run
+                and not self._ack_wire.pending()
+                and not any(c.feedback.pending() for c in self.caches))
 
     # -- HA control plane (docs/robustness.md) ------------------------------
+
+    def _lease_store_for(self, ix: int):
+        """The store a replica's elector sees: the raw lease store, or —
+        with ``--lease-fault-rate`` — its Lease CAS traffic behind the
+        SAME hostile-transport composition every other scheduler write
+        rides (retry funnel → seeded faulty transport → store; ROADMAP
+        item 5 remainder). One persistent transport per replica index so
+        restarts replay a deterministic fault stream."""
+        if not self.lease_fault_rate:
+            return self.lease_store
+        transport = self._lease_transports.get(ix)
+        if transport is None:
+            from ..chaos import StoreFaultInjector
+            from ..store_transport import (FaultyStoreTransport,
+                                           RetryingStoreTransport)
+            injector = StoreFaultInjector(
+                failure_rate=self.lease_fault_rate,
+                seed=self.lease_fault_seed * 7919 + ix,
+                latency_s=0.05, sleep_fn=self.clock.sleep)
+            transport = RetryingStoreTransport(
+                FaultyStoreTransport(self.lease_store, injector,
+                                     name=f"lease-{ix}"),
+                sleep_fn=self.clock.sleep, time_fn=self.clock.time,
+                cycle_budget_s=0.5 * self.period,
+                rng=random.Random(self.lease_fault_seed * 31 + ix))
+            self._lease_transports[ix] = transport
+        return transport
 
     def _init_ha(self, binder, evictor) -> None:
         """Build the N-replica control plane: shared lease store +
@@ -761,6 +965,7 @@ class SimRunner:
             default_queue=None, journal=self.journal)
         cache.resync_queue.time_fn = self.clock.time
         cache.time_fn = self.clock.time
+        self._pin_feedback(cache)
         rep.cache = cache
         rep.follower = JournalFollower(cache)
         rep.follower.attach(self.journal)
@@ -773,7 +978,7 @@ class SimRunner:
         ident = f"replica-{rep.ix}" if rep.gen == 0 \
             else f"replica-{rep.ix}-g{rep.gen}"
         rep.elector = LeaderElector(
-            self.lease_store, "vc-scheduler",
+            self._lease_store_for(rep.ix), "vc-scheduler",
             on_started_leading=lambda: None,
             identity=ident,
             lease_duration=1.6 * self.period,
@@ -869,6 +1074,7 @@ class SimRunner:
         self._disarm_kills()
         c = rep.cache
         c.binding_tasks.clear()
+        c.inflight.clear()
         c.dead_letter.clear()
         metrics.set_dead_letter_size(0)
         c.err_tasks.clear()
@@ -952,6 +1158,8 @@ class SimRunner:
             # seeded action ordinal — it must abandon its open session at
             # that boundary and demote to fenced
             self._armed_revoke = self._lease_rng.randint(1, 5)
+        for transport in self._lease_transports.values():
+            transport.new_cycle()
         leader_ran = False
         for rep in self.replicas:
             t0 = time.perf_counter()
@@ -1023,6 +1231,7 @@ class SimRunner:
                 default_queue=None, journal=self.journal)
             cache.resync_queue.time_fn = self.clock.time
             cache.time_fn = self.clock.time
+            self._pin_feedback(cache)
             cache.snapshot_scope = \
                 lambda ci, p=pid: self.pmap.scope(ci, p)
             rep.cache = cache
@@ -1045,7 +1254,8 @@ class SimRunner:
         pid = rep.ix
         ident = f"fed-p{pid}" if rep.gen == 0 else f"fed-p{pid}-g{rep.gen}"
         rep.elector = LeaderElector(
-            self.lease_store, partition_lease_name("vc-scheduler", pid),
+            self._lease_store_for(pid),
+            partition_lease_name("vc-scheduler", pid),
             on_started_leading=lambda: None,
             identity=ident,
             lease_duration=1.6 * self.period,
@@ -1088,6 +1298,7 @@ class SimRunner:
         self._disarm_kills()
         c = rep.cache
         c.binding_tasks.clear()
+        c.inflight.clear()
         c.dead_letter.clear()
         metrics.set_dead_letter_size(0)
         c.err_tasks.clear()
@@ -1173,6 +1384,8 @@ class SimRunner:
                     0, self.federated - 1)
         if self.cycles in self.lease_loss_cycles:
             self._armed_revoke = self._lease_rng.randint(1, 5)
+        for transport in self._lease_transports.values():
+            transport.new_cycle()
         fired = False
         for rep in self.replicas:
             t0 = time.perf_counter()
@@ -1206,12 +1419,40 @@ class SimRunner:
         through the informer path, whose uid is namespace-qualified."""
         return f"default/{name}" if self.store_wired else name
 
+    def _store_inflight_oracle(self, entry):
+        """Cluster truth for the store-wired watchdog: the pod's state
+        in the RAW store (what a production watchdog would GET through
+        its transport)."""
+        pod = self.world.store.get("Pod", "default", entry.uid)
+        if entry.op == "bind":
+            return pod is not None and pod.status.node_name == entry.node
+        # the evict took effect iff the pod-as-placed is gone (the
+        # controller's recreate is a fresh, unplaced pod)
+        return pod is None or not pod.status.node_name
+
+    def _pin_store_feedback(self, cache: SchedulerCache, ix: int) -> None:
+        """Store-wired feedback plumbing: virtual ack deadlines, the
+        store-truth oracle, and — under ack chaos — the watch-path
+        injector on the cache's FeedbackChannel (acks are watch events
+        here; the store-wired ack chaos variant)."""
+        self._pin_feedback(cache)
+        cache.inflight_oracle_fn = self._store_inflight_oracle
+        if self._ack_injector is not None:
+            inj = AckFaultInjector(
+                failure_rate=self.ack_fault_rate,
+                seed=self.ack_fault_seed * 7919 + ix,
+                delay_s=2.5 * self.period,
+                stale_delay_s=6.5 * self.period)
+            cache.feedback.attach_injector(inj, self.clock.time)
+            self._store_ack_injectors.append(inj)
+
     def _init_store_single(self, binder_wrap, evictor_wrap) -> None:
         """Single scheduler over the hostile store boundary: the cache
         is informer-fed (resumable watches) and every executor write
         rides retry funnel → faulty transport → store."""
         cache, b, e = self.world.build_cache(
             0, binder_wrap, evictor_wrap, journal=self.journal)
+        self._pin_store_feedback(cache, 0)
         if self.kill_cycles:
             self._kill_binder = KillPointBinder(b)
             self._kill_evictor = KillPointEvictor(e)
@@ -1292,6 +1533,7 @@ class SimRunner:
             cache, b, e = self.world.build_cache(
                 pid, binder_wrap, evictor_wrap, journal=self.journal,
                 event_filter=self._fed_event_filter(pid))
+            self._pin_store_feedback(cache, pid)
             if self.kill_cycles:
                 kb, ke = KillPointBinder(b), KillPointEvictor(e)
                 self._store_kill_wrappers[pid] = (kb, ke)
@@ -1430,6 +1672,7 @@ class SimRunner:
         if self._kill_evictor is not None:
             self._kill_evictor.disarm()
         c.binding_tasks.clear()
+        c.inflight.clear()
         c.dead_letter.clear()
         metrics.set_dead_letter_size(0)
         c.err_tasks.clear()
@@ -1492,6 +1735,48 @@ class SimRunner:
         now = metrics.fast_admit_counts()
         return {k: int(now.get(k, 0) - self._fa_mark.get(k, 0))
                 for k in ("gangs", "binds")}
+
+    @property
+    def ack_chaos(self) -> bool:
+        return self._ack_injector is not None
+
+    def feedback_stats(self) -> Dict[str, object]:
+        """The report's deterministic feedback-plane section (seeded
+        chaos + virtual clock ⇒ byte-reproducible): faults injected on
+        the ack wire, normalizer verdicts, in-flight ledger resolutions,
+        and the zero-stuck witnesses (open entries / pending acks at
+        run end)."""
+        faults: Dict[str, int] = {}
+        injectors = ([self._ack_injector] if not self.store_wired
+                     else self._store_ack_injectors)
+        for inj in injectors:
+            if inj is None:
+                continue
+            for kind, n in inj.injected.items():
+                faults[kind] = faults.get(kind, 0) + n
+        acks: Dict[str, int] = {}
+        resolved: Dict[str, int] = {}
+        open_entries = 0
+        pending_watch = 0
+        for cache in self.caches:
+            for (kind, verdict), n in cache.feedback.counts.items():
+                key = f"{kind}/{verdict}"
+                acks[key] = acks.get(key, 0) + n
+            for how, n in cache.inflight.resolved.items():
+                resolved[how] = resolved.get(how, 0) + n
+            open_entries += cache.inflight.open_count()
+            pending_watch += cache.feedback.pending()
+        return {
+            "fault_rate": self.ack_fault_rate,
+            "faults": dict(sorted(faults.items())),
+            "acks": dict(sorted(acks.items())),
+            "inflight_resolved": dict(sorted(resolved.items())),
+            "inflight_open": open_entries,
+            "wire_pending": self._ack_wire.pending() + pending_watch,
+            "watchdog_fired": sum(
+                resolved.get(k, 0)
+                for k in ("repaired", "rolled_back", "reissued")),
+        }
 
     def run(self) -> dict:
         """Run the trace to completion (or stall/max_cycles); returns the

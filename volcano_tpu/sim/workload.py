@@ -266,6 +266,26 @@ SCENARIOS: Dict[str, dict] = {
                                      restore_at=80.0, fail=(30, 31),
                                      fail_at=60.0)),
     ),
+    "ack-chaos": dict(
+        description="120 gangs over 4 skew-weighted queues on a "
+                    "saturated 8-node cluster (reclaim-shaped "
+                    "evictions), with node drains/restores and one "
+                    "node death mid-run — the feedback-plane soak "
+                    "world: seeded ack delay/drop/dup/reorder/stale "
+                    "plus kills must converge to the no-fault terminal "
+                    "accounting with bind AND evict acks in flight "
+                    "(docs/robustness.md feedback failure model); the "
+                    "4 queues shard under --federated 4",
+        factory=lambda seed: synthetic_trace(
+            120, 8, seed=seed, arrival_rate=5.0, duration_mean=12.0,
+            duration_cap=30.0, cpu_choices=(2000, 3000),
+            priority_choices=(0,),
+            queues=(("q1", 4), ("q2", 2), ("q3", 1), ("q4", 1)),
+            queue_demand=(1, 1, 2, 4),
+            extra_events=_flap_events(range(0, 2), drain_at=10.0,
+                                      restore_at=20.0, fail=(7,),
+                                      fail_at=14.0)),
+    ),
     "fed-smoke": dict(
         description="60 gangs over 4 equal queues on 16 nodes, light "
                     "load — the federated non-contended oracle world: "
