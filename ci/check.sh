@@ -22,18 +22,20 @@ run_federated=true
 run_pipelined=true
 run_store=true
 run_ack=true
+run_overload=true
 case "${1:-}" in
-  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false ;;
-  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false ;;
-  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false ;;
-  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false ;;
-  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false ;;
-  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false ;;
-  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false ;;
-  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_pipelined=false; run_store=false ; run_ack=false ;;
-  --pipelined-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_store=false ; run_ack=false ;;
-  --store-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ; run_ack=false ;;
-  --ack-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ;;
+  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
+  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
+  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
+  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
+  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
+  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
+  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
+  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
+  --pipelined-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_store=false ; run_ack=false; run_overload=false ;;
+  --store-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ; run_ack=false; run_overload=false ;;
+  --ack-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_overload=false ;;
+  --overload-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false ;;
 esac
 
 if $run_lint; then
@@ -485,6 +487,89 @@ print("   ack-chaos: faults absorbed, watchdog fired (%d/%d), zero "
          fed["feedback"]["watchdog_fired"]))
 EOF
   echo "   ack-chaos: terminal-equivalent, byte-deterministic x2"
+fi
+
+if $run_overload; then
+  # overload soak (docs/robustness.md overload failure model): the
+  # sustained-overload world under the full preset — cycle deadline
+  # budgets (deterministic cost model), bounded admission with
+  # priority-aware shedding + retry-after re-offers, seeded arrival
+  # bursts — plus 4 seeded kills. (a) --verify-overload-equivalence
+  # asserts the contract (bounded per-queue depth, spend <= 2x budget,
+  # every admitted gang completes incl. shed-then-retried ones, zero
+  # double-binds, byte-deterministic x2 internally), (b) an external
+  # byte-diff x2 of the deterministic plane, (c) the budget/shed
+  # machinery must actually have FIRED, and (d) the --federated 4
+  # fed-hotspot world must converge queue ownership through the
+  # load-driven rebalancer with zero operator move_queue calls.
+  echo "== overload-soak: cycle budgets + backpressure + rebalancer =="
+  ovdir=$(mktemp -d)
+  trap 'rm -rf "${simdir:-/nonexistent}" "${soakdir:-/nonexistent}" \
+"${obsdir:-/nonexistent}" "${hadir:-/nonexistent}" \
+"${feddir:-/nonexistent}" "${pipedir:-/nonexistent}" \
+"${storedir:-/nonexistent}" "${ackdir:-/nonexistent}" \
+"${ovdir:-/nonexistent}"' EXIT
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario overload-burst \
+    --seed 3 --overload-chaos --kill-cycles 2,5,9,13 --kill-seed 1 \
+    --verify-overload-equivalence --deterministic > "$ovdir/ov.a.json" \
+    || { echo "overload-soak FAILED: overload contract violated"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario overload-burst \
+    --seed 3 --overload-chaos --kill-cycles 2,5,9,13 --kill-seed 1 \
+    --deterministic > "$ovdir/ov.b.json"
+  diff "$ovdir/ov.a.json" "$ovdir/ov.b.json" \
+    || { echo "overload-soak FAILED: overload run not \
+byte-deterministic"; exit 1; }
+  # the acceptance bar runs the 5x-overload world SHARDED too: 4
+  # partitions, seeded kills, backpressure + reserves composing —
+  # every admitted gang completes, zero double-binds (the verify flag
+  # also byte-compares an internal identical re-run)
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario overload-burst \
+    --seed 3 --federated 4 --overload-chaos --kill-cycles 2,5,9,13 \
+    --kill-seed 2 --verify-overload-equivalence --deterministic \
+    > "$ovdir/ovfed.json" \
+    || { echo "overload-soak FAILED: federated overload contract \
+violated"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario fed-hotspot \
+    --seed 3 --federated 4 --overload-chaos \
+    --verify-overload-equivalence --deterministic > "$ovdir/hot.a.json" \
+    || { echo "overload-soak FAILED: fed-hotspot did not converge"; \
+         exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario fed-hotspot \
+    --seed 3 --federated 4 --overload-chaos --deterministic \
+    > "$ovdir/hot.b.json"
+  diff "$ovdir/hot.a.json" "$ovdir/hot.b.json" \
+    || { echo "overload-soak FAILED: fed-hotspot not \
+byte-deterministic"; exit 1; }
+  python - "$ovdir/ov.a.json" "$ovdir/hot.a.json" <<'EOF'
+import json, sys
+ov = json.load(open(sys.argv[1]))
+hot = json.load(open(sys.argv[2]))
+o = ov["overload"]
+assert o["cycle_budget"]["exhausted"] > 0, "budget never exhausted"
+assert o["cycle_budget"]["deferred_actions"] > 0, "nothing deferred"
+assert o["cycle_budget"]["max_cycle_spend_s"] <= \
+    2 * o["cycle_budget"]["budget_s"]
+assert o["shed_total"] > 0 and o["shed"].get("priority_shed", 0) > 0, \
+    f"priority-aware shedding never fired: {o['shed']}"
+assert o["retries_pending"] == 0
+adm = o["admission"]
+assert all(d <= adm["max_queue_depth"]
+           for d in adm["high_water"].values()), adm["high_water"]
+assert ov["double_binds"] == 0 and ov["restarts"] > 0
+assert ov["jobs"]["completed"] == ov["jobs"]["arrived"]
+reb = hot["federation"]["rebalance"]
+assert reb["move_count"] > 0, "rebalancer never moved a queue"
+assert reb["last_move_t"] <= hot["virtual_time_s"] - 10, \
+    f"rebalancer did not converge: {reb}"
+assert hot["double_binds"] == 0
+assert hot["jobs"]["completed"] == hot["jobs"]["arrived"]
+print("   overload-soak: budget exhausted %d / deferred %d, shed %s, "
+      "rebalance moves %d (converged), zero double-binds"
+      % (o["cycle_budget"]["exhausted"],
+         o["cycle_budget"]["deferred_actions"], o["shed"],
+         reb["move_count"]))
+EOF
+  echo "   overload-soak: contract holds, byte-deterministic x2"
 fi
 
 if $run_shim; then

@@ -1940,3 +1940,148 @@ def test_vt017_rebroken_bind_batch_registration_strip():
     vt17 = [x for x in f if x.rule == "VT017"]
     assert vt17, "stripping bind_batch's ledger registration went unseen"
     assert any(x.symbol.endswith("bind_batch") for x in vt17)
+
+
+# ---------------------------------------------------------------------------
+# 7. VT018 bounded-work (overload failure model)
+# ---------------------------------------------------------------------------
+
+VT018_TRIGGER = '''
+class SchedulerCache:
+    def drain(self):
+        for key, item in self.pending_work.items():
+            self.retry(key, item)
+'''
+
+VT018_CLEAN_SLICE = '''
+class SchedulerCache:
+    def drain(self):
+        batch = sorted(self.pending_work.items())
+        for key, item in batch[:64]:
+            self.retry(key, item)
+'''
+
+VT018_CLEAN_GUARD = '''
+class SchedulerCache:
+    def drain(self):
+        done = 0
+        for key, item in self.pending_work.items():
+            if done >= self.max_per_cycle:
+                break
+            self.retry(key, item)
+            done += 1
+'''
+
+VT018_CLEAN_BUDGET = '''
+class SchedulerCache:
+    def drain(self, budget):
+        for key, item in self.pending_work.items():
+            if budget.exhausted():
+                return
+            self.retry(key, item)
+'''
+
+
+def test_vt018_trigger_and_clean_forms():
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": VT018_TRIGGER})
+    assert "VT018" in rule_ids(f)
+    for clean in (VT018_CLEAN_SLICE, VT018_CLEAN_GUARD,
+                  VT018_CLEAN_BUDGET):
+        f, _ = findings_of({"volcano_tpu/cache/cache.py": clean})
+        assert "VT018" not in rule_ids(f), clean
+
+
+def test_vt018_taint_through_list_and_getattr():
+    """Provenance, not naming: a local assigned from a matching
+    collection (through list()) or from a producer resolved via
+    getattr-by-name is tainted; a bare local that merely happens to be
+    called ``pending`` is not."""
+    tainted = '''
+class SchedulerCache:
+    def drain(self):
+        items = list(self.dead_letter.items())
+        for key, item in items:
+            self.retry(key, item)
+'''
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": tainted})
+    assert "VT018" in rule_ids(f)
+    via_getattr = '''
+def fast(cache):
+    drain = getattr(cache, "drain_new_jobs", None)
+    uids = drain()
+    for uid in uids:
+        place(uid)
+'''
+    f, _ = findings_of({"volcano_tpu/scheduler.py": via_getattr})
+    assert "VT018" in rule_ids(f)
+    bare_local = '''
+def rearm(self):
+    pending = []
+    for jid, job in self.jobs.items():
+        pending.append(jid)
+    for jid in pending:
+        self.register(jid)
+'''
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": bare_local})
+    assert "VT018" not in rule_ids(f)
+
+
+def test_vt018_producer_arg_witness_and_one_hop():
+    """pop_ready(max_items) — the callee owns the cap — and a one-hop
+    CycleBudget witness both excuse the loop."""
+    arg_witness = '''
+class SchedulerCache:
+    def process(self, max_items):
+        for key, item in self.resync_queue.pop_ready(max_items):
+            self.retry(key, item)
+'''
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": arg_witness})
+    assert "VT018" not in rule_ids(f)
+    unbounded_producer = '''
+class SchedulerCache:
+    def process(self):
+        for key, item in self.resync_queue.pop_ready():
+            self.retry(key, item)
+'''
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": unbounded_producer})
+    assert "VT018" in rule_ids(f)
+    one_hop = '''
+class SchedulerCache:
+    def process(self):
+        for key, item in self.resync_queue.pop_ready():
+            self._paced_retry(key, item)
+
+    def _paced_retry(self, key, item):
+        if self.budget.remaining() <= 0:
+            return
+        self.retry(key, item)
+'''
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": one_hop})
+    assert "VT018" not in rule_ids(f)
+
+
+def test_vt018_out_of_scope_ignored():
+    f, _ = findings_of({"volcano_tpu/cli/vcctl.py": VT018_TRIGGER})
+    assert "VT018" not in rule_ids(f)
+
+
+def test_vt018_rebreak_fast_admit_cap_strip():
+    """Re-broken regression: the REAL scheduler with fast_admit's
+    max_gangs cap stripped must fire VT018 (an unbounded between-cycles
+    walk of the arrival feed); the unmutated source must not."""
+    src = real_source("volcano_tpu/scheduler.py")
+    f, _ = findings_of({"volcano_tpu/scheduler.py": src})
+    assert "VT018" not in rule_ids(f)
+    broken = mutate(
+        src,
+        "                if gangs >= max_gangs:\n"
+        "                    # cap the between-cycles work; the full "
+        "cycle owns\n"
+        "                    # the rest (they stay in cache.jobs "
+        "regardless)\n"
+        "                    break\n",
+        "")
+    f, _ = findings_of({"volcano_tpu/scheduler.py": broken})
+    vt18 = [x for x in f if x.rule == "VT018"]
+    assert vt18, "stripping fast_admit's max_gangs cap went unseen"
+    assert any(x.symbol.endswith("fast_admit") for x in vt18)

@@ -50,7 +50,10 @@ def test_conf_hot_reload(tmp_path):
 def test_run_once_drains_resync_queue():
     cache, binder = build_cache()
     calls = []
-    cache.process_resync_tasks = lambda: calls.append(1) or 0
+    # the shell passes its per-cycle cap (None = unbounded, the
+    # no-budget default; docs/robustness.md overload failure model)
+    cache.process_resync_tasks = \
+        lambda max_items=None: calls.append(max_items) or 0
     sched = Scheduler(cache, schedule_period=0.01)
     sched.run_once()
     assert calls

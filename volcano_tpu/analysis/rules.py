@@ -1477,6 +1477,184 @@ class SpeculationIsolationRule(Rule):
         return findings
 
 
+# ---------------------------------------------------------------------------
+# VT018 — bounded per-cycle work (overload failure model)
+# ---------------------------------------------------------------------------
+
+class BoundedWorkRule(Rule):
+    """The cycle-budget companion contract (docs/robustness.md overload
+    failure model): a loop over a PENDING/BACKLOG collection in
+    scheduler-cycle scope is work that grows with the backlog — under
+    sustained overload an unguarded walk stretches the cycle, which
+    grows the backlog, which stretches the cycle. Every such loop must
+    consult a budget/limit witness within reach:
+
+    - a :class:`CycleBudget` check (``remaining``/``exhausted``/
+      ``charge``) in the function or one call-graph hop;
+    - a bounded slice of the iterable (``backlog[:max_items]``);
+    - a max-items guard (``if n >= max_gangs: break``) — any
+      break/return/continue gated on a ``budget``/``max``/``limit``/
+      ``cap`` name;
+    - a bound-named argument to the producing call
+      (``pop_ready(max_items)`` — the callee owns the cap).
+
+    Matched collections: dotted receivers naming
+    pending/backlog/dead_letter/resync/new_job/retry state, the
+    producer calls (``pop_ready``, ``drain_new_jobs``), and locals
+    TAINTED by assignment from either (including through
+    ``list``/``sorted`` wrappers and ``getattr(cache,
+    "drain_new_jobs")`` indirection). Bare locals that merely happen to
+    be named ``pending`` are not flagged — only provenance counts."""
+
+    id = "VT018"
+    name = "bounded-work"
+    contract = ("loop over a pending/backlog collection in scheduler-"
+                "cycle scope without a budget/limit witness "
+                "(CycleBudget, slice, or max-items guard) within "
+                "reach (docs/robustness.md overload failure model)")
+    scope = ("volcano_tpu/scheduler.py", "volcano_tpu/cache/cache.py",
+             "volcano_tpu/federation/rebalance.py")
+
+    import re as _re
+    COLLECTION_RE = _re.compile(
+        r"(pending|backlog|dead_letter|resync|new_job|retry_heap)")
+    PRODUCER_CALLS = {"pop_ready", "drain_new_jobs"}
+    BUDGET_WITNESS = {"remaining", "exhausted", "charge"}
+    BOUND_NAME_RE = _re.compile(r"(budget|max|limit|cap)", _re.I)
+
+    # -- collection matching -------------------------------------------------
+
+    def _attr_matches(self, node: ast.AST) -> bool:
+        """Dotted receivers only: ``self.dead_letter.items()`` matches,
+        a bare local coincidentally named ``pending`` does not."""
+        dn = dotted_name(node)
+        return bool(dn and "." in dn and self.COLLECTION_RE.search(dn))
+
+    def _call_matches(self, node: ast.Call, tainted: Set[str]) -> bool:
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else \
+            (f.id if isinstance(f, ast.Name) else "")
+        if fname in self.PRODUCER_CALLS:
+            return True
+        if isinstance(f, ast.Name):
+            if f.id in tainted:
+                return True
+            if f.id in ("list", "sorted", "tuple", "set"):
+                return any(self._expr_matches(a, tainted)
+                           for a in node.args)
+            if f.id == "getattr" and len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Constant) \
+                    and str(node.args[1].value) in self.PRODUCER_CALLS:
+                return True
+        if isinstance(f, ast.Attribute) and self._attr_matches(f.value):
+            return True                     # self.dead_letter.items()
+        return False
+
+    def _expr_matches(self, node: ast.AST, tainted: Set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Attribute):
+            return self._attr_matches(node)
+        if isinstance(node, ast.Call):
+            return self._call_matches(node, tainted)
+        if isinstance(node, ast.Subscript):
+            return self._expr_matches(node.value, tainted)
+        return False
+
+    def _taints(self, fn: FunctionInfo) -> Set[str]:
+        """Locals assigned (transitively, to a fixpoint) from matching
+        collections/producers."""
+        tainted: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not self._expr_matches(node.value, tainted):
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) \
+                            and tgt.id not in tainted:
+                        tainted.add(tgt.id)
+                        changed = True
+        return tainted
+
+    # -- witnesses -----------------------------------------------------------
+
+    def _mentions_bound_name(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) \
+                    and self.BOUND_NAME_RE.search(sub.id):
+                return True
+            if isinstance(sub, ast.Attribute) \
+                    and self.BOUND_NAME_RE.search(sub.attr):
+                return True
+            if isinstance(sub, ast.keyword) and sub.arg \
+                    and self.BOUND_NAME_RE.search(sub.arg):
+                return True
+        return False
+
+    def _iter_witnessed(self, it: ast.AST) -> bool:
+        """Witness ON the iterable itself: a bounded slice, or a
+        bound-named argument to the producing call (the callee owns
+        the cap — ``pop_ready(max_items)``)."""
+        if isinstance(it, ast.Subscript) \
+                and isinstance(it.slice, ast.Slice) \
+                and it.slice.upper is not None:
+            return True
+        if isinstance(it, ast.Call) \
+                and (any(self._mentions_bound_name(a) for a in it.args)
+                     or any(self._mentions_bound_name(k)
+                            for k in it.keywords)):
+            return True
+        return False
+
+    def _guarded_exit(self, fn: FunctionInfo) -> bool:
+        """A break/return/continue gated on a budget/max/limit/cap name
+        anywhere in the function — the max-items guard form."""
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.If):
+                continue
+            if not self._mentions_bound_name(node.test):
+                continue
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, (ast.Break, ast.Return,
+                                        ast.Continue)):
+                        return True
+        return False
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn in mod.functions:
+            tainted = self._taints(fn)
+            loops = [node for node in ast.walk(fn.node)
+                     if isinstance(node, ast.For)
+                     and self._expr_matches(node.iter, tainted)]
+            if not loops:
+                continue
+            if ctx.witness_in_scope(fn, self.BUDGET_WITNESS):
+                continue
+            if self._guarded_exit(fn):
+                continue
+            for node in loops:
+                if self._iter_witnessed(node.iter):
+                    continue
+                desc = dotted_name(node.iter) \
+                    or (ast.unparse(node.iter)
+                        if hasattr(ast, "unparse") else "<expr>")
+                findings.append(self.finding(
+                    mod, node,
+                    f"loop over pending/backlog collection ({desc}) in "
+                    f"{fn.qualname} without a budget/limit witness "
+                    f"(CycleBudget check, bounded slice, or max-items "
+                    f"guard) within reach; unbounded per-cycle work is "
+                    f"the overload collapse spiral "
+                    f"(docs/robustness.md overload failure model)"))
+        return findings
+
+
 ALL_RULES: List[Rule] = [
     DirtyWitnessRule(), RawClockRule(), UnseededRandomRule(),
     JournalFunnelRule(), SimKillSwallowRule(), ShapeBucketRule(),
@@ -1484,7 +1662,7 @@ ALL_RULES: List[Rule] = [
     HostSyncRule(), TracedBranchRule(), DataflowShapeBucketRule(),
     DtypeDisciplineRule(), SessionEscapeRule(),
     SpeculationIsolationRule(), StoreVerbFunnelRule(),
-    InflightLedgerRule(),
+    InflightLedgerRule(), BoundedWorkRule(),
 ]
 
 # the rules that run on the shared dataflow/callgraph engine
@@ -1552,6 +1730,10 @@ solver(state, idx)                     # truncates under x64-disabled''',
     self.binder.bind(task, task.node_name)   # no _register_inflight:
                                              # a lost kubelet ack wedges
                                              # this bind forever''',
+    "VT018": '''def drain(self):
+    for key, item in self.pending_work.items():   # no budget/limit
+        self.retry(key, item)                     # witness: unbounded
+                                                  # work per cycle''',
 }
 for _rule in ALL_RULES:
     _rule.example = _EXAMPLES.get(_rule.id, "")

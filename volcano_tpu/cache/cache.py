@@ -92,11 +92,19 @@ class RateLimitedQueue:
         with self._lock:
             self._failures.pop(key, None)
 
-    def pop_ready(self) -> List[Tuple[str, object]]:
+    def pop_ready(self, max_items: Optional[int] = None
+                  ) -> List[Tuple[str, object]]:
+        """Items whose backoff expired, oldest-deadline first.
+        ``max_items`` bounds the per-call work (the cycle-budget
+        contract, vlint VT018): items past the cap stay queued, already
+        ready, and drain on the next call — bounded work per cycle,
+        nothing dropped."""
         now = self.time_fn()
         out = []
         with self._lock:
             while self._heap and self._heap[0][0] <= now:
+                if max_items is not None and len(out) >= max_items:
+                    break
                 _, _, key, item = heapq.heappop(self._heap)
                 out.append((key, item))
         return out
@@ -111,6 +119,21 @@ class RateLimitedQueue:
 # the budget spans ~20s of exponential backoff, past any transient
 # apiserver hiccup the resync queue is meant to absorb.
 DEFAULT_RESYNC_MAX_RETRIES = 12
+
+
+def _dead_letter_max() -> int:
+    """Cap on the dead-letter set (docs/robustness.md overload failure
+    model): under pathological job churn every distinct failing job
+    parks one entry, so the set grows with distinct-job cardinality
+    unless bounded. Past the cap the OLDEST entry is evicted (counted in
+    volcano_dead_letter_evicted_total and warned about in
+    /healthz?detail) — an eviction means redrive can no longer recover
+    that side effect, which is the honest signal at that point: the
+    failure plane is outgrowing the parking lot. <=0 disables the cap."""
+    try:
+        return int(os.environ.get("VOLCANO_TPU_DEAD_LETTER_MAX", 4096))
+    except ValueError:
+        return 4096
 
 
 class SchedulerCache:
@@ -148,6 +171,11 @@ class SchedulerCache:
         # definition of the budget); ops inspect it and redrive_dead_letter
         # re-queues after the underlying fault is fixed.
         self.dead_letter: Dict[str, Tuple[str, TaskInfo]] = {}
+        # bounded (insertion-ordered dict; oldest evicted past the cap —
+        # see _dead_letter_max): churn cannot pin unbounded TaskInfo
+        # graphs through the dead-letter parking lot
+        self.dead_letter_max = _dead_letter_max()
+        self.dead_letter_evicted = 0
         # write-ahead intent journal (cache/journal.py): bind/evict/resync
         # funnels record intents before their executor call and acks after,
         # so a crash window is replayable at restart (reconcile_journal).
@@ -1277,12 +1305,31 @@ class SchedulerCache:
     def _resync_or_dead_letter(self, key: str, op: str,
                                task: TaskInfo) -> None:
         if not self.resync_queue.add_rate_limited(key, (op, task)):
+            evicted = 0
             with self._lock:
                 fresh = key not in self.dead_letter
+                # re-parking an existing key refreshes its age (it is
+                # the set's newest failure again)
+                self.dead_letter.pop(key, None)
                 self.dead_letter[key] = (op, task)
+                while 0 < self.dead_letter_max < len(self.dead_letter):
+                    # evict the OLDEST parked entry (insertion order):
+                    # bounded memory beats a silent unbounded pin — the
+                    # eviction is counted and warned about
+                    oldest = next(iter(self.dead_letter))
+                    self.dead_letter.pop(oldest)
+                    self.resync_queue.forget(oldest)
+                    self.dead_letter_evicted += 1
+                    evicted += 1
                 size = len(self.dead_letter)
             from .. import metrics
             metrics.set_dead_letter_size(size)
+            if evicted:
+                metrics.register_dead_letter_evicted(evicted)
+                log.error("dead-letter set overflowed its cap (%d): "
+                          "evicted %d oldest side effect(s) — redrive "
+                          "cannot recover them", self.dead_letter_max,
+                          evicted)
             if fresh:
                 # count logical events, not cycles: a PENDING-rolled-back
                 # task re-placed every cycle keeps hitting the refused
@@ -1310,6 +1357,9 @@ class SchedulerCache:
             items = list(self.dead_letter.items())
             self.dead_letter.clear()
         moved = 0
+        # the walk is operator-invoked (not cycle work) and the set
+        # evicts its oldest past the dead_letter_max cap
+        # vlint: disable=VT018 -- operator redrive, bounded by the cap
         for key, (op, task) in items:
             self.resync_queue.forget(key)
             if self.resync_queue.add_rate_limited(key, (op, task)):
@@ -1370,13 +1420,15 @@ class SchedulerCache:
             return (task.init_resreq.less_equal(node.idle)
                     and task.init_resreq.less_equal(node.future_idle()))
 
-    def process_resync_tasks(self) -> int:
+    def process_resync_tasks(self, max_items: Optional[int] = None) -> int:
         """Retry side effects whose backoff expired (processResyncTask,
         cache.go:781-799) — the scheduler shell calls this every cycle.
         Returns the number of successful retries. Stale entries (see
-        _resync_stale) are dropped, not retried."""
+        _resync_stale) are dropped, not retried. ``max_items`` bounds
+        the per-cycle retry work (the cycle-budget contract, vlint
+        VT018); capped-out items stay queued and drain next cycle."""
         done = 0
-        for key, (op, task) in self.resync_queue.pop_ready():
+        for key, (op, task) in self.resync_queue.pop_ready(max_items):
             if op == "pg_status":
                 # a parked podgroup status flush (the item is the
                 # JobInfo): re-flush the job's LATEST status — the

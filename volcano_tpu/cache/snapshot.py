@@ -287,7 +287,15 @@ class PersistentNodeTensors:
     def _ensure_device(self) -> dict:
         if self._device is None:
             import jax.numpy as jnp
-            self._device = {f: jnp.asarray(getattr(self, f))
+            # jnp.array, NOT jnp.asarray: on the CPU backend asarray
+            # may ZERO-COPY a 64-byte-aligned numpy buffer, silently
+            # aliasing the "immutable" device array onto the host
+            # mirror this class mutates in place every refresh — a
+            # pinned TensorEpochView then reads post-pin state, and
+            # whether it happens depends on where the allocator put
+            # the mirror (an alignment-dependent flake). The forced
+            # copy is one host memcpy per cold upload.
+            self._device = {f: jnp.array(getattr(self, f))
                             for f in self._ROW_FIELDS}
             self._node_state = None
         return self._device

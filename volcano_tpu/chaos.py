@@ -242,6 +242,63 @@ class AckFaultInjector:
         return kind
 
 
+class OverloadInjector:
+    """Seeded arrival-burst generator for the admission-overload drills
+    (docs/robustness.md overload failure model). Each ``tick()`` (one
+    virtual cycle) rolls ONE seeded coin; a hit yields a burst of
+    ``burst_range`` synthetic jobs on top of whatever the trace already
+    delivers — the flash-crowd the backpressure budget must shed and
+    the cycle budget must survive. ``job_spec(n_queues)`` draws one
+    burst job's shape (queue index, priority, gang size, resources,
+    duration) from the same seeded RNG, so a whole overload soak is a
+    pure function of its seed and replays byte-identically.
+
+    One ``random.Random(seed)`` per injector — a failing soak
+    reproduces from its printed seed, like every other chaos harness
+    here."""
+
+    def __init__(self, burst_rate: float = 0.15,
+                 burst_range: Tuple[int, int] = (8, 32), seed: int = 0,
+                 priorities: Iterable[int] = (0, 0, 0, 5, 10),
+                 cpu_choices: Iterable[int] = (500, 1000),
+                 duration_range: Tuple[float, float] = (2.0, 6.0)):
+        if not 0.0 <= burst_rate <= 1.0:
+            raise ValueError(f"burst_rate {burst_rate} not in [0, 1]")
+        self.burst_rate = burst_rate
+        self.burst_range = tuple(burst_range)
+        self.seed = seed
+        self.priorities = tuple(priorities)
+        self.cpu_choices = tuple(cpu_choices)
+        self.duration_range = tuple(duration_range)
+        self._rng = random.Random(seed)
+        self.ticks = 0
+        self.injected = 0
+        self.bursts: List[Tuple[int, int]] = []   # (tick, size)
+
+    def tick(self) -> int:
+        """One cycle: 0 (no burst) or the seeded burst size."""
+        self.ticks += 1
+        if self._rng.random() >= self.burst_rate:
+            return 0
+        lo, hi = self.burst_range
+        size = self._rng.randint(int(lo), int(hi))
+        self.bursts.append((self.ticks, size))
+        self.injected += size
+        return size
+
+    def job_spec(self, n_queues: int) -> Dict[str, object]:
+        """One burst job's seeded shape; the caller names it and routes
+        it through the admission front door like any client POST."""
+        lo, hi = self.duration_range
+        return {
+            "queue_ix": self._rng.randrange(max(int(n_queues), 1)),
+            "priority": self._rng.choice(self.priorities),
+            "tasks": self._rng.choice((1, 1, 2)),
+            "cpu_milli": self._rng.choice(self.cpu_choices),
+            "duration": round(self._rng.uniform(lo, hi), 3),
+        }
+
+
 class DeviceFaultInjector:
     """Simulate XLA device errors (OOM / device-lost) at the allocate
     solve boundary — install as ``actions.allocate.DEVICE_FAULT_HOOK``.

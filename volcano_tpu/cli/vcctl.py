@@ -219,6 +219,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="probe exactly N partitions; 0 discovers "
                          "contiguously from p0 until the first missing "
                          "lease")
+    fed.add_parser(
+        "rebalance-status",
+        description="The load-driven rebalancer's per-partition state "
+                    "(docs/federation.md): executed moves, abstentions, "
+                    "flap-blocked queues and thresholds — read from the "
+                    "process-local metrics detail, like the flight-"
+                    "recorder verbs")
 
     st = sub.add_parser(
         "store", description="Store-boundary verbs (docs/robustness.md "
@@ -306,6 +313,27 @@ def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
             out(f"{detail['open']} in flight; "
                 f"oldest {detail['oldest_age_s']:.1f}s; "
                 f"resolved: {res or '-'}")
+        return 0
+    if args.group == "federation" and args.verb == "rebalance-status":
+        # process-local (metrics detail), like the trace verbs — the
+        # rebalancer lives in the scheduler process, not the store
+        import json
+        from .. import metrics
+        detail = metrics.health_detail().get("overload", {}) \
+            .get("rebalance", {})
+        if not detail:
+            out("no rebalancer state recorded — the load-driven "
+                "rebalancer is not enabled (or this process runs no "
+                "partition leader)")
+            return 1
+        for pid in sorted(detail, key=int):
+            d = detail[pid]
+            out(f"p{pid}\tmoves={d.get('moves', 0)}\t"
+                f"abstentions={d.get('abstentions', 0)}\t"
+                f"refused={d.get('refused', 0)}\t"
+                f"blocked={sorted(d.get('blocked_queues', {}))}")
+            if d.get("last_move"):
+                out(f"p{pid}\tlast_move={json.dumps(d['last_move'], sort_keys=True)}")
         return 0
     if store is None:
         out("no cluster store attached (in-process CLI requires a store)")

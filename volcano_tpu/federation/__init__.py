@@ -17,7 +17,12 @@ else is partition-local and needs no coordination at all.
   strand capacity);
 - :class:`PartitionMember` — the per-partition glue the scheduler
   shell's cycle hooks drive (review incoming reserves at the cycle
-  boundary, detect starvation, publish health).
+  boundary, detect starvation, publish health);
+- :class:`RebalanceController` — load-driven queue rebalancing
+  (closes the ROADMAP item 5 remainder): published load signals feed a
+  deterministic greedy bin-balancer with hysteresis and a flap guard,
+  executing through the SAME journaled move_queue/settle_moves funnel
+  operators use.
 
 ``sim --federated N`` (volcano_tpu/sim) proves the protocol: partition
 kills mid-trace, zero cross-partition double-binds, aggregate
@@ -27,11 +32,12 @@ non-contended traces.
 
 from .member import PartitionMember
 from .partition import PartitionMap
+from .rebalance import RebalanceController
 from .reserve import ReserveLedger
 from .store_backed import (StoreBackedPartitionMap,
                            StoreBackedReserveLedger,
                            StorePartitionBackend)
 
-__all__ = ["PartitionMap", "PartitionMember", "ReserveLedger",
-           "StoreBackedPartitionMap", "StoreBackedReserveLedger",
-           "StorePartitionBackend"]
+__all__ = ["PartitionMap", "PartitionMember", "RebalanceController",
+           "ReserveLedger", "StoreBackedPartitionMap",
+           "StoreBackedReserveLedger", "StorePartitionBackend"]

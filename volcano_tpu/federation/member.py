@@ -38,7 +38,8 @@ class PartitionMember:
     def __init__(self, pid: int, pmap: PartitionMap, ledger: ReserveLedger,
                  cache, epoch_fn: Callable[[], int],
                  time_fn: Callable[[], float] = time.monotonic,
-                 starve_after_s: float = DEFAULT_STARVE_AFTER_S):
+                 starve_after_s: float = DEFAULT_STARVE_AFTER_S,
+                 rebalancer=None):
         self.pid = pid
         self.pmap = pmap
         self.ledger = ledger
@@ -47,6 +48,11 @@ class PartitionMember:
         self.time_fn = time_fn
         self.starve_after_s = starve_after_s
         self.requests_filed = 0
+        # load-driven rebalancing (federation/rebalance.py): when a
+        # RebalanceController rides this member, on_cycle_end publishes
+        # load signals and may move ONE owned queue through the
+        # journaled move funnel. None = the PR 9 operator-only behavior.
+        self.rebalancer = rebalancer
         ledger.attach_cache(pid, cache)
 
     # -- cycle hooks (leader-gated by the scheduler shell) -------------------
@@ -78,6 +84,16 @@ class PartitionMember:
         now = self.time_fn()
         idle_cpu, idle_mem = self._owned_idle()
         self.ledger.publish_idle(self.pid, idle_cpu, idle_mem)
+        if self.rebalancer is not None:
+            # publish this partition's load signals and (hysteresis +
+            # flap guard permitting) move at most one owned queue
+            # through the journaled move_queue/settle_moves funnel —
+            # isolated: a rebalancer fault must not cost the cycle
+            try:
+                self.rebalancer.step(now)
+            except Exception:
+                log.exception("rebalancer step failed; next cycle "
+                              "re-evaluates")
         metrics.set_partition_leader(self.pid, True, self.epoch_fn(),
                                     detail=self.detail())
         starved = self._starved_need(now, idle_cpu, idle_mem)
@@ -146,7 +162,7 @@ class PartitionMember:
 
     def detail(self) -> dict:
         counts = self.pmap.counts().get(self.pid, {})
-        return {
+        out = {
             "partition": self.pid,
             "epoch": self.epoch_fn(),
             "queues": counts.get("queues", 0),
@@ -154,3 +170,6 @@ class PartitionMember:
             "requests_filed": self.requests_filed,
             "map_version": self.pmap.version,
         }
+        if self.rebalancer is not None:
+            out["rebalance_moves"] = len(self.rebalancer.moves)
+        return out

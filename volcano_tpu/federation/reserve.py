@@ -122,6 +122,20 @@ class ReserveLedger:
         # requester's donor choice reads LAST cycle's published values,
         # never another partition's live cache
         self._idle: Dict[int, tuple] = {}
+        # pid -> load-signal dict (pending depth, budget-exhaustion
+        # rate, per-queue depths) published at each cycle end — the
+        # load-driven rebalancer's only cross-partition input
+        # (docs/federation.md): decisions read LAST cycle's published
+        # signals, never another partition's live cache
+        self._load: Dict[int, dict] = {}
+        # pid -> LOCAL receipt time of the last load-signal CHANGE.
+        # Freshness must be judged on the READER's clock: the published
+        # dict carries the publisher's own timestamp, and monotonic
+        # epochs are not comparable across processes/hosts (the
+        # store-backed deployment). An entry whose value stops changing
+        # stops refreshing its receipt — a dead publisher goes stale no
+        # matter what its last self-stamp claims.
+        self._load_seen: Dict[int, float] = {}
 
     # -- wiring --------------------------------------------------------------
 
@@ -134,6 +148,34 @@ class ReserveLedger:
     def publish_idle(self, pid: int, cpu: float, mem: float) -> None:
         with self._lock:
             self._idle[pid] = (float(cpu), float(mem))
+
+    def publish_load(self, pid: int, load: dict) -> None:
+        """Publish a partition's load signals for the rebalancer
+        (federation/rebalance.py); in-process the ledger IS the shared
+        board, the store-backed subclass persists to the PartitionState
+        CR."""
+        with self._lock:
+            self._apply_load_locked(pid, dict(load))
+
+    def _apply_load_locked(self, pid: int, load: dict) -> None:
+        """Caller holds self._lock: store a load signal and stamp its
+        LOCAL receipt time iff the value changed (re-applying an
+        unchanged entry — every CR watch echo re-delivers the whole
+        state — must not keep a dead publisher looking fresh)."""
+        if self._load.get(pid) != load:
+            self._load_seen[pid] = self.time_fn()
+        self._load[pid] = load
+
+    def loads(self) -> Dict[int, dict]:
+        """Every partition's last-published load signals (copies)."""
+        with self._lock:
+            return {pid: dict(d) for pid, d in self._load.items()}
+
+    def load_seen(self, pid: int) -> Optional[float]:
+        """LOCAL receipt time of ``pid``'s last load-signal change (the
+        rebalancer's freshness witness), or None if never seen."""
+        with self._lock:
+            return self._load_seen.get(pid)
 
     def _count(self, result: str, n: int = 1) -> None:
         """Caller holds self._lock."""

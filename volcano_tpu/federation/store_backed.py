@@ -327,6 +327,17 @@ class StoreBackedReserveLedger(ReserveLedger):
 
         self.backend.mutate(put)
 
+    def publish_load(self, pid: int, load: dict) -> None:
+        """The rebalancer's load signals persist to the PartitionState
+        CR next to idle — other partitions' rebalancers read them off
+        their own CR mirrors (docs/federation.md)."""
+        super().publish_load(pid, load)
+
+        def put(state: dict) -> None:
+            state.setdefault("load", {})[pid] = dict(load)
+
+        self.backend.mutate(put)
+
     # -- mirror application --------------------------------------------------
 
     def _apply_state(self, state: dict) -> None:
@@ -334,6 +345,11 @@ class StoreBackedReserveLedger(ReserveLedger):
         with self._lock:
             for pid, pair in state.get("idle", {}).items():
                 self._idle[int(pid)] = (float(pair[0]), float(pair[1]))
+            for pid, load in state.get("load", {}).items():
+                # change-detected receipt stamping (_apply_load_locked):
+                # a watch echo re-delivering an unchanged entry must not
+                # refresh a dead publisher's freshness
+                self._apply_load_locked(int(pid), dict(load))
             for rid, d in reqs.items():
                 rid = int(rid)
                 req = self.requests.get(rid)

@@ -215,6 +215,41 @@ if _HAVE_PROM:
         "Cluster acks consumed through the FeedbackChannel normalizer "
         "by verdict (docs/robustness.md feedback failure model)",
         ["kind", "verdict"])
+    _budget_exhausted = Counter(
+        f"{_SUBSYSTEM}_cycle_budget_exhausted_total",
+        "Cycles whose deadline budget ran out before the labelled "
+        "action could dispatch (it deferred to the next cycle; "
+        "docs/robustness.md overload failure model)", ["action"])
+    _deferred_actions = Counter(
+        f"{_SUBSYSTEM}_deferred_actions_total",
+        "Actions deferred to the next cycle by the cycle deadline "
+        "budget (carry-over ordering; docs/robustness.md)")
+    _backpressure = Counter(
+        f"{_SUBSYSTEM}_admission_backpressure_total",
+        "Submissions refused by the admission front door's bounded "
+        "pending-work budget (reason=queue_depth|bytes|priority_shed)",
+        ["reason"])
+    _admission_depth = Gauge(
+        f"{_SUBSYSTEM}_admission_pending_depth",
+        "Pending tasks currently charged against the admission "
+        "backpressure budget")
+    _admission_bytes = Gauge(
+        f"{_SUBSYSTEM}_admission_pending_bytes",
+        "Estimated bytes of pending work charged against the admission "
+        "backpressure budget")
+    _dl_evicted = Counter(
+        f"{_SUBSYSTEM}_dead_letter_evicted_total",
+        "Oldest dead-letter entries evicted to keep the set bounded "
+        "under pathological churn (docs/robustness.md)")
+    _audit_evicted = Counter(
+        f"{_SUBSYSTEM}_audit_latest_evicted_total",
+        "Oldest per-job audit records evicted to keep the decision "
+        "audit's live-job map bounded (docs/observability.md)")
+    _rebalance_moves = Counter(
+        f"{_SUBSYSTEM}_rebalance_moves_total",
+        "Load-driven partition rebalancer decisions "
+        "(result=moved|refused|abstained; docs/federation.md)",
+        ["result"])
 
 
 def update_e2e_duration(seconds: float) -> None:
@@ -297,7 +332,44 @@ def health_detail() -> dict:
             "inflight_expired_total": {
                 "/".join(k[1:]): v for k, v in _counters.items()
                 if k[0] == "inflight_expired"},
+            # the overload plane (docs/robustness.md overload failure
+            # model): cycle-budget exhaustion, admission backpressure,
+            # bounded-set evictions (each eviction is a WARNING: state
+            # was dropped to stay bounded) and the rebalancer state
+            "overload": _overload_detail_locked(),
         }
+
+
+def _overload_detail_locked() -> dict:
+    """Caller holds _lock: the /healthz?detail overload section."""
+    exhausted = {k[1]: v for k, v in _counters.items()
+                 if k[0] == "cycle_budget_exhausted"}
+    dl_evicted = int(_counters.get(("dead_letter_evicted",), 0))
+    audit_evicted = int(_counters.get(("audit_latest_evicted",), 0))
+    warnings = []
+    if dl_evicted:
+        warnings.append(
+            f"dead_letter_evicted={dl_evicted}: the bounded dead-letter "
+            f"set overflowed and dropped its oldest side effects — "
+            f"redrive cannot recover them; investigate the failing path")
+    if audit_evicted:
+        warnings.append(
+            f"audit_latest_evicted={audit_evicted}: decision-audit "
+            f"records were evicted under job-churn pressure; why() may "
+            f"miss old jobs")
+    return {
+        "cycle_budget_exhausted_total": exhausted,
+        "deferred_actions_total": int(
+            _counters.get(("deferred_actions",), 0)),
+        "backpressure_total": {k[1]: v for k, v in _counters.items()
+                               if k[0] == "admission_backpressure"},
+        "admission_pending_depth": int(
+            _gauges.get(("admission_pending_depth",), 0)),
+        "dead_letter_evicted_total": dl_evicted,
+        "audit_latest_evicted_total": audit_evicted,
+        "rebalance": dict(_health_detail.get("rebalance", {})),
+        "warnings": warnings,
+    }
 
 
 def register_store_retry(verb: str, result: str) -> None:
@@ -634,6 +706,82 @@ def register_dead_letter(op: str) -> None:
         _dead_letter.labels(op=op).inc()
 
 
+def register_cycle_budget_exhausted(action: str) -> None:
+    """A cycle's deadline budget ran out before ``action`` could
+    dispatch; it (and the rest of the pipeline) deferred to the next
+    cycle with carry-over ordering (docs/robustness.md overload
+    failure model)."""
+    with _lock:
+        _counters[("cycle_budget_exhausted", action)] += 1
+    if _HAVE_PROM:
+        _budget_exhausted.labels(action=action).inc()
+
+
+def register_deferred_actions(n: int) -> None:
+    with _lock:
+        _counters[("deferred_actions",)] += n
+    if _HAVE_PROM:
+        _deferred_actions.inc(n)
+
+
+def register_backpressure(reason: str, n: int = 1) -> None:
+    """The admission front door refused work under its bounded
+    pending-work budget (reason=queue_depth|bytes|priority_shed) —
+    volcano_admission_backpressure_total{reason}."""
+    with _lock:
+        _counters[("admission_backpressure", reason)] += n
+    if _HAVE_PROM:
+        _backpressure.labels(reason=reason).inc(n)
+
+
+def set_admission_pending(depth: int, nbytes: float) -> None:
+    """Published by the admission budget on every charge/credit: how
+    much accepted-but-unscheduled work the front door is carrying."""
+    with _lock:
+        _gauges[("admission_pending_depth",)] = float(depth)
+        _gauges[("admission_pending_bytes",)] = float(nbytes)
+    if _HAVE_PROM:
+        _admission_depth.set(depth)
+        _admission_bytes.set(float(nbytes))
+
+
+def register_dead_letter_evicted(n: int = 1) -> None:
+    """The bounded dead-letter set evicted its oldest entries to stay
+    under its cap — operator signal that the backlog of permanently
+    failing side effects is outgrowing what redrive can recover."""
+    with _lock:
+        _counters[("dead_letter_evicted",)] += n
+    if _HAVE_PROM:
+        _dl_evicted.inc(n)
+
+
+def register_audit_evicted(n: int = 1) -> None:
+    """The decision audit's per-live-job map evicted its oldest records
+    to stay bounded under pathological job-churn cardinality."""
+    with _lock:
+        _counters[("audit_latest_evicted",)] += n
+    if _HAVE_PROM:
+        _audit_evicted.inc(n)
+
+
+def register_rebalance_move(result: str) -> None:
+    """One load-driven rebalancer decision settled
+    (result=moved|refused|abstained; docs/federation.md)."""
+    with _lock:
+        _counters[("rebalance_moves", result)] += 1
+    if _HAVE_PROM:
+        _rebalance_moves.labels(result=result).inc()
+
+
+def set_rebalance_detail(partition: int, detail: dict) -> None:
+    """Publish one partition's rebalancer state for /healthz?detail and
+    ``vcctl federation rebalance-status`` (process-local, like the
+    flight-recorder verbs)."""
+    with _lock:
+        _health_detail.setdefault("rebalance", {})[str(partition)] = \
+            dict(detail)
+
+
 # In-process mirror key -> Prometheus family for the no-prometheus_client
 # /metrics fallback: first tuple element maps to (family name, label name,
 # type). Keys absent here expose as volcano_<key0> gauges with a generic
@@ -656,6 +804,10 @@ _EXPO_GAUGES = {
     "store_watch_staleness": (f"{_SUBSYSTEM}_store_watch_staleness", None),
     "inflight_open": (f"{_SUBSYSTEM}_inflight_open", None),
     "inflight_oldest_seconds": (f"{_SUBSYSTEM}_inflight_oldest_seconds",
+                                None),
+    "admission_pending_depth": (f"{_SUBSYSTEM}_admission_pending_depth",
+                                None),
+    "admission_pending_bytes": (f"{_SUBSYSTEM}_admission_pending_bytes",
                                 None),
 }
 _EXPO_COUNTERS = {
@@ -692,6 +844,16 @@ _EXPO_COUNTERS = {
     "ack_faults": (f"{_SUBSYSTEM}_ack_faults_total", "kind"),
     "feedback_acks": (f"{_SUBSYSTEM}_feedback_acks_total",
                       ("kind", "verdict")),
+    "cycle_budget_exhausted": (
+        f"{_SUBSYSTEM}_cycle_budget_exhausted_total", "action"),
+    "deferred_actions": (f"{_SUBSYSTEM}_deferred_actions_total", None),
+    "admission_backpressure": (
+        f"{_SUBSYSTEM}_admission_backpressure_total", "reason"),
+    "dead_letter_evicted": (f"{_SUBSYSTEM}_dead_letter_evicted_total",
+                            None),
+    "audit_latest_evicted": (f"{_SUBSYSTEM}_audit_latest_evicted_total",
+                             None),
+    "rebalance_moves": (f"{_SUBSYSTEM}_rebalance_moves_total", "result"),
 }
 # duration-series key -> (family, label name, unit suffix already in name)
 _EXPO_DURATIONS = {

@@ -45,6 +45,20 @@ def _default_cycles() -> int:
         return 32
 
 
+def _default_jobs() -> int:
+    """Cap on the per-LIVE-job record map (docs/robustness.md overload
+    failure model): live-set pruning bounds ``_latest`` by the number of
+    live jobs, which under pathological churn/overload is itself
+    unbounded. Past the cap the LEAST-RECENTLY-UPDATED record is evicted
+    (volcano_audit_latest_evicted_total; /healthz?detail warns) — a
+    why() miss on a stale job beats unbounded audit memory. <=0
+    disables the cap."""
+    try:
+        return int(os.environ.get("VOLCANO_TPU_AUDIT_JOBS", 8192))
+    except ValueError:
+        return 8192
+
+
 class AuditLog:
     """Memory contract: ``_latest`` holds at most ONE record per LIVE job
     (pruned against the live-job set every harvest), and the cycle ring
@@ -52,17 +66,24 @@ class AuditLog:
     from the job's previous state). A steady 10k-gang pending backlog
     therefore costs 10k records once, not 10k per retained cycle."""
 
-    def __init__(self, max_cycles: Optional[int] = None):
+    def __init__(self, max_cycles: Optional[int] = None,
+                 max_jobs: Optional[int] = None):
         if max_cycles is None:
             max_cycles = _default_cycles()
         max_cycles = max(0, max_cycles)      # negative == disabled
         self._lock = threading.Lock()
         self.max_cycles = max_cycles
+        # bounded (see _default_jobs): the live-set prune alone grows
+        # with live-job cardinality under churn/overload
+        self.max_jobs = _default_jobs() if max_jobs is None else max_jobs
+        self.jobs_evicted = 0
         # ring of (cycle, t, {job: [changed record, ...]})
         self._cycles: collections.deque = collections.deque(
             maxlen=max_cycles or 1)
-        # job -> its newest record (the current decision state)
-        self._latest: Dict[str, dict] = {}
+        # job -> its newest record (the current decision state); ordered
+        # by last update so the bound evicts least-recently-updated
+        self._latest: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
         self.enabled = max_cycles > 0
 
     def clear(self) -> None:
@@ -99,6 +120,7 @@ class AuditLog:
                 if prev is None or (last["verdict"], last["reason"]) \
                         != (prev["verdict"], prev["reason"]):
                     self._latest[job] = last
+                    self._latest.move_to_end(job)
                 if new:
                     changed[job] = new
             if changed:
@@ -107,6 +129,18 @@ class AuditLog:
                 for job in [j for j in self._latest
                             if j not in live_jobs]:
                     del self._latest[job]
+            evicted = 0
+            while 0 < self.max_jobs < len(self._latest):
+                # bound against pathological live-job cardinality
+                # (overload/churn): drop the least-recently-updated
+                # record — its job's state hasn't changed in the
+                # longest, so it is the cheapest why() answer to lose
+                self._latest.popitem(last=False)
+                self.jobs_evicted += 1
+                evicted += 1
+        if evicted:
+            from .. import metrics
+            metrics.register_audit_evicted(evicted)
 
     # -- query --------------------------------------------------------------
 

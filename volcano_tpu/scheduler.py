@@ -87,6 +87,11 @@ DEFAULT_BACKOFF_BASE = 1.0
 DEFAULT_BACKOFF_MAX = 60.0
 DEFAULT_BACKOFF_JITTER = 0.2
 
+# per-cycle resync retry cap when a cycle budget is configured
+# (docs/robustness.md overload failure model): the resync pass runs
+# before the budget exists, so it carries its own work bound
+DEFAULT_RESYNC_MAX_PER_CYCLE = 256
+
 # Shadow-verifier cadence (docs/robustness.md): every N cycles the cache
 # re-derives snapshot/tensor state from scratch OFF-CYCLE (outside the
 # e2e-timed window) and repairs any drift. 0 disables; the env var
@@ -151,7 +156,11 @@ class Scheduler:
                  drift_verify_every: Optional[int] = None,
                  rng: Optional[random.Random] = None,
                  pipelined: Optional[bool] = None,
-                 fast_admit: Optional[bool] = None):
+                 fast_admit: Optional[bool] = None,
+                 cycle_budget_s: Optional[float] = None,
+                 budget_cost_fn: Optional[Callable] = None,
+                 solve_deadline_s: Optional[float] = None,
+                 resync_max_per_cycle: Optional[int] = None):
         # actions/plugins register on import
         from . import actions as _actions  # noqa: F401
         from . import plugins as _plugins  # noqa: F401
@@ -237,6 +246,42 @@ class Scheduler:
                 and hasattr(self.cache, "fast_admit_feed"):
             self.cache.fast_admit_feed = True
         self._fast_admit_audit: list = []
+        # overload resilience (docs/robustness.md overload failure
+        # model): a per-cycle work bound. None (default) = unbounded —
+        # the historical behavior, byte-identical decision plane. With a
+        # budget set, every action checks the remaining budget before
+        # dispatch; exhausted cycles defer the remaining actions to the
+        # next cycle with carry-over ordering (_carryover is the
+        # round-robin cursor: the first deferred action name, persisted
+        # across cycles so no action starves behind an expensive one).
+        self.cycle_budget_s = cycle_budget_s
+        # deterministic work model for the budget (the sim prices
+        # actions by backlog size so exhaustion replays byte-identically;
+        # production leaves this None and spends wall time)
+        self.budget_cost_fn = budget_cost_fn
+        # hard deadline for the allocate slot: a device solve slower
+        # than this is treated as a device fault — the device_health
+        # cool-down opens and allocate degrades to the CPU placer for
+        # the window (a hung/thrashing accelerator must not stall the
+        # control plane; docs/robustness.md)
+        self.solve_deadline_s = solve_deadline_s
+        # the resync walk's per-cycle cap (cache.process_resync_tasks
+        # max_items; vlint VT018): capped-out retries stay queued,
+        # already ready, and drain next cycle. Defaults to bounded
+        # whenever a cycle budget is set — the resync pass runs BEFORE
+        # the budget is constructed, so this is its work bound — and
+        # unbounded otherwise (the historical, byte-identical behavior).
+        self.resync_max_per_cycle = resync_max_per_cycle \
+            if resync_max_per_cycle is not None \
+            else (DEFAULT_RESYNC_MAX_PER_CYCLE if cycle_budget_s
+                  else None)
+        self._carryover: Optional[str] = None
+        self.last_budget = None
+        self.budget_exhausted_total = 0
+        self.deferred_actions_total = 0
+        # high-water per-cycle spend (the overload soak's "p99 within
+        # 2x budget" witness reads this off the report)
+        self.max_cycle_spend_s = 0.0
         # warm-start witness (docs/performance.md): did the LAST cycle's
         # allocate fixpoint converge at the empty admitted set? Tracked
         # here per cycle — the module-global LAST_STATS is overwritten by
@@ -392,7 +437,8 @@ class Scheduler:
         if hasattr(self.cache, "process_resync_tasks"):
             try:
                 with rec.span("resync"):
-                    self.cache.process_resync_tasks()
+                    self.cache.process_resync_tasks(
+                        self.resync_max_per_cycle)
             except Exception as exc:
                 log.exception("resync processing failed")
                 metrics.register_action_failure("resync")
@@ -426,6 +472,24 @@ class Scheduler:
             self._discard_speculation("conflict")
             self._cycle_epilogue()
             return errors
+        # cycle deadline budget (docs/robustness.md overload failure
+        # model): rotate the pipeline to the carry-over cursor BEFORE
+        # anything runs — last cycle's deferred actions go first, so
+        # every action gets budget within at most a pipeline-length of
+        # cycles (fair round-robin; no queue starves behind an
+        # expensive neighbor). No budget -> no rotation -> the
+        # historical, byte-identical order.
+        budget = None
+        if self.cycle_budget_s:
+            from .cycle_budget import CycleBudget
+            budget = CycleBudget(self.cycle_budget_s, self.clock.time)
+            self.last_budget = budget
+            if self._carryover is not None:
+                names = [n for n, _ in runnable]
+                if self._carryover in names:
+                    ix = names.index(self._carryover)
+                    runnable = runnable[ix:] + runnable[:ix]
+                self._carryover = None
         # pipelined commit boundary (docs/performance.md): decide what the
         # in-flight speculation is worth BEFORE opening anything — a full
         # hit promotes the speculative session (the staged snapshot is
@@ -461,7 +525,26 @@ class Scheduler:
                 ssn.audit_events.extend(self._fast_admit_audit)
                 self._fast_admit_audit.clear()
             try:
-                for name, action in runnable:
+                for act_ix, (name, action) in enumerate(runnable):
+                    if budget is not None and act_ix > 0 \
+                            and budget.exhausted():
+                        # budget spent: defer the REST of the pipeline
+                        # to the next cycle (the first action of a cycle
+                        # always runs — a budget can bound work, never
+                        # starve the pipeline outright). The cursor
+                        # persists the deferral so the deferred actions
+                        # run FIRST next cycle.
+                        deferred = [n for n, _ in runnable[act_ix:]]
+                        self._carryover = name
+                        self.budget_exhausted_total += 1
+                        self.deferred_actions_total += len(deferred)
+                        metrics.register_cycle_budget_exhausted(name)
+                        metrics.register_deferred_actions(len(deferred))
+                        log.warning(
+                            "cycle budget exhausted (%.3fs spent of "
+                            "%.3fs); deferring %s to the next cycle",
+                            budget.spent(), budget.budget_s, deferred)
+                        break
                     if self._demoted_mid_cycle():
                         # the lease was lost while the cycle ran: stop
                         # scheduling NOW. Already-executed side effects
@@ -504,6 +587,33 @@ class Scheduler:
                     finally:
                         metrics.update_action_duration(name,
                                                        action_sp.dur_s)
+                    if budget is not None \
+                            and self.budget_cost_fn is not None:
+                        # deterministic work model (the sim's meter):
+                        # price the action by what it processed so
+                        # exhaustion is a pure function of the decision
+                        # plane — a broken cost model must not break
+                        # the cycle
+                        try:
+                            budget.charge(self.budget_cost_fn(name, ssn))
+                        except Exception:
+                            log.exception("budget cost model failed; "
+                                          "action %s not charged", name)
+                    if self.solve_deadline_s is not None \
+                            and name in ("allocate", "allocate-tpu") \
+                            and action_sp.dur_s > self.solve_deadline_s:
+                        # a hung/slow device solve past the hard
+                        # deadline is contained like a device fault:
+                        # the cool-down opens and allocate degrades to
+                        # the CPU placer until the window expires —
+                        # the same path an XLA OOM rides
+                        from .device_health import DEVICE_HEALTH
+                        DEVICE_HEALTH.record_fault("slow_solve")
+                        log.error(
+                            "device solve took %.3fs (hard deadline "
+                            "%.3fs); opening the device cool-down — "
+                            "allocate degrades to the CPU placer",
+                            action_sp.dur_s, self.solve_deadline_s)
                     if poisoned:
                         # the action mutated session state outside any
                         # undo log (allocate.ReplayFault): later actions
@@ -513,6 +623,16 @@ class Scheduler:
                                   "aborting the remaining actions this "
                                   "cycle", name)
                         break
+                if commit is not None:
+                    # the allocate slot never ran (budget deferral or a
+                    # poisoned earlier action broke the loop): the
+                    # in-flight speculation cannot carry across — retire
+                    # its pinned epoch and count the conflict
+                    plan, commit = commit, None
+                    self._finish_speculation(plan, "conflict")
+                if budget is not None:
+                    self.max_cycle_spend_s = max(self.max_cycle_spend_s,
+                                                 budget.spent())
                 if not demoted and self._demoted_mid_cycle():
                     demoted = True       # lost during the last action
             except BaseException as exc:

@@ -168,6 +168,40 @@ def main(argv=None) -> int:
                          "transport; --ha/--federated only)")
     ap.add_argument("--lease-fault-seed", type=int, default=None,
                     help="lease fault RNG seed (default: --seed)")
+    ap.add_argument("--overload-chaos", action="store_true",
+                    help="the overload soak preset (docs/robustness.md "
+                         "overload failure model): cycle deadline "
+                         "budget 0.5 periods with the deterministic "
+                         "per-pending-task cost model, bounded "
+                         "admission (depth 48/queue) with "
+                         "priority-aware shedding + retry-after "
+                         "re-offers, seeded OverloadInjector arrival "
+                         "bursts, and (with --federated) the "
+                         "load-driven queue rebalancer; individual "
+                         "--cycle-budget/--admission-depth/"
+                         "--burst-rate/--rebalance flags override")
+    ap.add_argument("--cycle-budget", type=float, default=None,
+                    help="per-cycle deadline budget in virtual seconds "
+                         "(0 = unbounded); actions defer past it with "
+                         "carry-over ordering")
+    ap.add_argument("--admission-depth", type=int, default=None,
+                    help="per-queue accepted-work task cap at the "
+                         "admission front door (0 = unbounded)")
+    ap.add_argument("--burst-rate", type=float, default=None,
+                    help="seeded OverloadInjector burst probability "
+                         "per cycle (0 = off)")
+    ap.add_argument("--rebalance", action="store_true",
+                    help="enable the load-driven partition rebalancer "
+                         "(requires --federated)")
+    ap.add_argument("--verify-overload-equivalence", action="store_true",
+                    help="assert the overload contract: bounded "
+                         "per-queue pending depth, max cycle spend "
+                         "within 2x the budget, every admitted gang "
+                         "completes (incl. retried shed arrivals), "
+                         "zero double-binds, byte-deterministic x2; "
+                         "with --federated --rebalance also that queue "
+                         "ownership converged without operator "
+                         "move_queue calls (exit 1 otherwise)")
     ap.add_argument("--pipelined", action="store_true",
                     help="run the pipelined scheduler shell "
                          "(speculative solve overlapped with host "
@@ -240,6 +274,35 @@ def main(argv=None) -> int:
         ack_fault_rate = 0.3
     ack_fault_rate = ack_fault_rate or 0.0
     lease_fault_rate = args.lease_fault_rate or 0.0
+    # the overload preset (docs/robustness.md overload failure model):
+    # budget + bounded admission + seeded bursts (+ rebalancer when
+    # federated); explicit flags override the preset values
+    cycle_budget = args.cycle_budget
+    admission_depth = args.admission_depth
+    burst_rate = args.burst_rate
+    rebalance = args.rebalance
+    if args.overload_chaos:
+        if cycle_budget is None:
+            cycle_budget = 0.5 * args.period
+        if admission_depth is None:
+            admission_depth = 48
+        if burst_rate is None:
+            burst_rate = 0.2
+        if args.federated:
+            rebalance = True
+    cycle_budget = cycle_budget or 0.0
+    admission_depth = admission_depth or 0
+    burst_rate = burst_rate or 0.0
+    # the deterministic cost model prices one pending task per action
+    # at 2ms of budget (scaled by the period like the budget itself):
+    # with the preset's 48-task/queue admission cap the worst single
+    # action charges ~0.38 periods < the 0.5 budget (one action may
+    # overshoot but can never double the spend), while a saturated
+    # 4-queue backlog walked by a 5-action pipeline charges ~1.5 —
+    # exhaustion and deferral genuinely fire in the overload soaks
+    budget_cost = 0.002 * args.period if cycle_budget else 0.0
+    if rebalance and not args.federated:
+        ap.error("--rebalance requires --federated N")
     if args.verify_ack_equivalence and not ack_fault_rate:
         # without faults the report has no feedback section and every
         # stuck-state assertion would pass vacuously
@@ -260,6 +323,13 @@ def main(argv=None) -> int:
             ack_rate=None, lease_rate=None):
         bw, ew = wraps()
         runner = SimRunner(trace, conf_text=conf_text, period=args.period,
+                           cycle_budget_s=cycle_budget,
+                           budget_cost_per_task=budget_cost,
+                           admission_depth=admission_depth,
+                           overload_burst_rate=burst_rate,
+                           rebalance=rebalance
+                           and bool(args.federated
+                                    if federated is None else federated),
                            seed=args.seed, max_cycles=args.max_cycles,
                            scenario=args.scenario, binder_wrap=bw,
                            evictor_wrap=ew, kill_cycles=kills,
@@ -384,6 +454,72 @@ def main(argv=None) -> int:
               f"watchdog_fired={fb.get('watchdog_fired', 0)}, "
               f"restarts={report.get('restarts', 0)}, "
               f"accounting={got}", file=sys.stderr)
+    if args.verify_overload_equivalence:
+        ov = report.get("overload")
+        problems = []
+        if ov is None:
+            problems.append("no overload section in the report — "
+                            "enable --overload-chaos (or individual "
+                            "overload flags)")
+            ov = {}
+        # byte-determinism x2: the overload machinery (cost model,
+        # shed/retry stream, bursts, rebalancer) is seeded + virtual-
+        # clock priced, so an identical re-run must reproduce the
+        # decision plane byte-for-byte
+        rerun = run(kill_cycles)
+        if deterministic_json(report) != deterministic_json(rerun):
+            problems.append("overload run not byte-deterministic x2")
+        budget = ov.get("cycle_budget", {})
+        if budget.get("budget_s"):
+            if budget.get("max_cycle_spend_s", 0.0) \
+                    > 2.0 * budget["budget_s"]:
+                problems.append(
+                    f"cycle spend exceeded 2x the budget: "
+                    f"{budget['max_cycle_spend_s']} vs "
+                    f"{budget['budget_s']}")
+        adm = ov.get("admission", {})
+        if adm:
+            over = {q: d for q, d in adm.get("high_water", {}).items()
+                    if d > adm["max_queue_depth"]}
+            if over:
+                problems.append(f"admission depth bound violated: "
+                                f"{over} > {adm['max_queue_depth']}")
+        if ov.get("retries_pending"):
+            problems.append(f"{ov['retries_pending']} shed arrivals "
+                            f"never re-admitted")
+        if report["jobs"]["completed"] != report["jobs"]["arrived"] \
+                or report["jobs"]["unfinished"]:
+            problems.append("not every admitted gang completed: "
+                            f"{report['jobs']}")
+        if report.get("double_binds"):
+            problems.append(f"double-binds under overload: "
+                            f"{report['double_binds']}")
+        reb = report.get("federation", {}).get("rebalance")
+        if reb is not None and reb.get("enabled"):
+            # a balanced world legitimately never moves (hysteresis
+            # abstains) — the hotspot scenarios assert moves>0 in CI;
+            # here the contract is CONVERGENCE: whatever moved must
+            # have settled well before the run ended
+            if reb.get("move_count") and reb["last_move_t"] \
+                    > report["virtual_time_s"] - 10 * args.period:
+                problems.append(
+                    f"rebalancer still moving at run end (last move "
+                    f"t={reb['last_move_t']}): ownership did not "
+                    f"converge")
+        if problems:
+            for p in problems:
+                print(f"overload-equivalence FAILED: {p}",
+                      file=sys.stderr)
+            return 1
+        print(f"overload-equivalence OK: budget={budget}, "
+              f"shed={ov.get('shed', {})}, "
+              f"readmits={ov.get('readmit_attempts', 0)}, "
+              f"bursts={ov.get('burst_jobs', 0)}, "
+              f"rebalance_moves="
+              f"{(reb or {}).get('move_count', 0)}, "
+              f"restarts={report.get('restarts', 0)}, "
+              f"accounting={terminal_accounting(report)}",
+              file=sys.stderr)
     if args.verify_federated_equivalence:
         import json as _json
         baseline = run([], replicas=1, losses=[], federated=0)

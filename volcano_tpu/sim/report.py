@@ -224,6 +224,15 @@ def build_report(runner, actions_ms: Dict[tuple, list],
         # for ack-chaos runs — fault-free reports stay byte-identical
         # to the pre-feedback-plane decision plane.
         report["feedback"] = runner.feedback_stats()
+    if getattr(runner, "overload", False):
+        # the overload plane (docs/robustness.md overload failure
+        # model): cycle-budget exhaustion/deferral, admission shed
+        # counts + retry hints, injected bursts. All priced on the
+        # deterministic cost model + seeded injector, so decision-plane
+        # material — and only emitted on overload runs, so every
+        # fault-free scenario stays byte-identical to the pre-overload
+        # decision plane.
+        report["overload"] = runner.overload_stats()
     if getattr(runner, "pipelined_mode", False):
         # deterministic (cycle-logic-driven) but MECHANISM, not decisions:
         # pipelined_oracle_part strips it for the serial-oracle diff
@@ -247,6 +256,11 @@ def build_report(runner, actions_ms: Dict[tuple, list],
         }
         if getattr(runner, "store_wired", False):
             report["federation"]["store_backed"] = True
+        if getattr(runner, "rebalance", False):
+            # load-driven queue moves (federation/rebalance.py):
+            # deterministic from published load signals + the virtual
+            # clock — the fed-hotspot convergence witness
+            report["federation"]["rebalance"] = runner.rebalance_stats()
     elif getattr(runner, "replicas", None):
         report["ha"] = {
             "replicas": runner.ha_replicas,

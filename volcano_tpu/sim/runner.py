@@ -232,7 +232,13 @@ class SimRunner:
                  ack_fault_rate: float = 0.0,
                  ack_fault_seed: Optional[int] = None,
                  lease_fault_rate: float = 0.0,
-                 lease_fault_seed: Optional[int] = None):
+                 lease_fault_seed: Optional[int] = None,
+                 cycle_budget_s: float = 0.0,
+                 budget_cost_per_task: float = 0.0,
+                 admission_depth: int = 0,
+                 overload_burst_rate: float = 0.0,
+                 overload_seed: Optional[int] = None,
+                 rebalance: bool = False):
         self.trace = list(trace)
         self.period = period
         self.seed = seed
@@ -347,6 +353,52 @@ class SimRunner:
         if self.store_wired and (pipelined or fast_admit):
             raise ValueError("store_wired and pipelined/fast_admit are "
                              "separate modes")
+        # overload resilience (docs/robustness.md overload failure
+        # model): a per-cycle deadline budget priced by a DETERMINISTIC
+        # cost model (budget_cost_per_task virtual seconds per pending
+        # task per action — the virtual clock never advances inside a
+        # cycle, so exhaustion is a pure function of the decision
+        # plane), a bounded admission budget at the front door (shed
+        # arrivals re-offer after their retry_after hint, like a
+        # well-behaved client), a seeded OverloadInjector layering
+        # arrival bursts on the trace, and (federated) the load-driven
+        # queue rebalancer. All off by default — fault-free scenarios
+        # stay byte-identical to the pre-overload decision plane.
+        self.cycle_budget_s = float(cycle_budget_s)
+        self.budget_cost_per_task = float(budget_cost_per_task)
+        self.admission_depth = int(admission_depth)
+        self.overload_burst_rate = float(overload_burst_rate)
+        self.overload_seed = seed if overload_seed is None \
+            else overload_seed
+        self.rebalance = bool(rebalance)
+        self.overload = bool(self.cycle_budget_s or self.admission_depth
+                             or self.overload_burst_rate
+                             or self.rebalance)
+        self._admission = None
+        if self.admission_depth:
+            from ..webhooks.backpressure import AdmissionBudget
+            self._admission = AdmissionBudget(
+                max_queue_depth=self.admission_depth,
+                cycle_period_s=period, time_fn=self.clock.time)
+        self._overload_inj = None
+        if self.overload_burst_rate:
+            from ..chaos import OverloadInjector
+            self._overload_inj = OverloadInjector(
+                burst_rate=self.overload_burst_rate,
+                seed=self.overload_seed)
+        self._queue_names: List[str] = []
+        self.sheds = 0
+        self.shed_reasons: Dict[str, int] = {}
+        self.readmit_attempts = 0
+        self._retry_heap: List[tuple] = []    # (due, seq, arrival dict)
+        self._retry_seq = itertools.count()
+        self._burst_seq = itertools.count()
+        self._adm_charge: Dict[str, tuple] = {}   # jid -> (queue, tasks, B)
+        self._drained_tasks = 0
+        self._budget_base = {"exhausted": 0, "deferred": 0, "spend": 0.0}
+        self._rebalance_moves: List[dict] = []
+        self._rebalance_base = {"abstentions": 0, "refused": 0}
+        self._rebalancers: Dict[int, object] = {}
         self.pmap = None
         self.ledger = None
         self.registry = None
@@ -422,7 +474,8 @@ class SimRunner:
                                    schedule_period=period, clock=self.clock,
                                    rng=random.Random(seed),
                                    pipelined=self.pipelined_mode,
-                                   fast_admit=self.fast_admit_mode)
+                                   fast_admit=self.fast_admit_mode,
+                                   **self._overload_kwargs())
             self.caches = [self.cache]
             self._spec_mark = dict(metrics.speculation_counts())
             self._fa_mark = dict(metrics.fast_admit_counts())
@@ -453,6 +506,154 @@ class SimRunner:
         self.drf_gap: List[float] = []
         # wall-clock plane
         self.pipeline_e2e_ms: List[float] = []
+
+    # -- overload plumbing (docs/robustness.md overload failure model) -------
+
+    def _overload_kwargs(self) -> dict:
+        """The scheduler-shell kwargs of the cycle deadline budget —
+        passed to EVERY shell construction (incl. crash restarts), so a
+        restarted incarnation keeps the same work bound."""
+        if not self.cycle_budget_s:
+            return {}
+        return {"cycle_budget_s": self.cycle_budget_s,
+                "budget_cost_fn": self._budget_cost}
+
+    def _budget_cost(self, name: str, ssn) -> float:
+        """The deterministic action cost model: each action is priced
+        by the pending backlog it walks. A pure function of the session
+        snapshot, so budget exhaustion (and the deferral it causes)
+        replays byte-identically."""
+        from ..api import TaskStatus
+        pending = 0
+        for job in ssn.jobs.values():
+            pending += len(job.task_status_index.get(TaskStatus.PENDING,
+                                                     {}))
+        return self.budget_cost_per_task * pending
+
+    def _harvest_budget(self, sched) -> None:
+        """A shell is about to be replaced (crash restart): fold its
+        budget counters into the run totals (they are per-process
+        state and die with it)."""
+        self._budget_base["exhausted"] += sched.budget_exhausted_total
+        self._budget_base["deferred"] += sched.deferred_actions_total
+        self._budget_base["spend"] = max(self._budget_base["spend"],
+                                         sched.max_cycle_spend_s)
+
+    def budget_stats(self) -> Dict[str, object]:
+        scheds = [rep.sched for rep in self.replicas] \
+            if self.replicas else [self.sched]
+        exhausted = self._budget_base["exhausted"] \
+            + sum(s.budget_exhausted_total for s in scheds)
+        deferred = self._budget_base["deferred"] \
+            + sum(s.deferred_actions_total for s in scheds)
+        spend = max([self._budget_base["spend"]]
+                    + [s.max_cycle_spend_s for s in scheds])
+        return {"budget_s": self.cycle_budget_s,
+                "exhausted": exhausted, "deferred_actions": deferred,
+                "max_cycle_spend_s": round(spend, 6)}
+
+    def _admit_arrival(self, t: float, d: dict) -> bool:
+        """The front door's backpressure gate: charge the arrival
+        against the bounded admission budget, or shed it and schedule
+        the client's retry at the refusal's retry_after hint. True =
+        admitted (proceed with ingestion)."""
+        if self._admission is None:
+            return True
+        from ..webhooks.backpressure import (BackpressureError,
+                                             estimate_job_bytes)
+        jid = self._jid(d["name"])
+        tasks = int(d["tasks"])
+        nbytes = estimate_job_bytes(tasks)
+        try:
+            self._admission.admit_batch({d["queue"]: tasks}, nbytes,
+                                        int(d.get("priority", 0)))
+        except BackpressureError as exc:
+            self.sheds += 1
+            self.shed_reasons[exc.reason] = \
+                self.shed_reasons.get(exc.reason, 0) + 1
+            heapq.heappush(self._retry_heap,
+                           (t + exc.retry_after_s,
+                            next(self._retry_seq), dict(d)))
+            return False
+        self._adm_charge[jid] = (d["queue"], tasks, nbytes)
+        return True
+
+    def _credit_admission(self, jid: str) -> None:
+        """The gang left the system (completed): release its admission
+        budget and feed the drain-throughput EWMA."""
+        charge = self._adm_charge.pop(jid, None)
+        if charge is None or self._admission is None:
+            return
+        queue, tasks, nbytes = charge
+        self._admission.credit(queue, tasks, nbytes)
+        self._drained_tasks += tasks
+
+    def _drain_retries(self, now: float) -> None:
+        """Shed clients retry their POSTs once their retry_after hint
+        expires — through the same gate, so a still-full queue sheds
+        them again with a fresh (larger-backlog-aware) hint."""
+        while self._retry_heap and self._retry_heap[0][0] <= now + 1e-9:
+            _, _, d = heapq.heappop(self._retry_heap)
+            self.readmit_attempts += 1
+            self._arrive(now, d)
+
+    def _inject_bursts(self, now: float) -> None:
+        """Seeded OverloadInjector flash crowds: extra single-gang jobs
+        on top of the trace, offered through the same admission gate as
+        any client POST. Bursts ride the TRACE's arrival window only —
+        once the trace is exhausted the crowd stops, the shed-retry
+        backlog drains, and the run terminates (the "every admitted
+        gang completes" witness needs an end)."""
+        if self._overload_inj is None or not self._queue_names \
+                or self._trace_ix >= len(self.trace):
+            return
+        n = self._overload_inj.tick()
+        GI = 1 << 30
+        for _ in range(n):
+            spec = self._overload_inj.job_spec(len(self._queue_names))
+            name = f"ovl-{next(self._burst_seq):06d}"
+            self._arrive(now, {
+                "name": name,
+                "queue": self._queue_names[spec["queue_ix"]],
+                "priority": int(spec["priority"]),
+                "tasks": int(spec["tasks"]),
+                "min_available": int(spec["tasks"]),
+                "cpu_milli": int(spec["cpu_milli"]),
+                "mem": GI // 4, "gpus": 0,
+                "duration": float(spec["duration"])})
+
+    def overload_stats(self) -> Dict[str, object]:
+        """The report's deterministic overload section (only emitted on
+        overload runs, sim/report.py)."""
+        out: Dict[str, object] = {
+            "cycle_budget": self.budget_stats(),
+            "shed_total": self.sheds,
+            "shed": dict(sorted(self.shed_reasons.items())),
+            "readmit_attempts": self.readmit_attempts,
+            "retries_pending": len(self._retry_heap),
+            "burst_jobs": self._overload_inj.injected
+            if self._overload_inj is not None else 0,
+        }
+        if self._admission is not None:
+            out["admission"] = self._admission.detail()
+        return out
+
+    def rebalance_stats(self) -> Dict[str, object]:
+        moves = list(self._rebalance_moves)
+        for ctrl in self._rebalancers.values():
+            moves.extend(ctrl.moves)
+        moves.sort(key=lambda m: (m["t"], m["queue"]))
+        last_t = max((m["t"] for m in moves), default=0.0)
+        return {
+            "enabled": self.rebalance,
+            "moves": moves,
+            "move_count": len(moves),
+            "last_move_t": last_t,
+            "abstentions": self._rebalance_base["abstentions"] + sum(
+                c.abstentions for c in self._rebalancers.values()),
+            "refused": self._rebalance_base["refused"] + sum(
+                c.refused for c in self._rebalancers.values()),
+        }
 
     def _pin_feedback(self, cache: SchedulerCache) -> None:
         """Pin a cache's feedback-plane machinery to the sim: in-flight
@@ -523,6 +724,10 @@ class SimRunner:
         """Apply one trace event to EVERY replica cache (the watch stream
         every replica sees) plus the runner's global bookkeeping once."""
         d = ev.data
+        if ev.kind == "queue_add" and d["name"] not in self._queue_names:
+            # burst-injection routing table (seeded OverloadInjector
+            # picks a queue index; watch-stream order = deterministic)
+            self._queue_names.append(d["name"])
         if self.pmap is not None:
             # federated: the watch stream also feeds the partition map
             # (deterministic round-robin in stream order)
@@ -607,6 +812,8 @@ class SimRunner:
             else 0
 
     def _arrive(self, t: float, d: dict) -> None:
+        if not self._admit_arrival(t, d):
+            return                 # shed: the client's retry is queued
         name = d["name"]
         if self.store_wired:
             # informer-path ingestion: the job materializes as
@@ -760,6 +967,7 @@ class SimRunner:
                 self.task_job.pop(tuid, None)
                 self._live_bound.discard(tuid)
             self.admitted_at.pop(uid, None)
+            self._credit_admission(uid)
             self.jct.append(t - self.arrival_time[uid])
             self.completed += 1
             return
@@ -778,6 +986,7 @@ class SimRunner:
             self.task_job.pop(tuid, None)
             self._live_bound.discard(tuid)
         self.admitted_at.pop(uid, None)
+        self._credit_admission(uid)
         self.jct.append(t - self.arrival_time[uid])
         self.completed += 1
 
@@ -889,12 +1098,18 @@ class SimRunner:
                 self.completed, self.requeues, self.unfinished_jobs(),
                 self._ack_wire.delivered, self._ack_wire.pending(),
                 sum(len(c.resync_queue) for c in self.caches),
-                sum(len(c.dead_letter) for c in self.caches))
+                sum(len(c.dead_letter) for c in self.caches),
+                len(self._retry_heap), self.sheds,
+                self.readmit_attempts)
 
     def _done(self) -> bool:
         return (self._trace_ix >= len(self.trace)
                 and not self._completions
                 and not self.unfinished_jobs()
+                # shed arrivals still waiting out their retry_after
+                # hints must land (and complete) before the run ends —
+                # "every admitted gang completes" covers retried ones
+                and not self._retry_heap
                 # drain the ack wire: a delayed/stale replay still in
                 # flight must meet the normalizer, not die with the run
                 and not self._ack_wire.pending()
@@ -991,7 +1206,8 @@ class SimRunner:
                                  time_fn=self.clock.time))
         sched = Scheduler(rep.cache, conf_text=self.conf_text,
                           schedule_period=self.period, clock=self.clock,
-                          rng=random.Random(self.seed))
+                          rng=random.Random(self.seed),
+                          **self._overload_kwargs())
         sched.attach_elector(rep.elector)
         sched.reconcile_oracle_fn = self._take_crash_oracle
         sched.action_fault_hook = self._mk_action_hook(rep)
@@ -1094,6 +1310,7 @@ class SimRunner:
             rep.follower.detach()
         rep.follower = JournalFollower(rep.cache)
         rep.follower.attach(self.journal)
+        self._harvest_budget(rep.sched)
         self._build_replica_shell(rep)
         cluster_binds = dict(self.binder.sequence[-1:]) \
             if kill_mode == "bind_after" else {}
@@ -1268,7 +1485,8 @@ class SimRunner:
                                  time_fn=self.clock.time))
         sched = Scheduler(rep.cache, conf_text=self.conf_text,
                           schedule_period=self.period, clock=self.clock,
-                          rng=random.Random(self.seed))
+                          rng=random.Random(self.seed),
+                          **self._overload_kwargs())
         sched.attach_elector(rep.elector)
         sched.reconcile_oracle_fn = \
             lambda p=pid: self._fed_oracles.pop(p, None)
@@ -1278,11 +1496,30 @@ class SimRunner:
         # mirror (federation/store_backed.py); in-process mode shares one
         pmap = getattr(self, "_p_maps", {}).get(pid, self.pmap)
         ledger = getattr(self, "_p_ledgers", {}).get(pid, self.ledger)
-        sched.federation = PartitionMember(
+        member = PartitionMember(
             pid, pmap, ledger, rep.cache,
             epoch_fn=lambda r=rep: r.elector.fencing_epoch,
             time_fn=self.clock.time,
             starve_after_s=4 * self.period)
+        if self.rebalance:
+            # load-driven rebalancing (federation/rebalance.py): each
+            # partition's controller decides only moves of its OWN
+            # queues, off published load signals. A partition restart
+            # loses flap-guard state (volatile, like device cool-down)
+            # but never the move audit trail — the runner harvests a
+            # dying incarnation's moves in _crash_restart_partition.
+            from ..federation import RebalanceController
+            ctrl = RebalanceController(
+                pid, pmap, ledger, rep.cache,
+                epoch_fn=lambda r=rep: r.elector.fencing_epoch,
+                time_fn=self.clock.time,
+                exhausted_fn=lambda s=sched: s.budget_exhausted_total,
+                min_depth=8, min_gap=8, ratio=2.0,
+                cooldown_s=8 * self.period,
+                max_cooldown_s=64 * self.period)
+            member.rebalancer = ctrl
+            self._rebalancers[pid] = ctrl
+        sched.federation = member
         rep.sched = sched
 
     def _crash_restart_partition(self, rep: _Replica,
@@ -1311,6 +1548,15 @@ class SimRunner:
         from ..device_health import DEVICE_HEALTH
         DEVICE_HEALTH.reset(time_fn=self.clock.time)
         rep.gen += 1
+        self._harvest_budget(rep.sched)
+        ctrl = self._rebalancers.get(rep.ix)
+        if ctrl is not None:
+            # the controller dies with the shell: fold its executed
+            # moves AND decision counters into the run's totals before
+            # the rebuild (same pattern as _harvest_budget)
+            self._rebalance_moves.extend(ctrl.moves)
+            self._rebalance_base["abstentions"] += ctrl.abstentions
+            self._rebalance_base["refused"] += ctrl.refused
         self._build_partition_shell(rep)
         cluster_binds = dict(self.binder.sequence[-1:]) \
             if kill_mode == "bind_after" else {}
@@ -1462,7 +1708,8 @@ class SimRunner:
         self.sched = Scheduler(self.cache, conf_text=self.conf_text,
                                schedule_period=self.period,
                                clock=self.clock,
-                               rng=random.Random(self.seed))
+                               rng=random.Random(self.seed),
+                               **self._overload_kwargs())
         self.caches = [self.cache]
 
     def _fed_event_filter(self, pid: int):
@@ -1682,12 +1929,14 @@ class SimRunner:
         c.mark_all_dirty()
         c.tensor_cache = None
         c._tensor_dirty = set()
+        self._harvest_budget(self.sched)
         self.sched = Scheduler(self.cache, conf_text=self.conf_text,
                                schedule_period=self.period,
                                clock=self.clock,
                                rng=random.Random(self.seed),
                                pipelined=self.pipelined_mode,
-                               fast_admit=self.fast_admit_mode)
+                               fast_admit=self.fast_admit_mode,
+                               **self._overload_kwargs())
         # a process death also resets the device cool-down state machine
         # (it lives in process memory) — and its clock stays virtual
         from ..device_health import DEVICE_HEALTH
@@ -1787,6 +2036,12 @@ class SimRunner:
         last_sig = None
         while self.cycles < self.max_cycles:
             now = self.clock.time()
+            if self.overload:
+                # shed clients whose retry_after expired re-POST, and
+                # the seeded OverloadInjector may land a flash crowd —
+                # both through the same admission gate as the trace
+                self._drain_retries(now)
+                self._inject_bursts(now)
             self._apply_trace_until(now)
             self._fire_completions_until(now)
             if self.store_wired:
@@ -1847,6 +2102,12 @@ class SimRunner:
             self.util_cpu.append(report_mod.cpu_utilization_all(sample))
             self.util_mem.append(report_mod.mem_utilization_all(sample))
             self.drf_gap.append(report_mod.drf_fairness_gap_all(sample))
+            if self._admission is not None:
+                # feed the drain-throughput EWMA behind the front
+                # door's retry_after hints (virtual counts — the hint
+                # stream is deterministic)
+                self._admission.observe_drain(self._drained_tasks)
+                self._drained_tasks = 0
             self.cycles += 1
             self.clock.sleep(self.period)
             if self._done():

@@ -286,6 +286,42 @@ SCENARIOS: Dict[str, dict] = {
                                       restore_at=20.0, fail=(7,),
                                       fail_at=14.0)),
     ),
+    "overload-burst": dict(
+        description="240 gangs arriving at ~5x the 8-node cluster's "
+                    "drain rate over 4 queues with the full priority "
+                    "spread — the sustained-overload world for "
+                    "--overload-chaos: the cycle budget must defer "
+                    "(not collapse), the admission budget must shed "
+                    "lowest-priority work first with retry-after "
+                    "hints, and EVERY admitted gang must still "
+                    "complete once the wave passes "
+                    "(docs/robustness.md overload failure model); the "
+                    "4 queues shard under --federated 4",
+        factory=lambda seed: synthetic_trace(
+            240, 8, seed=seed, arrival_rate=40.0, duration_mean=6.0,
+            duration_cap=20.0, cpu_choices=(2000, 3000),
+            mem_choices=(GI,),
+            gang_sizes=((1, 0.5), (2, 0.35), (4, 0.15)),
+            queues=(("q1", 2), ("q2", 2), ("q3", 1), ("q4", 1))),
+    ),
+    "fed-hotspot": dict(
+        description="8 queues round-robined over 4 partitions with "
+                    "~80% of the demand pinned to the two queues "
+                    "partition 0 owns (q1+q5) — globally under "
+                    "capacity but a ~2x hot shard: the load-driven "
+                    "rebalancer must move a hot queue off partition 0 "
+                    "through the journaled move funnel and CONVERGE "
+                    "(no operator move_queue, no ping-pong; "
+                    "docs/federation.md)",
+        factory=lambda seed: synthetic_trace(
+            160, 16, seed=seed, arrival_rate=4.5, duration_mean=12.0,
+            duration_cap=30.0, gang_sizes=((2, 0.6), (4, 0.4)),
+            queues=(("q1", 1), ("q2", 1), ("q3", 1), ("q4", 1),
+                    ("q5", 1), ("q6", 1), ("q7", 1), ("q8", 1)),
+            queue_demand=(40, 1, 1, 1, 40, 1, 1, 1),
+            cpu_choices=(2000,), mem_choices=(GI,),
+            priority_choices=(0,)),
+    ),
     "fed-smoke": dict(
         description="60 gangs over 4 equal queues on 16 nodes, light "
                     "load — the federated non-contended oracle world: "

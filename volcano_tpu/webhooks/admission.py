@@ -95,7 +95,8 @@ def make_validate_job(store: ObjectStore):
     return validate_job
 
 
-def submit_job_batch(store: ObjectStore, jobs) -> list:
+def submit_job_batch(store: ObjectStore, jobs, budget=None,
+                     priority_fn=None) -> list:
     """Batched job submission — the high-QPS front door
     (docs/federation.md): the whole batch is defaulted and validated
     against ONE prefetched queue read, then lands through ONE store
@@ -106,13 +107,27 @@ def submit_job_batch(store: ObjectStore, jobs) -> list:
     batch BEFORE anything is written, so a partially-admitted batch can
     never exist (same atomicity a transactional apiserver POST would
     give). Returns the created Job objects; raises AdmissionError with
-    the first offending job named."""
+    the first offending job named.
+
+    ``budget`` (an :class:`webhooks.backpressure.AdmissionBudget`)
+    gates the VALIDATED batch against the bounded pending-work budget
+    (docs/robustness.md overload failure model): over-depth/over-bytes
+    batches — and, past the shed watermark, low-priority ones — are
+    refused with a typed ``BackpressureError`` carrying a
+    ``retry_after_s`` hint derived from observed drain throughput,
+    before anything is written. The batch's priority is the MINIMUM
+    across its jobs (``priority_fn(job) -> int``; default resolves the
+    job's PriorityClass through one prefetched store read), so a batch
+    is only as shed-resistant as its least-deserving member."""
     from .. import metrics
+    from .backpressure import estimate_job_bytes
     jobs = list(jobs)
     if not jobs:
         return []
     queues = {q.metadata.name: q for q in store.list("Queue")}
     prepared = []
+    per_queue: dict = {}
+    nbytes = 0.0
     for job in jobs:
         job = mutate_job("CREATE", job, None)
         try:
@@ -123,7 +138,46 @@ def submit_job_batch(store: ObjectStore, jobs) -> list:
                 f"{job.metadata.namespace}/{job.metadata.name}: {exc}"
             ) from None
         prepared.append(job)
-    created = store.create_batch(prepared, admit=False)
+        if budget is not None:
+            tasks = sum(t.replicas for t in job.spec.tasks)
+            per_queue[job.spec.queue] = \
+                per_queue.get(job.spec.queue, 0) + tasks
+            nbytes += estimate_job_bytes(tasks)
+    if budget is not None:
+        # the batch's priority only matters once a target queue is in
+        # the shed band — below the watermark the floor is 0 by
+        # construction. Passing a THUNK lets the gate resolve it under
+        # its own lock exactly when a non-zero floor is hit: the common
+        # unloaded case skips the PriorityClass store read entirely,
+        # and a queue crossing the watermark concurrently cannot race a
+        # stale outside peek (the floor and the priority resolve under
+        # one lock).
+        def batch_priority() -> int:
+            resolve = priority_fn
+            if resolve is None:
+                classes = {pc.metadata.name: pc.value
+                           for pc in store.list("PriorityClass")}
+
+                def resolve(job, _classes=classes):
+                    return _classes.get(job.spec.priority_class_name, 0)
+            return min(int(resolve(j)) for j in prepared)
+
+        # the backpressure gate: raises BackpressureError (nothing
+        # written, nothing charged) or charges the whole batch
+        budget.admit_batch(per_queue, nbytes, batch_priority)
+    try:
+        created = store.create_batch(prepared, admit=False)
+    except BaseException:
+        # the store refused the batch AFTER the budget charged it
+        # (duplicate key, store fault): nothing was written, so the
+        # charge must not outlive the call — a leaked charge would
+        # ratchet the pending depth up on every failed submit until
+        # the queue sheds everything forever
+        if budget is not None:
+            for ix, queue in enumerate(sorted(per_queue)):
+                budget.credit(queue, per_queue[queue],
+                              nbytes if ix == 0 else 0.0)
+        raise
     metrics.observe_admission_batch(len(created))
     return created
 
