@@ -23,19 +23,21 @@ run_pipelined=true
 run_store=true
 run_ack=true
 run_overload=true
+run_elastic=true
 case "${1:-}" in
-  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
-  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
-  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
-  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
-  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
-  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
-  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
-  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false ;;
-  --pipelined-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_store=false ; run_ack=false; run_overload=false ;;
-  --store-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ; run_ack=false; run_overload=false ;;
-  --ack-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_overload=false ;;
-  --overload-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false ;;
+  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
+  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
+  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
+  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
+  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
+  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
+  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
+  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
+  --pipelined-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
+  --store-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ; run_ack=false; run_overload=false; run_elastic=false ;;
+  --ack-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_overload=false; run_elastic=false ;;
+  --overload-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_elastic=false ;;
+  --elastic-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_overload=false ;;
 esac
 
 if $run_lint; then
@@ -570,6 +572,59 @@ print("   overload-soak: budget exhausted %d / deferred %d, shed %s, "
          reb["move_count"]))
 EOF
   echo "   overload-soak: contract holds, byte-deterministic x2"
+fi
+
+if $run_elastic; then
+  # elastic soak (docs/federation.md elastic membership): the
+  # diurnal-flash-crowd world under the overload preset PLUS the
+  # store-chaos fault matrix (store-wired CRs, injected faults, torn
+  # watches) and 4 seeded kills landing at split/merge boundaries.
+  # --verify-elastic-equivalence asserts the contract (>=1 split and
+  # >=1 merge fire, membership returns to 1, bounded per-queue depth,
+  # every admitted gang completes, zero double-binds, byte-
+  # deterministic x2 internally); an external byte-diff x2 re-proves
+  # the deterministic plane, and the python block re-checks the
+  # report's elastic section explicitly.
+  echo "== elastic-soak: load-driven partition split/merge =="
+  eldir=$(mktemp -d)
+  trap 'rm -rf "${simdir:-/nonexistent}" "${soakdir:-/nonexistent}" \
+"${obsdir:-/nonexistent}" "${hadir:-/nonexistent}" \
+"${feddir:-/nonexistent}" "${pipedir:-/nonexistent}" \
+"${storedir:-/nonexistent}" "${ackdir:-/nonexistent}" \
+"${ovdir:-/nonexistent}" "${eldir:-/nonexistent}"' EXIT
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim \
+    --scenario diurnal-flash-crowd --seed 3 --federated 1 --elastic \
+    --overload-chaos --store-chaos --kill-cycles 22,39,134,146 \
+    --verify-elastic-equivalence --deterministic > "$eldir/el.a.json" \
+    || { echo "elastic-soak FAILED: elastic contract violated"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim \
+    --scenario diurnal-flash-crowd --seed 3 --federated 1 --elastic \
+    --overload-chaos --store-chaos --kill-cycles 22,39,134,146 \
+    --deterministic > "$eldir/el.b.json"
+  diff "$eldir/el.a.json" "$eldir/el.b.json" \
+    || { echo "elastic-soak FAILED: elastic run not \
+byte-deterministic"; exit 1; }
+  python - "$eldir/el.a.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+el = r["federation"]["elastic"]
+assert el["splits"] >= 1, "no partition split fired"
+assert el["merges"] >= 1, "no partition merge fired"
+assert el["partitions_final"] == 1, el
+assert el["partitions_peak"] >= 2, el
+adm = r["overload"]["admission"]
+assert all(d <= adm["max_queue_depth"]
+           for d in adm["high_water"].values()), adm["high_water"]
+assert r["double_binds"] == 0
+assert r["jobs"]["completed"] == r["jobs"]["arrived"]
+assert r["jobs"]["unfinished"] == 0
+assert r["restarts"] > 0, "the seeded kills never landed"
+print("   elastic-soak: splits %d / merges %d, peak %d -> final %d, "
+      "max depth %d, zero double-binds under kills + store faults"
+      % (el["splits"], el["merges"], el["partitions_peak"],
+         el["partitions_final"], el["max_queue_depth"]))
+EOF
+  echo "   elastic-soak: contract holds, byte-deterministic x2"
 fi
 
 if $run_shim; then

@@ -349,6 +349,60 @@ def test_vt009_trigger_and_clean():
     assert "VT009" not in rule_ids(f)
 
 
+VT019_TRIGGER = '''
+class Grower:
+    def grow(self, pmap):
+        return pmap._spawn_partition_raw()
+'''
+
+VT019_CLEAN = '''
+class Grower:
+    def _journal_reserve(self, kind, **fields):
+        self.journal.record_control(kind, fields)
+
+    def grow(self, pmap):
+        pid = pmap._spawn_partition_raw()
+        self._journal_reserve("partition_spawn", pid=pid)
+        return pid
+'''
+
+VT019_RETIRE_TRIGGER = '''
+class Shrinker:
+    def shrink(self, pmap, pid):
+        pmap._begin_retire_raw(pid)
+        pmap._retire_partition_raw(pid)
+'''
+
+VT019_RAW_DEF = '''
+class PartitionMap:
+    def _spawn_partition_raw(self):
+        pid = self.next_pid
+        self.next_pid += 1
+        return pid
+
+    def _retire_partition_raw(self, pid):
+        self.active.discard(pid)
+'''
+
+
+def test_vt019_trigger_and_clean():
+    """A membership mutation (partition spawn/retire) with no
+    _journal_reserve control record on the path fires VT019; journaling
+    in the same function is clean, and the raw mutators' own
+    definitions are the funnel's write primitives, not decisions."""
+    f, _ = findings_of({"volcano_tpu/sim/runner.py": VT019_TRIGGER})
+    assert "VT019" in rule_ids(f)
+    assert any(x.symbol == "Grower.grow" for x in f)
+    f, _ = findings_of({"volcano_tpu/sim/runner.py": VT019_CLEAN})
+    assert "VT019" not in rule_ids(f)
+    f, _ = findings_of(
+        {"volcano_tpu/federation/elastic.py": VT019_RETIRE_TRIGGER})
+    assert sum(1 for x in f if x.rule == "VT019") == 2
+    f, _ = findings_of(
+        {"volcano_tpu/federation/partition.py": VT019_RAW_DEF})
+    assert "VT019" not in rule_ids(f)
+
+
 VT005_TRIGGER = '''
 def cycle(action):
     try:
@@ -782,6 +836,28 @@ def test_rebreak_unjournaled_node_transfer_vt009():
     f, _ = findings_of({"volcano_tpu/federation/reserve.py": broken})
     assert any(x.rule == "VT009"
                and x.symbol == "ReserveLedger._drain_and_transfer"
+               for x in f), rule_ids(f)
+
+
+def test_rebreak_unjournaled_partition_spawn_vt019():
+    """PR 16's membership contract: the ledger mints a partition id
+    right next to its journaled ``partition_spawn`` control record.
+    Dropping the record leaves a partition that exists with no durable
+    trace — after a crash the survivors and the journal disagree about
+    the member set (docs/federation.md membership-change protocol). The
+    unmutated source must be clean; the reverted one must flag the
+    mint."""
+    src = real_source("volcano_tpu/federation/reserve.py")
+    f, _ = findings_of({"volcano_tpu/federation/reserve.py": src})
+    assert "VT019" not in rule_ids(f)
+    broken = mutate(src,
+                    '        self._journal_reserve("partition_spawn", '
+                    'pid=pid, frm=frm,\n'
+                    '                              epoch=epoch)\n',
+                    '        pass\n')
+    f, _ = findings_of({"volcano_tpu/federation/reserve.py": broken})
+    assert any(x.rule == "VT019"
+               and x.symbol == "ReserveLedger.partition_spawn"
                for x in f), rule_ids(f)
 
 

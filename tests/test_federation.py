@@ -352,6 +352,142 @@ class TestQueueRebalance:
 
 
 # ---------------------------------------------------------------------------
+# elastic membership: the journaled partition_spawn/partition_retire funnel
+# (docs/federation.md membership-change protocol; vlint VT019)
+# ---------------------------------------------------------------------------
+
+class TestElasticMembership:
+    def _setup(self, n=2):
+        clock = FakeClock()
+        journal = IntentJournal()
+        records = []
+        journal.subscribe(records.append)
+        pm, reg, ledger, caches = make_federation(clock, n=n,
+                                                  journal=journal)
+        return clock, journal, records, pm, reg, ledger, caches
+
+    def test_spawn_mints_a_journaled_fenced_partition_id(self):
+        clock, journal, records, pm, reg, ledger, caches = self._setup()
+        reg.authority(0).advance(2)
+        # a deposed leader (stale epoch) may not grow the membership
+        assert ledger.partition_spawn(frm=0, epoch=1) is None
+        pid = ledger.partition_spawn(frm=0, epoch=2)
+        assert pid == 2
+        assert pm.state_of(pid) == "active"
+        assert pid in pm.assignable_pids()
+        rec = [r for r in records if r["kind"] == "partition_spawn"][-1]
+        assert rec["pid"] == 2 and rec["frm"] == 0 and rec["epoch"] == 2
+        # ids are never reused: the next mint moves on even though 2
+        # could retire later (a journal replay must stay unambiguous)
+        assert ledger.partition_spawn(frm=0, epoch=2) == 3
+
+    def test_membership_never_empties_and_retiring_is_no_target(self):
+        clock, journal, records, pm, reg, ledger, caches = self._setup()
+        assert ledger.begin_retire(1, epoch=0) is True
+        assert pm.state_of(1) == "retiring"
+        rec = [r for r in records
+               if r["kind"] == "partition_retire_begin"][-1]
+        assert rec["pid"] == 1
+        # a retiring partition can no longer be a reserve target
+        assert ledger.request(frm=0, to=1, cpu=1000, mem=GI,
+                              epoch_from=0) is None
+        # ... and the LAST assignable partition may never retire
+        assert ledger.begin_retire(0, epoch=0) is False
+        assert pm.state_of(0) == "active"
+
+    def test_merge_defers_on_open_reserve_pin_until_expiry(self):
+        """Satellite: a pin held by the retiring partition (its open
+        reserve against a donor) defers retirement until the ledger's
+        deadline expiry releases it — retiring the requester early
+        would strand the donor's pinned node forever."""
+        clock, journal, records, pm, reg, ledger, caches = self._setup()
+        pm.register_queue("qa")                       # -> 0
+        pm.register_queue("qb")                       # -> 1
+        pid = ledger.partition_spawn(frm=0, epoch=0)  # -> 2
+        ledger.attach_cache(pid, make_cache(n_nodes=0, journal=journal))
+        # both of the donor's nodes are busy, so the grant pins and
+        # drains but cannot complete the transfer
+        owner = caches[1]
+        pg = PodGroup(name="vj", queue="qb", min_member=2,
+                      phase=PodGroupPhase.RUNNING)
+        job = JobInfo(uid="vj", name="vj", queue="qb", min_available=2,
+                      podgroup=pg)
+        for i in range(2):
+            job.add_task_info(TaskInfo(uid=f"vj-{i}", name=f"vj-{i}",
+                                       job="vj", resreq=Resource(1000, GI)))
+        owner.add_job(job)
+        place(owner, "vj", 0, "n1")
+        place(owner, "vj", 1, "n3")
+        rid = ledger.request(frm=pid, to=1, cpu=4000, mem=GI,
+                             epoch_from=0)
+        ledger.review(pid=1, epoch=0)         # pins n1, starts draining
+        assert pm.pinned == {"n1": rid}
+        assert ledger.begin_retire(pid, epoch=0) is True
+        assert "open-reserve" in ledger.retire_blockers(pid)
+        assert ledger.partition_retire(pid, epoch=0) is False
+        assert pm.state_of(pid) == "retiring"
+        assert pm.pinned, "deferral must not touch the ledger's pin"
+        # the deadline passes; expiry (not the retirement) releases the
+        # pin, and only then does the merge complete
+        clock.advance(9.0)
+        assert ledger.expire() == 1
+        assert not pm.pinned
+        assert pm.owner_of_node("n1") == 1
+        assert ledger.partition_retire(pid, epoch=0) is True
+        assert pm.state_of(pid) is None
+        rec = [r for r in records if r["kind"] == "partition_retire"][-1]
+        assert rec["pid"] == pid
+
+    def test_retired_pid_purged_never_a_ghost_donor_or_move_target(self):
+        """Satellite regression (the ghost-partition fix): every ledger
+        signal a retired pid ever published — idle, load, load_seen
+        freshness, cache attachment — is purged on partition_retire, so
+        the dead pid is never again a candidate donor and the
+        rebalancer finds no fresh move target pointing at it."""
+        from volcano_tpu.federation.rebalance import RebalanceController
+        clock, journal, records, pm, reg, ledger, caches = self._setup()
+        pm.register_queue("qa")                       # -> 0
+        pm.register_queue("qb")                       # -> 1
+        pm.register_queue("qc")                       # -> 0
+        pid = ledger.partition_spawn(frm=0, epoch=0)  # -> 2
+        cache2 = make_cache(n_nodes=0, journal=journal)
+        ledger.attach_cache(pid, cache2)
+        pm._transfer_node_raw("n2", pid)
+        pm._transfer_node_raw("n3", pid)
+        ledger.publish_idle(pid, 9000.0, GI)
+        ledger.publish_load(pid, {"pending": 0, "queues": {}, "t": 0.0})
+        assert ledger.pick_donor(0) == pid
+        assert ledger.load_seen(pid) is not None
+        # merge: drain the shard back, then retire through the funnel
+        assert ledger.begin_retire(pid, epoch=0)
+        pm._transfer_node_raw("n2", 0)
+        pm._transfer_node_raw("n3", 1)
+        assert ledger.partition_retire(pid, epoch=0) is True
+        assert pm.state_of(pid) is None
+        assert pid not in pm.assignable_pids()
+        assert ledger.pick_donor(0) != pid
+        assert pid not in ledger.loads()
+        assert ledger.load_seen(pid) is None
+        assert pid not in ledger._idle and pid not in ledger._caches
+        # the rebalancer never targets the ghost: partition 0 is hot
+        # (3 pending in qa) and the retired pid's stale "cool" signal
+        # is gone, so there is NO fresh move target at all
+        pg = PodGroup(name="hj", queue="qa", min_member=3,
+                      phase=PodGroupPhase.INQUEUE)
+        job = JobInfo(uid="hj", name="hj", queue="qa", min_available=3,
+                      podgroup=pg, creation_timestamp=0.0)
+        for i in range(3):
+            job.add_task_info(TaskInfo(uid=f"hj-{i}", name=f"hj-{i}",
+                                       job="hj", resreq=Resource(1000, GI)))
+        caches[0].add_job(job)
+        rc = RebalanceController(0, pm, ledger, caches[0],
+                                 epoch_fn=lambda: 0, time_fn=clock,
+                                 min_depth=1, min_gap=1, ratio=1.0)
+        assert rc.step(now=clock()) is None
+        assert not pm.draining and not rc.moves
+
+
+# ---------------------------------------------------------------------------
 # shared-journal standby: one follower, many partitions' intents
 # ---------------------------------------------------------------------------
 
@@ -591,6 +727,84 @@ class TestFederatedSim:
         hot = report["federation"]["map"]
         total = sum(p["nodes"] for p in hot.values())
         assert total == 8 and max(p["nodes"] for p in hot.values()) > 2
+
+
+# ---------------------------------------------------------------------------
+# sim --elastic acceptance slice: diurnal-flash-crowd 1→N→1
+# (ci/check.sh --elastic-only runs the full chaos matrix)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.sim
+class TestElasticSim:
+    # the --overload-chaos preset (sim/__main__.py): cycle-budget
+    # exhaustion is the split signal, so elastic runs always carry it
+    OVERLOAD = dict(period=1.0, cycle_budget_s=0.5,
+                    budget_cost_per_task=0.002, admission_depth=48,
+                    overload_burst_rate=0.2, rebalance=True,
+                    federated_partitions=1, elastic=True)
+    KILLS = (22, 39, 134, 146)     # split/merge boundaries (seed 3)
+
+    def _run(self, **kw):
+        trace = make_scenario("diurnal-flash-crowd", seed=3)
+        runner = SimRunner(trace, seed=3, **{**self.OVERLOAD, **kw})
+        return runner, runner.run()
+
+    def _assert_contract(self, runner, report):
+        el = report["federation"]["elastic"]
+        assert el["splits"] >= 1 and el["merges"] >= 1, el
+        assert el["partitions_peak"] >= 2
+        assert el["partitions_final"] == 1, \
+            "membership must return to the initial count"
+        assert report["jobs"]["completed"] == report["jobs"]["arrived"]
+        assert report["jobs"]["unfinished"] == 0
+        assert report["double_binds"] == 0
+        # bounded depth throughout: admission keeps every queue within
+        # its configured depth even while membership changes
+        assert el["max_queue_depth"] <= self.OVERLOAD["admission_depth"]
+        # zero stranded pins (satellite): nothing holds donor capacity
+        # after the run settles, and no reserve intent stays open
+        assert runner.pmap.pinned == {}
+        assert runner.ledger.detail()["open"] == []
+
+    def test_diurnal_flash_crowd_membership_follows_load(self):
+        runner, report = self._run()
+        self._assert_contract(runner, report)
+
+    def test_kills_mid_split_mid_merge_zero_double_binds(self):
+        runner, report = self._run(kill_cycles=self.KILLS, kill_seed=3)
+        assert report["restarts"] >= 1
+        self._assert_contract(runner, report)
+
+    def test_elastic_run_byte_deterministic(self):
+        _, a = self._run(kill_cycles=self.KILLS, kill_seed=3)
+        _, b = self._run(kill_cycles=self.KILLS, kill_seed=3)
+        assert deterministic_json(a) == deterministic_json(b)
+
+
+def test_vcctl_federation_elastic_status_verb():
+    from volcano_tpu.cli.vcctl import main
+    metrics.reset_local()
+    out = []
+    assert main(["federation", "elastic-status"], store=ObjectStore(),
+                out=out.append) == 1
+    assert "not enabled" in out[0]
+    metrics.set_partition_count(2)
+    metrics.register_partition_split("committed")
+    metrics.register_partition_merge("committed")
+    metrics.set_elastic_detail(0, {"partition": 0, "retiring": False,
+                                   "splits": 1, "merges": 1,
+                                   "abstentions": 4, "refused": 0,
+                                   "hot_streak": 2, "idle_streak": 0,
+                                   "block_until": 17.5,
+                                   "last_split": {"t": 9.0, "pid": 1}})
+    del out[:]
+    assert main(["federation", "elastic-status"], store=ObjectStore(),
+                out=out.append) == 0
+    assert "partitions=2" in out[0] and "committed" in out[0]
+    assert out[1].startswith("p0\t") and "hot=2" in out[1] \
+        and "splits=1" in out[1]
+    assert "last_split" in out[2] and '"pid": 1' in out[2]
+    metrics.reset_local()
 
 
 # ---------------------------------------------------------------------------

@@ -503,6 +503,63 @@ class CrossPartitionFunnelRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# VT019 — elastic membership moves through the journaled funnel
+# ---------------------------------------------------------------------------
+
+class MembershipFunnelRule(Rule):
+    """Partition MEMBERSHIP writes (minting a partition id for a split,
+    opening or completing a retirement for a merge) change who may own
+    cluster state at all — strictly stronger than a VT009 ownership
+    transfer. They may only happen inside the journaled membership
+    funnel: a ``_journal_reserve`` control record (``partition_spawn``,
+    ``partition_retire_begin``, ``partition_retire``) must be on the
+    path, same function or one hop. A bare membership mutation is a
+    partition that exists (or vanished) with no durable record — after
+    a crash the survivors and the journal disagree about the member
+    set, and a job whose queue the phantom partition owned is either
+    orphaned or schedulable twice (docs/federation.md membership-change
+    protocol)."""
+
+    id = "VT019"
+    name = "membership-funnel"
+    contract = ("PartitionMap membership mutation (spawn/retire) outside "
+                "the journaled membership funnel (elastic federation, "
+                "docs/federation.md)")
+    exclude = ("volcano_tpu/analysis/",)
+
+    MEMBER_METHODS = {"_spawn_partition_raw", "_begin_retire_raw",
+                      "_retire_partition_raw"}
+    WITNESS = {"_journal_reserve"}
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr not in self.MEMBER_METHODS:
+                continue
+            recv = dotted_name(node.func.value) or "<expr>"
+            fn = mod.enclosing_function(node.lineno)
+            if fn is not None:
+                # the raw mutators' own defs (and store-backed
+                # overrides, which CAS-persist then delegate) are the
+                # funnel floor, not membership decisions
+                if fn.name in self.MEMBER_METHODS:
+                    continue
+                if ctx.witness_in_scope(fn, self.WITNESS):
+                    continue
+            where = fn.qualname if fn else "<module>"
+            findings.append(self.finding(
+                mod, node,
+                f"membership mutation {recv}.{node.func.attr}(...) in "
+                f"{where} without a _journal_reserve control record on "
+                f"the path; partitions are minted and retired only "
+                f"through the journaled membership funnel "
+                f"(docs/federation.md)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
 # VT016 — store verbs ride the retrying-transport funnel (store boundary)
 # ---------------------------------------------------------------------------
 
@@ -1662,7 +1719,7 @@ ALL_RULES: List[Rule] = [
     HostSyncRule(), TracedBranchRule(), DataflowShapeBucketRule(),
     DtypeDisciplineRule(), SessionEscapeRule(),
     SpeculationIsolationRule(), StoreVerbFunnelRule(),
-    InflightLedgerRule(), BoundedWorkRule(),
+    InflightLedgerRule(), BoundedWorkRule(), MembershipFunnelRule(),
 ]
 
 # the rules that run on the shared dataflow/callgraph engine
@@ -1702,6 +1759,8 @@ solver(state, tasks)                       # no _bucket()/pad on the path''',
     self.binder.bind(task, task.node_name)     # fencing_epoch()''',
     "VT009": '''def hand_over(pmap, node):
     pmap._transfer_node_raw(node, 2)       # no _journal_reserve record''',
+    "VT019": '''def grow(pmap):
+    pid = pmap._spawn_partition_raw()      # no partition_spawn record''',
     "VT010": '''packed = solver(state, tasks)          # device value
 n = int(packed[0])                     # implicit fetch OUTSIDE any
                                        # solve/replay/upload span''',

@@ -226,6 +226,13 @@ def build_parser() -> argparse.ArgumentParser:
                     "flap-blocked queues and thresholds — read from the "
                     "process-local metrics detail, like the flight-"
                     "recorder verbs")
+    fed.add_parser(
+        "elastic-status",
+        description="Elastic membership per-partition state "
+                    "(docs/federation.md): live partition count, "
+                    "split/merge totals, hot/idle streaks, flap-guard "
+                    "windows and the last split/merge records — read "
+                    "from the process-local metrics detail")
 
     st = sub.add_parser(
         "store", description="Store-boundary verbs (docs/robustness.md "
@@ -334,6 +341,34 @@ def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
                 f"blocked={sorted(d.get('blocked_queues', {}))}")
             if d.get("last_move"):
                 out(f"p{pid}\tlast_move={json.dumps(d['last_move'], sort_keys=True)}")
+        return 0
+    if args.group == "federation" and args.verb == "elastic-status":
+        # process-local, like rebalance-status: the elastic controller
+        # lives in each partition leader's scheduler process
+        import json
+        from .. import metrics
+        health = metrics.health_detail()
+        detail = health.get("federation", {}).get("elastic", {})
+        if not detail:
+            out("no elastic state recorded — elastic membership is not "
+                "enabled (or this process runs no partition leader)")
+            return 1
+        out(f"partitions={health.get('partition_count', 0)}\t"
+            f"splits={health.get('partition_splits_total', {})}\t"
+            f"merges={health.get('partition_merges_total', {})}")
+        for pid in sorted(detail, key=int):
+            d = detail[pid]
+            out(f"p{pid}\tretiring={d.get('retiring', False)}\t"
+                f"splits={d.get('splits', 0)}\t"
+                f"merges={d.get('merges', 0)}\t"
+                f"abstentions={d.get('abstentions', 0)}\t"
+                f"refused={d.get('refused', 0)}\t"
+                f"hot={d.get('hot_streak', 0)}\t"
+                f"idle={d.get('idle_streak', 0)}\t"
+                f"block_until={d.get('block_until', 0)}")
+            for k in ("last_split", "last_merge"):
+                if d.get(k):
+                    out(f"p{pid}\t{k}={json.dumps(d[k], sort_keys=True)}")
         return 0
     if store is None:
         out("no cluster store attached (in-process CLI requires a store)")

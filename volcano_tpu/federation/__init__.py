@@ -22,14 +22,22 @@ else is partition-local and needs no coordination at all.
   (closes the ROADMAP item 5 remainder): published load signals feed a
   deterministic greedy bin-balancer with hysteresis and a flap guard,
   executing through the SAME journaled move_queue/settle_moves funnel
-  operators use.
+  operators use;
+- :class:`ElasticController` — load-driven membership (ROADMAP item
+  4): chronically budget-exhausted partitions SPLIT through the
+  journaled ``partition_spawn`` funnel and chronically idle ones MERGE
+  back through ``partition_retire``, queue/node ownership flowing
+  through the same move/reserve funnels — bounded depth 1→N→1 with no
+  operator in the loop.
 
 ``sim --federated N`` (volcano_tpu/sim) proves the protocol: partition
 kills mid-trace, zero cross-partition double-binds, aggregate
 decision-plane equivalence to a single-scheduler oracle on
-non-contended traces.
+non-contended traces; ``sim --elastic`` adds kills mid-split and
+mid-merge reconciling to a consistent membership.
 """
 
+from .elastic import ElasticController
 from .member import PartitionMember
 from .partition import PartitionMap
 from .rebalance import RebalanceController
@@ -38,6 +46,7 @@ from .store_backed import (StoreBackedPartitionMap,
                            StoreBackedReserveLedger,
                            StorePartitionBackend)
 
-__all__ = ["PartitionMap", "PartitionMember", "RebalanceController",
-           "ReserveLedger", "StoreBackedPartitionMap",
-           "StoreBackedReserveLedger", "StorePartitionBackend"]
+__all__ = ["ElasticController", "PartitionMap", "PartitionMember",
+           "RebalanceController", "ReserveLedger",
+           "StoreBackedPartitionMap", "StoreBackedReserveLedger",
+           "StorePartitionBackend"]

@@ -39,7 +39,7 @@ class PartitionMember:
                  cache, epoch_fn: Callable[[], int],
                  time_fn: Callable[[], float] = time.monotonic,
                  starve_after_s: float = DEFAULT_STARVE_AFTER_S,
-                 rebalancer=None):
+                 rebalancer=None, elastic=None):
         self.pid = pid
         self.pmap = pmap
         self.ledger = ledger
@@ -53,6 +53,10 @@ class PartitionMember:
         # load signals and may move ONE owned queue through the
         # journaled move funnel. None = the PR 9 operator-only behavior.
         self.rebalancer = rebalancer
+        # elastic membership (federation/elastic.py): when an
+        # ElasticController rides this member, on_cycle_end may split
+        # this partition or drive its merge. None = fixed membership.
+        self.elastic = elastic
         ledger.attach_cache(pid, cache)
 
     # -- cycle hooks (leader-gated by the scheduler shell) -------------------
@@ -93,6 +97,14 @@ class PartitionMember:
                 self.rebalancer.step(now)
             except Exception:
                 log.exception("rebalancer step failed; next cycle "
+                              "re-evaluates")
+        if self.elastic is not None:
+            # the membership decision (split/merge) — isolated the same
+            # way: an elastic fault must not cost the scheduling cycle
+            try:
+                self.elastic.step(now)
+            except Exception:
+                log.exception("elastic step failed; next cycle "
                               "re-evaluates")
         metrics.set_partition_leader(self.pid, True, self.epoch_fn(),
                                     detail=self.detail())
@@ -172,4 +184,8 @@ class PartitionMember:
         }
         if self.rebalancer is not None:
             out["rebalance_moves"] = len(self.rebalancer.moves)
+        if self.elastic is not None:
+            out["splits"] = self.elastic.splits
+            out["merges"] = self.elastic.merges
+            out["retiring"] = self.elastic.retiring
         return out

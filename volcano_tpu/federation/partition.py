@@ -17,6 +17,16 @@ drain-safe. The raw mutators below (``_transfer_node_raw``,
 ``_transfer_queue_raw``, ``_pin_node_raw``, ``_begin_drain_raw``) exist
 for that funnel alone — vlint rule VT009 flags any call to them without
 a ``_journal_reserve`` witness on the path (docs/static-analysis.md).
+
+MEMBERSHIP is elastic (docs/federation.md membership-change protocol):
+partitions can be spawned and retired at runtime through the journaled
+``partition_spawn``/``partition_retire`` funnel on the reserve ledger.
+The membership raw mutators (``_spawn_partition_raw``,
+``_begin_retire_raw``, ``_retire_partition_raw``) exist for that funnel
+alone — vlint rule VT019 flags any call without a ``_journal_reserve``
+witness on the path. Partition ids are never reused: ``next_pid`` only
+grows, so a fencing epoch, journal record or pin that names a pid can
+never be confused with a later incarnation.
 """
 
 from __future__ import annotations
@@ -47,9 +57,31 @@ class PartitionMap:
         # ownership flip; the owner's scope excludes pinned nodes so it
         # cannot refill capacity it is about to hand over
         self.pinned: Dict[str, int] = {}
+        # elastic membership: pid -> "active" | "retiring". Static
+        # deployments never touch this, so the initial map is exactly
+        # {0..n-1: active} and every code path below degenerates to the
+        # fixed-N arithmetic (byte-compat with pre-elastic runs).
+        self.active: Dict[int, str] = {p: "active" for p in range(self.n)}
+        self.next_pid = self.n
         self.version = 0
         self._rr_queue = 0
         self._rr_node = 0
+
+    # -- membership lookups --------------------------------------------------
+
+    def active_pids(self) -> List[int]:
+        """Every live partition (including retiring ones still draining)."""
+        with self._lock:
+            return sorted(self.active)
+
+    def assignable_pids(self) -> List[int]:
+        """Partitions that may RECEIVE new ownership (not retiring)."""
+        with self._lock:
+            return sorted(p for p, s in self.active.items() if s == "active")
+
+    def state_of(self, pid: int) -> Optional[str]:
+        with self._lock:
+            return self.active.get(pid)
 
     # -- registration (watch stream; deterministic round-robin) -------------
 
@@ -57,7 +89,9 @@ class PartitionMap:
         """Assign a newly observed queue to a partition (idempotent)."""
         with self._lock:
             if name not in self.queue_owner:
-                self.queue_owner[name] = self._rr_queue % self.n
+                pids = sorted(p for p, s in self.active.items()
+                              if s == "active")
+                self.queue_owner[name] = pids[self._rr_queue % len(pids)]
                 self._rr_queue += 1
                 self.version += 1
             return self.queue_owner[name]
@@ -65,7 +99,9 @@ class PartitionMap:
     def register_node(self, name: str) -> int:
         with self._lock:
             if name not in self.node_owner:
-                self.node_owner[name] = self._rr_node % self.n
+                pids = sorted(p for p, s in self.active.items()
+                              if s == "active")
+                self.node_owner[name] = pids[self._rr_node % len(pids)]
                 self._rr_node += 1
                 self.version += 1
             return self.node_owner[name]
@@ -111,11 +147,11 @@ class PartitionMap:
 
     def counts(self) -> Dict[int, Dict[str, int]]:
         with self._lock:
-            out = {p: {"queues": 0, "nodes": 0} for p in range(self.n)}
+            out = {p: {"queues": 0, "nodes": 0} for p in sorted(self.active)}
             for p in self.queue_owner.values():
-                out[p]["queues"] += 1
+                out.setdefault(p, {"queues": 0, "nodes": 0})["queues"] += 1
             for p in self.node_owner.values():
-                out[p]["nodes"] += 1
+                out.setdefault(p, {"queues": 0, "nodes": 0})["nodes"] += 1
             return out
 
     # -- ownership transfer: reserve/transfer funnel ONLY (vlint VT009) -----
@@ -146,6 +182,34 @@ class PartitionMap:
     def _begin_drain_raw(self, queue: str, to: int) -> None:
         with self._lock:
             self.draining[queue] = to
+            self.version += 1
+
+    # -- elastic membership: spawn/retire funnel ONLY (vlint VT019) ---------
+
+    def _spawn_partition_raw(self) -> int:
+        """Mint a new partition id. Membership funnel only — callers
+        must journal the spawn (VT019). Pids are never reused."""
+        with self._lock:
+            pid = self.next_pid
+            self.next_pid = pid + 1
+            self.active[pid] = "active"
+            self.version += 1
+            return pid
+
+    def _begin_retire_raw(self, pid: int) -> None:
+        """Mark a partition retiring: it keeps scheduling what it still
+        owns but can no longer receive queues/nodes or be a registration
+        target. Membership funnel only (VT019)."""
+        with self._lock:
+            if pid in self.active:
+                self.active[pid] = "retiring"
+                self.version += 1
+
+    def _retire_partition_raw(self, pid: int) -> None:
+        """Remove a fully drained partition from the membership.
+        Membership funnel only (VT019)."""
+        with self._lock:
+            self.active.pop(pid, None)
             self.version += 1
 
     # -- the per-partition scheduler scope -----------------------------------

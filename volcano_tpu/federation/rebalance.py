@@ -197,7 +197,10 @@ class RebalanceController:
         loads = self.ledger.loads()
         coolest = None
         coolest_pending = None
-        for pid in range(self.pmap.n):
+        # assignable pids only: a retired partition's stale load entry
+        # (or a retiring one mid-drain) must never be a move target —
+        # the elastic retire funnel also purges its ledger signals
+        for pid in self.pmap.assignable_pids():
             if pid == self.pid:
                 continue
             other = loads.get(pid)

@@ -95,12 +95,17 @@ class ReserveLedger:
 
     def __init__(self, pmap: PartitionMap, journal=None, registry=None,
                  time_fn=time.monotonic,
-                 timeout_s: float = DEFAULT_TIMEOUT_S):
+                 timeout_s: float = DEFAULT_TIMEOUT_S,
+                 donor_guard: bool = False):
         self.pmap = pmap
         self.journal = journal
         self.registry = registry           # executors.FencingRegistry
         self.time_fn = time_fn
         self.timeout_s = timeout_s
+        # Opt-in (elastic membership wires it on): a donor with its own
+        # unadmitted gangs only donates EMPTY nodes. Off by default so
+        # the static-federation decision plane is unchanged.
+        self.donor_guard = donor_guard
         # reentrant: the store-backed subclass persists transitions
         # through a CAS funnel whose watch echo applies remote state
         # back onto this ledger's mirror — possibly on the same thread
@@ -210,6 +215,9 @@ class ReserveLedger:
     def _drop_request(self, req: ReserveRequest) -> None:
         pass
 
+    def _persist_membership_purge(self, pid: int) -> None:
+        pass
+
     def find(self, rid: int) -> Optional[ReserveRequest]:
         with self._lock:
             return self.requests.get(rid) or self.settled.get(rid)
@@ -239,7 +247,7 @@ class ReserveLedger:
         inspects another's cache."""
         best: Optional[int] = None
         best_idle = -1.0
-        for pid in range(self.pmap.n):
+        for pid in self.pmap.assignable_pids():
             if pid == frm:
                 continue
             if len(self.pmap.unpinned_nodes_of(pid)) <= 1:
@@ -257,7 +265,7 @@ class ReserveLedger:
         intent is stamped with BOTH partitions' fencing epochs — the
         requester's own and the owner epoch it observed through the
         fencing registry."""
-        if to == frm or not (0 <= to < self.pmap.n):
+        if to == frm or self.pmap.state_of(to) != "active":
             return None
         if self.outstanding(frm) is not None:
             return None
@@ -297,6 +305,83 @@ class ReserveLedger:
                 self._start_grant(req, cache, epoch)
             if req.state == GRANTING:
                 self._drain_and_transfer(req, cache, epoch)
+        self._vacate_pinned(pid, cache)
+        if self.donor_guard:
+            self._evict_straddlers(pid, cache)
+
+    def _evict_straddlers(self, pid: int, cache) -> None:
+        """Membership hygiene (elastic only, with ``donor_guard``): a
+        gang that is NOT fully admitted must not straddle a membership
+        change. After a queue move its half-bound tasks can sit on
+        nodes the new owner does not own — remote usage that still
+        counts against the queue's share while the scoped capacity can
+        never complete the gang (proportion sees the queue overused,
+        allocate binds nothing, the placed tasks' durations never start
+        because the gang never re-admits: a permanent deadlock). Evict
+        the foreign-placed tasks of unadmitted gangs; the gang re-pends
+        whole and binds cleanly inside the new owner's scope."""
+        from ..api import TaskStatus
+        owned = set(self.pmap.nodes_of(pid))
+        for job in sorted(cache.jobs.values(), key=lambda j: j.uid):
+            if job.ready():
+                continue
+            for uid in sorted(job.tasks):
+                task = job.tasks[uid]
+                if not task.node_name or task.node_name in owned:
+                    continue
+                if task.status in (TaskStatus.RELEASING,
+                                   TaskStatus.PENDING):
+                    continue
+                try:
+                    cache.evict(task, "membership-straddle")
+                except Exception:
+                    log.exception("straddler evict %s failed; the "
+                                  "resync queue owns the retry", uid)
+
+    def _vacate_pinned(self, pid: int, cache) -> None:
+        """Evict partition ``pid``'s own straggler tasks off any node
+        pinned for an open grant. Queue moves (rebalancer, elastic
+        split/merge) can home a RUNNING task in a partition that does
+        not own its node — the donor's drain walks only its own mirror,
+        so without this sweep a pinned node could transfer while still
+        loaded and the receiver would overcommit it. Each partition
+        evicts through its OWN journaled+fenced funnel; the donor's
+        drain waits for every mirror to empty."""
+        from ..api import TaskStatus
+        with self._lock:
+            pinned = sorted(req.node for req in self.requests.values()
+                            if req.state == GRANTING and req.node
+                            and req.to != pid)
+        for name in pinned:
+            node = cache.nodes.get(name)
+            if node is None or not node.tasks:
+                continue
+            for uid in sorted(node.tasks):
+                clone = node.tasks[uid]
+                job = cache.jobs.get(clone.job)
+                task = job.tasks.get(uid) if job is not None else None
+                if task is None or task.status == TaskStatus.RELEASING:
+                    continue
+                try:
+                    cache.evict(task, "cross-partition-reserve")
+                except Exception:
+                    log.exception("pinned-node vacate evict %s failed; "
+                                  "the resync queue owns the retry", uid)
+
+    @staticmethod
+    def _has_pending_demand(cache) -> bool:
+        """True when the donor's own cache holds an unadmitted gang with
+        PENDING tasks — the same demand signal ``_starved_need`` reads,
+        without the age horizon (OWN state only; never another
+        partition's cache)."""
+        from ..api import TaskStatus
+        for job in cache.jobs.values():
+            if job.min_available <= 0 or job.ready():
+                continue
+            for task in job.tasks.values():
+                if task.status == TaskStatus.PENDING:
+                    return True
+        return False
 
     def _eligible_nodes(self, pid: int, cache) -> List[str]:
         out = []
@@ -312,7 +397,15 @@ class ReserveLedger:
         request by ALLOCATABLE (capacity follows demand even when the
         node is currently busy — draining empties it), falling back to
         the largest node when none covers it fully. The owner always
-        keeps one unpinned node."""
+        keeps one unpinned node.
+
+        A donor that itself has PENDING demand may only hand over EMPTY
+        nodes: draining a busy node evicts running work the donor still
+        needs placed, and under systemic overload (everyone starved,
+        everyone publishing residual idle) those mutual drains destroy
+        bound work faster than it can complete — a cluster-wide
+        livelock. An unloaded donor keeps the original capacity-follows-
+        demand behavior: its busy nodes drain and transfer."""
         nodes = self._eligible_nodes(req.to, cache)
         if len(nodes) <= 1:
             with self._lock:
@@ -320,6 +413,14 @@ class ReserveLedger:
             self._journal_reserve("reserve_reject", rid=req.rid,
                                   epoch=epoch, reason="last-node")
             return
+        if self.donor_guard and self._has_pending_demand(cache):
+            nodes = [n for n in nodes if not cache.nodes[n].tasks]
+            if not nodes:
+                with self._lock:
+                    self._settle(req, REJECTED)
+                self._journal_reserve("reserve_reject", rid=req.rid,
+                                      epoch=epoch, reason="donor-loaded")
+                return
         covering = [n for n in nodes
                     if cache.nodes[n].allocatable.cpu >= req.cpu
                     and cache.nodes[n].allocatable.memory >= req.mem]
@@ -376,6 +477,15 @@ class ReserveLedger:
                                   "resync queue owns the retry", uid)
             if node.tasks:
                 return                 # not empty yet: next cycle
+        for other in self._caches.values():
+            # a task whose queue moved away (rebalancer/elastic) is
+            # homed in ANOTHER partition's cache while still placed on
+            # this node — that partition's _vacate_pinned sweep evicts
+            # it; the transfer must wait for every mirror to drain or
+            # the receiver would see a loaded node as empty
+            mirror = other.nodes.get(req.node)
+            if mirror is not None and mirror.tasks:
+                return
         self.pmap._transfer_node_raw(req.node, req.frm)
         with self._lock:
             req.epoch_granted = epoch
@@ -451,7 +561,8 @@ class ReserveLedger:
             dest_cache = self._caches.get(dest)
             if dest_cache is None:
                 continue
-            self._move_queue_jobs(queue, cache, dest_cache)
+            if not self._move_queue_jobs(queue, cache, dest_cache):
+                continue             # mirrors not ready: next cycle
             self.pmap._transfer_queue_raw(queue, dest)
             with self._lock:
                 self.queue_moves += 1
@@ -461,14 +572,42 @@ class ReserveLedger:
         return flipped
 
     @staticmethod
-    def _move_queue_jobs(queue: str, frm_cache, to_cache) -> None:
+    def _move_queue_jobs(queue: str, frm_cache, to_cache) -> bool:
         """Surgically move a drained queue's jobs between partition
         caches: the job objects (and their placed tasks' node-mirror
         accounting) leave the source cache — remove_job also purges any
         queued retry/dead-letter state, so no orphaned side effects —
-        and land in the destination, dirty-marked on both sides."""
+        and land in the destination, dirty-marked on both sides.
+
+        The move is all-or-nothing: before touching either cache it
+        proves every placed task fits its destination node mirror.
+        A mirror that cannot absorb the accounting (a transient skew
+        while an eviction or vacate sweep is still in flight) defers
+        the whole flip to the next cycle — a half-applied move would
+        strand jobs in a cache whose queue it no longer owns."""
+        from ..api import TaskStatus
         moved = [j for j in list(frm_cache.jobs.values())
                  if j.queue == queue]
+        demand: Dict[str, List] = {}
+        for job in moved:
+            for task in job.tasks.values():
+                if task.node_name and task.status != TaskStatus.PIPELINED:
+                    demand.setdefault(task.node_name, []).append(task)
+        for node_name, tasks in demand.items():
+            node = to_cache.nodes.get(node_name)
+            if node is None:
+                continue
+            headroom = node.idle.clone()
+            for task in tasks:
+                if task.uid in node.tasks:
+                    continue
+                if not task.resreq.less_equal(headroom):
+                    log.warning(
+                        "deferring queue %s move: node %s mirror in the "
+                        "destination cannot absorb task %s yet",
+                        queue, node_name, task.uid)
+                    return False
+                headroom.sub(task.resreq)
         for job in moved:
             frm_cache.remove_job(job.uid)
             for task in job.tasks.values():
@@ -487,6 +626,138 @@ class ReserveLedger:
                 if node is not None and task.uid not in node.tasks:
                     to_cache.mark_node_dirty(node.name)
                     node.add_task(task)
+        return True
+
+    # -- elastic membership (the same journaled funnel; vlint VT019) ---------
+
+    def release_nodes(self, pid: int, to: int, epoch: int) -> int:
+        """MERGE node drain: hand every unpinned node partition ``pid``
+        owns that is EMPTY in its own cache (its resident tasks either
+        completed or left with their moved jobs — whose mirrors already
+        live in the destination cache) to partition ``to``, through the
+        journaled transfer funnel. Nodes still running the retiring
+        partition's tasks stay until they drain naturally; pinned nodes
+        belong to an open reserve, which ``retire_blockers`` defers on
+        anyway. Returns how many nodes ``pid`` still owns."""
+        if self.registry is not None \
+                and epoch < self.registry.current(pid):
+            return len(self.pmap.nodes_of(pid))
+        if self.pmap.state_of(to) != "active":
+            return len(self.pmap.nodes_of(pid))
+        cache = self._caches.get(pid)
+        for name in self.pmap.unpinned_nodes_of(pid):
+            node = cache.nodes.get(name) if cache is not None else None
+            if node is not None and node.tasks:
+                continue
+            self._journal_reserve("node_handoff", node=name, frm=pid,
+                                  to=to, epoch=epoch)
+            self.pmap._transfer_node_raw(name, to)
+            with self._lock:
+                self.node_transfers += 1
+        return len(self.pmap.nodes_of(pid))
+
+    def partition_spawn(self, frm: int, epoch: int) -> Optional[int]:
+        """SPLIT phase 1: mint a new partition id through the journaled
+        membership funnel. ``frm`` is the splitting partition; its
+        fencing epoch gates the record (a deposed leader may not grow
+        the membership). Store-backed, the mint is one CAS on the
+        PartitionState CR — other partitions see the new member or
+        don't, never a torn state. The caller (the elastic controller's
+        runner hooks) then spawns the scheduler shell + per-partition
+        Lease and moves queues via the EXISTING ``move_queue`` funnel,
+        so no job is ever schedulable by two partitions at any
+        instant."""
+        if self.registry is not None \
+                and epoch < self.registry.current(frm):
+            return None
+        pid = self.pmap._spawn_partition_raw()
+        self._journal_reserve("partition_spawn", pid=pid, frm=frm,
+                              epoch=epoch)
+        return pid
+
+    def begin_retire(self, pid: int, epoch: int) -> bool:
+        """MERGE phase 1: mark ``pid`` retiring — it keeps scheduling
+        what it still owns while its queues drain away through
+        ``move_queue``, but can no longer receive ownership, be a
+        donor/requester target, or take new registrations. Refuses for
+        the last active partition (the membership never empties)."""
+        if self.pmap.state_of(pid) != "active":
+            return False
+        if self.registry is not None \
+                and epoch < self.registry.current(pid):
+            return False
+        if len(self.pmap.assignable_pids()) <= 1:
+            return False
+        self._journal_reserve("partition_retire_begin", pid=pid,
+                              epoch=epoch)
+        self.pmap._begin_retire_raw(pid)
+        return True
+
+    def retire_blockers(self, pid: int) -> List[str]:
+        """What still prevents ``pid`` from retiring — the merge defers
+        (returns non-empty) while ANY of these reference the partition:
+        owned queues/nodes, draining moves touching it, an OPEN reserve
+        naming it as requester or owner (a pin held by a retiring
+        partition releases only by grant or deadline expiry — the
+        ledger, not the retirement, owns that lifecycle), or an open
+        journal intent on a job still homed in its cache."""
+        out: List[str] = []
+        if self.pmap.queues_of(pid):
+            out.append("owned-queues")
+        if self.pmap.nodes_of(pid):
+            out.append("owned-nodes")
+        with self.pmap._lock:
+            draining = dict(self.pmap.draining)
+        for queue, dest in draining.items():
+            if dest == pid:
+                out.append("draining-inbound")
+                break
+        with self._lock:
+            for req in self.requests.values():
+                if req.state in _OPEN and pid in (req.frm, req.to):
+                    out.append("open-reserve")
+                    break
+        cache = self._caches.get(pid)
+        if cache is not None and self.journal is not None:
+            for intent in self.journal.unacked():
+                if intent.job in cache.jobs:
+                    out.append("open-intent")
+                    break
+        return out
+
+    def partition_retire(self, pid: int, epoch: int) -> bool:
+        """MERGE phase 2: retire a fully drained partition. Defers
+        (returns False) while ``retire_blockers`` is non-empty — in
+        particular an open cross-partition reserve pin held by the
+        retiring partition defers retirement until the ledger's
+        deadline expiry releases it. On success the membership record
+        journals, the pid leaves the map, and every ledger signal the
+        partition ever published (idle, load, load_seen freshness,
+        cache attachment) is purged so the retired pid can never
+        linger as a ghost donor or rebalance target."""
+        if self.pmap.state_of(pid) is None:
+            return False
+        if self.registry is not None \
+                and epoch < self.registry.current(pid):
+            return False
+        if self.retire_blockers(pid):
+            return False
+        self._journal_reserve("partition_retire", pid=pid, epoch=epoch)
+        self.pmap._retire_partition_raw(pid)
+        self.purge_partition(pid)
+        return True
+
+    def purge_partition(self, pid: int) -> None:
+        """Drop every per-partition signal for a retired pid (the ghost
+        -partition fix): without this, stale ``load_seen``/``idle``
+        entries keep the dead pid a candidate donor and rebalance
+        target until freshness expiry."""
+        with self._lock:
+            self._idle.pop(pid, None)
+            self._load.pop(pid, None)
+            self._load_seen.pop(pid, None)
+            self._caches.pop(pid, None)
+        self._persist_membership_purge(pid)
 
     # -- introspection -------------------------------------------------------
 

@@ -70,7 +70,18 @@ class NoChange(Exception):
 def _initial_state(n: int) -> dict:
     return {"n": int(n), "queue_owner": {}, "node_owner": {},
             "pinned": {}, "draining": {}, "rr_queue": 0, "rr_node": 0,
-            "idle": {}, "requests": {}, "next_rid": 1, "version": 0}
+            "idle": {}, "requests": {}, "next_rid": 1,
+            "active": {p: "active" for p in range(int(n))},
+            "next_pid": int(n), "version": 0}
+
+
+def _state_active(state: dict) -> dict:
+    """The membership map off a CR spec, tolerating pre-elastic CRs
+    that predate the ``active`` field (static {0..n-1} membership)."""
+    active = state.get("active")
+    if active is None:
+        active = {p: "active" for p in range(int(state["n"]))}
+    return active
 
 
 class StorePartitionBackend:
@@ -191,6 +202,9 @@ class StoreBackedPartitionMap(PartitionMap):
             self.draining = dict(state.get("draining", {}))
             self._rr_queue = int(state.get("rr_queue", 0))
             self._rr_node = int(state.get("rr_node", 0))
+            self.active = {int(p): s
+                           for p, s in _state_active(state).items()}
+            self.next_pid = int(state.get("next_pid", state.get("n", 0)))
             self.version = int(state.get("version", 0))
 
     # -- registration (watch stream; CAS-allocated round-robin) --------------
@@ -204,7 +218,9 @@ class StoreBackedPartitionMap(PartitionMap):
             owner = state["queue_owner"].get(name)
             if owner is not None:
                 raise NoChange(owner)     # idempotent re-registration
-            owner = state["rr_queue"] % state["n"]
+            pids = sorted(int(p) for p, s in _state_active(state).items()
+                          if s == "active")
+            owner = pids[state["rr_queue"] % len(pids)]
             state["queue_owner"][name] = owner
             state["rr_queue"] += 1
             return owner
@@ -220,7 +236,9 @@ class StoreBackedPartitionMap(PartitionMap):
             owner = state["node_owner"].get(name)
             if owner is not None:
                 raise NoChange(owner)
-            owner = state["rr_node"] % state["n"]
+            pids = sorted(int(p) for p, s in _state_active(state).items()
+                          if s == "active")
+            owner = pids[state["rr_node"] % len(pids)]
             state["node_owner"][name] = owner
             state["rr_node"] += 1
             return owner
@@ -267,6 +285,56 @@ class StoreBackedPartitionMap(PartitionMap):
             state["draining"][queue] = to
 
         self.backend.mutate(drain)
+
+    # -- elastic membership (spawn/retire funnel only; persist-first) --------
+
+    def _spawn_partition_raw(self) -> int:
+        def spawn(state: dict) -> int:
+            active = _state_active(state)
+            pid = int(state.get("next_pid", state["n"]))
+            active[pid] = "active"
+            state["active"] = active
+            state["next_pid"] = pid + 1
+            return pid
+
+        pid = self.backend.mutate(spawn)
+        # the watch echo replaces the mirror wholesale; apply eagerly
+        # too so the caller's active_pids() sees the pid it just minted
+        with self._lock:
+            self.active[pid] = "active"
+            self.next_pid = max(self.next_pid, pid + 1)
+            self.version += 1
+        return pid
+
+    def _begin_retire_raw(self, pid: int) -> None:
+        def mark(state: dict) -> None:
+            active = _state_active(state)
+            if str(pid) in active:
+                active[str(pid)] = "retiring"
+            elif pid in active:
+                active[pid] = "retiring"
+            else:
+                raise NoChange()
+            state["active"] = active
+
+        self.backend.mutate(mark)
+        with self._lock:
+            if pid in self.active:
+                self.active[pid] = "retiring"
+                self.version += 1
+
+    def _retire_partition_raw(self, pid: int) -> None:
+        def drop(state: dict) -> None:
+            active = _state_active(state)
+            if active.pop(str(pid), None) is None \
+                    and active.pop(pid, None) is None:
+                raise NoChange()
+            state["active"] = active
+
+        self.backend.mutate(drop)
+        with self._lock:
+            self.active.pop(pid, None)
+            self.version += 1
 
 
 class StoreBackedReserveLedger(ReserveLedger):
@@ -337,6 +405,26 @@ class StoreBackedReserveLedger(ReserveLedger):
             state.setdefault("load", {})[pid] = dict(load)
 
         self.backend.mutate(put)
+
+    def _persist_membership_purge(self, pid: int) -> None:
+        def purge(state: dict) -> None:
+            hit = False
+            for key in ("idle", "load"):
+                table = state.get(key, {})
+                if table.pop(pid, None) is not None \
+                        or table.pop(str(pid), None) is not None:
+                    hit = True
+            if not hit:
+                raise NoChange()
+
+        try:
+            self.backend.mutate(purge)
+        except Exception:
+            # a purge whose CR write failed leaves stale idle/load
+            # entries for a pid no longer in the membership — harmless:
+            # every reader iterates active pids, never these tables
+            log.exception("purging retired partition %d from the CR "
+                          "failed", pid)
 
     # -- mirror application --------------------------------------------------
 

@@ -250,6 +250,19 @@ if _HAVE_PROM:
         "Load-driven partition rebalancer decisions "
         "(result=moved|refused|abstained; docs/federation.md)",
         ["result"])
+    _partition_count = Gauge(
+        f"{_SUBSYSTEM}_partition_count",
+        "Live federation partitions (elastic membership; "
+        "docs/federation.md)")
+    _partition_splits = Counter(
+        f"{_SUBSYSTEM}_partition_splits_total",
+        "Elastic membership splits through the journaled "
+        "partition_spawn funnel (result=executed|refused)", ["result"])
+    _partition_merges = Counter(
+        f"{_SUBSYSTEM}_partition_merges_total",
+        "Elastic membership merges through the journaled "
+        "partition_retire funnel (result=begun|completed|refused)",
+        ["result"])
 
 
 def update_e2e_duration(seconds: float) -> None:
@@ -313,6 +326,16 @@ def health_detail() -> dict:
             "cross_partition_reserves_total": {
                 k[1]: v for k, v in _counters.items()
                 if k[0] == "cross_partition_reserves"},
+            # elastic membership (docs/federation.md): the live count
+            # plus split/merge outcome rollups; per-partition elastic
+            # state lives under federation.elastic
+            "partition_count": int(_gauges.get(("partition_count",), 0)),
+            "partition_splits_total": {
+                k[1]: v for k, v in _counters.items()
+                if k[0] == "partition_splits"},
+            "partition_merges_total": {
+                k[1]: v for k, v in _counters.items()
+                if k[0] == "partition_merges"},
             # the store boundary (docs/robustness.md store failure
             # model): retry-funnel + fault + watch-stream state pushed by
             # the transports/watch manager, plus the counter totals
@@ -782,6 +805,45 @@ def set_rebalance_detail(partition: int, detail: dict) -> None:
             dict(detail)
 
 
+def set_partition_count(n: int) -> None:
+    """Publish the live federation partition count — the
+    volcano_partition_count gauge the elastic membership moves
+    (docs/federation.md)."""
+    with _lock:
+        _gauges[("partition_count",)] = float(n)
+        fed = _health_detail.setdefault("federation", {"enabled": True})
+        fed["partition_count"] = int(n)
+    if _HAVE_PROM:
+        _partition_count.set(n)
+
+
+def register_partition_split(result: str) -> None:
+    """One elastic split decision settled (result=executed|refused) —
+    volcano_partition_splits_total{result}."""
+    with _lock:
+        _counters[("partition_splits", result)] += 1
+    if _HAVE_PROM:
+        _partition_splits.labels(result=result).inc()
+
+
+def register_partition_merge(result: str) -> None:
+    """One elastic merge step settled (result=begun|completed|refused)
+    — volcano_partition_merges_total{result}."""
+    with _lock:
+        _counters[("partition_merges", result)] += 1
+    if _HAVE_PROM:
+        _partition_merges.labels(result=result).inc()
+
+
+def set_elastic_detail(partition: int, detail: dict) -> None:
+    """Publish one partition's elastic-membership state into
+    /healthz?detail's federation section (``federation.elastic``) for
+    ``vcctl federation elastic-status``."""
+    with _lock:
+        fed = _health_detail.setdefault("federation", {"enabled": True})
+        fed.setdefault("elastic", {})[str(partition)] = dict(detail)
+
+
 # In-process mirror key -> Prometheus family for the no-prometheus_client
 # /metrics fallback: first tuple element maps to (family name, label name,
 # type). Keys absent here expose as volcano_<key0> gauges with a generic
@@ -800,6 +862,7 @@ _EXPO_GAUGES = {
     "device_healthy": (f"{_SUBSYSTEM}_device_healthy", None),
     "leader": (f"{_SUBSYSTEM}_leader", None),
     "partition_leader": (f"{_SUBSYSTEM}_partition_leader", "partition"),
+    "partition_count": (f"{_SUBSYSTEM}_partition_count", None),
     "tensor_epochs_live": (f"{_SUBSYSTEM}_tensor_epochs_live", None),
     "store_watch_staleness": (f"{_SUBSYSTEM}_store_watch_staleness", None),
     "inflight_open": (f"{_SUBSYSTEM}_inflight_open", None),
@@ -854,6 +917,8 @@ _EXPO_COUNTERS = {
     "audit_latest_evicted": (f"{_SUBSYSTEM}_audit_latest_evicted_total",
                              None),
     "rebalance_moves": (f"{_SUBSYSTEM}_rebalance_moves_total", "result"),
+    "partition_splits": (f"{_SUBSYSTEM}_partition_splits_total", "result"),
+    "partition_merges": (f"{_SUBSYSTEM}_partition_merges_total", "result"),
 }
 # duration-series key -> (family, label name, unit suffix already in name)
 _EXPO_DURATIONS = {

@@ -193,6 +193,22 @@ def main(argv=None) -> int:
     ap.add_argument("--rebalance", action="store_true",
                     help="enable the load-driven partition rebalancer "
                          "(requires --federated)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="enable elastic partition membership: chronic "
+                         "cycle-budget exhaustion SPLITS a partition "
+                         "(journaled partition_spawn, queues transferred "
+                         "through the reserve funnel), chronic idleness "
+                         "MERGES it back (drain + partition_retire); "
+                         "requires --federated N (N may be 1 — the "
+                         "1->N->1 diurnal case; docs/federation.md)")
+    ap.add_argument("--verify-elastic-equivalence", action="store_true",
+                    help="assert the elastic contract: at least one "
+                         "split AND one merge fired, membership "
+                         "returned to the initial partition count, "
+                         "per-queue depth stayed bounded, every "
+                         "admitted gang completed with zero "
+                         "double-binds, byte-deterministic x2 "
+                         "(exit 1 otherwise)")
     ap.add_argument("--verify-overload-equivalence", action="store_true",
                     help="assert the overload contract: bounded "
                          "per-queue pending depth, max cycle spend "
@@ -303,6 +319,10 @@ def main(argv=None) -> int:
     budget_cost = 0.002 * args.period if cycle_budget else 0.0
     if rebalance and not args.federated:
         ap.error("--rebalance requires --federated N")
+    if args.elastic and not args.federated:
+        ap.error("--elastic requires --federated N (N may be 1)")
+    if args.verify_elastic_equivalence and not args.elastic:
+        ap.error("--verify-elastic-equivalence requires --elastic")
     if args.verify_ack_equivalence and not ack_fault_rate:
         # without faults the report has no feedback section and every
         # stuck-state assertion would pass vacuously
@@ -328,6 +348,9 @@ def main(argv=None) -> int:
                            admission_depth=admission_depth,
                            overload_burst_rate=burst_rate,
                            rebalance=rebalance
+                           and bool(args.federated
+                                    if federated is None else federated),
+                           elastic=args.elastic
                            and bool(args.federated
                                     if federated is None else federated),
                            seed=args.seed, max_cycles=args.max_cycles,
@@ -517,6 +540,59 @@ def main(argv=None) -> int:
               f"bursts={ov.get('burst_jobs', 0)}, "
               f"rebalance_moves="
               f"{(reb or {}).get('move_count', 0)}, "
+              f"restarts={report.get('restarts', 0)}, "
+              f"accounting={terminal_accounting(report)}",
+              file=sys.stderr)
+    if args.verify_elastic_equivalence:
+        el = report.get("federation", {}).get("elastic") or {}
+        problems = []
+        if not el.get("enabled"):
+            problems.append("no elastic section in the report — the "
+                            "controller never attached")
+        if not el.get("splits"):
+            problems.append("no partition split fired: the scenario "
+                            "never sustained cycle-budget exhaustion "
+                            "long enough (tune the flash crowd or the "
+                            "budget preset)")
+        if not el.get("merges"):
+            problems.append("no partition merge fired: spawned "
+                            "partitions never drained back")
+        if el.get("partitions_final") != el.get("partitions_initial"):
+            problems.append(
+                f"membership did not return to the initial count: "
+                f"final={el.get('partitions_final')} "
+                f"initial={el.get('partitions_initial')}")
+        if report["jobs"]["completed"] != report["jobs"]["arrived"] \
+                or report["jobs"]["unfinished"]:
+            problems.append("not every admitted gang completed across "
+                            f"membership changes: {report['jobs']}")
+        if report.get("double_binds"):
+            problems.append(f"double-binds across membership changes: "
+                            f"{report['double_binds']}")
+        adm = report.get("overload", {}).get("admission", {})
+        if adm and adm.get("max_queue_depth"):
+            over = {q: d for q, d in adm.get("high_water", {}).items()
+                    if d > adm["max_queue_depth"]}
+            if over:
+                problems.append(f"per-queue depth bound violated across "
+                                f"split/merge: {over} > "
+                                f"{adm['max_queue_depth']}")
+        # byte-determinism x2: split/merge triggers are virtual-clock
+        # hysteresis over seeded load, so an identical re-run must
+        # reproduce the decision plane byte-for-byte
+        rerun = run(kill_cycles)
+        if deterministic_json(report) != deterministic_json(rerun):
+            problems.append("elastic run not byte-deterministic x2")
+        if problems:
+            for p in problems:
+                print(f"elastic-equivalence FAILED: {p}", file=sys.stderr)
+            return 1
+        print(f"elastic-equivalence OK: splits={el.get('splits')}, "
+              f"merges={el.get('merges')}, "
+              f"peak={el.get('partitions_peak')}, "
+              f"final={el.get('partitions_final')}, "
+              f"max_queue_depth={el.get('max_queue_depth')}, "
+              f"abstentions={el.get('abstentions')}, "
               f"restarts={report.get('restarts', 0)}, "
               f"accounting={terminal_accounting(report)}",
               file=sys.stderr)

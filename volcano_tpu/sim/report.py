@@ -261,6 +261,13 @@ def build_report(runner, actions_ms: Dict[tuple, list],
             # deterministic from published load signals + the virtual
             # clock — the fed-hotspot convergence witness
             report["federation"]["rebalance"] = runner.rebalance_stats()
+        if getattr(runner, "elastic", False):
+            # load-driven membership (federation/elastic.py): splits,
+            # merges, the partition-count trajectory, and the bounded
+            # per-queue depth witness — deterministic from published
+            # load + the virtual clock (the diurnal-flash-crowd 1→N→1
+            # acceptance section)
+            report["federation"]["elastic"] = runner.elastic_stats()
     elif getattr(runner, "replicas", None):
         report["ha"] = {
             "replicas": runner.ha_replicas,

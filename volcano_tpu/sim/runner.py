@@ -238,7 +238,8 @@ class SimRunner:
                  admission_depth: int = 0,
                  overload_burst_rate: float = 0.0,
                  overload_seed: Optional[int] = None,
-                 rebalance: bool = False):
+                 rebalance: bool = False,
+                 elastic: bool = False):
         self.trace = list(trace)
         self.period = period
         self.seed = seed
@@ -284,8 +285,11 @@ class SimRunner:
         # reserve/transfer protocol. Mutually exclusive with --ha (the
         # two topologies answer different questions).
         self.federated = max(int(federated_partitions or 0), 0)
-        if self.federated == 1:
-            self.federated = 0              # one partition == standalone
+        if self.federated == 1 and not elastic:
+            # one partition == standalone — EXCEPT under elastic
+            # membership, where "1" is just today's partition count and
+            # the federation machinery must be live to grow it
+            self.federated = 0
         if self.federated and self.ha_replicas > 1:
             raise ValueError("ha_replicas and federated_partitions are "
                              "mutually exclusive")
@@ -371,6 +375,15 @@ class SimRunner:
         self.overload_seed = seed if overload_seed is None \
             else overload_seed
         self.rebalance = bool(rebalance)
+        # elastic membership (docs/federation.md): the partition COUNT
+        # itself becomes load-driven — chronically budget-exhausted
+        # partitions split, chronically idle ones merge back, through
+        # the journaled partition_spawn/partition_retire funnel. The
+        # runner is the host supervisor: its spawn/retire hooks build
+        # and reap partition shells mid-run.
+        self.elastic = bool(elastic)
+        if self.elastic and not self.federated:
+            raise ValueError("elastic requires federated_partitions")
         self.overload = bool(self.cycle_budget_s or self.admission_depth
                              or self.overload_burst_rate
                              or self.rebalance)
@@ -399,6 +412,21 @@ class SimRunner:
         self._rebalance_moves: List[dict] = []
         self._rebalance_base = {"abstentions": 0, "refused": 0}
         self._rebalancers: Dict[int, object] = {}
+        # elastic bookkeeping: live controllers per pid, counters
+        # harvested from dead/retired incarnations, the deterministic
+        # membership-change audit trail, and the trace specs a newborn
+        # partition's cache backfills from (its "relist")
+        self._elastics: Dict[int, object] = {}
+        self._elastic_base = {"splits": 0, "merges": 0,
+                              "abstentions": 0, "refused": 0}
+        self._elastic_events: List[dict] = []
+        self._partition_peak = self.federated
+        self._queue_specs: Dict[str, dict] = {}
+        self._node_specs: Dict[str, dict] = {}
+        self._unready_nodes: set = set()
+        self._cache_by_pid: Dict[int, SchedulerCache] = {}
+        self._retired_watch_counts = {"resumes": 0, "relists": 0}
+        self._max_queue_depth = 0
         self.pmap = None
         self.ledger = None
         self.registry = None
@@ -728,6 +756,19 @@ class SimRunner:
             # burst-injection routing table (seeded OverloadInjector
             # picks a queue index; watch-stream order = deterministic)
             self._queue_names.append(d["name"])
+        # elastic spawns backfill a newborn partition's cache from these
+        # recorded specs (the relist a fresh process start performs)
+        if ev.kind == "queue_add":
+            self._queue_specs[d["name"]] = dict(d)
+        elif ev.kind == "node_add":
+            self._node_specs[d["name"]] = dict(d)
+        elif ev.kind == "node_fail":
+            self._node_specs.pop(d["name"], None)
+            self._unready_nodes.discard(d["name"])
+        elif ev.kind == "node_drain":
+            self._unready_nodes.add(d["name"])
+        elif ev.kind == "node_restore":
+            self._unready_nodes.discard(d["name"])
         if self.pmap is not None:
             # federated: the watch stream also feeds the partition map
             # (deterministic round-robin in stream order)
@@ -837,9 +878,12 @@ class SimRunner:
         if self.federated:
             # partitioned ingestion: the job materializes only in its
             # queue's owning partition (a server-side filtered watch) —
-            # which is also what keeps the 1M-job scenario affordable
+            # which is also what keeps the 1M-job scenario affordable.
+            # Looked up BY PID (elastic membership retires pids, so a
+            # list index is not an identity)
             pid = self.pmap.owner_of_queue(d["queue"])
-            caches = [self.caches[pid if pid is not None else 0]]
+            cache = self._cache_by_pid.get(pid)
+            caches = [cache if cache is not None else self.caches[0]]
         for cache in caches:
             scalars = {"nvidia.com/gpu": float(d["gpus"])} if d["gpus"] \
                 else None
@@ -1113,7 +1157,13 @@ class SimRunner:
                 # drain the ack wire: a delayed/stale replay still in
                 # flight must meet the normalizer, not die with the run
                 and not self._ack_wire.pending()
-                and not any(c.feedback.pending() for c in self.caches))
+                and not any(c.feedback.pending() for c in self.caches)
+                # elastic runs end on the SHRUNK membership: spawned
+                # partitions idle out and merge back before the run
+                # reports terminal accounting (the 1→N→1 witness);
+                # stall_limit remains the backstop for a wedged merge
+                and (not self.elastic
+                     or len(self.replicas) <= max(self.federated, 1)))
 
     # -- HA control plane (docs/robustness.md) ------------------------------
 
@@ -1429,13 +1479,18 @@ class SimRunner:
         self.ledger = ReserveLedger(self.pmap, journal=self.journal,
                                     registry=self.registry,
                                     time_fn=self.clock.time,
-                                    timeout_s=8 * self.period)
+                                    timeout_s=8 * self.period,
+                                    donor_guard=self.elastic)
         self.caches: List[SchedulerCache] = []
         self._view_ix = 0
         self._fed_oracles: Dict[int, tuple] = {}
         self._p_leader_key: Dict[int, Optional[tuple]] = {}
         self._p_vacant: Dict[int, Optional[int]] = {}
         self._p_had: Dict[int, bool] = {}
+        # kept for elastic spawns: a newborn partition's executor gate
+        # wraps the SAME (possibly kill-wrapped) cluster executors
+        self._fed_binder = binder
+        self._fed_evictor = evictor
         for pid in range(self.federated):
             rep = _Replica(pid)
             cache = SchedulerCache(
@@ -1455,11 +1510,13 @@ class SimRunner:
             self._build_partition_shell(rep)
             self.replicas.append(rep)
             self.caches.append(cache)
+            self._cache_by_pid[pid] = cache
             self._p_leader_key[pid] = None
             self._p_vacant[pid] = None
             self._p_had[pid] = False
         self.cache = self.caches[0]
         self.sched = self.replicas[0].sched
+        metrics.set_partition_count(len(self.replicas))
 
     def _build_partition_shell(self, rep: _Replica) -> None:
         """(Re)build one partition's scheduler shell + elector + member
@@ -1519,8 +1576,213 @@ class SimRunner:
                 max_cooldown_s=64 * self.period)
             member.rebalancer = ctrl
             self._rebalancers[pid] = ctrl
+        if self.elastic:
+            # elastic membership (federation/elastic.py): this
+            # partition's controller may split it or drive its merge,
+            # with the runner as host supervisor (spawn_fn/retire_fn
+            # build and reap shells). A restart loses streak/flap
+            # state (volatile) but never the audit counters — the
+            # runner harvests a dying incarnation's in
+            # _crash_restart_partition; a killed RETIRING partition
+            # resumes its drain from the persisted membership state.
+            from ..federation import ElasticController
+            ectrl = ElasticController(
+                pid, pmap, ledger, rep.cache,
+                epoch_fn=lambda r=rep: r.elector.fencing_epoch,
+                time_fn=self.clock.time,
+                exhausted_fn=lambda s=sched: s.budget_exhausted_total,
+                spawn_fn=self._spawn_partition,
+                retire_fn=self._retire_partition,
+                cooldown_s=16 * self.period,
+                max_cooldown_s=128 * self.period)
+            member.elastic = ectrl
+            self._elastics[pid] = ectrl
         sched.federation = member
         rep.sched = sched
+
+    # -- elastic membership hooks (federation/elastic.py) --------------------
+
+    def _spawn_partition(self, pid: int) -> None:
+        """Host half of a SPLIT: the journaled ``partition_spawn``
+        already minted ``pid`` in the map; build the newborn's cache +
+        scheduler shell + per-partition Lease/FencingAuthority — what a
+        real deployment's supervisor does when it exec's one more
+        partition process. The newborn owns nothing until the split's
+        queue moves settle through the drain funnel; its cache
+        backfills queues/nodes from the recorded trace specs (direct
+        mode — the relist a fresh process runs) or its own filtered
+        informers (store mode)."""
+        rep = _Replica(pid)
+        if self.store_wired:
+            from ..federation import (StoreBackedPartitionMap,
+                                      StoreBackedReserveLedger,
+                                      StorePartitionBackend)
+            # the newborn's own hostile store chain: pid-indexed seed
+            # derivation, identical to an up-front partition's
+            while len(self.world.transports) <= pid:
+                self.world.add_scheduler()
+            backend = StorePartitionBackend(self.world.transports[pid],
+                                            self.federated)
+            pmap_p = StoreBackedPartitionMap(backend)
+            ledger = StoreBackedReserveLedger(
+                pmap_p, backend, journal=self.journal,
+                registry=self.registry, time_fn=self.clock.time,
+                timeout_s=8 * self.period, donor_guard=self.elastic)
+            cache, b, e = self.world.build_cache(
+                pid, self._fed_binder_wrap, self._fed_evictor_wrap,
+                journal=self.journal,
+                event_filter=self._fed_event_filter(pid))
+            self._pin_store_feedback(cache, pid)
+            if self.kill_cycles:
+                kb, ke = KillPointBinder(b), KillPointEvictor(e)
+                self._store_kill_wrappers[pid] = (kb, ke)
+                b, e = kb, ke
+            cache.binder = FencedBinder(
+                b, lambda r=rep: r.elector.fencing_epoch,
+                self.registry.authority(pid))
+            cache.evictor = FencedEvictor(
+                e, lambda r=rep: r.elector.fencing_epoch,
+                self.registry.authority(pid))
+            cache.snapshot_scope = \
+                lambda ci, m=pmap_p, p=pid: m.scope(ci, p)
+            rep.cache = cache
+            ledger.attach_cache(pid, cache)
+            self._p_maps[pid] = pmap_p
+            self._p_ledgers[pid] = ledger
+            self.ledgers.append(ledger)
+            # cross-attach (see _init_federated_store): the newborn's
+            # mirror learns every live cache, every live mirror learns
+            # the newborn's — settle_moves needs the destination cache
+            for other_pid, other_cache in self._cache_by_pid.items():
+                ledger.attach_cache(other_pid, other_cache)
+            for lg in self.ledgers:
+                lg.attach_cache(pid, cache)
+        else:
+            cache = SchedulerCache(
+                binder=FencedBinder(
+                    self._fed_binder,
+                    lambda r=rep: r.elector.fencing_epoch,
+                    self.registry.authority(pid)),
+                evictor=FencedEvictor(
+                    self._fed_evictor,
+                    lambda r=rep: r.elector.fencing_epoch,
+                    self.registry.authority(pid)),
+                default_queue=None, journal=self.journal)
+            cache.resync_queue.time_fn = self.clock.time
+            cache.time_fn = self.clock.time
+            self._pin_feedback(cache)
+            cache.snapshot_scope = \
+                lambda ci, p=pid: self.pmap.scope(ci, p)
+            rep.cache = cache
+        # the relist: every queue and node the watch stream has
+        # announced so far (jobs arrive only via the move funnel). A
+        # real newborn process LISTS before it watches — its informers
+        # replay existing objects; the store-wired cache's watch only
+        # delivers events from now on, so both modes backfill here.
+        cache = rep.cache
+        for spec in self._queue_specs.values():
+            cache.add_queue(QueueInfo(name=spec["name"],
+                                      weight=spec["weight"]))
+        for spec in self._node_specs.values():
+            scalars = {"nvidia.com/gpu": float(spec["gpus"])} \
+                if spec["gpus"] else None
+            alloc = Resource(spec["cpu_milli"], spec["mem"],
+                             scalars)
+            alloc.max_task_num = spec["pods"]
+            node = NodeInfo(name=spec["name"], allocatable=alloc)
+            if spec["name"] in self._unready_nodes:
+                node.ready = False
+            cache.add_node(node)
+        self._build_partition_shell(rep)
+        self.replicas.append(rep)
+        self.caches.append(rep.cache)
+        self._cache_by_pid[pid] = rep.cache
+        self._p_leader_key[pid] = None
+        self._p_vacant[pid] = None
+        self._p_had[pid] = False
+        self._partition_peak = max(self._partition_peak,
+                                   len(self.replicas))
+        self._elastic_events.append(
+            {"cycle": self.cycles, "kind": "spawn", "pid": pid})
+        metrics.set_partition_count(len(self.replicas))
+
+    def _retire_partition(self, pid: int) -> None:
+        """Host half of a MERGE: the journaled ``partition_retire``
+        already removed ``pid`` from the map with its ownership fully
+        drained; reap the shell, folding every per-process counter the
+        report aggregates into the run totals (the same harvest a
+        crash restart performs — retirement is just a PLANNED process
+        exit). Pids are never reused, so the reaped slot simply
+        disappears from the live lists."""
+        rep = next((r for r in self.replicas if r.ix == pid), None)
+        if rep is None:
+            return
+        self._harvest_budget(rep.sched)
+        ctrl = self._rebalancers.pop(pid, None)
+        if ctrl is not None:
+            self._rebalance_moves.extend(ctrl.moves)
+            self._rebalance_base["abstentions"] += ctrl.abstentions
+            self._rebalance_base["refused"] += ctrl.refused
+        ectrl = self._elastics.pop(pid, None)
+        if ectrl is not None:
+            self._elastic_base["splits"] += ectrl.splits
+            self._elastic_base["merges"] += ectrl.merges
+            self._elastic_base["abstentions"] += ectrl.abstentions
+            self._elastic_base["refused"] += ectrl.refused
+        if self.store_wired:
+            # the retired cache leaves self.caches: bank its stream-
+            # recovery counters so store_detail stays whole-run
+            mgr = getattr(rep.cache, "watch_manager", None)
+            if mgr is not None:
+                for w in mgr.watches:
+                    self._retired_watch_counts["resumes"] += w.resumes
+                    self._retired_watch_counts["relists"] += w.relists
+        self.replicas.remove(rep)
+        self.caches.remove(rep.cache)
+        self._cache_by_pid.pop(pid, None)
+        self._p_leader_key.pop(pid, None)
+        self._p_vacant.pop(pid, None)
+        self._p_had.pop(pid, None)
+        self._fed_oracles.pop(pid, None)
+        self._elastic_events.append(
+            {"cycle": self.cycles, "kind": "retire", "pid": pid})
+        metrics.set_partition_count(len(self.replicas))
+
+    def elastic_stats(self) -> Dict[str, object]:
+        """The report's deterministic elastic-membership section."""
+        totals = dict(self._elastic_base)
+        for c in self._elastics.values():
+            totals["splits"] += c.splits
+            totals["merges"] += c.merges
+            totals["abstentions"] += c.abstentions
+            totals["refused"] += c.refused
+        return {
+            "enabled": self.elastic,
+            "splits": totals["splits"],
+            "merges": totals["merges"],
+            "abstentions": totals["abstentions"],
+            "refused": totals["refused"],
+            "partitions_initial": self.federated,
+            "partitions_final": len(self.replicas),
+            "partitions_peak": self._partition_peak,
+            "max_queue_depth": self._max_queue_depth,
+            "events": list(self._elastic_events),
+        }
+
+    def _sample_queue_depth(self) -> None:
+        """Per-cycle bounded-depth witness of the elastic soak: the
+        deepest single queue's pending-task count, maxed over the run."""
+        depth = 0
+        for cache in self.caches:
+            per_q: Dict[str, int] = {}
+            for job in cache.jobs.values():
+                n = len(job.task_status_index.get(TaskStatus.PENDING,
+                                                  {}))
+                if n:
+                    per_q[job.queue] = per_q.get(job.queue, 0) + n
+            if per_q:
+                depth = max(depth, max(per_q.values()))
+        self._max_queue_depth = max(self._max_queue_depth, depth)
 
     def _crash_restart_partition(self, rep: _Replica,
                                  kill_mode: Optional[str]) -> None:
@@ -1557,6 +1819,16 @@ class SimRunner:
             self._rebalance_moves.extend(ctrl.moves)
             self._rebalance_base["abstentions"] += ctrl.abstentions
             self._rebalance_base["refused"] += ctrl.refused
+        ectrl = self._elastics.get(rep.ix)
+        if ectrl is not None:
+            # same reaping for the elastic controller; its streak/flap
+            # state is volatile but a killed RETIRING partition is NOT
+            # lost — the fresh controller resumes the drain from the
+            # persisted membership state (elastic.py step())
+            self._elastic_base["splits"] += ectrl.splits
+            self._elastic_base["merges"] += ectrl.merges
+            self._elastic_base["abstentions"] += ectrl.abstentions
+            self._elastic_base["refused"] += ectrl.refused
         self._build_partition_shell(rep)
         cluster_binds = dict(self.binder.sequence[-1:]) \
             if kill_mode == "bind_after" else {}
@@ -1613,27 +1885,35 @@ class SimRunner:
         run_once in pid order, leadership accounting, then cluster
         feedback unless a partition vacancy defers it."""
         kill_mode: Optional[str] = None
-        boundary_pid = 0
+        boundary_rep = self.replicas[0]
         if self.cycles in self.kill_cycles:
+            # the boundary partition is seeded among the LIVE pids —
+            # with static membership this is byte-identical to the
+            # fixed range draw (live == range(federated)); under
+            # elastic it means a kill can land mid-split on a newborn
+            # or mid-merge on a retiring partition
+            live = self.replicas
             if self.store_wired:
                 # store mode builds kill wrappers PER partition (each
                 # partition has its own store chain): seed the boundary
                 # partition first and arm that partition's wrappers
-                boundary_pid = self._kill_rng.randint(
-                    0, self.federated - 1)
+                boundary_rep = live[self._kill_rng.randint(
+                    0, len(live) - 1)]
                 self._kill_binder, self._kill_evictor = \
-                    self._store_kill_wrappers[boundary_pid]
+                    self._store_kill_wrappers[boundary_rep.ix]
                 kill_mode = self._arm_kill_ha()
             else:
                 kill_mode = self._arm_kill_ha()
-                boundary_pid = self._kill_rng.randint(
-                    0, self.federated - 1)
+                boundary_rep = live[self._kill_rng.randint(
+                    0, len(live) - 1)]
         if self.cycles in self.lease_loss_cycles:
             self._armed_revoke = self._lease_rng.randint(1, 5)
         for transport in self._lease_transports.values():
             transport.new_cycle()
         fired = False
-        for rep in self.replicas:
+        # snapshot: a partition's run_once may SPAWN a sibling (runs
+        # from the next cycle) or retire ITSELF (already ran this one)
+        for rep in list(self.replicas):
             t0 = time.perf_counter()
             try:
                 errors = rep.sched.run_once()
@@ -1651,8 +1931,12 @@ class SimRunner:
         if kill_mode is not None and not fired:
             # the armed kill never fired (too few side effects, or
             # post_cycle): clean-boundary death of the seeded partition
-            self._crash_restart_partition(self.replicas[boundary_pid],
-                                          "post_cycle")
+            if boundary_rep not in self.replicas:
+                # the seeded partition retired THIS cycle (its merge
+                # completed before the arm could fire): the degenerate
+                # clean-boundary death lands on the merge sink instead
+                boundary_rep = self.replicas[0]
+            self._crash_restart_partition(boundary_rep, "post_cycle")
         self._armed_revoke = None
         self._account_partitions()
         if not self._feedback_blocked:
@@ -1768,6 +2052,10 @@ class SimRunner:
         self._p_maps = {}
         self._p_ledgers = {}
         self._store_kill_wrappers = {}
+        # kept for elastic spawns: a newborn's store chain takes the
+        # same chaos wraps an up-front partition's does
+        self._fed_binder_wrap = binder_wrap
+        self._fed_evictor_wrap = evictor_wrap
         for pid in range(self.federated):
             rep = _Replica(pid)
             backend = StorePartitionBackend(self.world.transports[pid],
@@ -1776,7 +2064,7 @@ class SimRunner:
             ledger = StoreBackedReserveLedger(
                 pmap_p, backend, journal=self.journal,
                 registry=self.registry, time_fn=self.clock.time,
-                timeout_s=8 * self.period)
+                timeout_s=8 * self.period, donor_guard=self.elastic)
             cache, b, e = self.world.build_cache(
                 pid, binder_wrap, evictor_wrap, journal=self.journal,
                 event_filter=self._fed_event_filter(pid))
@@ -1801,12 +2089,21 @@ class SimRunner:
             self._build_partition_shell(rep)
             self.replicas.append(rep)
             self.caches.append(cache)
+            self._cache_by_pid[pid] = cache
             self._p_leader_key[pid] = None
             self._p_vacant[pid] = None
             self._p_had[pid] = False
+        # Cross-attach every partition's cache to every ledger mirror:
+        # settle_moves does its job surgery on the DESTINATION cache,
+        # and _drain_and_transfer waits on every mirror — the in-process
+        # stand-in for the relist a real destination process would run.
+        for lg in self.ledgers:
+            for other_pid, other_cache in self._cache_by_pid.items():
+                lg.attach_cache(other_pid, other_cache)
         self.cache = self.caches[0]
         self.sched = self.replicas[0].sched
         self.ledger = self.ledgers[0]
+        metrics.set_partition_count(len(self.replicas))
 
     def _drain_store_pending(self) -> None:
         """Re-run client submissions that failed at the store boundary
@@ -1841,7 +2138,8 @@ class SimRunner:
 
     def store_detail(self) -> Dict[str, object]:
         """The report's deterministic store-boundary section."""
-        resumes = relists = 0
+        resumes = self._retired_watch_counts["resumes"]
+        relists = self._retired_watch_counts["relists"]
         for cache in self.caches:
             mgr = getattr(cache, "watch_manager", None)
             if mgr is not None:
@@ -2099,6 +2397,8 @@ class SimRunner:
             # in DISJOINT partition caches, so utilization/fairness
             # aggregate across them; single/HA read the (converged) view
             sample = self.caches if self.federated else [self._view()]
+            if self.elastic:
+                self._sample_queue_depth()
             self.util_cpu.append(report_mod.cpu_utilization_all(sample))
             self.util_mem.append(report_mod.mem_utilization_all(sample))
             self.drf_gap.append(report_mod.drf_fairness_gap_all(sample))

@@ -90,23 +90,17 @@ class StoreWorld:
         self.clock = clock
         self.store = ObjectStore()
         self.fault_rate = fault_rate
+        self.fault_seed = fault_seed
+        self.latency_s = latency_s
+        self.retry_rng_seed = retry_rng_seed
+        self.period = period
         self.bind_witness = SharedWitness()
         self.evict_witness = SharedWitness()
         self.injectors: List[StoreFaultInjector] = []
         self.faulties: List[FaultyStoreTransport] = []
         self.transports: List[RetryingStoreTransport] = []
-        for i in range(max(n_schedulers, 1)):
-            inj = StoreFaultInjector(
-                failure_rate=fault_rate, seed=fault_seed * 7919 + i,
-                latency_s=latency_s, sleep_fn=clock.sleep)
-            faulty = FaultyStoreTransport(self.store, inj)
-            transport = RetryingStoreTransport(
-                faulty, sleep_fn=clock.sleep, time_fn=clock.time,
-                cycle_budget_s=2.0 * period,
-                rng=random.Random(retry_rng_seed * 31 + i))
-            self.injectors.append(inj)
-            self.faulties.append(faulty)
-            self.transports.append(transport)
+        for _ in range(max(n_schedulers, 1)):
+            self.add_scheduler()
         # pod uid -> blueprint for the controller-recreate analogue
         self._blueprints: Dict[str, dict] = {}
         self._known_prio: set = set()
@@ -115,6 +109,27 @@ class StoreWorld:
         self._completed: set = set()
 
     # -- per-scheduler wiring -------------------------------------------------
+
+    def add_scheduler(self) -> int:
+        """Mint one more scheduler's hostile store chain (its own seeded
+        injector under its own retry funnel) and return its transport
+        index. Seeds derive from the index exactly as at construction,
+        so a partition SPAWNED mid-run (sim --elastic) replays the same
+        fault stream a same-index partition built up front would — the
+        elastic soak stays byte-deterministic."""
+        i = len(self.transports)
+        inj = StoreFaultInjector(
+            failure_rate=self.fault_rate, seed=self.fault_seed * 7919 + i,
+            latency_s=self.latency_s, sleep_fn=self.clock.sleep)
+        faulty = FaultyStoreTransport(self.store, inj)
+        transport = RetryingStoreTransport(
+            faulty, sleep_fn=self.clock.sleep, time_fn=self.clock.time,
+            cycle_budget_s=2.0 * self.period,
+            rng=random.Random(self.retry_rng_seed * 31 + i))
+        self.injectors.append(inj)
+        self.faulties.append(faulty)
+        self.transports.append(transport)
+        return i
 
     def build_cache(self, ix: int = 0,
                     binder_wrap: Optional[Callable] = None,

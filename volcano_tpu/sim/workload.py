@@ -198,6 +198,27 @@ def _priority_wave(seed: int, at: float, n: int, queue: str, priority: int,
     return tuple(out)
 
 
+def _flash_crowd(seed: int, at: float, n: int,
+                 queues: Sequence[str],
+                 duration_mean: float = 6.0) -> Tuple[TraceEvent, ...]:
+    """The diurnal flash crowd: ``n`` gangs landing in one tight burst
+    window, spread round-robin over the queues (names prefixed ``fc-``
+    to stay disjoint from the Poisson stream's) — the daytime peak that
+    must drive a partition split under ``sim --elastic``."""
+    rng = random.Random(seed ^ 0x5EED)
+    out = []
+    for i in range(n):
+        size = rng.choices([1, 2], [0.6, 0.4])[0]
+        out.append(TraceEvent(_round(at + 0.01 * i), "job_arrival", {
+            "name": f"fc-{i:04d}", "queue": queues[i % len(queues)],
+            "priority": 0,
+            "tasks": size, "min_available": size,
+            "cpu_milli": rng.choice((1000, 2000)), "mem": GI,
+            "gpus": 0,
+            "duration": _round(rng.uniform(0.5, 2.0) * duration_mean)}))
+    return tuple(out)
+
+
 # The named scenario catalog (docs/simulation.md records each scenario's
 # expected report ranges). Each entry is a factory(seed) -> trace plus a
 # one-line description; `python -m volcano_tpu.sim --scenario NAME` runs
@@ -303,6 +324,31 @@ SCENARIOS: Dict[str, dict] = {
             mem_choices=(GI,),
             gang_sizes=((1, 0.5), (2, 0.35), (4, 0.15)),
             queues=(("q1", 2), ("q2", 2), ("q3", 1), ("q4", 1))),
+    ),
+    "diurnal-flash-crowd": dict(
+        description="a quiet Poisson trickle over 6 queues on 8 small "
+                    "nodes, then a ~150-gang flash crowd lands at t=15 "
+                    "across every queue and the trickle dies back down "
+                    "— the elastic-membership world for `sim "
+                    "--federated 1 --elastic` with --overload-chaos: "
+                    "chronic cycle-budget exhaustion must SPLIT the "
+                    "single partition (bounded per-queue depth while "
+                    "the crowd drains through admission backpressure "
+                    "and starvation reserves), and the emptied spawned "
+                    "partitions must MERGE back to one before the run "
+                    "ends (docs/federation.md membership protocol)",
+        factory=lambda seed: synthetic_trace(
+            40, 8, seed=seed, arrival_rate=1.2, duration_mean=5.0,
+            duration_cap=12.0,
+            gang_sizes=((1, 0.55), (2, 0.35), (4, 0.10)),
+            queues=(("q1", 1), ("q2", 1), ("q3", 1), ("q4", 1),
+                    ("q5", 1), ("q6", 1)),
+            cpu_choices=(1000, 2000), mem_choices=(GI,),
+            priority_choices=(0,),
+            node_cpu_milli=8000, node_mem=64 * GI, node_pods=40,
+            extra_events=_flash_crowd(
+                seed, at=15.0, n=150,
+                queues=("q1", "q2", "q3", "q4", "q5", "q6"))),
     ),
     "fed-hotspot": dict(
         description="8 queues round-robined over 4 partitions with "
