@@ -24,20 +24,22 @@ run_store=true
 run_ack=true
 run_overload=true
 run_elastic=true
+run_egang=true
 case "${1:-}" in
-  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
-  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
-  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
-  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
-  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
-  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
-  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
-  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
-  --pipelined-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false ;;
-  --store-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ; run_ack=false; run_overload=false; run_elastic=false ;;
-  --ack-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_overload=false; run_elastic=false ;;
-  --overload-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_elastic=false ;;
-  --elastic-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_overload=false ;;
+  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false ;;
+  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false ;;
+  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false ;;
+  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false ;;
+  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false ;;
+  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false ;;
+  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false ;;
+  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false ;;
+  --pipelined-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false ;;
+  --store-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false ;;
+  --ack-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_overload=false; run_elastic=false; run_egang=false ;;
+  --overload-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_elastic=false; run_egang=false ;;
+  --elastic-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_overload=false; run_egang=false ;;
+  --elastic-gang-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_overload=false; run_elastic=false; run_egang=true ;;
 esac
 
 if $run_lint; then
@@ -89,14 +91,16 @@ if $run_lint; then
 "finding must be fixed or carry a written justification "\
 "(docs/static-analysis.md)"; exit 1; }
   # the async-overlap burn-down ratchet (ROADMAP item 2, PR 12): the
-  # host-sync inventory shrank to 7 sites (allowlist 2 -> 1; the
-  # _DeviceJobPlacer fetch moved under the solve span, the serial and
-  # speculative fused fetches share ONE _fetch_packed site). A new sync
+  # host-sync inventory shrank to 6 sites (allowlist 2 -> 1; the
+  # _DeviceJobPlacer fetch moved under the solve span; the serial,
+  # speculative AND blocks fused fetches share ONE _fetch_packed site —
+  # place_blocks_packed adopted the scan solver's on-device packed
+  # layout, retiring the blocks-branch jax.device_get). A new sync
   # site must raise this budget with a written justification, not slide
   # in silently.
-  echo "== lint: vlint --sync-inventory --sync-budget 7 =="
+  echo "== lint: vlint --sync-inventory --sync-budget 6 =="
   python -m volcano_tpu.analysis volcano_tpu/ --sync-inventory \
-    --sync-budget 7 \
+    --sync-budget 6 \
     || { echo "lint FAILED: host-sync inventory grew past the budget"; \
          exit 1; }
   echo "== lint: SARIF 2.1.0 validity =="
@@ -625,6 +629,81 @@ print("   elastic-soak: splits %d / merges %d, peak %d -> final %d, "
          el["partitions_final"], el["max_queue_depth"]))
 EOF
   echo "   elastic-soak: contract holds, byte-deterministic x2"
+fi
+
+if $run_egang; then
+  # elastic-gang soak (docs/design/elastic-gangs.md): the elastic-churn
+  # world under --elastic-gangs — gangs flexing min -> desired -> min,
+  # lifecycle commands through the journaled funnel, node churn.
+  # --verify-elastic-gang-equivalence asserts the contract (every gang
+  # completes at >= min, zero double-binds, zero below-min evictions
+  # outside full-gang decisions, grows + all three shrink reasons
+  # non-zero, command ledger balanced, byte-deterministic x2
+  # internally); an external byte-diff x2 re-proves the deterministic
+  # plane, and the same bar must hold with (a) 4 seeded kills landing
+  # mid-flex and (b) the hostile feedback plane (--ack-chaos) delaying/
+  # dropping the acks the grow/shrink ledger depends on.
+  echo "== elastic-gang-soak: min/desired flex + commands + churn =="
+  egdir=$(mktemp -d)
+  trap 'rm -rf "${simdir:-/nonexistent}" "${soakdir:-/nonexistent}" \
+"${obsdir:-/nonexistent}" "${hadir:-/nonexistent}" \
+"${feddir:-/nonexistent}" "${pipedir:-/nonexistent}" \
+"${storedir:-/nonexistent}" "${ackdir:-/nonexistent}" \
+"${ovdir:-/nonexistent}" "${eldir:-/nonexistent}" \
+"${egdir:-/nonexistent}"' EXIT
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario elastic-churn \
+    --seed 0 --elastic-gangs --verify-elastic-gang-equivalence \
+    --deterministic > "$egdir/eg.a.json" \
+    || { echo "elastic-gang-soak FAILED: elastic-gang contract \
+violated"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario elastic-churn \
+    --seed 0 --elastic-gangs --deterministic > "$egdir/eg.b.json"
+  diff "$egdir/eg.a.json" "$egdir/eg.b.json" \
+    || { echo "elastic-gang-soak FAILED: elastic-churn not \
+byte-deterministic"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario elastic-churn \
+    --seed 0 --elastic-gangs --kill-cycles 6,14,22,30 --kill-seed 1 \
+    --verify-elastic-gang-equivalence --deterministic \
+    > "$egdir/kill.json" \
+    || { echo "elastic-gang-soak FAILED: killed run diverged, \
+double-bound or shrank below min"; exit 1; }
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario elastic-churn \
+    --seed 0 --elastic-gangs --ack-chaos \
+    --verify-elastic-gang-equivalence --deterministic \
+    > "$egdir/ack.json" \
+    || { echo "elastic-gang-soak FAILED: ack-chaos run diverged, \
+double-bound or shrank below min"; exit 1; }
+  python - "$egdir/eg.a.json" "$egdir/kill.json" <<'EOF'
+import json, sys
+clean = json.load(open(sys.argv[1]))
+killed = json.load(open(sys.argv[2]))
+for name, r in (("clean", clean), ("killed", killed)):
+    eg = r["elastic_gangs"]
+    assert eg["enabled"], name
+    assert eg["grows"] > 0, f"{name}: the grow stage never fired"
+    for reason in ("pressure", "scale", "suspend"):
+        assert eg["shrinks"].get(reason, 0) > 0, \
+            f"{name}: no {reason} shrink: {eg['shrinks']}"
+    assert eg["below_min_evictions"] == 0, \
+        f"{name}: gang shrank below min: {eg}"
+    assert eg["elastic_continues"] > 0, \
+        f"{name}: no member loss rode the elastic-continue path"
+    c = eg["commands"]
+    assert c["submitted"] == c["applied"] + c["dropped"] and \
+        c["pending"] == c["rejected"] == 0, f"{name}: ledger: {c}"
+    assert r["double_binds"] == 0
+    assert r["jobs"]["completed"] == r["jobs"]["arrived"]
+    assert r["jobs"]["unfinished"] == 0
+assert clean["elastic_gangs"]["colocation_rate"] >= 0.75, \
+    clean["elastic_gangs"]
+assert killed["restarts"] > 0, "the seeded kills never landed"
+print("   elastic-gang-soak: grows %d, shrinks %s, colocation %.2f, "
+      "zero below-min, zero double-binds (clean + killed)"
+      % (clean["elastic_gangs"]["grows"],
+         clean["elastic_gangs"]["shrinks"],
+         clean["elastic_gangs"]["colocation_rate"]))
+EOF
+  echo "   elastic-gang-soak: contract holds, byte-deterministic x2"
 fi
 
 if $run_shim; then

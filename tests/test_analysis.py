@@ -403,6 +403,83 @@ def test_vt019_trigger_and_clean():
     assert "VT019" not in rule_ids(f)
 
 
+VT020_MOVE_TRIGGER = '''
+class Rogue:
+    def shed(self, ssn, task):
+        ssn.evict(task, "elastic-scale")
+'''
+
+VT020_MOVE_CLEAN = '''
+class Stage:
+    def _journal_elastic(self, ssn, kind, task):
+        ssn.cache.journal.record_control(kind, {"task": task.uid})
+
+    def shed(self, ssn, task):
+        ssn.evict(task, "elastic-scale")
+        self._journal_elastic(ssn, "elastic_shrink", task)
+'''
+
+VT020_GROW_TRIGGER = '''
+class Rogue:
+    def add(self, ssn, task, node):
+        ssn.allocate(task, node)
+'''
+
+VT020_ANNOTATION_TRIGGER = '''
+def sneak_suspend(job):
+    ann = job.podgroup.annotations
+    ann[SUSPEND_ANNOTATION] = "true"
+
+
+def sneak_resume(job):
+    job.podgroup.annotations.pop(SUSPEND_ANNOTATION, None)
+
+
+def sneak_scale(job, n):
+    job.podgroup.annotations[ELASTIC_DESIRED_ANNOTATION] = str(n)
+'''
+
+VT020_ANNOTATION_CLEAN = '''
+def apply_verb(cache, job, journal):
+    ann = job.podgroup.annotations
+    ann[SUSPEND_ANNOTATION] = "true"
+    journal.record_control("command_applied", {"job": job.uid})
+'''
+
+
+def test_vt020_trigger_and_clean():
+    """An elastic member move (ssn.evict / ssn.allocate) inside the
+    elastic_gang package without a journaled control record fires
+    VT020; the same move with _journal_elastic on the path is clean,
+    and the rule is scoped — the identical source outside
+    volcano_tpu/elastic_gang/ is someone else's contract (VT004 et
+    al.), not this one."""
+    f, _ = findings_of(
+        {"volcano_tpu/elastic_gang/rogue.py": VT020_MOVE_TRIGGER})
+    assert "VT020" in rule_ids(f)
+    assert any(x.symbol == "Rogue.shed" for x in f)
+    f, _ = findings_of(
+        {"volcano_tpu/elastic_gang/rogue.py": VT020_GROW_TRIGGER})
+    assert "VT020" in rule_ids(f)
+    f, _ = findings_of(
+        {"volcano_tpu/elastic_gang/stage.py": VT020_MOVE_CLEAN})
+    assert "VT020" not in rule_ids(f)
+    f, _ = findings_of({"volcano_tpu/actions/rogue.py": VT020_MOVE_TRIGGER})
+    assert "VT020" not in rule_ids(f)
+
+
+def test_vt020_annotation_rewrites():
+    """Lifecycle-annotation rewrites (suspend set, resume pop, desired
+    scale) outside the Command funnel's journaled consume path each
+    fire; the journaled rewrite is clean."""
+    f, _ = findings_of(
+        {"volcano_tpu/elastic_gang/sneak.py": VT020_ANNOTATION_TRIGGER})
+    assert sum(1 for x in f if x.rule == "VT020") == 3
+    f, _ = findings_of(
+        {"volcano_tpu/elastic_gang/ok.py": VT020_ANNOTATION_CLEAN})
+    assert "VT020" not in rule_ids(f)
+
+
 VT005_TRIGGER = '''
 def cycle(action):
     try:
@@ -858,6 +935,43 @@ def test_rebreak_unjournaled_partition_spawn_vt019():
     f, _ = findings_of({"volcano_tpu/federation/reserve.py": broken})
     assert any(x.rule == "VT019"
                and x.symbol == "ReserveLedger.partition_spawn"
+               for x in f), rule_ids(f)
+
+
+def test_rebreak_unjournaled_elastic_grow_vt020():
+    """PR 17's elastic contract: the grow-shrink stage binds a pending
+    member right next to its journaled ``elastic_grow`` control record.
+    Dropping the record leaves a bind the replayer cannot distinguish
+    from an admission-time allocation — after a crash a scale-down's
+    freed capacity is re-promised to the wrong gang. The unmutated
+    source must be clean; the reverted one must flag the grow."""
+    src = real_source("volcano_tpu/elastic_gang/grow_shrink.py")
+    f, _ = findings_of({"volcano_tpu/elastic_gang/grow_shrink.py": src})
+    assert "VT020" not in rule_ids(f)
+    broken = mutate(src,
+                    '        self._journal_elastic(ssn, "elastic_grow", '
+                    'task, "grow")\n',
+                    '')
+    f, _ = findings_of({"volcano_tpu/elastic_gang/grow_shrink.py": broken})
+    assert any(x.rule == "VT020"
+               and x.symbol == "GrowShrinkAction._grow_one"
+               for x in f), rule_ids(f)
+
+
+def test_rebreak_unjournaled_command_apply_vt020():
+    """The Command funnel's consume path rewrites lifecycle annotations
+    right next to its ``command_applied`` record. Stripping both
+    journal writes from consume leaves annotation rewrites with no
+    durable trace — a crash forgets a suspend that the live cache
+    already applied. The unmutated funnel must be clean; the stripped
+    one must flag every rewrite."""
+    src = real_source("volcano_tpu/elastic_gang/commands.py")
+    f, _ = findings_of({"volcano_tpu/elastic_gang/commands.py": src})
+    assert "VT020" not in rule_ids(f)
+    broken = mutate(src, "journal.record_control(", "_dropped_record(")
+    f, _ = findings_of({"volcano_tpu/elastic_gang/commands.py": broken})
+    assert any(x.rule == "VT020"
+               and x.symbol == "CommandFunnel.consume"
                for x in f), rule_ids(f)
 
 

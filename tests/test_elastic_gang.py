@@ -1,0 +1,426 @@
+"""Elastic gangs: min/desired membership as a scheduler decision class
+(volcano_tpu/elastic_gang, plugins/elastic_gang, ops/place.place_scan_topo;
+docs/design/elastic-gangs.md).
+
+The load-bearing contracts:
+
+- a gang ADMITS at ``min_available`` even when ``desired`` can never fit
+  (that is the whole point of elastic membership);
+- nothing ever evicts an elastic gang below min outside a full-gang
+  decision (the below-min counter must stay zero under pressure);
+- losing a member above min is an elastic CONTINUE (completion timer
+  runs on), losing the gang below min is a duration RESTART — the two
+  accountings must stay distinguishable;
+- the batched topology solver (place_scan_topo) is bit-identical to a
+  brute-force host oracle replaying the same greedy rule on small
+  worlds — the compactness term is a score term, not a new algorithm;
+- vcctl lifecycle verbs round-trip through the journaled Command
+  funnel, never around it.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from volcano_tpu.api import JobInfo, PodGroup, PodGroupPhase
+from volcano_tpu.elastic_gang import CommandFunnel
+from volcano_tpu.elastic_gang.membership import (ELASTIC_DESIRED_ANNOTATION,
+                                                 SUSPEND_ANNOTATION)
+from volcano_tpu.sim import SimRunner, TraceEvent
+
+SEED = 20260806
+
+
+# -- trace builders --------------------------------------------------------
+
+def _node(t, name, cpu, pods=40, zone=None, mem=64 << 30):
+    d = {"name": name, "cpu_milli": cpu, "mem": mem, "pods": pods, "gpus": 0}
+    if zone is not None:
+        d["zone"] = zone
+    return TraceEvent(t, "node_add", d)
+
+
+def _job(t, name, tasks, min_available, cpu, duration, desired=None,
+         queue="q1", priority=0):
+    d = {"name": name, "queue": queue, "priority": priority, "tasks": tasks,
+         "min_available": min_available, "cpu_milli": cpu, "mem": 1 << 28,
+         "gpus": 0, "duration": duration}
+    if desired is not None:
+        d["desired"] = desired
+    return TraceEvent(t, "job_arrival", d)
+
+
+def _trace(events):
+    out = [TraceEvent(0.0, "queue_add", {"name": "q1", "weight": 1})]
+    out.extend(events)
+    out.sort(key=lambda ev: (ev.t, ev.kind, ev.data.get("name", "")))
+    return out
+
+
+def _run(trace, **kw):
+    kw.setdefault("seed", SEED)
+    kw.setdefault("elastic_gangs", True)
+    r = SimRunner(trace, **kw)
+    return r, r.run()
+
+
+# -- admission at min ------------------------------------------------------
+
+@pytest.mark.sim
+def test_elastic_gang_admits_at_min():
+    """A world with capacity for 3 members can never run a rigid 8-gang,
+    but an elastic 8-gang with min=2 admits, runs at what fits, and
+    completes — gang size really is a decision variable, not a fixed
+    demand."""
+    events = [_node(0.0, "n0", 3000, pods=8),
+              _job(1.0, "eg", tasks=8, min_available=2, cpu=1000,
+                   duration=10.0, desired=8)]
+    r, rep = _run(_trace(events))
+    assert rep["jobs"]["completed"] == 1
+    assert rep["jobs"]["unfinished"] == 0
+    assert rep["double_binds"] == 0
+    eg = rep["elastic_gangs"]
+    assert eg["enabled"]
+    # admitted at 2, grew into the third slot while capacity lasted
+    assert eg["grows"] >= 1
+    assert eg["below_min_evictions"] == 0
+
+
+@pytest.mark.sim
+def test_rigid_gang_control_stalls_where_elastic_runs():
+    """The control for admit-at-min: the SAME job without the elastic
+    annotation (min == tasks == 8) can never admit on 3 slots — the
+    sim exits on its stall backstop with the gang unfinished."""
+    events = [_node(0.0, "n0", 3000, pods=8),
+              _job(1.0, "rigid", tasks=8, min_available=8, cpu=1000,
+                   duration=10.0)]
+    r, rep = _run(_trace(events), stall_limit=25)
+    assert rep["jobs"]["completed"] == 0
+    assert rep["jobs"]["unfinished"] == 1
+
+
+# -- never below min under pressure ---------------------------------------
+
+@pytest.mark.sim
+def test_pressure_shrinks_never_go_below_min():
+    """A fully grown elastic gang donates members when rigid jobs starve
+    for admission — but never below min: the below-min counter is the
+    witness that every shrink/preempt decision honored the floor, and
+    everyone still completes."""
+    events = [_node(0.0, f"n{i}", 4000, pods=16) for i in range(4)]
+    events.append(_job(1.0, "eg", tasks=12, min_available=2, cpu=1000,
+                       duration=30.0, desired=12))
+    # the starvation wave: arrives after the gang has grown into the
+    # whole cluster, needs capacity only shrinks can free in time
+    events.extend(_job(8.0 + 0.1 * i, f"rg-{i}", tasks=2, min_available=2,
+                       cpu=2000, duration=5.0) for i in range(4))
+    r, rep = _run(_trace(events))
+    assert rep["jobs"]["completed"] == rep["jobs"]["arrived"] == 5
+    assert rep["double_binds"] == 0
+    eg = rep["elastic_gangs"]
+    assert eg["grows"] > 0
+    assert sum(eg["shrinks"].values()) > 0
+    assert eg["below_min_evictions"] == 0
+
+
+# -- elastic continue vs duration restart ---------------------------------
+
+@pytest.mark.sim
+def test_member_loss_above_min_is_elastic_continue():
+    """pods=2 nodes force the grown gang across both nodes; killing one
+    node takes the gang from 4 members to 2 == min, so the gang keeps
+    its admission and its completion timer (elastic continue) — it
+    finishes on schedule, not fail-time + duration."""
+    events = [_node(0.0, "n0", 4000, pods=2),
+              _node(0.0, "n1", 4000, pods=2),
+              _job(1.0, "eg", tasks=4, min_available=2, cpu=1000,
+                   duration=20.0, desired=4),
+              TraceEvent(8.0, "node_fail", {"name": "n1"})]
+    r, rep = _run(_trace(events))
+    assert rep["jobs"]["completed"] == 1
+    eg = rep["elastic_gangs"]
+    assert eg["elastic_continues"] >= 1
+    # timer ran on: JCT stays near the nominal duration, nowhere near
+    # the fail-time + duration a restart would cost
+    assert r.jct[0] < 26.0, r.jct
+
+
+@pytest.mark.sim
+def test_member_loss_below_min_is_duration_restart():
+    """The whole gang dies with its only node: once membership drops
+    below min the admission resets (the per-member losses on the way
+    down count as continues, but they don't survive the collapse), and
+    the job pays fail-time + duration once the replacement node
+    arrives — visible as a restart-shaped JCT."""
+    events = [_node(0.0, "n0", 4000, pods=8),
+              _job(1.0, "eg", tasks=4, min_available=2, cpu=1000,
+                   duration=20.0, desired=4),
+              TraceEvent(8.0, "node_fail", {"name": "n0"}),
+              _node(9.0, "n1", 4000, pods=8)]
+    r, rep = _run(_trace(events))
+    assert rep["jobs"]["completed"] == 1
+    assert r.jct[0] > 26.0, r.jct
+
+
+# -- topology solver vs brute-force host oracle ---------------------------
+
+def _oracle_topo(nodes, tasks, jobs, allocatable, max_tasks, zone_code,
+                 weights, topo_w):
+    """Pure-host replay of place_scan_topo's greedy rule: sequential
+    tasks, per-job tentative state, first-placement zone anchor, commit
+    or rollback at job end. Scores reuse the same term functions the
+    kernel calls, evaluated eagerly per step."""
+    from volcano_tpu.ops import NO_NODE, combined_dynamic_score
+    from volcano_tpu.ops.dense import EPS
+
+    idle = np.array(nodes.idle)
+    fidle = np.array(nodes.future_idle)
+    used = np.array(nodes.used)
+    ntasks = np.array(nodes.ntasks)
+    T = tasks.req.shape[0]
+    J = jobs.min_available.shape[0]
+    task_node = np.full(T, NO_NODE, np.int32)
+    task_pipe = np.zeros(T, bool)
+    job_ready = np.zeros(J, bool)
+    job_kept = np.zeros(J, bool)
+    saved = None
+    cnt_alloc = cnt_pipe = 0
+    broken = False
+    anchor = 0
+    zc = np.array(zone_code)
+    mt = np.array(max_tasks)
+    for i in range(T):
+        req = np.array(tasks.req[i])
+        j = int(tasks.job_ix[i])
+        valid = bool(tasks.valid[i])
+        if bool(tasks.first_of_job[i]):
+            saved = (idle.copy(), fidle.copy(), used.copy(), ntasks.copy())
+            cnt_alloc = cnt_pipe = 0
+            broken = False
+            anchor = 0
+        pods_ok = ntasks < mt
+        fit_future = (np.all(req[None, :] < fidle + EPS, axis=-1)
+                      & np.array(tasks.feas[i]) & pods_ok)
+        fit_idle = np.all(req[None, :] < idle + EPS, axis=-1) & fit_future
+        has_node = bool(fit_future.any())
+        attempt = valid and not broken
+        broken = broken or (attempt and not has_node)
+        score = np.array(tasks.static_score[i]) + np.asarray(
+            combined_dynamic_score(req, used, np.array(allocatable),
+                                   weights))
+        score = score + topo_w * ((zc == anchor) & (anchor != 0))
+        best = int(np.argmax(np.where(fit_future, score, -np.inf)))
+        do_place = attempt and has_node
+        do_alloc = do_place and bool(fit_idle[best])
+        do_pipe = do_place and not do_alloc
+        if do_place and anchor == 0:
+            anchor = int(zc[best])
+        if do_alloc:
+            idle[best] -= req
+            used[best] += req
+        if do_place:
+            fidle[best] -= req
+            ntasks[best] += 1
+        cnt_alloc += int(do_alloc)
+        cnt_pipe += int(do_pipe)
+        min_avail = int(jobs.min_available[j])
+        ready = int(jobs.base_ready[j]) + cnt_alloc >= min_avail
+        keep = ready or (int(jobs.base_ready[j]) + int(jobs.base_pipelined[j])
+                         + cnt_alloc + cnt_pipe >= min_avail)
+        if bool(tasks.last_of_job[i]) and valid:
+            job_ready[j] |= ready
+            job_kept[j] |= keep
+            if not keep:
+                idle, fidle, used, ntasks = saved
+        task_node[i] = best if do_place else NO_NODE
+        task_pipe[i] = do_pipe
+    task_node = np.where(job_kept[np.array(tasks.job_ix)], task_node,
+                         NO_NODE).astype(np.int32)
+    return task_node, task_pipe, job_ready, job_kept
+
+
+def _small_world(seed, N=5, T=7, J=3, R=2):
+    import jax.numpy as jnp
+    from volcano_tpu.ops import JobMeta, NodeState, PlacementTasks
+    rng = np.random.RandomState(seed)
+    used = rng.uniform(0.0, 3.0, (N, R)).astype(np.float32)
+    idle = rng.uniform(2.0, 8.0, (N, R)).astype(np.float32)
+    releasing = rng.uniform(0.0, 1.0, (N, R)).astype(np.float32)
+    nodes = NodeState(idle=jnp.asarray(idle),
+                      future_idle=jnp.asarray(idle + releasing),
+                      used=jnp.asarray(used),
+                      ntasks=jnp.asarray(rng.randint(0, 2, N)
+                                         .astype(np.int32)))
+    allocatable = jnp.asarray(used + idle + releasing)
+    max_tasks = jnp.asarray(rng.randint(3, 6, N).astype(np.int32))
+    zone_code = jnp.asarray(rng.randint(0, 3, N).astype(np.int32))
+
+    cuts = np.sort(rng.choice(np.arange(1, T), J - 1, replace=False))
+    job_ix = np.zeros(T, np.int32)
+    for c in cuts:
+        job_ix[c:] += 1
+    first = np.r_[True, job_ix[1:] != job_ix[:-1]]
+    last = np.r_[job_ix[1:] != job_ix[:-1], True]
+    sizes = np.bincount(job_ix, minlength=J)
+    tasks = PlacementTasks(
+        req=jnp.asarray(rng.uniform(0.5, 3.0, (T, R)).astype(np.float32)),
+        job_ix=jnp.asarray(job_ix),
+        valid=jnp.ones(T, bool),
+        feas=jnp.asarray(rng.random((T, N)) < 0.85),
+        static_score=jnp.asarray(rng.uniform(0.0, 5.0, (T, N))
+                                 .astype(np.float32)),
+        first_of_job=jnp.asarray(first),
+        last_of_job=jnp.asarray(last))
+    jobs = JobMeta(
+        min_available=jnp.asarray(np.maximum(1, sizes - 1).astype(np.int32)),
+        base_ready=jnp.zeros(J, jnp.int32),
+        base_pipelined=jnp.zeros(J, jnp.int32))
+    return nodes, tasks, jobs, allocatable, max_tasks, zone_code
+
+
+@pytest.mark.parametrize("topo_w", [0.0, 3.0])
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_place_scan_topo_matches_host_oracle(seed, topo_w):
+    """The batched topology solver replays the brute-force host greedy
+    exactly on small random worlds — placements, pipeline split, gang
+    verdicts, all of it, with and without the compactness term."""
+    import jax.numpy as jnp
+    from volcano_tpu.ops import default_weights
+    from volcano_tpu.ops.place import place_scan_topo
+
+    nodes, tasks, jobs, allocatable, max_tasks, zone_code = \
+        _small_world(seed)
+    w = default_weights(2)
+    res = place_scan_topo(nodes, tasks, jobs, w, allocatable, max_tasks,
+                          zone_code, jnp.float32(topo_w))
+    o_node, o_pipe, o_ready, o_kept = _oracle_topo(
+        nodes, tasks, jobs, allocatable, max_tasks, zone_code, w,
+        topo_w)
+    np.testing.assert_array_equal(np.array(res.task_node), o_node)
+    np.testing.assert_array_equal(np.array(res.task_pipelined), o_pipe)
+    np.testing.assert_array_equal(np.array(res.job_ready), o_ready)
+    np.testing.assert_array_equal(np.array(res.job_kept), o_kept)
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_topo_weight_zero_is_plain_place_scan(seed):
+    """With the compactness term off, place_scan_topo and place_scan are
+    the same decision procedure — the topology axis costs existing users
+    nothing (the byte-identity half of the acceptance bar)."""
+    import jax.numpy as jnp
+    from volcano_tpu.ops import default_weights, place_scan
+    from volcano_tpu.ops.place import place_scan_topo
+
+    nodes, tasks, jobs, allocatable, max_tasks, zone_code = \
+        _small_world(seed)
+    w = default_weights(2)
+    base = place_scan(nodes, tasks, jobs, w, allocatable, max_tasks)
+    topo = place_scan_topo(nodes, tasks, jobs, w, allocatable, max_tasks,
+                           zone_code, jnp.float32(0.0))
+    np.testing.assert_array_equal(np.array(base.task_node),
+                                  np.array(topo.task_node))
+    np.testing.assert_array_equal(np.array(base.task_pipelined),
+                                  np.array(topo.task_pipelined))
+    np.testing.assert_array_equal(np.array(base.job_ready),
+                                  np.array(topo.job_ready))
+    np.testing.assert_array_equal(np.array(base.job_kept),
+                                  np.array(topo.job_kept))
+
+
+# -- topology co-location end to end --------------------------------------
+
+@pytest.mark.sim
+def test_topology_colocates_gangs_when_capacity_permits():
+    """Capacity-permitting world (each zone holds a whole gang): the
+    topology-aware run packs every multi-member gang into one zone; the
+    unaware baseline on the same trace spreads some of them."""
+    events = [_node(0.0, f"n{i}", 8000, pods=16, zone=f"z{i // 2}")
+              for i in range(6)]
+    events.extend(_job(1.0 + 0.5 * i, f"eg-{i}", tasks=4, min_available=2,
+                       cpu=1000, duration=12.0, desired=4)
+                  for i in range(6))
+    _, aware = _run(_trace(events), topology_weight=10.0)
+    _, blind = _run(_trace(events), topology_weight=0.0)
+    assert aware["jobs"]["completed"] == blind["jobs"]["completed"] == 6
+    rate_aware = aware["elastic_gangs"]["colocation_rate"]
+    rate_blind = blind["elastic_gangs"]["colocation_rate"]
+    assert rate_aware >= 0.9, (rate_aware, rate_blind)
+    assert rate_aware >= rate_blind
+
+
+# -- vcctl lifecycle verbs round-trip -------------------------------------
+
+class _FakeCache:
+    """The funnel's cache surface: jobs, epoch, dirty marks, journal."""
+
+    def __init__(self):
+        self.jobs = {}
+        self._lock = threading.Lock()
+        self.journal = None
+        self.dirty = []
+
+    def fencing_epoch(self):
+        return 7
+
+    def mark_job_dirty(self, uid):
+        self.dirty.append(uid)
+
+
+def _elastic_job(name="eg", desired="6"):
+    pg = PodGroup(name=name, min_member=2, phase=PodGroupPhase.PENDING,
+                  annotations={ELASTIC_DESIRED_ANNOTATION: desired})
+    return JobInfo(uid=name, name=name, min_available=2, podgroup=pg)
+
+
+def test_vcctl_lifecycle_verbs_round_trip():
+    """vcctl job scale|suspend|resume submit through the Command funnel;
+    consume applies the annotation rewrites at the cycle boundary and
+    the ledger balances (submitted == applied, nothing rejected)."""
+    from volcano_tpu.cli.vcctl import main
+
+    cache = _FakeCache()
+    job = _elastic_job()
+    cache.jobs[job.uid] = job
+    funnel = CommandFunnel(cache)
+    lines = []
+
+    assert main(["job", "scale", "--name", "eg", "--desired", "4"],
+                funnel=funnel, out=lines.append) == 0
+    assert main(["job", "suspend", "--name", "eg"],
+                funnel=funnel, out=lines.append) == 0
+    # nothing mutates at submit time: the cycle boundary owns the apply
+    ann = job.podgroup.annotations
+    assert ann[ELASTIC_DESIRED_ANNOTATION] == "6"
+    assert SUSPEND_ANNOTATION not in ann
+    assert funnel.consume() == 2
+    assert ann[ELASTIC_DESIRED_ANNOTATION] == "4"
+    assert ann[SUSPEND_ANNOTATION] == "true"
+    assert cache.dirty == ["eg", "eg"]
+
+    assert main(["job", "resume", "--name", "eg"],
+                funnel=funnel, out=lines.append) == 0
+    assert funnel.consume() == 1
+    assert SUSPEND_ANNOTATION not in ann
+
+    stats = funnel.stats()
+    assert stats["submitted"] == stats["applied"] == 3
+    assert stats["rejected"] == stats["dropped"] == stats["pending"] == 0
+
+
+def test_vcctl_scale_requires_funnel_and_known_job():
+    """No store fallback for scale (a desired rewrite outside the funnel
+    is a VT020 violation), and an unknown job is a clean error, not a
+    queued verb."""
+    from volcano_tpu.cli.vcctl import main
+
+    lines = []
+    assert main(["job", "scale", "--name", "eg", "--desired", "4"],
+                out=lines.append) == 1
+    assert any("funnel" in ln for ln in lines)
+
+    funnel = CommandFunnel(_FakeCache())
+    lines = []
+    assert main(["job", "scale", "--name", "ghost", "--desired", "4"],
+                funnel=funnel, out=lines.append) == 1
+    assert funnel.stats()["submitted"] == 0

@@ -1,6 +1,8 @@
 """Actions (mirrors /root/reference/pkg/scheduler/actions). Importing this
 package registers the in-tree actions."""
 
+import sys as _sys
+
 from ..framework.registry import register_action
 from .allocate import AllocateAction, AllocateTPUAction
 from .backfill import BackfillAction
@@ -20,6 +22,21 @@ register_action(ReclaimAction())
 register_action(ElectAction())
 register_action(ReserveAction())
 
+# grow-shrink lives in the elastic_gang package (it is the elastic stage,
+# not a generic action) and SELF-registers at the end of its module. The
+# sys.modules guard breaks the import cycle: grow_shrink imports
+# actions.base, so when ITS import triggered this package the module is
+# mid-flight here — skipping it is safe because its own tail registers.
+if "volcano_tpu.elastic_gang.grow_shrink" not in _sys.modules:
+    from ..elastic_gang import grow_shrink as _grow_shrink  # noqa: F401
+
 __all__ = ["Action", "AllocateAction", "AllocateTPUAction", "BackfillAction",
-           "ElectAction", "EnqueueAction", "PreemptAction", "ReclaimAction",
-           "ReserveAction"]
+           "ElectAction", "EnqueueAction", "GrowShrinkAction",
+           "PreemptAction", "ReclaimAction", "ReserveAction"]
+
+
+def __getattr__(name):
+    if name == "GrowShrinkAction":
+        from ..elastic_gang.grow_shrink import GrowShrinkAction
+        return GrowShrinkAction
+    raise AttributeError(name)

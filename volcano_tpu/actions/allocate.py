@@ -271,6 +271,14 @@ def _pending_tasks(ssn, job) -> List[TaskInfo]:
     tasks = [t for t in job.task_status_index.get(TaskStatus.PENDING,
                                                   {}).values()
              if not t.resreq.is_empty()]
+    # elastic decision class (elastic_gang): when the elastic plugin is in
+    # the conf it narrows an elastic gang's allocate-visible pending set —
+    # core members until admission (the solver sees the MIN-sized gang),
+    # nothing after (grow-shrink owns expansion toward desired). Absent
+    # the plugin this attribute does not exist and the path is unchanged.
+    flt = getattr(ssn, "elastic_pending_filter", None)
+    if flt is not None:
+        tasks = flt(job, tasks)
     # the ENABLED comparator chain decides whether a key sort is equivalent
     enabled = [name for tier in ssn.tiers for opt in tier.plugins
                if opt.is_enabled("enabledTaskOrder")
@@ -797,6 +805,35 @@ def _job_solver():
     return _SOLVER_CACHE["solve"]
 
 
+def _job_solver_topo():
+    """Jitted packed solver WITH the gang-compactness term
+    (ops/place.place_scan_topo): selected only when the allocate action's
+    ``topology-weight`` argument is positive, so weight-0 confs dispatch
+    the exact pre-existing program (byte-identity with the topology term
+    disabled). The weight is a traced scalar — one compile serves every
+    weight at a given shape bucket."""
+    import jax
+    if "solve_topo" not in _SOLVER_CACHE:
+        from ..ops.place import place_scan_topo_packed
+        _SOLVER_CACHE["solve_topo"] = jax.jit(place_scan_topo_packed)
+    return _SOLVER_CACHE["solve_topo"]
+
+
+def _topology_weight(ssn) -> float:
+    """The allocate action's ``topology-weight`` argument (0 = term off).
+    Rides the scan kernel only: pallas/blocks/sharded formulations carry
+    no per-job anchor state, so a positive weight steers kernel selection
+    to the scan path in _solve_fused."""
+    w = 0.0
+    for conf in ssn.configurations:
+        if conf.name in ("allocate", "allocate-tpu"):
+            try:
+                w = float(conf.arguments.get("topology-weight", w))
+            except (TypeError, ValueError):
+                w = 0.0
+    return max(w, 0.0)
+
+
 # ---------------------------------------------------------------------------
 # fused engine: one device program per cycle
 # ---------------------------------------------------------------------------
@@ -1109,8 +1146,9 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
         return _FusedSolution(tasks, job_ix_np, jobs_list, node_t, task_node,
                               pipelined, ready, kept)
 
+    topo_w = _topology_weight(ssn)
     from ..ops import pallas_place
-    use_pallas = (not blocks and kernel != "scan"
+    use_pallas = (not blocks and kernel != "scan" and topo_w == 0.0
                   and pallas_place.supported(len(rnames), N)
                   and (kernel == "pallas"
                        or not pallas_place.use_interpret()))
@@ -1156,20 +1194,27 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
         # same size-scaled sweep budget as the sharded engine above, so
         # the two block-auction paths keep identical admissions at any T
         big_b = T > 12000
-        assign, pipe, ready, kept, _ = _fused_blocks_solver()(
+        packed, _ = _fused_blocks_solver()(
             node_t.node_state(), bt, jobs_meta, weights,
             node_t.device_allocatable(), node_t.device_max_tasks(),
             sweeps=5 if big_b else 3, passes=4 if big_b else 3)
-        import jax
-        task_node, pipelined, job_ready, job_kept = jax.device_get(
-            (assign, pipe, ready, kept))
-        pipelined = np.asarray(pipelined, bool)
+        # same single-fetch wire format as the scan solver (place_blocks
+        # packs [task_node | pipelined | ready | kept] on device), so the
+        # inventory's one sanctioned readback site serves both engines
+        task_node, pipelined, job_ready, job_kept = _fetch_packed(
+            packed, T, Jp, T)
     else:
         pt, bucket = _scan_placement_tasks(req, job_ix_np, feas_np,
                                            static_np)
-        packed, _ = _job_solver()(node_t.node_state(), pt, jobs_meta, weights,
-                                  node_t.device_allocatable(),
-                                  node_t.device_max_tasks())
+        if topo_w > 0.0:
+            packed, _ = _job_solver_topo()(
+                node_t.node_state(), pt, jobs_meta, weights,
+                node_t.device_allocatable(), node_t.device_max_tasks(),
+                node_t.device_zone_code(), jnp.float32(topo_w))
+        else:
+            packed, _ = _job_solver()(node_t.node_state(), pt, jobs_meta,
+                                      weights, node_t.device_allocatable(),
+                                      node_t.device_max_tasks())
         task_node, pipelined, job_ready, job_kept = _fetch_packed(
             packed, bucket, Jp, T)
 
@@ -1524,9 +1569,17 @@ def dispatch_speculative_solve(ssn, engine: str = "tpu-fused",
     static_np = (np.zeros((T, N), np.float32) if static is None
                  else np.asarray(static, np.float32))
     pt, bucket = _scan_placement_tasks(req, job_ix_np, feas_np, static_np)
-    packed, _ = _job_solver()(node_t.node_state(), pt, jobs_meta, weights,
-                              node_t.device_allocatable(),
-                              node_t.device_max_tasks())
+    topo_w = _topology_weight(ssn)
+    if topo_w > 0.0:
+        import jax.numpy as jnp
+        packed, _ = _job_solver_topo()(
+            node_t.node_state(), pt, jobs_meta, weights,
+            node_t.device_allocatable(), node_t.device_max_tasks(),
+            node_t.device_zone_code(), jnp.float32(topo_w))
+    else:
+        packed, _ = _job_solver()(node_t.node_state(), pt, jobs_meta,
+                                  weights, node_t.device_allocatable(),
+                                  node_t.device_max_tasks())
     LAST_STATS["speculate_order_s"] = sp.dur_s
     return PendingFusedSolution(ordered_jobs, tasks, job_ix_np, jobs_list,
                                 node_t, packed, bucket, Jp,
@@ -1590,9 +1643,9 @@ def remap_speculative_solution(sol: _FusedSolution, ordered_jobs, ssn):
 def _fused_blocks_solver():
     import jax
     if "blocks" not in _SOLVER_CACHE:
-        from ..ops.auction import place_blocks
+        from ..ops.auction import place_blocks_packed
         _SOLVER_CACHE["blocks"] = jax.jit(
-            place_blocks, static_argnames=("chunk", "sweeps", "passes"))
+            place_blocks_packed, static_argnames=("chunk", "sweeps", "passes"))
     return _SOLVER_CACHE["blocks"]
 
 
@@ -1739,12 +1792,20 @@ def prewarm_shapes(ssn, shape_configs=None, engine: str = "tpu-fused",
             pt, _ = _scan_placement_tasks(
                 req, job_ix, np.ones((T, N), bool),
                 np.zeros((T, N), np.float32))
-            out = _job_solver()(
-                node_t.node_state(), pt,
-                JobMeta(min_available=min_av, base_ready=base_z,
-                        base_pipelined=base_z),
-                weights, jnp.asarray(node_t.allocatable),
-                jnp.asarray(node_t.max_tasks))
+            meta = JobMeta(min_available=min_av, base_ready=base_z,
+                           base_pipelined=base_z)
+            if _topology_weight(ssn) > 0.0:
+                out = _job_solver_topo()(
+                    node_t.node_state(), pt, meta, weights,
+                    jnp.asarray(node_t.allocatable),
+                    jnp.asarray(node_t.max_tasks),
+                    jnp.asarray(node_t.zone_code),
+                    jnp.float32(_topology_weight(ssn)))
+            else:
+                out = _job_solver()(
+                    node_t.node_state(), pt, meta,
+                    weights, jnp.asarray(node_t.allocatable),
+                    jnp.asarray(node_t.max_tasks))
         jax.block_until_ready(out)
         warmed += 1
     warmed += _warm_preempt()

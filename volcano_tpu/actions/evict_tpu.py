@@ -279,11 +279,11 @@ def _eviction_order(ssn, victims: List[TaskInfo]) -> List[TaskInfo]:
     comparator sort); comparator sort otherwise."""
     chain = _task_order_chain(ssn)
     if chain == ["priority"]:
-        return sorted(victims,
-                      key=lambda t: (-t.priority, t.creation_timestamp,
-                                     t.uid), reverse=True)
+        return _elastic_victims_first(ssn, sorted(
+            victims, key=lambda t: (-t.priority, t.creation_timestamp,
+                                    t.uid), reverse=True))
     if not chain:
-        return list(victims)
+        return _elastic_victims_first(ssn, list(victims))
 
     def cmp(l, r):
         if ssn.task_order_fn(l, r):
@@ -291,7 +291,42 @@ def _eviction_order(ssn, victims: List[TaskInfo]) -> List[TaskInfo]:
         if ssn.task_order_fn(r, l):
             return -1
         return 0
-    return sorted(victims, key=cmp_to_key(cmp))
+    return _elastic_victims_first(ssn, sorted(victims, key=cmp_to_key(cmp)))
+
+
+def _elastic_victims_first(ssn, ordered: List[TaskInfo]) -> List[TaskInfo]:
+    """The elastic-gang victim tier: above-min members of elastic gangs
+    are the cheapest victims in the cluster, so they move to the FRONT
+    of the eviction order — the walk spends them before touching any
+    rigid gang or any elastic gang's core. Each gang designates its
+    highest-uid victims, capped at its shrink allowance (the count-based
+    surplus; never a path below min — the live tiered chain re-validates
+    allowances per attempt on top of this ordering). Exact no-op — same
+    list object order — when no elastic gang is present, which is what
+    keeps pre-elastic scenarios byte-identical."""
+    from ..elastic_gang.membership import is_elastic, shrink_allowance
+    allow: Dict[str, int] = {}
+    for t in ordered:
+        if t.job in allow:
+            continue
+        job = ssn.jobs.get(t.job)
+        allow[t.job] = shrink_allowance(job) \
+            if job is not None and is_elastic(job) else 0
+    if not any(allow.values()):
+        return ordered
+    surplus = set()
+    by_job: Dict[str, List[TaskInfo]] = {}
+    for t in ordered:
+        by_job.setdefault(t.job, []).append(t)
+    for uid, ts in by_job.items():
+        a = allow[uid]
+        if a <= 0:
+            continue
+        for t in sorted(ts, key=lambda x: x.uid, reverse=True)[:a]:
+            surplus.add(t.uid)
+    front = [t for t in ordered if t.uid in surplus]
+    rest = [t for t in ordered if t.uid not in surplus]
+    return front + rest
 
 
 def _collect_victims(ssn) -> List[TaskInfo]:

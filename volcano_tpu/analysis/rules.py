@@ -560,6 +560,82 @@ class MembershipFunnelRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# VT020 — elastic mutations ride journaled+fenced funnels
+# ---------------------------------------------------------------------------
+
+class ElasticFunnelRule(Rule):
+    """Elastic-gang mutations come in two shapes, and both must leave a
+    durable, epoch-stamped control record on the path. (a) Membership
+    moves — a grow (``ssn.allocate``) or shrink (``ssn.evict``) issued
+    from the elastic stage — need an ``elastic_grow``/``elastic_shrink``
+    record beside the bind/evict intent (the ``_journal_elastic``
+    witness): after a crash the replayer must distinguish an elastic
+    shrink from a genuine preemption, or it restores surplus members a
+    scale-down already shed. (b) Lifecycle verbs — rewrites of the
+    ``volcano.sh/suspend`` / ``volcano.sh/elastic-desired`` annotations
+    — may only happen inside the Command funnel's consume path, which
+    journals ``command_applied``/``command_dropped`` (``record_control``
+    witness): a bare annotation write is a suspend that never happened
+    as far as the journal is concerned (docs/design/elastic-gangs.md
+    lifecycle protocol)."""
+
+    id = "VT020"
+    name = "elastic-funnel"
+    contract = ("elastic grow/shrink or lifecycle-annotation rewrite "
+                "outside the journaled+fenced funnel (elastic gangs, "
+                "docs/design/elastic-gangs.md)")
+    scope = ("volcano_tpu/elastic_gang/",)
+
+    SESSION_MUTATORS = {"evict", "allocate"}
+    ANNOTATION_KEYS = {"SUSPEND_ANNOTATION", "ELASTIC_DESIRED_ANNOTATION"}
+    WITNESS = {"_journal_elastic", "record_control"}
+
+    @classmethod
+    def _elastic_key(cls, node: Optional[ast.AST]) -> bool:
+        if isinstance(node, getattr(ast, "Index", ())):  # py<3.9 slices
+            node = node.value
+        return isinstance(node, ast.Name) and node.id in cls.ANNOTATION_KEYS
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            desc = None
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                recv = dotted_name(node.func.value) or "<expr>"
+                if node.func.attr in self.SESSION_MUTATORS:
+                    desc = (f"elastic member move "
+                            f"{recv}.{node.func.attr}(...)")
+                elif node.func.attr == "pop" and node.args \
+                        and self._elastic_key(node.args[0]):
+                    desc = (f"lifecycle annotation removal "
+                            f"{recv}.pop({node.args[0].id}, ...)")
+            elif isinstance(node, (ast.Assign, ast.Delete)):
+                targets = node.targets
+                for tgt in targets:
+                    if isinstance(tgt, ast.Subscript) \
+                            and self._elastic_key(tgt.slice):
+                        recv = dotted_name(tgt.value) or "<expr>"
+                        desc = (f"lifecycle annotation rewrite "
+                                f"{recv}[...]")
+                        break
+            if desc is None:
+                continue
+            fn = mod.enclosing_function(node.lineno)
+            if fn is not None and ctx.witness_in_scope(fn, self.WITNESS):
+                continue
+            where = fn.qualname if fn else "<module>"
+            findings.append(self.finding(
+                mod, node,
+                f"{desc} in {where} without a journaled control record "
+                f"(_journal_elastic / record_control) on the path; "
+                f"elastic grows, shrinks and lifecycle verbs ride the "
+                f"journaled+fenced funnel only "
+                f"(docs/design/elastic-gangs.md)"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
 # VT016 — store verbs ride the retrying-transport funnel (store boundary)
 # ---------------------------------------------------------------------------
 
@@ -1720,6 +1796,7 @@ ALL_RULES: List[Rule] = [
     DtypeDisciplineRule(), SessionEscapeRule(),
     SpeculationIsolationRule(), StoreVerbFunnelRule(),
     InflightLedgerRule(), BoundedWorkRule(), MembershipFunnelRule(),
+    ElasticFunnelRule(),
 ]
 
 # the rules that run on the shared dataflow/callgraph engine
@@ -1761,6 +1838,10 @@ solver(state, tasks)                       # no _bucket()/pad on the path''',
     pmap._transfer_node_raw(node, 2)       # no _journal_reserve record''',
     "VT019": '''def grow(pmap):
     pid = pmap._spawn_partition_raw()      # no partition_spawn record''',
+    "VT020": '''def shed(self, ssn, task):
+    ssn.evict(task, "elastic-scale")       # no elastic_shrink record:
+                                           # replay can't tell a shrink
+                                           # from a preemption''',
     "VT010": '''packed = solver(state, tasks)          # device value
 n = int(packed[0])                     # implicit fetch OUTSIDE any
                                        # solve/replay/upload span''',

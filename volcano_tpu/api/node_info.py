@@ -52,6 +52,11 @@ class NodeInfo:
         # volcano.sh/revocable-zone label marks time-division-multiplexed
         # nodes (tdm plugin)
         self.revocable_zone = self.labels.get("volcano.sh/revocable-zone", "")
+        # volcano.sh/topology-zone label names the node's interconnect
+        # locality group (rack / NUMA island, the Numatopology CRD reduced
+        # to one axis); the elastic-gang compactness term co-locates gang
+        # members by it (cache/snapshot.py zone_code)
+        self.topology_zone = self.labels.get("volcano.sh/topology-zone", "")
         self.tasks: Dict[str, TaskInfo] = {}
         # Mutation witness for the incremental snapshot (cache.snapshot
         # clone-on-dirty, docs/performance.md): add_task/remove_task — the
@@ -208,6 +213,7 @@ class NodeInfo:
         n.unschedulable = self.unschedulable
         n.annotations = self.annotations
         n.revocable_zone = self.revocable_zone
+        n.topology_zone = self.topology_zone
         n.used_ports = dict(self.used_ports)
         n.ready = self.ready
         n._touched = False

@@ -981,11 +981,13 @@ class SchedulerCache:
             if not np.array_equal(getattr(tc, field)[i],
                                   getattr(node, field).to_vector(rnames)):
                 return False
-        from .snapshot import BIG_MAX_TASKS
+        from .snapshot import BIG_MAX_TASKS, zone_code
         want_max = node.max_task_num if node.max_task_num > 0 \
             else BIG_MAX_TASKS
         return (int(tc.max_tasks[i]) == want_max
-                and int(tc.ntasks[i]) == len(node.tasks))
+                and int(tc.ntasks[i]) == len(node.tasks)
+                and int(tc.zone_code[i])
+                == zone_code(getattr(node, "topology_zone", "")))
 
     # -- side effects (cache.go:549-666) ------------------------------------
 

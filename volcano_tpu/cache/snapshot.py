@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import heapq
 import time
+import zlib
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -30,6 +31,19 @@ from ..ops.place import NodeState
 from ..ops.scores import ScoreWeights
 
 BIG_MAX_TASKS = 1 << 30
+
+
+def zone_code(zone: str) -> int:
+    """Stable i32 code for a topology-zone name (0 = unzoned). The
+    interconnect-distance matrix the topology term consumes is
+    block-constant over zones, so it factors into this per-node axis —
+    the only shape the row-wise dirty-set/scatter contract below can
+    carry. crc32 is content-addressed (no per-process interning table),
+    so codes survive restarts and row churn; the kernel only ever
+    compares codes for equality, never orders them."""
+    if not zone:
+        return 0
+    return (zlib.crc32(zone.encode("utf-8")) & 0x7FFFFFFF) or 1
 
 
 class NodeTensors:
@@ -54,6 +68,7 @@ class NodeTensors:
         self.allocatable = np.zeros((N, R), np.float32)
         self.max_tasks = np.zeros(N, np.int32)
         self.ntasks = np.zeros(N, np.int32)
+        self.zone_code = np.zeros(N, np.int32)
         for i, n in enumerate(nodes):
             self.idle[i] = n.idle.to_vector(rnames)
             self.used[i] = n.used.to_vector(rnames)
@@ -62,6 +77,7 @@ class NodeTensors:
             self.allocatable[i] = n.allocatable.to_vector(rnames)
             self.max_tasks[i] = n.max_task_num if n.max_task_num > 0 else BIG_MAX_TASKS
             self.ntasks[i] = len(n.tasks)
+            self.zone_code[i] = zone_code(getattr(n, "topology_zone", ""))
 
     def node_state(self) -> NodeState:
         import jax.numpy as jnp
@@ -78,6 +94,10 @@ class NodeTensors:
     def device_max_tasks(self):
         import jax.numpy as jnp
         return jnp.asarray(self.max_tasks)
+
+    def device_zone_code(self):
+        import jax.numpy as jnp
+        return jnp.asarray(self.zone_code)
 
 
 def _delta_bucket(n: int) -> int:
@@ -134,6 +154,9 @@ class TensorEpochView:
     def device_max_tasks(self):
         return self._device["max_tasks"]
 
+    def device_zone_code(self):
+        return self._device["zone_code"]
+
 
 class PersistentNodeTensors:
     """NodeTensors that survive across scheduling cycles.
@@ -177,6 +200,7 @@ class PersistentNodeTensors:
         self.allocatable = np.zeros((0, R), np.float32)
         self.max_tasks = np.zeros(0, np.int32)
         self.ntasks = np.zeros(0, np.int32)
+        self.zone_code = np.zeros(0, np.int32)
         self._device: Optional[dict] = None  # field -> jnp array
         self._node_state: Optional[NodeState] = None
         self.last_refresh: Dict[str, object] = {}
@@ -185,7 +209,7 @@ class PersistentNodeTensors:
         self.live_pins = 0
 
     _ROW_FIELDS = ("idle", "used", "releasing", "pipelined", "allocatable",
-                   "max_tasks", "ntasks")
+                   "max_tasks", "ntasks", "zone_code")
 
     def _write_row(self, i: int, node: NodeInfo) -> None:
         rn = self.rnames
@@ -197,12 +221,14 @@ class PersistentNodeTensors:
         self.max_tasks[i] = (node.max_task_num if node.max_task_num > 0
                              else BIG_MAX_TASKS)
         self.ntasks[i] = len(node.tasks)
+        self.zone_code[i] = zone_code(getattr(node, "topology_zone", ""))
 
     def _clear_row(self, i: int) -> None:
         for f in ("idle", "used", "releasing", "pipelined", "allocatable"):
             getattr(self, f)[i] = 0.0
         self.max_tasks[i] = 0                # ntasks < max_tasks never holds
         self.ntasks[i] = 0
+        self.zone_code[i] = 0
 
     def full_build(self, nodes: Dict[str, NodeInfo]) -> None:
         """Rebuild every row in snapshot order — byte-equal to a fresh
@@ -215,6 +241,7 @@ class PersistentNodeTensors:
             setattr(self, f, np.zeros((N, R), np.float32))
         self.max_tasks = np.zeros(N, np.int32)
         self.ntasks = np.zeros(N, np.int32)
+        self.zone_code = np.zeros(N, np.int32)
         for i, node in enumerate(nodes.values()):
             self._write_row(i, node)
         self._device = None
@@ -318,7 +345,7 @@ class PersistentNodeTensors:
     # -- epoch pair (docs/performance.md pipelining) ------------------------
 
     _HOST_FIELDS = ("idle", "used", "releasing", "pipelined", "allocatable",
-                    "max_tasks", "ntasks")
+                    "max_tasks", "ntasks", "zone_code")
 
     def pin_epoch(self) -> TensorEpochView:
         """Freeze the CURRENT epoch for an in-flight speculative solve:

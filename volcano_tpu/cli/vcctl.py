@@ -1,10 +1,19 @@
 """vcctl: the operator CLI.
 
 Mirrors /root/reference/{cmd/cli/vcctl.go:47-49, pkg/cli/job/*, pkg/cli/queue/*}:
-``job {run,list,view,suspend,resume,delete}``, ``queue {create,get,list,
-operate,delete}``, ``version``. Job suspend/resume/delete post bus Command
-CRs owner-referenced to the Job (pkg/cli/job/util.go:69-95), exactly like
-the reference — the job controller consumes them asynchronously.
+``job {run,list,view,suspend,resume,scale,delete}``, ``queue {create,get,
+list,operate,delete}``, ``version``. Job suspend/resume/delete post bus
+Command CRs owner-referenced to the Job (pkg/cli/job/util.go:69-95),
+exactly like the reference — the job controller consumes them
+asynchronously.
+
+With the running scheduler's elastic Command funnel attached
+(``main(..., funnel=...)``, like the in-process cache/trace verbs),
+``job suspend|resume|scale`` route through the journaled+fenced funnel
+instead (docs/design/elastic-gangs.md): the verb enqueues durably and
+applies at the next cycle boundary. ``job scale`` exists ONLY on that
+path — rewriting the desired-members annotation anywhere but the funnel
+is a vlint VT020 violation, so there is no store fallback for it.
 
 The standalone verb entry points (vsub/vcancel/vsuspend/vresume/vjobs/
 vqueues, Makefile:172-180) are exposed as functions of the same commands.
@@ -146,6 +155,17 @@ def build_parser() -> argparse.ArgumentParser:
         if verb != "list":
             p.add_argument("--name", required=True)
         p.add_argument("--namespace", default="default")
+    js = job.add_parser(
+        "scale", description="Rewrite an elastic gang's desired member "
+                             "count through the scheduler's journaled "
+                             "Command funnel; grow-shrink converges the "
+                             "gang over the next cycles "
+                             "(docs/design/elastic-gangs.md)")
+    js.add_argument("--name", required=True)
+    js.add_argument("--namespace", default="default")
+    js.add_argument("--desired", type=int, required=True,
+                    help="target member count (min_available still floors "
+                         "the gang; 0 parks it at min)")
 
     queue = sub.add_parser("queue").add_subparsers(dest="verb")
     qc = queue.add_parser("create")
@@ -258,7 +278,7 @@ def parse_requests(text: str) -> dict:
 
 
 def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
-         out=print, cache=None) -> int:
+         out=print, cache=None, funnel=None) -> int:
     args = build_parser().parse_args(argv)
     if args.group == "version":
         out(f"vcctl version {__version__}")
@@ -370,6 +390,31 @@ def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
                 if d.get(k):
                     out(f"p{pid}\t{k}={json.dumps(d[k], sort_keys=True)}")
         return 0
+    if args.group == "job" and args.verb in ("suspend", "resume", "scale"):
+        if funnel is not None:
+            # the scheduler's elastic lifecycle path: submit journals the
+            # verb (epoch-stamped), consume applies it at the next cycle
+            # boundary — never a direct annotation write from here (VT020)
+            uid = funnel.resolve_job(args.name, args.namespace)
+            if uid is None:
+                out(f"job {args.namespace}/{args.name} not known to the "
+                    f"scheduler cache")
+                return 1
+            ok = funnel.submit(args.verb, uid,
+                               getattr(args, "desired", None))
+            if not ok:
+                out(f"{args.verb} {args.namespace}/{args.name} rejected: "
+                    f"stale fencing epoch")
+                return 1
+            out(f"{args.verb} {args.namespace}/{args.name} queued "
+                f"(applies at the next cycle boundary)")
+            return 0
+        if args.verb == "scale":
+            # no store fallback by design: a desired-members rewrite
+            # outside the journaled funnel is exactly what VT020 forbids
+            out("job scale requires the running scheduler's command "
+                "funnel (in-process CLI: main(..., funnel=...))")
+            return 1
     if store is None:
         out("no cluster store attached (in-process CLI requires a store)")
         return 1

@@ -41,6 +41,14 @@ def vresume(argv=None) -> int:
     return _run(["job", "resume"], argv)
 
 
+def vscale(argv=None) -> int:
+    """vscale == vcctl job scale: rewrite an elastic gang's desired
+    member count through the scheduler's journaled Command funnel
+    (docs/design/elastic-gangs.md). In-process callers pass the running
+    scheduler's funnel via vcctl.main(..., funnel=...)."""
+    return _run(["job", "scale"], argv)
+
+
 def vjobs(argv=None) -> int:
     return _run(["job", "list"], argv)
 

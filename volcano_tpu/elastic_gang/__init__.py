@@ -1,0 +1,36 @@
+"""Elastic gangs: min/desired membership as a scheduler decision class.
+
+- membership.py — annotations, counts, the allocate pending filter;
+- commands.py — the journaled+fenced suspend/resume/scale funnel;
+- grow_shrink.py — the elastic stage between allocate and preempt.
+
+The plugin half (pending filter installation, victim guards, topology
+node-order bonus) lives in plugins/elastic_gang.py; the device victim
+tier in actions/evict_tpu.py.
+"""
+
+from .commands import VERBS, CommandFunnel
+from .membership import (ELASTIC_DESIRED_ANNOTATION, SUSPEND_ANNOTATION,
+                         TOPOLOGY_ZONE_LABEL, active_members,
+                         allocate_pending_filter, desired_members,
+                         grow_candidates, is_elastic, is_suspended,
+                         shrink_allowance, shrink_candidates)
+
+__all__ = [
+    "CommandFunnel", "VERBS", "GrowShrinkAction",
+    "ELASTIC_DESIRED_ANNOTATION", "SUSPEND_ANNOTATION",
+    "TOPOLOGY_ZONE_LABEL",
+    "active_members", "allocate_pending_filter", "desired_members",
+    "grow_candidates", "is_elastic", "is_suspended", "shrink_allowance",
+    "shrink_candidates",
+]
+
+
+def __getattr__(name):
+    # GrowShrinkAction is exported lazily: grow_shrink.py imports
+    # actions.base, and an eager import here would close the
+    # elastic_gang -> actions -> elastic_gang cycle at package-init time
+    if name == "GrowShrinkAction":
+        from .grow_shrink import GrowShrinkAction
+        return GrowShrinkAction
+    raise AttributeError(name)

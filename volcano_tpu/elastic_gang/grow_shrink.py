@@ -1,0 +1,228 @@
+"""grow-shrink action: the elastic stage between allocate and preempt.
+
+Runs after allocate (admission at min already settled this cycle) and
+before preempt/reclaim (so voluntarily freed capacity is visible before
+anyone considers forced victims). Four sub-passes, in order:
+
+1. suspend drain — a suspended gang gives up EVERY member. This is the
+   one full-gang decision in the file: below-min is legal here because
+   the whole gang stops, not a fraction of it.
+2. scale shrink — a gang bound above its (possibly just re-written)
+   desired count sheds the excess, highest-uid members first.
+3. pressure shrink — when admission-starved gangs are waiting, shed a
+   bounded number of above-min members per cycle from the most-inflated
+   elastic gangs, before preempt has to pick forced victims.
+4. grow — only when NO gang is starving for admission: place pending
+   members of admitted elastic gangs toward desired through the host
+   placer (predicates + node order, so the topology compactness bonus
+   steers members into the gang's anchor zone), binding through
+   ``ssn.allocate`` -> dispatch -> cache.bind — the journaled funnel.
+
+Every grow/shrink additionally journals an ``elastic_grow`` /
+``elastic_shrink`` control record stamped with the fencing epoch
+(vlint VT020: elastic mutations ride journaled+fenced funnels).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..api import TaskStatus
+from ..metrics import (register_below_min_eviction, register_gang_growth,
+                       register_gang_shrink, set_elastic_members,
+                       set_topology_spread)
+from ..obs import trace as obs_trace
+from ..utils.scheduler_helper import (predicate_nodes, prioritize_nodes,
+                                      select_best_node)
+from ..actions.base import Action
+from .membership import (active_members, desired_members, grow_candidates,
+                         is_elastic, is_suspended, shrink_allowance,
+                         shrink_candidates)
+
+log = logging.getLogger(__name__)
+
+
+def _conf_int(ssn, key: str, default: int) -> int:
+    for conf in getattr(ssn, "configurations", []) or []:
+        if getattr(conf, "name", "") == "grow-shrink":
+            try:
+                return int((conf.arguments or {}).get(key, default))
+            except (TypeError, ValueError):
+                return default
+    return default
+
+
+class GrowShrinkAction(Action):
+    NAME = "grow-shrink"
+
+    def __init__(self):
+        # per-cycle stats, harvested by the sim runner / report after
+        # each execute (reset at entry)
+        self.last_stats = {}
+
+    def execute(self, ssn) -> None:
+        with obs_trace.span("grow_shrink"):
+            self._execute(ssn)
+
+    # -- journal witness ----------------------------------------------------
+
+    def _journal_elastic(self, ssn, kind: str, task, reason: str = "") -> None:
+        """Every elastic mutation leaves a durable, epoch-stamped control
+        record beside the bind/evict intent the session funnel already
+        wrote — the VT020 witness and the soak's byte-diff evidence."""
+        journal = getattr(ssn.cache, "journal", None)
+        if journal is None:
+            return
+        journal.record_control(kind, {
+            "job": task.job, "task": task.uid, "node": task.node_name,
+            "reason": reason, "epoch": ssn.cache.fencing_epoch()})
+
+    # -- mutation funnels ---------------------------------------------------
+
+    def _shrink_one(self, ssn, job, task, reason: str,
+                    full_gang: bool = False) -> bool:
+        """Evict one elastic member through the session funnel. Refuses
+        to go below min unless this is a full-gang decision (suspend
+        drain); the below-min counter is the witness that the guard
+        held — it must stay zero outside full-gang drains."""
+        if not full_gang and active_members(job) - 1 < job.min_available:
+            register_below_min_eviction()
+            log.error("refusing below-min shrink of %s (%s)", job.uid, reason)
+            return False
+        if task.node_name not in ssn.nodes:
+            # the member's node left the snapshot (drained/cordoned):
+            # there is no session-visible placement to release this
+            # cycle. Retry next cycle — a restore brings the node back,
+            # a node death requeues the member through the cache funnel.
+            return False
+        ssn.evict(task, f"elastic-{reason}")
+        self._journal_elastic(ssn, "elastic_shrink", task, reason)
+        register_gang_shrink(reason)
+        self.last_stats["shrinks"] = self.last_stats.get("shrinks", 0) + 1
+        return True
+
+    def _grow_one(self, ssn, job, task) -> bool:
+        """Place one pending member of an admitted gang. The gang is
+        ready (active >= min), so ``ssn.allocate`` dispatches the bind
+        immediately — cache.bind journals + fences it."""
+        fit = [n for n in ssn.node_list
+               if task.resreq.less_equal(n.idle) and n.ready]
+        if not fit:
+            return False
+        feasible, _ = predicate_nodes(task, fit, ssn.predicate_fn)
+        if not feasible:
+            return False
+        scores = prioritize_nodes(task, feasible, ssn.batch_node_order_fn,
+                                  ssn.node_order_fn)
+        node = select_best_node(scores)
+        if node is None:
+            return False
+        ssn.allocate(task, node)
+        self._journal_elastic(ssn, "elastic_grow", task, "grow")
+        register_gang_growth()
+        self.last_stats["grows"] = self.last_stats.get("grows", 0) + 1
+        return True
+
+    # -- the stage ----------------------------------------------------------
+
+    def _execute(self, ssn) -> None:
+        self.last_stats = {"grows": 0, "shrinks": 0, "suspended_drained": 0}
+        elastic = sorted((j for j in ssn.jobs.values() if is_elastic(j)),
+                         key=lambda j: j.uid)
+        if not elastic:
+            self._publish_gauges(ssn, elastic)
+            return
+
+        # 1. suspend drain: the full-gang decision.
+        for job in elastic:
+            if not is_suspended(job):
+                continue
+            drained = 0
+            for task in shrink_candidates(job):
+                if self._shrink_one(ssn, job, task, "suspend",
+                                    full_gang=True):
+                    drained += 1
+            if drained:
+                self.last_stats["suspended_drained"] += 1
+
+        # 2. scale shrink: above the (possibly freshly scaled) desired.
+        for job in elastic:
+            if is_suspended(job):
+                continue
+            excess = active_members(job) - desired_members(job)
+            if excess <= 0:
+                continue
+            excess = min(excess, shrink_allowance(job))
+            for task in shrink_candidates(job)[:excess]:
+                self._shrink_one(ssn, job, task, "scale")
+
+        # 3/4. pressure shrink vs grow: starving gangs get first claim.
+        starving = self._starving_exists(ssn)
+        if starving:
+            budget = _conf_int(ssn, "max-pressure-shrinks", 2)
+            donors = sorted((j for j in elastic
+                             if not is_suspended(j) and shrink_allowance(j) > 0),
+                            key=lambda j: (-shrink_allowance(j), j.uid))
+            for job in donors:
+                if budget <= 0:
+                    break
+                take = min(shrink_allowance(job), budget)
+                for task in shrink_candidates(job)[:take]:
+                    if self._shrink_one(ssn, job, task, "pressure"):
+                        budget -= 1
+        else:
+            max_grows = _conf_int(ssn, "max-grows-per-cycle", 0)
+            grown = 0
+            for job in elastic:
+                if is_suspended(job) or not job.ready():
+                    continue
+                need = desired_members(job) - active_members(job)
+                for task in grow_candidates(job)[:max(need, 0)]:
+                    if max_grows and grown >= max_grows:
+                        break
+                    if self._grow_one(ssn, job, task):
+                        grown += 1
+
+        self._publish_gauges(ssn, elastic)
+
+    @staticmethod
+    def _starving_exists(ssn) -> bool:
+        """A valid, unadmitted gang with real pending requests is waiting
+        for capacity — elastic surplus must not outbid admission."""
+        for job in ssn.jobs.values():
+            if job.ready():
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.passed:
+                continue
+            pend = job.task_status_index.get(TaskStatus.PENDING, {})
+            if any(not t.init_resreq.is_empty() for t in pend.values()):
+                return True
+        return False
+
+    def _publish_gauges(self, ssn, elastic) -> None:
+        above_min = sum(max(active_members(j) - j.min_available, 0)
+                        for j in elastic)
+        set_elastic_members(above_min)
+        spread = 0
+        for job in ssn.jobs.values():
+            zones = set()
+            for status in (TaskStatus.BOUND, TaskStatus.RUNNING,
+                           TaskStatus.BINDING, TaskStatus.ALLOCATED):
+                for t in job.task_status_index.get(status, {}).values():
+                    node = ssn.nodes.get(t.node_name)
+                    if node is not None and node.topology_zone:
+                        zones.add(node.topology_zone)
+            if len(zones) > 1:
+                spread += 1
+        set_topology_spread(spread)
+        self.last_stats["above_min_members"] = above_min
+        self.last_stats["topology_spread"] = spread
+
+
+# self-registration: actions/__init__ imports this module for the side
+# effect (guarded against the grow_shrink -> actions.base import cycle),
+# so "grow-shrink" resolves from conf like any in-tree action
+from ..framework.registry import register_action  # noqa: E402
+
+register_action(GrowShrinkAction())

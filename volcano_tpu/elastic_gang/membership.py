@@ -1,0 +1,130 @@
+"""Elastic gang membership: the min/desired decision class.
+
+An elastic gang admits at ``min_available`` and opportunistically expands
+toward a ``desired`` member count as capacity frees; under pressure its
+above-min members are the cheapest victims in the cluster. The decision
+class is COUNT-based, not identity-based: any ``active - min`` surplus is
+shrinkable and any pending member of an admitted gang is growable, so a
+core member lost under churn is re-placed by the next grow pass instead
+of deadlocking behind a surviving "surplus" member. Identity only enters
+as a deterministic tie-order (task uid).
+
+Annotations (PodGroup):
+
+- ``volcano.sh/elastic-desired``: presence marks the gang elastic; the
+  integer value is the target member count (clamped to >= min_available).
+- ``volcano.sh/suspend``: ``"true"`` parks the gang — grow-shrink drains
+  every member (a full-gang decision, so below-min is legal there and
+  only there) and the allocate engines see an empty pending set until a
+  ``resume`` command clears the mark.
+
+Both annotations are rewritten exclusively by the Command funnel
+(commands.py) at the cycle boundary, never mid-cycle.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..api import TaskStatus
+
+ELASTIC_DESIRED_ANNOTATION = "volcano.sh/elastic-desired"
+SUSPEND_ANNOTATION = "volcano.sh/suspend"
+# the node label naming its interconnect locality group (NodeInfo reads
+# it into .topology_zone; cache/snapshot.py hashes it into zone_code)
+TOPOLOGY_ZONE_LABEL = "volcano.sh/topology-zone"
+
+
+def _annotations(job) -> dict:
+    pg = getattr(job, "podgroup", None)
+    if pg is None:
+        return {}
+    return getattr(pg, "annotations", None) or {}
+
+
+def is_elastic(job) -> bool:
+    """The elastic-desired annotation is the membership switch: absent
+    means a classic rigid gang and every elastic code path must degrade
+    to a byte-identical no-op."""
+    return ELASTIC_DESIRED_ANNOTATION in _annotations(job)
+
+
+def is_suspended(job) -> bool:
+    return _annotations(job).get(SUSPEND_ANNOTATION, "") == "true"
+
+
+def desired_members(job) -> int:
+    """Target member count: the annotation value clamped to min_available
+    (a desired below min is a malformed spec the webhook rejects, but a
+    stale object may still carry one — clamping keeps the invariant)."""
+    try:
+        d = int(_annotations(job).get(ELASTIC_DESIRED_ANNOTATION, 0))
+    except (TypeError, ValueError):
+        d = 0
+    return max(d, job.min_available)
+
+
+def active_members(job) -> int:
+    """Members currently holding (or pledged) capacity — the same count
+    gang admission reads (JobInfo.ready_task_num)."""
+    return job.ready_task_num()
+
+
+def shrink_allowance(job) -> int:
+    """How many members an elastic decision (preempt victim tier, scale
+    verb, pressure shrink) may take WITHOUT a full-gang decision:
+    ``active - min``, floored at zero. Rigid gangs always answer 0."""
+    if not is_elastic(job):
+        return 0
+    return max(active_members(job) - job.min_available, 0)
+
+
+def shrink_candidates(job) -> List:
+    """Bound/running members in eviction-preference order: highest task
+    uid first. When a gang is fully placed these are exactly the members
+    admission filled last; under churn the order stays total and
+    deterministic regardless of which members survived. Callers must cap
+    the slice they take at shrink_allowance (or drain fully for the
+    suspend full-gang decision)."""
+    out: List = []
+    for status in (TaskStatus.BOUND, TaskStatus.RUNNING):
+        out.extend(job.task_status_index.get(status, {}).values())
+    out.sort(key=lambda t: t.uid, reverse=True)
+    return out
+
+
+def grow_candidates(job) -> List:
+    """Pending members with a real request, lowest uid first — the order
+    grow fills them. Only members whose placement the solver can account
+    for are growable (best-effort pendings already ride backfill)."""
+    pending = [t for t in job.task_status_index.get(TaskStatus.PENDING,
+                                                    {}).values()
+               if not t.init_resreq.is_empty()]
+    pending.sort(key=lambda t: t.uid)
+    return pending
+
+
+def allocate_pending_filter(job, tasks):
+    """Session hook consumed by allocate._pending_tasks (attribute
+    ``ssn.elastic_pending_filter``, installed by the elastic_gang
+    plugin): narrows the pending set the batched solvers see so the
+    min/desired split becomes a solver-visible decision class.
+
+    - rigid gang: unchanged (byte-identical to the pre-elastic planner);
+    - suspended: empty — a parked gang asks for nothing;
+    - admitted (active >= min): empty — expansion beyond min belongs to
+      the grow-shrink stage, which only moves when no starving gang
+      wants the capacity, so surplus members can never outbid admission;
+    - not yet admitted: the first ``min - active`` pendings by uid, so
+      the gang vote fires exactly at min and the admission footprint is
+      the smallest the gang can run with.
+    """
+    if not tasks or not is_elastic(job):
+        return tasks
+    if is_suspended(job):
+        return []
+    need = job.min_available - active_members(job)
+    if need <= 0:
+        return []
+    ordered = sorted(tasks, key=lambda t: t.uid)
+    return ordered[:need]

@@ -263,6 +263,89 @@ if _HAVE_PROM:
         "Elastic membership merges through the journaled "
         "partition_retire funnel (result=begun|completed|refused)",
         ["result"])
+    _elastic_members = Gauge(
+        f"{_SUBSYSTEM}_elastic_members",
+        "Bound above-min members across elastic gangs (the flex the "
+        "grow/shrink stage manages; docs/design/elastic-gangs.md)")
+    _gang_growths = Counter(
+        f"{_SUBSYSTEM}_gang_growths_total",
+        "Elastic gang members placed by the grow/shrink stage beyond "
+        "admission (docs/design/elastic-gangs.md)")
+    _gang_shrinks = Counter(
+        f"{_SUBSYSTEM}_gang_shrinks_total",
+        "Elastic gang members evicted by an elastic decision "
+        "(reason=scale|pressure|suspend)", ["reason"])
+    _topology_spread = Gauge(
+        f"{_SUBSYSTEM}_topology_spread",
+        "Multi-member gangs currently spanning more than one topology "
+        "zone (0 with the compactness term doing its job and capacity "
+        "permitting)")
+    _below_min_evictions = Counter(
+        f"{_SUBSYSTEM}_elastic_below_min_evictions_total",
+        "Evictions that took an elastic gang below min outside a "
+        "full-gang decision — the invariant witness, expected 0")
+
+
+def set_elastic_members(n: int) -> None:
+    """Publish the bound above-min member count across elastic gangs —
+    the volcano_elastic_members gauge the grow/shrink stage moves."""
+    with _lock:
+        _gauges[("elastic_members",)] = float(n)
+    if _HAVE_PROM:
+        _elastic_members.set(n)
+
+
+def register_gang_growth(n: int = 1) -> None:
+    """The grow/shrink stage placed ``n`` elastic members beyond
+    admission (toward desired)."""
+    with _lock:
+        _counters[("gang_growths",)] += n
+    if _HAVE_PROM:
+        _gang_growths.inc(n)
+
+
+def register_gang_shrink(reason: str, n: int = 1) -> None:
+    """An elastic decision evicted ``n`` gang members
+    (reason=scale|pressure|suspend)."""
+    with _lock:
+        _counters[("gang_shrinks", reason)] += n
+    if _HAVE_PROM:
+        _gang_shrinks.labels(reason=reason).inc(n)
+
+
+def set_topology_spread(n: int) -> None:
+    """Publish the count of multi-member gangs spanning more than one
+    topology zone (volcano_topology_spread)."""
+    with _lock:
+        _gauges[("topology_spread",)] = float(n)
+    if _HAVE_PROM:
+        _topology_spread.set(n)
+
+
+def register_below_min_eviction(n: int = 1) -> None:
+    """An eviction took an elastic gang below min OUTSIDE a full-gang
+    decision — the never-below-min invariant witness (expected 0; the
+    elastic-churn scenario asserts it)."""
+    with _lock:
+        _counters[("elastic_below_min_evictions",)] += n
+    if _HAVE_PROM:
+        _below_min_evictions.inc(n)
+
+
+def elastic_counts() -> Dict[str, float]:
+    """Current elastic-gang outcome counts (grows, per-reason shrinks as
+    ``shrink/<reason>``, below-min eviction witness); the sim reads these
+    and takes a before/after delta for per-run sections."""
+    with _lock:
+        out: Dict[str, float] = {}
+        for k, v in _counters.items():
+            if k[0] == "gang_growths":
+                out["grows"] = out.get("grows", 0.0) + v
+            elif k[0] == "gang_shrinks":
+                out[f"shrink/{k[1]}"] = v
+            elif k[0] == "elastic_below_min_evictions":
+                out["below_min"] = v
+        return out
 
 
 def update_e2e_duration(seconds: float) -> None:
@@ -864,6 +947,8 @@ _EXPO_GAUGES = {
     "partition_leader": (f"{_SUBSYSTEM}_partition_leader", "partition"),
     "partition_count": (f"{_SUBSYSTEM}_partition_count", None),
     "tensor_epochs_live": (f"{_SUBSYSTEM}_tensor_epochs_live", None),
+    "elastic_members": (f"{_SUBSYSTEM}_elastic_members", None),
+    "topology_spread": (f"{_SUBSYSTEM}_topology_spread", None),
     "store_watch_staleness": (f"{_SUBSYSTEM}_store_watch_staleness", None),
     "inflight_open": (f"{_SUBSYSTEM}_inflight_open", None),
     "inflight_oldest_seconds": (f"{_SUBSYSTEM}_inflight_oldest_seconds",
@@ -888,6 +973,10 @@ _EXPO_COUNTERS = {
     "device_faults": (f"{_SUBSYSTEM}_device_faults_total", "kind"),
     "device_degraded_cycles": (
         f"{_SUBSYSTEM}_device_degraded_cycles_total", None),
+    "gang_growths": (f"{_SUBSYSTEM}_gang_growths_total", None),
+    "gang_shrinks": (f"{_SUBSYSTEM}_gang_shrinks_total", "reason"),
+    "elastic_below_min_evictions": (
+        f"{_SUBSYSTEM}_elastic_below_min_evictions_total", None),
     "fencing_rejections": (f"{_SUBSYSTEM}_fencing_rejections_total", "op"),
     "failovers": (f"{_SUBSYSTEM}_failovers_total", None),
     "cross_partition_reserves": (

@@ -7,7 +7,7 @@ from .scores import (ScoreWeights, balanced_allocation_score, binpack_score,
 from .place import (NO_NODE, JobMeta, NodeState, PlacementResult,
                     PlacementTasks, gang_admission, make_node_state,
                     place_scan)
-from .auction import BlockTasks, place_blocks
+from .auction import BlockTasks, place_blocks, place_blocks_packed
 from .fairness import (ProportionResult, dominant_share, drf_shares,
                        proportion_deserved, queue_overused)
 
@@ -18,7 +18,7 @@ __all__ = [
     "most_allocated_score",
     "NO_NODE", "JobMeta", "NodeState", "PlacementResult", "PlacementTasks",
     "gang_admission", "make_node_state", "place_scan",
-    "BlockTasks", "place_blocks",
+    "BlockTasks", "place_blocks", "place_blocks_packed",
     "ProportionResult", "dominant_share", "drf_shares", "proportion_deserved",
     "queue_overused",
 ]

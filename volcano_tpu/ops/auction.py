@@ -231,3 +231,24 @@ def place_blocks(nodes: NodeState, tasks: BlockTasks, jobs: JobMeta,
     (nodes, assign, pipe, _), (readies, kepts) = jax.lax.scan(
         sweep, (nodes, assign, pipe0, job_dead), jnp.arange(sweeps))
     return assign[:T], pipe[:T], readies[-1], kepts[-1], nodes
+
+
+def place_blocks_packed(nodes: NodeState, tasks: BlockTasks, jobs: JobMeta,
+                        weights: ScoreWeights, allocatable: jnp.ndarray,
+                        max_tasks: jnp.ndarray, chunk: int = 256,
+                        sweeps: int = 3, passes: int = 3):
+    """place_blocks with the place_scan_packed single-fetch layout
+    ``[task_node | task_pipelined | job_ready | job_kept]`` (i32, task
+    spans length T, job spans length J). One wire format for both fused
+    solvers means ONE host readback site (allocate._fetch_packed) serves
+    the scan and blocks engines alike; the final NodeState stays on
+    device, never fetched."""
+    assign, pipe, ready, kept, nodes = place_blocks(
+        nodes, tasks, jobs, weights, allocatable, max_tasks,
+        chunk=chunk, sweeps=sweeps, passes=passes)
+    packed = jnp.concatenate([
+        assign,
+        pipe.astype(jnp.int32),
+        ready.astype(jnp.int32),
+        kept.astype(jnp.int32)])
+    return packed, nodes

@@ -201,6 +201,127 @@ def place_scan_packed(nodes: NodeState, tasks: PlacementTasks, jobs: JobMeta,
     return packed, res.nodes
 
 
+class _CarryTopo(NamedTuple):
+    tent: NodeState            # tentative (inside current job's statement)
+    saved: NodeState           # committed state at current job's start
+    cnt_alloc: jnp.ndarray     # i32 newly-allocated tasks of current job
+    cnt_pipe: jnp.ndarray      # i32 newly-pipelined tasks of current job
+    broken: jnp.ndarray        # bool: a task of this job had no feasible node
+    anchor: jnp.ndarray        # i32 zone code of the job's first placement (0=none)
+
+
+def place_scan_topo(nodes: NodeState, tasks: PlacementTasks, jobs: JobMeta,
+                    weights: ScoreWeights, allocatable: jnp.ndarray,
+                    max_tasks: jnp.ndarray, zone_code: jnp.ndarray,
+                    topo_weight: jnp.ndarray,
+                    unroll: int = 8) -> PlacementResult:
+    """place_scan with a batched gang-compactness term (Tesserae-style
+    topology packing as a score term, not a host filter).
+
+    zone_code: i32[N] per-node topology-zone code (0 = unzoned). The
+    interconnect-distance matrix is block-constant over zones (intra-zone
+    ~0, inter-zone ~1 for rack/NUMA locality), so it factors into this
+    per-node axis — the only shape compatible with the persistent
+    snapshot's row-wise dirty-set/scatter contract. The job's FIRST
+    placement anchors its zone; every later member scores
+    ``+topo_weight`` on nodes sharing that zone, steering the argmax
+    toward co-location while resource fit and the other score terms
+    still dominate infeasible-or-worse choices. topo_weight: f32 scalar
+    (traced, so one compiled program serves all weights)."""
+    J = jobs.min_available.shape[0]
+
+    def step(carry: _CarryTopo, inp):
+        (req, job_ix, valid, feas, static_score,
+         first_of_job, last_of_job) = inp
+
+        saved = _select(first_of_job, carry.tent, carry.saved)
+        cnt_alloc = jnp.where(first_of_job, 0, carry.cnt_alloc)
+        cnt_pipe = jnp.where(first_of_job, 0, carry.cnt_pipe)
+        broken = jnp.where(first_of_job, False, carry.broken)
+        anchor = jnp.where(first_of_job, 0, carry.anchor)
+        tent = carry.tent
+
+        pods_ok = tent.ntasks < max_tasks
+        fit_future = le_all(req[None, :], tent.future_idle) & feas & pods_ok
+        fit_idle = le_all(req[None, :], tent.idle) & fit_future
+        has_node = jnp.any(fit_future)
+
+        attempt = valid & ~broken
+        broken = broken | (attempt & ~has_node)
+
+        score = static_score + combined_dynamic_score(
+            req, tent.used, allocatable, weights)
+        same_zone = (zone_code == anchor) & (anchor != 0)
+        score = score + topo_weight * same_zone.astype(score.dtype)
+        masked = jnp.where(fit_future, score, -jnp.inf)
+        best = jnp.argmax(masked)
+
+        do_place = attempt & has_node
+        do_alloc = do_place & fit_idle[best]
+        do_pipe = do_place & ~fit_idle[best]
+        anchor = jnp.where(do_place & (anchor == 0), zone_code[best], anchor)
+
+        onehot = (jnp.arange(tent.idle.shape[0]) == best)[:, None]  # [N,1]
+        delta = onehot * req[None, :]
+        new_idle = tent.idle - jnp.where(do_alloc, delta, 0.0)
+        new_used = tent.used + jnp.where(do_alloc, delta, 0.0)
+        new_fidle = tent.future_idle - jnp.where(do_place, delta, 0.0)
+        new_ntasks = tent.ntasks + jnp.where(
+            do_place, onehot[:, 0].astype(jnp.int32), 0)
+        tent = NodeState(new_idle, new_fidle, new_used, new_ntasks)
+
+        cnt_alloc = cnt_alloc + do_alloc.astype(jnp.int32)
+        cnt_pipe = cnt_pipe + do_pipe.astype(jnp.int32)
+
+        min_avail = jobs.min_available[job_ix]
+        ready = jobs.base_ready[job_ix] + cnt_alloc >= min_avail
+        pipelined_ok = (jobs.base_ready[job_ix] + jobs.base_pipelined[job_ix]
+                        + cnt_alloc + cnt_pipe >= min_avail)
+        keep = ready | pipelined_ok
+        commit_now = last_of_job & valid
+        tent = _select(commit_now & ~keep, saved, tent)
+
+        out = (jnp.where(do_place, best, NO_NODE).astype(jnp.int32),
+               do_pipe,
+               commit_now & ready,
+               commit_now & keep)
+        return _CarryTopo(tent, saved, cnt_alloc, cnt_pipe, broken,
+                          anchor), out
+
+    init = _CarryTopo(tent=nodes, saved=nodes,
+                      cnt_alloc=jnp.int32(0), cnt_pipe=jnp.int32(0),
+                      broken=jnp.bool_(False), anchor=jnp.int32(0))
+    xs = (tasks.req, tasks.job_ix, tasks.valid, tasks.feas, tasks.static_score,
+          tasks.first_of_job, tasks.last_of_job)
+    carry, (task_node, task_pipe, job_ready_t, job_kept_t) = jax.lax.scan(
+        step, init, xs, unroll=unroll)
+
+    job_ready = jnp.zeros(J, dtype=bool).at[tasks.job_ix].max(job_ready_t)
+    job_kept = jnp.zeros(J, dtype=bool).at[tasks.job_ix].max(job_kept_t)
+
+    kept_task = job_kept[tasks.job_ix]
+    task_node = jnp.where(kept_task, task_node, NO_NODE)
+    return PlacementResult(task_node=task_node, task_pipelined=task_pipe,
+                           job_ready=job_ready, job_kept=job_kept,
+                           nodes=carry.tent)
+
+
+def place_scan_topo_packed(nodes: NodeState, tasks: PlacementTasks,
+                           jobs: JobMeta, weights: ScoreWeights,
+                           allocatable: jnp.ndarray, max_tasks: jnp.ndarray,
+                           zone_code: jnp.ndarray, topo_weight: jnp.ndarray,
+                           unroll: int = 8):
+    """place_scan_topo with the place_scan_packed single-fetch layout."""
+    res = place_scan_topo(nodes, tasks, jobs, weights, allocatable,
+                          max_tasks, zone_code, topo_weight, unroll=unroll)
+    packed = jnp.concatenate([
+        res.task_node,
+        res.task_pipelined.astype(jnp.int32),
+        res.job_ready.astype(jnp.int32),
+        res.job_kept.astype(jnp.int32)])
+    return packed, res.nodes
+
+
 def unpack_placement(packed: "np.ndarray", T_padded: int, J: int):
     """Split the packed vector back into (task_node, task_pipelined,
     job_ready, job_kept) numpy views."""

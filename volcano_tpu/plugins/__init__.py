@@ -5,11 +5,13 @@ Importing this package registers all in-tree plugins.
 
 from ..framework.registry import register_plugin_builder
 from .base import Plugin
-from . import binpack, conformance, drf, gang, nodeorder, numaaware, overcommit
+from . import binpack, conformance, drf, elastic_gang, gang, nodeorder
+from . import numaaware, overcommit
 from . import predicates, priority, proportion, reservation, sla
 from . import task_topology, tdm
 
 register_plugin_builder("gang", gang.New)
+register_plugin_builder("elastic-gang", elastic_gang.New)
 register_plugin_builder("priority", priority.New)
 register_plugin_builder("conformance", conformance.New)
 register_plugin_builder("drf", drf.New)

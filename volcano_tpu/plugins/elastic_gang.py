@@ -1,0 +1,121 @@
+"""elastic-gang plugin: session policy for min/desired gangs.
+
+Installs the four host-side hooks that make elastic membership a policy
+every engine respects (the tensor-path victim tier lives in
+actions/evict_tpu.py; the lifecycle funnel in elastic_gang/commands.py):
+
+- ``ssn.elastic_pending_filter`` — narrows the pending set the allocate
+  engines (and preempt's pending collection) see, so elastic gangs bid
+  for exactly ``min`` at admission and never preempt on behalf of
+  surplus members (allocate._pending_tasks reads the attribute);
+- job_valid — a suspended gang is not schedulable this cycle;
+- preemptable/reclaimable — above-min members of elastic gangs are
+  offered as victims ONLY up to the per-job shrink allowance (highest
+  uid first), so no host preempt/reclaim decision can drag a gang below
+  min without a full-gang decision;
+- node_order — a compactness bonus for nodes in a zone where the task's
+  gang already holds members: the host mirror of the batched solver's
+  anchor term (ops/place.py place_scan_topo), and what steers the
+  grow-shrink placer into the gang's anchor zone.
+
+Arguments: ``topology-weight`` (float, default 10.0) scales the
+node_order bonus; 0 disables it.
+"""
+
+from __future__ import annotations
+
+from ..api import TaskStatus
+from ..elastic_gang.membership import (allocate_pending_filter, is_elastic,
+                                       is_suspended, shrink_allowance)
+from ..framework.session import PERMIT, ValidateResult
+from .base import Plugin
+
+SUSPENDED = "Suspended"
+
+
+def _member_zones(ssn, job) -> set:
+    """Zones where the gang currently holds capacity — its anchor set."""
+    zones = set()
+    for status in (TaskStatus.BOUND, TaskStatus.RUNNING,
+                   TaskStatus.BINDING, TaskStatus.ALLOCATED):
+        for t in job.task_status_index.get(status, {}).values():
+            node = ssn.nodes.get(t.node_name)
+            if node is not None and node.topology_zone:
+                zones.add(node.topology_zone)
+    return zones
+
+
+class ElasticGangPlugin(Plugin):
+    NAME = "elastic-gang"
+
+    def on_session_open(self, ssn) -> None:
+        args = self.arguments or {}
+        try:
+            topo_weight = float(args.get("topology-weight", 10.0))
+        except (TypeError, ValueError):
+            topo_weight = 10.0
+
+        # the allocate-engine hook: THE decision-class switch. Absent
+        # (plugin disabled) every engine is byte-identical to pre-elastic.
+        ssn.elastic_pending_filter = allocate_pending_filter
+
+        def job_valid(job):
+            if is_elastic(job) and is_suspended(job):
+                return ValidateResult(
+                    False, SUSPENDED,
+                    "gang is suspended by lifecycle command")
+            return None
+
+        ssn.add_job_valid_fn(self.NAME, job_valid)
+
+        def preemptable(preemptor, preemptees):
+            """Cap elastic victims at each gang's shrink allowance so no
+            preempt/reclaim decision evicts below min. Victims per gang
+            are its highest-uid members — the same order grow-shrink
+            sheds them — keeping host and device paths convergent."""
+            by_job = {}
+            for t in preemptees:
+                by_job.setdefault(t.job, []).append(t)
+            victims = []
+            for uid, tasks in by_job.items():
+                job = ssn.jobs.get(uid)
+                if job is None or not is_elastic(job):
+                    victims.extend(tasks)
+                    continue
+                if is_suspended(job):
+                    # a suspended gang is already draining through the
+                    # full-gang funnel; don't double-claim its members
+                    continue
+                allow = shrink_allowance(job)
+                if allow <= 0:
+                    continue
+                tasks = sorted(tasks, key=lambda t: t.uid, reverse=True)
+                victims.extend(tasks[:allow])
+            return victims, PERMIT
+
+        ssn.add_preemptable_fn(self.NAME, preemptable)
+        ssn.add_reclaimable_fn(self.NAME, preemptable)
+
+        if topo_weight > 0.0:
+            # binpack-style scaling: the bonus rides the MAX_NODE_SCORE
+            # scale (nodeorder's terms each span ~0-100), so the default
+            # weight 10 yields a 1000-point anchor pull that dominates
+            # spread/packing preferences without silencing predicates
+            bonus = topo_weight * 100.0
+
+            def node_order(task, node):
+                if not node.topology_zone:
+                    return 0.0
+                job = ssn.jobs.get(task.job)
+                if job is None:
+                    return 0.0
+                zones = _member_zones(ssn, job)
+                if not zones:
+                    return 0.0
+                return bonus if node.topology_zone in zones else 0.0
+
+            ssn.add_node_order_fn(self.NAME, node_order)
+
+
+def New(arguments):
+    return ElasticGangPlugin(arguments)

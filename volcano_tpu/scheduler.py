@@ -219,6 +219,12 @@ class Scheduler:
         # on_cycle_end in the epilogue — and only while this replica
         # leads its partition (the hooks sit behind the HA gate).
         self.federation = None
+        # elastic-gang lifecycle verbs (docs/design/elastic-gangs.md): a
+        # CommandFunnel when command-driven suspend/resume/scale is
+        # enabled. Drained exactly once per cycle, at the boundary AFTER
+        # the federation hooks and BEFORE the snapshot, so a verb's
+        # annotation rewrite is atomic w.r.t. scheduling decisions.
+        self.command_funnel = None
         # pipelined scheduling (docs/performance.md): overlap cycle N+1's
         # device solve with cycle N's host commit via a speculative
         # session + conflict check at the commit boundary. Standalone
@@ -458,6 +464,19 @@ class Scheduler:
                 log.exception("federation cycle-start hook failed")
                 metrics.register_action_failure("federation")
                 errors.append(("federation", exc))
+        # elastic-gang command funnel (docs/design/elastic-gangs.md):
+        # apply queued suspend/resume/scale verbs against pre-snapshot
+        # state — each apply journals a fenced command_applied record and
+        # dirties the job, so this cycle's snapshot sees whole commands
+        # or none. Isolated like an action.
+        if self.command_funnel is not None:
+            try:
+                with rec.span("commands"):
+                    self.command_funnel.consume()
+            except Exception as exc:
+                log.exception("command funnel consume failed")
+                metrics.register_action_failure("commands")
+                errors.append(("commands", exc))
         # A cycle whose pipeline resolves to NO runnable action is a no-op:
         # don't pay cache.snapshot() (re-cloning queues/jobs at 10k scale)
         # plus a full open/close just to run zero actions — the state a

@@ -227,6 +227,31 @@ def main(argv=None) -> int:
                     help="enable the event-driven fast-admit path: "
                          "trivially-fitting gangs bind between full "
                          "cycles through the journaled funnel")
+    ap.add_argument("--elastic-gangs", action="store_true",
+                    help="enable elastic GANG membership (distinct from "
+                         "--elastic partition membership): gangs with a "
+                         "desired count admit at min, the grow-shrink "
+                         "stage expands them toward desired as capacity "
+                         "frees and shrinks them first under pressure, "
+                         "and suspend/resume/scale verbs ride the "
+                         "journaled Command funnel "
+                         "(docs/design/elastic-gangs.md). Direct "
+                         "single-scheduler topology only")
+    ap.add_argument("--topology-weight", type=float, default=10.0,
+                    metavar="W",
+                    help="zone-compactness weight for --elastic-gangs "
+                         "(the allocate anchor term + the plugin's "
+                         "node_order bonus); 0 = topology-unaware "
+                         "baseline (default 10.0)")
+    ap.add_argument("--verify-elastic-gang-equivalence",
+                    action="store_true",
+                    help="assert the elastic-gang contract: gangs "
+                         "flexed (grows AND shrinks fired), zero "
+                         "below-min evictions outside full-gang "
+                         "decisions, zero rejected commands, every "
+                         "arrived gang completed with zero "
+                         "double-binds, byte-deterministic x2 "
+                         "(exit 1 otherwise)")
     ap.add_argument("--verify-pipelined-equivalence", action="store_true",
                     help="also run the SERIAL single-scheduler oracle "
                          "and assert equivalence: byte-identical "
@@ -323,6 +348,14 @@ def main(argv=None) -> int:
         ap.error("--elastic requires --federated N (N may be 1)")
     if args.verify_elastic_equivalence and not args.elastic:
         ap.error("--verify-elastic-equivalence requires --elastic")
+    if args.elastic_gangs and (args.federated or args.ha > 1 or store_wired
+                               or args.pipelined or args.fast_admit):
+        ap.error("--elastic-gangs is a direct single-scheduler mode "
+                 "(not --federated / --ha / --store-wired / --pipelined "
+                 "/ --fast-admit)")
+    if args.verify_elastic_gang_equivalence and not args.elastic_gangs:
+        ap.error("--verify-elastic-gang-equivalence requires "
+                 "--elastic-gangs")
     if args.verify_ack_equivalence and not ack_fault_rate:
         # without faults the report has no feedback section and every
         # stuck-state assertion would pass vacuously
@@ -378,7 +411,9 @@ def main(argv=None) -> int:
                            ack_fault_seed=args.ack_fault_seed,
                            lease_fault_rate=lease_fault_rate
                            if lease_rate is None else lease_rate,
-                           lease_fault_seed=args.lease_fault_seed)
+                           lease_fault_seed=args.lease_fault_seed,
+                           elastic_gangs=args.elastic_gangs,
+                           topology_weight=args.topology_weight)
         return runner.run()
 
     if args.trace_out:
@@ -593,6 +628,57 @@ def main(argv=None) -> int:
               f"final={el.get('partitions_final')}, "
               f"max_queue_depth={el.get('max_queue_depth')}, "
               f"abstentions={el.get('abstentions')}, "
+              f"restarts={report.get('restarts', 0)}, "
+              f"accounting={terminal_accounting(report)}",
+              file=sys.stderr)
+    if args.verify_elastic_gang_equivalence:
+        eg = report.get("elastic_gangs") or {}
+        cmds = eg.get("commands") or {}
+        problems = []
+        if not eg.get("enabled"):
+            problems.append("no elastic_gangs section in the report — "
+                            "the mode never engaged")
+        if not eg.get("grows"):
+            problems.append("no elastic grow fired: gangs never "
+                            "expanded beyond min (tune the scenario's "
+                            "filler drain)")
+        if not eg.get("shrinks"):
+            problems.append("no elastic shrink fired: gangs never gave "
+                            "capacity back (tune the pressure wave or "
+                            "the lifecycle commands)")
+        if eg.get("below_min_evictions"):
+            problems.append(
+                f"{eg['below_min_evictions']} eviction(s) took a gang "
+                f"below min outside a full-gang decision")
+        if cmds.get("rejected"):
+            problems.append(f"{cmds['rejected']} lifecycle command(s) "
+                            f"rejected by the funnel")
+        if cmds.get("submitted", 0) != cmds.get("applied", 0) \
+                + cmds.get("dropped", 0):
+            problems.append(f"command ledger does not balance: {cmds}")
+        if report["jobs"]["completed"] != report["jobs"]["arrived"] \
+                or report["jobs"]["unfinished"]:
+            problems.append("not every arrived gang completed: "
+                            f"{report['jobs']}")
+        if report.get("double_binds"):
+            problems.append(f"double-binds under elastic churn: "
+                            f"{report['double_binds']}")
+        # byte-determinism x2: grow/shrink ordering, funnel consumption,
+        # and the topology term are all seeded + virtual-clock driven,
+        # so an identical re-run must reproduce the report byte-for-byte
+        rerun = run(kill_cycles)
+        if deterministic_json(report) != deterministic_json(rerun):
+            problems.append("elastic-gang run not byte-deterministic x2")
+        if problems:
+            for p in problems:
+                print(f"elastic-gang-equivalence FAILED: {p}",
+                      file=sys.stderr)
+            return 1
+        print(f"elastic-gang-equivalence OK: grows={eg.get('grows')}, "
+              f"shrinks={eg.get('shrinks')}, "
+              f"continues={eg.get('elastic_continues')}, "
+              f"colocation_rate={eg.get('colocation_rate')}, "
+              f"commands={cmds}, "
               f"restarts={report.get('restarts', 0)}, "
               f"accounting={terminal_accounting(report)}",
               file=sys.stderr)

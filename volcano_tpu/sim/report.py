@@ -239,6 +239,15 @@ def build_report(runner, actions_ms: Dict[tuple, list],
         report["speculation"] = runner.speculation_stats()
     if getattr(runner, "fast_admit_mode", False):
         report["fast_admit"] = runner.fast_admit_stats()
+    if getattr(runner, "elastic_gangs", False) \
+            or getattr(runner, "_command_funnel", None) is not None:
+        # elastic GANGS (docs/design/elastic-gangs.md — distinct from
+        # federation's elastic partition membership): grow/shrink deltas,
+        # the never-below-min witness, the elastic-continue accounting,
+        # completion-time co-location, and the Command funnel ledger.
+        # Only emitted when the mode (or a job_command trace) is live, so
+        # every pre-elastic scenario stays byte-identical.
+        report["elastic_gangs"] = runner.elastic_gang_stats()
     if getattr(runner, "federated", 0):
         totals = runner.federation_totals() \
             if hasattr(runner, "federation_totals") else {

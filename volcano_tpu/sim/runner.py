@@ -59,6 +59,8 @@ from ..cache.executors import (FencedBinder, FencedEvictor,
                                FencingAuthority, SequenceBinder,
                                SequenceEvictor)
 from ..cache.journal import IntentJournal, JournalFollower
+from ..elastic_gang.membership import (ELASTIC_DESIRED_ANNOTATION,
+                                       TOPOLOGY_ZONE_LABEL, is_elastic)
 from ..chaos import (AckFaultInjector, KillPointBinder, KillPointEvictor,
                      SimKill)
 from ..scheduler import ROLE_LEADER, Scheduler
@@ -97,6 +99,36 @@ tiers:
   - name: predicates
   - name: proportion
   - name: nodeorder
+"""
+
+
+def elastic_sim_conf(topology_weight: float = 10.0) -> str:
+    """Conf for ``--elastic-gangs`` runs: the default action chain with
+    the grow-shrink stage between allocate and preempt (elastic gangs
+    admit at min, then expand toward desired as capacity frees), the
+    elastic-gang policy plugin in tier 1, and the topology compactness
+    weight threaded to both the plugin's node_order bonus and the
+    allocate engine's batched anchor term. Weight 0 = topology-unaware
+    baseline (the co-location comparison run)."""
+    w = float(topology_weight)
+    return f"""
+actions: "enqueue, allocate, grow-shrink, preempt, reclaim, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: elastic-gang
+    arguments:
+      topology-weight: {w}
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+configurations:
+- name: allocate
+  arguments:
+    topology-weight: {w}
 """
 
 
@@ -239,7 +271,9 @@ class SimRunner:
                  overload_burst_rate: float = 0.0,
                  overload_seed: Optional[int] = None,
                  rebalance: bool = False,
-                 elastic: bool = False):
+                 elastic: bool = False,
+                 elastic_gangs: bool = False,
+                 topology_weight: float = 10.0):
         self.trace = list(trace)
         self.period = period
         self.seed = seed
@@ -384,6 +418,23 @@ class SimRunner:
         self.elastic = bool(elastic)
         if self.elastic and not self.federated:
             raise ValueError("elastic requires federated_partitions")
+        # elastic GANGS (docs/design/elastic-gangs.md) — distinct from
+        # elastic partition membership above: gang SIZE becomes the
+        # decision variable (admit at min, grow toward desired, shrink
+        # elastic members first), with lifecycle verbs riding the
+        # journaled Command funnel consumed at cycle boundary. Single
+        # direct-scheduler topology only: the funnel mutates the one
+        # cache that is cluster truth here.
+        self.elastic_gangs = bool(elastic_gangs)
+        self.topology_weight = float(topology_weight)
+        self._wants_commands = any(ev.kind == "job_command"
+                                   for ev in self.trace)
+        if self.elastic_gangs or self._wants_commands:
+            if (self.federated or self.ha_replicas > 1 or self.store_wired
+                    or self.pipelined_mode or self.fast_admit_mode):
+                raise ValueError(
+                    "elastic_gangs / job_command events require the "
+                    "direct single-scheduler topology")
         self.overload = bool(self.cycle_budget_s or self.admission_depth
                              or self.overload_burst_rate
                              or self.rebalance)
@@ -461,6 +512,8 @@ class SimRunner:
         DEVICE_HEALTH.reset(time_fn=self.clock.time)
         if conf_text is not None:
             self.conf_text = conf_text
+        elif self.elastic_gangs:
+            self.conf_text = elastic_sim_conf(self.topology_weight)
         elif self.pipelined_mode or self.fast_admit_mode:
             self.conf_text = PIPELINED_SIM_CONF
         else:
@@ -507,6 +560,23 @@ class SimRunner:
             self.caches = [self.cache]
             self._spec_mark = dict(metrics.speculation_counts())
             self._fa_mark = dict(metrics.fast_admit_counts())
+
+        # elastic-gang bookkeeping: the Command funnel (journaled+fenced
+        # mutation path for suspend/resume/scale — survives crash
+        # restarts because it holds the CACHE, which is cluster truth
+        # here; _crash_restart re-attaches it to the fresh shell), the
+        # metric mark for per-run deltas, and the completion-time
+        # co-location counters the topology acceptance gate reads
+        self._command_funnel = None
+        self._commands_submitted = 0
+        self._elastic_continues = 0
+        self.colocated_gangs = 0
+        self.spread_gangs = 0
+        self._eg_mark = dict(metrics.elastic_counts())
+        if self.elastic_gangs or self._wants_commands:
+            from ..elastic_gang import CommandFunnel
+            self._command_funnel = CommandFunnel(self.cache)
+            self.sched.command_funnel = self._command_funnel
 
         # decision-plane bookkeeping
         self.arrival_time: Dict[str, float] = {}
@@ -789,6 +859,15 @@ class SimRunner:
             if self._job(jid) is not None:
                 self._complete_job(jid, ev.t)
             return
+        if ev.kind == "job_command":
+            # lifecycle verbs never mutate the cache here: they ride the
+            # journaled Command funnel and apply at the NEXT cycle
+            # boundary, exactly like a kubectl-annotated CR would land
+            # through the watch between cycles
+            self._command_funnel.submit(d["verb"], self._jid(d["name"]),
+                                        d.get("value"))
+            self._commands_submitted += 1
+            return
         if self.store_wired and ev.kind == "queue_add":
             # store mode: the queue is a CR; caches learn it through
             # their watches. Submission rides the faulted transport and
@@ -811,7 +890,10 @@ class SimRunner:
                     if d["gpus"] else None
                 alloc = Resource(d["cpu_milli"], d["mem"], scalars)
                 alloc.max_task_num = d["pods"]
-                cache.add_node(NodeInfo(name=d["name"], allocatable=alloc))
+                labels = {TOPOLOGY_ZONE_LABEL: d["zone"]} \
+                    if d.get("zone") else None
+                cache.add_node(NodeInfo(name=d["name"], allocatable=alloc,
+                                        labels=labels))
             elif ev.kind == "node_drain":
                 node = cache.nodes.get(d["name"])
                 if node is not None:
@@ -887,9 +969,12 @@ class SimRunner:
         for cache in caches:
             scalars = {"nvidia.com/gpu": float(d["gpus"])} if d["gpus"] \
                 else None
+            ann = {ELASTIC_DESIRED_ANNOTATION: str(int(d["desired"]))} \
+                if d.get("desired") is not None else None
             pg = PodGroup(name=name, queue=d["queue"],
                           min_member=d["min_available"],
-                          phase=PodGroupPhase.PENDING)
+                          phase=PodGroupPhase.PENDING,
+                          annotations=ann)
             job = JobInfo(uid=name, name=name, queue=d["queue"],
                           priority=d["priority"],
                           min_available=d["min_available"], podgroup=pg,
@@ -984,10 +1069,21 @@ class SimRunner:
         self._note_requeue(uid)
         self.requeues += 1
         if jid in self.admitted_at:
-            # the gang dropped below min_available: cancel its pending
-            # completion (epoch bump makes it stale) and let it re-admit
-            del self.admitted_at[jid]
-            self._admit_epoch[jid] = self._admit_epoch.get(jid, 0) + 1
+            vjob = self._job(jid)
+            if (vjob is not None and is_elastic(vjob)
+                    and vjob.ready_task_num() >= max(vjob.min_available, 1)):
+                # elastic-continue: the member lost was surplus (a scale/
+                # pressure shrink, a preempt victim, or churn above min)
+                # and the gang still holds >= min — collective progress
+                # survives, the completion timer keeps running. Dropping
+                # below min (or any rigid-gang loss) stays a restart.
+                self._elastic_continues += 1
+            else:
+                # the gang dropped below min_available: cancel its pending
+                # completion (epoch bump makes it stale) and let it
+                # re-admit
+                del self.admitted_at[jid]
+                self._admit_epoch[jid] = self._admit_epoch.get(jid, 0) + 1
 
     def _fire_completions_until(self, now: float) -> None:
         while self._completions and self._completions[0][0] <= now + 1e-9:
@@ -1018,6 +1114,7 @@ class SimRunner:
         vjob = self._job(uid)
         if vjob is None:
             return
+        self._note_colocation(vjob)
         uids = list(vjob.tasks)
         for cache in self.caches:
             job = cache.jobs.get(uid)
@@ -1033,6 +1130,27 @@ class SimRunner:
         self._credit_admission(uid)
         self.jct.append(t - self.arrival_time[uid])
         self.completed += 1
+
+    def _note_colocation(self, vjob) -> None:
+        """Completion-time topology witness: did this gang finish with
+        all its placed members in ONE zone? Counted only for multi-member
+        gangs on fully-zoned placements — the acceptance comparison
+        (topology-weight W vs 0) reads colocated/(colocated+spread)."""
+        if not self.elastic_gangs:
+            return
+        zones = []
+        view = self._view()
+        for task in vjob.tasks.values():
+            if not task.node_name:
+                continue
+            node = view.nodes.get(task.node_name)
+            zones.append(node.topology_zone if node is not None else "")
+        if len(zones) < 2 or not all(zones):
+            return
+        if len(set(zones)) == 1:
+            self.colocated_gangs += 1
+        else:
+            self.spread_gangs += 1
 
     # -- post-cycle feedback ------------------------------------------------
 
@@ -1158,6 +1276,10 @@ class SimRunner:
                 # flight must meet the normalizer, not die with the run
                 and not self._ack_wire.pending()
                 and not any(c.feedback.pending() for c in self.caches)
+                # a submitted lifecycle verb must meet its cycle boundary
+                # (and be applied or journaled dropped), not die queued
+                and (self._command_funnel is None
+                     or not self._command_funnel.pending_count())
                 # elastic runs end on the SHRUNK membership: spawned
                 # partitions idle out and merge back before the run
                 # reports terminal accounting (the 1→N→1 witness);
@@ -1689,7 +1811,10 @@ class SimRunner:
             alloc = Resource(spec["cpu_milli"], spec["mem"],
                              scalars)
             alloc.max_task_num = spec["pods"]
-            node = NodeInfo(name=spec["name"], allocatable=alloc)
+            labels = {TOPOLOGY_ZONE_LABEL: spec["zone"]} \
+                if spec.get("zone") else None
+            node = NodeInfo(name=spec["name"], allocatable=alloc,
+                            labels=labels)
             if spec["name"] in self._unready_nodes:
                 node.ready = False
             cache.add_node(node)
@@ -2235,6 +2360,11 @@ class SimRunner:
                                pipelined=self.pipelined_mode,
                                fast_admit=self.fast_admit_mode,
                                **self._overload_kwargs())
+        if self._command_funnel is not None:
+            # the funnel outlives the shell (it holds the cache + journal
+            # — cluster truth): pending verbs submitted before the crash
+            # apply at the fresh incarnation's first cycle boundary
+            self.sched.command_funnel = self._command_funnel
         # a process death also resets the device cool-down state machine
         # (it lives in process memory) — and its clock stays virtual
         from ..device_health import DEVICE_HEALTH
@@ -2277,6 +2407,33 @@ class SimRunner:
         return {"hits": hits, "partial": partial, "conflicts": conflicts,
                 "hit_rate": round((hits + partial) / total, 4)
                 if total else 0.0}
+
+    def elastic_gang_stats(self) -> Dict[str, object]:
+        """The report's deterministic elastic-gangs section: per-run
+        grow/shrink deltas (process-global counters marked at
+        construction), the never-below-min witness (expected 0), the
+        elastic-continue vs duration-restart split, completion-time
+        co-location counters, and the Command funnel's ledger."""
+        now = metrics.elastic_counts()
+        d = {k: int(now.get(k, 0) - self._eg_mark.get(k, 0))
+             for k in set(now) | set(self._eg_mark)}
+        shrinks = {k.split("/", 1)[1]: v for k, v in d.items()
+                   if k.startswith("shrink/") and v}
+        placed = self.colocated_gangs + self.spread_gangs
+        return {
+            "enabled": self.elastic_gangs,
+            "topology_weight": self.topology_weight,
+            "grows": d.get("grows", 0),
+            "shrinks": dict(sorted(shrinks.items())),
+            "below_min_evictions": d.get("below_min", 0),
+            "elastic_continues": self._elastic_continues,
+            "colocated_gangs": self.colocated_gangs,
+            "spread_gangs": self.spread_gangs,
+            "colocation_rate": round(self.colocated_gangs / placed, 4)
+            if placed else 0.0,
+            "commands": self._command_funnel.stats()
+            if self._command_funnel is not None else {},
+        }
 
     def fast_admit_stats(self) -> Dict[str, int]:
         now = metrics.fast_admit_counts()

@@ -18,6 +18,15 @@ from simulation start). Kinds and their payloads:
   (``min_available`` members placed), then completes.
 - ``job_complete`` {name} — explicit completion (recorded traces); jobs
   without one complete ``duration`` seconds after admission.
+- ``job_command`` {name, verb[, value]} — an elastic-gang lifecycle verb
+  (``suspend`` / ``resume`` / ``scale``; ``scale`` carries the new
+  desired member count in ``value``) submitted through the journaled
+  Command funnel and consumed at the next cycle boundary.
+
+Two payload keys are optional: ``job_arrival`` may carry ``desired``
+(elastic gang: grow toward this member count; default = rigid gang) and
+``node_add`` may carry ``zone`` (the node's ``volcano.sh/topology-zone``
+label; default = unzoned).
 
 The schema is flat and uniform-per-gang on purpose: it round-trips
 losslessly through JSONL (`load_trace(write_trace(t)) == t`), and the
@@ -32,7 +41,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, List
 
 KINDS = ("queue_add", "node_add", "node_drain", "node_restore", "node_fail",
-         "job_arrival", "job_complete")
+         "job_arrival", "job_complete", "job_command")
 
 # required payload keys per kind (beyond t/kind); extra keys are rejected
 # so schema drift fails at load time, not as a silently ignored field
@@ -45,6 +54,15 @@ _REQUIRED: Dict[str, tuple] = {
     "job_arrival": ("name", "queue", "priority", "tasks", "min_available",
                     "cpu_milli", "mem", "gpus", "duration"),
     "job_complete": ("name",),
+    "job_command": ("name", "verb"),
+}
+
+# optional payload keys per kind — absent in every pre-elastic trace, so
+# old traces round-trip byte-identically
+_OPTIONAL: Dict[str, tuple] = {
+    "node_add": ("zone",),
+    "job_arrival": ("desired",),
+    "job_command": ("value",),
 }
 
 
@@ -73,10 +91,11 @@ class TraceEvent:
                              f"(known: {KINDS})")
         want = set(_REQUIRED[self.kind])
         got = set(self.data)
-        if got != want:
+        extra = got - want - set(_OPTIONAL.get(self.kind, ()))
+        if (want - got) or extra:
             raise ValueError(
                 f"{self.kind} event payload mismatch at t={self.t}: "
-                f"missing {sorted(want - got)}, unexpected {sorted(got - want)}")
+                f"missing {sorted(want - got)}, unexpected {sorted(extra)}")
         if self.t < 0:
             raise ValueError(f"negative event time {self.t}")
 
@@ -119,6 +138,16 @@ def validate_trace(events: Iterable[TraceEvent]) -> List[TraceEvent]:
         elif ev.kind == "job_complete":
             if name not in jobs:
                 raise ValueError(f"job_complete for unknown job {name!r}")
+        elif ev.kind == "job_command":
+            if name not in jobs:
+                raise ValueError(f"job_command for unknown job {name!r}")
+            verb = ev.data["verb"]
+            if verb not in ("suspend", "resume", "scale"):
+                raise ValueError(f"job_command {name!r}: unknown verb "
+                                 f"{verb!r}")
+            if verb == "scale" and "value" not in ev.data:
+                raise ValueError(f"job_command {name!r}: scale needs a "
+                                 f"value")
         out.append(ev)
     return out
 

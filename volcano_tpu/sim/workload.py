@@ -219,6 +219,74 @@ def _flash_crowd(seed: int, at: float, n: int,
     return tuple(out)
 
 
+def _elastic_churn_trace(seed: int) -> List[TraceEvent]:
+    """The elastic-gangs acceptance world (docs/design/elastic-gangs.md):
+    zoned nodes, min/desired gangs that must flex min -> desired -> min,
+    lifecycle commands through the funnel, and node churn.
+
+    Shape: 12 nodes in 3 zones. Eight elastic gangs (6 tasks, min 2,
+    desired 6) arrive into a cluster pre-loaded with a rigid filler wave,
+    so they admit at min; the filler drains and the grow stage expands
+    them toward desired; a second, larger filler wave lands at t=20 and
+    starves, driving pressure shrinks back toward min. Two gangs ride
+    the Command funnel (a suspend/resume pair and a scale-down/scale-up
+    pair) and the cluster churns underneath (two drains + restores, one
+    node death). Run under `--elastic-gangs`; the acceptance gate
+    asserts every gang completes at >= min, zero double-binds, zero
+    below-min evictions outside full-gang decisions, and byte-identical
+    reports across repeated runs."""
+    rng = random.Random(seed ^ 0xE1A5)
+    events: List[TraceEvent] = [
+        TraceEvent(0.0, "queue_add", {"name": "q1", "weight": 2}),
+        TraceEvent(0.0, "queue_add", {"name": "q2", "weight": 1}),
+    ]
+    for i in range(12):
+        events.append(TraceEvent(0.0, "node_add", {
+            "name": f"node-{i:05d}", "cpu_milli": 8000, "mem": 64 * GI,
+            "pods": 40, "gpus": 0, "zone": f"z{i // 4}"}))
+    rest: List[TraceEvent] = []
+    # rigid filler wave 1: saturates enough capacity that the elastic
+    # gangs arriving behind it admit at MIN, not desired
+    for i in range(10):
+        rest.append(TraceEvent(0.5, "job_arrival", {
+            "name": f"rf-{i:04d}", "queue": "q2", "priority": 0,
+            "tasks": 2, "min_available": 2, "cpu_milli": 2000, "mem": GI,
+            "gpus": 0, "duration": _round(rng.uniform(6.0, 10.0))}))
+    # the elastic gangs: 6 tasks, min 2, desired 6
+    for i in range(8):
+        rest.append(TraceEvent(_round(1.0 + 1.5 * i), "job_arrival", {
+            "name": f"eg-{i:04d}", "queue": "q1" if i % 2 == 0 else "q2",
+            "priority": 0, "tasks": 6, "min_available": 2, "desired": 6,
+            "cpu_milli": 1000, "mem": GI, "gpus": 0,
+            "duration": _round(rng.uniform(18.0, 30.0))}))
+    # rigid filler wave 2: bigger than the free capacity left once the
+    # elastic gangs have grown — the starvation that triggers pressure
+    # shrinks back toward min
+    for i in range(14):
+        rest.append(TraceEvent(_round(20.0 + 0.01 * i), "job_arrival", {
+            "name": f"rg-{i:04d}", "queue": "q2", "priority": 0,
+            "tasks": 2, "min_available": 2, "cpu_milli": 2000, "mem": GI,
+            "gpus": 0, "duration": _round(rng.uniform(5.0, 8.0))}))
+    # lifecycle verbs through the Command funnel: a suspend/resume pair
+    # (the full-gang drain, where below-min is legal) and a scale
+    # round-trip (desired 6 -> 2 -> 6)
+    rest += [
+        TraceEvent(12.0, "job_command",
+                   {"name": "eg-0000", "verb": "suspend"}),
+        TraceEvent(14.0, "job_command",
+                   {"name": "eg-0001", "verb": "scale", "value": 2}),
+        TraceEvent(24.0, "job_command",
+                   {"name": "eg-0000", "verb": "resume"}),
+        TraceEvent(26.0, "job_command",
+                   {"name": "eg-0001", "verb": "scale", "value": 6}),
+    ]
+    # churn: two drains that restore, one node death mid-run
+    rest += list(_flap_events((10, 11), drain_at=10.0, restore_at=22.0,
+                              fail=(9,), fail_at=16.0))
+    rest.sort(key=lambda ev: (ev.t, ev.kind, ev.data.get("name", "")))
+    return validate_trace(events + rest)
+
+
 # The named scenario catalog (docs/simulation.md records each scenario's
 # expected report ranges). Each entry is a factory(seed) -> trace plus a
 # one-line description; `python -m volcano_tpu.sim --scenario NAME` runs
@@ -442,6 +510,16 @@ SCENARIOS: Dict[str, dict] = {
             queues=(("q1", 2), ("q2", 1)), cpu_choices=(1000, 2000),
             mem_choices=(GI,), priority_choices=(0,),
             node_cpu_milli=6000, node_mem=64 * GI, node_pods=40),
+    ),
+    "elastic-churn": dict(
+        description="8 min-2/desired-6 elastic gangs on 12 zoned nodes "
+                    "between two rigid filler waves, with suspend/resume "
+                    "+ scale commands and node churn — the elastic-gangs "
+                    "acceptance world for `sim --elastic-gangs`: gangs "
+                    "flex min -> desired -> min, every gang completes at "
+                    ">= min, zero double-binds, zero below-min evictions "
+                    "outside full-gang decisions, byte-deterministic",
+        factory=_elastic_churn_trace,
     ),
     "baseline-tiny": dict(
         description="BASELINE config 1 (1 gang of 3, 10 nodes) as the "

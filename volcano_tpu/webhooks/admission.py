@@ -282,6 +282,33 @@ def mutate_podgroup(operation: str, pg: PodGroupCR, old) -> PodGroupCR:
     return pg
 
 
+def validate_podgroup(operation: str, pg: PodGroupCR, old) -> None:
+    """Reject malformed elastic-gang specs at the door
+    (docs/design/elastic-gangs.md): a desired below min would make the
+    min/desired decision class degenerate (the scheduler clamps, but the
+    clamp is a crash-consistency net, not an API), and the suspend mark
+    only takes "true"/"false" so the Command funnel's rewrites stay
+    round-trippable."""
+    from ..elastic_gang.membership import (ELASTIC_DESIRED_ANNOTATION,
+                                           SUSPEND_ANNOTATION)
+    ann = pg.metadata.annotations or {}
+    if ELASTIC_DESIRED_ANNOTATION in ann:
+        raw = ann[ELASTIC_DESIRED_ANNOTATION]
+        try:
+            desired = int(str(raw).strip())
+        except (TypeError, ValueError):
+            deny(f"invalid value <{raw}> for {ELASTIC_DESIRED_ANNOTATION}, "
+                 f"it must be an integer")
+        if desired < max(pg.spec.min_member, 1):
+            deny(f"invalid value <{desired}> for "
+                 f"{ELASTIC_DESIRED_ANNOTATION}: desired members must be "
+                 f">= minMember ({pg.spec.min_member})")
+    sus = ann.get(SUSPEND_ANNOTATION)
+    if sus is not None and sus not in ("true", "false"):
+        deny(f"invalid value <{sus}> for {SUSPEND_ANNOTATION}, "
+             f"it must be \"true\" or \"false\"")
+
+
 # pods webhook (admit_pod.go:1-203) ------------------------------------------
 
 JDB_MIN_AVAILABLE = "volcano.sh/jdb-min-available"
@@ -366,6 +393,9 @@ def register_webhooks(store: ObjectStore) -> Router:
     router.register(AdmissionService(
         "/podgroups/mutate", ["PodGroup"], ["CREATE"], mutate_podgroup,
         mutating=True))
+    router.register(AdmissionService(
+        "/podgroups/validate", ["PodGroup"], ["CREATE", "UPDATE"],
+        validate_podgroup))
     router.register(AdmissionService(
         "/pods", ["Pod"], ["CREATE"], make_validate_pod(store)))
     store.register_admission_hook(router.hook)
