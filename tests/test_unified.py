@@ -1,0 +1,377 @@
+"""Unified shard_map solver (ops/unified.py): byte-identity parity suite.
+
+The unified solver's contract is that decisions are MESH-SIZE INVARIANT
+BY CONSTRUCTION — the 8-device solve is byte-identical to the
+single-device oracle, not merely admission-equivalent. This suite pins
+that contract at every layer:
+
+- ops level: blocks mode and scan mode, mesh sizes 1/2/4/8 vs
+  ``mesh=None``, both sweep/pass budget tiers, with and without the
+  masked-static matrix, and the zero-capacity node padding used when N
+  is not divisible by the mesh;
+- engine level: the ``tpu-sharded`` AllocateAction on the full 8-device
+  mesh vs the SAME engine capped to ``sharded-devices: 1`` (the oracle
+  the sim's --verify-sharded-equivalence runs) — identical bind maps;
+- speculative level: ``dispatch_speculative_solve``'s sharded branch vs
+  the serial ``_solve_fused`` sharded solve on one session — byte-equal
+  packed decisions (the committed-speculation contract);
+- pallas wire level: ``place_pallas_packed``'s device decode vs
+  ``place_pallas``'s host decode (interpret mode on CPU);
+- fault level: a device fault injected into the sharded engine is
+  contained exactly like the single-chip engines (cool-down, epoch
+  bump, sequential-placer completion).
+
+Runs on the 8-device virtual CPU mesh (conftest sets
+xla_force_host_platform_device_count=8).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from volcano_tpu.ops import JobMeta, NO_NODE, default_weights, make_node_state
+from volcano_tpu.ops.pallas_place import NEG
+from volcano_tpu.ops.unified import (make_mesh, padded_task_len,
+                                     place_blocks_unified, place_scan_unified)
+
+R = 2
+SEED = 20260807
+
+
+def build(T=96, N=16, J=8, seed=0):
+    rng = np.random.RandomState(seed)
+    alloc = rng.choice([4000.0, 8000.0], size=(N, R)).astype(np.float32)
+    req = rng.choice([500.0, 1000.0, 2000.0], size=(T, R)).astype(np.float32)
+    job_ix = np.sort(rng.randint(0, J, size=T)).astype(np.int32)
+    min_avail = np.asarray(
+        [max(1, (job_ix == j).sum() // 2) for j in range(J)], np.int32)
+    return alloc, req, job_ix, min_avail
+
+
+def node_state(alloc):
+    N = alloc.shape[0]
+    return make_node_state(jnp.asarray(alloc), jnp.zeros((N, R)),
+                           jnp.zeros((N, R)), jnp.zeros((N, R)),
+                           jnp.zeros(N, jnp.int32))
+
+
+def job_meta(min_avail):
+    J = min_avail.shape[0]
+    return JobMeta(min_available=jnp.asarray(min_avail),
+                   base_ready=jnp.zeros(J, jnp.int32),
+                   base_pipelined=jnp.zeros(J, jnp.int32))
+
+
+def masked_static_for(T, N, seed):
+    """~85% feasible mask with small random static scores, NEG elsewhere —
+    exercises the has_ms solver variant and the sharded ms columns."""
+    rng = np.random.RandomState(seed + 1000)
+    feas = rng.rand(T, N) < 0.85
+    feas[:, 0] = True                     # no task is fully infeasible
+    static = rng.rand(T, N).astype(np.float32) * 0.5
+    return np.where(feas, static, NEG).astype(np.float32)
+
+
+def run_blocks(D, alloc, req, job_ix, min_avail, ms=None,
+               sweeps=3, passes=3, chunk=16):
+    """One blocks-mode solve on a D-device mesh (None = unsharded);
+    returns the packed wire row as host bytes."""
+    mesh = None if D is None else make_mesh(jax.devices()[:D])
+    N, T = alloc.shape[0], req.shape[0]
+    packed, _ = place_blocks_unified(
+        mesh, node_state(alloc), jnp.asarray(req), jnp.ones(T, bool),
+        jnp.asarray(job_ix), job_meta(min_avail), default_weights(R),
+        jnp.asarray(alloc), jnp.full(N, 100, jnp.int32), chunk=chunk,
+        sweeps=sweeps, passes=passes,
+        masked_static=None if ms is None else jnp.asarray(ms))
+    return np.asarray(packed)
+
+
+class TestBlocksMeshInvariance:
+    def test_mesh_sizes_and_budget_tiers_byte_identical(self):
+        """mesh 1/2/4/8 vs mesh=None, both budget tiers, with and
+        without masked_static: the ENTIRE packed row is byte-identical
+        (task_node, pipelined, ready, kept — placements, not just
+        admissions)."""
+        assert len(jax.devices()) == 8, "conftest must provide 8 devices"
+        for seed in (0, 3):
+            alloc, req, job_ix, min_avail = build(seed=seed)
+            ms = masked_static_for(req.shape[0], alloc.shape[0], seed)
+            for use_ms in (None, ms):
+                for sweeps, passes in ((3, 3), (5, 4)):
+                    ref = run_blocks(None, alloc, req, job_ix, min_avail,
+                                     ms=use_ms, sweeps=sweeps, passes=passes)
+                    for D in (1, 2, 4, 8):
+                        got = run_blocks(D, alloc, req, job_ix, min_avail,
+                                         ms=use_ms, sweeps=sweeps,
+                                         passes=passes)
+                        assert np.array_equal(ref, got), (
+                            f"seed={seed} D={D} budget=({sweeps},{passes}) "
+                            f"ms={use_ms is not None}: mesh-size invariance "
+                            f"broken at "
+                            f"{np.flatnonzero(ref != got)[:8].tolist()}")
+
+    def test_budget_cap_is_fixpoint_safe(self):
+        """The while_loop budgets are CAPS with fixpoint early exit:
+        raising them far past convergence changes nothing."""
+        alloc, req, job_ix, min_avail = build(seed=1)
+        a = run_blocks(8, alloc, req, job_ix, min_avail, sweeps=5, passes=4)
+        b = run_blocks(8, alloc, req, job_ix, min_avail, sweeps=9, passes=8)
+        assert np.array_equal(a, b), "budget cap changed a converged solve"
+
+    def test_zero_capacity_node_padding_is_inert(self):
+        """N=20 is not divisible by 8: the engine pads with zero-capacity
+        rows (cache/snapshot.sharded_node_layout). The padded 8-device
+        solve must be byte-identical to the UNPADDED single-device solve
+        on the task/job spans, and never assign a pad row."""
+        alloc, req, job_ix, min_avail = build(T=64, N=20, seed=2)
+        T, J = req.shape[0], min_avail.shape[0]
+        Tp = padded_task_len(T, 16)
+        ref = run_blocks(None, alloc, req, job_ix, min_avail)
+
+        pad = (-20) % 8
+        alloc_p = np.pad(alloc, ((0, pad), (0, 0)))
+        mesh = make_mesh(jax.devices())
+        packed, _ = place_blocks_unified(
+            mesh, node_state(alloc_p), jnp.asarray(req), jnp.ones(T, bool),
+            jnp.asarray(job_ix), job_meta(min_avail), default_weights(R),
+            jnp.asarray(alloc_p),
+            jnp.concatenate([jnp.full(20, 100, jnp.int32),
+                             jnp.zeros(pad, jnp.int32)]), chunk=16)
+        got = np.asarray(packed)
+        assert got.shape == ref.shape == (2 * Tp + 2 * J,)
+        assert np.array_equal(ref, got), (
+            "zero-capacity padding leaked into decisions at "
+            f"{np.flatnonzero(ref != got)[:8].tolist()}")
+        tn = got[:T]
+        assert tn.max() < 20, "a task was assigned to a zero-capacity pad row"
+
+
+class TestScanMeshInvariance:
+    def test_scan_mode_byte_identical_across_mesh_sizes(self):
+        from volcano_tpu.ops.place import PlacementTasks
+
+        alloc, req, job_ix, min_avail = build(T=48, N=16, seed=4)
+        T, N = req.shape, alloc.shape[0]
+        T = req.shape[0]
+        first = np.zeros(T, bool)
+        last = np.zeros(T, bool)
+        first[0] = True
+        first[1:] = job_ix[1:] != job_ix[:-1]
+        last[:-1] = job_ix[1:] != job_ix[:-1]
+        last[-1] = True
+        rng = np.random.RandomState(4)
+        feas = rng.rand(T, N) < 0.9
+        feas[:, 0] = True
+        pt = PlacementTasks(
+            req=jnp.asarray(req), job_ix=jnp.asarray(job_ix),
+            valid=jnp.ones(T, bool), feas=jnp.asarray(feas),
+            static_score=jnp.asarray(
+                rng.rand(T, N).astype(np.float32) * 0.5),
+            first_of_job=jnp.asarray(first), last_of_job=jnp.asarray(last))
+        args = (node_state(alloc), pt, job_meta(min_avail),
+                default_weights(R), jnp.asarray(alloc),
+                jnp.full(N, 100, jnp.int32))
+        ref, _ = place_scan_unified(None, *args)
+        ref = np.asarray(ref)
+        for D in (1, 2, 8):
+            got, _ = place_scan_unified(make_mesh(jax.devices()[:D]), *args)
+            assert np.array_equal(ref, np.asarray(got)), (
+                f"scan mode diverged at D={D}: "
+                f"{np.flatnonzero(ref != np.asarray(got))[:8].tolist()}")
+
+
+def _engine_run(devices: int):
+    """One tpu-sharded allocate cycle at the 1k config with the mesh
+    capped to ``devices`` (0 = full mesh); returns (binds, pipelined)."""
+    from volcano_tpu.actions import AllocateAction
+    from volcano_tpu.api import TaskStatus
+    from volcano_tpu.cache.synthetic import baseline_config
+    from volcano_tpu.framework import close_session, open_session, \
+        parse_scheduler_conf
+    from volcano_tpu.framework.arguments import Arguments
+    from volcano_tpu.framework.conf import Configuration
+    import volcano_tpu.plugins  # noqa: F401
+
+    conf = parse_scheduler_conf(None)
+    cache, binder, _ = baseline_config("1k", seed=0)
+    ssn = open_session(cache, conf.tiers, [
+        Configuration(name="allocate-tpu",
+                      arguments=Arguments({"sharded-devices": str(devices)}))])
+    AllocateAction(engine="tpu-sharded").execute(ssn)
+    piped = sorted(t.uid for j in ssn.jobs.values() for t in j.tasks.values()
+                   if t.status == TaskStatus.PIPELINED)
+    close_session(ssn)
+    return binder.binds, piped
+
+
+class TestEngineOracleParity:
+    def test_full_mesh_matches_one_device_oracle_bind_map(self):
+        """The tpu-sharded engine on the full 8-device mesh vs the SAME
+        engine at sharded-devices:1 — the sim oracle. The bind MAP
+        (task -> node), not just the admitted set, must be identical."""
+        assert len(jax.devices()) == 8, "conftest must provide 8 devices"
+        binds8, pipe8 = _engine_run(0)
+        binds1, pipe1 = _engine_run(1)
+        assert binds8 == binds1, (
+            f"bind maps diverge: {len(binds8)} vs {len(binds1)} binds")
+        assert pipe8 == pipe1
+        assert len(binds8) > 0, "1k fixture placed nothing"
+
+
+class TestSpeculativeShardedParity:
+    def test_dispatch_finalize_matches_serial_solve(self):
+        """dispatch_speculative_solve('tpu-sharded') +
+        finalize_speculative_dispatch vs the serial _solve_fused sharded
+        solve on ONE session: byte-equal packed decisions over the same
+        task list — the committed-speculation byte-equivalence contract
+        extended to the unified sharded engine (ISSUE 18)."""
+        from volcano_tpu.actions.allocate import (
+            _fixed_job_order, _solve_fused, dispatch_speculative_solve,
+            finalize_speculative_dispatch)
+        from volcano_tpu.cache.synthetic import baseline_config
+        from volcano_tpu.framework import close_session, open_session, \
+            parse_scheduler_conf
+        import volcano_tpu.plugins  # noqa: F401
+
+        conf = parse_scheduler_conf(None)
+        cache, _, _ = baseline_config("1k", seed=1)
+        ssn = open_session(cache, conf.tiers, [])
+        try:
+            pending = dispatch_speculative_solve(ssn, "tpu-sharded")
+            assert pending is not None, "speculation refused to dispatch"
+            spec = finalize_speculative_dispatch(pending)
+            serial = _solve_fused(ssn, _fixed_job_order(ssn), blocks=False,
+                                  kernel="auto", sharded=True)
+            assert serial is not None
+            assert [t.uid for t in spec.tasks] == \
+                [t.uid for t in serial.tasks], "task axis assembly diverged"
+            for field in ("task_node", "pipelined", "job_ready", "job_kept"):
+                a = np.asarray(getattr(spec, field))
+                b = np.asarray(getattr(serial, field))
+                assert np.array_equal(a, b), (
+                    f"speculative sharded {field} != serial: "
+                    f"{np.flatnonzero(a != b)[:8].tolist()}")
+        finally:
+            close_session(ssn)
+
+
+class TestPallasPackedWire:
+    def test_device_decode_matches_host_decode(self):
+        """place_pallas_packed's on-device decode into the unified wire
+        layout vs place_pallas's host decode (interpret mode on CPU) —
+        the two readback paths of the same kernel must agree bit-for-bit."""
+        from volcano_tpu.ops import pallas_place
+        from volcano_tpu.actions.allocate import _fetch_packed
+
+        alloc, req, job_ix, min_avail = build(T=40, N=16, seed=5)
+        T, N, J = req.shape[0], alloc.shape[0], min_avail.shape[0]
+        assert pallas_place.supported(R, N)
+        ms = masked_static_for(T, N, 5)
+        zeros = np.zeros((N, R), np.float32)
+        base = dict(idle=alloc, future_idle=alloc, used=zeros,
+                    ntasks=np.zeros(N, np.float32), allocatable=alloc,
+                    max_tasks=np.full(N, 100.0, np.float32))
+        args = (base["idle"], base["future_idle"], base["used"],
+                base["ntasks"], base["allocatable"], base["max_tasks"],
+                req, job_ix, ms, min_avail, np.zeros(J, np.int32),
+                np.zeros(J, np.int32), np.ones(R, np.float32))
+        host = pallas_place.place_pallas(*args, fetch_state=False)
+        packed = pallas_place.place_pallas_packed(*args)
+        bucket = pallas_place.padded_shape(T, N)[0]
+        tn, pipe, ready, kept = _fetch_packed(packed, bucket, J, T)
+        assert np.array_equal(tn, host.task_node)
+        assert np.array_equal(pipe.astype(bool), host.task_pipelined)
+        assert np.array_equal(ready.astype(bool), host.job_ready)
+        assert np.array_equal(kept.astype(bool), host.job_kept)
+
+
+# ---------------------------------------------------------------------------
+# device-fault containment on the sharded engine
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+@pytest.fixture
+def sharded_fault_rig():
+    from volcano_tpu.actions import allocate as alloc_mod
+    from volcano_tpu.device_health import DEVICE_HEALTH
+    clock = FakeClock()
+    DEVICE_HEALTH.reset(time_fn=clock)
+    yield clock
+    alloc_mod.DEVICE_FAULT_HOOK = None
+    import time as _time
+    DEVICE_HEALTH.reset(time_fn=_time.monotonic)
+
+
+class TestShardedFaultContainment:
+    def test_mid_solve_fault_contained_and_cycle_completes(
+            self, sharded_fault_rig):
+        """A device fault inside the SHARDED solve hits the same
+        containment chain as the single-chip engines: the cycle absorbs
+        it through the sequential placer, the cool-down opens, the snap
+        epoch bumps (resident tensors dropped), and during the window
+        the device engine is never dispatched."""
+        from volcano_tpu import metrics
+        from volcano_tpu.actions import allocate as alloc_mod
+        from volcano_tpu.api import (JobInfo, NodeInfo, PodGroup,
+                                     PodGroupPhase, Resource, TaskInfo)
+        from volcano_tpu.cache import SchedulerCache, SequenceBinder, \
+            SequenceEvictor
+        from volcano_tpu.chaos import DeviceFaultInjector
+        from volcano_tpu.device_health import DEVICE_HEALTH
+        from volcano_tpu.scheduler import Scheduler
+
+        GI = 1 << 30
+        metrics.reset_local()
+        binder = SequenceBinder()
+        cache = SchedulerCache(binder=binder, evictor=SequenceEvictor())
+        for i in range(8):
+            alloc = Resource(16000, 32 * GI)
+            alloc.max_task_num = 110
+            cache.add_node(NodeInfo(name=f"n{i}", allocatable=alloc))
+        for j in range(4):
+            pg = PodGroup(name=f"j{j}", queue="default", min_member=3,
+                          phase=PodGroupPhase.INQUEUE)
+            job = JobInfo(uid=f"j{j}", name=f"j{j}", queue="default",
+                          min_available=3, podgroup=pg)
+            for k in range(3):
+                job.add_task_info(TaskInfo(
+                    uid=f"j{j}-{k}", name=f"j{j}-{k}", job=f"j{j}",
+                    resreq=Resource(1000, GI)))
+            cache.add_job(job)
+
+        injector = DeviceFaultInjector({"oom": [1]}, seed=SEED)
+        alloc_mod.DEVICE_FAULT_HOOK = injector
+        conf = (
+            'actions: "allocate-tpu"\n'
+            "tiers:\n- plugins:\n  - name: priority\n  - name: gang\n"
+            "- plugins:\n  - name: drf\n  - name: proportion\n"
+            'configurations:\n- name: allocate-tpu\n'
+            "  arguments:\n    engine: tpu-sharded\n")
+        sched = Scheduler(cache, conf_text=conf, schedule_period=0.0,
+                          drift_verify_every=0)
+        epoch_before = cache._snap_epoch
+        errs = sched.run_once()
+        assert not errs, f"fallback should absorb the sharded fault: {errs}"
+        assert injector.injected == [(1, "oom")], injector.injected
+        assert not DEVICE_HEALTH.available(), "cool-down did not open"
+        assert cache._snap_epoch > epoch_before, "epoch not bumped"
+        assert cache.tensor_cache is None
+        assert len(binder.sequence) == \
+            sum(len(j.tasks) for j in cache.jobs.values()), \
+            "sequential fallback did not complete the cycle"
+        # inside the window the device engine (and hence the hook) is
+        # never consulted
+        attempts = injector.attempt
+        sched.run_once()
+        assert injector.attempt == attempts, \
+            "sharded engine dispatched during cool-down"

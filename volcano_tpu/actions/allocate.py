@@ -672,14 +672,11 @@ def _execute_strict_batched(ssn, batch: int = 16) -> None:
     comparator it replays.
 
     The batch size is ADAPTIVE (VERDICT r5 #8): it doubles after every
-    fully-verified batch (up to 8x the configured floor) and halves on a
+    fully-verified batch (up to 32x the configured floor) and halves on a
     mispredict — on a well-predicted cycle the RTT count shrinks
     geometrically, which is the whole cost model on a ~100ms-RTT tunnel.
     Shape buckets stay bounded: the job axis pads to the CURRENT batch
-    size, so at most log2(8)+1 job-axis shapes per task bucket exist."""
-    import jax.numpy as jnp
-    from ..ops.place import unpack_placement
-
+    size, so at most log2(32)+1 job-axis shapes per task bucket exist."""
     if not ssn.nodes:
         return
     tasks_all = [t for j in ssn.jobs.values() for t in j.tasks.values()]
@@ -703,7 +700,11 @@ def _execute_strict_batched(ssn, batch: int = 16) -> None:
     namespaces, jobs_map = _build_interleave(ssn)
     pending: Dict[str, List[TaskInfo]] = {}
     carry = None        # (job, ns) a mismatch live-popped but left unprocessed
-    b_cur, b_max = batch, batch * 8 if batch > 1 else 1
+    # 32x ceiling (was 8x): on a well-predicted saturated cycle the RTT
+    # count keeps shrinking geometrically for two more doublings; the
+    # shape-bucket bound grows to log2(32)+1 job-axis shapes per task
+    # bucket, all warmed through the same _job_bucket ladder
+    b_cur, b_max = batch, batch * 32 if batch > 1 else 1
 
     def live_tasks(job):
         if job.uid not in pending:
@@ -724,9 +725,10 @@ def _execute_strict_batched(ssn, batch: int = 16) -> None:
                 packed_d, new_state, bucket, J, slices = _solve_job_batch(
                     ssn, solvable, state, node_t, rnames, weights,
                     allocatable_d, max_tasks_d, solver, j_pad=b_cur)
-                packed = np.asarray(packed_d)        # the batch's ONE fetch
-                task_node, pipelined, _, job_kept = unpack_placement(
-                    packed, bucket, J)
+                # the batch's ONE fetch, through the same sanctioned
+                # readback site as every other fused engine
+                task_node, pipelined, _, job_kept = _fetch_packed(
+                    packed_d, bucket, J, bucket)
         solved_ix = {id(j): k for k, (j, _) in enumerate(solvable)}
 
         verified_prefix: List[tuple] = []
@@ -832,6 +834,22 @@ def _topology_weight(ssn) -> float:
             except (TypeError, ValueError):
                 w = 0.0
     return max(w, 0.0)
+
+
+def _sharded_device_count(ssn) -> int:
+    """The allocate action's ``sharded-devices`` argument: cap the unified
+    sharded engine's mesh to the FIRST k devices (0 = the full device
+    set). The sim's ``--verify-sharded-equivalence`` runs the same engine
+    at k=1 as the single-device oracle — mesh-size invariance
+    (ops/unified.py) is what makes that comparison byte-exact."""
+    k = 0
+    for conf in ssn.configurations:
+        if conf.name in ("allocate", "allocate-tpu"):
+            try:
+                k = int(conf.arguments.get("sharded-devices", k))
+            except (TypeError, ValueError):
+                k = 0
+    return max(k, 0)
 
 
 # ---------------------------------------------------------------------------
@@ -1101,25 +1119,23 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
     jobs_meta, min_av_np, base_r_np, base_p_np, Jp = _gang_meta(jobs_list)
 
     if sharded:
-        # multi-chip engine: node axis sharded over the device mesh (VERDICT
-        # r1 #2 — the flagship scale mechanism as a selectable engine).
-        from ..parallel.mesh import (NEG as MNEG, make_mesh,
-                                     place_blocks_sharded)
+        # multi-chip engine: the unified solver (ops/unified.py) with the
+        # node axis sharded over the device mesh. Decisions are mesh-size
+        # invariant, so the 1-device run of this very engine IS the oracle
+        # for any D — and a 1-device mesh collapses to the plain jit
+        # program inside place_blocks_unified, skipping shard_map overhead.
         import jax
-        mesh = make_mesh(jax.devices())
-        D = mesh.devices.size
-        n_pad = (-N) % D
-        idle = np.pad(node_t.idle, ((0, n_pad), (0, 0)))
-        releasing = np.pad(node_t.releasing, ((0, n_pad), (0, 0)))
-        pipelined_r = np.pad(node_t.pipelined, ((0, n_pad), (0, 0)))
-        used = np.pad(node_t.used, ((0, n_pad), (0, 0)))
-        alloc = np.pad(node_t.allocatable, ((0, n_pad), (0, 0)))
-        ntasks = np.pad(node_t.ntasks, (0, n_pad))
-        maxt = np.pad(node_t.max_tasks, (0, n_pad))   # zero: no pod fits
-        state = NodeState(
-            idle=jnp.asarray(idle),
-            future_idle=jnp.asarray(idle + releasing - pipelined_r),
-            used=jnp.asarray(used), ntasks=jnp.asarray(ntasks))
+        from ..cache.snapshot import sharded_node_layout
+        from ..ops.pallas_place import NEG as MNEG
+        from ..ops.unified import (make_mesh, padded_task_len,
+                                   place_blocks_unified)
+        devices = jax.devices()
+        k = _sharded_device_count(ssn)
+        if k:
+            devices = devices[:k]
+        mesh = make_mesh(devices)
+        D = int(mesh.devices.size)
+        state, alloc_d, maxt_d, n_pad = sharded_node_layout(node_t, D)
         ms = None
         if feas is not None or static is not None:
             f = np.ones((T, N), bool) if feas is None else feas
@@ -1131,18 +1147,20 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
         # config the default sweeps=3/passes=3 budget leaves ~1.5% of a
         # full packing on the table (19700/20000); raising BOTH to
         # sweeps=5/passes=4 recovers the full packing (measured together —
-        # the split between the two knobs was not isolated)
+        # the split between the two knobs was not isolated). The budgets
+        # are while_loop CAPS with fixpoint early exit, so the big tier
+        # costs extra passes only while they still change something.
         big = T > 12000
-        assign, pipelined, ready, kept, _ = place_blocks_sharded(
+        packed, _ = place_blocks_unified(
             mesh, state, jnp.asarray(req), jnp.ones(T, bool),
-            jnp.asarray(job_ix_np), jobs_meta, weights, jnp.asarray(alloc),
-            jnp.asarray(maxt), masked_static=ms,
-            sweeps=5 if big else 3, passes=4 if big else 3)
-        # ONE batched readback (four separate np.asarray fetches cost four
-        # tunnel RTTs on remote TPU backends)
-        assign, pipelined, ready, kept = jax.device_get(
-            (assign, pipelined, ready, kept))
-        task_node = np.where(assign < N, assign, NO_NODE).astype(np.int32)
+            jnp.asarray(job_ix_np), jobs_meta, weights, alloc_d, maxt_d,
+            sweeps=5 if big else 3, passes=4 if big else 3,
+            masked_static=ms)
+        # same packed single-fetch wire layout as every fused engine: the
+        # former separate 4-array device_get readback is gone, and the one
+        # sanctioned site (_fetch_packed) serves this engine too
+        task_node, pipelined, ready, kept = _fetch_packed(
+            packed, padded_task_len(T), Jp, T)
         return _FusedSolution(tasks, job_ix_np, jobs_list, node_t, task_node,
                               pipelined, ready, kept)
 
@@ -1519,14 +1537,16 @@ def dispatch_speculative_solve(ssn, engine: str = "tpu-fused",
     helpers — one definition of collection, padding, dtypes and the jit
     cache key), which is what makes a committed speculation
     byte-equivalent to the serial cycle.
+    Every fused kernel dispatches: scan, the pallas VMEM kernel (device
+    decode into the same packed layout — place_pallas_packed), and the
+    unified sharded engine, so multi-chip backends pipeline end-to-end.
     Returns None whenever speculation cannot run this cycle: nothing
     pending, stateful predicates (the mask would go stale mid-replay),
-    device cool-down, a pallas-eligible shape under ``tpu-fused`` auto
-    mode (that kernel is not dispatch/await split), or non-finite
-    inputs (the serial path's SolverFault degradation owns those)."""
+    device cool-down, or non-finite inputs (the serial path's
+    SolverFault degradation owns those)."""
     if ssn.stateful_predicates or not ssn.nodes:
         return None
-    if engine not in ("tpu-fused", "tpu-scan"):
+    if engine not in ("tpu-fused", "tpu-scan", "tpu-pallas", "tpu-sharded"):
         return None
     if not _device_available():
         return None
@@ -1543,11 +1563,6 @@ def dispatch_speculative_solve(ssn, engine: str = "tpu-fused",
     rnames = discover_resource_names(list(ssn.nodes.values()), tasks)
     node_t = _node_tensors(ssn, rnames)
     N = len(node_t.names)
-    if engine == "tpu-fused":
-        from ..ops import pallas_place
-        if pallas_place.supported(len(rnames), N) \
-                and not pallas_place.use_interpret():
-            return None
     req = task_requests(tasks, rnames)
     feas = assemble_feasibility(ssn, tasks, node_t)
     static = assemble_static_score(ssn, tasks, node_t)
@@ -1564,22 +1579,85 @@ def dispatch_speculative_solve(ssn, engine: str = "tpu-fused",
 
     T = len(tasks)
     job_ix_np = np.asarray(job_ix, np.int32)
-    jobs_meta, _, _, _, Jp = _gang_meta(jobs_list)
-    feas_np = np.ones((T, N), bool) if feas is None else np.asarray(feas)
-    static_np = (np.zeros((T, N), np.float32) if static is None
-                 else np.asarray(static, np.float32))
-    pt, bucket = _scan_placement_tasks(req, job_ix_np, feas_np, static_np)
+    jobs_meta, min_av_np, base_r_np, base_p_np, Jp = _gang_meta(jobs_list)
     topo_w = _topology_weight(ssn)
-    if topo_w > 0.0:
+    from ..ops import pallas_place
+    # mirror of _solve_fused's kernel selection (tpu-fused = auto): the
+    # committed speculation must run the SAME kernel the serial cycle
+    # would have — byte-equivalence is the contract, not just parity
+    use_pallas = (engine in ("tpu-fused", "tpu-pallas") and topo_w == 0.0
+                  and pallas_place.supported(len(rnames), N)
+                  and (engine == "tpu-pallas"
+                       or not pallas_place.use_interpret()))
+    if engine == "tpu-sharded":
+        # unified sharded solve — same assembly as _solve_fused's sharded
+        # branch, dispatch only: the packed result stays on device until
+        # finalize_speculative_dispatch's one fetch
+        import jax
         import jax.numpy as jnp
-        packed, _ = _job_solver_topo()(
-            node_t.node_state(), pt, jobs_meta, weights,
-            node_t.device_allocatable(), node_t.device_max_tasks(),
-            node_t.device_zone_code(), jnp.float32(topo_w))
+        from ..cache.snapshot import sharded_node_layout
+        from ..ops.pallas_place import NEG as MNEG
+        from ..ops.unified import (make_mesh, padded_task_len,
+                                   place_blocks_unified)
+        devices = jax.devices()
+        k = _sharded_device_count(ssn)
+        if k:
+            devices = devices[:k]
+        mesh = make_mesh(devices)
+        state, alloc_d, maxt_d, n_pad = sharded_node_layout(
+            node_t, int(mesh.devices.size))
+        ms = None
+        if feas is not None or static is not None:
+            f = np.ones((T, N), bool) if feas is None else np.asarray(feas)
+            s = (np.zeros((T, N), np.float32) if static is None
+                 else np.asarray(static, np.float32))
+            ms = jnp.asarray(np.pad(
+                np.where(f, s, MNEG).astype(np.float32),
+                ((0, 0), (0, n_pad)), constant_values=MNEG))
+        big = T > 12000
+        packed, _ = place_blocks_unified(
+            mesh, state, jnp.asarray(req), jnp.ones(T, bool),
+            jnp.asarray(job_ix_np), jobs_meta, weights, alloc_d, maxt_d,
+            sweeps=5 if big else 3, passes=4 if big else 3,
+            masked_static=ms)
+        bucket = padded_task_len(T)
+    elif use_pallas:
+        if feas is None and static is None:
+            ms = pallas_place.neutral_masked_static(
+                *pallas_place.padded_shape(T, N), T, N)
+        else:
+            f = np.ones((T, N), bool) if feas is None else np.asarray(feas)
+            s = (np.zeros((T, N), np.float32) if static is None
+                 else np.asarray(static, np.float32))
+            ms = np.where(f, s, pallas_place.NEG).astype(np.float32)
+        packed = pallas_place.place_pallas_packed(
+            node_t.idle,
+            node_t.idle + node_t.releasing - node_t.pipelined,
+            node_t.used, node_t.ntasks.astype(np.float32),
+            node_t.allocatable, node_t.max_tasks.astype(np.float32),
+            req, job_ix_np, ms, min_av_np, base_r_np, base_p_np,
+            np.asarray(weights.binpack_res),
+            binpack_weight=float(weights.binpack_weight),
+            least_weight=float(weights.least_req_weight),
+            most_weight=float(weights.most_req_weight),
+            balanced_weight=float(weights.balanced_weight))
+        bucket = pallas_place.padded_shape(T, N)[0]
     else:
-        packed, _ = _job_solver()(node_t.node_state(), pt, jobs_meta,
-                                  weights, node_t.device_allocatable(),
-                                  node_t.device_max_tasks())
+        feas_np = np.ones((T, N), bool) if feas is None else np.asarray(feas)
+        static_np = (np.zeros((T, N), np.float32) if static is None
+                     else np.asarray(static, np.float32))
+        pt, bucket = _scan_placement_tasks(req, job_ix_np, feas_np,
+                                           static_np)
+        if topo_w > 0.0:
+            import jax.numpy as jnp
+            packed, _ = _job_solver_topo()(
+                node_t.node_state(), pt, jobs_meta, weights,
+                node_t.device_allocatable(), node_t.device_max_tasks(),
+                node_t.device_zone_code(), jnp.float32(topo_w))
+        else:
+            packed, _ = _job_solver()(node_t.node_state(), pt, jobs_meta,
+                                      weights, node_t.device_allocatable(),
+                                      node_t.device_max_tasks())
     LAST_STATS["speculate_order_s"] = sp.dur_s
     return PendingFusedSolution(ordered_jobs, tasks, job_ix_np, jobs_list,
                                 node_t, packed, bucket, Jp,
@@ -1644,8 +1722,12 @@ def _fused_blocks_solver():
     import jax
     if "blocks" not in _SOLVER_CACHE:
         from ..ops.auction import place_blocks_packed
+        # chunk is shape-static; sweeps/passes are runtime while_loop caps
+        # in the unified kernel (fixpoint early exit), so ONE compile per
+        # task bucket serves every budget tier — the big-tier budget bump
+        # at T > 12000 no longer mints a second program
         _SOLVER_CACHE["blocks"] = jax.jit(
-            place_blocks_packed, static_argnames=("chunk", "sweeps", "passes"))
+            place_blocks_packed, static_argnames=("chunk",))
     return _SOLVER_CACHE["blocks"]
 
 
@@ -1759,30 +1841,23 @@ def prewarm_shapes(ssn, shape_configs=None, engine: str = "tpu-fused",
                 jnp.asarray(node_t.max_tasks),
                 sweeps=5 if big else 3, passes=4 if big else 3)
         elif engine == "tpu-sharded":
-            from ..parallel.mesh import make_mesh, place_blocks_sharded
-            from ..ops.place import NodeState
-            mesh = make_mesh(jax.devices())
-            D = mesh.devices.size
-            n_pad = (-N) % D
-            idle = np.pad(node_t.idle, ((0, n_pad), (0, 0)))
-            releasing = np.pad(node_t.releasing, ((0, n_pad), (0, 0)))
-            pipelined_r = np.pad(node_t.pipelined, ((0, n_pad), (0, 0)))
-            state = NodeState(
-                idle=jnp.asarray(idle),
-                future_idle=jnp.asarray(idle + releasing - pipelined_r),
-                used=jnp.asarray(np.pad(node_t.used, ((0, n_pad), (0, 0)))),
-                ntasks=jnp.asarray(np.pad(node_t.ntasks, (0, n_pad))))
+            from ..cache.snapshot import sharded_node_layout
+            from ..ops.unified import make_mesh, place_blocks_unified
+            devices = jax.devices()
+            k = _sharded_device_count(ssn)
+            if k:
+                devices = devices[:k]
+            mesh = make_mesh(devices)
+            state, alloc_d, maxt_d, _ = sharded_node_layout(
+                node_t, int(mesh.devices.size))
             big = T > 12000
-            out = place_blocks_sharded(
+            out = place_blocks_unified(
                 mesh, state, jnp.asarray(req), jnp.ones(T, bool),
                 jnp.asarray(job_ix),
                 JobMeta(min_available=jnp.asarray(min_av),
                         base_ready=jnp.asarray(base_z),
                         base_pipelined=jnp.asarray(base_z)),
-                weights,
-                jnp.asarray(np.pad(node_t.allocatable, ((0, n_pad), (0, 0)))),
-                jnp.asarray(np.pad(node_t.max_tasks, (0, n_pad))),
-                masked_static=None,
+                weights, alloc_d, maxt_d, masked_static=None,
                 sweeps=5 if big else 3, passes=4 if big else 3)
         else:
             # scan solver: the fused engine's CPU/interpret path and the

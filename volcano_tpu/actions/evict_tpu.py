@@ -1003,10 +1003,18 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool,
     fidle0 = tensors.future_idle0()
     score_arr = score_g
     if sharded:
-        from ..ops.evict import EvictNW
         from ..parallel.mesh import make_mesh
         mesh = make_mesh(jax.devices())
         D = int(mesh.devices.size)
+        if D == 1:
+            # a 1-device mesh runs the single-device program: the sharded
+            # walk is bit-identical to it by construction (ops/evict.py),
+            # so collapsing only skips the shard_map/psum plumbing — this
+            # is what closed the 527ms-vs-387ms sharded preempt gap on
+            # single-device hosts
+            sharded = False
+    if sharded:
+        from ..ops.evict import EvictNW
         N0 = tensors.vslot.shape[0]
         n_pad = (-N0) % D
         if n_pad:

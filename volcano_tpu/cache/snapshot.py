@@ -100,6 +100,35 @@ class NodeTensors:
         return jnp.asarray(self.zone_code)
 
 
+def sharded_node_layout(node_t, D: int):
+    """Device-resident node tensors padded to a multiple of the mesh size
+    ``D`` — the unified sharded solver's input contract (its node axis
+    must split evenly across the shards). Padding happens ON DEVICE
+    (``jnp.pad`` over the already-resident ``node_state()`` arrays): a
+    host-side ``np.pad`` would force a full [N,R] re-upload every cycle
+    and — worse — read the host mirrors instead of the pinned epoch the
+    persistent tensor cache hands a speculative solve, going stale the
+    moment cycle N's binds scatter-update the live epoch. Pad rows are
+    zero-capacity (``max_tasks`` 0), so the kernels' ``ntasks <
+    max_tasks`` predicate makes them unselectable — the same hole
+    contract PersistentNodeTensors relies on for removed nodes.
+    Returns ``(NodeState, allocatable, max_tasks, n_pad)``."""
+    import jax.numpy as jnp
+    state = node_t.node_state()
+    alloc = node_t.device_allocatable()
+    maxt = node_t.device_max_tasks()
+    n_pad = (-state.idle.shape[0]) % D
+    if n_pad:
+        state = NodeState(
+            idle=jnp.pad(state.idle, ((0, n_pad), (0, 0))),
+            future_idle=jnp.pad(state.future_idle, ((0, n_pad), (0, 0))),
+            used=jnp.pad(state.used, ((0, n_pad), (0, 0))),
+            ntasks=jnp.pad(state.ntasks, (0, n_pad)))
+        alloc = jnp.pad(alloc, ((0, n_pad), (0, 0)))
+        maxt = jnp.pad(maxt, (0, n_pad))
+    return state, alloc, maxt, n_pad
+
+
 def _delta_bucket(n: int) -> int:
     """Pad dirty-row scatter updates to power-of-two buckets so a churning
     dirty count does not mint a fresh XLA scatter shape every cycle

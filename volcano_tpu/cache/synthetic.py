@@ -98,6 +98,7 @@ def baseline_config(name: str, seed: int = 0):
     - "tiny":    example/job.yaml analogue — 1 gang of 3, 10 nodes
     - "1k":      1k pending pods / 200 nodes, gang+priority
     - "10k":     10k pods / 2k nodes, 3 queues (drf+proportion)
+    - "100k":    100k pods / 20k nodes — the sharded-solver scale config
     - "preempt": 5k running + 5k pending / 1k nodes
     - "gpu":     2k nodes x 8 GPUs, GPU-requesting tasks
     """
@@ -122,6 +123,16 @@ def baseline_config(name: str, seed: int = 0):
         # the long-axis scale config (SURVEY §5.7: nodes 2k -> tens of k)
         nodes = make_cluster(5000, seed=seed)
         jobs = make_jobs(20000, 400, ["q1", "q2", "q3"], seed=seed)
+        queues = [QueueInfo(name="q1", weight=3), QueueInfo(name="q2", weight=2),
+                  QueueInfo(name="q3", weight=1)]
+    elif name == "100k":
+        # the 100k-pod scale config (ISSUE 18): 100k pods / 20k nodes.
+        # Synthetic worlds keep the plugins' [T,N] feasibility/static
+        # contributions abstaining (no selectors/taints), so the unified
+        # sharded solver stays on its masked_static=None path — an 8 GB
+        # dense matrix at this shape would be the first thing to OOM.
+        nodes = make_cluster(20000, seed=seed)
+        jobs = make_jobs(100000, 2000, ["q1", "q2", "q3"], seed=seed)
         queues = [QueueInfo(name="q1", weight=3), QueueInfo(name="q2", weight=2),
                   QueueInfo(name="q3", weight=1)]
     elif name == "preempt":

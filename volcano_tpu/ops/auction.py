@@ -13,18 +13,28 @@ Gang semantics are restored after placement: a segment-sum gang check
 resources are returned in one vectorized rollback, and an optional extra
 sweep reuses the freed capacity — the batched analogue of
 Statement.Commit/Discard (statement.go:352-395).
+
+The kernel itself lives in ops/unified.py — ONE shard_map-partitioned
+solver whose unsharded (mesh=None) degenerate form is exactly the chunked
+greedy described above. This module keeps the single-device entry points
+(BlockTasks with dense feas/static matrices) and folds them into the
+unified solver's NEG-masked static-score representation:
+``ms = where(feas, static_score, NEG)`` carries the same fit mask
+(``ms > NEG_TEST``) and, where feasible, the same score (float addition
+is commutative, so ``dynamic + ms == static + dynamic`` bitwise) — the
+delegation is byte-identical to the former in-module kernel.
 """
 
 from __future__ import annotations
 
 from typing import NamedTuple, Tuple
 
-import jax
 import jax.numpy as jnp
 
-from .dense import EPS
-from .place import NO_NODE, JobMeta, NodeState
-from .scores import ScoreWeights, combined_dynamic_score
+from .pallas_place import NEG
+from .place import JobMeta, NodeState
+from .scores import ScoreWeights
+from .unified import K_CAND, place_blocks_unified  # noqa: F401 (re-export)
 
 
 class BlockTasks(NamedTuple):
@@ -37,118 +47,6 @@ class BlockTasks(NamedTuple):
     static_score: jnp.ndarray  # f32[T,N]
 
 
-K_CAND = 8
-
-
-def _round_contention(req, bid, bidding, avail_bid, base_cnt, maxt_bid):
-    """Exact intra-round capacity contention via a [C,C] same-bid matmul.
-
-    For task i, the demand claimed ahead of it is the sum of req over
-    earlier tasks j<i bidding the same node — a lower-triangular same-bid
-    mask times req (MXU work, no [C,N,R] cumsum). Three waves: count all
-    bidders (conservative), recount with only accepted (recovers tasks
-    displaced by rejected bidders), re-validate the merged set.
-    """
-    C = req.shape[0]
-    lower = jnp.arange(C)[:, None] > jnp.arange(C)[None, :]   # j < i
-    same = (bid[:, None] == bid[None, :]) & lower             # [C,C]
-
-    def wave(mask):
-        live = (mask & bidding).astype(req.dtype)             # [C]
-        m = same * live[None, :]
-        cum = m.astype(req.dtype) @ req                       # [C,R]
-        room = jnp.all(req + cum < avail_bid + EPS, axis=-1)
-        cnt = jnp.sum(m, axis=1)
-        pods_room = base_cnt + cnt < maxt_bid
-        return bidding & room & pods_room
-
-    accept = wave(jnp.ones(C, dtype=bool))
-    accept = accept | wave(accept)
-    return wave(accept)
-
-
-def _chunk_step(allocatable, max_tasks, weights):
-    def step(nodes: NodeState, chunk):
-        req, job_ix, valid, feas, static_score = chunk
-        C, R = req.shape
-        N = nodes.idle.shape[0]
-        K = min(K_CAND, N)
-
-        pods_ok = nodes.ntasks < max_tasks                       # [N]
-        # bids are FutureIdle-based (allocate.go:232-256): a task that does
-        # not fit Idle may pipeline onto releasing capacity; alloc-vs-pipe
-        # is split per accepted task below
-        fit = (jnp.all(req[:, None, :] < nodes.future_idle[None] + EPS,
-                       axis=-1) & feas & pods_ok[None])           # [C,N]
-        score = static_score + combined_dynamic_score(
-            req, nodes.used, allocatable, weights)                # [C,N]
-        masked = jnp.where(fit, score, -jnp.inf)
-        cand_score, cand = jax.lax.top_k(masked, K)               # [C,K]
-
-        # K bidding rounds: a task rejected at its r-th choice (node filled
-        # by earlier bidders) falls to its (r+1)-th within the same chunk —
-        # without this, homogeneous tasks herd onto one argmax node and each
-        # chunk pass fills a single node.
-        def round_body(_, st):
-            accept, choice, slot = st
-            bid = jnp.take_along_axis(cand, slot[:, None], 1)[:, 0]
-            bscore = jnp.take_along_axis(cand_score, slot[:, None], 1)[:, 0]
-            bidding = ~accept & valid & (bscore > -jnp.inf)
-            # claimed state = accepted choices so far, by construction
-            claimed_hot = (jax.nn.one_hot(choice, N, dtype=req.dtype)
-                           * accept[:, None])
-            claimed = jnp.einsum("cn,cr->nr", claimed_hot, req)
-            claimed_cnt = jnp.sum(claimed_hot, axis=0)
-            avail_bid = nodes.future_idle[bid] - claimed[bid]
-            base_cnt = nodes.ntasks[bid] + claimed_cnt[bid]
-            acc = _round_contention(req, bid, bidding, avail_bid, base_cnt,
-                                    max_tasks[bid])
-            choice = jnp.where(acc, bid, choice)
-            accept = accept | acc
-            slot = jnp.where(bidding & ~acc,
-                             jnp.minimum(slot + 1, K - 1), slot)
-            return accept, choice, slot
-
-        accept0 = jnp.zeros(C, dtype=bool)
-        choice0 = jnp.zeros(C, dtype=jnp.int32)
-        slot0 = jnp.zeros(C, dtype=jnp.int32)
-        accept, choice, _ = jax.lax.fori_loop(
-            0, K, round_body, (accept0, choice0, slot0))
-
-        placed = jax.nn.one_hot(choice, N, dtype=req.dtype) * accept[:, None]
-
-        # alloc-vs-pipeline split (same construction as parallel/mesh.py):
-        # a task allocates iff it fits Idle after the IDLE consumption of
-        # earlier-in-chunk same-node allocs; iterate the antitone fit map —
-        # an ODD iterate under-approximates the true greedy alloc set, so
-        # deep same-node ties fall safely to pipeline and Idle can never
-        # be oversubscribed (exact for up to 9 same-node contenders)
-        C_lower = jnp.arange(C)[:, None] > jnp.arange(C)[None, :]
-        same_node = (choice[:, None] == choice[None, :]) \
-            & accept[:, None] & accept[None, :] & C_lower
-        idle_bid = nodes.idle[choice]
-
-        def alloc_iter(_, alloc):
-            cum = (same_node * alloc[None, :].astype(req.dtype)) @ req
-            return accept & jnp.all(req + cum < idle_bid + EPS, axis=-1)
-
-        alloc = jax.lax.fori_loop(0, 9, alloc_iter, accept)
-        pipe = accept & ~alloc
-
-        alloc_hot = placed * alloc[:, None].astype(req.dtype)
-        delta_alloc = jnp.einsum("cn,cr->nr", alloc_hot, req)
-        delta_all = jnp.einsum("cn,cr->nr", placed, req)
-        nodes = NodeState(
-            idle=nodes.idle - delta_alloc,
-            future_idle=nodes.future_idle - delta_all,
-            used=nodes.used + delta_alloc,
-            ntasks=nodes.ntasks + jnp.sum(placed, axis=0).astype(jnp.int32))
-        out = jnp.where(accept, choice, NO_NODE).astype(jnp.int32)
-        return nodes, (out, pipe)
-
-    return step
-
-
 def place_blocks(nodes: NodeState, tasks: BlockTasks, jobs: JobMeta,
                  weights: ScoreWeights, allocatable: jnp.ndarray,
                  max_tasks: jnp.ndarray, chunk: int = 256,
@@ -156,81 +54,26 @@ def place_blocks(nodes: NodeState, tasks: BlockTasks, jobs: JobMeta,
                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
                             jnp.ndarray, NodeState]:
     """Place tasks; returns (task_node i32[T], task_pipelined bool[T],
-    job_ready bool[J], job_kept bool[J], nodes).
+    job_ready bool[J], job_kept bool[J], nodes) — device arrays.
 
-    Each sweep runs ``passes`` placement passes — a task rejected in pass k
-    (its chosen node filled up inside the chunk) retries against updated node
-    state in pass k+1 — then one gang check rolls back jobs below
-    minAvailable. Later sweeps let other jobs reuse freed capacity.
+    Each sweep runs up to ``passes`` placement passes — a task rejected in
+    pass k (its chosen node filled up inside the chunk) retries against
+    updated node state in pass k+1 — then one gang check rolls back jobs
+    below minAvailable. Later sweeps let other jobs reuse freed capacity.
+    The unified kernel exits early at the first fixpoint pass/sweep, which
+    is byte-identical to running the full budget (see ops/unified.py).
     """
     T = tasks.req.shape[0]
-    pad = (-T) % chunk
-    if pad:
-        tasks = BlockTasks(
-            req=jnp.pad(tasks.req, ((0, pad), (0, 0))),
-            job_ix=jnp.pad(tasks.job_ix, (0, pad)),
-            valid=jnp.pad(tasks.valid, (0, pad)),
-            feas=jnp.pad(tasks.feas, ((0, pad), (0, 0))),
-            static_score=jnp.pad(tasks.static_score, ((0, pad), (0, 0))))
-    Tp = T + pad
-    n_chunks = Tp // chunk
-
-    def reshape(x):
-        return x.reshape((n_chunks, chunk) + x.shape[1:])
-
     J = jobs.min_available.shape[0]
-    assign = jnp.full(Tp, NO_NODE, dtype=jnp.int32)
-    pipe0 = jnp.zeros(Tp, dtype=bool)
-
-    def place_pass(carry, _):
-        nodes, assign, pipe, job_dead = carry
-        todo = (assign == NO_NODE) & tasks.valid & ~job_dead[tasks.job_ix]
-        xs = (reshape(tasks.req), reshape(tasks.job_ix), reshape(todo),
-              reshape(tasks.feas), reshape(tasks.static_score))
-        nodes, (out, out_pipe) = jax.lax.scan(
-            _chunk_step(allocatable, max_tasks, weights), nodes, xs)
-        fresh = assign == NO_NODE
-        assign = jnp.where(fresh, out.reshape(Tp), assign)
-        pipe = jnp.where(fresh, out_pipe.reshape(Tp), pipe)
-        return (nodes, assign, pipe, job_dead), None
-
-    def sweep(carry, _):
-        (nodes, new_assign, pipe, job_dead), _ = jax.lax.scan(
-            place_pass, carry, jnp.arange(passes))
-
-        # Gang votes + vectorized rollback of non-kept jobs (batched
-        # Statement.Discard): ready counts allocations only; a
-        # merely-pipelined gang is KEPT open (allocate.go:264-270). A
-        # rolled-back job does not retry in later sweeps — the reference
-        # pops each job once and discards for good.
-        placed = new_assign != NO_NODE
-        alloc_cnt = jax.ops.segment_sum((placed & ~pipe).astype(jnp.int32),
-                                        tasks.job_ix, num_segments=J)
-        pipe_cnt = jax.ops.segment_sum((placed & pipe).astype(jnp.int32),
-                                       tasks.job_ix, num_segments=J)
-        ready = alloc_cnt + jobs.base_ready >= jobs.min_available
-        kept = (alloc_cnt + pipe_cnt + jobs.base_ready
-                + jobs.base_pipelined >= jobs.min_available)
-        drop = placed & ~kept[tasks.job_ix]
-        drop_hot = (jax.nn.one_hot(jnp.where(drop, new_assign, 0),
-                                   nodes.idle.shape[0], dtype=tasks.req.dtype)
-                    * drop[:, None])
-        alloc_hot = drop_hot * (~pipe)[:, None].astype(tasks.req.dtype)
-        freed_alloc = jnp.einsum("tn,tr->nr", alloc_hot, tasks.req)
-        freed_all = jnp.einsum("tn,tr->nr", drop_hot, tasks.req)
-        nodes = NodeState(
-            idle=nodes.idle + freed_alloc,
-            future_idle=nodes.future_idle + freed_all,
-            used=nodes.used - freed_alloc,
-            ntasks=nodes.ntasks - jnp.sum(drop_hot, axis=0).astype(jnp.int32))
-        new_assign = jnp.where(drop, NO_NODE, new_assign)
-        job_dead = job_dead | (~kept & (alloc_cnt + pipe_cnt > 0))
-        return (nodes, new_assign, pipe, job_dead), (ready, kept)
-
-    job_dead = jnp.zeros(J, dtype=bool)
-    (nodes, assign, pipe, _), (readies, kepts) = jax.lax.scan(
-        sweep, (nodes, assign, pipe0, job_dead), jnp.arange(sweeps))
-    return assign[:T], pipe[:T], readies[-1], kepts[-1], nodes
+    ms = jnp.where(tasks.feas, tasks.static_score, NEG)
+    packed, out_nodes = place_blocks_unified(
+        None, nodes, tasks.req, tasks.valid, tasks.job_ix, jobs, weights,
+        allocatable, max_tasks, chunk=chunk, sweeps=sweeps, passes=passes,
+        masked_static=ms)
+    Tp = T + (-T) % chunk
+    return (packed[:T], packed[Tp:Tp + T].astype(bool),
+            packed[2 * Tp:2 * Tp + J].astype(bool),
+            packed[2 * Tp + J:2 * Tp + 2 * J].astype(bool), out_nodes)
 
 
 def place_blocks_packed(nodes: NodeState, tasks: BlockTasks, jobs: JobMeta,
@@ -239,10 +82,10 @@ def place_blocks_packed(nodes: NodeState, tasks: BlockTasks, jobs: JobMeta,
                         sweeps: int = 3, passes: int = 3):
     """place_blocks with the place_scan_packed single-fetch layout
     ``[task_node | task_pipelined | job_ready | job_kept]`` (i32, task
-    spans length T, job spans length J). One wire format for both fused
-    solvers means ONE host readback site (allocate._fetch_packed) serves
-    the scan and blocks engines alike; the final NodeState stays on
-    device, never fetched."""
+    spans length T, job spans length J). One wire format for every fused
+    solver means ONE host readback site (allocate._fetch_packed) serves
+    the scan, blocks, and sharded engines alike; the final NodeState
+    stays on device, never fetched."""
     assign, pipe, ready, kept, nodes = place_blocks(
         nodes, tasks, jobs, weights, allocatable, max_tasks,
         chunk=chunk, sweeps=sweeps, passes=passes)

@@ -92,13 +92,24 @@ def make_node_state(idle, releasing, pipelined, used, ntasks) -> NodeState:
 
 def place_scan(nodes: NodeState, tasks: PlacementTasks, jobs: JobMeta,
                weights: ScoreWeights, allocatable: jnp.ndarray,
-               max_tasks: jnp.ndarray, unroll: int = 8) -> PlacementResult:
+               max_tasks: jnp.ndarray, unroll: int = 8,
+               axis=None, shard_offset=None) -> PlacementResult:
     """Run the sequential-parity placement over all tasks.
 
     allocatable: f32[N,R]; max_tasks: i32[N] (pod-count capacity; the
     reference checks it first in the predicate chain, predicates.go:267-290).
     unroll amortizes the TPU while-loop per-iteration overhead over several
     task steps without changing sequential semantics.
+
+    ``axis``/``shard_offset`` make the same kernel run node-sharded inside
+    a shard_map (ops/unified.place_scan_unified): per-node arrays are the
+    local shards, the per-step argmax is resolved by one all_gather of
+    per-shard (score, global index, fit) maxima with ties falling to the
+    lowest shard — i.e. the lowest global node index, exactly the
+    single-device ``jnp.argmax`` tie-break — and node deltas apply on the
+    owning shard only. With ``axis=None`` (the default) the program below
+    is literally the unsharded original; task_node indices are global
+    either way, so decisions are byte-identical at every mesh size.
     """
     J = jobs.min_available.shape[0]
 
@@ -118,7 +129,11 @@ def place_scan(nodes: NodeState, tasks: PlacementTasks, jobs: JobMeta,
         pods_ok = tent.ntasks < max_tasks
         fit_future = le_all(req[None, :], tent.future_idle) & feas & pods_ok
         fit_idle = le_all(req[None, :], tent.idle) & fit_future
-        has_node = jnp.any(fit_future)
+        if axis is None:
+            has_node = jnp.any(fit_future)
+        else:
+            has_node = jax.lax.psum(
+                jnp.any(fit_future).astype(jnp.int32), axis) > 0
 
         # Reference breaks out of the job's task loop when no node passes
         # predicates (allocate.go:206-210).
@@ -129,13 +144,33 @@ def place_scan(nodes: NodeState, tasks: PlacementTasks, jobs: JobMeta,
             req, tent.used, allocatable, weights)
         # Prefer feasible nodes; among them argmax score, lowest index on tie.
         masked = jnp.where(fit_future, score, -jnp.inf)
-        best = jnp.argmax(masked)
+        if axis is None:
+            best = jnp.argmax(masked)
+            fit_idle_best = fit_idle[best]
+        else:
+            lbest = jnp.argmax(masked)
+            g_score = jax.lax.all_gather(masked[lbest], axis)       # [D]
+            g_idx = jax.lax.all_gather(lbest + shard_offset, axis)
+            g_fit = jax.lax.all_gather(fit_idle[lbest], axis)
+            # argmax over shards: first max wins = lowest shard = lowest
+            # global index (per-shard argmax already picked the lowest
+            # local index), so ties resolve exactly as unsharded
+            w = jnp.argmax(g_score)
+            best = g_idx[w]
+            fit_idle_best = g_fit[w]
 
         do_place = attempt & has_node
-        do_alloc = do_place & fit_idle[best]
-        do_pipe = do_place & ~fit_idle[best]
+        do_alloc = do_place & fit_idle_best
+        do_pipe = do_place & ~fit_idle_best
 
-        onehot = (jnp.arange(tent.idle.shape[0]) == best)[:, None]  # [N,1]
+        if axis is None:
+            onehot = (jnp.arange(tent.idle.shape[0])
+                      == best)[:, None]                             # [N,1]
+        else:
+            # global comparison doubles as the owner-shard mask: the
+            # one-hot is all-False on every non-owning shard
+            onehot = ((jnp.arange(tent.idle.shape[0]) + shard_offset)
+                      == best)[:, None]                             # [Nl,1]
         delta = onehot * req[None, :]
         new_idle = tent.idle - jnp.where(do_alloc, delta, 0.0)
         new_used = tent.used + jnp.where(do_alloc, delta, 0.0)
@@ -185,14 +220,15 @@ def place_scan(nodes: NodeState, tasks: PlacementTasks, jobs: JobMeta,
 
 def place_scan_packed(nodes: NodeState, tasks: PlacementTasks, jobs: JobMeta,
                       weights: ScoreWeights, allocatable: jnp.ndarray,
-                      max_tasks: jnp.ndarray, unroll: int = 8):
+                      max_tasks: jnp.ndarray, unroll: int = 8,
+                      axis=None, shard_offset=None):
     """place_scan with all host-bound outputs packed into ONE i32 vector
     ``[task_node | task_pipelined | job_ready | job_kept]`` — a single
     device→host fetch. On tunneled backends every fetch costs a full RTT
     (~60ms measured), so result packing matters more than kernel time.
     The final NodeState is returned as device arrays (never fetched)."""
     res = place_scan(nodes, tasks, jobs, weights, allocatable, max_tasks,
-                     unroll=unroll)
+                     unroll=unroll, axis=axis, shard_offset=shard_offset)
     packed = jnp.concatenate([
         res.task_node,
         res.task_pipelined.astype(jnp.int32),

@@ -1,0 +1,592 @@
+"""One shard_map-partitioned placement solver — the device kernel behind
+every allocate engine.
+
+Before this module the repo carried four divergent solve paths (scan,
+strict, blocks, sharded) with two wire layouts and per-path readback
+sites. They are now ONE partitioned solver with two *mode* kernels over
+one packed single-fetch layout ``[task_node | pipelined | ready | kept]``:
+
+- mode **blocks**: the chunked block-greedy kernel (the throughput path,
+  ops/auction.py semantics) — top-K candidate bidding per chunk, exact
+  capacity contention, gang rollback sweeps;
+- mode **scan**: the sequential-parity kernel (ops/place.py semantics) —
+  the reference's task-by-task loop, also what the strict engine batches.
+
+Both kernels run unsharded (``mesh=None``) or node-sharded over a 1-D
+device mesh (axis ``NODE_AXIS``): the node axis is partitioned across
+the mesh, the task/job axes are replicated, and per-node state updates
+are shard-local. Decisions are **mesh-size invariant by construction**:
+
+- candidate merging keeps the *global* top-K in global-index tie order
+  (per-shard stable top-k → shard-major flat concat → stable top-k, so
+  equal scores resolve to the lowest global index, exactly what a
+  single-device ``top_k``/``argmax`` over the full node axis picks);
+- the number of contention rounds is ``min(K_CAND, N_global)`` — a
+  *global* quantity, not the per-shard one (the old parallel/mesh.py
+  kernel used the local shard size here, which is why it could diverge
+  from the single-device oracle on small shards);
+- accept verdicts are psums over disjoint owner shards (exact), and all
+  remaining arithmetic is element-wise over shard-local rows.
+
+So the 8-device solve is byte-identical to the single-device oracle
+(tests/test_unified.py), and ``mesh=None`` vs a 1-device mesh are the
+same program modulo the shard_map wrapper — the engine drops the wrapper
+at D == 1 to skip its dispatch overhead.
+
+The blocks kernel's sweep/pass budgets are *runtime* scalars driven by a
+``lax.while_loop`` with fixed-point early exit: a pass that places
+nothing (or a sweep that changes no assignment and kills no job) is a
+fixpoint, so exiting early is byte-identical to running the full budget.
+This is the 20k-crossover fix: at steady state most of the former
+``sweeps x passes`` grid was re-scoring an unchanged cluster, and on
+sharded meshes every wasted pass paid cross-shard gather/argmax traffic.
+
+All collectives ride ICI inside one jit program; nothing touches the
+host between chunks, and the packed result is fetched by the caller at a
+single site (allocate._fetch_packed).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .dense import EPS
+from .pallas_place import NEG, NEG_TEST
+from .place import (NO_NODE, JobMeta, NodeState, PlacementTasks,
+                    place_scan_packed)
+from .scores import ScoreWeights, combined_dynamic_score
+
+NODE_AXIS = "nodes"
+
+# Candidate-list width of the blocks kernel's bidding rounds. The round
+# count is min(K_CAND, N_global) — global, so it cannot depend on how
+# the node axis happens to be partitioned. 32, not 8: the dynamic
+# scorers rank nodes near-identically for same-shaped tasks, so a
+# narrow candidate list makes every task in a chunk fight over the same
+# few nodes — at 20k/5k a K=8 first pass lands only ~27% of tasks and
+# the rest re-bid in later full-price passes (measured 16s -> 7s at
+# K=32, same full packing). Rounds beyond the last productive one cost
+# nothing: the round loop exits at its fixpoint.
+K_CAND = 32
+
+_MESH_CACHE: dict = {}
+
+
+def make_mesh(devices=None, axis: str = NODE_AXIS) -> Mesh:
+    """1-D device mesh over ``axis``, cached per device set. Mesh
+    construction is not free (it hashes the device list and builds the
+    sharding machinery); the preempt/allocate hot paths call this every
+    phase, so the cache is what keeps the sharded engines from paying it
+    per cycle."""
+    devices = tuple(devices) if devices is not None else tuple(jax.devices())
+    key = (tuple(d.id for d in devices), axis)
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        mesh = Mesh(np.asarray(devices), (axis,))
+        _MESH_CACHE[key] = mesh
+    return mesh
+
+
+def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+    """shard_map across jax releases: ``jax.shard_map(..., check_vma=)`` on
+    new jax, ``jax.experimental.shard_map.shard_map(..., check_rep=)``
+    before the promotion. Without this shim the whole multi-chip engine
+    family dies with an AttributeError on one side of the move — a
+    toolchain-version fault, not a scheduling fault, so it is absorbed
+    here instead of crashing the cycle (docs/robustness.md)."""
+    import inspect
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    # the replication/VMA check must stay OFF (the solvers' out_specs are
+    # not provably replicated), under whichever keyword this jax spells
+    # it. Probe the signature rather than catching TypeError — a genuine
+    # TypeError from shard_map's own argument validation must surface as
+    # itself, not as a bogus incompatibility retry.
+    params = inspect.signature(sm).parameters
+    if "check_vma" in params:
+        kw = {"check_vma": False}
+    elif "check_rep" in params:
+        kw = {"check_rep": False}
+    else:
+        raise TypeError(
+            "installed jax's shard_map accepts neither check_vma nor "
+            "check_rep; cannot disable the replication check the sharded "
+            "solvers require")
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+# --- degenerate collectives -------------------------------------------------
+# axis=None means the solver runs unsharded: every collective collapses to
+# the identity so one kernel body serves both deployments.
+
+def _axis_index(axis):
+    return 0 if axis is None else jax.lax.axis_index(axis)
+
+
+def _all_gather(x, axis):
+    return x[None] if axis is None else jax.lax.all_gather(x, axis)
+
+
+def _any_shard(x, axis):
+    """bool[...] -> "true on any shard" (identity unsharded; psum of
+    disjoint owner verdicts sharded)."""
+    return x if axis is None else jax.lax.psum(x.astype(jnp.int32), axis) > 0
+
+
+def _chunk_step(axis: Optional[str], has_ms: bool):
+    """One blocks-mode chunk over (possibly node-sharded) state. All array
+    args are the per-device shards when ``axis`` is set, the full arrays
+    otherwise.
+
+    Top-K bidding: every shard offers its local top-K candidates, one
+    all_gather merges them into the exact global top-K per task, then
+    ``min(K_CAND, N_global)`` contention rounds let a task rejected at
+    its r-th choice fall to its (r+1)-th. Contention for a node is
+    resolved on the shard that owns it; one psum per round merges accept
+    verdicts."""
+
+    def step(carry, chunk, *, allocatable, max_tasks, weights, shard_offset):
+        nodes: NodeState = carry
+        if has_ms:
+            req, valid, ms = chunk          # req/valid replicated, ms sharded
+        else:
+            req, valid = chunk
+            ms = None
+        C, R = req.shape
+        Nl = nodes.idle.shape[0]                            # local shard size
+        K_loc = min(K_CAND, Nl)
+
+        pods_ok = nodes.ntasks < max_tasks
+        # bid eligibility is FutureIdle-based (allocate.go:232-256): a task
+        # that does not fit Idle may still pipeline onto releasing capacity;
+        # the alloc-vs-pipeline split is resolved per accepted task below
+        fit = (jnp.all(req[:, None, :] < nodes.future_idle[None] + EPS,
+                       axis=-1) & pods_ok[None])              # [C,Nl]
+        score = combined_dynamic_score(req, nodes.used, allocatable, weights)
+        if ms is not None:
+            fit = fit & (ms > NEG_TEST)
+            score = score + ms
+        masked = jnp.where(fit, score, -jnp.inf)
+        lscore, lidx = jax.lax.top_k(masked, K_loc)          # [C,K_loc] local
+        gidx = lidx + shard_offset
+
+        # merge every shard's candidates into the global per-task top-K:
+        # one gather of [D,C,K_loc] scores + ids across the mesh. The flat
+        # concat is shard-major, and per-shard top_k is stable, so equal
+        # scores sit in global-index order and the merged stable top_k
+        # keeps exactly the candidates (and tie order) a single-device
+        # top_k over the full node axis would — mesh-size invariance.
+        all_s = jax.lax.all_gather(lscore, axis) if axis is not None \
+            else lscore[None]
+        all_i = jax.lax.all_gather(gidx, axis) if axis is not None \
+            else gidx[None]
+        D = all_s.shape[0]
+        K = min(K_CAND, Nl * D)                              # global K
+        flat_s = jnp.moveaxis(all_s, 0, 1).reshape(C, D * K_loc)
+        flat_i = jnp.moveaxis(all_i, 0, 1).reshape(C, D * K_loc)
+        cand_score, pos = jax.lax.top_k(flat_s, K)           # [C,K] global
+        cand = jnp.take_along_axis(flat_i, pos, axis=1)
+
+        lower = jnp.arange(C)[:, None] > jnp.arange(C)[None, :]
+
+        def round_body(st):
+            _r, _, accept, choice_g, slot = st
+            st_in = (accept, choice_g, slot)
+            bid_g = jnp.take_along_axis(cand, slot[:, None], 1)[:, 0]
+            bscore = jnp.take_along_axis(cand_score, slot[:, None], 1)[:, 0]
+            bidding = ~accept & valid & (bscore > -jnp.inf)
+            local = (bid_g >= shard_offset) & (bid_g < shard_offset + Nl)
+            bid_l = jnp.clip(bid_g - shard_offset, 0, Nl - 1)
+            bidding_l = bidding & local
+
+            # claimed capacity on this shard from earlier-round accepts
+            choice_l = jnp.clip(choice_g - shard_offset, 0, Nl - 1)
+            acc_l = (accept & (choice_g >= shard_offset)
+                     & (choice_g < shard_offset + Nl))
+            claimed_hot = (jax.nn.one_hot(choice_l, Nl, dtype=req.dtype)
+                           * acc_l[:, None])
+            claimed = jnp.einsum("cn,cr->nr", claimed_hot, req)
+            claimed_cnt = jnp.sum(claimed_hot, axis=0)
+            avail_bid = nodes.future_idle[bid_l] - claimed[bid_l]
+            base_cnt = nodes.ntasks[bid_l] + claimed_cnt[bid_l]
+            maxt_bid = max_tasks[bid_l]
+
+            same = (bid_l[:, None] == bid_l[None, :]) & lower
+
+            def wave(mask):
+                live = (mask & bidding_l).astype(req.dtype)
+                m = same * live[None, :]
+                cum = m.astype(req.dtype) @ req
+                room = jnp.all(req + cum < avail_bid + EPS, axis=-1)
+                cnt = jnp.sum(m, axis=1)
+                return bidding_l & room & (base_cnt + cnt < maxt_bid)
+
+            acc = wave(jnp.ones(C, dtype=bool))
+            acc = acc | wave(acc)
+            acc = wave(acc)
+            # each bid node is owned by exactly one shard: psum broadcasts
+            # the owner's verdict to everyone
+            acc_any = _any_shard(acc, axis)
+            choice_g = jnp.where(acc_any, bid_g, choice_g)
+            accept = accept | acc_any
+            slot = jnp.where(bidding & ~acc_any,
+                             jnp.minimum(slot + 1, K - 1), slot)
+            # fixpoint: a round that accepted nothing and advanced no
+            # slot leaves the next round with identical inputs (claims
+            # only grow with accepts), so every later round is the
+            # identity — exiting early is byte-identical to running all
+            # K rounds. All three fields are replicated, so the exit is
+            # uniform across shards.
+            changed = (jnp.any(accept != st_in[0])
+                       | jnp.any(choice_g != st_in[1])
+                       | jnp.any(slot != st_in[2]))
+            return _r + 1, changed, accept, choice_g, slot
+
+        accept0 = jnp.zeros(C, dtype=bool)
+        choice0 = jnp.full(C, -1, dtype=jnp.int32)
+        slot0 = jnp.zeros(C, dtype=jnp.int32)
+        _, _, accept, choice_g, _ = jax.lax.while_loop(
+            lambda st: (st[0] < K) & st[1], round_body,
+            (jnp.int32(0), jnp.bool_(True), accept0, choice0, slot0))
+
+        # apply deltas on the owning shard
+        mine = (accept & (choice_g >= shard_offset)
+                & (choice_g < shard_offset + Nl))
+        choice_l = jnp.clip(choice_g - shard_offset, 0, Nl - 1)
+        placed = jax.nn.one_hot(choice_l, Nl, dtype=req.dtype) * mine[:, None]
+
+        # alloc-vs-pipeline split (allocate.go:232-256 / ops/place.py:119):
+        # within the chunk, a task allocates iff it fits the node's Idle
+        # after the IDLE consumption of earlier-in-chunk allocs on the same
+        # node — pipelined neighbors consume FutureIdle only. Earlier alloc
+        # membership is itself the unknown; iterate the antitone fit map F:
+        # after t applications the first t same-node tasks carry their
+        # exact sequential value, and an ODD iterate is a SUBSET of the
+        # true greedy alloc set (S0=all ⊇ true ⇒ S1=F(S0) ⊆ F(true)=true,
+        # alternating), so any task still undecided at depth >9 falls on
+        # the safe side — pipelined, consuming only the FutureIdle room its
+        # acceptance already validated. Idle can never be oversubscribed.
+        same_node = (choice_l[:, None] == choice_l[None, :]) \
+            & mine[:, None] & mine[None, :] & lower
+        idle_bid = nodes.idle[choice_l]
+
+        def alloc_iter(_, alloc):
+            cum = (same_node * alloc[None, :].astype(req.dtype)) @ req
+            return mine & jnp.all(req + cum < idle_bid + EPS, axis=-1)
+
+        alloc = jax.lax.fori_loop(0, 9, alloc_iter, mine)
+        # one psum so every shard sees the global pipelined verdict
+        alloc_any = _any_shard(alloc, axis)
+        pipe = accept & ~alloc_any
+
+        alloc_hot = placed * alloc[:, None].astype(req.dtype)
+        delta_alloc = jnp.einsum("cn,cr->nr", alloc_hot, req)
+        delta_all = jnp.einsum("cn,cr->nr", placed, req)
+        nodes = NodeState(
+            idle=nodes.idle - delta_alloc,
+            future_idle=nodes.future_idle - delta_all,
+            used=nodes.used + delta_alloc,
+            ntasks=nodes.ntasks + jnp.sum(placed, axis=0).astype(jnp.int32))
+
+        out = jnp.where(accept, choice_g, NO_NODE).astype(jnp.int32)
+        return nodes, (out, pipe)
+
+    return step
+
+
+def _make_blocks_solve(axis: Optional[str], has_ms: bool, chunk: int):
+    """The blocks-mode solve body. Runs whole-array when ``axis`` is None,
+    per-shard inside shard_map otherwise. ``sweeps``/``passes`` are traced
+    i32 budget caps: a ``lax.while_loop`` runs up to the cap but exits at
+    the first fixpoint pass/sweep — byte-identical to running the full
+    budget (an unchanged pass implies every later pass is the identity),
+    and one compiled program serves every budget."""
+
+    def solve(nodes, allocatable, max_tasks, req, valid, job_ix, jobs,
+              weights, sweeps, passes, *maybe_ms):
+        Tp = req.shape[0]
+        n_chunks = Tp // chunk
+        Nl = allocatable.shape[0]
+        J = jobs.min_available.shape[0]
+        shard_offset = _axis_index(axis) * Nl
+        step = partial(_chunk_step(axis, has_ms),
+                       allocatable=allocatable, max_tasks=max_tasks,
+                       weights=weights, shard_offset=shard_offset)
+        ms = maybe_ms[0] if has_ms else None
+
+        assign0 = jnp.full(Tp, NO_NODE, dtype=jnp.int32)
+        pipe0 = jnp.zeros(Tp, dtype=bool)
+
+        def todo_of(assign, job_dead):
+            return (assign == NO_NODE) & valid & ~job_dead[job_ix]
+
+        # a chunk whose todo rows are all False is the IDENTITY (nothing
+        # bids, deltas are exact zeros, every row comes back NO_NODE), so
+        # skipping it is byte-identical — and it is what makes the
+        # fixpoint-confirmation passes ~free: on a fully-packed cluster
+        # the straggler pass and every later sweep's re-check pay only
+        # the chunks that still hold unplaced tasks, not a full [T,N]
+        # re-score. The predicate is replicated (assign/valid/job_ix are),
+        # so the cond is uniform across shards.
+        def guarded_step(carry, chunk_xs):
+            todo_c = chunk_xs[1]
+            skip_out = (jnp.full(todo_c.shape[0], NO_NODE, dtype=jnp.int32),
+                        jnp.zeros(todo_c.shape[0], dtype=bool))
+            return jax.lax.cond(
+                jnp.any(todo_c),
+                lambda c: step(c, chunk_xs),
+                lambda c: (c, skip_out),
+                carry)
+
+        def one_pass(nodes, assign, pipe, job_dead):
+            todo = todo_of(assign, job_dead)
+            xs = (req.reshape(n_chunks, chunk, -1),
+                  todo.reshape(n_chunks, chunk))
+            if has_ms:
+                xs = xs + (ms.reshape(n_chunks, chunk, Nl),)
+            nodes, (out, out_pipe) = jax.lax.scan(guarded_step, nodes, xs)
+            fresh = assign == NO_NODE
+            assign = jnp.where(fresh, out.reshape(Tp), assign)
+            pipe = jnp.where(fresh, out_pipe.reshape(Tp), pipe)
+            return nodes, assign, pipe
+
+        def pass_cond(st):
+            k, changed = st[0], st[1]
+            return (k < passes) & changed
+
+        def pass_body(st):
+            k, _, nodes, assign, pipe, job_dead = st
+            nodes, assign2, pipe2 = one_pass(nodes, assign, pipe, job_dead)
+            # a pass that assigned nothing left nodes/pipe untouched too
+            # (pipe only changes where a fresh assignment landed) — the
+            # next pass would see identical inputs: fixpoint, exit early.
+            # Likewise a pass that emptied todo: later passes have no
+            # bidders, i.e. are the identity, so exit without paying one
+            changed = (jnp.any(assign2 != assign)
+                       & jnp.any(todo_of(assign2, job_dead)))
+            return k + 1, changed, nodes, assign2, pipe2, job_dead
+
+        def sweep_cond(st):
+            s, changed = st[0], st[1]
+            return (s < sweeps) & changed
+
+        def sweep_body(st):
+            s, _, nodes, assign, pipe, job_dead, _, _ = st
+            assign_in, dead_in = assign, job_dead
+            # seed with any(todo), not True: a re-sweep over a cluster
+            # with nothing left to place runs ZERO passes (the gang
+            # re-check below is all this sweep needs)
+            _, _, nodes, assign, pipe, job_dead = jax.lax.while_loop(
+                pass_cond,
+                pass_body,
+                (jnp.int32(0), jnp.any(todo_of(assign, job_dead)), nodes,
+                 assign, pipe, job_dead))
+
+            placed = assign != NO_NODE
+            alloc_cnt = jax.ops.segment_sum(
+                (placed & ~pipe).astype(jnp.int32), job_ix, num_segments=J)
+            pipe_cnt = jax.ops.segment_sum(
+                (placed & pipe).astype(jnp.int32), job_ix, num_segments=J)
+            # gang votes (gang.go:45-216): ready counts allocations only;
+            # a merely-pipelined gang is KEPT (allocate.go:264-270 commits
+            # ready jobs, keeps pipelined ones open)
+            ready = alloc_cnt + jobs.base_ready >= jobs.min_available
+            kept = (alloc_cnt + pipe_cnt + jobs.base_ready
+                    + jobs.base_pipelined >= jobs.min_available)
+            drop = placed & ~kept[job_ix]
+            # free dropped demand on the owning shard (alloc'd drops free
+            # Idle too; pipelined drops only reserved future capacity)
+            local = (assign >= shard_offset) & (assign < shard_offset + Nl) \
+                & drop
+            drop_hot = (jax.nn.one_hot(
+                jnp.where(local, assign - shard_offset, 0), Nl,
+                dtype=req.dtype) * local[:, None])
+            alloc_hot = drop_hot * (~pipe)[:, None].astype(req.dtype)
+            freed_alloc = jnp.einsum("tn,tr->nr", alloc_hot, req)
+            freed_all = jnp.einsum("tn,tr->nr", drop_hot, req)
+            nodes = NodeState(
+                idle=nodes.idle + freed_alloc,
+                future_idle=nodes.future_idle + freed_all,
+                used=nodes.used - freed_alloc,
+                ntasks=nodes.ntasks
+                - jnp.sum(drop_hot, axis=0).astype(jnp.int32))
+            assign = jnp.where(drop, NO_NODE, assign)
+            job_dead = job_dead | (~kept & (alloc_cnt + pipe_cnt > 0))
+            # a sweep that changed no assignment and killed no job is a
+            # fixpoint: every later sweep reproduces this ready/kept
+            changed = (jnp.any(assign != assign_in)
+                       | jnp.any(job_dead != dead_in))
+            return s + 1, changed, nodes, assign, pipe, job_dead, ready, kept
+
+        _, _, nodes, assign, pipe, _, ready, kept = jax.lax.while_loop(
+            sweep_cond, sweep_body,
+            (jnp.int32(0), jnp.bool_(True), nodes, assign0, pipe0,
+             jnp.zeros(J, dtype=bool), jnp.zeros(J, dtype=bool),
+             jnp.zeros(J, dtype=bool)))
+        # pack (assign, pipe, ready, kept) in one i32 row: one host fetch
+        packed = jnp.concatenate([assign, pipe.astype(jnp.int32),
+                                  ready.astype(jnp.int32),
+                                  kept.astype(jnp.int32)])
+        return packed, nodes
+
+    return solve
+
+
+_SOLVER_CACHE: dict = {}
+
+
+def _blocks_solver(mesh: Optional[Mesh], chunk: int, has_ms: bool):
+    """Compiled blocks-mode solve, cached per (mesh, chunk, has_ms).
+    jobs/weights/budgets are runtime args (re-tracing per cycle or per
+    budget tier would pay a multi-second compile)."""
+    key = ("blocks",
+           None if mesh is None else tuple(d.id for d in mesh.devices.flat),
+           chunk, has_ms)
+    fn = _SOLVER_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    axis = None if mesh is None else NODE_AXIS
+    solve = _make_blocks_solve(axis, has_ms, chunk)
+    if mesh is not None:
+        node_sharded = P(NODE_AXIS)
+        repl = P()
+        in_specs = [NodeState(*(node_sharded,) * 4), node_sharded,
+                    node_sharded, repl, repl, repl,
+                    JobMeta(repl, repl, repl),
+                    ScoreWeights(repl, repl, repl, repl, repl), repl, repl]
+        if has_ms:
+            in_specs.append(P(None, NODE_AXIS))
+        solve = shard_map_compat(
+            solve, mesh=mesh, in_specs=tuple(in_specs),
+            out_specs=(repl, NodeState(*(node_sharded,) * 4)))
+    fn = jax.jit(solve)
+    _SOLVER_CACHE[key] = fn
+    return fn
+
+
+def padded_task_len(T: int, chunk: int = 256) -> int:
+    """Padded task-axis length of the blocks-mode packed layout."""
+    return T + (-T) % chunk
+
+
+def bucket_nodes_for_mesh(n: int, d: int) -> int:
+    """Node-axis length after padding to a multiple of the mesh size.
+    Callers pad with zero-capacity nodes (max_tasks 0), which the fit
+    predicate can never select — inert by construction, so the padded
+    solve is byte-identical to the unpadded one."""
+    return n + (-n) % d
+
+
+def place_blocks_unified(mesh: Optional[Mesh], nodes: NodeState,
+                         req: jnp.ndarray, valid: jnp.ndarray,
+                         job_ix: jnp.ndarray, jobs: JobMeta,
+                         weights: ScoreWeights, allocatable: jnp.ndarray,
+                         max_tasks: jnp.ndarray, chunk: int = 256,
+                         sweeps: int = 3, passes: int = 3,
+                         masked_static: Optional[jnp.ndarray] = None,
+                         ) -> Tuple[jnp.ndarray, NodeState]:
+    """Blocks-mode placement, unsharded (``mesh=None``) or node-sharded.
+
+    nodes/allocatable/max_tasks are (shard-)resident on the node axis;
+    tasks (req/valid/job_ix) and JobMeta are replicated; ``masked_static``
+    (optional f32[T,N], NEG where statically infeasible) is sharded on
+    its node axis. Returns ``(packed, nodes)`` with BOTH left on device —
+    ``packed`` is the i32 single-fetch layout
+    ``[task_node | pipelined | ready | kept]`` with task spans of length
+    ``padded_task_len(T, chunk)``; the caller fetches it at ONE site
+    (allocate._fetch_packed). N must be divisible by the mesh size (pad
+    with zero-capacity nodes). A 1-device mesh is collapsed to
+    ``mesh=None`` — the kernel is mesh-size invariant, so this only skips
+    the shard_map dispatch overhead, never changes a decision."""
+    if mesh is not None and int(mesh.devices.size) == 1:
+        mesh = None
+    D = 1 if mesh is None else int(mesh.devices.size)
+    N = allocatable.shape[0]
+    assert N == bucket_nodes_for_mesh(N, D), \
+        f"node count {N} not divisible by mesh size {D}"
+    T = req.shape[0]
+    pad = (-T) % chunk
+    if pad:
+        req = jnp.pad(req, ((0, pad), (0, 0)))
+        valid = jnp.pad(valid, (0, pad))
+        job_ix = jnp.pad(job_ix, (0, pad))
+        if masked_static is not None:
+            masked_static = jnp.pad(masked_static, ((0, pad), (0, 0)),
+                                    constant_values=NEG)
+
+    fn = _blocks_solver(mesh, chunk, masked_static is not None)
+    args = [nodes, allocatable, max_tasks, req, valid, job_ix, jobs,
+            weights, jnp.int32(sweeps), jnp.int32(passes)]
+    if masked_static is not None:
+        args.append(masked_static)
+    return fn(*args)
+
+
+def _scan_solver(mesh: Mesh):
+    """Compiled node-sharded scan-mode solve for this mesh: the exact
+    sequential kernel (ops/place.place_scan) with its per-step argmax
+    resolved by one all_gather of per-shard (score, index, fit) maxima —
+    ties fall to the lowest shard, i.e. the lowest global node index,
+    matching the single-device ``jnp.argmax``."""
+    key = ("scan", tuple(d.id for d in mesh.devices.flat))
+    fn = _SOLVER_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    node_sharded = P(NODE_AXIS)
+    repl = P()
+    tasks_spec = PlacementTasks(
+        req=repl, job_ix=repl, valid=repl,
+        feas=P(None, NODE_AXIS), static_score=P(None, NODE_AXIS),
+        first_of_job=repl, last_of_job=repl)
+    in_specs = (NodeState(*(node_sharded,) * 4), tasks_spec,
+                JobMeta(repl, repl, repl),
+                ScoreWeights(repl, repl, repl, repl, repl),
+                node_sharded, node_sharded)
+
+    @partial(shard_map_compat, mesh=mesh, in_specs=in_specs,
+             out_specs=(repl, NodeState(*(node_sharded,) * 4)))
+    def solve(nodes, tasks, jobs, weights, allocatable, max_tasks):
+        Nl = allocatable.shape[0]
+        offset = jax.lax.axis_index(NODE_AXIS) * Nl
+        return place_scan_packed(nodes, tasks, jobs, weights, allocatable,
+                                 max_tasks, axis=NODE_AXIS,
+                                 shard_offset=offset)
+
+    fn = jax.jit(solve)
+    _SOLVER_CACHE[key] = fn
+    return fn
+
+
+def place_scan_unified(mesh: Optional[Mesh], nodes: NodeState,
+                       tasks: PlacementTasks, jobs: JobMeta,
+                       weights: ScoreWeights, allocatable: jnp.ndarray,
+                       max_tasks: jnp.ndarray):
+    """Scan-mode placement over ``mesh`` (or unsharded when None / one
+    device), packed single-fetch layout, everything left on device. N
+    must be divisible by the mesh size; decisions are byte-identical to
+    the single-device ``place_scan_packed`` at every mesh size."""
+    if mesh is not None and int(mesh.devices.size) == 1:
+        mesh = None
+    if mesh is None:
+        key = ("scan", None)
+        fn = _SOLVER_CACHE.get(key)
+        if fn is None:
+            fn = jax.jit(place_scan_packed)
+            _SOLVER_CACHE[key] = fn
+        return fn(nodes, tasks, jobs, weights, allocatable, max_tasks)
+    D = int(mesh.devices.size)
+    N = allocatable.shape[0]
+    assert N == bucket_nodes_for_mesh(N, D), \
+        f"node count {N} not divisible by mesh size {D}"
+    return _scan_solver(mesh)(nodes, tasks, jobs, weights, allocatable,
+                              max_tasks)

@@ -1,357 +1,49 @@
-"""Multi-chip scaling: shard the placement solve over a device mesh.
+"""Multi-chip scaling — compatibility shim.
 
-SURVEY.md §5.7: the reference's "long axis" analogue is the node axis (2k →
-tens of k) and the pending-task axis (10k+). This module shards the
-block-greedy solver (ops/auction.py) over the NODE axis with ``shard_map`` —
-each device owns a node shard and scores every task chunk against it; the
-global best node per task is resolved with one ``all_gather`` of per-shard
-(score, index) maxima per chunk (the structural cousin of a ring-attention
-step: local compute + a small collective across the ring). Gang admission is
-a ``psum`` of per-job placement counts.
-
-All collectives ride ICI inside one jit program; nothing touches the host
-between chunks. The compiled solver is cached per (mesh, chunk, sweeps) with
-job metadata and score weights as runtime arguments, so a scheduler calling
-it every cycle pays one compile per shape bucket, not per cycle; the
-(assign, pipelined, ready, kept) results come back in ONE packed
-device->host fetch (tunnel RTT dominates payload size on remote TPU
-backends).
+The node-sharded solver that used to live here was unified with the
+single-device blocks/scan kernels into ops/unified.py: ONE
+shard_map-partitioned solver (nodes axis sharded, jobs axis replicated)
+whose packed single-fetch wire layout and mesh-size-invariant decisions
+serve every allocate engine. This module re-exports the mesh plumbing
+(`NODE_AXIS`, `make_mesh`, `shard_map_compat`) for its existing importers
+(ops/evict.py, actions/evict_tpu.py) plus an unpacking
+``place_blocks_sharded`` wrapper for the dryrun/test callers of the old
+5-tuple contract; new code should import from volcano_tpu.ops.unified
+directly.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
-import jax
 import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.auction import K_CAND
-from ..ops.dense import EPS
-from ..ops.pallas_place import NEG, NEG_TEST
-from ..ops.place import NO_NODE, JobMeta, NodeState
-from ..ops.scores import ScoreWeights, combined_dynamic_score
+from ..ops.place import NodeState
+from ..ops.unified import (  # noqa: F401
+    NODE_AXIS, make_mesh, padded_task_len, place_blocks_unified,
+    place_scan_unified, shard_map_compat)
 
-NODE_AXIS = "nodes"
+__all__ = ["NODE_AXIS", "make_mesh", "place_blocks_sharded",
+           "place_blocks_unified", "place_scan_unified", "shard_map_compat"]
 
 
-def make_mesh(devices=None, axis: str = NODE_AXIS) -> Mesh:
-    devices = devices if devices is not None else jax.devices()
-    return Mesh(np.asarray(devices), (axis,))
-
-
-def shard_map_compat(fn, *, mesh, in_specs, out_specs):
-    """shard_map across jax releases: ``jax.shard_map(..., check_vma=)`` on
-    new jax, ``jax.experimental.shard_map.shard_map(..., check_rep=)``
-    before the promotion. Without this shim the whole multi-chip engine
-    family dies with an AttributeError on one side of the move — a
-    toolchain-version fault, not a scheduling fault, so it is absorbed
-    here instead of crashing the cycle (docs/robustness.md)."""
-    import inspect
-
-    sm = getattr(jax, "shard_map", None)
-    if sm is None:
-        from jax.experimental.shard_map import shard_map as sm
-    # the replication/VMA check must stay OFF (the solvers' out_specs are
-    # not provably replicated), under whichever keyword this jax spells
-    # it. Probe the signature rather than catching TypeError — a genuine
-    # TypeError from shard_map's own argument validation must surface as
-    # itself, not as a bogus incompatibility retry.
-    params = inspect.signature(sm).parameters
-    if "check_vma" in params:
-        kw = {"check_vma": False}
-    elif "check_rep" in params:
-        kw = {"check_rep": False}
-    else:
-        raise TypeError(
-            "installed jax's shard_map accepts neither check_vma nor "
-            "check_rep; cannot disable the replication check the sharded "
-            "solvers require")
-    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
-
-
-def _sharded_chunk_step(axis: str, has_ms: bool):
-    """One chunk over node-sharded state. Runs inside shard_map: all array
-    args are the per-device shards.
-
-    Mirrors ops/auction._chunk_step's top-K bidding: every shard offers its
-    local top-K candidates, one all_gather merges them into a global top-K
-    per task, then K contention rounds let a task rejected at its r-th
-    choice fall to its (r+1)-th. Contention for a node is resolved on the
-    shard that owns it; one psum per round merges accept verdicts."""
-
-    def step(carry, chunk, *, allocatable, max_tasks, weights, shard_offset):
-        nodes: NodeState = carry
-        if has_ms:
-            req, valid, ms = chunk          # req/valid replicated, ms sharded
-        else:
-            req, valid = chunk
-            ms = None
-        C, R = req.shape
-        Nl = nodes.idle.shape[0]                            # local shard size
-        K = min(K_CAND, Nl)
-
-        pods_ok = nodes.ntasks < max_tasks
-        # bid eligibility is FutureIdle-based (allocate.go:232-256): a task
-        # that does not fit Idle may still pipeline onto releasing capacity;
-        # the alloc-vs-pipeline split is resolved per accepted task below
-        fit = (jnp.all(req[:, None, :] < nodes.future_idle[None] + EPS,
-                       axis=-1) & pods_ok[None])              # [C,Nl]
-        score = combined_dynamic_score(req, nodes.used, allocatable, weights)
-        if ms is not None:
-            fit = fit & (ms > NEG_TEST)
-            score = score + ms
-        masked = jnp.where(fit, score, -jnp.inf)
-        lscore, lidx = jax.lax.top_k(masked, K)              # [C,K] local
-        gidx = lidx + shard_offset
-
-        # merge every shard's candidates into a global per-task top-K:
-        # one gather of [D,C,K] scores + ids across the mesh.
-        all_s = jax.lax.all_gather(lscore, axis)             # [D,C,K]
-        all_i = jax.lax.all_gather(gidx, axis)
-        D = all_s.shape[0]
-        flat_s = jnp.moveaxis(all_s, 0, 1).reshape(C, D * K)
-        flat_i = jnp.moveaxis(all_i, 0, 1).reshape(C, D * K)
-        cand_score, pos = jax.lax.top_k(flat_s, K)           # [C,K] global
-        cand = jnp.take_along_axis(flat_i, pos, axis=1)
-
-        lower = jnp.arange(C)[:, None] > jnp.arange(C)[None, :]
-
-        def round_body(_, st):
-            accept, choice_g, slot = st
-            bid_g = jnp.take_along_axis(cand, slot[:, None], 1)[:, 0]
-            bscore = jnp.take_along_axis(cand_score, slot[:, None], 1)[:, 0]
-            bidding = ~accept & valid & (bscore > -jnp.inf)
-            local = (bid_g >= shard_offset) & (bid_g < shard_offset + Nl)
-            bid_l = jnp.clip(bid_g - shard_offset, 0, Nl - 1)
-            bidding_l = bidding & local
-
-            # claimed capacity on this shard from earlier-round accepts
-            choice_l = jnp.clip(choice_g - shard_offset, 0, Nl - 1)
-            acc_l = (accept & (choice_g >= shard_offset)
-                     & (choice_g < shard_offset + Nl))
-            claimed_hot = (jax.nn.one_hot(choice_l, Nl, dtype=req.dtype)
-                           * acc_l[:, None])
-            claimed = jnp.einsum("cn,cr->nr", claimed_hot, req)
-            claimed_cnt = jnp.sum(claimed_hot, axis=0)
-            avail_bid = nodes.future_idle[bid_l] - claimed[bid_l]
-            base_cnt = nodes.ntasks[bid_l] + claimed_cnt[bid_l]
-            maxt_bid = max_tasks[bid_l]
-
-            same = (bid_l[:, None] == bid_l[None, :]) & lower
-
-            def wave(mask):
-                live = (mask & bidding_l).astype(req.dtype)
-                m = same * live[None, :]
-                cum = m.astype(req.dtype) @ req
-                room = jnp.all(req + cum < avail_bid + EPS, axis=-1)
-                cnt = jnp.sum(m, axis=1)
-                return bidding_l & room & (base_cnt + cnt < maxt_bid)
-
-            acc = wave(jnp.ones(C, dtype=bool))
-            acc = acc | wave(acc)
-            acc = wave(acc)
-            # each bid node is owned by exactly one shard: psum broadcasts
-            # the owner's verdict to everyone
-            acc_any = jax.lax.psum(acc.astype(jnp.int32), axis) > 0
-            choice_g = jnp.where(acc_any, bid_g, choice_g)
-            accept = accept | acc_any
-            slot = jnp.where(bidding & ~acc_any,
-                             jnp.minimum(slot + 1, K - 1), slot)
-            return accept, choice_g, slot
-
-        accept0 = jnp.zeros(C, dtype=bool)
-        choice0 = jnp.full(C, -1, dtype=jnp.int32)
-        slot0 = jnp.zeros(C, dtype=jnp.int32)
-        accept, choice_g, _ = jax.lax.fori_loop(
-            0, K, round_body, (accept0, choice0, slot0))
-
-        # apply deltas on the owning shard
-        mine = (accept & (choice_g >= shard_offset)
-                & (choice_g < shard_offset + Nl))
-        choice_l = jnp.clip(choice_g - shard_offset, 0, Nl - 1)
-        placed = jax.nn.one_hot(choice_l, Nl, dtype=req.dtype) * mine[:, None]
-
-        # alloc-vs-pipeline split (allocate.go:232-256 / ops/place.py:119):
-        # within the chunk, a task allocates iff it fits the node's Idle
-        # after the IDLE consumption of earlier-in-chunk allocs on the same
-        # node — pipelined neighbors consume FutureIdle only. Earlier alloc
-        # membership is itself the unknown; iterate the antitone fit map F:
-        # after t applications the first t same-node tasks carry their
-        # exact sequential value, and an ODD iterate is a SUBSET of the
-        # true greedy alloc set (S0=all ⊇ true ⇒ S1=F(S0) ⊆ F(true)=true,
-        # alternating), so any task still undecided at depth >9 falls on
-        # the safe side — pipelined, consuming only the FutureIdle room its
-        # acceptance already validated. Idle can never be oversubscribed.
-        same_node = (choice_l[:, None] == choice_l[None, :]) \
-            & mine[:, None] & mine[None, :] & lower
-        idle_bid = nodes.idle[choice_l]
-
-        def alloc_iter(_, alloc):
-            cum = (same_node * alloc[None, :].astype(req.dtype)) @ req
-            return mine & jnp.all(req + cum < idle_bid + EPS, axis=-1)
-
-        alloc = jax.lax.fori_loop(0, 9, alloc_iter, mine)
-        # one psum so every shard sees the global pipelined verdict
-        alloc_any = jax.lax.psum(alloc.astype(jnp.int32), axis) > 0
-        pipe = accept & ~alloc_any
-
-        alloc_hot = placed * alloc[:, None].astype(req.dtype)
-        delta_alloc = jnp.einsum("cn,cr->nr", alloc_hot, req)
-        delta_all = jnp.einsum("cn,cr->nr", placed, req)
-        nodes = NodeState(
-            idle=nodes.idle - delta_alloc,
-            future_idle=nodes.future_idle - delta_all,
-            used=nodes.used + delta_alloc,
-            ntasks=nodes.ntasks + jnp.sum(placed, axis=0).astype(jnp.int32))
-
-        out = jnp.where(accept, choice_g, NO_NODE).astype(jnp.int32)
-        return nodes, (out, pipe)
-
-    return step
-
-
-_SOLVER_CACHE: dict = {}
-
-
-def _sharded_solver(mesh: Mesh, chunk: int, sweeps: int, passes: int,
-                    has_ms: bool):
-    """Compiled node-sharded solve for this mesh. jobs/weights are runtime
-    args (re-tracing per cycle would pay a multi-second compile)."""
-    key = (tuple(d.id for d in mesh.devices.flat), chunk, sweeps, passes,
-           has_ms)
-    if key in _SOLVER_CACHE:
-        return _SOLVER_CACHE[key]
-
-    node_sharded = P(NODE_AXIS)
-    repl = P()
-    in_specs = [NodeState(*(node_sharded,) * 4), node_sharded, node_sharded,
-                repl, repl, repl,
-                JobMeta(repl, repl, repl),
-                ScoreWeights(repl, repl, repl, repl, repl)]
-    if has_ms:
-        in_specs.append(P(None, NODE_AXIS))
-
-    @partial(shard_map_compat, mesh=mesh, in_specs=tuple(in_specs),
-             out_specs=(repl, NodeState(*(node_sharded,) * 4)))
-    def solve(nodes, allocatable, max_tasks, req, valid, job_ix, jobs,
-              weights, *maybe_ms):
-        Tp = req.shape[0]
-        n_chunks = Tp // chunk
-        Nl = allocatable.shape[0]
-        J = jobs.min_available.shape[0]
-        shard_offset = jax.lax.axis_index(NODE_AXIS) * Nl
-        step = partial(_sharded_chunk_step(NODE_AXIS, has_ms),
-                       allocatable=allocatable, max_tasks=max_tasks,
-                       weights=weights, shard_offset=shard_offset)
-        ms = maybe_ms[0] if has_ms else None
-
-        assign0 = jnp.full(Tp, NO_NODE, dtype=jnp.int32)
-        pipe0 = jnp.zeros(Tp, dtype=bool)
-
-        def place_pass(carry, _):
-            nodes, assign, pipe, job_dead = carry
-            todo = (assign == NO_NODE) & valid & ~job_dead[job_ix]
-            xs = (req.reshape(n_chunks, chunk, -1),
-                  todo.reshape(n_chunks, chunk))
-            if has_ms:
-                xs = xs + (ms.reshape(n_chunks, chunk, Nl),)
-            nodes, (out, out_pipe) = jax.lax.scan(step, nodes, xs)
-            fresh = assign == NO_NODE
-            assign = jnp.where(fresh, out.reshape(Tp), assign)
-            pipe = jnp.where(fresh, out_pipe.reshape(Tp), pipe)
-            return (nodes, assign, pipe, job_dead), None
-
-        def sweep(carry, _):
-            (nodes, assign, pipe, job_dead), _ = jax.lax.scan(
-                place_pass, carry, jnp.arange(passes))
-
-            placed = assign != NO_NODE
-            alloc_cnt = jax.ops.segment_sum(
-                (placed & ~pipe).astype(jnp.int32), job_ix, num_segments=J)
-            pipe_cnt = jax.ops.segment_sum(
-                (placed & pipe).astype(jnp.int32), job_ix, num_segments=J)
-            # gang votes (gang.go:45-216): ready counts allocations only;
-            # a merely-pipelined gang is KEPT (allocate.go:264-270 commits
-            # ready jobs, keeps pipelined ones open)
-            ready = alloc_cnt + jobs.base_ready >= jobs.min_available
-            kept = (alloc_cnt + pipe_cnt + jobs.base_ready
-                    + jobs.base_pipelined >= jobs.min_available)
-            drop = placed & ~kept[job_ix]
-            # free dropped demand on the owning shard (alloc'd drops free
-            # Idle too; pipelined drops only reserved future capacity)
-            local = (assign >= shard_offset) & (assign < shard_offset + Nl) & drop
-            drop_hot = (jax.nn.one_hot(
-                jnp.where(local, assign - shard_offset, 0), Nl,
-                dtype=req.dtype) * local[:, None])
-            alloc_hot = drop_hot * (~pipe)[:, None].astype(req.dtype)
-            freed_alloc = jnp.einsum("tn,tr->nr", alloc_hot, req)
-            freed_all = jnp.einsum("tn,tr->nr", drop_hot, req)
-            nodes = NodeState(
-                idle=nodes.idle + freed_alloc,
-                future_idle=nodes.future_idle + freed_all,
-                used=nodes.used - freed_alloc,
-                ntasks=nodes.ntasks - jnp.sum(drop_hot, axis=0).astype(jnp.int32))
-            assign = jnp.where(drop, NO_NODE, assign)
-            job_dead = job_dead | (~kept & (alloc_cnt + pipe_cnt > 0))
-            return (nodes, assign, pipe, job_dead), (ready, kept)
-
-        (nodes, assign, pipe, _), (readies, kepts) = jax.lax.scan(
-            sweep, (nodes, assign0, pipe0, jnp.zeros(J, dtype=bool)),
-            jnp.arange(sweeps))
-        # pack (assign, pipe, ready, kept) in one i32 row: one host fetch
-        packed = jnp.concatenate([assign, pipe.astype(jnp.int32),
-                                  readies[-1].astype(jnp.int32),
-                                  kepts[-1].astype(jnp.int32)])
-        return packed, nodes
-
-    fn = jax.jit(solve)
-    _SOLVER_CACHE[key] = fn
-    return fn
-
-
-def place_blocks_sharded(mesh: Mesh, nodes: NodeState, req: jnp.ndarray,
-                         valid: jnp.ndarray, job_ix: jnp.ndarray,
-                         jobs: JobMeta, weights: ScoreWeights,
-                         allocatable: jnp.ndarray, max_tasks: jnp.ndarray,
-                         chunk: int = 256, sweeps: int = 3, passes: int = 3,
+def place_blocks_sharded(mesh, nodes: NodeState, req, valid, job_ix, jobs,
+                         weights, allocatable, max_tasks, chunk: int = 256,
+                         sweeps: int = 3, passes: int = 3,
                          masked_static: Optional[jnp.ndarray] = None,
-                         ) -> Tuple[np.ndarray, np.ndarray,
-                                    np.ndarray, np.ndarray, NodeState]:
-    """Node-sharded block-greedy placement over ``mesh``.
-
-    nodes/allocatable/max_tasks are sharded on the node axis; tasks
-    (req/valid/job_ix) and JobMeta are replicated; ``masked_static``
-    (optional f32[T,N], NEG where statically infeasible) is sharded on its
-    node axis. Returns (task_node i32[T] global indices, task_pipelined
-    bool[T], job_ready bool[J], job_kept bool[J] — host numpy from one
-    packed fetch — and the final sharded NodeState, left on device). N
-    must be divisible by the mesh size (pad with zero-capacity nodes).
-    """
-    D = mesh.devices.size
-    N = allocatable.shape[0]
-    assert N % D == 0, f"node count {N} not divisible by mesh size {D}"
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                                    jnp.ndarray, NodeState]:
+    """The pre-unification 5-tuple surface, now a slicing view over the
+    unified solver's packed result: (task_node i32[T], pipelined bool[T],
+    job_ready bool[J], job_kept bool[J], nodes). The slices stay on
+    device — no fetch happens here."""
     T = req.shape[0]
-    pad = (-T) % chunk
-    if pad:
-        req = jnp.pad(req, ((0, pad), (0, 0)))
-        valid = jnp.pad(valid, (0, pad))
-        job_ix = jnp.pad(job_ix, (0, pad))
-        if masked_static is not None:
-            masked_static = jnp.pad(masked_static, ((0, pad), (0, 0)),
-                                    constant_values=NEG)
-    Tp = T + pad
-
-    fn = _sharded_solver(mesh, chunk, sweeps, passes,
-                         masked_static is not None)
-    args = [nodes, allocatable, max_tasks, req, valid, job_ix, jobs, weights]
-    if masked_static is not None:
-        args.append(masked_static)
-    packed, out_nodes = fn(*args)
-    packed = np.asarray(packed)                       # the ONE fetch
     J = jobs.min_available.shape[0]
+    packed, out_nodes = place_blocks_unified(
+        mesh, nodes, req, valid, job_ix, jobs, weights, allocatable,
+        max_tasks, chunk=chunk, sweeps=sweeps, passes=passes,
+        masked_static=masked_static)
+    Tp = padded_task_len(T, chunk)
     return (packed[:T], packed[Tp:Tp + T].astype(bool),
             packed[2 * Tp:2 * Tp + J].astype(bool),
-            packed[2 * Tp + J:].astype(bool), out_nodes)
+            packed[2 * Tp + J:2 * Tp + 2 * J].astype(bool), out_nodes)

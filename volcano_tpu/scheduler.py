@@ -999,7 +999,8 @@ class Scheduler:
 
     def _allocate_engine(self, runnable) -> Optional[str]:
         """The engine the allocate slot will run, when it is one the
-        dispatch/await split supports (the scan-kernel fused paths)."""
+        dispatch/await split supports — every fused device kernel: scan,
+        pallas (packed device decode), and the unified sharded engine."""
         for name, action in runnable:
             if name not in ("allocate", "allocate-tpu"):
                 continue
@@ -1007,7 +1008,8 @@ class Scheduler:
             for c in self.conf.configurations:
                 if c.name in (name, "allocate"):
                     engine = c.arguments.get("engine", engine)
-            return engine if engine in ("tpu-fused", "tpu-scan") else None
+            return engine if engine in ("tpu-fused", "tpu-scan",
+                                        "tpu-pallas", "tpu-sharded") else None
         return None
 
     def _dispatch_speculation(self, rec, runnable) -> None:

@@ -252,6 +252,22 @@ def main(argv=None) -> int:
                          "arrived gang completed with zero "
                          "double-binds, byte-deterministic x2 "
                          "(exit 1 otherwise)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="run allocate through the unified shard_map "
+                         "engine (tpu-sharded: nodes axis sharded over "
+                         "the device mesh, jobs replicated; "
+                         "ops/unified.py). Single-scheduler only")
+    ap.add_argument("--sharded-devices", type=int, default=0, metavar="N",
+                    help="cap the sharded mesh to the first N devices "
+                         "(0 = full mesh). N=1 is the single-device "
+                         "oracle the equivalence verify diffs against")
+    ap.add_argument("--verify-sharded-equivalence", action="store_true",
+                    help="also run the SAME trace with sharded-devices=1 "
+                         "(the single-device oracle — the unified "
+                         "solver's decisions are mesh-size invariant by "
+                         "construction) and assert the full-mesh decision "
+                         "plane is BYTE-IDENTICAL (exit 1 on mismatch); "
+                         "requires --sharded")
     ap.add_argument("--verify-pipelined-equivalence", action="store_true",
                     help="also run the SERIAL single-scheduler oracle "
                          "and assert equivalence: byte-identical "
@@ -278,6 +294,12 @@ def main(argv=None) -> int:
     if args.conf:
         with open(args.conf) as f:
             conf_text = f.read()
+    elif args.sharded:
+        # pin the sharded conf explicitly: allocate on the unified
+        # shard_map engine, mesh capped by --sharded-devices — the
+        # equivalence oracle below swaps ONLY the device cap to 1
+        from .runner import sharded_sim_conf
+        conf_text = sharded_sim_conf(args.sharded_devices)
     elif args.pipelined or args.fast_admit:
         # pin the pipelined conf EXPLICITLY so the serial oracle of
         # --verify-pipelined-equivalence schedules with the identical
@@ -356,6 +378,12 @@ def main(argv=None) -> int:
     if args.verify_elastic_gang_equivalence and not args.elastic_gangs:
         ap.error("--verify-elastic-gang-equivalence requires "
                  "--elastic-gangs")
+    if args.sharded and (args.federated or args.ha > 1 or args.pipelined
+                         or args.elastic_gangs):
+        ap.error("--sharded is a direct single-scheduler mode (not "
+                 "--federated / --ha / --pipelined / --elastic-gangs)")
+    if args.verify_sharded_equivalence and not args.sharded:
+        ap.error("--verify-sharded-equivalence requires --sharded")
     if args.verify_ack_equivalence and not ack_fault_rate:
         # without faults the report has no feedback section and every
         # stuck-state assertion would pass vacuously
@@ -373,9 +401,11 @@ def main(argv=None) -> int:
 
     def run(kills, replicas=None, losses=None, federated=None,
             pipelined=None, fast_admit=None, fault_rate=None, torn=None,
-            ack_rate=None, lease_rate=None):
+            ack_rate=None, lease_rate=None, conf=None):
         bw, ew = wraps()
-        runner = SimRunner(trace, conf_text=conf_text, period=args.period,
+        runner = SimRunner(trace,
+                           conf_text=conf_text if conf is None else conf,
+                           period=args.period,
                            cycle_budget_s=cycle_budget,
                            budget_cost_per_task=budget_cost,
                            admission_depth=admission_depth,
@@ -726,6 +756,31 @@ def main(argv=None) -> int:
               f"reserves={report.get('cross_partition_reserves', {})}, "
               f"node_transfers={fed.get('node_transfers', 0)}",
               file=sys.stderr)
+    if args.verify_sharded_equivalence:
+        from .runner import sharded_sim_conf
+        # the unified solver's decisions are mesh-size invariant by
+        # construction (per-shard stable top-K -> shard-major merge ->
+        # global stable top-K; psum'd gang verdicts over disjoint owner
+        # shards), so the full-mesh run must be BYTE-identical to the
+        # sharded-devices=1 single-device oracle — no contended/terminal
+        # fallback tier exists for this verify on purpose
+        oracle = run(kill_cycles, conf=sharded_sim_conf(1))
+        problems = []
+        if deterministic_json(report) != deterministic_json(oracle):
+            problems.append("sharded decision plane differs from the "
+                            "single-device oracle (mesh-size invariance "
+                            "broken)")
+        if report.get("double_binds"):
+            problems.append(f"double-binds in sharded run: "
+                            f"{report['double_binds']}")
+        if problems:
+            for p in problems:
+                print(f"sharded-equivalence FAILED: {p}", file=sys.stderr)
+            return 1
+        import jax as _jax
+        print(f"sharded-equivalence OK: devices="
+              f"{args.sharded_devices or len(_jax.devices())} vs oracle 1, "
+              f"accounting={terminal_accounting(report)}", file=sys.stderr)
     if args.verify_pipelined_equivalence:
         import json as _json
         from .report import pipelined_oracle_part

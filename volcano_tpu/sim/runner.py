@@ -102,6 +102,34 @@ tiers:
 """
 
 
+def sharded_sim_conf(devices: int = 0) -> str:
+    """Conf for ``--sharded`` runs: the pipelined action chain with the
+    allocate slot on the unified shard_map engine (ops/unified — nodes
+    axis sharded over the mesh, jobs replicated). ``devices`` caps the
+    mesh to the first N devices; 0 = the full mesh. Because the unified
+    solver's decisions are mesh-size invariant by construction,
+    ``devices=1`` IS the single-device oracle —
+    --verify-sharded-equivalence byte-diffs the two decision planes."""
+    d = int(devices)
+    return f"""
+actions: "enqueue, allocate-tpu, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+configurations:
+- name: allocate-tpu
+  arguments:
+    engine: tpu-sharded
+    sharded-devices: {d}
+"""
+
+
 def elastic_sim_conf(topology_weight: float = 10.0) -> str:
     """Conf for ``--elastic-gangs`` runs: the default action chain with
     the grow-shrink stage between allocate and preempt (elastic gangs

@@ -536,6 +536,15 @@ SCENARIOS: Dict[str, dict] = {
                     "the degenerate all-at-t0 trace",
         factory=lambda seed: baseline_trace("10k", seed=seed),
     ),
+    "steady-100k": dict(
+        description="100,000 pods / 20,000 nodes (BASELINE config '100k') "
+                    "as the all-at-t0 trace — the unified sharded solver's "
+                    "scale world (slow; run with --sharded, and "
+                    "--verify-sharded-equivalence diffs the full-mesh "
+                    "decision plane against the sharded-devices:1 "
+                    "single-device oracle byte-for-byte)",
+        factory=lambda seed: baseline_trace("100k", seed=seed),
+    ),
 }
 
 
