@@ -921,6 +921,52 @@ def main():
                   if s20 > 0 else 0.0,
                   sharded_20k_crossover_ok=s20s <= s20)
 
+    # mesh fault containment (docs/robustness.md mesh failure model):
+    # what a mid-solve heal COSTS and what a 1-of-8 quarantine costs at
+    # steady state. Needs the multi-device mesh — on a 1-device host a
+    # quarantine leaves no survivors and the ladder (correctly) bottoms
+    # out, which is not the path being priced here.
+    import jax as _jax
+    if len(_jax.devices()) >= 8:
+        from volcano_tpu.actions import allocate as alloc_mod
+        from volcano_tpu.chaos import MeshFaultInjector
+        from volcano_tpu.device_health import DEVICE_HEALTH
+        try:
+            # steady-state D=7: device 7 quarantined the whole cycle (the
+            # 1-of-8 outage after its heal). The canary is the POINT:
+            # LAST_FALLBACK stayed empty inside run_cycle — a 1-of-8
+            # fault never routes to the CPU placer. The warm run also
+            # primes the D-1 mesh shapes for the heal measurement below.
+            DEVICE_HEALTH.quarantine(_jax.devices()[7].id, "device_lost")
+            run_cycle("20k", "tpu-sharded")       # warm the D=7 shapes
+            s20d7, _, nb20d7 = run_cycle("20k", "tpu-sharded")
+            assert nb20d7 == nb20s, (
+                f"D=7 bound {nb20d7} != D=8 {nb20s} — decisions are not "
+                f"mesh-size invariant")
+            DEVICE_HEALTH.reset()
+
+            # heal latency: fault the FIRST solve attempt (attributed oom
+            # on a live shard) — the cycle quarantines it, re-forms the
+            # mesh at D-1, re-pads/re-uploads and re-dispatches, all
+            # inside the one timed execute. The delta over the clean
+            # sharded cycle is the heal's all-in price.
+            alloc_mod.DEVICE_FAULT_HOOK = MeshFaultInjector({"oom": [1]})
+            s20h, _, nb20h = run_cycle("20k", "tpu-sharded")
+            alloc_mod.DEVICE_FAULT_HOOK = None
+            assert nb20h == nb20s, (
+                f"healed cycle bound {nb20h} != clean sharded {nb20s} — "
+                f"mesh-size invariance broke across the heal")
+            extras.update(
+                heal_latency_ms=round((s20h - s20s) * 1e3, 1),
+                alloc_20k_healed_ms=round(s20h * 1e3, 1),
+                alloc_20k_d7_ms=round(s20d7 * 1e3, 1),
+                alloc_20k_d7_vs_d8=round(s20d7 / s20s, 2)
+                if s20s > 0 else 0.0,
+                mesh_never_cpu_ok=True)
+        finally:
+            alloc_mod.DEVICE_FAULT_HOOK = None
+            DEVICE_HEALTH.reset()
+
     # the 100k-pod scale stage (ISSUE 18): 100k pods / 20k nodes through
     # the unified sharded engine — the masked_static=None wire path is
     # the only one that exists at this shape (a dense [T,N] would be

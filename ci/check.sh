@@ -26,22 +26,24 @@ run_overload=true
 run_elastic=true
 run_egang=true
 run_sharded=true
+run_mesh=true
 case "${1:-}" in
-  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false ;;
-  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false ;;
-  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false ;;
-  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false ;;
-  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false ;;
-  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false ;;
-  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false ;;
-  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false ;;
-  --pipelined-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false ;;
-  --store-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false ;;
-  --ack-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false ;;
-  --overload-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_elastic=false; run_egang=false; run_sharded=false ;;
-  --elastic-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_overload=false; run_egang=false; run_sharded=false ;;
-  --elastic-gang-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_overload=false; run_elastic=false; run_egang=true; run_sharded=false ;;
-  --sharded-soak-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=true ;;
+  --shim-only) run_python=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false; run_mesh=false ;;
+  --python-only) run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false; run_mesh=false ;;
+  --sim-only) run_python=false; run_shim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false; run_mesh=false ;;
+  --soak-only) run_python=false; run_shim=false; run_sim=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false; run_mesh=false ;;
+  --obs-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false; run_mesh=false ;;
+  --lint-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false; run_mesh=false ;;
+  --ha-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_federated=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false; run_mesh=false ;;
+  --federated-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_pipelined=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false; run_mesh=false ;;
+  --pipelined-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_store=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false; run_mesh=false ;;
+  --store-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false ; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false; run_mesh=false ;;
+  --ack-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false; run_mesh=false ;;
+  --overload-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_elastic=false; run_egang=false; run_sharded=false; run_mesh=false ;;
+  --elastic-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_overload=false; run_egang=false; run_sharded=false; run_mesh=false ;;
+  --elastic-gang-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_overload=false; run_elastic=false; run_egang=true; run_sharded=false; run_mesh=false ;;
+  --sharded-soak-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=true; run_mesh=false ;;
+  --mesh-chaos-only) run_python=false; run_shim=false; run_sim=false; run_soak=false; run_obs=false; run_lint=false; run_ha=false; run_federated=false; run_pipelined=false; run_store=false; run_ack=false; run_overload=false; run_elastic=false; run_egang=false; run_sharded=false ;;
 esac
 
 if $run_lint; then
@@ -746,6 +748,60 @@ from the sharded-devices:1 oracle"; exit 1; }
     || { echo "sharded-soak FAILED: sharded run not byte-deterministic"; \
          exit 1; }
   echo "   sharded-soak: dryrun OK, oracle-equal, byte-deterministic x2"
+fi
+
+if $run_mesh; then
+  # mesh-chaos soak (ISSUE 19, docs/robustness.md mesh failure model):
+  # seeded per-shard faults (oom / device_lost / slow stragglers) on the
+  # 8-device virtual mesh, COMPOSED with mid-run scheduler kills. The
+  # contract: every fault quarantines exactly one chip, the mesh heals
+  # mid-cycle, probes readmit cooled chips, the decision plane stays
+  # BYTE-identical to the zero-fault single-device oracle with the same
+  # kills (--verify-mesh-equivalence runs the oracle in-process), the
+  # CPU rung never fires while a healthy device remains, and the whole
+  # faulted run is byte-deterministic x2.
+  echo "== mesh-chaos: per-shard faults + kills vs single-device oracle =="
+  meshdir=$(mktemp -d)
+  trap 'rm -rf "${simdir:-/nonexistent}" "${soakdir:-/nonexistent}" \
+"${obsdir:-/nonexistent}" "${hadir:-/nonexistent}" \
+"${feddir:-/nonexistent}" "${pipedir:-/nonexistent}" \
+"${storedir:-/nonexistent}" "${ackdir:-/nonexistent}" \
+"${ovdir:-/nonexistent}" "${eldir:-/nonexistent}" \
+"${egdir:-/nonexistent}" "${sharddir:-/nonexistent}" \
+"${meshdir:-/nonexistent}"' EXIT
+  JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
+    python -m volcano_tpu.sim --scenario mesh-chaos --mesh-chaos \
+    --verify-mesh-equivalence --kill-cycles 6,17 --deterministic \
+    > "$meshdir/mesh.a.json" \
+    || { echo "mesh-chaos FAILED: faulted decision plane diverged from \
+the zero-fault single-device oracle"; exit 1; }
+  JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
+    python -m volcano_tpu.sim --scenario mesh-chaos --mesh-chaos \
+    --kill-cycles 6,17 --deterministic > "$meshdir/mesh.b.json"
+  diff "$meshdir/mesh.a.json" "$meshdir/mesh.b.json" \
+    || { echo "mesh-chaos FAILED: faulted run not byte-deterministic"; \
+         exit 1; }
+  python - "$meshdir/mesh.a.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+m = r["mesh"]
+assert sum(m["injected"].values()) > 0, "the seeded faults never landed"
+assert sum(m["heals"].values()) >= 1, m
+assert m["readmissions"] >= 1, m
+assert m["cpu_fallback_cycles"] == 0, \
+    "CPU rung fired with healthy devices remaining: %r" % (m,)
+assert r["restarts"] == 2, "the seeded kills never landed"
+assert r["double_binds"] == 0
+assert r["jobs"]["completed"] == r["jobs"]["arrived"]
+assert r["jobs"]["unfinished"] == 0
+print("   mesh-chaos: %d faults -> %d heals, %d readmissions, "
+      "0 CPU-rung cycles, zero double-binds"
+      % (sum(m["injected"].values()), sum(m["heals"].values()),
+         m["readmissions"]))
+EOF
+  echo "   mesh-chaos: oracle-equal under faults+kills, byte-deterministic x2"
 fi
 
 if $run_shim; then

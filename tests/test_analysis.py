@@ -480,6 +480,66 @@ def test_vt020_annotation_rewrites():
     assert "VT020" not in rule_ids(f)
 
 
+VT021_TRIGGER = '''
+class Healer:
+    def heal(self, device):
+        DEVICE_HEALTH.quarantine(device, "oom")
+'''
+
+VT021_READMIT_TRIGGER = '''
+class Prober:
+    def probe_ok(self, device):
+        DEVICE_HEALTH.readmit(device)
+'''
+
+VT021_CLEAN = '''
+class Healer:
+    def heal(self, ssn, device):
+        DEVICE_HEALTH.quarantine(device, "oom")
+        ssn.cache.invalidate_device_state()
+'''
+
+VT021_HOP_CLEAN = '''
+class Healer:
+    def _retire(self, ssn):
+        ssn.cache.retire_epoch()
+
+    def heal(self, ssn, device):
+        DEVICE_HEALTH.quarantine(device, "oom")
+        self._retire(ssn)
+'''
+
+VT021_RAW_DEF = '''
+class StoreBackedHealth:
+    def quarantine(self, device, kind):
+        self._persist(device, kind)
+        return self.inner.quarantine(device, kind)
+'''
+
+
+def test_vt021_trigger_and_clean():
+    """A device-set mutation (quarantine/readmit) without a tensor-epoch
+    bump on the path fires VT021; bumping in the same function or one
+    hop away is clean; a lattice verb's own def (delegating override) is
+    the mutation floor, not a mesh decision; and device_health.py — the
+    raw verbs plus the record_fault attribution delegation — is
+    excluded."""
+    f, _ = findings_of({"volcano_tpu/actions/heal.py": VT021_TRIGGER})
+    assert "VT021" in rule_ids(f)
+    assert any(x.symbol == "Healer.heal" for x in f)
+    f, _ = findings_of(
+        {"volcano_tpu/actions/heal.py": VT021_READMIT_TRIGGER})
+    assert "VT021" in rule_ids(f)
+    f, _ = findings_of({"volcano_tpu/actions/heal.py": VT021_CLEAN})
+    assert "VT021" not in rule_ids(f)
+    f, _ = findings_of({"volcano_tpu/actions/heal.py": VT021_HOP_CLEAN})
+    assert "VT021" not in rule_ids(f)
+    f, _ = findings_of({"volcano_tpu/actions/health.py": VT021_RAW_DEF})
+    assert "VT021" not in rule_ids(f)
+    f, _ = findings_of({"volcano_tpu/device_health.py": VT021_TRIGGER})
+    assert "VT021" not in rule_ids(f)
+
+
 VT005_TRIGGER = '''
 def cycle(action):
     try:
@@ -973,6 +1033,45 @@ def test_rebreak_unjournaled_command_apply_vt020():
     assert any(x.rule == "VT020"
                and x.symbol == "CommandFunnel.consume"
                for x in f), rule_ids(f)
+
+
+def test_rebreak_unbumped_mesh_heal_vt021():
+    """The mesh-heal contract: _with_fallback quarantines the faulted
+    device right next to the tensor-epoch bump that retires the stale
+    layout. Stripping the bumps (both the attributed-heal and the
+    fleet-window path) re-dispatches the solve onto tensors padded and
+    uploaded for the dead mesh — shape error at best, a stale-shard
+    read at worst (docs/robustness.md mesh failure model). The
+    unmutated source must be clean; the stripped one must flag the
+    quarantine."""
+    src = real_source("volcano_tpu/actions/allocate.py")
+    f, _ = findings_of({"volcano_tpu/actions/allocate.py": src})
+    assert "VT021" not in rule_ids(f)
+    broken = mutate(
+        src,
+        "                    ssn.cache.invalidate_device_state()\n",
+        "                    pass\n")
+    f, _ = findings_of({"volcano_tpu/actions/allocate.py": broken})
+    assert any(x.rule == "VT021"
+               and x.symbol == "AllocateAction._with_fallback"
+               for x in f), rule_ids(f)
+
+
+def test_rebreak_unbumped_probe_readmit_vt021():
+    """Readmission grows the device set, so the probe loop retires the
+    epoch right next to the readmit. Stripping the bump hands the
+    re-formed (larger) mesh tensors laid out for the quarantined-era D.
+    The stripped probe loop must flag both its lattice verbs (the
+    probe-failure quarantine loses its in-scope witness too)."""
+    src = real_source("volcano_tpu/actions/allocate.py")
+    broken = mutate(
+        src,
+        "        ssn.cache.invalidate_device_state()\n        "
+        "readmitted += 1\n",
+        "        readmitted += 1\n")
+    f, _ = findings_of({"volcano_tpu/actions/allocate.py": broken})
+    assert sum(1 for x in f if x.rule == "VT021"
+               and x.symbol == "_probe_quarantined") == 2, rule_ids(f)
 
 
 def test_rebreak_unjournaled_evict_vt004():

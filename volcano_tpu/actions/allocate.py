@@ -75,17 +75,136 @@ class ReplayFault(RuntimeError):
 # ran its configured engine end to end.
 LAST_FALLBACK: Dict[str, str] = {}
 
-# Pre-solve hook for device-fault injection (chaos.DeviceFaultInjector):
-# called with the engine name before every device solve attempt; raising
+# Pre-solve hook for device-fault injection (chaos.DeviceFaultInjector /
+# chaos.MeshFaultInjector): called with the engine name before every
+# device solve attempt (and with "<engine>:probe:<id>" before a
+# quarantined device's dry-run probe); raising
 # device_health.DeviceFaultError simulates an XLA OOM/device-lost at
 # exactly the point the real XlaRuntimeError would surface.
 DEVICE_FAULT_HOOK = None
 
+# Device ids of the mesh the CURRENT sharded solve attempt runs over,
+# refreshed before each attempt (including mid-cycle heal retries).
+# MeshFaultInjector reads it to target a live shard; the heal path reads
+# it to validate fault attribution against the devices that were solving.
+CURRENT_MESH_DEVICES: tuple = ()
+
 
 def _device_available() -> bool:
-    """Is the device-fault cool-down window closed (device_health)?"""
+    """Is the FLEET cool-down window closed (device_health)? The fleet
+    window opens only on unattributed faults — attributed ones
+    quarantine a single device and heal the mesh instead."""
     from ..device_health import DEVICE_HEALTH
     return DEVICE_HEALTH.available()
+
+
+def _mesh_devices(ssn):
+    """The sharded engine's device selection with the health lattice
+    applied: ``(capped, healthy)`` where ``capped`` is jax.devices()
+    truncated by the ``sharded-devices`` conf argument and ``healthy``
+    is the non-quarantined subset in the same order. The degradation
+    ladder falls out of ``healthy``: the full capped set is rung 0, a
+    strict subset re-forms the mesh (rung 1 — byte-identical decisions
+    by the mesh-size-invariance contract, ops/unified.py), one survivor
+    collapses to the single-device program (rung 2), and empty is rung 3
+    (the CPU placer, taken only here)."""
+    import jax
+    from ..device_health import DEVICE_HEALTH
+    devices = jax.devices()
+    k = _sharded_device_count(ssn)
+    if k:
+        devices = devices[:k]
+    live = set(DEVICE_HEALTH.healthy_devices([d.id for d in devices]))
+    return devices, [d for d in devices if d.id in live]
+
+
+def current_mesh_ids(ssn) -> tuple:
+    """Device-id tuple the sharded engine would solve over right now —
+    the pipelined shell compares this against the tuple recorded at
+    speculative dispatch: any difference (quarantine OR readmission)
+    means the packed result may live on a lost device or a stale
+    layout, and the commit classifies it as a conflict."""
+    return tuple(d.id for d in _mesh_devices(ssn)[1])
+
+
+def _degradation_rung(total: int, healthy: int) -> int:
+    """0 full mesh, 1 shrunken mesh, 2 single device (degraded from a
+    larger mesh), 3 CPU placer. A deliberately 1-device configuration
+    (total == healthy == 1) is rung 0 — nothing degraded."""
+    if healthy == 0:
+        return 3
+    if healthy == 1 and total > 1:
+        return 2
+    if healthy < total:
+        return 1
+    return 0
+
+
+def _dry_run_probe_solve(device) -> None:
+    """A throwaway micro-solve pinned to ``device`` — the quarantined
+    device's PROBE. Runs the unified blocks kernel (the same program
+    family a readmitted device will serve) over dummy 1-node/1-task
+    tensors and blocks on the result; the output is discarded, so a
+    probe can NEVER leak into a live decision. Raises whatever the
+    device raises — the caller classifies and doubles the window."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops import JobMeta, default_weights, make_node_state
+    from ..ops.unified import place_blocks_unified
+    # the probe's await IS its point — a scheduled readback of a real
+    # solve, so it rides the sanctioned solve span (vlint VT010)
+    with obs_trace.span("solve", probe=True), jax.default_device(device):
+        state = make_node_state(
+            jnp.ones((1, 1), jnp.float32), jnp.zeros((1, 1), jnp.float32),
+            jnp.zeros((1, 1), jnp.float32), jnp.zeros((1, 1), jnp.float32),
+            jnp.zeros(1, jnp.int32))
+        meta = JobMeta(min_available=jnp.ones(1, jnp.int32),
+                       base_ready=jnp.zeros(1, jnp.int32),
+                       base_pipelined=jnp.zeros(1, jnp.int32))
+        packed, _ = place_blocks_unified(
+            None, state, jnp.full((1, 1), 0.5, jnp.float32),
+            jnp.ones(1, bool), jnp.zeros(1, jnp.int32), meta,
+            default_weights(1), jnp.ones((1, 1), jnp.float32),
+            jnp.ones(1, jnp.int32))
+        jax.block_until_ready(packed)
+
+
+def _probe_quarantined(ssn) -> int:
+    """Probe every PROBE-state device (quarantine window expired) with a
+    throwaway dry-run solve and readmit the ones that pass. Readmission
+    grows the device set, so the tensor epoch is retired
+    (``invalidate_device_state`` — vlint VT021) and the next layout
+    re-pads/re-uploads at the larger D. A probe fault doubles the
+    device's window; probes are skipped entirely while the FLEET window
+    is open (an unattributed outage means hands off the device)."""
+    from ..device_health import DEVICE_HEALTH, classify_device_fault
+    if not DEVICE_HEALTH.available():
+        return 0
+    import jax
+    devices = jax.devices()
+    k = _sharded_device_count(ssn)
+    if k:
+        devices = devices[:k]
+    by_id = {d.id: d for d in devices}
+    readmitted = 0
+    for dev_id in DEVICE_HEALTH.probe_candidates(list(by_id)):
+        try:
+            if DEVICE_FAULT_HOOK is not None:
+                DEVICE_FAULT_HOOK(f"tpu-sharded:probe:{dev_id}")
+            _dry_run_probe_solve(by_id[dev_id])
+        except Exception as exc:
+            kind = classify_device_fault(exc) or "probe"
+            DEVICE_HEALTH.quarantine(dev_id, kind)
+            log.warning("device %s failed its probe dry-run (%s): "
+                        "quarantine window doubled", dev_id, kind)
+            continue
+        DEVICE_HEALTH.readmit(dev_id)
+        ssn.cache.invalidate_device_state()
+        readmitted += 1
+        log.info("device %s readmitted after probe dry-run: mesh "
+                 "re-forms over %d device(s), epoch retired", dev_id,
+                 len(_mesh_devices(ssn)[1]))
+    return readmitted
 
 
 def _node_tensors(ssn, rnames) -> NodeTensors:
@@ -135,7 +254,23 @@ class AllocateAction(Action):
         LAST_FALLBACK.clear()
         LAST_STATS.pop("tensor_s", None)      # accumulates within one cycle
         LAST_STATS.pop("tensor_incremental", None)
-        if engine.startswith("tpu-") and not _device_available():
+        degraded = engine.startswith("tpu-") and not _device_available()
+        if engine == "tpu-sharded":
+            # Per-device lattice path (docs/robustness.md): quarantined
+            # devices whose window expired get a throwaway probe solve
+            # (readmission bumps the tensor epoch), then the degradation
+            # ladder picks the rung — the CPU placer is rung 3, taken
+            # only when the FLEET window is open (an unattributed fault
+            # suspects everything) or zero devices survive quarantine.
+            from .. import metrics
+            if _device_available():
+                _probe_quarantined(ssn)
+            capped, healthy = _mesh_devices(ssn)
+            rung = 3 if not _device_available() else \
+                _degradation_rung(len(capped), len(healthy))
+            metrics.set_degradation_rung(rung)
+            degraded = rung == 3
+        if degraded:
             # device-fault cool-down (docs/robustness.md): a recent XLA
             # OOM/device-lost opened a cool-down window — run this cycle
             # on the CPU placer without touching the device; the window's
@@ -208,39 +343,78 @@ class AllocateAction(Action):
         benches want the raw error).
 
         DEVICE faults (XLA OOM / device-lost — see device_health) are
-        additionally contained before falling back: the cool-down state
-        machine opens (subsequent cycles skip the device engine until
-        the window expires) and the cache's device-resident tensor state
-        is invalidated via the session-epoch bump, because a lost
-        device's buffers are gone and an OOM'd one must not be fed the
-        same resident arrays straight back."""
-        from ..device_health import DEVICE_HEALTH, classify_device_fault
-        try:
-            if DEVICE_FAULT_HOOK is not None:
-                DEVICE_FAULT_HOOK(engine)
-            run()
-            DEVICE_HEALTH.record_ok()
-        except ReplayFault:
-            raise            # session not provably consistent — no fallback
-        except Exception as exc:
-            from .. import metrics
-            kind = classify_device_fault(exc)
-            if kind is not None:
-                window = DEVICE_HEALTH.record_fault(kind)
-                invalidate = getattr(ssn.cache, "invalidate_device_state",
-                                     None)
-                if invalidate is not None:
-                    invalidate()
-                log.error("device fault (%s) in allocate engine %s: "
-                          "cooling down for %.1fs, device tensor state "
-                          "invalidated", kind, engine, window)
-            if not enabled:
-                raise
-            log.exception("allocate engine %s failed; completing the cycle "
-                          "with the sequential placer", engine)
-            metrics.register_solver_fallback(self.NAME)
-            LAST_FALLBACK.update(engine=engine, error=repr(exc))
-            _execute_interleaved(ssn, _CallbackJobPlacer(ssn))
+        additionally contained before falling back. When the fault
+        ATTRIBUTES to a single device (the XLA error names the chip, or
+        the injector tagged it), the sharded engine HEALS mid-cycle
+        instead of degrading: the failing device is quarantined, the
+        tensor epoch retired (a lost device's buffers are gone, and an
+        OOM'd one must not be fed the same resident arrays straight
+        back), and the SAME solve re-dispatches over the surviving
+        devices — re-formed mesh, node layout re-padded at the new D,
+        persistent tensors re-uploaded through the scatter path. The
+        decisions are byte-identical across the heal by the mesh-size
+        invariance contract (ops/unified.py). Only an UNATTRIBUTED
+        fault opens the fleet-wide cool-down (suspect everything) and
+        drops the cycle to the sequential placer."""
+        from ..device_health import (DEVICE_HEALTH, attribute_device_fault,
+                                     classify_device_fault)
+        global CURRENT_MESH_DEVICES
+        sharded = engine == "tpu-sharded"
+        while True:
+            mesh_ids = current_mesh_ids(ssn) if sharded else ()
+            CURRENT_MESH_DEVICES = mesh_ids
+            try:
+                if DEVICE_FAULT_HOOK is not None:
+                    DEVICE_FAULT_HOOK(engine)
+                run()
+                DEVICE_HEALTH.record_ok()
+                return
+            except ReplayFault:
+                raise        # session not provably consistent — no fallback
+            except Exception as exc:
+                from .. import metrics
+                kind = classify_device_fault(exc)
+                device = attribute_device_fault(exc, mesh_ids) \
+                    if kind is not None and sharded else None
+                if device is not None:
+                    # Attributed device fault: quarantine ONE device and
+                    # heal the mesh in the same cycle. The epoch bump
+                    # forces the next attempt to re-pad/re-upload for
+                    # the shrunken device set (VT021 witness).
+                    window = DEVICE_HEALTH.quarantine(device, kind)
+                    ssn.cache.invalidate_device_state()
+                    capped, healthy = _mesh_devices(ssn)
+                    survivors = tuple(d.id for d in healthy)
+                    if survivors:
+                        # the ladder descended mid-cycle: the gauge
+                        # tracks the rung the re-dispatch runs on
+                        metrics.set_degradation_rung(
+                            _degradation_rung(len(capped), len(healthy)))
+                        metrics.register_mesh_heal(kind)
+                        log.warning(
+                            "device %s fault (%s): quarantined for "
+                            "%.1fs; healing mesh over %d surviving "
+                            "device(s) and re-dispatching the solve",
+                            device, kind, window, len(survivors))
+                        continue
+                    log.error("device %s fault (%s): quarantined for "
+                              "%.1fs and no devices survive — ladder "
+                              "bottoms out at the sequential placer",
+                              device, kind, window)
+                elif kind is not None:
+                    window = DEVICE_HEALTH.record_fault(kind)
+                    ssn.cache.invalidate_device_state()
+                    log.error("device fault (%s) in allocate engine %s: "
+                              "cooling down for %.1fs, device tensor state "
+                              "invalidated", kind, engine, window)
+                if not enabled:
+                    raise
+                log.exception("allocate engine %s failed; completing the "
+                              "cycle with the sequential placer", engine)
+                metrics.register_solver_fallback(self.NAME)
+                LAST_FALLBACK.update(engine=engine, error=repr(exc))
+                _execute_interleaved(ssn, _CallbackJobPlacer(ssn))
+                return
 
 
 class AllocateTPUAction(AllocateAction):
@@ -1124,15 +1298,16 @@ def _solve_fused(ssn, ordered_jobs, blocks: bool, kernel: str = "auto",
         # invariant, so the 1-device run of this very engine IS the oracle
         # for any D — and a 1-device mesh collapses to the plain jit
         # program inside place_blocks_unified, skipping shard_map overhead.
-        import jax
         from ..cache.snapshot import sharded_node_layout
         from ..ops.pallas_place import NEG as MNEG
         from ..ops.unified import (make_mesh, padded_task_len,
                                    place_blocks_unified)
-        devices = jax.devices()
-        k = _sharded_device_count(ssn)
-        if k:
-            devices = devices[:k]
+        # the health lattice filters quarantined devices out of the mesh
+        # — a shrunken mesh is degradation-ladder rung 1 and decisions
+        # stay byte-identical (sharded-devices: 1 is the oracle for
+        # every D). Zero healthy devices never reaches here: execute()
+        # routes rung 3 to the sequential placer.
+        _, devices = _mesh_devices(ssn)
         mesh = make_mesh(devices)
         D = int(mesh.devices.size)
         state, alloc_d, maxt_d, n_pad = sharded_node_layout(node_t, D)
@@ -1499,10 +1674,11 @@ class PendingFusedSolution:
 
     __slots__ = ("ordered_jobs", "tasks", "job_ix", "jobs_list", "node_t",
                  "packed_d", "bucket", "jp", "eligible_uids",
-                 "assumed_hint")
+                 "assumed_hint", "mesh_devices")
 
     def __init__(self, ordered_jobs, tasks, job_ix, jobs_list, node_t,
-                 packed_d, bucket, jp, eligible_uids, assumed_hint=None):
+                 packed_d, bucket, jp, eligible_uids, assumed_hint=None,
+                 mesh_devices=None):
         self.ordered_jobs = ordered_jobs
         self.tasks = tasks
         self.job_ix = job_ix
@@ -1520,6 +1696,12 @@ class PendingFusedSolution:
         # 1). set(): warm-started at the ∅ fixpoint — the commit must
         # verify kept==∅ and otherwise discard (conflict), never continue
         self.assumed_hint = assumed_hint
+        # tpu-sharded only: device-id tuple the speculative packed result
+        # was dispatched over. A mesh change before commit (quarantine or
+        # readmission) means packed_d may live on a lost device / stale
+        # layout — the commit classifies it as a conflict and retires the
+        # pinned epoch pair. None for single-device engines.
+        self.mesh_devices = mesh_devices
 
 
 def dispatch_speculative_solve(ssn, engine: str = "tpu-fused",
@@ -1593,16 +1775,17 @@ def dispatch_speculative_solve(ssn, engine: str = "tpu-fused",
         # unified sharded solve — same assembly as _solve_fused's sharded
         # branch, dispatch only: the packed result stays on device until
         # finalize_speculative_dispatch's one fetch
-        import jax
         import jax.numpy as jnp
         from ..cache.snapshot import sharded_node_layout
         from ..ops.pallas_place import NEG as MNEG
         from ..ops.unified import (make_mesh, padded_task_len,
                                    place_blocks_unified)
-        devices = jax.devices()
-        k = _sharded_device_count(ssn)
-        if k:
-            devices = devices[:k]
+        # same health-filtered mesh as the serial branch; an empty
+        # healthy set means no device to speculate on
+        _, devices = _mesh_devices(ssn)
+        if not devices:
+            return None
+        mesh_ids = tuple(d.id for d in devices)
         mesh = make_mesh(devices)
         state, alloc_d, maxt_d, n_pad = sharded_node_layout(
             node_t, int(mesh.devices.size))
@@ -1662,7 +1845,9 @@ def dispatch_speculative_solve(ssn, engine: str = "tpu-fused",
     return PendingFusedSolution(ordered_jobs, tasks, job_ix_np, jobs_list,
                                 node_t, packed, bucket, Jp,
                                 {j.uid for j in _eligible_jobs(ssn)},
-                                assumed_hint=assumed_hint)
+                                assumed_hint=assumed_hint,
+                                mesh_devices=(mesh_ids if engine
+                                              == "tpu-sharded" else None))
 
 
 def finalize_speculative_dispatch(pending: PendingFusedSolution
@@ -1843,10 +2028,12 @@ def prewarm_shapes(ssn, shape_configs=None, engine: str = "tpu-fused",
         elif engine == "tpu-sharded":
             from ..cache.snapshot import sharded_node_layout
             from ..ops.unified import make_mesh, place_blocks_unified
-            devices = jax.devices()
-            k = _sharded_device_count(ssn)
-            if k:
-                devices = devices[:k]
+            # warm the program at the CURRENT healthy mesh size — after a
+            # quarantine/readmission the next live solve runs at the new
+            # D and this is the bucket it will hit
+            _, devices = _mesh_devices(ssn)
+            if not devices:
+                continue
             mesh = make_mesh(devices)
             state, alloc_d, maxt_d, _ = sharded_node_layout(
                 node_t, int(mesh.devices.size))

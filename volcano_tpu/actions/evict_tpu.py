@@ -1003,8 +1003,17 @@ def _preempt_phase(ssn, pjobs, victims, inter_job: bool,
     fidle0 = tensors.future_idle0()
     score_arr = score_g
     if sharded:
+        from ..device_health import DEVICE_HEALTH
         from ..parallel.mesh import make_mesh
-        mesh = make_mesh(jax.devices())
+        # preempt rides the SAME health-filtered mesh as allocate: a
+        # quarantined device is out of the walk until its probe readmits
+        # it (allocate._probe_quarantined). Zero healthy devices drops to
+        # the single-device program on the default device — the walk is
+        # bit-identical at every D, so no decision changes either way.
+        devices = jax.devices()
+        live = set(DEVICE_HEALTH.healthy_devices([d.id for d in devices]))
+        healthy = [d for d in devices if d.id in live]
+        mesh = make_mesh(healthy or devices[:1])
         D = int(mesh.devices.size)
         if D == 1:
             # a 1-device mesh runs the single-device program: the sharded

@@ -1788,6 +1788,63 @@ class BoundedWorkRule(Rule):
         return findings
 
 
+# ---------------------------------------------------------------------------
+# VT021 — mesh mutations carry a tensor-epoch bump
+# ---------------------------------------------------------------------------
+
+class MeshMutationWitnessRule(Rule):
+    """Any call that changes the solver's device set — quarantining a
+    faulted device out of the mesh, or readmitting a probed one — makes
+    every persistent device tensor stale: the node layout was padded for
+    the old D, and the uploaded shards live on a mesh that no longer
+    exists. The mutation must therefore have a tensor-epoch bump
+    (``invalidate_device_state`` / ``retire_epoch``) on the path, same
+    function or one hop. A bare mutation is a heal that re-dispatches
+    onto tensors shaped for the dead mesh — at best an XLA shape error,
+    at worst a silently wrong placement read from a stale shard
+    (docs/robustness.md mesh failure model)."""
+
+    id = "VT021"
+    name = "mesh-mutation-witness"
+    contract = ("device-set mutation (quarantine/readmit) without a "
+                "tensor-epoch bump (invalidate_device_state/retire_epoch) "
+                "on the path (mesh fault containment, docs/robustness.md)")
+    # device_health.py holds the raw lattice verbs themselves plus the
+    # record_fault -> quarantine attribution delegation; it owns lattice
+    # state only — the caller owns the epoch
+    exclude = ("volcano_tpu/analysis/", "volcano_tpu/device_health.py")
+
+    MUTATOR_METHODS = {"quarantine", "readmit"}
+    WITNESS = {"invalidate_device_state", "retire_epoch"}
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr not in self.MUTATOR_METHODS:
+                continue
+            recv = dotted_name(node.func.value) or "<expr>"
+            fn = mod.enclosing_function(node.lineno)
+            if fn is not None:
+                # a lattice verb's own def (store-backed or test-double
+                # overrides) is the mutation floor, not a mesh decision
+                if fn.name in self.MUTATOR_METHODS:
+                    continue
+                if ctx.witness_in_scope(fn, self.WITNESS):
+                    continue
+            where = fn.qualname if fn else "<module>"
+            findings.append(self.finding(
+                mod, node,
+                f"device-set mutation {recv}.{node.func.attr}(...) in "
+                f"{where} without a tensor-epoch bump "
+                f"(invalidate_device_state / retire_epoch) on the path; "
+                f"persistent device tensors are shaped for the old mesh "
+                f"and must be retired before the next dispatch "
+                f"(docs/robustness.md mesh failure model)"))
+        return findings
+
+
 ALL_RULES: List[Rule] = [
     DirtyWitnessRule(), RawClockRule(), UnseededRandomRule(),
     JournalFunnelRule(), SimKillSwallowRule(), ShapeBucketRule(),
@@ -1796,7 +1853,7 @@ ALL_RULES: List[Rule] = [
     DtypeDisciplineRule(), SessionEscapeRule(),
     SpeculationIsolationRule(), StoreVerbFunnelRule(),
     InflightLedgerRule(), BoundedWorkRule(), MembershipFunnelRule(),
-    ElasticFunnelRule(),
+    ElasticFunnelRule(), MeshMutationWitnessRule(),
 ]
 
 # the rules that run on the shared dataflow/callgraph engine
@@ -1842,6 +1899,10 @@ solver(state, tasks)                       # no _bucket()/pad on the path''',
     ssn.evict(task, "elastic-scale")       # no elastic_shrink record:
                                            # replay can't tell a shrink
                                            # from a preemption''',
+    "VT021": '''def heal(self, device):
+    DEVICE_HEALTH.quarantine(device, "oom")   # no invalidate_device_state:
+                                              # next dispatch reuses tensors
+                                              # shaped for the dead mesh''',
     "VT010": '''packed = solver(state, tasks)          # device value
 n = int(packed[0])                     # implicit fetch OUTSIDE any
                                        # solve/replay/upload span''',

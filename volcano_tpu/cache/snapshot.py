@@ -112,6 +112,17 @@ def sharded_node_layout(node_t, D: int):
     zero-capacity (``max_tasks`` 0), so the kernels' ``ntasks <
     max_tasks`` predicate makes them unselectable — the same hole
     contract PersistentNodeTensors relies on for removed nodes.
+
+    Mesh changes (a mid-cycle heal or a probe readmission,
+    allocate._with_fallback/_probe_quarantined) re-run this at the new
+    ``D``: the heal path retires the tensor epoch first
+    (``invalidate_device_state``), so the next ``_node_tensors`` call
+    rebuilds PersistentNodeTensors — a full re-upload through the same
+    scatter path steady-state deltas use — and the re-pad here sizes the
+    node axis for the surviving device count. The pad rows are decision
+    inert at EVERY D (zero capacity), which is half of why the healed
+    solve is byte-identical to the pre-fault one; the other half is the
+    unified kernel's mesh-size invariance (ops/unified.py).
     Returns ``(NodeState, allocatable, max_tasks, n_pad)``."""
     import jax.numpy as jnp
     state = node_t.node_state()

@@ -350,6 +350,77 @@ class DeviceFaultInjector:
                                      f"attempt={self.attempt})")
 
 
+class MeshFaultInjector:
+    """Per-SHARD device faults for the unified sharded engine — install
+    as ``actions.allocate.DEVICE_FAULT_HOOK`` (same socket as
+    ``DeviceFaultInjector``, so the two are interchangeable per run).
+
+    Where ``DeviceFaultInjector`` raises anonymous faults (the fleet
+    cool-down path), this one ATTRIBUTES each fault to a live shard:
+    the raised ``DeviceFaultError`` carries ``device=<id>`` picked
+    seeded from ``allocate.CURRENT_MESH_DEVICES`` — the device-id tuple
+    the current solve attempt actually runs over, refreshed per heal
+    retry — so the per-device lattice quarantines exactly one chip and
+    the mesh heals mid-cycle instead of degrading to CPU. Kinds:
+    "oom", "device_lost", and "slow" (a slow-shard straggler,
+    classified as a device fault by the ``DEADLINE_EXCEEDED`` marker).
+
+    ``plan`` maps kind -> 1-based solve-attempt indices, or set
+    ``failure_rate`` for a seeded coin per attempt (same contract as
+    ``DeviceFaultInjector``). Probe dry-runs (hook calls named
+    ``"<engine>:probe:<id>"``) are separate attempts and fault against
+    the PROBED device when their index is in the plan — that is how a
+    test keeps a chip quarantined across probe windows. Faults recorded
+    in ``injected`` as ``(attempt, kind, device)``."""
+
+    _MESSAGES = {
+        "oom": "RESOURCE_EXHAUSTED: Out of memory allocating device buffer",
+        "device_lost": "DEVICE_LOST: device lost (simulated)",
+        "slow": "DEADLINE_EXCEEDED: collective timed out waiting on shard"
+                " (simulated straggler)",
+    }
+
+    def __init__(self, plan: Dict[str, Iterable[int]],
+                 failure_rate: Optional[float] = None, seed: int = 0):
+        self.plan = {kind: set(attempts) for kind, attempts in plan.items()}
+        self.failure_rate = failure_rate
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self.attempt = 0
+        self.injected: List[tuple] = []    # (attempt, kind, device)
+
+    def _pick_kind(self) -> Optional[str]:
+        if self.failure_rate is not None:
+            if self._rng.random() < self.failure_rate:
+                kinds = sorted(self.plan) or ["device_lost"]
+                return kinds[len(self.injected) % len(kinds)]
+            return None
+        for k, attempts in self.plan.items():
+            if self.attempt in attempts:
+                return k
+        return None
+
+    def __call__(self, engine: str) -> None:
+        from .device_health import DeviceFaultError
+        self.attempt += 1
+        kind = self._pick_kind()
+        if kind is None:
+            return
+        if ":probe:" in engine:
+            device = int(engine.rsplit(":", 1)[1])
+        else:
+            from .actions.allocate import CURRENT_MESH_DEVICES
+            if not CURRENT_MESH_DEVICES:
+                return               # nothing live to attribute to
+            device = CURRENT_MESH_DEVICES[
+                self._rng.randrange(len(CURRENT_MESH_DEVICES))]
+        self.injected.append((self.attempt, kind, device))
+        raise DeviceFaultError(
+            kind, f"chaos: {self._MESSAGES[kind]} on device {device} "
+                  f"(seed={self.seed}, attempt={self.attempt})",
+            device=device)
+
+
 class SimKill(BaseException):
     """A simulated process death. Derives from BaseException ON PURPOSE:
     the cache's bind/evict funnels catch ``Exception`` to roll back and

@@ -254,6 +254,19 @@ def build_parser() -> argparse.ArgumentParser:
                     "windows and the last split/merge records — read "
                     "from the process-local metrics detail")
 
+    dev = sub.add_parser(
+        "device", description="Accelerator-mesh verbs (docs/robustness.md "
+                              "mesh failure model): the per-device health "
+                              "lattice, quarantine windows and the "
+                              "degradation rung — read from the process-"
+                              "local metrics detail").add_subparsers(
+                                  dest="verb")
+    dev.add_parser(
+        "status", description="Fleet window plus every known device's "
+                              "lattice state (ok/suspect/quarantined/"
+                              "probe), consecutive faults, window "
+                              "remaining and readmission count")
+
     st = sub.add_parser(
         "store", description="Store-boundary verbs (docs/robustness.md "
                              "store failure model): object counts, "
@@ -389,6 +402,41 @@ def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
             for k in ("last_split", "last_merge"):
                 if d.get(k):
                     out(f"p{pid}\t{k}={json.dumps(d[k], sort_keys=True)}")
+        return 0
+    if args.group == "device" and args.verb == "status":
+        # process-local, like rebalance-status: the health lattice lives
+        # in the scheduler process that runs the sharded solver
+        from .. import metrics
+        detail = metrics.health_detail().get("device", {})
+        counts = metrics.mesh_counts()
+        out(f"fleet\tavailable={detail.get('available', True)}\t"
+            f"consecutive_faults={detail.get('consecutive_faults', 0)}\t"
+            f"total_faults={detail.get('total_faults', 0)}\t"
+            f"last_kind={detail.get('last_kind')}\t"
+            f"cooldown_remaining_s={detail.get('cooldown_remaining_s', 0.0)}")
+        heals = {k.split("/", 1)[1]: int(v)
+                 for k, v in sorted(counts.items())
+                 if k.startswith("heals/")}
+        quarantines = {k.split("/", 1)[1]: int(v)
+                       for k, v in sorted(counts.items())
+                       if k.startswith("quarantines/")}
+        out(f"mesh\trung={int(counts.get('rung', 0))}\t"
+            f"devices_healthy={int(counts.get('devices_healthy', 0))}\t"
+            f"readmissions={int(counts.get('readmissions', 0))}\t"
+            f"heals={heals}\tquarantines={quarantines}")
+        devices = detail.get("devices", {})
+        if not devices:
+            out("no per-device state recorded — the sharded engine has "
+                "not run in this process (or the lattice was reset)")
+            return 0
+        for did in sorted(devices, key=int):
+            d = devices[did]
+            out(f"device/{did}\tstate={d.get('state')}\t"
+                f"consecutive_faults={d.get('consecutive_faults', 0)}\t"
+                f"total_faults={d.get('total_faults', 0)}\t"
+                f"last_kind={d.get('last_kind')}\t"
+                f"window_remaining_s={d.get('window_remaining_s', 0.0)}\t"
+                f"readmissions={d.get('readmissions', 0)}")
         return 0
     if args.group == "job" and args.verb in ("suspend", "resume", "scale"):
         if funnel is not None:

@@ -1,4 +1,5 @@
-"""Device-fault containment: classify accelerator errors and cool down.
+"""Device-fault containment: classify accelerator errors, quarantine the
+failing chip, and cool the fleet down only when nothing smaller works.
 
 A batched solve can fail for two very different reasons, and the right
 response differs (docs/robustness.md):
@@ -12,26 +13,57 @@ response differs (docs/robustness.md):
   re-fails — and after a device loss the device-resident tensor mirrors
   are gone, so any cached device state is poison.
 
-``classify_device_fault`` tells the two apart; ``DeviceHealth`` is the
-cool-down state machine the allocate action consults:
+``classify_device_fault`` tells the two apart. Containment is now a
+PER-DEVICE lattice plus the original fleet-level cool-down:
 
-    OK --fault--> COOLDOWN (allocate degrades to the CPU/callbacks
-                  engine; volcano_device_healthy=0)
-    COOLDOWN --window expires--> PROBE (the next cycle attempts the
-                  device engine once)
-    PROBE --success--> OK (counters reset)
-    PROBE --fault--> COOLDOWN, window doubled (capped)
+- **Attributed faults** (the error exposes the failing shard — an
+  injected ``DeviceFaultError.device`` or a device ordinal in the XLA
+  message, ``attribute_device_fault``) quarantine ONLY that device::
+
+      OK --attributed fault--> QUARANTINED (excluded from the mesh;
+                    per-device window, doubling on repeat)
+      QUARANTINED --window expires--> PROBE (still excluded from LIVE
+                    solves; allocate runs a throwaway dry-run solve on
+                    the device — never a live decision)
+      PROBE --dry-run succeeds--> readmitted (OK; the mesh re-forms
+                    over the grown device set, epoch bumped)
+      PROBE --dry-run faults--> QUARANTINED, window doubled (capped)
+
+  The degradation ladder rides the healthy set: full mesh → re-formed
+  mesh over the survivors (byte-identical decisions — the unified
+  solver is mesh-size invariant by construction) → single device → the
+  CPU placer, each rung only when the one above is unavailable.
+
+- **Unattributed faults** (the error names no shard) mark every known
+  device SUSPECT and open the original FLEET window — the D=1
+  degenerate case, and exactly the pre-lattice behavior::
+
+      OK --fault--> COOLDOWN (allocate degrades to the CPU/callbacks
+                    engine; volcano_device_healthy=0)
+      COOLDOWN --window expires--> PROBE (the next cycle attempts the
+                    device engine once)
+      PROBE --success--> OK (counters reset; SUSPECT marks clear)
+      PROBE --fault--> COOLDOWN, window doubled (capped)
+
+  SUSPECT is a marker, not an exclusion: suspicion without attribution
+  must not shrink the mesh (it would shrink it to nothing), so suspect
+  devices stay in the healthy set and the fleet window is what gates
+  dispatch.
 
 Every transition is exported (``volcano_device_faults_total{kind}``,
-``volcano_device_healthy``, /healthz?detail). The window runs on an
-injectable ``time_fn`` so the sim and tests drive it on virtual time.
+``volcano_device_quarantines_total{kind}``,
+``volcano_mesh_devices_healthy``, ``volcano_device_healthy``,
+/healthz?detail). The windows run on an injectable ``time_fn`` so the
+sim and tests drive them on virtual time; ``reset`` (sim restarts)
+clears the per-device lattice too — health lives in process memory.
 """
 
 from __future__ import annotations
 
+import re
 import threading
 import time
-from typing import Optional
+from typing import Dict, Iterable, List, Optional
 
 DEFAULT_COOLDOWN_S = 30.0
 DEFAULT_MAX_COOLDOWN_S = 480.0
@@ -41,20 +73,37 @@ DEFAULT_MAX_COOLDOWN_S = 480.0
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "OOM")
 _LOST_MARKERS = ("DEVICE_LOST", "device lost", "Device lost",
                  "DATA_LOSS", "failed to enqueue")
+# a straggling shard surfaces as a collective timeout — a device fault
+# (the chip is unhealthy), not a program bug
+_SLOW_MARKERS = ("DEADLINE_EXCEEDED", "collective timed out")
+
+# message shapes that expose WHICH device faulted — jaxlib's device-lost
+# and per-core OOM errors name the ordinal in these forms
+_DEVICE_ID_PATTERNS = (
+    re.compile(r"\bdevice[:= ]+(\d+)\b", re.IGNORECASE),
+    re.compile(r"\bTPU[_ ](\d+)\b"),
+    re.compile(r"\bshard[:= ]+(\d+)\b", re.IGNORECASE),
+)
 
 
 class DeviceFaultError(RuntimeError):
-    """A simulated device error (chaos.DeviceFaultInjector raises these
-    with ``kind`` in {"oom", "device_lost"}); classified exactly like
-    the real XlaRuntimeError equivalents."""
+    """A simulated device error (chaos.DeviceFaultInjector /
+    chaos.MeshFaultInjector raise these with ``kind`` in
+    {"oom", "device_lost", "slow"}); classified exactly like the real
+    XlaRuntimeError equivalents. ``device`` carries the faulting shard's
+    device id when the injector models an attributed fault — the same
+    information a real per-core XLA error exposes in its message."""
 
-    def __init__(self, kind: str, message: Optional[str] = None):
+    def __init__(self, kind: str, message: Optional[str] = None,
+                 device: Optional[int] = None):
         super().__init__(message or f"simulated device fault: {kind}")
         self.kind = kind
+        self.device = device
 
 
 def classify_device_fault(exc: BaseException) -> Optional[str]:
-    """Return the device-fault kind ("oom" | "device_lost" | "xla") when
+    """Return the device-fault kind ("oom" | "device_lost" | "slow" |
+    "xla") when
     ``exc`` is a device error, None for logic/solver faults. Matches on
     the exception type name (jaxlib's XlaRuntimeError lives at different
     import paths across releases) plus message markers."""
@@ -67,12 +116,60 @@ def classify_device_fault(exc: BaseException) -> Optional[str]:
         return "oom"
     if any(m in msg for m in _LOST_MARKERS):
         return "device_lost"
+    if any(m in msg for m in _SLOW_MARKERS):
+        return "slow"
     return "xla"
 
 
+def attribute_device_fault(exc: BaseException,
+                           devices: Optional[Iterable[int]] = None
+                           ) -> Optional[int]:
+    """Which device does a classified fault name? The injected ``device``
+    attribute when present, else the first device ordinal the message
+    exposes (``_DEVICE_ID_PATTERNS``). Returns None when the error names
+    no shard — the SUSPECT-all path — or names one outside ``devices``
+    (a stale ordinal from a previous mesh must not quarantine a device
+    that was not even solving)."""
+    dev = getattr(exc, "device", None)
+    if dev is None:
+        msg = str(exc)
+        for pat in _DEVICE_ID_PATTERNS:
+            m = pat.search(msg)
+            if m:
+                dev = int(m.group(1))
+                break
+    if dev is None:
+        return None
+    dev = int(dev)
+    if devices is not None and dev not in set(devices):
+        return None
+    return dev
+
+
+class _DeviceRecord:
+    """One device's health state. ``state`` is "ok" | "suspect" |
+    "quarantined"; PROBE is derived (quarantined with an expired
+    window) so virtual-clock advances need no transition callback."""
+
+    __slots__ = ("state", "consecutive_faults", "total_faults",
+                 "last_kind", "quarantined_until", "readmissions")
+
+    def __init__(self):
+        self.state = "ok"
+        self.consecutive_faults = 0
+        self.total_faults = 0
+        self.last_kind: Optional[str] = None
+        self.quarantined_until: Optional[float] = None
+        self.readmissions = 0
+
+
 class DeviceHealth:
-    """Cool-down state machine for the device engines (module-global
-    ``DEVICE_HEALTH`` instance; allocate consults it every cycle)."""
+    """Per-device health lattice + fleet cool-down state machine
+    (module-global ``DEVICE_HEALTH`` instance; allocate consults it
+    every cycle). The pre-lattice single-device API (``record_fault``
+    with no device, ``record_ok``, ``available``, ``cooldown_remaining``)
+    operates on the FLEET window — the D=1 degenerate case — so existing
+    callers and tests are unchanged."""
 
     def __init__(self, cooldown_s: float = DEFAULT_COOLDOWN_S,
                  max_cooldown_s: float = DEFAULT_MAX_COOLDOWN_S,
@@ -85,16 +182,26 @@ class DeviceHealth:
         self.total_faults = 0
         self.last_kind: Optional[str] = None
         self._cooldown_until: Optional[float] = None
+        self._devices: Dict[int, _DeviceRecord] = {}
 
-    def record_fault(self, kind: str) -> float:
-        """A device fault occurred: open (or, after an expired window's
-        failed probe, DOUBLE) the cool-down window. A fault reported
-        while the window is still open is the same outage classified
-        twice (e.g. the tensor refresh AND the solve both blow up in one
+    # -- fleet-level machine (the original API; D=1 degenerate case) ------
+
+    def record_fault(self, kind: str,
+                     device: Optional[int] = None) -> float:
+        """A device fault occurred. With ``device`` the fault is
+        ATTRIBUTED: quarantine exactly that shard (``quarantine``) and
+        leave the fleet window closed — the mesh heals around it.
+        Without, the original fleet semantics: open (or, after an
+        expired window's failed probe, DOUBLE) the cool-down window and
+        mark every known device SUSPECT. A fleet fault reported while
+        the window is still open is the same outage classified twice
+        (e.g. the tensor refresh AND the solve both blow up in one
         cycle) — it updates ``last_kind`` but neither bumps the counters
         nor extends the window. Returns the window length in force. Also
         publishes ``volcano_device_faults_total{kind}`` for fresh
         faults, so call sites cannot double-count either."""
+        if device is not None:
+            return self.quarantine(device, kind)
         with self._lock:
             now = self.time_fn()
             if self._cooldown_until is not None \
@@ -108,26 +215,43 @@ class DeviceHealth:
                 self.cooldown_s * (2 ** (self.consecutive_faults - 1)),
                 self.max_cooldown_s)
             self._cooldown_until = now + window
+            # unattributed: the outage could be any shard — suspect all
+            for rec in self._devices.values():
+                if rec.state == "ok":
+                    rec.state = "suspect"
+                rec.last_kind = kind
         from . import metrics
         metrics.register_device_fault(kind)
         self._publish()
         return window
 
-    def record_ok(self) -> None:
-        """A device solve completed: close the state machine back to OK
-        (no-op when already OK — the hot path stays branch-cheap)."""
+    def record_ok(self, device: Optional[int] = None) -> None:
+        """A device solve completed: close the fleet machine back to OK
+        and clear SUSPECT marks (the whole healthy mesh just proved
+        itself). Quarantined devices stay quarantined — only a probe
+        readmits. With ``device``, clears that one device's suspicion.
+        No-op when already OK — the hot path stays branch-cheap."""
         with self._lock:
+            if device is not None:
+                rec = self._devices.get(device)
+                if rec is not None and rec.state == "suspect":
+                    rec.state = "ok"
+                return
+            suspects = [r for r in self._devices.values()
+                        if r.state == "suspect"]
             if self.consecutive_faults == 0 \
-                    and self._cooldown_until is None:
+                    and self._cooldown_until is None and not suspects:
                 return
             self.consecutive_faults = 0
             self._cooldown_until = None
+            for rec in suspects:
+                rec.state = "ok"
         self._publish()
 
     def available(self) -> bool:
-        """May allocate dispatch to the device this cycle? True in OK
-        and PROBE (window expired — one re-probe attempt is the only way
-        to learn the device recovered), False inside the window."""
+        """May allocate dispatch to the device fleet this cycle? True in
+        OK and PROBE (window expired — one re-probe attempt is the only
+        way to learn the device recovered), False inside the window."""
         with self._lock:
             until = self._cooldown_until
             return until is None or self.time_fn() >= until
@@ -138,10 +262,122 @@ class DeviceHealth:
                 return 0.0
             return max(0.0, self._cooldown_until - self.time_fn())
 
+    # -- per-device lattice ----------------------------------------------
+
+    def quarantine(self, device: int, kind: str) -> float:
+        """An ATTRIBUTED fault: pull ``device`` out of the mesh. Same
+        dedup/doubling contract as the fleet window, keyed per device: a
+        fault inside the open window only updates ``last_kind``; a fresh
+        one (first, or a failed probe after expiry) doubles the window
+        (capped). The caller owns the epoch bump — a quarantine changes
+        the device set, so the resident tensor layout is stale (vlint
+        VT021). Returns the window length in force."""
+        with self._lock:
+            rec = self._devices.setdefault(int(device), _DeviceRecord())
+            now = self.time_fn()
+            if rec.quarantined_until is not None \
+                    and now < rec.quarantined_until:
+                rec.last_kind = kind
+                return rec.quarantined_until - now
+            rec.consecutive_faults += 1
+            rec.total_faults += 1
+            rec.last_kind = kind
+            rec.state = "quarantined"
+            window = min(
+                self.cooldown_s * (2 ** (rec.consecutive_faults - 1)),
+                self.max_cooldown_s)
+            rec.quarantined_until = now + window
+        from . import metrics
+        metrics.register_device_fault(kind)
+        metrics.register_device_quarantine(kind)
+        self._publish()
+        return window
+
+    def readmit(self, device: int) -> None:
+        """A quarantined device's PROBE dry-run succeeded: back to OK,
+        counters reset. The caller owns the epoch bump — readmission
+        grows the device set, re-forming the mesh (vlint VT021)."""
+        with self._lock:
+            rec = self._devices.get(int(device))
+            if rec is None or rec.state != "quarantined":
+                return
+            rec.state = "ok"
+            rec.consecutive_faults = 0
+            rec.quarantined_until = None
+            rec.readmissions += 1
+        from . import metrics
+        metrics.register_device_readmission()
+        self._publish()
+
+    def healthy_devices(self, device_ids: Iterable[int]) -> List[int]:
+        """The subset of ``device_ids`` eligible for LIVE solves, in the
+        given order: everything not quarantined. SUSPECT devices stay in
+        (suspicion without attribution must not shrink the mesh); PROBE
+        devices stay out — an expired window readmits only through a
+        successful dry-run, never a live decision. Also registers
+        previously unseen ids so unattributed faults can suspect them."""
+        with self._lock:
+            out = []
+            for did in device_ids:
+                rec = self._devices.setdefault(int(did), _DeviceRecord())
+                if rec.state != "quarantined":
+                    out.append(did)
+            return out
+
+    def probe_candidates(self, device_ids: Iterable[int]) -> List[int]:
+        """Quarantined devices whose window expired — the PROBE state:
+        ready for a throwaway dry-run solve (allocate owns the probe;
+        success readmits, a fault doubles the window)."""
+        with self._lock:
+            now = self.time_fn()
+            return [did for did in device_ids
+                    if (rec := self._devices.get(int(did))) is not None
+                    and rec.state == "quarantined"
+                    and rec.quarantined_until is not None
+                    and now >= rec.quarantined_until]
+
+    def device_state(self, device: int) -> str:
+        """"ok" | "suspect" | "quarantined" | "probe" (derived)."""
+        with self._lock:
+            rec = self._devices.get(int(device))
+            if rec is None:
+                return "ok"
+            if rec.state == "quarantined":
+                if rec.quarantined_until is not None \
+                        and self.time_fn() >= rec.quarantined_until:
+                    return "probe"
+                return "quarantined"
+            return rec.state
+
+    # -- introspection / lifecycle ---------------------------------------
+
     def detail(self) -> dict:
         with self._lock:
             until = self._cooldown_until
             now = self.time_fn()
+            devices = {}
+            healthy = quarantined = 0
+            for did in sorted(self._devices):
+                rec = self._devices[did]
+                if rec.state == "quarantined":
+                    quarantined += 1
+                    state = ("probe" if rec.quarantined_until is not None
+                             and now >= rec.quarantined_until
+                             else "quarantined")
+                    remaining = round(max(
+                        0.0, (rec.quarantined_until or now) - now), 3)
+                else:
+                    healthy += 1
+                    state = rec.state
+                    remaining = 0.0
+                devices[str(did)] = {
+                    "state": state,
+                    "consecutive_faults": rec.consecutive_faults,
+                    "total_faults": rec.total_faults,
+                    "last_kind": rec.last_kind,
+                    "window_remaining_s": remaining,
+                    "readmissions": rec.readmissions,
+                }
             return {
                 "available": until is None or now >= until,
                 "consecutive_faults": self.consecutive_faults,
@@ -149,16 +385,23 @@ class DeviceHealth:
                 "last_kind": self.last_kind,
                 "cooldown_remaining_s": round(max(0.0, (until - now)), 3)
                 if until is not None else 0.0,
+                "devices": devices,
+                "devices_known": len(devices),
+                "devices_healthy": healthy,
+                "devices_quarantined": quarantined,
             }
 
     def reset(self, time_fn=None) -> None:
-        """Full reset (tests / sim restart); optionally swap the time
-        source."""
+        """Full reset, fleet AND per-device lattice (tests / sim
+        restart — health lives in process memory, so a simulated process
+        death forgets quarantines exactly like a real one); optionally
+        swap the time source."""
         with self._lock:
             self.consecutive_faults = 0
             self.total_faults = 0
             self.last_kind = None
             self._cooldown_until = None
+            self._devices = {}
             if time_fn is not None:
                 self.time_fn = time_fn
         self._publish()
@@ -167,6 +410,8 @@ class DeviceHealth:
         from . import metrics
         d = self.detail()
         metrics.set_device_health(d["available"], d)
+        metrics.set_mesh_devices_healthy(d["devices_healthy"],
+                                         d["devices_known"])
 
 
 DEVICE_HEALTH = DeviceHealth()

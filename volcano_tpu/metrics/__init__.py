@@ -137,6 +137,23 @@ if _HAVE_PROM:
         f"{_SUBSYSTEM}_device_degraded_cycles_total",
         "Allocate cycles that ran on the CPU placer because the "
         "device cool-down window was open")
+    _device_quarantines = Counter(
+        f"{_SUBSYSTEM}_device_quarantines_total",
+        "Devices pulled out of the mesh by an attributed fault "
+        "(docs/robustness.md mesh failure model)", ["kind"])
+    _mesh_heals = Counter(
+        f"{_SUBSYSTEM}_mesh_heals_total",
+        "Mid-cycle mesh re-formations: a device fault during solve "
+        "quarantined the shard and the same solve re-dispatched over "
+        "the survivors", ["trigger"])
+    _mesh_healthy = Gauge(
+        f"{_SUBSYSTEM}_mesh_devices_healthy",
+        "Devices currently eligible for live sharded solves "
+        "(known minus quarantined)")
+    _degradation_rung = Gauge(
+        f"{_SUBSYSTEM}_degradation_rung",
+        "The sharded engine's current degradation-ladder rung: 0 full "
+        "mesh, 1 shrunken mesh, 2 single device, 3 CPU placer")
     _leader_g = Gauge(f"{_SUBSYSTEM}_leader",
                       "1 this replica holds the scheduler lease, 0 "
                       "follower/fenced (docs/robustness.md HA)")
@@ -732,6 +749,71 @@ def set_device_health(available: bool, detail: Optional[dict] = None) -> None:
         _device_ok.set(1.0 if available else 0.0)
 
 
+def register_device_quarantine(kind: str) -> None:
+    """An attributed device fault quarantined one shard — the mesh heals
+    around it instead of dumping the solve on the CPU placer."""
+    with _lock:
+        _counters[("device_quarantines", kind)] += 1
+    if _HAVE_PROM:
+        _device_quarantines.labels(kind=kind).inc()
+
+
+def register_device_readmission() -> None:
+    """A quarantined device's probe dry-run succeeded and the device
+    rejoined the mesh (epoch bumped by the caller)."""
+    with _lock:
+        _counters[("device_readmissions",)] += 1
+
+
+def register_mesh_heal(trigger: str) -> None:
+    """A mid-cycle mesh heal: the failing shard was quarantined, the
+    tensor epoch retired, and the SAME solve re-dispatched over the
+    surviving devices within the same cycle."""
+    with _lock:
+        _counters[("mesh_heals", trigger)] += 1
+    if _HAVE_PROM:
+        _mesh_heals.labels(trigger=trigger).inc()
+
+
+def set_mesh_devices_healthy(healthy: int, known: int) -> None:
+    """Publish the per-device lattice's healthy-device count (pushed by
+    DeviceHealth on every transition, like set_device_health)."""
+    with _lock:
+        _gauges[("mesh_devices_healthy",)] = float(healthy)
+        _gauges[("mesh_devices_known",)] = float(known)
+    if _HAVE_PROM:
+        _mesh_healthy.set(float(healthy))
+
+
+def set_degradation_rung(rung: int) -> None:
+    """Publish the sharded engine's current degradation-ladder rung
+    (0 full mesh, 1 shrunken mesh, 2 single device, 3 CPU placer)."""
+    with _lock:
+        _gauges[("degradation_rung",)] = float(rung)
+    if _HAVE_PROM:
+        _degradation_rung.set(float(rung))
+
+
+def mesh_counts() -> Dict[str, float]:
+    """Snapshot of the mesh-containment counters for delta-based
+    reporting (sim/report.py ``mesh`` section): flattened
+    ``heals/<trigger>``, ``quarantines/<kind>``, plus readmissions,
+    degraded cycles and the current rung/healthy gauges."""
+    with _lock:
+        out: Dict[str, float] = {}
+        for key, v in _counters.items():
+            if key[0] == "mesh_heals":
+                out[f"heals/{key[1]}"] = v
+            elif key[0] == "device_quarantines":
+                out[f"quarantines/{key[1]}"] = v
+        out["readmissions"] = _counters.get(("device_readmissions",), 0)
+        out["degraded_cycles"] = _counters.get(("device_degraded_cycles",),
+                                               0)
+        out["rung"] = _gauges.get(("degradation_rung",), 0.0)
+        out["devices_healthy"] = _gauges.get(("mesh_devices_healthy",), 0.0)
+        return out
+
+
 def set_leader(leading: bool, role: str = "", epoch: int = 0) -> None:
     """Publish this replica's leadership state (the scheduler's HA gate
     calls it on every role transition and each gated cycle); role/epoch
@@ -943,6 +1025,8 @@ _EXPO_GAUGES = {
     "resync_dead_letter_size": (f"{_SUBSYSTEM}_resync_dead_letter_size",
                                 None),
     "device_healthy": (f"{_SUBSYSTEM}_device_healthy", None),
+    "mesh_devices_healthy": (f"{_SUBSYSTEM}_mesh_devices_healthy", None),
+    "degradation_rung": (f"{_SUBSYSTEM}_degradation_rung", None),
     "leader": (f"{_SUBSYSTEM}_leader", None),
     "partition_leader": (f"{_SUBSYSTEM}_partition_leader", "partition"),
     "partition_count": (f"{_SUBSYSTEM}_partition_count", None),

@@ -83,7 +83,15 @@ def make_mesh(devices=None, axis: str = NODE_AXIS) -> Mesh:
     construction is not free (it hashes the device list and builds the
     sharding machinery); the preempt/allocate hot paths call this every
     phase, so the cache is what keeps the sharded engines from paying it
-    per cycle."""
+    per cycle.
+
+    The cache key is the device-id tuple, so every healthy subset the
+    degradation ladder walks through (allocate._mesh_devices) gets its
+    own cached Mesh — a heal that drops device 3 and a later probe that
+    readmits it alternate between two cache ENTRIES, never rebuilding
+    either. Meshes over retired/quarantined device sets are tiny (the
+    Mesh holds device handles, not buffers), so no eviction is needed:
+    the entry count is bounded by the subsets actually visited."""
     devices = tuple(devices) if devices is not None else tuple(jax.devices())
     key = (tuple(d.id for d in devices), axis)
     mesh = _MESH_CACHE.get(key)

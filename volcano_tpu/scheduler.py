@@ -910,6 +910,22 @@ class Scheduler:
         serial action within the same cycle."""
         from .actions import allocate as alloc
         alloc.LAST_FALLBACK.clear()
+        spec_mesh = getattr(plan.pending, "mesh_devices", None)
+        if spec_mesh is not None \
+                and alloc.current_mesh_ids(ssn) != tuple(spec_mesh):
+            # the mesh changed between dispatch and commit — a device was
+            # quarantined (its shard of the packed result is gone) or
+            # readmitted (the live layout re-padded to a different D).
+            # Either way the dispatched result is unusable: classify as
+            # conflict, which retires the pinned epoch pair, and re-solve
+            # serially over the mesh as it is NOW.
+            log.warning("mesh changed under speculation (%s -> %s): "
+                        "conflict, re-solving serially", spec_mesh,
+                        alloc.current_mesh_ids(ssn))
+            self._finish_speculation(plan, "conflict")
+            action.execute(ssn)
+            self._warmstart_empty = self._allocate_kept_empty()
+            return
         mapped = ordered = None
         try:
             sol = alloc.finalize_speculative_dispatch(plan.pending)

@@ -268,6 +268,31 @@ def main(argv=None) -> int:
                          "construction) and assert the full-mesh decision "
                          "plane is BYTE-IDENTICAL (exit 1 on mismatch); "
                          "requires --sharded")
+    ap.add_argument("--mesh-chaos", action="store_true",
+                    help="the mesh fault soak preset (docs/robustness.md "
+                         "mesh failure model): seeded per-shard "
+                         "device-lost/OOM/slow-shard faults at rate 0.2 "
+                         "(chaos.MeshFaultInjector), each attributed to "
+                         "a live shard so the per-device lattice "
+                         "quarantines exactly that chip, the mesh heals "
+                         "mid-cycle over the survivors, and expired "
+                         "quarantines are probed + readmitted on the "
+                         "virtual clock. Implies --sharded")
+    ap.add_argument("--mesh-fault-rate", type=float, default=None,
+                    help="seeded per-solve-attempt mesh fault rate "
+                         "(overrides the --mesh-chaos preset; implies "
+                         "--mesh-chaos)")
+    ap.add_argument("--mesh-fault-seed", type=int, default=None,
+                    help="mesh fault RNG seed (default: --seed)")
+    ap.add_argument("--verify-mesh-equivalence", action="store_true",
+                    help="also run the SAME trace FAULT-FREE at "
+                         "sharded-devices=1 (the healthy single-device "
+                         "oracle) and assert the mesh-chaos decision "
+                         "plane is byte-identical (mesh section "
+                         "stripped), zero double-binds, faults actually "
+                         "injected, a quarantined device readmitted, and "
+                         "the CPU-placer rung never reached (exit 1 "
+                         "otherwise); implies --mesh-chaos")
     ap.add_argument("--verify-pipelined-equivalence", action="store_true",
                     help="also run the SERIAL single-scheduler oracle "
                          "and assert equivalence: byte-identical "
@@ -276,6 +301,19 @@ def main(argv=None) -> int:
                          "accounting equivalence + zero double-binds "
                          "otherwise (exit 1 on mismatch)")
     args = ap.parse_args(argv)
+
+    # the mesh-chaos preset (docs/robustness.md mesh failure model):
+    # resolved BEFORE the conf is pinned because it implies the sharded
+    # engine — mesh faults are attributed per shard, and only the
+    # unified sharded solver has shards to attribute to
+    mesh_fault_rate = args.mesh_fault_rate
+    mesh_chaos = bool(args.mesh_chaos or args.verify_mesh_equivalence
+                      or mesh_fault_rate is not None)
+    if mesh_chaos:
+        if mesh_fault_rate is None:
+            mesh_fault_rate = 0.2
+        args.sharded = True
+    mesh_fault_rate = mesh_fault_rate or 0.0
 
     if args.list:
         for name in sorted(SCENARIOS):
@@ -401,7 +439,8 @@ def main(argv=None) -> int:
 
     def run(kills, replicas=None, losses=None, federated=None,
             pipelined=None, fast_admit=None, fault_rate=None, torn=None,
-            ack_rate=None, lease_rate=None, conf=None):
+            ack_rate=None, lease_rate=None, conf=None, mesh_rate=None):
+        mesh_r = mesh_fault_rate if mesh_rate is None else mesh_rate
         bw, ew = wraps()
         runner = SimRunner(trace,
                            conf_text=conf_text if conf is None else conf,
@@ -443,7 +482,10 @@ def main(argv=None) -> int:
                            if lease_rate is None else lease_rate,
                            lease_fault_seed=args.lease_fault_seed,
                            elastic_gangs=args.elastic_gangs,
-                           topology_weight=args.topology_weight)
+                           topology_weight=args.topology_weight,
+                           mesh_chaos=mesh_chaos and mesh_r > 0,
+                           mesh_fault_rate=mesh_r,
+                           mesh_fault_seed=args.mesh_fault_seed)
         return runner.run()
 
     if args.trace_out:
@@ -781,6 +823,60 @@ def main(argv=None) -> int:
         print(f"sharded-equivalence OK: devices="
               f"{args.sharded_devices or len(_jax.devices())} vs oracle 1, "
               f"accounting={terminal_accounting(report)}", file=sys.stderr)
+    if args.verify_mesh_equivalence:
+        import json as _json
+        from .runner import sharded_sim_conf
+        # the degradation ladder's whole contract in one diff: every
+        # heal, probe and readmission the chaotic run went through must
+        # leave the decision plane BYTE-identical to the fault-free
+        # single-device oracle (mesh-size invariance cashes in at every
+        # rung), and the CPU-placer rung must never fire while any
+        # device survives. Kills compose: the oracle gets the SAME
+        # --kill-cycles, so restart accounting matches too.
+        oracle = run(kill_cycles, conf=sharded_sim_conf(1), mesh_rate=0.0)
+        mesh = report.get("mesh", {})
+        problems = []
+        got_json = _json.dumps(oracle_part(report), sort_keys=True,
+                               separators=(",", ":"))
+        want_json = _json.dumps(oracle_part(oracle), sort_keys=True,
+                                separators=(",", ":"))
+        if got_json != want_json:
+            problems.append("mesh-chaos decision plane differs from the "
+                            "healthy single-device oracle (degradation "
+                            "ladder broke mesh-size invariance)")
+        if report.get("double_binds"):
+            problems.append(f"double-binds under mesh faults: "
+                            f"{report['double_binds']}")
+        if not mesh.get("injected"):
+            problems.append("no mesh faults injected — the soak is "
+                            "vacuous (raise --mesh-fault-rate or run "
+                            "more cycles)")
+        if mesh.get("heals") == {} and mesh.get("injected"):
+            problems.append("faults injected but no mesh heal fired — "
+                            "attribution or the heal path is broken")
+        if not mesh.get("readmissions"):
+            problems.append("no quarantined device was readmitted — the "
+                            "probe/readmit arc never completed (run more "
+                            "cycles or shorten the window)")
+        if mesh.get("cpu_fallback_cycles"):
+            problems.append(
+                f"{mesh['cpu_fallback_cycles']} cycle(s) fell to the "
+                f"CPU-placer rung — only legal with zero healthy "
+                f"devices, which this soak never reaches")
+        if report["jobs"]["completed"] != report["jobs"]["arrived"]:
+            problems.append("mesh-chaos run did not complete every "
+                            "arrived job")
+        if problems:
+            for p in problems:
+                print(f"mesh-equivalence FAILED: {p}", file=sys.stderr)
+            return 1
+        print(f"mesh-equivalence OK: injected={mesh.get('injected')}, "
+              f"heals={mesh.get('heals')}, "
+              f"readmissions={mesh.get('readmissions')}, "
+              f"rung_cycles={mesh.get('rung_cycles')}, "
+              f"restarts={report.get('restarts', 0)}, "
+              f"accounting={terminal_accounting(report)}",
+              file=sys.stderr)
     if args.verify_pipelined_equivalence:
         import json as _json
         from .report import pipelined_oracle_part

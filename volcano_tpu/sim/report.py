@@ -233,6 +233,14 @@ def build_report(runner, actions_ms: Dict[tuple, list],
         # fault-free scenario stays byte-identical to the pre-overload
         # decision plane.
         report["overload"] = runner.overload_stats()
+    if getattr(runner, "mesh_chaos", False):
+        # the mesh plane (docs/robustness.md mesh failure model): seeded
+        # per-shard faults, heal/quarantine/readmission deltas, the
+        # per-rung cycle tally and the never-CPU witness. Seeded
+        # injector + virtual-clock windows ⇒ byte-reproducible; only
+        # emitted under --mesh-chaos, so every fault-free scenario stays
+        # byte-identical to the pre-mesh decision plane.
+        report["mesh"] = runner.mesh_stats()
     if getattr(runner, "pipelined_mode", False):
         # deterministic (cycle-logic-driven) but MECHANISM, not decisions:
         # pipelined_oracle_part strips it for the serial-oracle diff
@@ -317,6 +325,8 @@ def oracle_part(report: dict) -> dict:
     part = deterministic_part(report)
     part.pop("ha", None)
     part.pop("federation", None)
+    part.pop("mesh", None)      # chaos mechanism, not decisions — the
+    #                             fault-free oracle has no section at all
     return part
 
 

@@ -102,6 +102,15 @@ tiers:
 """
 
 
+# quarantine windows under --mesh-chaos, in VIRTUAL seconds: short
+# enough that quarantine → probe → readmit completes within a scenario
+# (the default period is 1.0 s/cycle), long enough that a quarantined
+# device misses several solves first. run() restores the wall-clock
+# defaults when the sim hands the global DEVICE_HEALTH back.
+MESH_SIM_COOLDOWN_S = 6.0
+MESH_SIM_MAX_COOLDOWN_S = 48.0
+
+
 def sharded_sim_conf(devices: int = 0) -> str:
     """Conf for ``--sharded`` runs: the pipelined action chain with the
     allocate slot on the unified shard_map engine (ops/unified — nodes
@@ -301,7 +310,11 @@ class SimRunner:
                  rebalance: bool = False,
                  elastic: bool = False,
                  elastic_gangs: bool = False,
-                 topology_weight: float = 10.0):
+                 topology_weight: float = 10.0,
+                 mesh_chaos: bool = False,
+                 mesh_fault_rate: float = 0.0,
+                 mesh_fault_plan: Optional[Dict[str, Sequence[int]]] = None,
+                 mesh_fault_seed: Optional[int] = None):
         self.trace = list(trace)
         self.period = period
         self.seed = seed
@@ -538,6 +551,38 @@ class SimRunner:
         # instead of wherever the host's wall clock lands
         from ..device_health import DEVICE_HEALTH
         DEVICE_HEALTH.reset(time_fn=self.clock.time)
+        # per-SHARD mesh chaos (docs/robustness.md mesh failure model): a
+        # seeded MeshFaultInjector on the allocate fault hook attributes
+        # each fault to a live shard, so the per-device lattice
+        # quarantines chips and the mesh heals mid-cycle. The quarantine
+        # windows run on the virtual clock at a sim-scale length so the
+        # full quarantine → probe → readmit arc completes inside a
+        # scenario; run() restores the wall-clock defaults. Restarts
+        # (_crash_restart) reset the lattice — health is process memory —
+        # but NOT the injector: chaos is the universe, it survives.
+        self.mesh_fault_rate = float(mesh_fault_rate)
+        self.mesh_fault_plan = {k: tuple(v) for k, v in
+                                (mesh_fault_plan or {}).items()}
+        self.mesh_fault_seed = seed if mesh_fault_seed is None \
+            else mesh_fault_seed
+        self.mesh_chaos = bool(mesh_chaos or self.mesh_fault_rate
+                               or self.mesh_fault_plan)
+        self._mesh_injector = None
+        self._mesh_section: Optional[dict] = None
+        self._mesh_mark = dict(metrics.mesh_counts())
+        self.rung_cycles: Dict[int, int] = {}
+        if self.mesh_chaos:
+            from ..actions import allocate as _alloc_mod
+            from ..chaos import MeshFaultInjector
+            DEVICE_HEALTH.cooldown_s = MESH_SIM_COOLDOWN_S
+            DEVICE_HEALTH.max_cooldown_s = MESH_SIM_MAX_COOLDOWN_S
+            rate = self.mesh_fault_rate or (
+                None if self.mesh_fault_plan else 0.2)
+            self._mesh_injector = MeshFaultInjector(
+                self.mesh_fault_plan or {"device_lost": (),
+                                         "oom": (), "slow": ()},
+                failure_rate=rate, seed=self.mesh_fault_seed)
+            _alloc_mod.DEVICE_FAULT_HOOK = self._mesh_injector
         if conf_text is not None:
             self.conf_text = conf_text
         elif self.elastic_gangs:
@@ -2468,6 +2513,47 @@ class SimRunner:
         return {k: int(now.get(k, 0) - self._fa_mark.get(k, 0))
                 for k in ("gangs", "binds")}
 
+    def mesh_stats(self) -> Dict[str, object]:
+        """The report's deterministic mesh section (seeded injector +
+        virtual-clock windows ⇒ byte-reproducible): faults injected per
+        kind and device, heal/quarantine/readmission deltas
+        (process-global counters marked at construction), the per-rung
+        cycle tally, and the never-CPU witness (rung-3 cycles — expected
+        0 whenever any device survives). run() snapshots this BEFORE it
+        hands DEVICE_HEALTH back to wall time, so the section reflects
+        the run, not the post-run reset."""
+        if self._mesh_section is not None:
+            return self._mesh_section
+        from ..device_health import DEVICE_HEALTH
+        now = metrics.mesh_counts()
+        d = {k: now.get(k, 0) - self._mesh_mark.get(k, 0)
+             for k in set(now) | set(self._mesh_mark)}
+        heals = {k.split("/", 1)[1]: int(v) for k, v in d.items()
+                 if k.startswith("heals/") and v}
+        quars = {k.split("/", 1)[1]: int(v) for k, v in d.items()
+                 if k.startswith("quarantines/") and v}
+        inj: Dict[str, int] = {}
+        devices_hit: List[int] = []
+        if self._mesh_injector is not None:
+            for _, kind, dev in self._mesh_injector.injected:
+                inj[kind] = inj.get(kind, 0) + 1
+                if dev not in devices_hit:
+                    devices_hit.append(dev)
+        detail = DEVICE_HEALTH.detail()
+        return {
+            "fault_rate": self.mesh_fault_rate,
+            "injected": dict(sorted(inj.items())),
+            "devices_faulted": sorted(devices_hit),
+            "heals": dict(sorted(heals.items())),
+            "quarantines": dict(sorted(quars.items())),
+            "readmissions": int(d.get("readmissions", 0)),
+            "rung_cycles": {str(k): v for k, v in
+                            sorted(self.rung_cycles.items())},
+            "cpu_fallback_cycles": int(self.rung_cycles.get(3, 0)),
+            "devices_healthy_final": detail["devices_healthy"],
+            "devices_quarantined_final": detail["devices_quarantined"],
+        }
+
     @property
     def ack_chaos(self) -> bool:
         return self._ack_injector is not None
@@ -2593,6 +2679,13 @@ class SimRunner:
                 # stream is deterministic)
                 self._admission.observe_drain(self._drained_tasks)
                 self._drained_tasks = 0
+            if self.mesh_chaos:
+                # per-rung cycle tally: the gauge holds the rung the
+                # allocate gate picked this cycle (0 full .. 3 CPU) —
+                # a pure function of the seeded fault stream on the
+                # virtual clock, so the tally is deterministic
+                rung = int(metrics.mesh_counts().get("rung", 0))
+                self.rung_cycles[rung] = self.rung_cycles.get(rung, 0) + 1
             self.cycles += 1
             self.clock.sleep(self.period)
             if self._done():
@@ -2605,8 +2698,17 @@ class SimRunner:
         wall_s = time.perf_counter() - wall0
         # hand the (global) device-health state machine back to wall time
         # so post-sim code in the same process isn't stuck on a frozen
-        # virtual clock
-        from ..device_health import DEVICE_HEALTH
+        # virtual clock; the mesh section must be snapshotted FIRST (the
+        # reset clears the lattice the section reads)
+        from ..device_health import (DEFAULT_COOLDOWN_S,
+                                     DEFAULT_MAX_COOLDOWN_S, DEVICE_HEALTH)
+        if self.mesh_chaos:
+            self._mesh_section = self.mesh_stats()
+            from ..actions import allocate as _alloc_mod
+            if _alloc_mod.DEVICE_FAULT_HOOK is self._mesh_injector:
+                _alloc_mod.DEVICE_FAULT_HOOK = None
+            DEVICE_HEALTH.cooldown_s = DEFAULT_COOLDOWN_S
+            DEVICE_HEALTH.max_cooldown_s = DEFAULT_MAX_COOLDOWN_S
         DEVICE_HEALTH.reset(time_fn=time.monotonic)
         # runs longer than the bounded metrics ring lose their oldest
         # per-action samples — flag the affected series so the report's

@@ -545,6 +545,25 @@ SCENARIOS: Dict[str, dict] = {
                     "single-device oracle byte-for-byte)",
         factory=lambda seed: baseline_trace("100k", seed=seed),
     ),
+    "mesh-chaos": dict(
+        description="140 gangs over ~45 virtual seconds on 16 nodes, "
+                    "long enough past the last arrival that every "
+                    "quarantine window expires — the mesh fault soak "
+                    "world for `sim --mesh-chaos` on the 8-device "
+                    "dryrun mesh: seeded per-shard faults quarantine "
+                    "chips mid-solve, the mesh heals over the "
+                    "survivors, expired windows probe + readmit, and "
+                    "--verify-mesh-equivalence proves the decision "
+                    "plane byte-identical to the fault-free 1-device "
+                    "oracle (docs/robustness.md mesh failure model)",
+        factory=lambda seed: synthetic_trace(
+            140, 16, seed=seed, arrival_rate=3.5, duration_mean=6.0,
+            duration_cap=18.0,
+            gang_sizes=((1, 0.5), (2, 0.35), (4, 0.15)),
+            queues=(("q1", 2), ("q2", 1)), cpu_choices=(1000, 2000),
+            mem_choices=(GI,), priority_choices=(0,),
+            node_cpu_milli=6000, node_mem=64 * GI, node_pods=40),
+    ),
 }
 
 
