@@ -828,6 +828,24 @@ def main():
         trace_overhead_ratio=round(traced_e2e / pipe_e2e, 3)
         if pipe_e2e else None)
 
+    # the SAME cycle with the lifecycle-timeline layer OFF: the headline
+    # pipeline_e2e_ms above runs with the layer at its default (on), so
+    # timeline_overhead_ratio measures what the cluster-causal stamps
+    # cost against a truly bare cycle — held to the flight recorder's
+    # bound by the ci/check.sh --obs-only canary
+    from volcano_tpu.obs import TIMELINE
+    TIMELINE.clear()
+    timeline_was_on = TIMELINE.enabled
+    TIMELINE.enabled = False
+    try:
+        bare_e2e, _, _, _ = run_pipeline_e2e(warm=False)
+    finally:
+        TIMELINE.enabled = timeline_was_on
+    extras.update(
+        pipeline_bare_e2e_ms=round(bare_e2e * 1e3, 1),
+        timeline_overhead_ratio=round(pipe_e2e / bare_e2e, 3)
+        if bare_e2e else None)
+
     # steady-state churn (VERDICT r5 #4): 6 consecutive shell cycles at
     # 10k/2k with 5 gangs completing + 5 arriving between cycles, the
     # shape buckets prewarmed (Scheduler.prewarm) so no cycle pays a

@@ -223,6 +223,117 @@ byte-reproducible"; exit 1; }
   JAX_PLATFORMS=cpu python -m volcano_tpu.obs.validate --metrics-scrape \
     || { echo "observability FAILED: /metrics scrape/parse"; exit 1; }
   echo "   trace schema valid, byte-reproducible; /metrics parses both paths"
+
+  # federated merged trace (docs/observability.md cluster-causal model):
+  # per-partition process lanes + flow arcs (bind intent -> running ack
+  # -> queue move -> complete) must validate AND be byte-identical
+  # across two runs, report included
+  echo "== observability: federated merged trace, flow arcs + lanes =="
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario fed-hotspot \
+    --seed 3 --federated 2 --deterministic \
+    --trace-out "$obsdir/fed.a.trace.json" > "$obsdir/fed.a.json"
+  JAX_PLATFORMS=cpu python -m volcano_tpu.sim --scenario fed-hotspot \
+    --seed 3 --federated 2 --deterministic \
+    --trace-out "$obsdir/fed.b.trace.json" > "$obsdir/fed.b.json"
+  JAX_PLATFORMS=cpu python -m volcano_tpu.obs.validate --flows \
+    "$obsdir/fed.a.trace.json" \
+    || { echo "observability FAILED: federated flow/lane contract"; \
+         exit 1; }
+  diff "$obsdir/fed.a.trace.json" "$obsdir/fed.b.trace.json" \
+    || { echo "observability FAILED: merged federated trace not \
+byte-reproducible"; exit 1; }
+  diff "$obsdir/fed.a.json" "$obsdir/fed.b.json" \
+    || { echo "observability FAILED: federated report not \
+byte-reproducible"; exit 1; }
+
+  # lifecycle + SLO on an overload burst: the report's latency section
+  # must agree with the runner's own JCT bookkeeping (oracle parity via
+  # the percentiles both publish), the exactly-once store must show no
+  # LRU pressure at this scale, and the SLO engine must evaluate real
+  # samples with burn rates on every configured window
+  echo "== observability: SLO burn-rate + lifecycle oracle parity =="
+  # the per-job event ring is sized up for this run so heavily churned
+  # jobs (preempt/reclaim under overload) keep their arrival anchor —
+  # full retention is what makes the exact count/mean parity assertable
+  VOLCANO_TPU_TIMELINE_EVENTS=4096 JAX_PLATFORMS=cpu \
+    python -m volcano_tpu.sim --scenario overload-burst \
+    --seed 3 --overload-chaos --lifecycle --deterministic \
+    > "$obsdir/slo.json" \
+    || { echo "observability FAILED: overload+lifecycle run"; exit 1; }
+  python - "$obsdir/slo.json" <<'EOF'
+import json, sys
+rep = json.load(open(sys.argv[1]))
+lat, slo = rep["latency"], rep["slo"]
+assert lat["timeline"]["jobs"] == rep["jobs"]["arrived"], lat["timeline"]
+assert lat["timeline"]["lru_evicted"] == 0, lat["timeline"]
+assert slo, "SLO engine evaluated no objectives"
+names = {s["slo"] for s in slo}
+assert any(n.startswith("jct_by_class/") for n in names), names
+# oracle parity, two planes at once: per-class sample counts come from
+# the SLO engine, per-class means from the latency section; the
+# count-weighted recombination must reproduce the runner's own JCT
+# bookkeeping (rep["jct_s"], sampled at the same instants)
+cls_n = {s["slo"].split("/", 1)[1]: s["samples"]
+         for s in slo if s["slo"].startswith("jct_by_class/")}
+assert sum(cls_n.values()) == rep["jobs"]["completed"], \
+    (cls_n, rep["jobs"]["completed"])
+num = sum(lat["classes"][c]["jct_s"]["mean"] * n
+          for c, n in cls_n.items() if n)
+den = sum(cls_n.values())
+oracle = rep["jct_s"]["mean"]
+assert den and abs(num / den - oracle) < 1e-4, (num / den, oracle)
+sampled = [s for s in slo if s["samples"] > 0]
+assert sampled, f"no objective saw a sample: {slo}"
+for s in slo:
+    assert s["burn_rate"], f"objective {s['slo']} has no burn windows"
+    assert 0.0 <= s["compliance"] <= 1.0, s
+print("   slo: %d objectives (%d sampled), JCT oracle parity "
+      "mean=%.3fs over %d completions, timeline %d jobs / %d events"
+      % (len(slo), len(sampled), oracle, den,
+         lat["timeline"]["jobs"], lat["timeline"]["events"]))
+EOF
+
+  # timeline overhead canary: the lifecycle layer must cost no more than
+  # the flight recorder's own accepted bound over the same run (bench.py
+  # reports the pipeline-cycle ratios; this canary holds the sim path)
+  echo "== observability: timeline overhead canary =="
+  JAX_PLATFORMS=cpu python - <<'EOF'
+import time
+from volcano_tpu.obs import TIMELINE, TRACE
+from volcano_tpu.sim.runner import SimRunner
+from volcano_tpu.sim.workload import make_scenario
+
+def wall(reps=3, **kw):
+    best = None
+    for _ in range(reps):
+        trace = make_scenario("steady", seed=3)
+        t0 = time.perf_counter()
+        SimRunner(trace, seed=3, **kw).run()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best
+
+TIMELINE.enabled = False
+bare = wall()
+TIMELINE.enabled = True
+timeline = wall(lifecycle=True)
+TRACE.configure(max_cycles=0, logical=True)
+TRACE.enable()
+try:
+    traced = wall()
+finally:
+    TRACE.disable()
+    TRACE.configure(max_cycles=64, logical=False)
+    TRACE.clear()
+timeline_ratio = timeline / bare
+trace_ratio = traced / bare
+bound = max(1.5, 1.25 * trace_ratio)
+assert timeline_ratio <= bound, (
+    f"timeline_overhead_ratio {timeline_ratio:.3f} exceeds bound "
+    f"{bound:.3f} (trace_overhead_ratio {trace_ratio:.3f})")
+print(f"   timeline_overhead_ratio {timeline_ratio:.3f} within bound "
+      f"{bound:.3f} (trace_overhead_ratio {trace_ratio:.3f})")
+EOF
 fi
 
 if $run_ha; then

@@ -280,7 +280,8 @@ def test_vt008_trigger_and_clean():
     one-hop funnel is clean."""
     f, _ = findings_of({"volcano_tpu/cache/cache.py": VT008_TRIGGER})
     # the journal witness satisfies VT004 but not the ledger (VT017)
-    assert rule_ids(f) == ["VT008", "VT017"]
+    # nor the lifecycle-timeline stamp (VT022)
+    assert rule_ids(f) == ["VT008", "VT017", "VT022"]
     assert any(x.symbol == "SchedulerCache.bind" for x in f)
     f, _ = findings_of({"volcano_tpu/cache/cache.py": VT008_CLEAN})
     assert "VT008" not in rule_ids(f)
@@ -538,6 +539,74 @@ def test_vt021_trigger_and_clean():
     assert "VT021" not in rule_ids(f)
     f, _ = findings_of({"volcano_tpu/device_health.py": VT021_TRIGGER})
     assert "VT021" not in rule_ids(f)
+
+
+VT022_TRIGGER = '''
+class SchedulerCache:
+    def _journal_intent(self, op, task, node=None):
+        return self.journal.record_intent(op, task, node)
+'''
+
+VT022_CONTROL_TRIGGER = '''
+class ReservationLedger:
+    def _journal_reserve(self, kind, fields):
+        self.backend.record_control(kind, **fields)
+'''
+
+VT022_CLEAN = '''
+class SchedulerCache:
+    def _journal_intent(self, op, task, node=None):
+        ctx = TIMELINE.stamp(part=self.obs_part)
+        if ctx is not None:
+            TIMELINE.record(task.job, f"{op}_intent", ctx=ctx)
+        return self.journal.record_intent(op, task, node, ctx=ctx)
+'''
+
+VT022_HOP_CLEAN = '''
+class ReservationLedger:
+    def _stamp(self, fields):
+        fields["ctx"] = TIMELINE.stamp(part=fields.get("frm"))
+
+    def _journal_reserve(self, kind, fields):
+        self._stamp(fields)
+        self.backend.record_control(kind, **fields)
+'''
+
+VT022_RAW_DEF = '''
+class BindJournal:
+    def record_intent(self, op, task, node=None, ctx=None):
+        return self.inner.record_intent(op, task, node, ctx=ctx)
+'''
+
+
+def test_vt022_trigger_and_clean():
+    """A decision funnel writing a durable record (record_intent /
+    record_control) without a lifecycle-timeline witness
+    (TIMELINE.stamp/record/ingest, same function or one hop) fires
+    VT022; stamping inline or one hop away is clean; the writer's own
+    def (a delegating override) is the persistence floor; and only the
+    four decision-funnel files are in scope — the operator-verb command
+    ledger (elastic_gang/commands.py) journals no job milestones."""
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": VT022_TRIGGER})
+    assert "VT022" in rule_ids(f)
+    assert any(x.symbol == "SchedulerCache._journal_intent" for x in f)
+    f, _ = findings_of(
+        {"volcano_tpu/federation/reserve.py": VT022_CONTROL_TRIGGER})
+    assert "VT022" in rule_ids(f)
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": VT022_CLEAN})
+    assert "VT022" not in rule_ids(f)
+    f, _ = findings_of(
+        {"volcano_tpu/federation/reserve.py": VT022_HOP_CLEAN})
+    assert "VT022" not in rule_ids(f)
+    # the delegating override in-scope: its own def is the floor
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": VT022_RAW_DEF})
+    assert "VT022" not in rule_ids(f)
+    # journal.py defines the writers — out of scope entirely
+    f, _ = findings_of({"volcano_tpu/cache/journal.py": VT022_TRIGGER})
+    assert "VT022" not in rule_ids(f)
+    f, _ = findings_of(
+        {"volcano_tpu/elastic_gang/commands.py": VT022_TRIGGER})
+    assert "VT022" not in rule_ids(f)
 
 
 VT005_TRIGGER = '''
@@ -1084,6 +1153,32 @@ def test_rebreak_unjournaled_evict_vt004():
     f, _ = findings_of({"volcano_tpu/cache/cache.py": broken})
     assert any(x.rule == "VT004" and x.symbol == "SchedulerCache.evict"
                for x in f)
+
+
+def test_rebreak_unstamped_bind_intent_vt022():
+    """The cluster-causal contract: every journaled bind/evict intent
+    carries a correlation ctx so a successor process (JournalFollower
+    after a failover, a mover partition after a queue handoff) can
+    place it on the job's timeline. Stripping the stamp+record pair
+    from _journal_intent durably writes milestones no timeline can
+    ever ingest — the job's story silently breaks at exactly the
+    handoff the layer exists to survive. The unmutated funnel must be
+    clean; the stripped one must flag."""
+    src = real_source("volcano_tpu/cache/cache.py")
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": src})
+    assert "VT022" not in rule_ids(f)
+    broken = mutate(
+        src,
+        "        ctx = TIMELINE.stamp(part=self.obs_part, epoch=epoch)\n"
+        "        if ctx is not None:\n"
+        "            TIMELINE.record(task.job, f\"{op}_intent\", ctx=ctx,\n"
+        "                            node=node or task.node_name or None,\n"
+        "                            via=via or None)\n",
+        "        ctx = None\n")
+    f, _ = findings_of({"volcano_tpu/cache/cache.py": broken})
+    assert any(x.rule == "VT022"
+               and x.symbol == "SchedulerCache._journal_intent"
+               for x in f), rule_ids(f)
 
 
 def test_rebreak_sla_wall_clock_vt002():

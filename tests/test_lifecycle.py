@@ -1,0 +1,533 @@
+"""Cluster-causal observability tests (docs/observability.md): the
+per-job lifecycle timeline store, journal-propagated trace context and
+its exactly-once ingestion, timeline continuity across leader failovers
+/ queue moves / torn watch streams, the SLO burn-rate engine, flow
+events in merged federated traces, and the /debug + vcctl surfaces."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from volcano_tpu import metrics
+from volcano_tpu.obs import TIMELINE, TRACE, SLO_ENGINE, flow_summary
+from volcano_tpu.obs.audit import AUDIT
+from volcano_tpu.obs.export import span_totals_ms, validate_chrome_trace
+from volcano_tpu.obs.lifecycle import (TimelineStore, job_latency,
+                                       latency_classes, why)
+from volcano_tpu.obs.slo import SLO, SLOEngine, default_slos
+from volcano_tpu.sim.report import percentiles
+from volcano_tpu.sim.runner import SimRunner
+from volcano_tpu.sim.workload import make_scenario
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorders():
+    """Tests share the process-global TIMELINE/TRACE/AUDIT: reset
+    around each."""
+    TIMELINE.clear()
+    TRACE.configure(max_cycles=64, logical=False)
+    TRACE.disable()
+    AUDIT.clear()
+    yield
+    TIMELINE.clear()
+    TRACE.configure(max_cycles=64, logical=False)
+    TRACE.disable()
+    AUDIT.clear()
+
+
+# ---------------------------------------------------------------------------
+# 1. the timeline store: ctx stamping, exactly-once, bounds
+# ---------------------------------------------------------------------------
+
+class TestTimelineStore:
+    def test_stamp_inherits_ambient_context_with_fresh_eids(self):
+        store = TimelineStore(max_jobs=16, max_events=16)
+        store.set_context(cycle=7, part=2, epoch=3, t=41.5)
+        a = store.stamp()
+        b = store.stamp(part=5)
+        assert a == {"cycle": 7, "part": 2, "epoch": 3, "eid": 1}
+        assert b == {"cycle": 7, "part": 5, "epoch": 3, "eid": 2}
+        assert store.now() == 41.5
+
+    def test_record_event_shape_and_extras(self):
+        store = TimelineStore(max_jobs=16, max_events=16)
+        store.set_context(cycle=1, part=0, epoch=1, t=2.0)
+        assert store.record("j1", "arrival", queue="q1", skipped=None)
+        (ev,) = store.events("j1")
+        assert ev == {"ev": "arrival", "cycle": 1, "part": 0, "epoch": 1,
+                      "eid": 1, "t": 2.0, "queue": "q1"}
+
+    def test_ingest_same_ctx_is_exactly_once(self):
+        store = TimelineStore(max_jobs=16, max_events=16)
+        ctx = {"cycle": 3, "part": 1, "epoch": 2, "eid": 9}
+        assert store.ingest("j1", "bind_intent", ctx, t=3.0)
+        # a journal replay / torn-stream redelivery carries the SAME ctx
+        assert not store.ingest("j1", "bind_intent", ctx, t=3.0)
+        assert len(store.events("j1")) == 1
+        assert store.stats()["duplicates_dropped"] == 1
+
+    def test_same_eid_from_different_partitions_both_land(self):
+        store = TimelineStore(max_jobs=16, max_events=16)
+        assert store.ingest("j1", "bind_intent",
+                            {"cycle": 1, "part": 0, "epoch": 1, "eid": 5})
+        assert store.ingest("j1", "move",
+                            {"cycle": 1, "part": 1, "epoch": 1, "eid": 5})
+        assert len(store.events("j1")) == 2
+
+    def test_lru_evicts_oldest_job(self):
+        store = TimelineStore(max_jobs=2, max_events=8)
+        for j in ("a", "b", "c"):
+            store.record(j, "arrival")
+        assert store.jobs() == ["b", "c"]
+        assert store.stats()["evicted"] == 1
+        assert store.timeline("a") is None
+
+    def test_per_job_event_ring_is_bounded(self):
+        store = TimelineStore(max_jobs=4, max_events=3)
+        for i in range(10):
+            store.record("j1", "solve", verdict="denied")
+        assert len(store.events("j1")) == 3
+
+    def test_bare_name_resolves_namespaced_job(self):
+        store = TimelineStore(max_jobs=4, max_events=4)
+        store.record("default/train", "arrival")
+        assert store.timeline("train")["job"] == "default/train"
+
+    def test_clear_resets_eids_for_deterministic_reruns(self):
+        store = TimelineStore(max_jobs=4, max_events=4)
+        store.record("j1", "arrival")
+        store.clear()
+        store.record("j1", "arrival")
+        assert store.events("j1")[0]["eid"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 2. latency attribution + SLO burn-rate math
+# ---------------------------------------------------------------------------
+
+class TestLatencyMath:
+    def _events(self):
+        mk = lambda ev, t, eid, **kw: dict(
+            {"ev": ev, "cycle": 0, "part": 0, "epoch": 1,
+             "eid": eid, "t": t}, **kw)
+        return [mk("arrival", 1.0, 1, queue="q1"),
+                mk("bind_intent", 2.0, 2),
+                mk("bind", 2.5, 3),
+                mk("running", 3.0, 4),
+                mk("admitted", 4.0, 5),
+                mk("complete", 9.0, 6)]
+
+    def test_job_latency_spans(self):
+        lat = job_latency(self._events())
+        assert lat == {"ttfb_s": 1.5, "admission_wait_s": 3.0,
+                       "ack_latency_s": 1.0, "jct_s": 8.0}
+
+    def test_job_latency_emits_only_known_endpoints(self):
+        assert job_latency(self._events()[:1]) == {}
+        assert "jct_s" not in job_latency(self._events()[:3])
+        assert job_latency([]) == {}         # no arrival: nothing at all
+
+    def test_latency_classes_groups_by_arrival_queue(self):
+        store = TimelineStore(max_jobs=8, max_events=8)
+        store.set_context(t=0.0)
+        store.record("a", "arrival", t=0.0, queue="gpu")
+        store.record("a", "complete", t=4.0)
+        store.record("b", "arrival", t=0.0, queue="cpu")
+        store.record("b", "complete", t=2.0)
+        out = latency_classes(store)
+        assert out["gpu"]["jct_s"] == [4.0]
+        assert out["cpu"]["jct_s"] == [2.0]
+
+
+class TestSLOEngine:
+    def _store(self):
+        """8 jobs on one class: jct 1s for six, 10s for two — the two
+        slow ones complete last (inside the short window)."""
+        store = TimelineStore(max_jobs=16, max_events=8)
+        for i in range(8):
+            jct = 10.0 if i >= 6 else 1.0
+            t0 = float(i)
+            store.record(f"j{i}", "arrival", t=t0, queue="batch")
+            store.record(f"j{i}", "complete", t=t0 + jct)
+        return store
+
+    def test_compliance_and_burn_rate_windows(self):
+        store = self._store()
+        eng = SLOEngine([SLO("jct_fast", "jct", threshold_s=5.0,
+                             target=0.9, windows=(4.0, 100.0))])
+        (st,) = eng.evaluate(store, now=17.0)
+        assert st["slo"] == "jct_fast" and st["samples"] == 8
+        assert st["compliance"] == 0.75 and not st["ok"]
+        # completions anchor the windows: t=16,17 (the slow pair) are the
+        # only samples inside [13, 17] -> error rate 1.0 / budget 0.1
+        assert st["burn_rate"]["4"] == 10.0
+        # the long window sees all 8: (2/8) / 0.1
+        assert st["burn_rate"]["100"] == 2.5
+
+    def test_within_threshold_burns_zero(self):
+        store = self._store()
+        eng = SLOEngine([SLO("jct_lax", "jct", threshold_s=30.0,
+                             target=0.99, windows=(100.0,))])
+        (st,) = eng.evaluate(store, now=17.0)
+        assert st["compliance"] == 1.0 and st["ok"]
+        assert st["burn_rate"] == {"100": 0.0}
+
+    def test_queue_star_expands_one_objective_per_class(self):
+        store = self._store()
+        store.record("k", "arrival", t=0.0, queue="svc")
+        store.record("k", "complete", t=1.0)
+        eng = SLOEngine([SLO("jct_by_class", "jct", threshold_s=5.0,
+                             target=0.9, windows=(100.0,), queue="*")])
+        names = [st["slo"] for st in eng.evaluate(store, now=17.0)]
+        assert names == ["jct_by_class/batch", "jct_by_class/svc"]
+
+    def test_default_slos_scale_with_period(self):
+        slos = {s.name: s for s in default_slos(period=2.0)}
+        assert slos["ttfb_p99"].threshold_s == 20.0
+        assert slos["ttfb_p99"].windows == (64.0, 256.0)
+        assert slos["jct_by_class"].queue == "*"
+
+    def test_publish_feeds_gauges_and_health_detail(self):
+        store = self._store()
+        eng = SLOEngine([SLO("jct_fast", "jct", threshold_s=5.0,
+                             target=0.9, windows=(4.0,))])
+        status = eng.publish(store, now=17.0)
+        detail = metrics.health_detail()
+        assert detail["slo"] == status
+        body = metrics.fallback_exposition().decode()
+        assert 'volcano_slo_compliance{slo="jct_fast"} 0.75' in body
+        assert 'volcano_slo_burn_rate{slo="jct_fast",window="4"} 10' \
+            in body
+
+    def test_publish_replaces_stale_objectives(self):
+        store = self._store()
+        SLOEngine([SLO("old_slo", "jct", threshold_s=5.0)]).publish(
+            store, now=17.0)
+        SLOEngine([SLO("new_slo", "jct", threshold_s=5.0)]).publish(
+            store, now=17.0)
+        body = metrics.fallback_exposition().decode()
+        assert "old_slo" not in body and "new_slo" in body
+
+
+# ---------------------------------------------------------------------------
+# 3. flow events + per-partition lanes in the merged trace
+# ---------------------------------------------------------------------------
+
+class TestFlowEvents:
+    def test_flow_arcs_are_valid_by_construction(self):
+        TRACE.enable()
+        TRACE.begin_cycle(0)
+        TRACE.flow_step("bind_intent", "job:a")      # s
+        TRACE.flow_step("running_ack", "job:a")      # t
+        TRACE.flow_end("complete", "job:a")          # f
+        TRACE.flow_end("complete", "job:a")          # closed: no-op
+        TRACE.flow_end("complete", "job:never")      # never open: no-op
+        TRACE.end_cycle()
+        TRACE.disable()
+        events = TRACE.chrome_events()
+        assert [e["ph"] for e in events] == ["s", "t", "f"]
+        assert len({e["id"] for e in events}) == 1
+        assert events[-1]["bp"] == "e"
+        assert validate_chrome_trace({"traceEvents": events}) >= 0
+
+    def test_flow_ids_deterministic_from_key_order(self):
+        TRACE.configure(logical=True)
+        TRACE.enable()
+        TRACE.begin_cycle(0)
+        TRACE.flow_step("bind_intent", "job:a")
+        TRACE.flow_step("bind_intent", "job:b")
+        TRACE.flow_step("queue_move", "job:a")
+        TRACE.end_cycle()
+        TRACE.disable()
+        evs = TRACE.chrome_events()
+        assert [(e["name"], e["id"]) for e in evs] == [
+            ("bind_intent", 1), ("bind_intent", 2), ("queue_move", 1)]
+
+    def test_flow_summary_counts_and_lanes(self):
+        TRACE.enable()
+        TRACE.begin_cycle(0)
+        TRACE.set_pid(1)
+        TRACE.flow_step("bind_intent", "job:a")
+        TRACE.set_pid(2)
+        TRACE.flow_step("queue_move", "job:a")
+        TRACE.flow_end("complete", "job:a")
+        TRACE.end_cycle()
+        TRACE.disable()
+        fs = flow_summary(TRACE.chrome_events())
+        assert fs == {"started": 1, "steps": 1, "finished": 1,
+                      "lanes": [1, 2]}
+
+    def test_span_totals_split_per_lane_only_when_multi_pid(self):
+        TRACE.enable()
+        TRACE.begin_cycle(0)
+        with TRACE.span("schedule"):
+            pass
+        TRACE.end_cycle()
+        TRACE.disable()
+        totals = TRACE.chrome_events()
+        assert set(span_totals_ms(totals)) == {"schedule"}
+        # now the same span name from two partitions' lanes
+        TRACE.clear()
+        TRACE.enable()
+        TRACE.begin_cycle(0)
+        TRACE.set_pid(1)
+        with TRACE.span("schedule"):
+            pass
+        TRACE.set_pid(2)
+        with TRACE.span("schedule"):
+            pass
+        TRACE.end_cycle()
+        TRACE.disable()
+        split = span_totals_ms(TRACE.chrome_events())
+        assert set(split) == {"p1/schedule", "p2/schedule"}
+
+
+# ---------------------------------------------------------------------------
+# 4. timeline continuity across the three handoff shapes (sim)
+# ---------------------------------------------------------------------------
+
+def _assert_contiguous(store, job):
+    """One timeline, causally ordered, exactly-once. The causal axis is
+    the store's observation order — the deterministic eid counter —
+    not ``t`` or ``cycle``: event ``t`` mixes clock anchors (ambient
+    cycle stamp vs the runner's feedback clock) and feedback-plane
+    events carry best-effort ambient cycle/epoch. So: eids strictly
+    increase, no (part, eid) pair repeats, and the story opens with
+    the arrival."""
+    evs = store.events(job)
+    assert evs, f"no timeline for {job}"
+    eids = [e["eid"] for e in evs]
+    assert eids == sorted(eids) and len(set(eids)) == len(eids), \
+        f"{job}: observation order broken: {evs}"
+    keys = [(e["part"], e["eid"]) for e in evs]
+    assert len(keys) == len(set(keys)), f"{job}: duplicated events: {evs}"
+    # a job's story opens at the admission edge: accepted (arrival) or
+    # refused outright (shed, under overload admission-depth pressure)
+    assert evs[0]["ev"] in ("arrival", "shed"), \
+        f"{job}: story opens mid-flight: {evs[0]}"
+
+
+@pytest.mark.sim
+class TestHandoffContinuity:
+    def test_leader_failover_mid_bind_stitches_one_timeline(self):
+        """Seeded leader kills mid-run: the successor's events carry the
+        successor fencing epoch, and every affected job still reads as
+        ONE contiguous story — including the binds whose acks landed
+        across the handoff."""
+        trace = make_scenario("smoke", seed=3)
+        runner = SimRunner(trace, seed=3, ha_replicas=3,
+                           kill_cycles=(2, 5, 9, 13), kill_seed=2,
+                           lifecycle=True)
+        report = runner.run()
+        assert report["double_binds"] == 0
+        assert report["failovers"] == 4
+        tl = runner._timeline
+        spanning = [j for j in tl.jobs()
+                    if len({e["epoch"] for e in tl.events(j)}) > 1]
+        assert spanning, "no timeline spans a leadership epoch handoff"
+        for job in tl.jobs():
+            _assert_contiguous(tl, job)
+            evs = tl.events(job)
+            assert [e["ev"] for e in evs].count("arrival") == 1
+            assert [e["ev"] for e in evs].count("complete") == 1
+        # the journal replay after each kill re-ingested events the
+        # successor already held — the exactly-once key dropped them
+        assert tl.stats()["duplicates_dropped"] > 0
+
+    def test_queue_move_mid_gang_spans_partitions_without_double_binds(self):
+        """A load-driven queue move lands while its gangs are mid-flight
+        AND a seeded kill fails a partition leader over: the affected
+        jobs' timelines span both partitions (the acceptance criterion)
+        and no milestone doubled."""
+        trace = make_scenario("fed-hotspot", seed=3)
+        runner = SimRunner(trace, seed=3, federated_partitions=4,
+                           rebalance=True, cycle_budget_s=0.5,
+                           budget_cost_per_task=0.002, admission_depth=48,
+                           overload_burst_rate=0.2,
+                           kill_cycles=(6,), kill_seed=2, lifecycle=True)
+        report = runner.run()
+        assert report["double_binds"] == 0
+        assert report["federation"]["queue_moves"] >= 1
+        assert report["failovers"] >= 1
+        tl = runner._timeline
+        moved = [j for j in tl.jobs()
+                 if any(e["ev"] == "move" for e in tl.events(j))]
+        assert moved, "queue move left no 'move' milestone"
+        cross = [j for j in moved
+                 if len({e["part"] for e in tl.events(j)}) > 1]
+        assert cross, "no moved job's timeline spans both partitions"
+        for job in tl.jobs():
+            _assert_contiguous(tl, job)
+            evs = [e["ev"] for e in tl.events(job)]
+            if "arrival" not in evs:
+                # refused at the admission edge: shed-only story
+                assert set(evs) == {"shed"}, evs
+                continue
+            assert evs.count("arrival") == 1
+            assert evs.count("complete") == 1
+            assert evs.count("move") <= 1
+
+    def test_store_chaos_torn_streams_stay_exactly_once(self):
+        """Torn watch streams re-deliver; seeded store faults retry the
+        verbs. The dedupe key (part, eid) keeps every milestone single
+        and every gang still completes."""
+        trace = make_scenario("smoke", seed=3)
+        runner = SimRunner(trace, seed=3, store_wired=True,
+                           store_fault_rate=0.3, torn_watches=2,
+                           lifecycle=True)
+        report = runner.run()
+        assert report["jobs"]["completed"] == report["jobs"]["arrived"]
+        assert report["store"]["torn_watch_events"] >= 1
+        tl = runner._timeline
+        assert tl.job_count() == report["jobs"]["arrived"]
+        for job in tl.jobs():
+            _assert_contiguous(tl, job)
+            evs = [e["ev"] for e in tl.events(job)]
+            assert evs.count("arrival") == 1
+            assert evs.count("complete") == 1
+
+
+# ---------------------------------------------------------------------------
+# 5. oracle parity: the timeline-derived latency section vs the runner's
+#    own JCT bookkeeping
+# ---------------------------------------------------------------------------
+
+@pytest.mark.sim
+class TestReportParity:
+    def test_latency_section_matches_jct_bookkeeping(self):
+        trace = make_scenario("smoke", seed=3)
+        runner = SimRunner(trace, seed=3, lifecycle=True)
+        report = runner.run()
+        classes = latency_classes(runner._timeline)
+        jct = sorted(v for c in classes.values()
+                     for v in c.get("jct_s", ()))
+        ttfb = sorted(v for c in classes.values()
+                      for v in c.get("ttfb_s", ()))
+        assert jct == pytest.approx(sorted(runner.jct), abs=2e-6)
+        assert ttfb == pytest.approx(sorted(runner.queueing_delay),
+                                     abs=2e-6)
+        # and the report section holds the same percentiles
+        merged = percentiles(jct)
+        got = report["latency"]["classes"]
+        assert set(got) == set(classes)
+        assert report["latency"]["timeline"]["jobs"] \
+            == report["jobs"]["arrived"]
+        for key in ("p50", "p99"):
+            assert abs(percentiles(runner.jct)[key] - merged[key]) < 2e-6
+
+    def test_lifecycle_sections_are_flag_gated(self):
+        trace = make_scenario("smoke", seed=3)
+        plain = SimRunner(trace, seed=3).run()
+        assert "latency" not in plain and "slo" not in plain
+
+    def test_lifecycle_run_is_repeat_identical(self):
+        trace = make_scenario("smoke", seed=3)
+        a = SimRunner(trace, seed=3, lifecycle=True).run()
+        b = SimRunner(trace, seed=3, lifecycle=True).run()
+        from volcano_tpu.sim.report import deterministic_json
+        assert deterministic_json(a) == deterministic_json(b)
+        assert a["slo"], "SLO engine evaluated no objectives"
+
+
+# ---------------------------------------------------------------------------
+# 6. /debug surfaces + vcctl verbs
+# ---------------------------------------------------------------------------
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+@pytest.fixture()
+def server():
+    srv = metrics.start_metrics_server(0, "127.0.0.1")
+    yield srv.server_address[1]
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestDebugSurfaces:
+    def test_debug_timeline_endpoint(self, server):
+        TIMELINE.set_context(cycle=4, part=1, epoch=2, t=8.0)
+        TIMELINE.record("default/train", "arrival", queue="q1")
+        TIMELINE.record("default/train", "bind_intent", node="n1")
+        status, body = _get(server, "/debug/timeline?job=train")
+        assert status == 200
+        tl = json.loads(body)
+        assert tl["job"] == "default/train"
+        assert [e["ev"] for e in tl["events"]] == ["arrival",
+                                                   "bind_intent"]
+        assert tl["events"][0]["part"] == 1
+        status, body = _get(server, "/debug/timeline?job=ghost")
+        assert status == 404 and b"jobs_retained" in body
+        status, _ = _get(server, "/debug/timeline")
+        assert status == 400
+
+    def test_debug_why_first_denied_cycle_survives_ring_aging(self, server):
+        """The regression: a gang denied long ago whose audit-ring
+        records aged out must still explain itself — the timeline's
+        teed solve events carry the first denial."""
+        TIMELINE.set_context(cycle=2, part=0, epoch=1, t=2.0)
+        TIMELINE.record("jold", "solve", verdict="denied",
+                        reason="gang not ready: 1/2")
+        TIMELINE.set_context(cycle=400, t=400.0)
+        TIMELINE.record("jold", "solve", verdict="denied",
+                        reason="queue overused")
+        assert AUDIT.why("jold") is None      # the ring aged it out
+        rec = why("jold")
+        assert rec["first_denied_cycle"] == 2
+        assert rec["verdict"] == "denied"
+        assert rec["reason"] == "queue overused"
+        assert rec["timeline_events"] == 2
+        status, body = _get(server, "/debug/why?job=jold")
+        assert status == 200
+        assert json.loads(body)["first_denied_cycle"] == 2
+
+    def test_debug_why_unknown_job_still_404s(self, server):
+        status, body = _get(server, "/debug/why?job=never-seen")
+        assert status == 404
+
+
+class TestCLIVerbs:
+    def test_vcctl_job_timeline(self):
+        from volcano_tpu.cli.vcctl import main as vcctl_main
+        TIMELINE.set_context(cycle=3, part=1, epoch=2, t=5.0)
+        TIMELINE.record("default/train", "arrival", queue="q1")
+        TIMELINE.record("default/train", "running", node="n1")
+        lines = []
+        rc = vcctl_main(["job", "timeline", "--name", "train"],
+                        out=lines.append)
+        assert rc == 0
+        assert "default/train: 2 event(s)" in lines[0]
+        assert "p1/e2" in lines[1] and "arrival" in lines[1]
+        assert '"queue": "q1"' in lines[1]
+        lines.clear()
+        rc = vcctl_main(["job", "timeline", "--name", "ghost"],
+                        out=lines.append)
+        assert rc == 1 and "no timeline retained" in lines[0]
+
+    def test_vcctl_slo_status(self):
+        from volcano_tpu.cli.vcctl import main as vcctl_main
+        TIMELINE.set_context(t=17.0)
+        for i in range(4):
+            TIMELINE.record(f"j{i}", "arrival", t=float(i), queue="q1")
+            TIMELINE.record(f"j{i}", "complete", t=float(i) + 1.0)
+        saved = SLO_ENGINE.objectives
+        SLO_ENGINE.objectives = [SLO("jct_ok", "jct", threshold_s=5.0,
+                                     target=0.9, windows=(8.0, 64.0))]
+        try:
+            lines = []
+            rc = vcctl_main(["slo", "status"], out=lines.append)
+        finally:
+            SLO_ENGINE.objectives = saved
+        assert rc == 0
+        line = next(ln for ln in lines if "jct_ok" in ln)
+        assert "compliance=1.0" in line and "burn[8]=0" in line

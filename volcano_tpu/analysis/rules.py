@@ -1845,6 +1845,67 @@ class MeshMutationWitnessRule(Rule):
         return findings
 
 
+# ---------------------------------------------------------------------------
+# VT022 — durable funnel records carry a lifecycle-timeline witness
+# ---------------------------------------------------------------------------
+
+class LifecycleEventWitnessRule(Rule):
+    """Every durable record a decision funnel writes (a journal intent,
+    a reserve/move/elastic control record) is a milestone in some job's
+    cluster-causal story — and the per-job timeline (obs/lifecycle.py)
+    is reconstructed FROM those records after a failover or queue move.
+    A funnel that writes the record without stamping/forwarding a
+    correlation ctx (``TIMELINE.stamp``/``record``/``ingest``, same
+    function or one hop) produces a durable event no successor process
+    can place on the timeline: the job's story silently breaks at
+    exactly the handoff the observability layer exists to survive."""
+
+    id = "VT022"
+    name = "lifecycle-event-witness"
+    contract = ("durable funnel record (record_intent/record_control) "
+                "without a lifecycle-timeline witness (TIMELINE.stamp/"
+                "record/ingest) on the path (cluster-causal "
+                "observability, docs/observability.md)")
+    # the decision funnels whose records carry per-job milestones; the
+    # command funnel (elastic_gang/commands.py) journals operator-verb
+    # ledger records, not job lifecycle events, and journal.py itself
+    # defines the writers (it ingests, it does not originate)
+    scope = ("volcano_tpu/cache/cache.py",
+             "volcano_tpu/cache/feedback.py",
+             "volcano_tpu/federation/reserve.py",
+             "volcano_tpu/elastic_gang/grow_shrink.py")
+
+    MUTATOR_METHODS = {"record_intent", "record_control"}
+    WITNESS = {"stamp", "record", "ingest"}
+
+    def check(self, mod: ModuleInfo, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr not in self.MUTATOR_METHODS:
+                continue
+            recv = dotted_name(node.func.value) or "<expr>"
+            fn = mod.enclosing_function(node.lineno)
+            if fn is not None:
+                # the writer's own def (an override/test double) is the
+                # persistence floor, not a funnel decision
+                if fn.name in self.MUTATOR_METHODS:
+                    continue
+                if ctx.witness_in_scope(fn, self.WITNESS):
+                    continue
+            where = fn.qualname if fn else "<module>"
+            findings.append(self.finding(
+                mod, node,
+                f"durable funnel record {recv}.{node.func.attr}(...) in "
+                f"{where} without a lifecycle-timeline witness "
+                f"(TIMELINE.stamp / record / ingest) on the path; the "
+                f"record cannot be placed on any job timeline after a "
+                f"failover or queue move (docs/observability.md "
+                f"cluster-causal model)"))
+        return findings
+
+
 ALL_RULES: List[Rule] = [
     DirtyWitnessRule(), RawClockRule(), UnseededRandomRule(),
     JournalFunnelRule(), SimKillSwallowRule(), ShapeBucketRule(),
@@ -1854,6 +1915,7 @@ ALL_RULES: List[Rule] = [
     SpeculationIsolationRule(), StoreVerbFunnelRule(),
     InflightLedgerRule(), BoundedWorkRule(), MembershipFunnelRule(),
     ElasticFunnelRule(), MeshMutationWitnessRule(),
+    LifecycleEventWitnessRule(),
 ]
 
 # the rules that run on the shared dataflow/callgraph engine
@@ -1903,6 +1965,11 @@ solver(state, tasks)                       # no _bucket()/pad on the path''',
     DEVICE_HEALTH.quarantine(device, "oom")   # no invalidate_device_state:
                                               # next dispatch reuses tensors
                                               # shaped for the dead mesh''',
+    "VT022": '''def _journal_intent(self, op, task):
+    self.journal.record_intent(op, task)   # no TIMELINE.stamp/record:
+                                           # the durable record carries no
+                                           # ctx — the job timeline breaks
+                                           # at the next failover/move''',
     "VT010": '''packed = solver(state, tasks)          # device value
 n = int(packed[0])                     # implicit fetch OUTSIDE any
                                        # solve/replay/upload span''',

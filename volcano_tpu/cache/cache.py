@@ -27,6 +27,8 @@ from ..api import (ClusterInfo, JobInfo, NamespaceCollection, NamespaceInfo,
                    TaskStatus, allocated_status)
 from .executors import (Binder, Evictor, FakeBinder, FakeEvictor,
                         StatusUpdater, VolumeBinder)
+from ..obs.lifecycle import TIMELINE
+from ..obs.trace import TRACE as OBS_TRACE
 from .feedback import FeedbackChannel
 from .inflight import InflightLedger
 from .journal import IntentJournal, journal_enabled
@@ -254,6 +256,11 @@ class SchedulerCache:
         # executed — the executor DID ack the call — so expiry recovers
         # the lost ack instead of inventing a rollback.
         self.inflight_oracle_fn: Optional[Callable] = None
+        # lifecycle-timeline attribution (obs/lifecycle.py): the
+        # partition id this cache's funnel events are stamped with —
+        # 0 standalone; the federated sim/member wiring sets the real
+        # pid. Observability only: nothing decision-plane reads it.
+        self.obs_part = 0
 
     # -- intent journal (cache/journal.py) ----------------------------------
 
@@ -282,10 +289,25 @@ class SchedulerCache:
         crash-window rollback may strip the task's placement
         (journal._rollback_bind)."""
         epoch = self.fencing_epoch()
+        # lifecycle stamp (obs/lifecycle.py; vlint VT022): the intent's
+        # correlation ctx both records the timeline event HERE and rides
+        # inside the durable record, so a follower/restart continues the
+        # same timeline exactly-once (dedupe on the ctx's part+eid)
+        ctx = TIMELINE.stamp(part=self.obs_part, epoch=epoch)
+        if ctx is not None:
+            TIMELINE.record(task.job, f"{op}_intent", ctx=ctx,
+                            node=node or task.node_name or None,
+                            via=via or None)
+        if op == "bind":
+            # cross-lane causal arc (merged federated traces): the bind
+            # intent opens/continues the job's flow; the RUNNING ack and
+            # any queue move step it, completion closes it
+            OBS_TRACE.flow_step("bind_intent", f"job:{task.job}",
+                                task=task.uid)
         if self.journal is None:
             return None
         seq = self.journal.record_intent(op, task, node, via, fresh,
-                                         epoch=epoch)
+                                         epoch=epoch, ctx=ctx)
         if sync:
             self.journal.flush()
         return seq

@@ -64,16 +64,22 @@ class Intent:
     """One journaled side-effect intent (decoded view)."""
 
     __slots__ = ("seq", "op", "task", "job", "node", "via", "fresh",
-                 "epoch")
+                 "epoch", "ctx")
 
     def __init__(self, seq: int, op: str, task: str, job: str, node: str,
-                 via: str = "", fresh: bool = True, epoch: int = 0):
+                 via: str = "", fresh: bool = True, epoch: int = 0,
+                 ctx: Optional[dict] = None):
         self.seq = seq
         self.op = op                  # "bind" | "evict"
         self.task = task              # task uid
         self.job = job                # owning job uid
         self.node = node              # bind target / evictee's node
         self.via = via                # "" (scheduler cycle) | "resync"
+        # optional correlation context (obs/lifecycle.py): the logical
+        # {cycle, part, epoch, eid} stamp that lets a follower/restart
+        # continue the job's timeline exactly-once. None keeps the
+        # record byte-identical to the pre-ctx shape.
+        self.ctx = ctx
         # fresh=True: a NEW placement (the optimistic phase moved the
         # task from unplaced to this node). False: a RE-bind of a task
         # already validly placed — rolling that back must not strip the
@@ -148,7 +154,8 @@ class IntentJournal:
                                      rec.get("job", ""), rec.get("node", ""),
                                      rec.get("via", ""),
                                      bool(rec.get("fresh", True)),
-                                     int(rec.get("epoch", 0)))
+                                     int(rec.get("epoch", 0)),
+                                     rec.get("ctx"))
         elif rec.get("kind") == "ack":
             self._open.pop(seq, None)
 
@@ -184,12 +191,13 @@ class IntentJournal:
         with open(tmp, "w", encoding="utf-8") as f:
             for seq in sorted(self._open):
                 it = self._open[seq]
-                f.write(json.dumps(
-                    {"kind": "intent", "seq": it.seq, "op": it.op,
-                     "task": it.task, "job": it.job, "node": it.node,
-                     "via": it.via, "fresh": it.fresh,
-                     "epoch": it.epoch},
-                    separators=(",", ":")) + "\n")
+                rec = {"kind": "intent", "seq": it.seq, "op": it.op,
+                       "task": it.task, "job": it.job, "node": it.node,
+                       "via": it.via, "fresh": it.fresh,
+                       "epoch": it.epoch}
+                if it.ctx is not None:
+                    rec["ctx"] = it.ctx
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
             f.flush()
             os.fsync(f.fileno())
         self._fh.close()
@@ -221,21 +229,25 @@ class IntentJournal:
 
     def record_intent(self, op: str, task, node: str = "",
                       via: str = "", fresh: bool = True,
-                      epoch: int = 0) -> int:
+                      epoch: int = 0, ctx: Optional[dict] = None) -> int:
         """Journal a side-effect intent BEFORE the executor runs, stamped
-        with the issuing leader's fencing ``epoch``. Returns the seq to
-        ack with."""
+        with the issuing leader's fencing ``epoch`` and (optionally) its
+        correlation ``ctx`` — the lifecycle-timeline stamp a follower or
+        restarted process ingests to continue the job's story
+        (obs/lifecycle.py). Returns the seq to ack with."""
         with self._lock:
             self._seq += 1
             seq = self._seq
             intent = Intent(seq, op, task.uid, task.job,
                             node or task.node_name or "", via, fresh,
-                            epoch)
+                            epoch, ctx)
             self._open[seq] = intent
             rec = {"kind": "intent", "seq": seq, "op": op,
                    "task": intent.task, "job": intent.job,
                    "node": intent.node, "via": via, "fresh": fresh,
                    "epoch": epoch}
+            if ctx is not None:
+                rec["ctx"] = ctx
             self._append(rec)
         self._publish(rec)
         return seq
@@ -349,15 +361,57 @@ class JournalFollower:
 
     def seed(self, journal: IntentJournal) -> None:
         for it in journal.unacked():
-            self._pending[it.seq] = {
+            rec = {
                 "kind": "intent", "seq": it.seq, "op": it.op,
                 "task": it.task, "job": it.job, "node": it.node,
                 "via": it.via, "fresh": it.fresh, "epoch": it.epoch}
+            if it.ctx is not None:
+                rec["ctx"] = it.ctx
+            self._pending[it.seq] = rec
+            self._ingest_timeline(rec)
+
+    # journal record kind -> lifecycle event the follower continues the
+    # timeline with (obs/lifecycle.py); intents map to "<op>_intent"
+    _TIMELINE_KINDS = {"elastic_grow": "grow", "elastic_shrink": "shrink"}
+
+    def _ingest_timeline(self, rec: dict) -> None:
+        """Continue job timelines from the ctx stamps riding the record
+        stream — what lets a standby/newborn process hold the events it
+        never witnessed. Exactly-once: the store dedupes on the ctx's
+        (part, eid), so re-seeding, rotation replay, or a torn tail
+        re-read is a no-op."""
+        if rec.get("kind") == "queue_move_done":
+            # one record per queue; per-job ctx stamps ride in "jobs"
+            jobs = rec.get("jobs")
+            if isinstance(jobs, dict) and jobs:
+                from ..obs.lifecycle import TIMELINE
+                for job, ctx in jobs.items():
+                    if isinstance(ctx, dict):
+                        TIMELINE.ingest(job, "move", ctx,
+                                        queue=rec.get("queue"),
+                                        frm=rec.get("frm"),
+                                        to=rec.get("to"))
+            return
+        ctx = rec.get("ctx")
+        job = rec.get("job", "")
+        if not isinstance(ctx, dict) or not job:
+            return
+        from ..obs.lifecycle import TIMELINE
+        if rec.get("kind") == "intent":
+            ev = f"{rec.get('op', 'bind')}_intent"
+        else:
+            ev = self._TIMELINE_KINDS.get(rec.get("kind"))
+            if ev is None:
+                return
+        TIMELINE.ingest(job, ev, ctx, node=rec.get("node") or None,
+                        reason=rec.get("reason") or None,
+                        frm=rec.get("frm"), to=rec.get("to"))
 
     # -- the replay ---------------------------------------------------------
 
     def apply_record(self, rec: dict) -> None:
         kind = rec.get("kind")
+        self._ingest_timeline(rec)
         if kind == "intent":
             self._pending[int(rec.get("seq", 0))] = rec
             return

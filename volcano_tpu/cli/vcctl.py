@@ -166,6 +166,15 @@ def build_parser() -> argparse.ArgumentParser:
     js.add_argument("--desired", type=int, required=True,
                     help="target member count (min_available still floors "
                          "the gang; 0 parks it at min)")
+    jt = job.add_parser(
+        "timeline", description="The job's retained lifecycle timeline "
+                                "(docs/observability.md): every causal "
+                                "event — arrival, solve verdicts, bind "
+                                "intents, acks, queue moves, elastic "
+                                "grow/shrink, completion — stamped with "
+                                "its originating cycle/partition/epoch; "
+                                "process-local like the trace verbs")
+    jt.add_argument("--name", required=True)
 
     queue = sub.add_parser("queue").add_subparsers(dest="verb")
     qc = queue.add_parser("create")
@@ -277,6 +286,18 @@ def build_parser() -> argparse.ArgumentParser:
                               "counts, volcano_store_faults/retries "
                               "totals and per-stream watch state")
 
+    slo = sub.add_parser(
+        "slo", description="SLO verbs (docs/observability.md): the "
+                           "declarative objectives evaluated over the "
+                           "lifecycle timeline store — process-local "
+                           "like the trace verbs").add_subparsers(
+        dest="verb")
+    slo.add_parser(
+        "status", description="Evaluate every configured objective at "
+                              "the store's current virtual time: "
+                              "compliance, sample count and per-window "
+                              "burn rates")
+
     sub.add_parser("version")
     return parser
 
@@ -310,13 +331,55 @@ def main(argv: Optional[List[str]] = None, store: Optional[ObjectStore] = None,
                 out(TRACE.dump())
             return 0
         if args.verb == "why":
-            rec = AUDIT.why(args.job)
+            # timeline-backed (obs/lifecycle.py): the audit verdict
+            # extended with the causal history the ring ages out of
+            from ..obs.lifecycle import why as timeline_why
+            rec = timeline_why(args.job)
             if rec is None:
                 out(f"no decision recorded for job {args.job!r} in the "
                     f"last {AUDIT.cycles_retained()} retained cycle(s)")
                 return 1
             import json
             out(json.dumps(rec, sort_keys=True))
+            return 0
+        build_parser().print_help()
+        return 1
+    if args.group == "job" and args.verb == "timeline":
+        # process-local, like the trace verbs: read the running
+        # scheduler's lifecycle timeline store (docs/observability.md)
+        import json
+        from ..obs import TIMELINE
+        tl = TIMELINE.timeline(args.name)
+        if tl is None:
+            out(f"no timeline retained for job {args.name!r} "
+                f"({TIMELINE.job_count()} job(s) retained)")
+            return 1
+        out(f"job {tl['job']}: {len(tl['events'])} event(s)")
+        for ev in tl["events"]:
+            extras = {k: v for k, v in ev.items()
+                      if k not in ("ev", "cycle", "part", "epoch",
+                                   "eid", "t")}
+            tail = " " + json.dumps(extras, sort_keys=True) if extras \
+                else ""
+            out(f"t={ev['t']}\tcycle={ev['cycle']}\t"
+                f"p{ev['part']}/e{ev['epoch']}\t{ev['ev']}{tail}")
+        return 0
+    if args.group == "slo":
+        if args.verb == "status":
+            from ..obs import SLO_ENGINE, TIMELINE
+            status = SLO_ENGINE.publish(now=TIMELINE.now())
+            if not status:
+                out("no SLO objectives configured")
+                return 1
+            for obj in status:
+                burns = " ".join(
+                    f"burn[{w}]={r}" for w, r in sorted(
+                        obj["burn_rate"].items(),
+                        key=lambda kv: float(kv[0])))
+                out(f"{obj['slo']}\tmetric={obj['metric']}\t"
+                    f"ok={obj['ok']}\tcompliance={obj['compliance']}\t"
+                    f"samples={obj['samples']}\t"
+                    f"threshold_s={obj['threshold_s']}\t{burns}")
             return 0
         build_parser().print_help()
         return 1

@@ -43,6 +43,8 @@ import time
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
+from ..obs.lifecycle import TIMELINE
+from ..obs.trace import TRACE as OBS_TRACE
 from .partition import PartitionMap
 
 log = logging.getLogger(__name__)
@@ -226,9 +228,21 @@ class ReserveLedger:
         """The reserve/transfer journal funnel: every protocol step is a
         durable control record in the SHARED intent journal, so a
         restarted partition (or a warm standby tailing the stream) sees
-        the full cross-partition audit trail. The VT009 witness."""
-        if self.journal is not None:
-            self.journal.record_control(kind, fields)
+        the full cross-partition audit trail. The VT009 witness.
+
+        Each record carries a correlation ``ctx`` stamp
+        (obs/lifecycle.py) unless the caller already attached job-level
+        stamps — the ``ctx`` key is present only when the timeline store
+        is enabled, so the pre-ctx record shape is preserved verbatim
+        with the store off."""
+        if self.journal is None:
+            return
+        if "ctx" not in fields and "jobs" not in fields:
+            ctx = TIMELINE.stamp(part=fields.get("frm"),
+                                 epoch=fields.get("epoch"))
+            if ctx is not None:
+                fields = dict(fields, ctx=ctx)
+        self.journal.record_control(kind, fields)
 
     # -- requester side ------------------------------------------------------
 
@@ -561,23 +575,41 @@ class ReserveLedger:
             dest_cache = self._caches.get(dest)
             if dest_cache is None:
                 continue
-            if not self._move_queue_jobs(queue, cache, dest_cache):
+            moved_jobs = self._move_queue_jobs(queue, cache, dest_cache)
+            if moved_jobs is None:
                 continue             # mirrors not ready: next cycle
             self.pmap._transfer_queue_raw(queue, dest)
             with self._lock:
                 self.queue_moves += 1
+            # per-job lifecycle stamps (vlint VT022): each moved job gets
+            # its own correlation ctx, recorded locally AND carried
+            # inside the single queue_move_done record, so a follower on
+            # the destination continues every job's timeline without a
+            # duplicate (the store dedupes on (part, eid))
+            job_ctx: Dict[str, dict] = {}
+            for jid in moved_jobs:
+                ctx = TIMELINE.stamp(part=pid, epoch=epoch)
+                if ctx is not None:
+                    job_ctx[jid] = ctx
+                    TIMELINE.record(jid, "move", ctx=ctx, queue=queue,
+                                    frm=pid, to=dest)
+                    OBS_TRACE.flow_step("queue_move", f"job:{jid}",
+                                        queue=queue)
+            extra = {"jobs": job_ctx} if job_ctx else {}
             self._journal_reserve("queue_move_done", queue=queue, frm=pid,
-                                  to=dest, epoch=epoch)
+                                  to=dest, epoch=epoch, **extra)
             flipped += 1
         return flipped
 
     @staticmethod
-    def _move_queue_jobs(queue: str, frm_cache, to_cache) -> bool:
+    def _move_queue_jobs(queue: str, frm_cache,
+                         to_cache) -> Optional[List[str]]:
         """Surgically move a drained queue's jobs between partition
         caches: the job objects (and their placed tasks' node-mirror
         accounting) leave the source cache — remove_job also purges any
         queued retry/dead-letter state, so no orphaned side effects —
         and land in the destination, dirty-marked on both sides.
+        Returns the moved job uids, or ``None`` when the flip deferred.
 
         The move is all-or-nothing: before touching either cache it
         proves every placed task fits its destination node mirror.
@@ -606,7 +638,7 @@ class ReserveLedger:
                         "deferring queue %s move: node %s mirror in the "
                         "destination cannot absorb task %s yet",
                         queue, node_name, task.uid)
-                    return False
+                    return None
                 headroom.sub(task.resreq)
         for job in moved:
             frm_cache.remove_job(job.uid)
@@ -626,7 +658,7 @@ class ReserveLedger:
                 if node is not None and task.uid not in node.tasks:
                     to_cache.mark_node_dirty(node.name)
                     node.add_task(task)
-        return True
+        return [job.uid for job in moved]
 
     # -- elastic membership (the same journaled funnel; vlint VT019) ---------
 

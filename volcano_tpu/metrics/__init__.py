@@ -301,6 +301,16 @@ if _HAVE_PROM:
         f"{_SUBSYSTEM}_elastic_below_min_evictions_total",
         "Evictions that took an elastic gang below min outside a "
         "full-gang decision — the invariant witness, expected 0")
+    _slo_compliance = Gauge(
+        f"{_SUBSYSTEM}_slo_compliance",
+        "Fraction of retained timeline samples within the labelled "
+        "objective's threshold (obs/slo.py; docs/observability.md)",
+        ["slo"])
+    _slo_burn_rate = Gauge(
+        f"{_SUBSYSTEM}_slo_burn_rate",
+        "Error-budget burn rate of the labelled objective over the "
+        "labelled look-back window (1.0 = spending the budget exactly)",
+        ["slo", "window"])
 
 
 def set_elastic_members(n: int) -> None:
@@ -460,6 +470,9 @@ def health_detail() -> dict:
             # bounded-set evictions (each eviction is a WARNING: state
             # was dropped to stay bounded) and the rebalancer state
             "overload": _overload_detail_locked(),
+            # the SLO plane (docs/observability.md): the engine's last
+            # published evaluation (compliance + per-window burn rates)
+            "slo": [dict(obj) for obj in _health_detail.get("slo", [])],
         }
 
 
@@ -595,6 +608,34 @@ def register_feedback_ack(kind: str, verdict: str) -> None:
         _counters[("feedback_acks", kind, verdict)] += 1
     if _HAVE_PROM:
         _feedback_acks.labels(kind=kind, verdict=verdict).inc()
+
+
+def set_slo_status(status) -> None:
+    """Publish one SLO-engine evaluation (obs/slo.py): the
+    volcano_slo_compliance{slo} / volcano_slo_burn_rate{slo,window}
+    gauges plus the ``slo`` section of /healthz?detail. Replaces the
+    previous evaluation wholesale — objectives that disappeared (a
+    per-class expansion whose class drained away) must not linger as
+    stale samples."""
+    with _lock:
+        for k in [k for k in _gauges
+                  if k[0] in ("slo_compliance", "slo_burn_rate")]:
+            del _gauges[k]
+        for obj in status:
+            name = str(obj.get("slo", ""))
+            _gauges[("slo_compliance", name)] = float(
+                obj.get("compliance", 1.0))
+            for window, rate in (obj.get("burn_rate") or {}).items():
+                _gauges[("slo_burn_rate", name, str(window))] = float(rate)
+        _health_detail["slo"] = [dict(obj) for obj in status]
+    if _HAVE_PROM:
+        for obj in status:
+            name = str(obj.get("slo", ""))
+            _slo_compliance.labels(slo=name).set(
+                float(obj.get("compliance", 1.0)))
+            for window, rate in (obj.get("burn_rate") or {}).items():
+                _slo_burn_rate.labels(slo=name,
+                                      window=str(window)).set(float(rate))
 
 
 def register_speculation(outcome: str) -> None:
@@ -1041,6 +1082,9 @@ _EXPO_GAUGES = {
                                 None),
     "admission_pending_bytes": (f"{_SUBSYSTEM}_admission_pending_bytes",
                                 None),
+    "slo_compliance": (f"{_SUBSYSTEM}_slo_compliance", "slo"),
+    # tuple label spec: one label per key component (slo, window)
+    "slo_burn_rate": (f"{_SUBSYSTEM}_slo_burn_rate", ("slo", "window")),
 }
 _EXPO_COUNTERS = {
     "attempts": (f"{_SUBSYSTEM}_schedule_attempts_total", "result"),
@@ -1147,6 +1191,10 @@ def fallback_exposition() -> bytes:
                 name = f"{_SUBSYSTEM}_{_expo_name(key[0])}"
                 label, labelv = ("key", ":".join(key[1:])) \
                     if len(key) > 1 else (None, None)
+            elif isinstance(spec[1], tuple):
+                # multi-label gauge (e.g. slo_burn_rate{slo,window})
+                name, label = spec
+                labelv = tuple(key[1:]) if len(key) > 1 else None
             else:
                 name, label = spec
                 labelv = key[1] if label is not None and len(key) > 1 \
@@ -1197,9 +1245,10 @@ def start_metrics_server(port: int = 8080, host: str = ""):
     trips, so a liveness probe can distinguish slow from crash-looping.
 
     /debug/traces serves the recorder's Chrome trace-event JSON ring
-    (perfetto-loadable); /debug/why?job=NAME serves the last audit
-    verdict for a gang (docs/observability.md). Returns the http.server
-    instance (daemon thread)."""
+    (perfetto-loadable); /debug/why?job=NAME serves the timeline-backed
+    decision explanation for a gang; /debug/timeline?job=NAME serves its
+    full retained lifecycle timeline (docs/observability.md). Returns
+    the http.server instance (daemon thread)."""
     import http.server
     import threading
 
@@ -1235,9 +1284,9 @@ def start_metrics_server(port: int = 8080, host: str = ""):
                 from ..obs import TRACE
                 body = TRACE.dump().encode()
                 ctype = "application/json"
-            elif self.path.startswith("/debug/why"):
+            elif self.path.startswith("/debug/timeline"):
                 from urllib.parse import parse_qs, urlparse
-                from ..obs import AUDIT
+                from ..obs import TIMELINE
                 ctype = "application/json"
                 q = parse_qs(urlparse(self.path).query)
                 job = (q.get("job") or [None])[0]
@@ -1246,7 +1295,32 @@ def start_metrics_server(port: int = 8080, host: str = ""):
                     body = json.dumps(
                         {"error": "missing ?job= query parameter"}).encode()
                 else:
-                    rec = AUDIT.why(job)
+                    tl = TIMELINE.timeline(job)
+                    if tl is None:
+                        status = 404
+                        body = json.dumps(
+                            {"error": f"no timeline retained for job "
+                                      f"{job!r}",
+                             "jobs_retained":
+                                 TIMELINE.job_count()}).encode()
+                    else:
+                        body = json.dumps(tl, sort_keys=True).encode()
+            elif self.path.startswith("/debug/why"):
+                from urllib.parse import parse_qs, urlparse
+                from ..obs import AUDIT
+                from ..obs.lifecycle import why as timeline_why
+                ctype = "application/json"
+                q = parse_qs(urlparse(self.path).query)
+                job = (q.get("job") or [None])[0]
+                if not job:
+                    status = 400
+                    body = json.dumps(
+                        {"error": "missing ?job= query parameter"}).encode()
+                else:
+                    # timeline-backed: the audit ring's verdict extended
+                    # with causal history the ring ages out of, so a gang
+                    # denied 200 cycles ago still explains itself
+                    rec = timeline_why(job)
                     if rec is None:
                         status = 404
                         body = json.dumps(

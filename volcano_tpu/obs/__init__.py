@@ -12,14 +12,27 @@ Three coupled layers:
 - ``export`` — Chrome trace-event JSON (perfetto-loadable) dumps, served
   by ``/debug/traces`` + ``/debug/why`` on the metrics HTTP server,
   ``vcctl trace dump|why``, and ``python -m volcano_tpu.sim --trace-out``.
+- ``lifecycle`` — the cluster-causal layer: per-job timelines stitched
+  from correlation contexts carried inside the durable records, so a
+  job's story survives queue moves / failovers / membership changes;
+  served by ``/debug/timeline`` + ``vcctl job timeline``.
+- ``slo``    — declarative objectives with multi-window burn-rate math
+  over the timeline store (``vcctl slo status``, /healthz?detail).
 """
 
 from .audit import AUDIT, AuditLog
-from .export import chrome_trace, span_totals_ms, validate_chrome_trace
+from .export import (chrome_trace, flow_summary, span_totals_ms,
+                     validate_chrome_trace)
+from .lifecycle import TIMELINE, TimelineStore
+from .slo import ENGINE as SLO_ENGINE
+from .slo import SLO, SLOEngine, default_slos
 from .trace import TRACE, TraceRecorder, span
 
 __all__ = [
     "AUDIT", "AuditLog",
     "TRACE", "TraceRecorder", "span",
-    "chrome_trace", "span_totals_ms", "validate_chrome_trace",
+    "TIMELINE", "TimelineStore",
+    "SLO", "SLOEngine", "SLO_ENGINE", "default_slos",
+    "chrome_trace", "flow_summary", "span_totals_ms",
+    "validate_chrome_trace",
 ]

@@ -95,14 +95,17 @@ class AuditLog:
 
     def record_cycle(self, cycle: int, t: float,
                      records: Dict[str, List[dict]],
-                     live_jobs=None) -> None:
+                     live_jobs=None) -> Dict[str, List[dict]]:
         """Absorb one cycle's records. Unchanged repeats (same
         verdict+reason as the job's current state — the steady "still
         denied for the same reason" case) refresh nothing and are dropped
         from the ring; ``live_jobs`` (the cycle's job-uid set) prunes
-        ``_latest`` entries of completed/deleted jobs."""
+        ``_latest`` entries of completed/deleted jobs. Returns the
+        CHANGED records (what entered the ring) so the harvest can tee
+        them into the lifecycle timelines without re-deriving the
+        change-only filter."""
         if not self.enabled:
-            return
+            return {}
         with self._lock:
             changed: Dict[str, List[dict]] = {}
             for job, recs in records.items():
@@ -141,6 +144,7 @@ class AuditLog:
         if evicted:
             from .. import metrics
             metrics.register_audit_evicted(evicted)
+        return changed
 
     # -- query --------------------------------------------------------------
 
@@ -258,7 +262,15 @@ def harvest_cycle(ssn, cycle: int, t: float,
                     reason = f"{reason} — {detail}"
                 break
             add(job.uid, job.queue, "denied", reason)
-    log.record_cycle(cycle, t, records, live_jobs=set(ssn.jobs))
+    changed = log.record_cycle(cycle, t, records, live_jobs=set(ssn.jobs))
+    # tee the change-only decisions into the lifecycle timelines
+    # (obs/lifecycle.py): the "solve" event is what lets /debug/why
+    # answer for a gang whose denial aged out of this ring
+    from .lifecycle import TIMELINE
+    for job_uid, recs in changed.items():
+        for rec in recs:
+            TIMELINE.record(job_uid, "solve", t=rec["t"],
+                            verdict=rec["verdict"], reason=rec["reason"])
     return len(records)
 
 

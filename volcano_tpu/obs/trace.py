@@ -83,6 +83,16 @@ class TraceRecorder:
         self._cycles: collections.deque = collections.deque(
             maxlen=max_cycles or None)
         self._current: Optional[List[dict]] = None
+        # process lane: the pid stamped on every event. Standalone stays
+        # 1 (the historical shape); a federated sim sets the partition id
+        # at each cycle boundary so a merged trace renders one process
+        # lane per partition (docs/observability.md).
+        self._pid = 1
+        # flow-event state (s/t/f phases connecting events across lanes):
+        # insertion-ordered key -> id map keeps flow ids deterministic,
+        # the open set guarantees s/t/f validity by construction
+        self._flow_ids: Dict[str, int] = {}
+        self._flow_open: set = set()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -121,13 +131,23 @@ class TraceRecorder:
         self._current = None
         self._seq = 0
         self._last_ts = 0.0
+        self._pid = 1
+        self._flow_ids.clear()
+        self._flow_open.clear()
+
+    def set_pid(self, pid: int) -> None:
+        """Pin the process lane subsequent events are stamped with — the
+        federated sim sets each partition's id at its cycle boundary so
+        the merged artifact splits into per-partition lanes."""
+        with self._lock:
+            self._pid = int(pid)
 
     # -- hot path -----------------------------------------------------------
 
     def span(self, name: str, **attrs) -> Span:
         return Span(self, name, attrs)
 
-    def _now_us(self) -> float:
+    def _now_us_locked(self) -> float:
         if self._logical:
             self._seq += 1
             return float(self._seq)
@@ -142,16 +162,52 @@ class TraceRecorder:
         return ts
 
     def _emit(self, ph: str, name: str, attrs: Optional[dict]) -> None:
-        ident = threading.get_ident()
         with self._lock:
-            tid = self._tids.setdefault(ident, len(self._tids) + 1)
-            ev = {"ph": ph, "name": name, "cat": "scheduler",
-                  "pid": 1, "tid": tid, "ts": self._now_us()}
-            if attrs:
-                ev["args"] = attrs
-            if self._current is None:        # ambient span outside a cycle
-                self._current = []
-            self._current.append(ev)
+            self._emit_locked(ph, name, attrs)
+
+    def _emit_locked(self, ph: str, name: str, attrs: Optional[dict],
+                     cat: str = "scheduler",
+                     extra: Optional[dict] = None) -> None:
+        ident = threading.get_ident()
+        tid = self._tids.setdefault(ident, len(self._tids) + 1)
+        ev = {"ph": ph, "name": name, "cat": cat,
+              "pid": self._pid, "tid": tid, "ts": self._now_us_locked()}
+        if extra:
+            ev.update(extra)
+        if attrs:
+            ev["args"] = attrs
+        if self._current is None:            # ambient span outside a cycle
+            self._current = []
+        self._current.append(ev)
+
+    # -- flow events (cross-lane causality) ---------------------------------
+
+    def flow_step(self, name: str, key: str, **attrs) -> None:
+        """One hop of a cross-lane causal arc (bind intent → ack → move →
+        re-bind): emits a flow-start ``s`` the first time ``key`` is
+        seen (or after an end), a flow-step ``t`` afterwards. Flow ids
+        are minted from an insertion-ordered map, so a deterministic
+        event sequence produces a byte-identical artifact."""
+        with self._lock:
+            if not self._recording:
+                return
+            fid = self._flow_ids.setdefault(key, len(self._flow_ids) + 1)
+            ph = "t" if key in self._flow_open else "s"
+            self._flow_open.add(key)
+            self._emit_locked(ph, name, attrs or None, cat="flow",
+                              extra={"id": fid})
+
+    def flow_end(self, name: str, key: str, **attrs) -> None:
+        """Close ``key``'s causal arc with a flow-finish ``f``. A no-op
+        unless the arc is open, so emission is valid by construction
+        (every ``f`` has its ``s``; never two ``f``)."""
+        with self._lock:
+            if not self._recording or key not in self._flow_open:
+                return
+            fid = self._flow_ids[key]
+            self._flow_open.discard(key)
+            self._emit_locked("f", name, attrs or None, cat="flow",
+                              extra={"id": fid, "bp": "e"})
 
     # -- cycle ring ---------------------------------------------------------
 

@@ -4,6 +4,9 @@ Usage::
 
     python -m volcano_tpu.obs.validate trace.json        # schema-check a
                                                          # --trace-out file
+    python -m volcano_tpu.obs.validate --flows fed.json  # + federated
+                                                         # flow-arc/lane
+                                                         # contract
     python -m volcano_tpu.obs.validate --metrics-scrape  # serve+scrape
                                                          # /metrics (prom
                                                          # AND fallback)
@@ -25,8 +28,8 @@ import sys
 import urllib.request
 
 
-def check_trace(path: str) -> int:
-    from .export import validate_chrome_trace
+def check_trace(path: str, flows: bool = False) -> int:
+    from .export import flow_summary, validate_chrome_trace
     with open(path) as f:
         obj = json.load(f)
     spans = validate_chrome_trace(obj)
@@ -39,6 +42,27 @@ def check_trace(path: str) -> int:
         print(f"{path}: expected span names missing: {sorted(missing)}",
               file=sys.stderr)
         return 1
+    if flows:
+        # federated merged-trace contract: the causal arcs exist (flow
+        # starts AND finishes — an intent with no completion arc means
+        # the flow_end wiring regressed), and the partitions landed in
+        # DISTINCT process lanes (pid = partition + 1)
+        fs = flow_summary(obj["traceEvents"])
+        problems = []
+        if not fs["started"]:
+            problems.append("no flow arcs started (s-phase events)")
+        if not fs["finished"]:
+            problems.append("no flow arcs finished (f-phase events)")
+        if len(fs["lanes"]) < 2:
+            problems.append(f"expected >=2 partition lanes, saw pids "
+                            f"{sorted(fs['lanes'])}")
+        if problems:
+            for p in problems:
+                print(f"{path}: {p}", file=sys.stderr)
+            return 1
+        print(f"{path}: flows OK — {fs['started']} started, "
+              f"{fs['steps']} steps, {fs['finished']} finished, "
+              f"lanes {sorted(fs['lanes'])}")
     print(f"{path}: OK — {spans} spans, {len(names)} distinct names, "
           f"{len(obj['traceEvents'])} events")
     return 0
@@ -136,12 +160,16 @@ def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "--metrics-scrape":
         return check_metrics_scrape()
+    flows = False
+    if argv and argv[0] == "--flows":
+        flows = True
+        argv = argv[1:]
     if not argv:
         print(__doc__, file=sys.stderr)
         return 2
     rc = 0
     for path in argv:
-        rc = max(rc, check_trace(path))
+        rc = max(rc, check_trace(path, flows=flows))
     return rc
 
 

@@ -30,6 +30,7 @@ from .framework import (abandon_session, close_session, get_action,
                         open_session, parse_scheduler_conf)
 from .framework.conf import SchedulerConfiguration
 from .obs import audit as obs_audit
+from .obs import lifecycle as obs_lifecycle
 from .obs import trace as obs_trace
 
 log = logging.getLogger(__name__)
@@ -416,6 +417,18 @@ class Scheduler:
         timing is recorded once."""
         rec = obs_trace.TRACE
         cycle = self._cycles_run
+        # pin the ambient correlation context (obs/lifecycle.py) every
+        # funnel-level stamp of this cycle inherits; a federated member
+        # also claims its own lane (pid) in the merged Chrome trace
+        part = self.federation.pid if self.federation is not None \
+            else getattr(self.cache, "obs_part", 0)
+        if hasattr(self.cache, "obs_part"):
+            self.cache.obs_part = part
+        obs_lifecycle.TIMELINE.set_context(
+            cycle=cycle, part=part, epoch=self.current_fencing_epoch(),
+            t=self.clock.time())
+        if self.federation is not None:
+            rec.set_pid(part + 1)
         began = rec.enabled
         if began:
             rec.begin_cycle(cycle)

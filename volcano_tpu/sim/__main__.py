@@ -293,6 +293,13 @@ def main(argv=None) -> int:
                          "injected, a quarantined device readmitted, and "
                          "the CPU-placer rung never reached (exit 1 "
                          "otherwise); implies --mesh-chaos")
+    ap.add_argument("--lifecycle", action="store_true",
+                    help="derive the cluster-causal latency/SLO report "
+                         "sections from the per-job lifecycle timelines "
+                         "(obs/lifecycle.py): per-class ttfb/admission/"
+                         "ack/jct percentiles plus the SLO burn-rate "
+                         "evaluation; off by default so fault-free "
+                         "decision planes stay byte-identical")
     ap.add_argument("--verify-pipelined-equivalence", action="store_true",
                     help="also run the SERIAL single-scheduler oracle "
                          "and assert equivalence: byte-identical "
@@ -485,7 +492,8 @@ def main(argv=None) -> int:
                            topology_weight=args.topology_weight,
                            mesh_chaos=mesh_chaos and mesh_r > 0,
                            mesh_fault_rate=mesh_r,
-                           mesh_fault_seed=args.mesh_fault_seed)
+                           mesh_fault_seed=args.mesh_fault_seed,
+                           lifecycle=args.lifecycle)
         return runner.run()
 
     if args.trace_out:

@@ -247,6 +247,15 @@ def build_report(runner, actions_ms: Dict[tuple, list],
         report["speculation"] = runner.speculation_stats()
     if getattr(runner, "fast_admit_mode", False):
         report["fast_admit"] = runner.fast_admit_stats()
+    if getattr(runner, "lifecycle", False):
+        # the cluster-causal plane (obs/lifecycle.py + obs/slo.py):
+        # per-class latency attribution derived from the job timelines,
+        # and the SLO engine's burn-rate evaluation at end-of-run. Both
+        # are pure functions of the virtual-time event stream, so
+        # decision-plane material — and only emitted under --lifecycle,
+        # so every pre-lifecycle scenario stays byte-identical.
+        report["latency"] = runner.lifecycle_stats()
+        report["slo"] = runner.slo_status()
     if getattr(runner, "elastic_gangs", False) \
             or getattr(runner, "_command_funnel", None) is not None:
         # elastic GANGS (docs/design/elastic-gangs.md — distinct from

@@ -63,6 +63,7 @@ from ..elastic_gang.membership import (ELASTIC_DESIRED_ANNOTATION,
                                        TOPOLOGY_ZONE_LABEL, is_elastic)
 from ..chaos import (AckFaultInjector, KillPointBinder, KillPointEvictor,
                      SimKill)
+from ..obs.trace import TRACE as OBS_TRACE
 from ..scheduler import ROLE_LEADER, Scheduler
 from .trace import TraceEvent
 from . import report as report_mod
@@ -314,7 +315,8 @@ class SimRunner:
                  mesh_chaos: bool = False,
                  mesh_fault_rate: float = 0.0,
                  mesh_fault_plan: Optional[Dict[str, Sequence[int]]] = None,
-                 mesh_fault_seed: Optional[int] = None):
+                 mesh_fault_seed: Optional[int] = None,
+                 lifecycle: bool = False):
         self.trace = list(trace)
         self.period = period
         self.seed = seed
@@ -551,6 +553,20 @@ class SimRunner:
         # instead of wherever the host's wall clock lands
         from ..device_health import DEVICE_HEALTH
         DEVICE_HEALTH.reset(time_fn=self.clock.time)
+        # lifecycle timelines (obs/lifecycle.py): the store records in
+        # every mode (it observes, never influences), but the derived
+        # report sections (latency/slo) are emitted only under the
+        # explicit --lifecycle flag so fault-free decision planes stay
+        # byte-identical. Cleared here so back-to-back runs in one
+        # process mint the same deterministic event ids.
+        self.lifecycle = bool(lifecycle)
+        from ..obs.lifecycle import TIMELINE
+        self._timeline = TIMELINE
+        self._timeline.clear()
+        self._slo_engine = None
+        if self.lifecycle:
+            from ..obs.slo import SLOEngine
+            self._slo_engine = SLOEngine(period=period)
         # per-SHARD mesh chaos (docs/robustness.md mesh failure model): a
         # seeded MeshFaultInjector on the allocate fault hook attributes
         # each fault to a live shard, so the per-device lattice
@@ -742,6 +758,10 @@ class SimRunner:
             self.sheds += 1
             self.shed_reasons[exc.reason] = \
                 self.shed_reasons.get(exc.reason, 0) + 1
+            # lifecycle breadcrumb: the shed IS the job's first timeline
+            # event — a gang refused at the door still explains itself
+            self._timeline.record(jid, "shed", t=t, reason=exc.reason,
+                                  queue=d["queue"])
             heapq.heappush(self._retry_heap,
                            (t + exc.retry_after_s,
                             next(self._retry_seq), dict(d)))
@@ -1023,6 +1043,7 @@ class SimRunner:
             self.arrival_time[jid] = t
             self.duration[jid] = d["duration"]
             self.arrived += 1
+            self._timeline.record(jid, "arrival", t=t, queue=d["queue"])
             thunk = self.world.submit_job(0, t, d)
             try:
                 thunk()
@@ -1064,6 +1085,7 @@ class SimRunner:
         self.arrival_time[name] = t
         self.duration[name] = d["duration"]
         self.arrived += 1
+        self._timeline.record(name, "arrival", t=t, queue=d["queue"])
 
     def _fail_node(self, name: str) -> None:
         """The node dies with its tasks: lost members re-queue PENDING and
@@ -1139,6 +1161,11 @@ class SimRunner:
             touched_any = touched or touched_any
         if not touched_any:
             return
+        if not via_ack:
+            # cluster-initiated loss (node death): the ack funnel never
+            # saw it, so the runner records the requeue milestone itself
+            self._timeline.record(jid, "requeue", task=uid,
+                                  node=lost_node or None)
         self._note_requeue(uid)
         self.requeues += 1
         if jid in self.admitted_at:
@@ -1182,6 +1209,8 @@ class SimRunner:
             self.admitted_at.pop(uid, None)
             self._credit_admission(uid)
             self.jct.append(t - self.arrival_time[uid])
+            self._timeline.record(uid, "complete", t=t)
+            OBS_TRACE.flow_end("complete", f"job:{uid}")
             self.completed += 1
             return
         vjob = self._job(uid)
@@ -1202,6 +1231,8 @@ class SimRunner:
         self.admitted_at.pop(uid, None)
         self._credit_admission(uid)
         self.jct.append(t - self.arrival_time[uid])
+        self._timeline.record(uid, "complete", t=t)
+        OBS_TRACE.flow_end("complete", f"job:{uid}")
         self.completed += 1
 
     def _note_colocation(self, vjob) -> None:
@@ -1237,6 +1268,11 @@ class SimRunner:
         consumed by each cache's FeedbackChannel normalizer (the watch
         stream is cluster-wide, so deliveries fan out to every replica
         cache)."""
+        # re-pin the ambient virtual time: feedback runs BETWEEN cycles,
+        # so timeline events minted here (running/evicted acks, bind/
+        # admitted milestones) carry the feedback instant, not the
+        # previous cycle's
+        self._timeline.set_context(t=now)
         touched: Dict[str, bool] = {}
         seq = self.binder.sequence
         while self._binds_seen < len(seq):
@@ -1272,6 +1308,9 @@ class SimRunner:
             if jid not in self.first_bind:
                 self.first_bind[jid] = now
                 self.queueing_delay.append(now - self.arrival_time[jid])
+                # harvested first bind — the same instant queueing_delay
+                # samples, so timeline ttfb and the JCT bookkeeping agree
+                self._timeline.record(jid, "bind", t=now, node=host)
             touched[jid] = True
         eseq = self.evictor.sequence
         while self._evicts_seen < len(eseq):
@@ -1321,6 +1360,7 @@ class SimRunner:
                     and job.ready_task_num() >= job.min_available:
                 self.admitted_at[jid] = now
                 self.gang_admission.append(now - self.arrival_time[jid])
+                self._timeline.record(jid, "admitted", t=now)
                 epoch = self._admit_epoch.get(jid, 0)
                 heapq.heappush(self._completions,
                                (now + self.duration[jid], next(self._cseq),
@@ -2595,6 +2635,35 @@ class SimRunner:
                 resolved.get(k, 0)
                 for k in ("repaired", "rolled_back", "reissued")),
         }
+
+    def lifecycle_stats(self) -> Dict[str, object]:
+        """The report's ``latency`` section (--lifecycle only): per queue
+        class, percentiles of every timeline-derived latency span —
+        ttfb_s must agree with ``queueing_delay_s`` and jct_s with
+        ``jct_s`` above (the oracle-parity contract the lifecycle tests
+        assert), because both planes sample the SAME virtual instants."""
+        from ..obs.lifecycle import latency_classes
+        classes = latency_classes(self._timeline)
+        stats = self._timeline.stats()
+        return {
+            "classes": {
+                cls: {kind: report_mod.percentiles(vals)
+                      for kind, vals in sorted(kinds.items())}
+                for cls, kinds in sorted(classes.items())},
+            "timeline": {
+                "jobs": stats["jobs"],
+                "events": stats["events"],
+                "lru_evicted": stats["evicted"],
+                "duplicates_dropped": stats["duplicates_dropped"],
+            },
+        }
+
+    def slo_status(self) -> List[dict]:
+        """End-of-run SLO evaluation (--lifecycle only), published to the
+        metrics plane as it goes so /healthz?detail and the gauges agree
+        with the report."""
+        return self._slo_engine.publish(self._timeline,
+                                        now=self.clock.time())
 
     def run(self) -> dict:
         """Run the trace to completion (or stall/max_cycles); returns the
